@@ -1,0 +1,42 @@
+"""Test fixtures: fake multi-chip mesh on CPU.
+
+Mirrors the reference's ``python/ray/cluster_utils.py:10`` pattern (boot a
+multi-node topology on one host so distributed code paths run in CI): here we
+force the JAX host platform to expose 8 virtual CPU devices so every mesh /
+collective / sharding test executes the real multi-device code without TPUs.
+
+This file must run before anything imports jax, which pytest guarantees for
+conftest-level env mutation as long as tests import jax lazily (inside test
+modules, which import after conftest is loaded).
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    import jax
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs[:8]
+
+
+@pytest.fixture
+def mesh8(devices8):
+    import numpy as np
+    from jax.sharding import Mesh
+    return Mesh(np.array(devices8).reshape(4, 2), ("dp", "tp"))
+
+
+@pytest.fixture
+def mesh1d(devices8):
+    import numpy as np
+    from jax.sharding import Mesh
+    return Mesh(np.array(devices8), ("x",))

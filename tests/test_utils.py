@@ -1,0 +1,112 @@
+import json
+import os
+
+import pytest
+
+from tosem_tpu.utils.flags import FlagSet
+from tosem_tpu.utils.results import ResultRow, ResultWriter, read_results, SCHEMA
+from tosem_tpu.utils.manifest import Manifest, load_manifest, merge_params
+from tosem_tpu.utils.timing import time_fn, matmul_flops, conv2d_flops, gflops
+
+
+def make_flags():
+    fs = FlagSet()
+    fs.define_string("name", "x", "a name")
+    fs.define_integer("iters", 10, "iterations")
+    fs.define_float("lr", 0.1, "learning rate")
+    fs.define_bool("debug", False, "debug mode")
+    fs.define_list("tags", ["a"], "tags")
+    fs.define_enum("device", "tpu", ["tpu", "cpu"], "device")
+    return fs
+
+
+class TestFlags:
+    def test_defaults(self):
+        fs = make_flags()
+        assert fs.name == "x" and fs.iters == 10 and fs.debug is False
+
+    def test_parse_equals_and_space(self):
+        fs = make_flags()
+        rest = fs.parse_args(["--iters=5", "--lr", "0.5", "pos"])
+        assert fs.iters == 5 and fs.lr == 0.5 and rest == ["pos"]
+
+    def test_bool_forms(self):
+        fs = make_flags()
+        fs.parse_args(["--debug"])
+        assert fs.debug is True
+        fs.parse_args(["--nodebug"])
+        assert fs.debug is False
+        fs.parse_args(["--debug=true"])
+        assert fs.debug is True
+
+    def test_list_and_enum(self):
+        fs = make_flags()
+        fs.parse_args(["--tags=a,b,c", "--device=cpu"])
+        assert fs.tags == ["a", "b", "c"] and fs.device == "cpu"
+        with pytest.raises(ValueError):
+            fs.parse_args(["--device=gpu"])
+
+    def test_unknown_flag(self):
+        fs = make_flags()
+        with pytest.raises(ValueError):
+            fs.parse_args(["--nope=1"])
+
+    def test_env_override(self):
+        fs = make_flags()
+        fs.apply_env({"TOSEM_ITERS": "42"})
+        assert fs.iters == 42
+
+    def test_reset(self):
+        fs = make_flags()
+        fs.set("iters", 99)
+        fs.reset()
+        assert fs.iters == 10
+
+
+class TestResults:
+    def test_roundtrip(self, tmp_path):
+        path = str(tmp_path / "r.csv")
+        with ResultWriter(path) as w:
+            w.add(ResultRow(project="ops", config="gemm", bench_id="gemm_1024",
+                            metric="gflops", value=123.4, unit="GFLOPS",
+                            device="cpu", n_devices=1, extra={"m": 1024}))
+        rows = read_results(path)
+        assert len(rows) == 1
+        r = rows[0]
+        assert r["value"] == 123.4 and r["extra"]["m"] == 1024
+        assert list(r.keys()) == SCHEMA
+
+    def test_append_no_double_header(self, tmp_path):
+        path = str(tmp_path / "r.csv")
+        for _ in range(2):
+            with ResultWriter(path) as w:
+                w.add(ResultRow("p", "c", "b", "m", 1.0, "u"))
+        rows = read_results(path)
+        assert len(rows) == 2
+
+
+class TestManifest:
+    def test_load_yaml(self, tmp_path):
+        p = tmp_path / "exp.yaml"
+        p.write_text("name: sweep\ndevice: cpu\nconfigs: [gemm]\nbatch: 8\n")
+        m = load_manifest(str(p))
+        assert m.name == "sweep" and m.device == "cpu"
+        assert m.configs == ["gemm"] and m.params["batch"] == 8
+
+    def test_merge(self):
+        out = merge_params({"a": 1, "b": {"c": 2, "d": 3}}, {"b": {"c": 9}})
+        assert out == {"a": 1, "b": {"c": 9, "d": 3}}
+
+
+class TestTiming:
+    def test_flops_formulas(self):
+        assert matmul_flops(2, 3, 4) == 48
+        assert conv2d_flops(1, 2, 2, 8, 3, 3, 4) == 2 * 2 * 2 * 8 * 3 * 3 * 4
+        assert gflops(2e9, 2.0) == 1.0
+
+    def test_time_fn_on_jax(self):
+        import jax.numpy as jnp
+        import jax
+        f = jax.jit(lambda: jnp.ones((8, 8)) @ jnp.ones((8, 8)))
+        st = time_fn(f, iters=3, warmup=1, name="mm")
+        assert st.iters == 3 and st.mean_s > 0 and st.min_s <= st.mean_s

@@ -1,0 +1,20 @@
+"""tosem_tpu: a TPU-native framework with the capabilities of the
+TOSEM-2021 replication package (openjamoses/TOSEM-2021-Replication).
+
+The reference package bundles nine ML systems (Ray, Apollo/Cyber RT,
+DeepSpeech, NNI, NuPIC, auto-sklearn, AutoKeras, TPOT, EfficientDet) whose
+GPU compute kernels, NCCL collectives, training loops, and experiment
+harnesses this framework re-expresses TPU-first:
+
+- ``tosem_tpu.ops``       XLA/Pallas compute kernels (the CUDA/cuBLAS/cuDNN layer)
+- ``tosem_tpu.parallel``  device meshes + ICI/DCN collectives (the NCCL/Gloo layer)
+- ``tosem_tpu.nn``        functional module system (params-as-pytrees)
+- ``tosem_tpu.models``    model families (ResNet, BERT, speech, detection, HTM)
+- ``tosem_tpu.train``     pjit training loops, checkpoint/resume
+- ``tosem_tpu.runtime``   host-side task/actor runtime (the Ray-core layer)
+- ``tosem_tpu.tune``      trial runner + schedulers + search (the Tune/NNI layer)
+- ``tosem_tpu.profiler``  trace capture + CSV analysis schema (the nvprof layer)
+- ``tosem_tpu.utils``     flags, yaml experiment manifests, CSV results, timing
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,163 @@
+"""Typed flag registry + CLI parser.
+
+Plays the role of the reference's absl-flags modules (DeepSpeech defines ~87
+flags in ``training/deepspeech_training/util/flags.py`` and materialises them
+into a global Config in ``util/config.py``; Ray uses ``ray_constants.py`` +
+env-var-driven ``src/ray/common/ray_config_def.h``). This is a small
+self-contained equivalent: typed definitions, ``--name=value`` / ``--name
+value`` parsing, environment-variable overrides (``TOSEM_<NAME>``), and yaml
+merge for experiment manifests.
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+
+def _parse_bool(s: str) -> bool:
+    if isinstance(s, bool):
+        return s
+    v = s.strip().lower()
+    if v in ("1", "true", "yes", "on"):
+        return True
+    if v in ("0", "false", "no", "off"):
+        return False
+    raise ValueError(f"not a boolean: {s!r}")
+
+
+@dataclass
+class _Flag:
+    name: str
+    default: Any
+    help: str
+    parser: Callable[[str], Any]
+    choices: Optional[List[Any]] = None
+    value: Any = None
+
+    def set(self, raw: Any) -> None:
+        val = self.parser(raw) if isinstance(raw, str) else raw
+        if self.choices is not None and val not in self.choices:
+            raise ValueError(
+                f"--{self.name}={val!r} not in allowed choices {self.choices}"
+            )
+        self.value = val
+
+
+class FlagSet:
+    """A registry of typed flags with CLI/env/yaml binding."""
+
+    def __init__(self, env_prefix: str = "TOSEM_"):
+        self._flags: Dict[str, _Flag] = {}
+        self._env_prefix = env_prefix
+
+    # -- definitions -------------------------------------------------------
+    def define_string(self, name, default=None, help=""):
+        self._define(name, default, help, str)
+
+    def define_integer(self, name, default=None, help=""):
+        self._define(name, default, help, int)
+
+    def define_float(self, name, default=None, help=""):
+        self._define(name, default, help, float)
+
+    def define_bool(self, name, default=False, help=""):
+        self._define(name, default, help, _parse_bool)
+
+    def define_list(self, name, default=None, help=""):
+        self._define(name, list(default or []), help,
+                     lambda s: [t for t in s.split(",") if t])
+
+    def define_enum(self, name, default, choices, help=""):
+        self._define(name, default, help, str, choices=list(choices))
+
+    def _define(self, name, default, help, parser, choices=None):
+        if name in self._flags:
+            raise ValueError(f"flag {name!r} already defined")
+        f = _Flag(name=name, default=default, help=help, parser=parser,
+                  choices=choices)
+        f.value = default
+        self._flags[name] = f
+
+    # -- access ------------------------------------------------------------
+    def __getattr__(self, name: str) -> Any:
+        flags = object.__getattribute__(self, "_flags")
+        if name in flags:
+            return flags[name].value
+        raise AttributeError(name)
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._flags
+
+    def get(self, name: str, default: Any = None) -> Any:
+        f = self._flags.get(name)
+        return default if f is None else f.value
+
+    def set(self, name: str, value: Any) -> None:
+        if name not in self._flags:
+            raise KeyError(f"unknown flag {name!r}")
+        self._flags[name].set(value)
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {n: f.value for n, f in self._flags.items()}
+
+    def reset(self) -> None:
+        for f in self._flags.values():
+            f.value = f.default
+
+    # -- binding -----------------------------------------------------------
+    def apply_env(self, environ=None) -> None:
+        environ = os.environ if environ is None else environ
+        for name, f in self._flags.items():
+            key = self._env_prefix + name.upper()
+            if key in environ:
+                f.set(environ[key])
+
+    def parse_args(self, argv: List[str]) -> List[str]:
+        """Parse ``--name=value`` / ``--name value`` / ``--nobool``.
+
+        Returns leftover (positional) args. Unknown flags raise.
+        """
+        leftover: List[str] = []
+        i = 0
+        while i < len(argv):
+            arg = argv[i]
+            if not arg.startswith("--"):
+                leftover.append(arg)
+                i += 1
+                continue
+            body = arg[2:]
+            if "=" in body:
+                name, raw = body.split("=", 1)
+                self._require(name).set(raw)
+            elif body.startswith("no") and body[2:] in self._flags and isinstance(
+                    self._flags[body[2:]].default, bool):
+                self._flags[body[2:]].set(False)
+            elif body in self._flags and isinstance(self._flags[body].default, bool):
+                self._flags[body].set(True)
+            else:
+                if i + 1 >= len(argv):
+                    raise ValueError(f"flag --{body} missing value")
+                self._require(body).set(argv[i + 1])
+                i += 1
+            i += 1
+        return leftover
+
+    def apply_mapping(self, mapping: Dict[str, Any]) -> None:
+        for k, v in mapping.items():
+            self.set(k, v)
+
+    def _require(self, name: str) -> _Flag:
+        if name not in self._flags:
+            raise ValueError(f"unknown flag --{name}")
+        return self._flags[name]
+
+    def usage(self) -> str:
+        lines = []
+        for n, f in sorted(self._flags.items()):
+            extra = f" (choices: {f.choices})" if f.choices else ""
+            lines.append(f"  --{n}={f.default!r}\t{f.help}{extra}")
+        return "\n".join(lines)
+
+
+GLOBAL_FLAGS = FlagSet()

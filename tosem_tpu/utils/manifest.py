@@ -1,0 +1,60 @@
+"""YAML experiment manifests.
+
+Role model: NNI's yaml experiment config (validated in
+``nni/experiment/config/``) and EfficientDet's ``--hparams=voc_config.yaml``
+override pattern (``hparams_config.py``). A manifest names a config, a device,
+and free-form parameter overrides; ``load_manifest`` merges it over defaults.
+"""
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+try:
+    import yaml  # pyyaml ships with the baked-in stack (transformers dep)
+    _HAVE_YAML = True
+except Exception:  # pragma: no cover
+    yaml = None
+    _HAVE_YAML = False
+
+import json
+
+
+@dataclass
+class Manifest:
+    name: str
+    device: str = "tpu"
+    configs: list = field(default_factory=list)
+    params: Dict[str, Any] = field(default_factory=dict)
+    results_csv: str = "results/results.csv"
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Manifest":
+        known = {k: v for k, v in d.items() if k in cls.__dataclass_fields__}
+        extra = {k: v for k, v in d.items() if k not in cls.__dataclass_fields__}
+        m = cls(**known)
+        m.params.update(extra)
+        return m
+
+
+def load_manifest(path: str) -> Manifest:
+    with open(path) as f:
+        text = f.read()
+    if _HAVE_YAML:
+        data = yaml.safe_load(text)
+    else:  # yaml unavailable: accept JSON manifests
+        data = json.loads(text)
+    if not isinstance(data, dict):
+        raise ValueError(f"manifest {path} must be a mapping")
+    return Manifest.from_dict(data)
+
+
+def merge_params(defaults: Dict[str, Any], overrides: Dict[str, Any]) -> Dict[str, Any]:
+    out = copy.deepcopy(defaults)
+    for k, v in overrides.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = merge_params(out[k], v)
+        else:
+            out[k] = v
+    return out

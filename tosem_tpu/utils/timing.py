@@ -1,0 +1,79 @@
+"""Benchmark timing harness for jitted TPU computations.
+
+Equivalent role to the reference's CUDA-event timing around kernel launches
+(e.g. Apollo's ``modules/perception/inference/utils/gemm.cu`` measured under
+nvprof) and Ray's ``python/ray/ray_perf.py:74`` ``timeit`` harness. On TPU the
+only correct recipe is: jit, run once to compile, then wall-time loops ended
+with ``block_until_ready`` (dispatch is async).
+"""
+from __future__ import annotations
+
+import statistics
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+import jax
+
+
+@dataclass
+class BenchStats:
+    name: str
+    iters: int
+    mean_s: float
+    std_s: float
+    min_s: float
+    p50_s: float
+
+    @property
+    def mean_ms(self) -> float:
+        return self.mean_s * 1e3
+
+    def throughput(self, work_per_iter: float) -> float:
+        """work units / second based on mean time."""
+        return work_per_iter / self.mean_s if self.mean_s > 0 else float("inf")
+
+
+def _block(x: Any) -> None:
+    jax.tree_util.tree_map(
+        lambda v: v.block_until_ready() if hasattr(v, "block_until_ready") else v, x)
+
+
+def time_fn(fn: Callable[[], Any], *, iters: int = 20, warmup: int = 3,
+            name: str = "bench", inner: int = 1) -> BenchStats:
+    """Time ``fn`` (returning device arrays) with compile warmup.
+
+    ``inner`` repeats fn per timed sample (for very fast ops, time the batch
+    and divide — same trick as ``ray_perf``'s loops).
+    """
+    for _ in range(max(1, warmup)):
+        _block(fn())
+    samples = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(inner):
+            out = fn()
+        _block(out)
+        samples.append((time.perf_counter() - t0) / inner)
+    return BenchStats(
+        name=name,
+        iters=iters,
+        mean_s=statistics.fmean(samples),
+        std_s=statistics.pstdev(samples) if len(samples) > 1 else 0.0,
+        min_s=min(samples),
+        p50_s=statistics.median(samples),
+    )
+
+
+def gflops(flop_count: float, seconds: float) -> float:
+    return flop_count / seconds / 1e9 if seconds > 0 else float("inf")
+
+
+def matmul_flops(m: int, n: int, k: int) -> float:
+    return 2.0 * m * n * k
+
+
+def conv2d_flops(n: int, h_out: int, w_out: int, c_out: int, kh: int, kw: int,
+                 c_in: int) -> float:
+    return 2.0 * n * h_out * w_out * c_out * kh * kw * c_in
