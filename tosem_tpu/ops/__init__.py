@@ -1,0 +1,2 @@
+from tosem_tpu.ops.gemm import gemm, gemm_bench, GemmSpec
+from tosem_tpu.ops.conv import conv2d, conv_bench, ConvSpec, RESNET50_CONV_SWEEP

@@ -1,0 +1,130 @@
+"""Conv2D on the MXU — the cuDNN layer sweep, TPU-style.
+
+North-star config 2 re-runs the cuDNN conv2d shape sweep over ResNet-50
+layer configs. The reference exercises cuDNN through TF towers
+(DeepSpeech ``train.py:312``) and TensorRT plugins
+(``modules/perception/inference/tensorrt/plugins``); here each shape is one
+``lax.conv_general_dilated`` jitted under a fixed NHWC layout (TPU's native
+layout — NCHW costs a relayout, the survey's §7 "conv layouts change
+achievable FLOPS" point).
+"""
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tosem_tpu.utils.results import ResultRow
+from tosem_tpu.utils.timing import (BenchStats, DeviceLoopBench, conv2d_flops,
+                                    time_fn)
+
+_PRECISION = {
+    "float32": lax.Precision.HIGHEST,
+    "default": lax.Precision.DEFAULT,
+}
+
+
+@dataclass(frozen=True)
+class ConvSpec:
+    name: str
+    batch: int
+    h: int
+    w: int
+    c_in: int
+    c_out: int
+    kh: int
+    kw: int
+    stride: int = 1
+    dtype: str = "float32"
+    precision: str = "float32"
+
+    @property
+    def bench_id(self) -> str:
+        return (f"conv_{self.name}_b{self.batch}_{self.h}x{self.w}x{self.c_in}"
+                f"_k{self.kh}x{self.kw}s{self.stride}_{self.c_out}_{self.dtype}")
+
+    @property
+    def out_hw(self) -> Tuple[int, int]:
+        # SAME padding
+        return (-(-self.h // self.stride), -(-self.w // self.stride))
+
+    @property
+    def flops(self) -> float:
+        ho, wo = self.out_hw
+        return conv2d_flops(self.batch, ho, wo, self.c_out, self.kh, self.kw,
+                            self.c_in)
+
+
+@functools.partial(jax.jit, static_argnames=("stride", "precision"))
+def conv2d(x: jax.Array, w: jax.Array, stride: int = 1,
+           precision: str = "float32") -> jax.Array:
+    """NHWC x HWIO -> NHWC convolution with SAME padding."""
+    return lax.conv_general_dilated(
+        x, w, window_strides=(stride, stride), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        precision=_PRECISION[precision])
+
+
+def conv_bench(spec: ConvSpec, *, n_iter: int = 0, reps: int = 3,
+               seed: int = 0) -> Tuple[BenchStats, ResultRow]:
+    """Pure kernel time for one conv shape (on-device loop, see gemm_bench).
+
+    The perturbed operand is the *weights* (small), so the chain feedback
+    adds negligible HBM traffic next to the conv itself.
+    """
+    kx, kw_ = jax.random.split(jax.random.PRNGKey(seed))
+    dt = jnp.dtype(spec.dtype)
+    x = jax.random.normal(kx, (spec.batch, spec.h, spec.w, spec.c_in),
+                          dtype=jnp.float32).astype(dt)
+    w = jax.random.normal(kw_, (spec.kh, spec.kw, spec.c_in, spec.c_out),
+                          dtype=jnp.float32).astype(dt)
+    x, w = jax.device_put(x), jax.device_put(w)
+    stride, prec = spec.stride, spec.precision
+    bench = DeviceLoopBench(
+        op=lambda xx, ww: conv2d(xx, ww, stride, prec), args=(x, w), perturb=1)
+    sec = bench.time(n_iter=n_iter, reps=reps)
+    stats = BenchStats(name=spec.bench_id, iters=reps, mean_s=sec, std_s=0.0,
+                       min_s=sec, p50_s=sec)
+    gf = spec.flops / stats.min_s / 1e9
+    row = ResultRow(
+        project="ops", config="conv_sweep", bench_id=spec.bench_id,
+        metric="gflops", value=gf, unit="GFLOPS",
+        device=jax.devices()[0].platform, n_devices=1,
+        extra={"batch": spec.batch, "hw": [spec.h, spec.w],
+               "c_in": spec.c_in, "c_out": spec.c_out,
+               "k": [spec.kh, spec.kw], "stride": spec.stride,
+               "dtype": spec.dtype, "mean_ms": stats.mean_ms},
+    )
+    return stats, row
+
+
+def _resnet50_specs(batch: int, dtype: str, precision: str) -> List[ConvSpec]:
+    """The distinct conv layer shapes of ResNet-50 at 224x224 input."""
+    raw = [
+        # name,            h,   w, cin, cout, kh, kw, stride
+        ("conv1",         224, 224,   3,   64, 7, 7, 2),
+        ("conv2_1x1a",     56,  56,  64,   64, 1, 1, 1),
+        ("conv2_3x3",      56,  56,  64,   64, 3, 3, 1),
+        ("conv2_1x1b",     56,  56,  64,  256, 1, 1, 1),
+        ("conv3_down",     56,  56, 256,  128, 1, 1, 2),
+        ("conv3_3x3",      28,  28, 128,  128, 3, 3, 1),
+        ("conv3_1x1b",     28,  28, 128,  512, 1, 1, 1),
+        ("conv4_down",     28,  28, 512,  256, 1, 1, 2),
+        ("conv4_3x3",      14,  14, 256,  256, 3, 3, 1),
+        ("conv4_1x1b",     14,  14, 256, 1024, 1, 1, 1),
+        ("conv5_down",     14,  14, 1024, 512, 1, 1, 2),
+        ("conv5_3x3",       7,   7, 512,  512, 3, 3, 1),
+        ("conv5_1x1b",      7,   7, 512, 2048, 1, 1, 1),
+    ]
+    return [ConvSpec(n, batch, h, w, ci, co, kh, kw, s, dtype, precision)
+            for (n, h, w, ci, co, kh, kw, s) in raw]
+
+
+RESNET50_CONV_SWEEP = _resnet50_specs(batch=32, dtype="float32",
+                                      precision="float32")
+RESNET50_CONV_SWEEP_BF16 = _resnet50_specs(batch=32, dtype="bfloat16",
+                                           precision="default")

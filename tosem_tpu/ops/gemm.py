@@ -1,0 +1,96 @@
+"""GEMM on the MXU.
+
+TPU-native re-expression of the reference's cuBLAS GEMM wrapper
+(``src/apollo/v6.0.0/modules/perception/inference/utils/gemm.cu:107-121``
+calls ``cublasSgemm`` through a singleton handle,
+``cuda_util.cu:43-62``) and of north-star config 1 (single-op GEMM
+microbench, 1024x1024x1024 fp32). Here the "handle" is XLA: ``jnp.dot``
+under ``jax.jit`` tiles directly onto the 128x128 systolic array; precision
+is pinned per-call so fp32 numbers are honest fp32 (the TF32 ambiguity the
+survey flags in §7 does not arise).
+"""
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tosem_tpu.utils.results import ResultRow
+from tosem_tpu.utils.timing import (BenchStats, DeviceLoopBench, matmul_flops,
+                                    time_fn)
+
+# Precision names map to jax.lax.Precision: "float32" forces full fp32
+# accumulation (HIGHEST); "default" lets the MXU use bf16 passes.
+_PRECISION = {
+    "float32": lax.Precision.HIGHEST,
+    "tensorfloat32": lax.Precision.HIGH,
+    "default": lax.Precision.DEFAULT,
+}
+
+
+@dataclass(frozen=True)
+class GemmSpec:
+    m: int
+    n: int
+    k: int
+    dtype: str = "float32"        # operand dtype
+    precision: str = "float32"    # accumulation discipline
+
+    @property
+    def bench_id(self) -> str:
+        return f"gemm_{self.m}x{self.n}x{self.k}_{self.dtype}_{self.precision}"
+
+    @property
+    def flops(self) -> float:
+        return matmul_flops(self.m, self.n, self.k)
+
+
+@functools.partial(jax.jit, static_argnames=("precision",))
+def gemm(a: jax.Array, b: jax.Array, precision: str = "float32") -> jax.Array:
+    return jnp.dot(a, b, precision=_PRECISION[precision])
+
+
+def gemm_bench(spec: GemmSpec, *, n_iter: int = 0, reps: int = 3,
+               seed: int = 0, device: Optional[str] = None
+               ) -> Tuple[BenchStats, ResultRow]:
+    """Time one GEMM shape; returns stats + a schema row for the results CSV.
+
+    Timing runs on-device (chained ``fori_loop``, one dispatch) so the
+    number is pure kernel time — the analog of nvprof's kernel duration for
+    ``cublasSgemm``, not launch+sync wall time.
+    """
+    key_a, key_b = jax.random.split(jax.random.PRNGKey(seed))
+    dt = jnp.dtype(spec.dtype)
+    a = jax.random.normal(key_a, (spec.m, spec.k), dtype=jnp.float32).astype(dt)
+    b = jax.random.normal(key_b, (spec.k, spec.n), dtype=jnp.float32).astype(dt)
+    a, b = jax.device_put(a), jax.device_put(b)
+    prec = spec.precision
+    bench = DeviceLoopBench(
+        op=lambda x, y: gemm(x, y, prec), args=(a, b), perturb=0)
+    sec = bench.time(n_iter=n_iter, reps=reps)
+    stats = BenchStats(name=spec.bench_id, iters=reps, mean_s=sec, std_s=0.0,
+                       min_s=sec, p50_s=sec)
+    gf = spec.flops / stats.min_s / 1e9
+    platform = device or jax.devices()[0].platform
+    row = ResultRow(
+        project="ops", config="gemm", bench_id=spec.bench_id,
+        metric="gflops", value=gf, unit="GFLOPS", device=platform,
+        n_devices=1,
+        extra={"m": spec.m, "n": spec.n, "k": spec.k, "dtype": spec.dtype,
+               "precision": spec.precision, "mean_ms": stats.mean_ms},
+    )
+    return stats, row
+
+
+# The north-star shape plus an MXU-friendly sweep (powers of two, bf16 pairs).
+DEFAULT_GEMM_SWEEP = [
+    GemmSpec(1024, 1024, 1024, "float32", "float32"),
+    GemmSpec(1024, 1024, 1024, "bfloat16", "default"),
+    GemmSpec(2048, 2048, 2048, "float32", "float32"),
+    GemmSpec(4096, 4096, 4096, "bfloat16", "default"),
+    GemmSpec(8192, 8192, 8192, "bfloat16", "default"),
+]

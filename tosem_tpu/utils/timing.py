@@ -14,6 +14,7 @@ from dataclasses import dataclass
 from typing import Any, Callable, Optional
 
 import jax
+import jax.numpy as jnp
 
 
 @dataclass
@@ -30,32 +31,65 @@ class BenchStats:
         return self.mean_s * 1e3
 
     def throughput(self, work_per_iter: float) -> float:
-        """work units / second based on mean time."""
-        return work_per_iter / self.mean_s if self.mean_s > 0 else float("inf")
+        """work units / second based on min time (the noise-free estimator:
+        sync round-trip jitter only ever inflates samples, never deflates)."""
+        return work_per_iter / self.min_s if self.min_s > 0 else float("inf")
 
 
-def _block(x: Any) -> None:
-    jax.tree_util.tree_map(
-        lambda v: v.block_until_ready() if hasattr(v, "block_until_ready") else v, x)
+def _sync(x: Any) -> None:
+    """Force real device synchronisation.
+
+    ``block_until_ready`` alone is not trustworthy on remote-tunnelled
+    platforms (observed: it returns immediately under axon), so we fetch one
+    scalar element per leaf to the host. Device programs execute in order, so
+    fetching from the *last* enqueued output drains the whole queue.
+    """
+    for v in jax.tree_util.tree_leaves(x):
+        if isinstance(v, jax.Array):
+            if v.size:
+                jax.device_get(v.ravel()[0])
+            else:
+                v.block_until_ready()
+
+
+def _timed_batch(fn: Callable[[], Any], n: int) -> float:
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(n):
+        out = fn()
+    _sync(out)
+    return time.perf_counter() - t0
 
 
 def time_fn(fn: Callable[[], Any], *, iters: int = 20, warmup: int = 3,
-            name: str = "bench", inner: int = 1) -> BenchStats:
+            name: str = "bench", inner: int = 0,
+            target_sample_s: float = 50e-3) -> BenchStats:
     """Time ``fn`` (returning device arrays) with compile warmup.
 
-    ``inner`` repeats fn per timed sample (for very fast ops, time the batch
-    and divide — same trick as ``ray_perf``'s loops).
+    Uses differential batch timing: a sample enqueues ``inner`` calls
+    back-to-back and syncs once; per-call time is
+    ``(t_inner - min t_1)/(inner - 1)``, which cancels the per-sample sync
+    round trip. On remote-tunnelled TPU platforms (axon) that round trip is
+    tens of ms — orders of magnitude above kernel time — and
+    ``block_until_ready`` alone does not even synchronise, so naive timing
+    is wrong in both directions. ``inner=0`` auto-calibrates so each
+    sample's pure compute is ~``target_sample_s``.
     """
     for _ in range(max(1, warmup)):
-        _block(fn())
+        _sync(fn())
+    # t_N = N*k + R with R the (large, noisy) per-sample sync round trip.
+    # Min-statistics differential: k = (min t_N - min t_1) / (N - 1) cancels
+    # R without modelling it.
+    t1_min = min(_timed_batch(fn, 1) for _ in range(3))
+    t10_min = min(_timed_batch(fn, 10) for _ in range(2))
+    k_est = max((t10_min - t1_min) / 9.0, 1e-8)
+    if inner <= 0:
+        inner = max(2, min(4000, int(round(target_sample_s / k_est))))
+    inner = max(2, inner)
     samples = []
     for _ in range(iters):
-        t0 = time.perf_counter()
-        out = None
-        for _ in range(inner):
-            out = fn()
-        _block(out)
-        samples.append((time.perf_counter() - t0) / inner)
+        t = _timed_batch(fn, inner)
+        samples.append(max(t - t1_min, 1e-9) / (inner - 1))
     return BenchStats(
         name=name,
         iters=iters,
@@ -64,6 +98,76 @@ def time_fn(fn: Callable[[], Any], *, iters: int = 20, warmup: int = 3,
         min_s=min(samples),
         p50_s=statistics.median(samples),
     )
+
+
+@dataclass
+class DeviceLoopBench:
+    """On-device kernel timing via a chained ``lax.fori_loop``.
+
+    Python-side dispatch over a remote-tunnelled platform costs ~0.1ms per
+    call, swamping sub-ms kernels. This harness runs N op applications
+    inside ONE compiled program, chained through a scalar extracted from
+    each output and added to one operand scaled by a runtime-zero epsilon:
+    numerics are exact (eps=0 at run time) but XLA cannot hoist the op out
+    of the loop (eps is unknown at compile time), so all N executions
+    really happen, serialised by the data dependence.
+    """
+    op: Callable[..., Any]       # op(*args) -> array
+    args: tuple                  # device arrays
+    perturb: int = 0             # which arg receives the +eps*s feedback
+
+    def _loop_fn(self):
+        from jax import lax
+        op, perturb = self.op, self.perturb
+
+        def run(n_iter, eps, *args):
+            def body(i, s):
+                ins = list(args)
+                a = ins[perturb]
+                ins[perturb] = a + (eps * s).astype(a.dtype)
+                out = op(*ins)
+                # the carry must consume EVERY output element — a single
+                # element would let XLA dead-code-eliminate most of the op
+                return jnp.mean(out.astype(jnp.float32))
+            # dynamic trip count: ONE compiled program serves every n, so
+            # growth probing never pays (or mis-measures) recompilation
+            return lax.fori_loop(0, n_iter, body, jnp.float32(0.0))
+
+        return jax.jit(run)
+
+    def time(self, *, n_iter: int = 0, reps: int = 3,
+             signal_s: float = 0.3, max_iter: int = 400_000) -> float:
+        """Seconds per op execution (min over reps, dispatch cancelled).
+
+        ``n_iter=0`` grows the loop count geometrically until total loop
+        time clearly exceeds the per-dispatch round-trip noise (tens of ms
+        on tunnelled platforms), so ``(t_n - t_1)/(n-1)`` is a clean
+        kernel-time estimate even for micro-second kernels.
+        """
+        loop = self._loop_fn()
+        eps = jax.device_put(jnp.zeros((), "float32"))
+
+        def timed(n: int) -> float:
+            nn = jnp.int32(n)
+            t0 = time.perf_counter()
+            _sync(loop(nn, eps, *self.args))
+            return time.perf_counter() - t0
+
+        timed(1)  # compile
+        t1_min = min(timed(1) for _ in range(reps))
+        if n_iter <= 0:
+            if t1_min >= 2 * signal_s:
+                # slow kernel: one execution already dwarfs round-trip
+                # noise, no need to grow the loop (saves ~30x wall clock)
+                n_iter = 4
+            else:
+                n_iter = 64
+                while n_iter < max_iter and timed(n_iter) - t1_min < signal_s:
+                    n_iter *= 4
+                n_iter = min(n_iter, max_iter)
+        n_iter = max(n_iter, 2)
+        tn_min = min(timed(n_iter) for _ in range(reps))
+        return max((tn_min - t1_min) / (n_iter - 1), 1e-9)
 
 
 def gflops(flop_count: float, seconds: float) -> float:
