@@ -17,7 +17,7 @@ class TestGemm:
 
     def test_bench_emits_row(self):
         spec = GemmSpec(128, 128, 128)
-        stats, row = gemm_bench(spec, n_iter=4, reps=1)
+        stats, row = gemm_bench(spec, n_iter=64, reps=1)
         assert row.metric == "gflops" and row.value > 0
         assert row.bench_id == spec.bench_id
         assert stats.mean_s > 0
@@ -56,5 +56,5 @@ class TestConv:
 
     def test_bench_emits_row(self):
         spec = ConvSpec("tiny", 1, 8, 8, 4, 8, 3, 3)
-        stats, row = conv_bench(spec, n_iter=4, reps=1)
+        stats, row = conv_bench(spec, n_iter=64, reps=1)
         assert row.config == "conv_sweep" and row.value > 0

@@ -1,0 +1,112 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tosem_tpu.parallel.mesh import (MeshSpec, make_mesh, default_mesh,
+                                     multihost_init)
+from tosem_tpu.parallel.collectives import (
+    CollectiveSpec, collective_bench, bus_bandwidth_factor, all_reduce,
+    all_gather_op, reduce_scatter_op, ring_permute, all_to_all_op, broadcast,
+    _make_global_input)
+
+
+class TestMeshSpec:
+    def test_resolve_exact(self):
+        assert MeshSpec.of(dp=4, tp=2).resolve(8) == {"dp": 4, "tp": 2}
+
+    def test_resolve_wildcard(self):
+        assert MeshSpec.of(dp=-1, tp=2).resolve(8) == {"dp": 4, "tp": 2}
+
+    def test_resolve_errors(self):
+        with pytest.raises(ValueError):
+            MeshSpec.of(dp=3, tp=2).resolve(8)
+        with pytest.raises(ValueError):
+            MeshSpec.of(dp=-1, tp=-1).resolve(8)
+        with pytest.raises(ValueError):
+            MeshSpec.of(dp=-1, tp=3).resolve(8)
+
+    def test_make_mesh(self, devices8):
+        mesh = make_mesh(MeshSpec.of(dp=2, tp=4), devices8)
+        assert mesh.shape == {"dp": 2, "tp": 4}
+        mesh = default_mesh("x", devices8)
+        assert mesh.shape == {"x": 8}
+
+    def test_multihost_noop_without_env(self, monkeypatch):
+        monkeypatch.delenv("COORDINATOR_ADDRESS", raising=False)
+        assert multihost_init() is False
+
+
+def _x(mesh, axis="x", rows_per_dev=4, cols=8):
+    n = mesh.shape[axis]
+    x = jnp.arange(n * rows_per_dev * cols, dtype=jnp.float32).reshape(
+        n * rows_per_dev, cols)
+    return jax.device_put(x, NamedSharding(mesh, P(axis)))
+
+
+class TestCollectiveNumerics:
+    def test_all_reduce(self, mesh1d):
+        x = _x(mesh1d)
+        out = all_reduce(mesh1d, "x")(x)
+        shards = np.split(np.asarray(x), 8, axis=0)
+        np.testing.assert_allclose(np.asarray(out), sum(shards), rtol=1e-6)
+
+    def test_all_gather(self, mesh1d):
+        x = _x(mesh1d)
+        out = all_gather_op(mesh1d, "x")(x)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+
+    def test_reduce_scatter(self, mesh1d):
+        x = _x(mesh1d, rows_per_dev=8)
+        out = reduce_scatter_op(mesh1d, "x")(x)
+        # dual check: all_gather(reduce_scatter(x)) == all_reduce(x)
+        full = all_gather_op(mesh1d, "x")(out)
+        expect = all_reduce(mesh1d, "x")(x)
+        np.testing.assert_allclose(np.asarray(full), np.asarray(expect),
+                                   rtol=1e-6)
+
+    def test_ring_permute(self, mesh1d):
+        x = _x(mesh1d)
+        out = ring_permute(mesh1d, "x")(x)
+        xs = np.split(np.asarray(x), 8, axis=0)
+        outs = np.split(np.asarray(out), 8, axis=0)
+        for i in range(8):
+            np.testing.assert_array_equal(outs[(i + 1) % 8], xs[i])
+
+    def test_all_to_all(self, mesh1d):
+        n = 8
+        x = _x(mesh1d, rows_per_dev=n, cols=4)  # per-dev block (n, 4), rows split n ways
+        out = all_to_all_op(mesh1d, "x")(x)
+        xs = np.asarray(x).reshape(n, n, 4)     # [src, dstchunk, c]
+        outs = np.asarray(out).reshape(n, n, 4)  # [dst, srcchunk, c]
+        np.testing.assert_array_equal(outs, np.swapaxes(xs, 0, 1))
+
+    def test_broadcast(self, mesh1d):
+        x = _x(mesh1d)
+        out = broadcast(mesh1d, "x", root=3)(x)
+        xs = np.split(np.asarray(x), 8, axis=0)
+        np.testing.assert_array_equal(np.asarray(out), xs[3])
+
+
+class TestBusBandwidth:
+    def test_factors(self):
+        assert bus_bandwidth_factor("all_reduce", 8) == pytest.approx(2 * 7 / 8)
+        assert bus_bandwidth_factor("all_gather", 8) == pytest.approx(7 / 8)
+        assert bus_bandwidth_factor("reduce_scatter", 4) == pytest.approx(3 / 4)
+        assert bus_bandwidth_factor("all_to_all", 8) == pytest.approx(7 / 8)
+        assert bus_bandwidth_factor("broadcast", 8) == 1.0
+        assert bus_bandwidth_factor("all_reduce", 1) == 1.0
+
+    def test_bench_row(self, mesh1d):
+        row = collective_bench(CollectiveSpec("all_reduce", 4096), mesh1d,
+                               n_iter=64, reps=1)
+        assert row.metric == "bus_bw_gbps" and row.value > 0
+        assert row.n_devices == 8
+        assert row.extra["bytes"] == 4096
+
+    def test_input_builder_alignment(self, mesh1d):
+        spec = CollectiveSpec("all_reduce", 1 << 16)
+        x = _make_global_input(spec, mesh1d)
+        assert x.nbytes == 8 * (1 << 16)
+        assert x.shape[1] == 128

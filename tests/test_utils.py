@@ -56,6 +56,13 @@ class TestFlags:
         fs.apply_env({"TOSEM_ITERS": "42"})
         assert fs.iters == 42
 
+    def test_no_prefix_not_shadowing_real_flag(self):
+        fs = FlagSet()
+        fs.define_bool("check", True, "")
+        fs.define_bool("nocheck", False, "")
+        fs.parse_args(["--nocheck"])
+        assert fs.nocheck is True and fs.check is True
+
     def test_reset(self):
         fs = make_flags()
         fs.set("iters", 99)

@@ -1,0 +1,10 @@
+"""Shared op-level helpers."""
+from jax import lax
+
+# Precision names → lax.Precision. "float32" forces full fp32 accumulation
+# (6-pass bf16 emulation on the MXU); "default" allows native bf16 passes.
+PRECISION = {
+    "float32": lax.Precision.HIGHEST,
+    "tensorfloat32": lax.Precision.HIGH,
+    "default": lax.Precision.DEFAULT,
+}

@@ -12,20 +12,16 @@ from __future__ import annotations
 
 import functools
 from dataclasses import dataclass
-from typing import List, Optional, Tuple
+from typing import List, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
+from tosem_tpu.ops.common import PRECISION
 from tosem_tpu.utils.results import ResultRow
 from tosem_tpu.utils.timing import (BenchStats, DeviceLoopBench, conv2d_flops,
-                                    time_fn)
-
-_PRECISION = {
-    "float32": lax.Precision.HIGHEST,
-    "default": lax.Precision.DEFAULT,
-}
+                                    gflops)
 
 
 @dataclass(frozen=True)
@@ -66,7 +62,7 @@ def conv2d(x: jax.Array, w: jax.Array, stride: int = 1,
     return lax.conv_general_dilated(
         x, w, window_strides=(stride, stride), padding="SAME",
         dimension_numbers=("NHWC", "HWIO", "NHWC"),
-        precision=_PRECISION[precision])
+        precision=PRECISION[precision])
 
 
 def conv_bench(spec: ConvSpec, *, n_iter: int = 0, reps: int = 3,
@@ -89,7 +85,7 @@ def conv_bench(spec: ConvSpec, *, n_iter: int = 0, reps: int = 3,
     sec = bench.time(n_iter=n_iter, reps=reps)
     stats = BenchStats(name=spec.bench_id, iters=reps, mean_s=sec, std_s=0.0,
                        min_s=sec, p50_s=sec)
-    gf = spec.flops / stats.min_s / 1e9
+    gf = gflops(spec.flops, stats.min_s)
     row = ResultRow(
         project="ops", config="conv_sweep", bench_id=spec.bench_id,
         metric="gflops", value=gf, unit="GFLOPS",

@@ -13,23 +13,16 @@ from __future__ import annotations
 
 import functools
 from dataclasses import dataclass
-from typing import Optional, Tuple
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
+from tosem_tpu.ops.common import PRECISION
 from tosem_tpu.utils.results import ResultRow
-from tosem_tpu.utils.timing import (BenchStats, DeviceLoopBench, matmul_flops,
-                                    time_fn)
-
-# Precision names map to jax.lax.Precision: "float32" forces full fp32
-# accumulation (HIGHEST); "default" lets the MXU use bf16 passes.
-_PRECISION = {
-    "float32": lax.Precision.HIGHEST,
-    "tensorfloat32": lax.Precision.HIGH,
-    "default": lax.Precision.DEFAULT,
-}
+from tosem_tpu.utils.timing import (BenchStats, DeviceLoopBench, gflops,
+                                    matmul_flops)
 
 
 @dataclass(frozen=True)
@@ -51,12 +44,11 @@ class GemmSpec:
 
 @functools.partial(jax.jit, static_argnames=("precision",))
 def gemm(a: jax.Array, b: jax.Array, precision: str = "float32") -> jax.Array:
-    return jnp.dot(a, b, precision=_PRECISION[precision])
+    return jnp.dot(a, b, precision=PRECISION[precision])
 
 
 def gemm_bench(spec: GemmSpec, *, n_iter: int = 0, reps: int = 3,
-               seed: int = 0, device: Optional[str] = None
-               ) -> Tuple[BenchStats, ResultRow]:
+               seed: int = 0) -> Tuple[BenchStats, ResultRow]:
     """Time one GEMM shape; returns stats + a schema row for the results CSV.
 
     Timing runs on-device (chained ``fori_loop``, one dispatch) so the
@@ -74,8 +66,8 @@ def gemm_bench(spec: GemmSpec, *, n_iter: int = 0, reps: int = 3,
     sec = bench.time(n_iter=n_iter, reps=reps)
     stats = BenchStats(name=spec.bench_id, iters=reps, mean_s=sec, std_s=0.0,
                        min_s=sec, p50_s=sec)
-    gf = spec.flops / stats.min_s / 1e9
-    platform = device or jax.devices()[0].platform
+    gf = gflops(spec.flops, stats.min_s)
+    platform = jax.devices()[0].platform
     row = ResultRow(
         project="ops", config="gemm", bench_id=spec.bench_id,
         metric="gflops", value=gf, unit="GFLOPS", device=platform,

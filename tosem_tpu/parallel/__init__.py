@@ -1,0 +1,8 @@
+from tosem_tpu.parallel.mesh import (MeshSpec, make_mesh, default_mesh,
+                                     multihost_init)
+from tosem_tpu.parallel.collectives import (CollectiveSpec, collective_bench,
+                                            bus_bandwidth_factor,
+                                            DEFAULT_COLLECTIVE_SWEEP,
+                                            all_reduce, all_gather_op,
+                                            reduce_scatter_op, ring_permute,
+                                            all_to_all_op, broadcast)

@@ -1,0 +1,207 @@
+"""ICI/DCN collectives + the NCCL-style bandwidth sweep.
+
+North-star config 3 re-runs the NCCL allreduce bandwidth sweep (1KB→1GB)
+that the reference exercises implicitly through RaySGD's
+``init_process_group(backend="nccl")`` (``distributed_torch_runner.py:37-39``)
+and DD-PPO's explicit allreduce step (``rllib/agents/ppo/ddppo.py:157-203``).
+Here each collective is a ``jax.shard_map`` program over a named mesh axis —
+XLA lowers them to ICI transfers — and results are reported as NCCL-tests
+style **bus bandwidth** so numbers are comparable across topologies.
+
+Bus-bandwidth conversion per collective (n = devices on the axis, B = bytes
+of the per-device buffer, t = seconds; algBw = B/t unless noted):
+
+  all_reduce      busBw = (B/t) * 2(n-1)/n   (ring sends+receives each byte
+                                              2(n-1)/n times per device)
+  all_gather      busBw = (B_total/t) * (n-1)/n  with B_total = n*B_shard
+  reduce_scatter  busBw = (B_total/t) * (n-1)/n
+  all_to_all      busBw = (B/t) * (n-1)/n    (each device keeps 1/n locally)
+  broadcast       busBw = B/t
+  ppermute (ring) busBw = B/t                (each link carries B once)
+
+This is the documented algorithm→bus conversion SURVEY §7 calls out as a
+hard part; formulas follow nccl-tests' PERFORMANCE.md definitions.
+"""
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Callable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tosem_tpu.utils.results import ResultRow
+from tosem_tpu.utils.timing import DeviceLoopBench
+
+
+# ---------------------------------------------------------------------------
+# collective ops (shard_map programs; global-view in, global-view out)
+# ---------------------------------------------------------------------------
+
+def all_reduce(mesh: Mesh, axis: str) -> Callable[[jax.Array], jax.Array]:
+    """x sharded on ``axis`` (leading dim = per-device buffers) → summed,
+    replicated buffer. Semantics of ``ncclAllReduce``."""
+    @functools.partial(jax.shard_map, mesh=mesh,
+                       in_specs=P(axis), out_specs=P())
+    def f(x):
+        return lax.psum(x, axis)
+    return f
+
+
+def all_gather_op(mesh: Mesh, axis: str) -> Callable[[jax.Array], jax.Array]:
+    """shards on ``axis`` → full array replicated (``ncclAllGather``)."""
+    # check_vma off: vma inference can't prove all_gather output replicated
+    @functools.partial(jax.shard_map, mesh=mesh,
+                       in_specs=P(axis), out_specs=P(), check_vma=False)
+    def f(x):
+        return lax.all_gather(x, axis, tiled=True)
+    return f
+
+
+def reduce_scatter_op(mesh: Mesh, axis: str) -> Callable[[jax.Array], jax.Array]:
+    """replicated-sized input sharded on ``axis`` → per-device reduced shard
+    (``ncclReduceScatter``)."""
+    @functools.partial(jax.shard_map, mesh=mesh,
+                       in_specs=P(axis), out_specs=P(axis))
+    def f(x):
+        return lax.psum_scatter(x, axis, tiled=True)
+    return f
+
+
+def ring_permute(mesh: Mesh, axis: str) -> Callable[[jax.Array], jax.Array]:
+    """Neighbour shift around the ring — the ICI point-to-point pattern
+    (``CollectivePermute``); building block of ring attention."""
+    n = mesh.shape[axis]
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    @functools.partial(jax.shard_map, mesh=mesh,
+                       in_specs=P(axis), out_specs=P(axis))
+    def f(x):
+        return lax.ppermute(x, axis, perm)
+    return f
+
+
+def all_to_all_op(mesh: Mesh, axis: str) -> Callable[[jax.Array], jax.Array]:
+    """Transpose shard dimension across devices (``ncclAllToAll`` /
+    the Ulysses sequence-parallel primitive)."""
+    @functools.partial(jax.shard_map, mesh=mesh,
+                       in_specs=P(axis), out_specs=P(axis))
+    def f(x):
+        # block rows split into n chunks; chunk j → device j; received
+        # chunks concatenated back along rows (chunk-transpose across devs)
+        return lax.all_to_all(x, axis, split_axis=0, concat_axis=0,
+                              tiled=True)
+    return f
+
+
+def broadcast(mesh: Mesh, axis: str, root: int = 0
+              ) -> Callable[[jax.Array], jax.Array]:
+    """Root's buffer to everyone (``ncclBroadcast``): implemented as a
+    masked psum (zero every non-root contribution — one ICI round)."""
+    @functools.partial(jax.shard_map, mesh=mesh,
+                       in_specs=P(axis), out_specs=P())
+    def f(x):
+        idx = lax.axis_index(axis)
+        contrib = jnp.where(idx == root, x, jnp.zeros_like(x))
+        return lax.psum(contrib, axis)
+    return f
+
+
+# ---------------------------------------------------------------------------
+# bandwidth sweep
+# ---------------------------------------------------------------------------
+
+_COLLECTIVES = {
+    "all_reduce": all_reduce,
+    "all_gather": all_gather_op,
+    "reduce_scatter": reduce_scatter_op,
+    "ring_permute": ring_permute,
+    "all_to_all": all_to_all_op,
+    "broadcast": broadcast,
+}
+
+
+def bus_bandwidth_factor(name: str, n: int) -> float:
+    """Multiplier converting algorithm bandwidth to bus bandwidth."""
+    if n <= 1:
+        return 1.0
+    if name == "all_reduce":
+        return 2.0 * (n - 1) / n
+    if name in ("all_gather", "reduce_scatter", "all_to_all"):
+        return (n - 1) / n
+    return 1.0  # broadcast, ring_permute
+
+
+@dataclass(frozen=True)
+class CollectiveSpec:
+    name: str                 # key into _COLLECTIVES
+    bytes_per_device: int     # per-device buffer size
+    dtype: str = "float32"
+    axis: str = "x"
+
+    @property
+    def bench_id(self) -> str:
+        return f"{self.name}_{self.bytes_per_device}B_{self.dtype}"
+
+
+def _make_global_input(spec: CollectiveSpec, mesh: Mesh) -> jax.Array:
+    n = mesh.shape[spec.axis]
+    itemsize = jnp.dtype(spec.dtype).itemsize
+    per_dev = max(spec.bytes_per_device // itemsize, n)
+    # keep shapes 2-D and lane-aligned where possible; per-device rows must
+    # divide by n (reduce_scatter) and cols by n (all_to_all)
+    cols = 128 if per_dev % 128 == 0 and n <= 128 else n
+    rows = max(per_dev // cols, 1)
+    rows = ((rows + n - 1) // n) * n
+    global_shape = (n * rows, cols)
+    x = jnp.arange(np.prod(global_shape), dtype=jnp.float32).reshape(
+        global_shape).astype(spec.dtype)
+    sharding = jax.sharding.NamedSharding(mesh, P(spec.axis))
+    return jax.device_put(x, sharding)
+
+
+def collective_bench(spec: CollectiveSpec, mesh: Mesh, *,
+                     n_iter: int = 0, reps: int = 3) -> ResultRow:
+    n = mesh.shape[spec.axis]
+    op = _COLLECTIVES[spec.name](mesh, spec.axis)
+    x = _make_global_input(spec, mesh)
+    jit_op = jax.jit(op)
+    bench = DeviceLoopBench(op=jit_op, args=(x,), perturb=0)
+    sec = bench.time(n_iter=n_iter, reps=reps)
+    # nccl-tests size convention: all_gather reports the total gathered
+    # bytes (= global array); everything else reports the per-rank buffer
+    # (= one shard of the global array). reduce_scatter's per-rank *input*
+    # is its shard here, making it the exact dual of all_gather.
+    actual_bytes = x.nbytes if spec.name == "all_gather" else (x.nbytes // n)
+    alg_bw = actual_bytes / sec  # B/s
+    bus_bw = alg_bw * bus_bandwidth_factor(spec.name, n)
+    return ResultRow(
+        project="parallel", config="collective_sweep",
+        bench_id=spec.bench_id, metric="bus_bw_gbps",
+        value=bus_bw / 1e9, unit="GB/s",
+        device=jax.devices()[0].platform, n_devices=n,
+        extra={"collective": spec.name, "bytes": actual_bytes,
+               "alg_bw_gbps": alg_bw / 1e9, "time_us": sec * 1e6,
+               "dtype": spec.dtype},
+    )
+
+
+def _sweep_sizes(lo: int = 1024, hi: int = 1 << 30) -> List[int]:
+    sizes = []
+    b = lo
+    while b <= hi:
+        sizes.append(b)
+        b *= 4
+    return sizes
+
+
+DEFAULT_COLLECTIVE_SWEEP = [
+    CollectiveSpec(name, size)
+    for name in ("all_reduce", "all_gather", "reduce_scatter",
+                 "ring_permute", "all_to_all", "broadcast")
+    for size in _sweep_sizes(1024, 1 << 28)  # 1KB → 256MB per device
+]

@@ -1,0 +1,105 @@
+"""Device meshes and multi-host bring-up.
+
+The TPU-native replacement for the reference's process-group construction:
+RaySGD picks NCCL/Gloo and calls ``torch.distributed.init_process_group``
+(``python/ray/util/sgd/torch/distributed_torch_runner.py:32-61``); DD-PPO
+does the same per rollout worker (``rllib/agents/ppo/ddppo.py:109-203``).
+Here a :class:`jax.sharding.Mesh` over the chip topology plays the role of
+the process group — collectives ride ICI within a slice — and
+``jax.distributed.initialize`` (coordinator-based, the gRPC/Redis bring-up
+analog of ``ray.init``, SURVEY §3.1) joins multiple hosts over DCN.
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """Named mesh axes and their sizes; -1 means 'absorb remaining devices'.
+
+    Conventional axis names used across the framework:
+      dp — data parallel        tp — tensor parallel
+      pp — pipeline parallel    sp — sequence/context parallel
+      ep — expert parallel
+    """
+    axes: Tuple[Tuple[str, int], ...]
+
+    @classmethod
+    def of(cls, **axes: int) -> "MeshSpec":
+        return cls(tuple(axes.items()))
+
+    def resolve(self, n_devices: int) -> Dict[str, int]:
+        sizes = dict(self.axes)
+        wild = [k for k, v in sizes.items() if v == -1]
+        if len(wild) > 1:
+            raise ValueError("at most one axis may be -1")
+        fixed = 1
+        for k, v in sizes.items():
+            if v != -1:
+                fixed *= v
+        if wild:
+            if n_devices % fixed:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes {sizes}")
+            sizes[wild[0]] = n_devices // fixed
+        else:
+            total = fixed
+            if total != n_devices:
+                raise ValueError(
+                    f"mesh {sizes} wants {total} devices, have {n_devices}")
+        return sizes
+
+
+def make_mesh(spec: MeshSpec, devices: Optional[Sequence[jax.Device]] = None
+              ) -> Mesh:
+    devices = list(jax.devices()) if devices is None else list(devices)
+    sizes = spec.resolve(len(devices))
+    names = tuple(sizes.keys())
+    shape = tuple(sizes.values())
+    arr = np.array(devices[:int(np.prod(shape))]).reshape(shape)
+    return Mesh(arr, names)
+
+
+def default_mesh(axis_name: str = "dp",
+                 devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """1-D mesh over all (or given) devices."""
+    devices = list(jax.devices()) if devices is None else list(devices)
+    return Mesh(np.array(devices), (axis_name,))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def sharded(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+def multihost_init(coordinator_address: Optional[str] = None,
+                   num_processes: Optional[int] = None,
+                   process_id: Optional[int] = None) -> bool:
+    """Join a multi-host TPU job (DCN control plane).
+
+    Reads standard env (``COORDINATOR_ADDRESS``/``NUM_PROCESSES``/
+    ``PROCESS_ID``) when args are absent — the moral equivalent of Ray's
+    redis address plumbing in ``python/ray/_private/services.py:777``.
+    Returns True if distributed init ran, False for single-process runs
+    (nothing to do — benign, like ``ray.init`` standalone mode).
+    """
+    addr = coordinator_address or os.environ.get("COORDINATOR_ADDRESS")
+    if addr is None:
+        return False
+    nproc = num_processes if num_processes is not None else int(
+        os.environ.get("NUM_PROCESSES", "1"))
+    pid = process_id if process_id is not None else int(
+        os.environ.get("PROCESS_ID", "0"))
+    jax.distributed.initialize(coordinator_address=addr,
+                               num_processes=nproc, process_id=pid)
+    return True

@@ -1,3 +1,5 @@
 from tosem_tpu.utils.flags import FlagSet, GLOBAL_FLAGS
 from tosem_tpu.utils.results import ResultWriter, ResultRow
-from tosem_tpu.utils.timing import BenchStats, time_fn, gflops
+from tosem_tpu.utils.timing import (BenchStats, DeviceLoopBench,
+                                    MeasurementBelowNoiseFloor,
+                                    time_fn, gflops)

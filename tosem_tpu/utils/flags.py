@@ -130,11 +130,11 @@ class FlagSet:
             if "=" in body:
                 name, raw = body.split("=", 1)
                 self._require(name).set(raw)
+            elif body in self._flags and isinstance(self._flags[body].default, bool):
+                self._flags[body].set(True)
             elif body.startswith("no") and body[2:] in self._flags and isinstance(
                     self._flags[body[2:]].default, bool):
                 self._flags[body[2:]].set(False)
-            elif body in self._flags and isinstance(self._flags[body].default, bool):
-                self._flags[body].set(True)
             else:
                 if i + 1 >= len(argv):
                     raise ValueError(f"flag --{body} missing value")

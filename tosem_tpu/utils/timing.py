@@ -17,6 +17,10 @@ import jax
 import jax.numpy as jnp
 
 
+class MeasurementBelowNoiseFloor(RuntimeError):
+    """The timed kernel cannot be resolved against host/sync noise."""
+
+
 @dataclass
 class BenchStats:
     name: str
@@ -155,7 +159,8 @@ class DeviceLoopBench:
 
         timed(1)  # compile
         t1_min = min(timed(1) for _ in range(reps))
-        if n_iter <= 0:
+        auto = n_iter <= 0
+        if auto:
             if t1_min >= 2 * signal_s:
                 # slow kernel: one execution already dwarfs round-trip
                 # noise, no need to grow the loop (saves ~30x wall clock)
@@ -166,8 +171,21 @@ class DeviceLoopBench:
                     n_iter *= 4
                 n_iter = min(n_iter, max_iter)
         n_iter = max(n_iter, 2)
-        tn_min = min(timed(n_iter) for _ in range(reps))
-        return max((tn_min - t1_min) / (n_iter - 1), 1e-9)
+        while True:
+            tn_min = min(timed(n_iter) for _ in range(reps))
+            if tn_min > t1_min:
+                return (tn_min - t1_min) / (n_iter - 1)
+            # differential below the noise floor: never report a fantasy
+            # number (the old 1e-9 clamp produced PFLOPS readings)
+            if n_iter >= max_iter:
+                raise MeasurementBelowNoiseFloor(
+                    f"loop of {n_iter} executions is indistinguishable from "
+                    f"sync noise (t1={t1_min * 1e3:.2f}ms)")
+            if not auto:
+                raise MeasurementBelowNoiseFloor(
+                    f"n_iter={n_iter} too small to resolve this kernel "
+                    "against sync noise; use n_iter=0 (auto)")
+            n_iter = min(n_iter * 4, max_iter)
 
 
 def gflops(flop_count: float, seconds: float) -> float:
