@@ -1,0 +1,129 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from tosem_tpu.models import resnet18_ish, resnet50, Bert, BertConfig
+from tosem_tpu.nn.core import variables
+from tosem_tpu.train import (create_train_state, make_train_step,
+                             shard_batch, cross_entropy_loss)
+from tosem_tpu.train.trainer import classification_loss, mlm_loss
+from tosem_tpu.data import cifar_like_batches, mlm_batches
+
+KEY = jax.random.PRNGKey(0)
+
+
+class TestResNet:
+    def test_small_forward(self):
+        m = resnet18_ish(num_classes=10, dtype=jnp.float32)
+        vs = m.init(KEY)
+        x = jnp.ones((2, 32, 32, 3))
+        logits, ns = m.apply(vs, x, train=True)
+        assert logits.shape == (2, 10)
+        assert logits.dtype == jnp.float32
+        assert "block0" in ns
+
+    def test_resnet50_param_count(self):
+        m = resnet50(num_classes=1000, small_inputs=False, dtype=jnp.float32)
+        vs = m.init(KEY)
+        n = m.param_count(vs)
+        # torchvision resnet50: 25.56M (incl. fc bias + BN params)
+        assert 24e6 < n < 27e6, n
+
+
+class TestBert:
+    def test_tiny_forward(self):
+        b = Bert(BertConfig.tiny())
+        vs = b.init(KEY)
+        ids = jnp.ones((2, 16), jnp.int32)
+        enc, _ = b.apply(vs, ids)
+        assert enc.shape == (2, 16, 32)
+        logits = b.mlm_logits(vs, enc)
+        assert logits.shape == (2, 16, 128)
+
+    def test_base_param_count(self):
+        b = Bert(BertConfig.base())
+        vs = b.init(jax.random.PRNGKey(1))
+        n = b.param_count(vs)
+        # BERT-base ~110M (we have no NSP head; tied MLM head)
+        assert 100e6 < n < 120e6, n
+
+    def test_mask_changes_output(self):
+        b = Bert(BertConfig.tiny())
+        vs = b.init(KEY)
+        ids = jnp.arange(32, dtype=jnp.int32).reshape(2, 16) % 100 + 2
+        full, _ = b.apply(vs, ids, mask=jnp.ones((2, 16), jnp.int32))
+        half_mask = jnp.concatenate(
+            [jnp.ones((2, 8), jnp.int32), jnp.zeros((2, 8), jnp.int32)], -1)
+        half, _ = b.apply(vs, ids, mask=half_mask)
+        assert not np.allclose(np.asarray(full[:, :8]), np.asarray(half[:, :8]),
+                               atol=1e-5)
+
+
+class TestTraining:
+    def test_loss_decreases_resnet(self, mesh8):
+        m = resnet18_ish(num_classes=4, dtype=jnp.float32)
+        opt = optax.adam(1e-2)
+        ts = create_train_state(m, KEY, opt)
+        step = make_train_step(m, opt, classification_loss, mesh=mesh8)
+        batches = cifar_like_batches(16, n=64, hw=8, classes=4, steps=30)
+        losses = []
+        rng = KEY
+        for batch in batches:
+            rng, sub = jax.random.split(rng)
+            sharded = shard_batch(batch, mesh8)
+            ts, metrics = step(ts, sharded, sub)
+            losses.append(float(metrics["loss"]))
+        assert losses[-1] < losses[0], losses
+        assert int(ts["step"]) == 30
+
+    def test_loss_decreases_bert_mlm(self, mesh8):
+        cfg = BertConfig(vocab_size=64, max_len=16, dim=16, heads=2, layers=1,
+                         mlp_dim=32, dropout=0.0, dtype="float32")
+        b = Bert(cfg)
+        opt = optax.adam(5e-3)
+        ts = create_train_state(b, KEY, opt)
+        step = make_train_step(b, opt, mlm_loss, mesh=mesh8)
+        losses = []
+        rng = KEY
+        for batch in mlm_batches(8, 16, 64, steps=20):
+            rng, sub = jax.random.split(rng)
+            ts, metrics = step(ts, shard_batch(batch, mesh8), sub)
+            losses.append(float(metrics["loss"]))
+        assert losses[-1] < losses[0], losses
+
+    def test_single_device_step(self):
+        m = resnet18_ish(num_classes=4, dtype=jnp.float32)
+        opt = optax.sgd(1e-2)
+        ts = create_train_state(m, KEY, opt)
+        step = make_train_step(m, opt, classification_loss)
+        batch = next(cifar_like_batches(8, n=32, hw=8, classes=4, steps=1))
+        batch = jax.tree_util.tree_map(jnp.asarray, batch)
+        ts2, metrics = step(ts, batch, KEY)
+        assert float(metrics["loss"]) > 0
+        assert int(ts2["step"]) == 1
+
+    def test_cross_entropy_known_value(self):
+        logits = jnp.array([[0.0, 0.0]])
+        labels = jnp.array([0])
+        assert float(cross_entropy_loss(logits, labels)) == pytest.approx(
+            np.log(2), rel=1e-5)
+
+
+class TestCheckpoint:
+    def test_roundtrip(self, tmp_path):
+        from tosem_tpu.train import save_checkpoint, restore_checkpoint
+        tree = {"a": jnp.arange(4, dtype=jnp.float32),
+                "b": {"c": jnp.ones((2, 2))}}
+        p = str(tmp_path / "ckpt")
+        save_checkpoint(p, tree)
+        restored = restore_checkpoint(p, jax.tree_util.tree_map(
+            jnp.zeros_like, tree))
+        np.testing.assert_array_equal(np.asarray(restored["a"]),
+                                      np.asarray(tree["a"]))
+
+    def test_restore_or_init(self, tmp_path):
+        from tosem_tpu.train.checkpoint import restore_or_init
+        tree = restore_or_init(str(tmp_path / "none"), lambda: {"x": jnp.ones(2)})
+        np.testing.assert_array_equal(np.asarray(tree["x"]), 1.0)

@@ -1,0 +1,128 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tosem_tpu.nn import (Dense, Conv2D, BatchNorm, LayerNorm, Embedding,
+                          Dropout, Sequential, Lambda, MultiHeadAttention,
+                          dot_product_attention, relu, variables)
+
+
+KEY = jax.random.PRNGKey(0)
+
+
+class TestDense:
+    def test_shapes_and_numerics(self):
+        d = Dense(8, 4)
+        vs = d.init(KEY)
+        x = jnp.ones((2, 8))
+        y, _ = d.apply(vs, x)
+        assert y.shape == (2, 4)
+        expect = np.asarray(x) @ np.asarray(vs["params"]["w"]) + np.asarray(
+            vs["params"]["b"])
+        np.testing.assert_allclose(np.asarray(y), expect, rtol=1e-5)
+
+    def test_no_bias(self):
+        d = Dense(8, 4, bias=False)
+        vs = d.init(KEY)
+        assert "b" not in vs["params"]
+
+
+class TestConv2D:
+    def test_shape(self):
+        c = Conv2D(3, 16, (3, 3), 2)
+        vs = c.init(KEY)
+        y, _ = c.apply(vs, jnp.ones((2, 8, 8, 3)))
+        assert y.shape == (2, 4, 4, 16)
+
+
+class TestBatchNorm:
+    def test_train_normalizes_and_updates_state(self):
+        bn = BatchNorm(4, momentum=0.5)
+        vs = bn.init(KEY)
+        x = jax.random.normal(KEY, (64, 4)) * 3 + 7
+        y, ns = bn.apply(vs, x, train=True)
+        assert abs(float(jnp.mean(y))) < 1e-3
+        assert abs(float(jnp.std(y)) - 1) < 1e-2
+        # moving stats moved toward batch stats
+        assert float(ns["mean"][0]) != 0.0
+
+    def test_eval_uses_state(self):
+        bn = BatchNorm(4)
+        vs = bn.init(KEY)
+        x = jnp.ones((8, 4)) * 5
+        y, ns = bn.apply(vs, x, train=False)
+        # eval with init state (mean 0, var 1) ≈ identity
+        np.testing.assert_allclose(np.asarray(y), np.asarray(x), rtol=1e-3)
+        assert ns is vs["state"] or ns == vs["state"]
+
+
+class TestLayerNorm:
+    def test_normalizes_last_dim(self):
+        ln = LayerNorm(16)
+        vs = ln.init(KEY)
+        x = jax.random.normal(KEY, (4, 16)) * 10 + 3
+        y, _ = ln.apply(vs, x)
+        np.testing.assert_allclose(np.asarray(jnp.mean(y, -1)), 0, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(jnp.std(y, -1)), 1, atol=1e-2)
+
+
+class TestEmbeddingDropout:
+    def test_embedding_lookup_and_attend(self):
+        e = Embedding(10, 6)
+        vs = e.init(KEY)
+        y, _ = e.apply(vs, jnp.array([[1, 2], [3, 4]]))
+        assert y.shape == (2, 2, 6)
+        logits = e.attend(vs, y)
+        assert logits.shape == (2, 2, 10)
+
+    def test_dropout(self):
+        d = Dropout(0.5)
+        vs = d.init(KEY)
+        x = jnp.ones((100, 100))
+        y_eval, _ = d.apply(vs, x, train=False)
+        np.testing.assert_array_equal(np.asarray(y_eval), np.asarray(x))
+        y_tr, _ = d.apply(vs, x, train=True, rng=KEY)
+        frac_zero = float(jnp.mean((y_tr == 0).astype(jnp.float32)))
+        assert 0.4 < frac_zero < 0.6
+        with pytest.raises(ValueError):
+            d.apply(vs, x, train=True)
+
+
+class TestSequential:
+    def test_mlp(self):
+        m = Sequential(Dense(4, 8), Lambda(relu), Dense(8, 2))
+        vs = m.init(KEY)
+        y, _ = m.apply(vs, jnp.ones((3, 4)))
+        assert y.shape == (3, 2)
+        assert m.param_count(vs) == 4 * 8 + 8 + 8 * 2 + 2
+
+
+class TestAttention:
+    def test_softmax_weights_sum(self):
+        q = jax.random.normal(KEY, (2, 5, 2, 4))
+        out = dot_product_attention(q, q, q)
+        assert out.shape == (2, 5, 2, 4)
+
+    def test_mask_blocks_attention(self):
+        B, T, H, D = 1, 4, 1, 8
+        k1, k2 = jax.random.split(KEY)
+        q = jax.random.normal(k1, (B, T, H, D))
+        k = jax.random.normal(k2, (B, T, H, D))
+        v = jnp.stack([jnp.full((H, D), i, jnp.float32)
+                       for i in range(T)])[None]  # (1, T, H, D), v[t]=t
+        # mask allowing only position 0
+        mask = jnp.zeros((B, 1, T, T), bool).at[:, :, :, 0].set(True)
+        out = dot_product_attention(q, k, v, mask)
+        np.testing.assert_allclose(np.asarray(out), 0.0, atol=1e-5)
+
+    def test_mha_forward(self):
+        mha = MultiHeadAttention(16, 4)
+        vs = mha.init(KEY)
+        x = jax.random.normal(KEY, (2, 6, 16))
+        y, _ = mha.apply(vs, x)
+        assert y.shape == (2, 6, 16)
+
+    def test_mha_rejects_bad_heads(self):
+        with pytest.raises(ValueError):
+            MultiHeadAttention(10, 3)

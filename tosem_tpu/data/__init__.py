@@ -1,0 +1,2 @@
+from tosem_tpu.data.synthetic import (cifar_like_batches, mlm_batches,
+                                      SyntheticImageDataset)

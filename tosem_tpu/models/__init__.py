@@ -1,0 +1,2 @@
+from tosem_tpu.models.resnet import ResNet, resnet50, resnet18_ish
+from tosem_tpu.models.bert import Bert, BertConfig, bert_base, bert_tiny

@@ -1,0 +1,146 @@
+"""BERT-base encoder — the north-star config 5 model.
+
+The reference contains no transformer (SURVEY §5.7: the only attention-era
+model is EfficientDet, a CNN); this model exists because the north star's
+BERT-base fwd/bwd kernel suite (attention + layernorm + softmax) needs a
+carrier, and it doubles as the flagship for tensor/sequence-parallel
+shardings. Pre-LN encoder, bf16 params, fp32 layernorm/softmax statistics.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from tosem_tpu.nn.attention import MultiHeadAttention
+from tosem_tpu.nn.core import Module, Variables, variables, split_key
+from tosem_tpu.nn.layers import Dense, Dropout, Embedding, LayerNorm, gelu
+
+
+@dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    max_len: int = 512
+    dim: int = 768
+    heads: int = 12
+    layers: int = 12
+    mlp_dim: int = 3072
+    dropout: float = 0.1
+    dtype: str = "bfloat16"
+    precision: str = "default"
+
+    @classmethod
+    def base(cls) -> "BertConfig":
+        return cls()
+
+    @classmethod
+    def tiny(cls) -> "BertConfig":
+        """CI-sized config (same topology, 2 layers)."""
+        return cls(vocab_size=128, max_len=64, dim=32, heads=2, layers=2,
+                   mlp_dim=64, dropout=0.0)
+
+
+class EncoderLayer(Module):
+    def __init__(self, cfg: BertConfig):
+        dt = jnp.dtype(cfg.dtype)
+        self.ln1 = LayerNorm(cfg.dim, dtype=dt)
+        self.attn = MultiHeadAttention(cfg.dim, cfg.heads,
+                                       dropout=cfg.dropout, dtype=dt,
+                                       precision=cfg.precision)
+        self.ln2 = LayerNorm(cfg.dim, dtype=dt)
+        self.fc1 = Dense(cfg.dim, cfg.mlp_dim, dtype=dt,
+                         precision=cfg.precision, init_std=0.02)
+        self.fc2 = Dense(cfg.mlp_dim, cfg.dim, dtype=dt,
+                         precision=cfg.precision, init_std=0.02)
+        self.drop = Dropout(cfg.dropout)
+
+    def init(self, key) -> Variables:
+        ks = jax.random.split(key, 5)
+        return variables({
+            "ln1": self.ln1.init(ks[0])["params"],
+            "attn": self.attn.init(ks[1])["params"],
+            "ln2": self.ln2.init(ks[2])["params"],
+            "fc1": self.fc1.init(ks[3])["params"],
+            "fc2": self.fc2.init(ks[4])["params"],
+        })
+
+    def apply(self, vs, x, *, mask=None, train=False, rng=None,
+              attn_fn=None):
+        p = vs["params"]
+        r1, r2 = split_key(rng, 2)
+        h, _ = self.ln1.apply(variables(p["ln1"]), x)
+        h, _ = self.attn.apply(variables(p["attn"]), h, mask=mask,
+                               train=train, rng=r1, attn_fn=attn_fn)
+        x = x + h
+        h, _ = self.ln2.apply(variables(p["ln2"]), x)
+        h, _ = self.fc1.apply(variables(p["fc1"]), h)
+        h = gelu(h)
+        h, _ = self.fc2.apply(variables(p["fc2"]), h)
+        h, _ = self.drop.apply(variables({}), h, train=train, rng=r2)
+        return x + h, vs["state"]
+
+
+class Bert(Module):
+    def __init__(self, cfg: BertConfig):
+        self.cfg = cfg
+        dt = jnp.dtype(cfg.dtype)
+        self.tok = Embedding(cfg.vocab_size, cfg.dim, dtype=dt)
+        self.pos = Embedding(cfg.max_len, cfg.dim, dtype=dt)
+        self.seg = Embedding(2, cfg.dim, dtype=dt)
+        self.ln_emb = LayerNorm(cfg.dim, dtype=dt)
+        self.layers = [EncoderLayer(cfg) for _ in range(cfg.layers)]
+        self.ln_out = LayerNorm(cfg.dim, dtype=dt)
+        self.drop = Dropout(cfg.dropout)
+
+    def init(self, key) -> Variables:
+        ks = jax.random.split(key, len(self.layers) + 5)
+        ps = {
+            "tok": self.tok.init(ks[0])["params"],
+            "pos": self.pos.init(ks[1])["params"],
+            "seg": self.seg.init(ks[2])["params"],
+            "ln_emb": self.ln_emb.init(ks[3])["params"],
+            "ln_out": self.ln_out.init(ks[4])["params"],
+        }
+        for i, (l, k) in enumerate(zip(self.layers, ks[5:])):
+            ps[f"layer{i}"] = l.init(k)["params"]
+        return variables(ps)
+
+    def apply(self, vs, ids, *, segments=None, mask=None, train=False,
+              rng=None, attn_fn=None):
+        """ids: [B, T] int32. mask: [B, T] (1=real token) or None.
+        Returns [B, T, dim] encodings."""
+        p = vs["params"]
+        B, T = ids.shape
+        pos_ids = jnp.arange(T)[None, :]
+        h, _ = self.tok.apply(variables(p["tok"]), ids)
+        hp, _ = self.pos.apply(variables(p["pos"]), pos_ids)
+        h = h + hp
+        if segments is not None:
+            hs, _ = self.seg.apply(variables(p["seg"]), segments)
+            h = h + hs
+        h, _ = self.ln_emb.apply(variables(p["ln_emb"]), h)
+        attn_mask = None
+        if mask is not None:
+            attn_mask = mask[:, None, None, :].astype(bool)
+        rngs = split_key(rng, len(self.layers) + 1)
+        h, _ = self.drop.apply(variables({}), h, train=train, rng=rngs[0])
+        for i, l in enumerate(self.layers):
+            h, _ = l.apply(variables(p[f"layer{i}"]), h, mask=attn_mask,
+                           train=train, rng=rngs[i + 1], attn_fn=attn_fn)
+        h, _ = self.ln_out.apply(variables(p["ln_out"]), h)
+        return h, vs["state"]
+
+    def mlm_logits(self, vs, encodings):
+        """Tied-embedding masked-LM head."""
+        return self.tok.attend(variables(vs["params"]["tok"]),
+                               encodings.astype(jnp.float32))
+
+
+def bert_base() -> Bert:
+    return Bert(BertConfig.base())
+
+
+def bert_tiny() -> Bert:
+    return Bert(BertConfig.tiny())

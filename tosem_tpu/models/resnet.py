@@ -1,0 +1,146 @@
+"""ResNet family (v1.5 bottleneck), NHWC, bf16-friendly.
+
+North-star config 4 is an end-to-end ResNet-50 training loop on a CIFAR-10
+subset — the TPU re-expression of the reference's conv training paths
+(DeepSpeech's tower loop ``train.py:292-352``; EfficientDet's backbone
+``backbone/`` + estimator training ``det_model_fn.py``). The layer shape
+sweep in ``tosem_tpu.ops.conv`` mirrors exactly these blocks.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tosem_tpu.nn.core import Module, Variables, variables
+from tosem_tpu.nn.layers import (BatchNorm, Conv2D, Dense, avg_pool_global,
+                                 max_pool, relu)
+
+
+class BottleneckBlock(Module):
+    """1x1 reduce → 3x3 → 1x1 expand (x4), projection shortcut on shape
+    change. ResNet v1.5: stride lives on the 3x3."""
+
+    expansion = 4
+
+    def __init__(self, c_in: int, width: int, stride: int, *,
+                 dtype=jnp.float32, precision: str = "default"):
+        c_out = width * self.expansion
+        self.stride, self.c_in, self.c_out = stride, c_in, c_out
+        kw = dict(dtype=dtype, precision=precision)
+        self.conv1 = Conv2D(c_in, width, (1, 1), **kw)
+        self.bn1 = BatchNorm(width, dtype=dtype)
+        self.conv2 = Conv2D(width, width, (3, 3), stride, **kw)
+        self.bn2 = BatchNorm(width, dtype=dtype)
+        self.conv3 = Conv2D(width, c_out, (1, 1), **kw)
+        self.bn3 = BatchNorm(c_out, dtype=dtype)
+        self.project = c_in != c_out or stride != 1
+        if self.project:
+            self.conv_proj = Conv2D(c_in, c_out, (1, 1), stride, **kw)
+            self.bn_proj = BatchNorm(c_out, dtype=dtype)
+
+    def _children(self):
+        names = ["conv1", "bn1", "conv2", "bn2", "conv3", "bn3"]
+        if self.project:
+            names += ["conv_proj", "bn_proj"]
+        return names
+
+    def init(self, key) -> Variables:
+        names = self._children()
+        keys = jax.random.split(key, len(names))
+        ps, ss = {}, {}
+        for n, k in zip(names, keys):
+            vs = getattr(self, n).init(k)
+            ps[n], ss[n] = vs["params"], vs["state"]
+        return variables(ps, ss)
+
+    def apply(self, vs, x, *, train=False, rng=None):
+        p, s = vs["params"], vs["state"]
+        ns = dict(s)
+
+        def run(name, h):
+            mod = getattr(self, name)
+            out, st = mod.apply(variables(p[name], s.get(name, {})), h,
+                                train=train)
+            ns[name] = st
+            return out
+
+        h = relu(run("bn1", run("conv1", x)))
+        h = relu(run("bn2", run("conv2", h)))
+        h = run("bn3", run("conv3", h))
+        shortcut = x
+        if self.project:
+            shortcut = run("bn_proj", run("conv_proj", x))
+        return relu(h + shortcut), ns
+
+
+class ResNet(Module):
+    """configurable depth; ``small_inputs`` swaps the 7x7/maxpool stem for
+    CIFAR's 3x3 stem."""
+
+    def __init__(self, block_counts: Sequence[int], num_classes: int, *,
+                 small_inputs: bool = False, dtype=jnp.float32,
+                 precision: str = "default"):
+        kw = dict(dtype=dtype, precision=precision)
+        self.small_inputs = small_inputs
+        if small_inputs:
+            self.stem = Conv2D(3, 64, (3, 3), 1, **kw)
+        else:
+            self.stem = Conv2D(3, 64, (7, 7), 2, **kw)
+        self.stem_bn = BatchNorm(64, dtype=dtype)
+        self.blocks: List[BottleneckBlock] = []
+        c_in = 64
+        for stage, count in enumerate(block_counts):
+            width = 64 * (2 ** stage)
+            for i in range(count):
+                stride = 2 if (i == 0 and stage > 0) else 1
+                self.blocks.append(BottleneckBlock(c_in, width, stride, **kw))
+                c_in = width * BottleneckBlock.expansion
+        self.head = Dense(c_in, num_classes, dtype=jnp.float32,
+                          precision=kw["precision"])
+
+    def init(self, key) -> Variables:
+        keys = jax.random.split(key, len(self.blocks) + 3)
+        ps, ss = {}, {}
+        for name, mod, k in [("stem", self.stem, keys[0]),
+                             ("stem_bn", self.stem_bn, keys[1]),
+                             ("head", self.head, keys[2])]:
+            vs = mod.init(k)
+            ps[name], ss[name] = vs["params"], vs["state"]
+        for i, (b, k) in enumerate(zip(self.blocks, keys[3:])):
+            vs = b.init(k)
+            ps[f"block{i}"], ss[f"block{i}"] = vs["params"], vs["state"]
+        return variables(ps, ss)
+
+    def apply(self, vs, x, *, train=False, rng=None):
+        p, s = vs["params"], vs["state"]
+        ns = {}
+        h, st = self.stem.apply(variables(p["stem"]), x)
+        ns["stem"] = st
+        h, st = self.stem_bn.apply(variables(p["stem_bn"],
+                                             s.get("stem_bn", {})), h,
+                                   train=train)
+        ns["stem_bn"] = st
+        h = relu(h)
+        if not self.small_inputs:
+            h = max_pool(h, 3, 2)
+        for i, b in enumerate(self.blocks):
+            h, st = b.apply(variables(p[f"block{i}"], s.get(f"block{i}", {})),
+                            h, train=train)
+            ns[f"block{i}"] = st
+        h = avg_pool_global(h).astype(jnp.float32)
+        logits, st = self.head.apply(variables(p["head"]), h)
+        ns["head"] = st
+        return logits, ns
+
+
+def resnet50(num_classes: int = 10, *, small_inputs: bool = True,
+             dtype=jnp.bfloat16, precision: str = "default") -> ResNet:
+    return ResNet([3, 4, 6, 3], num_classes, small_inputs=small_inputs,
+                  dtype=dtype, precision=precision)
+
+
+def resnet18_ish(num_classes: int = 10, *, dtype=jnp.bfloat16) -> ResNet:
+    """Small bottleneck variant for tests/CI."""
+    return ResNet([1, 1], num_classes, small_inputs=True, dtype=dtype)

@@ -1,0 +1,5 @@
+from tosem_tpu.nn.core import Module, Sequential, Lambda, variables
+from tosem_tpu.nn.layers import (Dense, Conv2D, BatchNorm, LayerNorm,
+                                 Embedding, Dropout, max_pool,
+                                 avg_pool_global, gelu, relu)
+from tosem_tpu.nn.attention import MultiHeadAttention, dot_product_attention

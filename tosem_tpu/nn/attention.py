@@ -1,0 +1,75 @@
+"""Multi-head attention (XLA path).
+
+The Pallas fused kernel lives in ``tosem_tpu.ops.flash_attention``; this
+module is the reference XLA implementation used for parity tests and for
+shapes where the fused kernel does not pay off. The reference has no
+transformer (SURVEY §5.7) — this exists for north-star config 5 (BERT-base
+kernel suite) and as the carrier for sequence parallelism.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tosem_tpu.nn.core import Module, Variables, variables, split_key
+from tosem_tpu.nn.layers import Dense, Dropout
+from tosem_tpu.ops.common import PRECISION
+
+
+def dot_product_attention(q, k, v, mask: Optional[jax.Array] = None, *,
+                          precision: str = "default"):
+    """q,k,v: [B, T, H, D]. mask: broadcastable to [B, H, Tq, Tk] (1=keep)."""
+    d = q.shape[-1]
+    scale = 1.0 / np.sqrt(d)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        precision=PRECISION[precision]) * scale
+    logits = logits.astype(jnp.float32)
+    if mask is not None:
+        logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+    weights = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", weights, v,
+                      precision=PRECISION[precision])
+
+
+class MultiHeadAttention(Module):
+    def __init__(self, dim: int, heads: int, *, dropout: float = 0.0,
+                 dtype=jnp.float32, precision: str = "default"):
+        if dim % heads:
+            raise ValueError(f"dim {dim} not divisible by heads {heads}")
+        self.dim, self.heads, self.head_dim = dim, heads, dim // heads
+        self.dtype, self.precision = dtype, precision
+        self.q = Dense(dim, dim, dtype=dtype, precision=precision)
+        self.k = Dense(dim, dim, dtype=dtype, precision=precision)
+        self.v = Dense(dim, dim, dtype=dtype, precision=precision)
+        self.o = Dense(dim, dim, dtype=dtype, precision=precision)
+        self.drop = Dropout(dropout)
+
+    def init(self, key) -> Variables:
+        ks = jax.random.split(key, 4)
+        return variables({
+            "q": self.q.init(ks[0])["params"],
+            "k": self.k.init(ks[1])["params"],
+            "v": self.v.init(ks[2])["params"],
+            "o": self.o.init(ks[3])["params"],
+        })
+
+    def apply(self, vs, x, *, mask=None, train=False, rng=None,
+              attn_fn=None):
+        """attn_fn overrides the core attention (e.g. Pallas flash, ring)."""
+        p = vs["params"]
+        B, T, _ = x.shape
+        proj = lambda name, m: m.apply(variables(p[name]), x)[0].reshape(
+            B, T, self.heads, self.head_dim)
+        q = proj("q", self.q)
+        k = proj("k", self.k)
+        v = proj("v", self.v)
+        core = attn_fn or (
+            lambda q, k, v, mask: dot_product_attention(
+                q, k, v, mask, precision=self.precision))
+        out = core(q, k, v, mask).reshape(B, T, self.dim)
+        out, _ = self.o.apply(variables(p["o"]), out)
+        out, _ = self.drop.apply(variables({}), out, train=train, rng=rng)
+        return out, vs["state"]

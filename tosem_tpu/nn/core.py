@@ -1,0 +1,100 @@
+"""Functional module system: params-as-pytrees, explicit state.
+
+This is the framework's model-building layer — the role TF1 graph builders
+play in the reference (DeepSpeech ``train.py:163`` ``create_model`` wires
+dense/LSTM layers by hand; EfficientDet ``efficientdet_arch.py`` builds
+Keras-style graphs). Design choices are TPU-first rather than a port of
+either:
+
+- **Pure functions over pytrees.** ``init(key) -> Variables`` and
+  ``apply(variables, x) -> (y, new_state)`` are both jit/vmap/shard_map
+  compatible; parameters are plain nested dicts so ``jax.tree_util`` /
+  sharding annotations apply directly.
+- **Explicit shapes.** Layers take input/output dims up front (no lazy
+  shape inference) — everything is static under ``jit``.
+- **Uniform state threading.** Mutable collections (batch-norm moving
+  stats) live in ``variables["state"]``; every ``apply`` returns the new
+  state so training steps stay functional.
+
+Variables layout: ``{"params": pytree, "state": pytree}``.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Params = Any
+State = Any
+Variables = Dict[str, Any]
+
+
+def variables(params: Params, state: State = None) -> Variables:
+    return {"params": params, "state": {} if state is None else state}
+
+
+class Module:
+    """Base class. Subclasses implement ``init`` and ``apply``.
+
+    ``apply(variables, *inputs, train=False, rng=None) -> (out, new_state)``.
+    Stateless modules return ``variables["state"]`` unchanged.
+    """
+
+    def init(self, key: jax.Array) -> Variables:
+        raise NotImplementedError
+
+    def apply(self, vs: Variables, *inputs, train: bool = False,
+              rng: Optional[jax.Array] = None):
+        raise NotImplementedError
+
+    # convenience: plain forward for stateless use
+    def __call__(self, vs: Variables, *inputs, **kw):
+        out, _ = self.apply(vs, *inputs, **kw)
+        return out
+
+    def param_count(self, vs: Variables) -> int:
+        return sum(int(p.size) for p in jax.tree_util.tree_leaves(vs["params"]))
+
+
+class Sequential(Module):
+    def __init__(self, *mods: Module):
+        self.mods = mods
+
+    def init(self, key: jax.Array) -> Variables:
+        keys = jax.random.split(key, max(len(self.mods), 1))
+        ps, ss = {}, {}
+        for i, (m, k) in enumerate(zip(self.mods, keys)):
+            vs = m.init(k)
+            ps[str(i)] = vs["params"]
+            ss[str(i)] = vs["state"]
+        return variables(ps, ss)
+
+    def apply(self, vs, x, *, train=False, rng=None):
+        new_state = {}
+        rngs = (jax.random.split(rng, len(self.mods))
+                if rng is not None else [None] * len(self.mods))
+        for i, m in enumerate(self.mods):
+            sub = variables(vs["params"][str(i)], vs["state"].get(str(i), {}))
+            x, s = m.apply(sub, x, train=train, rng=rngs[i])
+            new_state[str(i)] = s
+        return x, new_state
+
+
+class Lambda(Module):
+    """Wrap a stateless function as a module (activation, pooling…)."""
+
+    def __init__(self, fn: Callable[..., jax.Array]):
+        self.fn = fn
+
+    def init(self, key):
+        return variables({})
+
+    def apply(self, vs, x, *, train=False, rng=None):
+        return self.fn(x), vs["state"]
+
+
+def split_key(key: Optional[jax.Array], n: int):
+    if key is None:
+        return [None] * n
+    return list(jax.random.split(key, n))

@@ -1,0 +1,192 @@
+"""Standard layers. All computations stay in the param dtype given at
+construction; matmul-bearing layers take a ``precision`` name (see
+``tosem_tpu.ops.common.PRECISION``) so fp32 runs are honest fp32.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from tosem_tpu.nn.core import Module, Variables, variables
+from tosem_tpu.ops.common import PRECISION
+
+
+def _he_normal(key, shape, fan_in, dtype):
+    std = np.sqrt(2.0 / fan_in)
+    return (jax.random.normal(key, shape, jnp.float32) * std).astype(dtype)
+
+
+def _trunc_normal(key, shape, std, dtype):
+    return (jax.random.truncated_normal(key, -2.0, 2.0, shape, jnp.float32)
+            * std).astype(dtype)
+
+
+class Dense(Module):
+    def __init__(self, d_in: int, d_out: int, *, bias: bool = True,
+                 dtype=jnp.float32, precision: str = "default",
+                 init_std: Optional[float] = None):
+        self.d_in, self.d_out, self.bias = d_in, d_out, bias
+        self.dtype, self.precision = dtype, precision
+        self.init_std = init_std
+
+    def init(self, key) -> Variables:
+        kw, _ = jax.random.split(key)
+        if self.init_std is None:
+            w = _he_normal(kw, (self.d_in, self.d_out), self.d_in, self.dtype)
+        else:
+            w = _trunc_normal(kw, (self.d_in, self.d_out), self.init_std,
+                              self.dtype)
+        p = {"w": w}
+        if self.bias:
+            p["b"] = jnp.zeros((self.d_out,), self.dtype)
+        return variables(p)
+
+    def apply(self, vs, x, *, train=False, rng=None):
+        y = jnp.dot(x, vs["params"]["w"], precision=PRECISION[self.precision])
+        if self.bias:
+            y = y + vs["params"]["b"]
+        return y, vs["state"]
+
+
+class Conv2D(Module):
+    """NHWC x HWIO conv, SAME or VALID padding."""
+
+    def __init__(self, c_in: int, c_out: int, kernel: Tuple[int, int],
+                 stride: int = 1, *, padding: str = "SAME", bias: bool = False,
+                 dtype=jnp.float32, precision: str = "default"):
+        self.c_in, self.c_out = c_in, c_out
+        self.kernel, self.stride, self.padding = kernel, stride, padding
+        self.bias, self.dtype, self.precision = bias, dtype, precision
+
+    def init(self, key) -> Variables:
+        kh, kw = self.kernel
+        fan_in = kh * kw * self.c_in
+        w = _he_normal(key, (kh, kw, self.c_in, self.c_out), fan_in,
+                       self.dtype)
+        p = {"w": w}
+        if self.bias:
+            p["b"] = jnp.zeros((self.c_out,), self.dtype)
+        return variables(p)
+
+    def apply(self, vs, x, *, train=False, rng=None):
+        y = lax.conv_general_dilated(
+            x, vs["params"]["w"], window_strides=(self.stride, self.stride),
+            padding=self.padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            precision=PRECISION[self.precision])
+        if self.bias:
+            y = y + vs["params"]["b"]
+        return y, vs["state"]
+
+
+class BatchNorm(Module):
+    """Batch normalization with moving-average inference stats.
+
+    Moving stats live in ``state`` (non-trainable); training uses batch
+    stats and returns updated movings — functional equivalent of TF's
+    update ops in DeepSpeech/EfficientDet training graphs.
+    """
+
+    def __init__(self, dim: int, *, momentum: float = 0.9, eps: float = 1e-5,
+                 dtype=jnp.float32):
+        self.dim, self.momentum, self.eps, self.dtype = dim, momentum, eps, dtype
+
+    def init(self, key) -> Variables:
+        p = {"scale": jnp.ones((self.dim,), self.dtype),
+             "bias": jnp.zeros((self.dim,), self.dtype)}
+        s = {"mean": jnp.zeros((self.dim,), jnp.float32),
+             "var": jnp.ones((self.dim,), jnp.float32)}
+        return variables(p, s)
+
+    def apply(self, vs, x, *, train=False, rng=None):
+        p, s = vs["params"], vs["state"]
+        axes = tuple(range(x.ndim - 1))
+        if train:
+            xf = x.astype(jnp.float32)
+            mean = jnp.mean(xf, axes)
+            var = jnp.var(xf, axes)
+            m = self.momentum
+            new_state = {"mean": m * s["mean"] + (1 - m) * mean,
+                         "var": m * s["var"] + (1 - m) * var}
+        else:
+            mean, var = s["mean"], s["var"]
+            new_state = s
+        inv = lax.rsqrt(var + self.eps)
+        y = (x.astype(jnp.float32) - mean) * inv
+        y = y.astype(self.dtype) * p["scale"] + p["bias"]
+        return y.astype(x.dtype), new_state
+
+
+class LayerNorm(Module):
+    def __init__(self, dim: int, *, eps: float = 1e-6, dtype=jnp.float32):
+        self.dim, self.eps, self.dtype = dim, eps, dtype
+
+    def init(self, key) -> Variables:
+        return variables({"scale": jnp.ones((self.dim,), self.dtype),
+                          "bias": jnp.zeros((self.dim,), self.dtype)})
+
+    def apply(self, vs, x, *, train=False, rng=None):
+        p = vs["params"]
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, -1, keepdims=True)
+        var = jnp.var(xf, -1, keepdims=True)
+        y = (xf - mean) * lax.rsqrt(var + self.eps)
+        y = y.astype(x.dtype) * p["scale"] + p["bias"]
+        return y, vs["state"]
+
+
+class Embedding(Module):
+    def __init__(self, vocab: int, dim: int, *, dtype=jnp.float32,
+                 init_std: float = 0.02):
+        self.vocab, self.dim, self.dtype, self.init_std = vocab, dim, dtype, init_std
+
+    def init(self, key) -> Variables:
+        table = _trunc_normal(key, (self.vocab, self.dim), self.init_std,
+                              self.dtype)
+        return variables({"table": table})
+
+    def apply(self, vs, ids, *, train=False, rng=None):
+        return jnp.take(vs["params"]["table"], ids, axis=0), vs["state"]
+
+    def attend(self, vs, x):
+        """Logits against the embedding table (tied softmax head)."""
+        return jnp.dot(x, vs["params"]["table"].T)
+
+
+class Dropout(Module):
+    def __init__(self, rate: float):
+        self.rate = rate
+
+    def init(self, key) -> Variables:
+        return variables({})
+
+    def apply(self, vs, x, *, train=False, rng=None):
+        if not train or self.rate == 0.0:
+            return x, vs["state"]
+        if rng is None:
+            raise ValueError("Dropout needs rng when train=True")
+        keep = 1.0 - self.rate
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype), vs["state"]
+
+
+def max_pool(x, window: int, stride: int, padding: str = "SAME"):
+    return lax.reduce_window(x, -jnp.inf, lax.max,
+                             (1, window, window, 1), (1, stride, stride, 1),
+                             padding)
+
+
+def avg_pool_global(x):
+    return jnp.mean(x, axis=(1, 2))
+
+
+def gelu(x):
+    return jax.nn.gelu(x)
+
+
+def relu(x):
+    return jax.nn.relu(x)

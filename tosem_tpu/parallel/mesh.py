@@ -96,10 +96,16 @@ def multihost_init(coordinator_address: Optional[str] = None,
     addr = coordinator_address or os.environ.get("COORDINATOR_ADDRESS")
     if addr is None:
         return False
-    nproc = num_processes if num_processes is not None else int(
-        os.environ.get("NUM_PROCESSES", "1"))
-    pid = process_id if process_id is not None else int(
-        os.environ.get("PROCESS_ID", "0"))
+    nproc = num_processes if num_processes is not None else os.environ.get(
+        "NUM_PROCESSES")
+    pid = process_id if process_id is not None else os.environ.get(
+        "PROCESS_ID")
+    if nproc is None or pid is None:
+        # defaulting to a 1-process topology here would make every host of
+        # a misconfigured job believe it is its own cluster and hang later
+        raise ValueError(
+            "COORDINATOR_ADDRESS set but NUM_PROCESSES/PROCESS_ID missing")
     jax.distributed.initialize(coordinator_address=addr,
-                               num_processes=nproc, process_id=pid)
+                               num_processes=int(nproc),
+                               process_id=int(pid))
     return True

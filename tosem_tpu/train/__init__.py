@@ -1,0 +1,4 @@
+from tosem_tpu.train.trainer import (TrainState, create_train_state,
+                                     make_train_step, cross_entropy_loss,
+                                     shard_batch)
+from tosem_tpu.train.checkpoint import save_checkpoint, restore_checkpoint

@@ -1,0 +1,50 @@
+"""Sharded checkpoint / resume via orbax.
+
+Role model: DeepSpeech's ``util/checkpoints.py:126`` (load-or-init for
+training, plus cudnn→cpu conversion) and Tune's ``Trainable.save/restore``
+contract. On TPU the checkpoint is a sharded pytree write — orbax handles
+per-shard IO across hosts — and "load_or_init" becomes
+:func:`restore_or_init`.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Optional
+
+import jax
+
+try:
+    import orbax.checkpoint as ocp
+    _HAVE_ORBAX = True
+except Exception:  # pragma: no cover
+    ocp = None
+    _HAVE_ORBAX = False
+
+
+def _path(path: str) -> str:
+    return os.path.abspath(os.path.expanduser(path))
+
+
+def save_checkpoint(path: str, tree: Any) -> None:
+    if not _HAVE_ORBAX:
+        raise RuntimeError("orbax not available")
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(_path(path), tree, force=True)
+    ckptr.wait_until_finished()
+
+
+def restore_checkpoint(path: str, template: Any) -> Any:
+    """Restore into the structure/shardings of ``template``."""
+    if not _HAVE_ORBAX:
+        raise RuntimeError("orbax not available")
+    ckptr = ocp.StandardCheckpointer()
+    return ckptr.restore(_path(path), template)
+
+
+def restore_or_init(path: str, init_fn: Callable[[], Any]) -> Any:
+    """DeepSpeech's load_or_init contract: restore if present else init."""
+    tree = init_fn()
+    p = _path(path)
+    if os.path.isdir(p):
+        return restore_checkpoint(p, tree)
+    return tree
