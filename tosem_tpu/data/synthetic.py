@@ -55,15 +55,23 @@ def mlm_batches(batch_size: int, seq_len: int, vocab: int, *,
                 mask_rate: float = 0.15, seed: int = 0
                 ) -> Iterator[Dict[str, np.ndarray]]:
     """Token batches with BERT-style masking. ``labels`` hold the original
-    token everywhere (loss may be restricted by the caller)."""
+    token everywhere; ``masked`` marks which positions were replaced by
+    ``mask_id`` (the MLM loss averages only there).
+
+    Sequences are successor chains (t[j+1] = t[j] + 1 mod usable vocab) so a
+    masked token IS predictable from its neighbours — pure-noise tokens
+    would make the masked-LM objective unlearnable and CI couldn't assert
+    a decreasing loss."""
     rng = np.random.default_rng(seed)
+    usable = vocab - 2
     i = 0
     while steps is None or i < steps:
-        ids = rng.integers(2, vocab, size=(batch_size, seq_len),
-                           dtype=np.int32)
+        start = rng.integers(0, usable, size=(batch_size, 1))
+        ids = (2 + (start + np.arange(seq_len)[None, :]) % usable).astype(
+            np.int32)
         labels = ids.copy()
         masked = rng.random((batch_size, seq_len)) < mask_rate
         ids = np.where(masked, mask_id, ids).astype(np.int32)
-        yield {"ids": ids, "labels": labels,
+        yield {"ids": ids, "labels": labels, "masked": masked,
                "mask": np.ones((batch_size, seq_len), np.int32)}
         i += 1

@@ -14,7 +14,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from tosem_tpu.nn.core import Module, Variables, variables, split_key
+from tosem_tpu.nn.core import Module, Variables, variables
 from tosem_tpu.nn.layers import Dense, Dropout
 from tosem_tpu.ops.common import PRECISION
 

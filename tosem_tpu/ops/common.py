@@ -8,3 +8,10 @@ PRECISION = {
     "tensorfloat32": lax.Precision.HIGH,
     "default": lax.Precision.DEFAULT,
 }
+
+
+def interpret_default() -> bool:
+    """Pallas kernels interpret off-TPU (CI's CPU mesh), compile natively
+    on TPU."""
+    import jax
+    return jax.default_backend() != "tpu"

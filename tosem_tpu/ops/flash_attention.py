@@ -1,0 +1,288 @@
+"""Fused (flash) attention — Pallas TPU kernel, fwd + bwd.
+
+North-star config 5 is the BERT-base fwd/bwd kernel suite: attention,
+layernorm, softmax. The reference has no fused attention (its subject
+systems predate it; closest are the hand-fused CUDA kernels like the
+PointPillars pipeline, SURVEY §2.2) — this is the TPU-native equivalent of
+that "hand-fuse the hot path" practice: online-softmax tiling keeps the
+T×T score matrix out of HBM entirely, trading it for O(T·d) VMEM blocks.
+
+Layout: [B, H, T, D]. Grid (B·H, Tq/bq); K/V stream through VMEM in bk
+chunks inside a fori_loop. All statistics in fp32. Backward uses the
+standard recompute-from-logsumexp scheme (two kernels: dKV and dQ).
+
+The XLA reference implementation for parity tests lives in
+``tosem_tpu.nn.attention.dot_product_attention``.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BQ = 128
+DEFAULT_BK = 128
+_NEG_INF = -1e30
+
+
+from tosem_tpu.ops.common import interpret_default as _interpret
+
+
+def _causal_mask(bq: int, bk: int, qi: int, kj: int):
+    rows = lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + qi
+    cols = lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + kj
+    return rows >= cols
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bk, sm_scale, causal):
+    q = q_ref[0].astype(jnp.float32) * sm_scale          # (bq, d)
+    bq, d = q.shape
+    Tk = k_ref.shape[1]
+    qi = pl.program_id(1) * bq
+
+    def body(j, carry):
+        m, l, acc = carry
+        kj = j * bk
+        k = k_ref[0, pl.ds(kj, bk), :].astype(jnp.float32)   # (bk, d)
+        v = v_ref[0, pl.ds(kj, bk), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            s = jnp.where(_causal_mask(bq, bk, qi, kj), s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, -1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, -1, keepdims=True)
+        acc = acc * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l, acc
+
+    m0 = jnp.full((bq, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    a0 = jnp.zeros((bq, d), jnp.float32)
+    n_k = Tk // bk
+    if causal:
+        # only blocks with kj <= qi+bq-1 contribute
+        n_k_eff = lax.div(qi + bq - 1, bk) + 1
+        m, l, acc = lax.fori_loop(0, n_k_eff, body, (m0, l0, a0))
+    else:
+        m, l, acc = lax.fori_loop(0, n_k, body, (m0, l0, a0))
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+    lse_ref[0] = (m + jnp.log(l))[:, 0]
+
+
+def _flash_fwd(q, k, v, sm_scale, causal, bq, bk):
+    B, H, Tq, d = q.shape
+    Tk = k.shape[2]
+    bq = min(bq, Tq)
+    bk = min(bk, Tk)
+    if Tq % bq or Tk % bk:
+        raise ValueError(f"sequence lengths ({Tq},{Tk}) must divide into "
+                         f"blocks ({bq},{bk})")
+    qr = q.reshape(B * H, Tq, d)
+    kr = k.reshape(B * H, Tk, d)
+    vr = v.reshape(B * H, Tk, d)
+    grid = (B * H, Tq // bq)
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, bk=bk, sm_scale=sm_scale,
+                          causal=causal),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, Tk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, Tk, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, bq), lambda b, i: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Tq, d), q.dtype),
+            jax.ShapeDtypeStruct((B * H, Tq), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(qr, kr, vr)
+    return out.reshape(B, H, Tq, d), lse.reshape(B, H, Tq)
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, bq, sm_scale, causal):
+    k = k_ref[0].astype(jnp.float32)                     # (bk, d)
+    v = v_ref[0].astype(jnp.float32)
+    bk, d = k.shape
+    Tq = q_ref.shape[1]
+    kj = pl.program_id(1) * bk
+
+    def body(i, carry):
+        dk, dv = carry
+        qi = i * bq
+        q = q_ref[0, pl.ds(qi, bq), :].astype(jnp.float32) * sm_scale
+        do = do_ref[0, pl.ds(qi, bq), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(qi, bq)][:, None]
+        delta = delta_ref[0, pl.ds(qi, bq)][:, None]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            s = jnp.where(_causal_mask(bq, bk, qi, kj), s, _NEG_INF)
+        p = jnp.exp(s - lse)                              # (bq, bk)
+        dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)                             # (bq, bk)
+        dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        return dk, dv
+
+    dk0 = jnp.zeros((bk, d), jnp.float32)
+    dv0 = jnp.zeros((bk, d), jnp.float32)
+    if causal:
+        start = lax.div(kj, bq)
+        dk, dv = lax.fori_loop(start, Tq // bq, body, (dk0, dv0))
+    else:
+        dk, dv = lax.fori_loop(0, Tq // bq, body, (dk0, dv0))
+    # q was loaded pre-scaled, so dk = ds^T @ (scale*q) already carries the
+    # softmax scale — no extra factor here
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, *, bk, sm_scale, causal):
+    q = q_ref[0].astype(jnp.float32) * sm_scale
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0][:, None]
+    delta = delta_ref[0][:, None]
+    bq, d = q.shape
+    Tk = k_ref.shape[1]
+    qi = pl.program_id(1) * bq
+
+    def body(j, dq):
+        kj = j * bk
+        k = k_ref[0, pl.ds(kj, bk), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(kj, bk), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            s = jnp.where(_causal_mask(bq, bk, qi, kj), s, _NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+
+    dq0 = jnp.zeros((bq, d), jnp.float32)
+    if causal:
+        n_k_eff = lax.div(qi + bq - 1, bk) + 1
+        dq = lax.fori_loop(0, n_k_eff, body, dq0)
+    else:
+        dq = lax.fori_loop(0, Tk // bk, body, dq0)
+    dq_ref[0] = (dq * sm_scale).astype(dq_ref.dtype)
+
+
+def _flash_bwd(sm_scale, causal, bq, bk, res, g):
+    q, k, v, out, lse = res
+    do, _ = g
+    B, H, Tq, d = q.shape
+    Tk = k.shape[2]
+    bq = min(bq, Tq)
+    bk = min(bk, Tk)
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), -1)
+    shapes = dict(
+        q=q.reshape(B * H, Tq, d), k=k.reshape(B * H, Tk, d),
+        v=v.reshape(B * H, Tk, d), do=do.reshape(B * H, Tq, d),
+        lse=lse.reshape(B * H, Tq), delta=delta.reshape(B * H, Tq))
+    args = [shapes["q"], shapes["k"], shapes["v"], shapes["do"],
+            shapes["lse"], shapes["delta"]]
+    qspec_full = pl.BlockSpec((1, Tq, d), lambda b, j: (b, 0, 0))
+    vec_full = pl.BlockSpec((1, Tq), lambda b, j: (b, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, bq=bq, sm_scale=sm_scale,
+                          causal=causal),
+        grid=(B * H, Tk // bk),
+        in_specs=[qspec_full,
+                  pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
+                  pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
+                  qspec_full, vec_full, vec_full],
+        out_specs=[pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
+                   pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0))],
+        out_shape=[jax.ShapeDtypeStruct((B * H, Tk, d), k.dtype),
+                   jax.ShapeDtypeStruct((B * H, Tk, d), v.dtype)],
+        interpret=_interpret(),
+    )(*args)
+    kv_full = pl.BlockSpec((1, Tk, d), lambda b, i: (b, 0, 0))
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, bk=bk, sm_scale=sm_scale,
+                          causal=causal),
+        grid=(B * H, Tq // bq),
+        in_specs=[pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
+                  kv_full, kv_full,
+                  pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
+                  pl.BlockSpec((1, bq), lambda b, i: (b, i)),
+                  pl.BlockSpec((1, bq), lambda b, i: (b, i))],
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, Tq, d), q.dtype),
+        interpret=_interpret(),
+    )(*args)
+    return (dq.reshape(B, H, Tq, d), dk.reshape(B, H, Tk, d),
+            dv.reshape(B, H, Tk, d))
+
+
+# ---------------------------------------------------------------------------
+# public op
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, sm_scale: Optional[float] = None,
+                    causal: bool = False, bq: int = DEFAULT_BQ,
+                    bk: int = DEFAULT_BK):
+    """q,k,v: [B, H, T, D] → [B, H, T, D]."""
+    (out, _lse), _ = _fwd_rule(q, k, v, sm_scale, causal, bq, bk)
+    return out
+
+
+def _fwd_rule(q, k, v, sm_scale, causal, bq, bk):
+    scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(q.shape[-1])
+    out, lse = _flash_fwd(q, k, v, scale, causal, bq, bk)
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _vjp_fwd(q, k, v, sm_scale, causal, bq, bk):
+    (out, lse), res = _fwd_rule(q, k, v, sm_scale, causal, bq, bk)
+    return out, res
+
+
+def _vjp_bwd(sm_scale, causal, bq, bk, res, g):
+    scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(
+        res[0].shape[-1])
+    return _flash_bwd(scale, causal, bq, bk, res, (g, None))
+
+
+flash_attention.defvjp(_vjp_fwd, _vjp_bwd)
+
+
+def mha_flash_attention(q, k, v, mask=None, *, causal: bool = False):
+    """Adapter with the [B, T, H, D] layout of
+    :func:`tosem_tpu.nn.attention.dot_product_attention`. ``mask`` must be
+    None (padding masks take the XLA path)."""
+    if mask is not None:
+        raise ValueError("flash path supports causal/none masks only")
+    out = flash_attention(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+                          v.transpose(0, 2, 1, 3), None, causal)
+    return out.transpose(0, 2, 1, 3)

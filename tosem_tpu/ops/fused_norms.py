@@ -1,0 +1,184 @@
+"""Fused layernorm + softmax — Pallas kernels with analytic backward.
+
+Second half of north-star config 5 (BERT kernel suite). The reference's
+analog is cuDNN's fused softmax in the TensorRT plugin
+(``modules/perception/inference/tensorrt/plugins/softmax_plugin.cu:46``
+calls ``cudnnSoftmaxForward``). Forward passes are single-read fused Pallas
+kernels (statistics in fp32, one HBM round trip); backward uses the
+analytic formulas as Pallas kernels over the same row blocks.
+
+Both ops flatten inputs to (rows, dim) and grid over row blocks.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+_ROW_BLOCK = 256
+
+
+from tosem_tpu.ops.common import interpret_default as _interpret
+
+
+def _rows_grid(n_rows: int) -> Tuple[int, int]:
+    br = min(_ROW_BLOCK, n_rows)
+    while n_rows % br:
+        br //= 2
+    return max(br, 1), n_rows // max(br, 1)
+
+
+# ---------------------------------------------------------------------------
+# layernorm
+# ---------------------------------------------------------------------------
+
+def _ln_fwd_kernel(x_ref, g_ref, b_ref, o_ref, mu_ref, rstd_ref, *, eps):
+    x = x_ref[:].astype(jnp.float32)
+    mu = jnp.mean(x, -1, keepdims=True)
+    xc = x - mu
+    var = jnp.mean(xc * xc, -1, keepdims=True)
+    rstd = lax.rsqrt(var + eps)
+    y = xc * rstd
+    o_ref[:] = (y * g_ref[:].astype(jnp.float32)
+                + b_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
+    mu_ref[:] = mu[:, 0]
+    rstd_ref[:] = rstd[:, 0]
+
+
+def _ln_bwd_kernel(x_ref, g_ref, mu_ref, rstd_ref, dy_ref,
+                   dx_ref, dg_ref, db_ref):
+    x = x_ref[:].astype(jnp.float32)
+    g = g_ref[:].astype(jnp.float32)
+    dy = dy_ref[:].astype(jnp.float32)
+    mu = mu_ref[:][:, None]
+    rstd = rstd_ref[:][:, None]
+    xhat = (x - mu) * rstd
+    wdy = dy * g
+    c1 = jnp.mean(wdy, -1, keepdims=True)
+    c2 = jnp.mean(wdy * xhat, -1, keepdims=True)
+    dx = (wdy - c1 - xhat * c2) * rstd
+    dx_ref[:] = dx.astype(dx_ref.dtype)
+    # per-block partial reductions for dgamma/dbeta (summed outside)
+    dg_ref[:] = jnp.sum(dy * xhat, 0, keepdims=True)
+    db_ref[:] = jnp.sum(dy, 0, keepdims=True)
+
+
+def _ln_fwd(x2, gamma, beta, eps):
+    R, D = x2.shape
+    br, n_blocks = _rows_grid(R)
+    out, mu, rstd = pl.pallas_call(
+        functools.partial(_ln_fwd_kernel, eps=eps),
+        grid=(n_blocks,),
+        in_specs=[pl.BlockSpec((br, D), lambda i: (i, 0)),
+                  pl.BlockSpec((D,), lambda i: (0,)),
+                  pl.BlockSpec((D,), lambda i: (0,))],
+        out_specs=[pl.BlockSpec((br, D), lambda i: (i, 0)),
+                   pl.BlockSpec((br,), lambda i: (i,)),
+                   pl.BlockSpec((br,), lambda i: (i,))],
+        out_shape=[jax.ShapeDtypeStruct((R, D), x2.dtype),
+                   jax.ShapeDtypeStruct((R,), jnp.float32),
+                   jax.ShapeDtypeStruct((R,), jnp.float32)],
+        interpret=_interpret(),
+    )(x2, gamma, beta)
+    return out, mu, rstd
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def fused_layernorm(x, gamma, beta, eps: float = 1e-6):
+    """LayerNorm over the last dim. x: [..., D]."""
+    x2 = x.reshape(-1, x.shape[-1])
+    out, _, _ = _ln_fwd(x2, gamma, beta, eps)
+    return out.reshape(x.shape)
+
+
+def _ln_vjp_fwd(x, gamma, beta, eps):
+    x2 = x.reshape(-1, x.shape[-1])
+    out, mu, rstd = _ln_fwd(x2, gamma, beta, eps)
+    return out.reshape(x.shape), (x2, gamma, mu, rstd, x.shape)
+
+
+def _ln_vjp_bwd(eps, res, dy):
+    x2, gamma, mu, rstd, orig_shape = res
+    R, D = x2.shape
+    dy2 = dy.reshape(R, D)
+    br, n_blocks = _rows_grid(R)
+    dx, dg_parts, db_parts = pl.pallas_call(
+        _ln_bwd_kernel,
+        grid=(n_blocks,),
+        in_specs=[pl.BlockSpec((br, D), lambda i: (i, 0)),
+                  pl.BlockSpec((D,), lambda i: (0,)),
+                  pl.BlockSpec((br,), lambda i: (i,)),
+                  pl.BlockSpec((br,), lambda i: (i,)),
+                  pl.BlockSpec((br, D), lambda i: (i, 0))],
+        out_specs=[pl.BlockSpec((br, D), lambda i: (i, 0)),
+                   pl.BlockSpec((1, D), lambda i: (i, 0)),
+                   pl.BlockSpec((1, D), lambda i: (i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((R, D), x2.dtype),
+                   jax.ShapeDtypeStruct((n_blocks, D), jnp.float32),
+                   jax.ShapeDtypeStruct((n_blocks, D), jnp.float32)],
+        interpret=_interpret(),
+    )(x2, gamma, mu, rstd, dy2)
+    dg = jnp.sum(dg_parts, 0).astype(gamma.dtype)
+    db = jnp.sum(db_parts, 0).astype(gamma.dtype)
+    return dx.reshape(orig_shape), dg, db
+
+
+fused_layernorm.defvjp(_ln_vjp_fwd, _ln_vjp_bwd)
+
+
+# ---------------------------------------------------------------------------
+# softmax
+# ---------------------------------------------------------------------------
+
+def _sm_fwd_kernel(x_ref, o_ref):
+    x = x_ref[:].astype(jnp.float32)
+    m = jnp.max(x, -1, keepdims=True)
+    e = jnp.exp(x - m)
+    o_ref[:] = (e / jnp.sum(e, -1, keepdims=True)).astype(o_ref.dtype)
+
+
+def _sm_bwd_kernel(y_ref, dy_ref, dx_ref):
+    y = y_ref[:].astype(jnp.float32)
+    dy = dy_ref[:].astype(jnp.float32)
+    inner = jnp.sum(y * dy, -1, keepdims=True)
+    dx_ref[:] = (y * (dy - inner)).astype(dx_ref.dtype)
+
+
+def _sm_call(kernel, outs_dtype, *arrays):
+    R, D = arrays[0].shape
+    br, n_blocks = _rows_grid(R)
+    spec = pl.BlockSpec((br, D), lambda i: (i, 0))
+    return pl.pallas_call(
+        kernel,
+        grid=(n_blocks,),
+        in_specs=[spec] * len(arrays),
+        out_specs=spec,
+        out_shape=jax.ShapeDtypeStruct((R, D), outs_dtype),
+        interpret=_interpret(),
+    )(*arrays)
+
+
+@jax.custom_vjp
+def fused_softmax(x):
+    """Numerically-stable softmax over the last dim."""
+    x2 = x.reshape(-1, x.shape[-1])
+    return _sm_call(_sm_fwd_kernel, x.dtype, x2).reshape(x.shape)
+
+
+def _sm_vjp_fwd(x):
+    y = fused_softmax(x)
+    return y, y
+
+
+def _sm_vjp_bwd(y, dy):
+    y2 = y.reshape(-1, y.shape[-1])
+    dy2 = dy.reshape(y2.shape)
+    dx = _sm_call(_sm_bwd_kernel, y.dtype, y2, dy2)
+    return (dx.reshape(y.shape),)
+
+
+fused_softmax.defvjp(_sm_vjp_fwd, _sm_vjp_bwd)

@@ -1,0 +1,104 @@
+"""BERT-base fwd/bwd kernel-suite benchmark — north-star config 5.
+
+Shapes follow BERT-base: 12 heads x 64 head-dim (768 hidden), seq 512.
+Attention is reported in GFLOPS (flop model documented per entry);
+layernorm/softmax are HBM-bound, reported as effective GB/s (bytes touched
+per element: read x + write y, fp32 statistics internal).
+"""
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+
+from tosem_tpu.ops.flash_attention import flash_attention
+from tosem_tpu.ops.fused_norms import fused_layernorm, fused_softmax
+from tosem_tpu.utils.results import ResultRow
+from tosem_tpu.utils.timing import DeviceLoopBench
+
+
+def _row(bench_id, metric, value, unit, extra):
+    return ResultRow(project="ops", config="bert_kernel_suite",
+                     bench_id=bench_id, metric=metric, value=value, unit=unit,
+                     device=jax.devices()[0].platform, n_devices=1,
+                     extra=extra)
+
+
+def attention_flops(B, H, T, D, *, bwd: bool) -> float:
+    """fwd: QK^T + PV = 2 matmuls = 4*B*H*T^2*D. bwd (flash, recompute):
+    S recompute + dV + dP + dK + dQ = 5 matmuls = 10*B*H*T^2*D."""
+    fwd = 4.0 * B * H * T * T * D
+    return fwd + (10.0 * B * H * T * T * D if bwd else 0.0)
+
+
+def bert_kernel_suite(*, batch: int = 8, seq: int = 512, heads: int = 12,
+                      head_dim: int = 64, hidden: int = 768,
+                      dtype: str = "bfloat16", reps: int = 3
+                      ) -> List[ResultRow]:
+    dt = jnp.dtype(dtype)
+    ks = jax.random.split(jax.random.PRNGKey(0), 4)
+    B, H, T, D = batch, heads, seq, head_dim
+    q = jax.random.normal(ks[0], (B, H, T, D), jnp.float32).astype(dt)
+    k = jax.random.normal(ks[1], (B, H, T, D), jnp.float32).astype(dt)
+    v = jax.random.normal(ks[2], (B, H, T, D), jnp.float32).astype(dt)
+    rows: List[ResultRow] = []
+
+    # attention forward
+    fwd = jax.jit(lambda a, b, c: flash_attention(a, b, c))
+    sec = DeviceLoopBench(op=fwd, args=(q, k, v), perturb=0).time(reps=reps)
+    fl = attention_flops(B, H, T, D, bwd=False)
+    rows.append(_row(f"attention_fwd_b{B}_t{T}_{dtype}", "gflops",
+                     fl / sec / 1e9, "GFLOPS",
+                     {"flop_model": "4BHT^2D", "time_us": sec * 1e6,
+                      "shape": [B, H, T, D], "dtype": dtype}))
+
+    # attention forward+backward
+    grad_fn = jax.jit(jax.grad(
+        lambda a, b, c: jnp.sum(flash_attention(a, b, c)
+                                .astype(jnp.float32) ** 2), (0, 1, 2)))
+    sec = DeviceLoopBench(op=lambda a, b, c: grad_fn(a, b, c)[0],
+                          args=(q, k, v), perturb=0).time(reps=reps)
+    fl = attention_flops(B, H, T, D, bwd=True)
+    rows.append(_row(f"attention_fwdbwd_b{B}_t{T}_{dtype}", "gflops",
+                     fl / sec / 1e9, "GFLOPS",
+                     {"flop_model": "14BHT^2D", "time_us": sec * 1e6,
+                      "shape": [B, H, T, D], "dtype": dtype}))
+
+    # layernorm fwd / fwd+bwd over [B*T, hidden]
+    x = jax.random.normal(ks[3], (B * T, hidden), jnp.float32).astype(dt)
+    g = jnp.ones((hidden,), dt)
+    bt = jnp.zeros((hidden,), dt)
+    ln = jax.jit(lambda x, g, b: fused_layernorm(x, g, b))
+    sec = DeviceLoopBench(op=ln, args=(x, g, bt), perturb=0).time(reps=reps)
+    bytes_touched = 2 * x.nbytes
+    rows.append(_row(f"layernorm_fwd_{B * T}x{hidden}_{dtype}", "gbps",
+                     bytes_touched / sec / 1e9, "GB/s",
+                     {"bytes": bytes_touched, "time_us": sec * 1e6,
+                      "dtype": dtype}))
+    ln_grad = jax.jit(jax.grad(
+        lambda x, g, b: jnp.sum(fused_layernorm(x, g, b)
+                                .astype(jnp.float32) ** 2), (0, 1, 2)))
+    sec = DeviceLoopBench(op=lambda x, g, b: ln_grad(x, g, b)[0],
+                          args=(x, g, bt), perturb=0).time(reps=reps)
+    rows.append(_row(f"layernorm_fwdbwd_{B * T}x{hidden}_{dtype}", "gbps",
+                     4 * x.nbytes / sec / 1e9, "GB/s",
+                     {"bytes": 4 * x.nbytes, "time_us": sec * 1e6,
+                      "dtype": dtype}))
+
+    # softmax fwd / fwd+bwd over attention-logit shape [B*H*T, T]
+    s = jax.random.normal(ks[3], (B * H * T, T), jnp.float32).astype(dt)
+    sm = jax.jit(fused_softmax)
+    sec = DeviceLoopBench(op=sm, args=(s,), perturb=0).time(reps=reps)
+    rows.append(_row(f"softmax_fwd_{B * H * T}x{T}_{dtype}", "gbps",
+                     2 * s.nbytes / sec / 1e9, "GB/s",
+                     {"bytes": 2 * s.nbytes, "time_us": sec * 1e6,
+                      "dtype": dtype}))
+    sm_grad = jax.jit(jax.grad(
+        lambda x: jnp.sum(fused_softmax(x).astype(jnp.float32) ** 2)))
+    sec = DeviceLoopBench(op=sm_grad, args=(s,), perturb=0).time(reps=reps)
+    rows.append(_row(f"softmax_fwdbwd_{B * H * T}x{T}_{dtype}", "gbps",
+                     4 * s.nbytes / sec / 1e9, "GB/s",
+                     {"bytes": 4 * s.nbytes, "time_us": sec * 1e6,
+                      "dtype": dtype}))
+    return rows

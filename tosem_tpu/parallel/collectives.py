@@ -107,6 +107,10 @@ def broadcast(mesh: Mesh, axis: str, root: int = 0
     n = mesh.shape[axis]
 
     def f(x):
+        if x.shape[0] % n:
+            raise ValueError(
+                f"broadcast input dim0 {x.shape[0]} not divisible by "
+                f"axis size {n}")
         rows = x.shape[0] // n
         root_block = lax.dynamic_slice_in_dim(x, root * rows, rows, 0)
         return jax.lax.with_sharding_constraint(
@@ -188,9 +192,10 @@ def collective_bench(spec: CollectiveSpec, mesh: Mesh, *,
     actual_bytes = x.nbytes if spec.name == "all_gather" else (x.nbytes // n)
     alg_bw = actual_bytes / sec  # B/s
     bus_bw = alg_bw * bus_bandwidth_factor(spec.name, n)
-    # label with the bytes actually moved (alignment may round the
-    # requested size up — two sweep points must not share a disguised size)
-    bench_id = f"{spec.name}_{x.nbytes // n}B_{spec.dtype}"
+    # label with the bytes actually measured (nccl size convention per
+    # collective; alignment may round the requested size up — two sweep
+    # points must not share a disguised size, and id must match extra)
+    bench_id = f"{spec.name}_{actual_bytes}B_{spec.dtype}"
     return ResultRow(
         project="parallel", config="collective_sweep",
         bench_id=bench_id, metric="bus_bw_gbps",

@@ -121,10 +121,23 @@ def classification_loss(model: Module, params, state, batch, rng):
 
 
 def mlm_loss(model: Module, params, state, batch, rng):
-    """Masked-LM loss for BERT-style batches: ids/mask_positions/labels."""
+    """Masked-LM loss for BERT-style batches.
+
+    Batch keys: ``ids`` (with mask tokens substituted), ``labels`` (original
+    tokens), optional ``mask`` (attention mask) and ``masked`` (bool, which
+    positions were masked). The loss averages ONLY over masked positions —
+    averaging everywhere would reward the identity copy, not prediction.
+    Falls back to all positions when ``masked`` is absent (plain LM).
+    """
     enc, new_state = model.apply(variables(params, state), batch["ids"],
                                  mask=batch.get("mask"), train=True, rng=rng)
     logits = model.mlm_logits(variables(params, state), enc)
-    loss = cross_entropy_loss(logits.reshape(-1, logits.shape[-1]),
-                              batch["labels"].reshape(-1))
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    ll = jnp.take_along_axis(logp, batch["labels"][..., None], axis=-1)[..., 0]
+    masked = batch.get("masked")
+    if masked is None:
+        loss = -jnp.mean(ll)
+    else:
+        w = masked.astype(jnp.float32)
+        loss = -jnp.sum(ll * w) / jnp.maximum(jnp.sum(w), 1.0)
     return loss, {"state": new_state}
