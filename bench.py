@@ -1,0 +1,35 @@
+#!/usr/bin/env python
+"""Headline benchmark: north-star config 1 (single-op GEMM microbench).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The anchor is the reference's cuBLAS GEMM path
+(``modules/perception/inference/utils/gemm.cu:107-121`` — ``cublasSgemm``):
+a V100-class part sustains ~13 TFLOPS fp32 on a 1024x1024x1024 SGEMM, so
+``vs_baseline`` is measured GFLOPS / 13000. Timing uses the on-device
+chained-loop harness (``tosem_tpu.utils.timing.DeviceLoopBench``) so the
+number is pure kernel time even over a remote-tunnelled TPU.
+"""
+from __future__ import annotations
+
+import json
+import sys
+
+BASELINE_GFLOPS = 13000.0  # cublasSgemm 1024^3 fp32, V100-class (BASELINE.md)
+
+
+def main() -> None:
+    from tosem_tpu.ops.gemm import GemmSpec, gemm_bench
+
+    spec = GemmSpec(1024, 1024, 1024, dtype="float32", precision="float32")
+    stats, row = gemm_bench(spec)
+    print(json.dumps({
+        "metric": "gemm_1024x1024x1024_fp32_gflops",
+        "value": round(row.value, 2),
+        "unit": "GFLOPS",
+        "vs_baseline": round(row.value / BASELINE_GFLOPS, 4),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -80,6 +80,37 @@ class TestFlashAttention:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=5e-4, rtol=5e-3, err_msg=name)
 
+    def test_causal_cross_attention_tq_gt_tk(self):
+        """Regression: causal with Tq > Tk must clamp the K-block loop to
+        the buffer instead of reading past the end of K/V."""
+        B, H, D = 1, 2, 16
+        ks = jax.random.split(KEY, 3)
+        q = jax.random.normal(ks[0], (B, H, 128, D))
+        k = jax.random.normal(ks[1], (B, H, 64, D))
+        v = jax.random.normal(ks[2], (B, H, 64, D))
+        out = flash_attention(q, k, v, None, True, 64, 64)
+        rows = jnp.arange(128)[:, None]
+        cols = jnp.arange(64)[None, :]
+        mask = (rows >= cols)[None, None]
+        tr = lambda x: x.transpose(0, 2, 1, 3)
+        ref = tr(dot_product_attention(tr(q), tr(k), tr(v), mask,
+                                       precision="float32"))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+        def loss_flash(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, None, True, 64, 64) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(tr(dot_product_attention(
+                tr(q), tr(k), tr(v), mask, precision="float32")) ** 2)
+
+        gf = jax.grad(loss_flash, (0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, (0, 1, 2))(q, k, v)
+        for a, b, name in zip(gf, gr, "qkv"):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-4, rtol=5e-3, err_msg=name)
+
     def test_rejects_indivisible_lengths(self):
         q, k, v = _qkv(T=100)
         with pytest.raises(ValueError):

@@ -1,0 +1,91 @@
+"""Partition-rule sharding + fully-partitioned train step (dp x tp x sp).
+
+The CI analog of the reference's multi-node-on-one-host pattern
+(``python/ray/cluster_utils.py:10``): 8 virtual CPU devices stand in for a
+TPU slice so the tensor/sequence/data-parallel code paths execute for real.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tosem_tpu.models.bert import Bert, BertConfig
+from tosem_tpu.parallel.sharding import (bert_rules, seq_batch_rules,
+                                         spec_for_path, tree_specs)
+from tosem_tpu.train.trainer import (create_train_state,
+                                     make_partitioned_train_step, mlm_loss,
+                                     shard_batch_by_rules, shard_train_state)
+
+
+def test_spec_for_path_rules():
+    rules = bert_rules()
+    assert spec_for_path("params/layer0/attn/q/w", rules) == P(None, "tp")
+    assert spec_for_path("params/layer0/attn/o/w", rules) == P("tp", None)
+    assert spec_for_path("params/layer1/fc2/w", rules) == P("tp", None)
+    assert spec_for_path("params/ln_out/scale", rules) == P()
+    # optimizer moments pick up the same layout through their path suffix
+    assert spec_for_path("opt_state/0/mu/layer0/fc1/w", rules) == P(None, "tp")
+
+
+def test_tree_specs_clips_scalars():
+    tree = {"w": jnp.zeros((4, 4)), "count": jnp.zeros(())}
+    specs = tree_specs(tree, [(r"", P("dp", None))])
+    assert specs["w"] == P("dp", None)
+    assert specs["count"] == P()  # rank-0 leaf can't take a 2-axis spec
+
+
+@pytest.fixture
+def mesh_dp_tp_sp(devices8):
+    return Mesh(np.array(devices8).reshape(2, 2, 2), ("dp", "tp", "sp"))
+
+
+def test_partitioned_bert_step(mesh_dp_tp_sp):
+    mesh = mesh_dp_tp_sp
+    cfg = BertConfig(vocab_size=64, max_len=32, dim=16, heads=2, layers=2,
+                     mlp_dim=32, dropout=0.0, dtype="float32")
+    model = Bert(cfg)
+    opt = optax.adamw(1e-2)
+    ts = create_train_state(model, jax.random.PRNGKey(0), opt)
+    ts = shard_train_state(ts, mesh, bert_rules())
+
+    # params landed with the rule-derived layout
+    fc1_w = ts["params"]["layer0"]["fc1"]["w"]
+    assert fc1_w.sharding.spec == P(None, "tp")
+    mu = ts["opt_state"][0].mu["layer0"]["fc1"]["w"]
+    assert mu.sharding.spec == P(None, "tp")
+
+    B, T = 4, 16
+    ids = jax.random.randint(jax.random.PRNGKey(1), (B, T), 0, 64, jnp.int32)
+    batch = {"ids": ids, "labels": ids,
+             "masked": jnp.ones((B, T), bool)}
+    batch = shard_batch_by_rules(batch, mesh, seq_batch_rules())
+    assert batch["ids"].sharding.spec == P("dp", "sp")
+
+    step = make_partitioned_train_step(model, opt, mlm_loss, mesh=mesh,
+                                       rules=bert_rules(),
+                                       batch_rules=seq_batch_rules())
+    losses = []
+    rngs = jax.random.split(jax.random.PRNGKey(2), 5)
+    for i in range(5):
+        ts, metrics = step(ts, batch, rngs[i])
+        losses.append(float(metrics["loss"]))
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0]  # it actually learns on the fixed batch
+    # output layout matches input layout (donation-safe)
+    assert ts["params"]["layer0"]["fc1"]["w"].sharding.spec == P(None, "tp")
+
+
+def test_graft_dryrun_multichip():
+    import __graft_entry__ as ge
+    ge.dryrun_multichip(8)
+
+
+def test_graft_factor3():
+    import __graft_entry__ as ge
+    assert ge._factor3(8) == (2, 2, 2)
+    assert ge._factor3(4) == (2, 2, 1)
+    assert ge._factor3(1) == (1, 1, 1)
+    dp, tp, sp = ge._factor3(12)
+    assert dp * tp * sp == 12

@@ -73,8 +73,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bk, sm_scale, causal):
     a0 = jnp.zeros((bq, d), jnp.float32)
     n_k = Tk // bk
     if causal:
-        # only blocks with kj <= qi+bq-1 contribute
-        n_k_eff = lax.div(qi + bq - 1, bk) + 1
+        # only blocks with kj <= qi+bq-1 contribute; clamp to the buffer so
+        # Tq > Tk never reads K/V blocks past the end
+        n_k_eff = jnp.minimum(lax.div(qi + bq - 1, bk) + 1, n_k)
         m, l, acc = lax.fori_loop(0, n_k_eff, body, (m0, l0, a0))
     else:
         m, l, acc = lax.fori_loop(0, n_k, body, (m0, l0, a0))
@@ -189,7 +190,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     dq0 = jnp.zeros((bq, d), jnp.float32)
     if causal:
-        n_k_eff = lax.div(qi + bq - 1, bk) + 1
+        n_k_eff = jnp.minimum(lax.div(qi + bq - 1, bk) + 1, Tk // bk)
         dq = lax.fori_loop(0, n_k_eff, body, dq0)
     else:
         dq = lax.fori_loop(0, Tk // bk, body, dq0)

@@ -53,11 +53,18 @@ def bert_kernel_suite(*, batch: int = 8, seq: int = 512, heads: int = 12,
                      {"flop_model": "4BHT^2D", "time_us": sec * 1e6,
                       "shape": [B, H, T, D], "dtype": dtype}))
 
-    # attention forward+backward
+    # attention forward+backward. The op must consume dq AND dk/dv — the
+    # dKV pallas_call is independent of dq, so returning grads[0] alone
+    # would let XLA dead-code-eliminate it and inflate the GFLOPS ~40%.
     grad_fn = jax.jit(jax.grad(
         lambda a, b, c: jnp.sum(flash_attention(a, b, c)
                                 .astype(jnp.float32) ** 2), (0, 1, 2)))
-    sec = DeviceLoopBench(op=lambda a, b, c: grad_fn(a, b, c)[0],
+
+    def _all_grads(fn):
+        return lambda *xs: jnp.stack(
+            [jnp.mean(g.astype(jnp.float32)) for g in fn(*xs)])
+
+    sec = DeviceLoopBench(op=_all_grads(grad_fn),
                           args=(q, k, v), perturb=0).time(reps=reps)
     fl = attention_flops(B, H, T, D, bwd=True)
     rows.append(_row(f"attention_fwdbwd_b{B}_t{T}_{dtype}", "gflops",
@@ -79,7 +86,7 @@ def bert_kernel_suite(*, batch: int = 8, seq: int = 512, heads: int = 12,
     ln_grad = jax.jit(jax.grad(
         lambda x, g, b: jnp.sum(fused_layernorm(x, g, b)
                                 .astype(jnp.float32) ** 2), (0, 1, 2)))
-    sec = DeviceLoopBench(op=lambda x, g, b: ln_grad(x, g, b)[0],
+    sec = DeviceLoopBench(op=_all_grads(ln_grad),
                           args=(x, g, bt), perturb=0).time(reps=reps)
     rows.append(_row(f"layernorm_fwdbwd_{B * T}x{hidden}_{dtype}", "gbps",
                      4 * x.nbytes / sec / 1e9, "GB/s",

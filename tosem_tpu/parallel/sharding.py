@@ -1,0 +1,113 @@
+"""Rule-based partition specs: the framework's sharding vocabulary.
+
+The reference distributes training by constructing process groups and
+wiring gradient allreduce by hand (RaySGD ``distributed_torch_runner.py:32-61``,
+DeepSpeech ``train.py:342-352`` ``average_gradients``). TPU-first, the whole
+strategy is *data layout*: every leaf of the train state gets a
+:class:`~jax.sharding.PartitionSpec` over the named mesh axes (dp/tp/sp/...),
+``jax.jit`` consumes those shardings, and XLA inserts the collectives
+(AllReduce over dp, AllGather/ReduceScatter around tp contractions) on ICI.
+
+The mechanism here is Megatron/t5x-style *path rules*: a list of
+``(regex, PartitionSpec)`` pairs matched against the "/"-joined pytree path
+of each leaf. Because optimizer moments mirror the param tree, the same
+rules shard Adam's mu/nu without any optimizer-specific code — the regexes
+simply match inside ``opt_state/0/mu/...`` paths too.
+"""
+from __future__ import annotations
+
+import re
+from typing import Any, Iterable, List, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# A rule is (pattern, spec); first match (re.search) wins.
+Rules = Sequence[Tuple[str, P]]
+
+
+def path_str(path: Tuple[Any, ...]) -> str:
+    """Join a jax key path into "a/b/0/mu/w" form."""
+    parts = []
+    for k in path:
+        if hasattr(k, "key"):          # DictKey
+            parts.append(str(k.key))
+        elif hasattr(k, "name"):       # GetAttrKey (namedtuple field)
+            parts.append(str(k.name))
+        elif hasattr(k, "idx"):        # SequenceKey / FlattenedIndexKey
+            parts.append(str(k.idx))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def spec_for_path(p: str, rules: Rules, default: P = P()) -> P:
+    for pat, spec in rules:
+        if re.search(pat, p):
+            return spec
+    return default
+
+
+def _clip_spec(spec: P, ndim: int) -> P:
+    """Drop trailing axes of a spec that exceed the leaf's rank (scalars in
+    a tree matched by a 2D rule should just replicate)."""
+    if len(spec) <= ndim:
+        return spec
+    return P(*spec[:ndim])
+
+
+def tree_specs(tree: Any, rules: Rules, default: P = P()) -> Any:
+    """PartitionSpec pytree for ``tree``, matched leaf-by-leaf via rules."""
+    def one(path, leaf):
+        ndim = getattr(leaf, "ndim", 0)
+        return _clip_spec(spec_for_path(path_str(path), rules, default), ndim)
+    return jax.tree_util.tree_map_with_path(one, tree)
+
+
+def tree_shardings(tree: Any, mesh: Mesh, rules: Rules,
+                   default: P = P()) -> Any:
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), tree_specs(tree, rules, default))
+
+
+def shard_tree(tree: Any, mesh: Mesh, rules: Rules, default: P = P()) -> Any:
+    """device_put every leaf with its rule-derived sharding (committed)."""
+    return jax.tree_util.tree_map(
+        jax.device_put, tree, tree_shardings(tree, mesh, rules, default))
+
+
+# ---------------------------------------------------------------------------
+# Canonical rule sets
+
+
+def bert_rules(tp: str = "tp") -> List[Tuple[str, P]]:
+    """Megatron-style tensor parallelism for the BERT encoder
+    (``tosem_tpu.models.bert``): QKV and the MLP up-projection are
+    column-parallel (output features sharded), the attention output and MLP
+    down-projection are row-parallel (contraction dim sharded, XLA emits the
+    AllReduce), embeddings shard the feature dim. Everything else
+    (layernorms, biases of row-parallel layers) replicates.
+    """
+    return [
+        (r"attn/(q|k|v)/w$", P(None, tp)),
+        (r"attn/(q|k|v)/b$", P(tp)),
+        (r"attn/o/w$", P(tp, None)),
+        (r"fc1/w$", P(None, tp)),
+        (r"fc1/b$", P(tp)),
+        (r"fc2/w$", P(tp, None)),
+        (r"(tok|pos|seg)/table$", P(None, tp)),
+    ]
+
+
+def seq_batch_rules(dp: str = "dp", sp: Optional[str] = "sp"
+                    ) -> List[Tuple[str, P]]:
+    """Token batches ([B, T] int arrays): batch dim over dp, sequence dim
+    over sp (context parallelism — each shard holds a slice of the
+    sequence; attention over sp is handled by GSPMD gather or by the ring
+    attention kernel in ``tosem_tpu.parallel.ring``)."""
+    return [(r"", P(dp, sp) if sp else P(dp))]
+
+
+def image_batch_rules(dp: str = "dp") -> List[Tuple[str, P]]:
+    """Image batches ([B, H, W, C] + [B] labels): batch dim over dp."""
+    return [(r"", P(dp))]
