@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# Experiment entry point — the reference's run-everything contract
+# (SURVEY §5.6: "keep the run_experiments.sh --device={gpu,tpu,cpu}
+# contract"). All arguments pass through to the CLI:
+#
+#   ./run_experiments.sh --device=tpu --config=gemm,conv_sweep
+#   ./run_experiments.sh --device=cpu                      # full CI sweep
+#   ./run_experiments.sh --manifest=manifests/smoke.yaml
+set -euo pipefail
+cd "$(dirname "$0")"
+exec python -m tosem_tpu.cli "$@"

@@ -1,0 +1,48 @@
+"""Experiment-runner CLI: flags, manifest merge, CSV emission."""
+import json
+import os
+
+import pytest
+
+from tosem_tpu.cli import CONFIGS, RUNNERS, main, make_flags
+from tosem_tpu.utils.results import read_results
+
+
+def test_configs_all_have_runners():
+    assert set(CONFIGS) == set(RUNNERS)
+
+
+def test_flag_parsing():
+    fs = make_flags()
+    left = fs.parse_args(["--device=cpu", "--config=gemm,allreduce",
+                          "--steps", "3"])
+    assert left == []
+    assert fs.device == "cpu"
+    assert fs.config == ["gemm", "allreduce"]
+    assert fs.steps == 3
+
+
+def test_unknown_config_rejected(capsys):
+    assert main(["--device=cpu", "--config=bogus"]) == 2
+
+
+def test_gemm_end_to_end_csv(tmp_path):
+    out = tmp_path / "r.csv"
+    rc = main(["--device=cpu", "--config=gemm", f"--results_csv={out}"])
+    assert rc == 0
+    rows = read_results(str(out))
+    assert len(rows) == 1
+    r = rows[0]
+    assert r["project"] == "ops" and r["metric"] == "gflops"
+    assert r["value"] > 0
+    assert json.loads(json.dumps(r["extra"]))["m"] == 256
+
+
+def test_manifest_drives_run(tmp_path):
+    out = tmp_path / "m.csv"
+    mpath = tmp_path / "exp.yaml"
+    mpath.write_text(
+        f"name: t\ndevice: cpu\nconfigs: [gemm]\n"
+        f"results_csv: {out}\nsteps: 2\n")
+    assert main([f"--manifest={mpath}"]) == 0
+    assert read_results(str(out))[0]["bench_id"].startswith("gemm_")

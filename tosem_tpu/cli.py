@@ -1,0 +1,243 @@
+"""Experiment runner CLI — the ``run_experiments.sh --device={tpu,cpu}``
+contract (SURVEY §5.6, §7 step 1).
+
+Plays the role of the reference's experiment entry points: DeepSpeech's flag
+-driven ``train.run_script`` (``DeepSpeech.py:5-12``), EfficientDet's
+``main.py --strategy={tpu,gpus,''}`` (``main.py:83``), and
+``ray microbenchmark`` (``python/ray/scripts/scripts.py``). Each config
+funnels its measurements through the RQ-compatible CSV schema
+(:mod:`tosem_tpu.utils.results`).
+
+Usage::
+
+    python -m tosem_tpu.cli --device=tpu --config=gemm
+    python -m tosem_tpu.cli --device=cpu --config=gemm,allreduce \
+        --results_csv=results/ci.csv
+    python -m tosem_tpu.cli --manifest=manifests/smoke.yaml
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+from typing import Any, Dict, List
+
+from tosem_tpu.utils.flags import FlagSet
+
+CONFIGS = ("gemm", "conv_sweep", "allreduce", "resnet_train", "bert_kernels")
+
+
+def make_flags() -> FlagSet:
+    fs = FlagSet()
+    fs.define_enum("device", "tpu", ["tpu", "cpu"],
+                   "target platform (cpu = virtual multi-device host)")
+    fs.define_list("config", [], f"configs to run, subset of {CONFIGS}")
+    fs.define_string("manifest", None, "yaml manifest (overrides other flags)")
+    fs.define_string("results_csv", "results/results.csv", "output CSV path")
+    fs.define_integer("n_virtual_devices", 8,
+                      "virtual device count for --device=cpu")
+    fs.define_integer("steps", 20, "training steps for resnet_train")
+    fs.define_integer("batch", 0, "global batch (0 = per-config default)")
+    fs.define_integer("seq", 0, "sequence length for bert_kernels (0 = auto)")
+    fs.define_integer("max_bytes", 0,
+                      "cap collective sweep size in bytes (0 = full sweep)")
+    fs.define_string("dtype", "", "dtype override for sweeps")
+    fs.define_bool("fake_data", True,
+                   "use synthetic data (the --use_fake_data pattern)")
+    return fs
+
+
+def _setup_device(device: str, n_virtual: int) -> None:
+    """Must run before anything imports jax (SURVEY §7: CPU via
+    xla_force_host_platform_device_count so everything runs in CI)."""
+    if device == "cpu":
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count="
+                f"{n_virtual}").strip()
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+
+# ---------------------------------------------------------------------------
+# config runners — each returns a list of ResultRow
+
+
+def run_gemm(fs: FlagSet) -> List[Any]:
+    from tosem_tpu.ops.gemm import DEFAULT_GEMM_SWEEP, GemmSpec, gemm_bench
+    sweep = DEFAULT_GEMM_SWEEP
+    if fs.device == "cpu":  # keep CI fast: the north-star shape only
+        sweep = [GemmSpec(256, 256, 256, "float32", "float32")]
+    rows = []
+    for spec in sweep:
+        _, row = gemm_bench(spec)
+        rows.append(row)
+        print(f"  {row.bench_id}: {row.value:.1f} {row.unit}")
+    return rows
+
+
+def run_conv_sweep(fs: FlagSet) -> List[Any]:
+    from tosem_tpu.ops.conv import (RESNET50_CONV_SWEEP,
+                                    RESNET50_CONV_SWEEP_BF16, ConvSpec,
+                                    conv_bench)
+    if fs.device == "cpu":
+        sweep = [ConvSpec(batch=2, h=28, w=28, c_in=32, c_out=32,
+                          kh=3, kw=3, stride=1, dtype="float32",
+                          precision="float32")]
+    else:
+        sweep = list(RESNET50_CONV_SWEEP) + list(RESNET50_CONV_SWEEP_BF16)
+        if fs.dtype == "float32":
+            sweep = list(RESNET50_CONV_SWEEP)
+        elif fs.dtype == "bfloat16":
+            sweep = list(RESNET50_CONV_SWEEP_BF16)
+    rows = []
+    for spec in sweep:
+        _, row = conv_bench(spec)
+        rows.append(row)
+        print(f"  {row.bench_id}: {row.value:.1f} {row.unit}")
+    return rows
+
+
+def run_allreduce(fs: FlagSet) -> List[Any]:
+    from tosem_tpu.parallel.collectives import (DEFAULT_COLLECTIVE_SWEEP,
+                                                collective_bench)
+    from tosem_tpu.parallel.mesh import default_mesh
+    import jax
+    mesh = default_mesh("x")
+    cap = fs.max_bytes or (1 << 22 if fs.device == "cpu" else 0)
+    rows = []
+    for spec in DEFAULT_COLLECTIVE_SWEEP:
+        if cap and spec.bytes_per_device > cap:
+            continue
+        row = collective_bench(spec, mesh)
+        rows.append(row)
+        print(f"  {row.bench_id} x{row.n_devices}: "
+              f"{row.value:.2f} {row.unit}")
+    return rows
+
+
+def run_resnet_train(fs: FlagSet) -> List[Any]:
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from tosem_tpu.data.synthetic import cifar_like_batches
+    from tosem_tpu.models.resnet import resnet50
+    from tosem_tpu.parallel.mesh import default_mesh
+    from tosem_tpu.train.trainer import (classification_loss,
+                                         create_train_state, make_train_step,
+                                         shard_batch)
+    from tosem_tpu.utils.results import ResultRow
+
+    n_dev = len(jax.devices())
+    batch = fs.batch or (256 if fs.device == "tpu" else 16)
+    batch = max(batch // n_dev * n_dev, n_dev)
+    steps = fs.steps
+    model = resnet50(num_classes=10, small_inputs=True)
+    opt = optax.sgd(0.1, momentum=0.9)
+    ts = create_train_state(model, jax.random.PRNGKey(0), opt)
+    mesh = default_mesh("dp") if n_dev > 1 else None
+    step = make_train_step(model, opt, classification_loss, mesh=mesh)
+    batches = cifar_like_batches(batch, steps=steps + 6)
+    rng = jax.random.PRNGKey(1)
+
+    times = []
+    t_prev = None
+    for i, b in enumerate(batches):
+        if mesh is not None:
+            b = shard_batch(b, mesh)
+        rng, sub = jax.random.split(rng)
+        ts, metrics = step(ts, b, sub)
+        loss = float(jax.device_get(metrics["loss"]))  # sync point
+        now = time.perf_counter()
+        if t_prev is not None and i > 5:  # skip compile + warmup steps
+            times.append(now - t_prev)
+        t_prev = now
+    step_s = sorted(times)[len(times) // 2] if times else float("nan")
+    rows = [
+        ResultRow(project="train", config="resnet_train",
+                  bench_id=f"resnet50_cifar_b{batch}", metric="step_time_ms",
+                  value=step_s * 1e3, unit="ms",
+                  device=jax.devices()[0].platform, n_devices=n_dev,
+                  extra={"batch": batch, "steps": steps,
+                         "final_loss": loss}),
+        ResultRow(project="train", config="resnet_train",
+                  bench_id=f"resnet50_cifar_b{batch}", metric="images_per_sec",
+                  value=batch / step_s, unit="img/s",
+                  device=jax.devices()[0].platform, n_devices=n_dev,
+                  extra={"batch": batch}),
+    ]
+    for r in rows:
+        print(f"  {r.bench_id}: {r.value:.2f} {r.unit}")
+    return rows
+
+
+def run_bert_kernels(fs: FlagSet) -> List[Any]:
+    from tosem_tpu.ops.kernel_suite import bert_kernel_suite
+    if fs.device == "cpu":  # interpret-mode Pallas: keep it tiny
+        rows = bert_kernel_suite(batch=1, seq=fs.seq or 128, heads=2,
+                                 head_dim=32, hidden=64)
+    else:
+        rows = bert_kernel_suite(batch=8, seq=fs.seq or 512)
+    for r in rows:
+        print(f"  {r.bench_id}: {r.value:.1f} {r.unit}")
+    return rows
+
+
+RUNNERS = {
+    "gemm": run_gemm,
+    "conv_sweep": run_conv_sweep,
+    "allreduce": run_allreduce,
+    "resnet_train": run_resnet_train,
+    "bert_kernels": run_bert_kernels,
+}
+
+
+def main(argv: List[str] = None) -> int:
+    fs = make_flags()
+    fs.apply_env()
+    leftover = fs.parse_args(sys.argv[1:] if argv is None else list(argv))
+    if leftover:
+        print(f"unexpected positional args: {leftover}", file=sys.stderr)
+        print(fs.usage(), file=sys.stderr)
+        return 2
+
+    if fs.manifest:
+        from tosem_tpu.utils.manifest import load_manifest
+        m = load_manifest(fs.manifest)
+        fs.set("device", m.device)
+        if m.configs:
+            fs.set("config", ",".join(m.configs))
+        fs.set("results_csv", m.results_csv)
+        for k, v in m.params.items():
+            if k in fs:
+                fs.set(k, v)
+
+    configs = fs.config or list(CONFIGS)
+    unknown = [c for c in configs if c not in RUNNERS]
+    if unknown:
+        print(f"unknown configs {unknown}; choose from {CONFIGS}",
+              file=sys.stderr)
+        return 2
+
+    _setup_device(fs.device, fs.n_virtual_devices)
+    import jax
+    from tosem_tpu.utils.results import ResultWriter
+    print(f"device={fs.device} jax_devices={len(jax.devices())} "
+          f"platform={jax.devices()[0].platform}")
+
+    with ResultWriter(fs.results_csv) as w:
+        for c in configs:
+            print(f"[{c}]")
+            t0 = time.perf_counter()
+            rows = RUNNERS[c](fs)
+            w.add_many(rows)
+            print(f"[{c}] {len(rows)} rows in "
+                  f"{time.perf_counter() - t0:.1f}s")
+    print(f"results -> {fs.results_csv}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -83,6 +83,7 @@ class ResNet(Module):
                  small_inputs: bool = False, dtype=jnp.float32,
                  precision: str = "default"):
         kw = dict(dtype=dtype, precision=precision)
+        self.dtype = dtype
         self.small_inputs = small_inputs
         if small_inputs:
             self.stem = Conv2D(3, 64, (3, 3), 1, **kw)
@@ -116,7 +117,9 @@ class ResNet(Module):
     def apply(self, vs, x, *, train=False, rng=None):
         p, s = vs["params"], vs["state"]
         ns = {}
-        h, st = self.stem.apply(variables(p["stem"]), x)
+        # host pipelines feed fp32; compute in the model's dtype (bf16 on
+        # TPU — the MXU path), fp32 restored at the head
+        h, st = self.stem.apply(variables(p["stem"]), x.astype(self.dtype))
         ns["stem"] = st
         h, st = self.stem_bn.apply(variables(p["stem_bn"],
                                              s.get("stem_bn", {})), h,
