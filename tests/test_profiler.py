@@ -1,0 +1,80 @@
+"""Profiler subsystem: span API, Chrome dump, xplane parse + kernel CSV."""
+import csv
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tosem_tpu.profiler import (SpanRecorder, capture_trace, kernel_summary,
+                                kernel_summary_csv, span, chrome_trace_dump,
+                                get_recorder)
+
+
+class TestSpans:
+    def test_span_records_duration(self):
+        rec = SpanRecorder()
+        with rec.span("work", cat="test", k=1):
+            time.sleep(0.01)
+        spans = rec.spans()
+        assert len(spans) == 1
+        assert spans[0].name == "work"
+        assert spans[0].dur_us >= 10_000 * 0.5
+        assert spans[0].args == {"k": 1}
+
+    def test_chrome_trace_format(self, tmp_path):
+        rec = SpanRecorder()
+        with rec.span("a"):
+            pass
+        with rec.span("b"):
+            pass
+        path = rec.dump(str(tmp_path / "t.json"))
+        data = json.load(open(path))
+        evs = data["traceEvents"]
+        assert len(evs) == 2
+        assert all(e["ph"] == "X" for e in evs)
+        assert {e["name"] for e in evs} == {"a", "b"}
+        assert all("ts" in e and "dur" in e for e in evs)
+
+    def test_global_recorder(self, tmp_path):
+        get_recorder().clear()
+        with span("global_work"):
+            pass
+        path = chrome_trace_dump(str(tmp_path / "g.json"))
+        names = [e["name"] for e in json.load(open(path))["traceEvents"]]
+        assert "global_work" in names
+        get_recorder().clear()
+
+
+class TestXplanePipeline:
+    @pytest.fixture(scope="class")
+    def capture_dir(self, tmp_path_factory):
+        d = str(tmp_path_factory.mktemp("trace"))
+        with capture_trace(d):
+            x = jnp.ones((256, 256))
+            y = jnp.dot(x, x)
+            jax.block_until_ready(y)
+        return d
+
+    def test_parse_and_summarize(self, capture_dir):
+        stats = kernel_summary(capture_dir)
+        assert stats, "expected events in the capture"
+        total = sum(s.total_us for s in stats)
+        assert total > 0
+        # sorted by descending total time
+        assert all(stats[i].total_us >= stats[i + 1].total_us
+                   for i in range(len(stats) - 1))
+
+    def test_csv_schema(self, capture_dir, tmp_path):
+        out = str(tmp_path / "kernels.csv")
+        stats = kernel_summary_csv(capture_dir, out)
+        rows = list(csv.DictReader(open(out)))
+        assert len(rows) == len(stats)
+        r = rows[0]
+        for col in ("name", "plane", "calls", "total_us", "mean_us",
+                    "min_us", "max_us", "pct"):
+            assert col in r
+        assert float(r["total_us"]) >= float(r["min_us"])
+        pct = sum(float(x["pct"]) for x in rows)
+        assert 99.0 < pct < 101.0

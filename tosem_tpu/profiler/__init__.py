@@ -1,0 +1,23 @@
+"""Tracing / profiling subsystem (SURVEY §5.1).
+
+Three capabilities, mirroring the reference's observability stack:
+
+- :mod:`tosem_tpu.profiler.spans` — host-side span API + Chrome-tracing JSON
+  dump (the ``ray.profile`` / ``ray timeline`` pair,
+  ``python/ray/profiling.py:17`` and ``python/ray/state.py:521``).
+- :mod:`tosem_tpu.profiler.trace` — on-device capture via ``jax.profiler``
+  and an xplane parser that aggregates XLA op events into the nvprof-style
+  kernel-summary CSV the study's analysis layer consumes (the nvprof/nsys
+  analog; north-star trace-parser requirement).
+"""
+from tosem_tpu.profiler.spans import (SpanRecorder, chrome_trace_dump,
+                                      get_recorder, span)
+from tosem_tpu.profiler.trace import (KernelStat, capture_trace,
+                                      kernel_summary, kernel_summary_csv,
+                                      parse_xplane)
+
+__all__ = [
+    "SpanRecorder", "chrome_trace_dump", "get_recorder", "span",
+    "KernelStat", "capture_trace", "kernel_summary", "kernel_summary_csv",
+    "parse_xplane",
+]

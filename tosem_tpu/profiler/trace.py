@@ -1,0 +1,130 @@
+"""On-device trace capture + nvprof-style kernel summary.
+
+The study's GPU experiments assume nvprof/nsys traces parsed into CSVs
+(SURVEY §5.1: "the rebuilt trace parser must emit the same CSV schema the RQ
+notebooks consume"). The TPU pipeline is: ``jax.profiler`` capture →
+``.xplane.pb`` → :func:`parse_xplane` (via ``jax.profiler.ProfileData``, no
+TensorBoard needed) → :func:`kernel_summary` aggregation with nvprof
+``--print-gpu-summary`` semantics (per-op calls/total/mean/min/max/pct) →
+stable CSV columns.
+
+Kernel occupancy has no TPU analog (SURVEY §7 hard parts); the stable
+columns are the time statistics, which exist on both platforms.
+"""
+from __future__ import annotations
+
+import csv
+import glob
+import os
+import re
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Tuple
+
+KERNEL_CSV_COLUMNS = [
+    "name", "plane", "calls", "total_us", "mean_us", "min_us", "max_us",
+    "pct",
+]
+
+# device planes: TPU "/device:TPU:0", GPU "/device:GPU:0"; the XLA-op lines
+# on CPU live under the host plane's per-thread lines
+_DEVICE_PLANE = re.compile(r"/device:(TPU|GPU)", re.I)
+
+
+@dataclass
+class KernelStat:
+    name: str
+    plane: str
+    calls: int = 0
+    total_us: float = 0.0
+    min_us: float = float("inf")
+    max_us: float = 0.0
+
+    @property
+    def mean_us(self) -> float:
+        return self.total_us / self.calls if self.calls else 0.0
+
+    def add(self, dur_us: float) -> None:
+        self.calls += 1
+        self.total_us += dur_us
+        self.min_us = min(self.min_us, dur_us)
+        self.max_us = max(self.max_us, dur_us)
+
+
+@contextmanager
+def capture_trace(log_dir: str, *, perfetto: bool = False):
+    """Capture a ``jax.profiler`` trace into ``log_dir``; yields the dir.
+
+    On exit the newest ``*.xplane.pb`` under ``log_dir`` is ready for
+    :func:`parse_xplane`.
+    """
+    import jax
+    os.makedirs(log_dir, exist_ok=True)
+    with jax.profiler.trace(log_dir, create_perfetto_trace=perfetto):
+        yield log_dir
+
+
+def latest_xplane(log_dir: str) -> str:
+    pbs = glob.glob(os.path.join(log_dir, "**", "*.xplane.pb"),
+                    recursive=True)
+    if not pbs:
+        raise FileNotFoundError(f"no .xplane.pb under {log_dir}")
+    return max(pbs, key=os.path.getmtime)
+
+
+def parse_xplane(path_or_dir: str) -> Iterator[Tuple[str, str, str, float]]:
+    """Yield (plane, line, event_name, duration_us) for every trace event."""
+    from jax.profiler import ProfileData
+    path = (latest_xplane(path_or_dir) if os.path.isdir(path_or_dir)
+            else path_or_dir)
+    pd = ProfileData.from_file(path)
+    for plane in pd.planes:
+        for line in plane.lines:
+            for ev in line.events:
+                dur_ns = ev.duration_ns or 0.0
+                yield plane.name, line.name, ev.name, dur_ns / 1e3
+
+
+def kernel_summary(path_or_dir: str, *, device_only: bool = True,
+                   name_filter: Optional[str] = None) -> List[KernelStat]:
+    """nvprof ``--print-gpu-summary`` analog over an xplane capture.
+
+    ``device_only`` keeps events from device planes (XLA ops that actually
+    ran on TPU/GPU); with no device plane present (pure-CPU runs, as in CI)
+    it falls back to XLA-op host lines so the pipeline stays testable.
+    """
+    pat = re.compile(name_filter) if name_filter else None
+    stats: Dict[Tuple[str, str], KernelStat] = {}
+    rows = list(parse_xplane(path_or_dir))
+    planes = {p for p, _, _, _ in rows}
+    device_planes = {p for p in planes if _DEVICE_PLANE.search(p)}
+    use_planes = device_planes if (device_only and device_planes) else planes
+    for plane, line, name, dur_us in rows:
+        if plane not in use_planes:
+            continue
+        if pat and not pat.search(name):
+            continue
+        key = (plane, name)
+        if key not in stats:
+            stats[key] = KernelStat(name=name, plane=plane)
+        stats[key].add(dur_us)
+    out = sorted(stats.values(), key=lambda s: -s.total_us)
+    return out
+
+
+def kernel_summary_csv(path_or_dir: str, csv_path: str,
+                       **kw) -> List[KernelStat]:
+    """Write the kernel summary with the stable column schema; returns it."""
+    stats = kernel_summary(path_or_dir, **kw)
+    grand = sum(s.total_us for s in stats) or 1.0
+    parent = os.path.dirname(os.path.abspath(csv_path))
+    os.makedirs(parent, exist_ok=True)
+    with open(csv_path, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(KERNEL_CSV_COLUMNS)
+        for s in stats:
+            w.writerow([s.name, s.plane, s.calls,
+                        f"{s.total_us:.3f}", f"{s.mean_us:.3f}",
+                        f"{s.min_us:.3f}", f"{s.max_us:.3f}",
+                        f"{100.0 * s.total_us / grand:.2f}"])
+    return stats
