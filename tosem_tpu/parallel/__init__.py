@@ -6,3 +6,9 @@ from tosem_tpu.parallel.collectives import (CollectiveSpec, collective_bench,
                                             all_reduce, all_gather_op,
                                             reduce_scatter_op, ring_permute,
                                             all_to_all_op, broadcast)
+from tosem_tpu.parallel.sharding import (bert_rules, image_batch_rules,
+                                         seq_batch_rules, shard_tree,
+                                         spec_for_path, tree_shardings,
+                                         tree_specs)
+from tosem_tpu.parallel.ring import (make_ring_attn_fn, make_ulysses_attn_fn,
+                                     ring_attention, ulysses_attention)

@@ -1,0 +1,156 @@
+"""Sequence/context parallelism: ring attention + Ulysses all-to-all.
+
+The reference has no sequence parallelism (SURVEY §5.7 — its long-input
+story is DeepSpeech's host-side streaming); this framework makes long
+context first-class. Two TPU-native mechanisms over the ``sp`` mesh axis:
+
+- **Ring attention** (:func:`ring_attention`): K/V shards rotate around the
+  ICI ring via ``lax.ppermute`` while each device accumulates blockwise
+  online-softmax attention for its local Q shard — attention over sequences
+  ``sp``× longer than one chip's HBM could hold, with compute/communication
+  overlap left to XLA. The online-softmax math matches the Pallas flash
+  kernel (``tosem_tpu.ops.flash_attention``).
+- **Ulysses-style all-to-all** (:func:`ulysses_attention`): ``all_to_all``
+  re-shards [T/sp, H] → [T, H/sp], runs *full* local attention per head
+  group, and converts back. Cheaper for moderate T when heads ≥ sp.
+
+Both expose ``make_*_attn_fn`` adapters matching the ``attn_fn`` hook of
+:class:`tosem_tpu.nn.attention.MultiHeadAttention` ([B, T, H, D] layout),
+usable inside a jitted, GSPMD-partitioned train step (shard_map composes
+under jit).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+_NEG_INF = -1e30
+
+
+def _block_update(q, k, v, m, l, acc, mask_block, scale):
+    """One online-softmax accumulation step. q:[B,Tq,H,D] k,v:[B,Tk,H,D];
+    m,l:[B,H,Tq] fp32; acc:[B,Tq,H,D] fp32."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if mask_block is not None:
+        s = jnp.where(mask_block, s, _NEG_INF)
+    m_new = jnp.maximum(m, jnp.max(s, -1))                    # [B,H,Tq]
+    p = jnp.exp(s - m_new[..., None])                         # [B,H,Tq,Tk]
+    alpha = jnp.exp(m - m_new)                                # [B,H,Tq]
+    l = l * alpha + jnp.sum(p, -1)
+    acc = acc * alpha.transpose(0, 2, 1)[..., None] + jnp.einsum(
+        "bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return m_new, l, acc
+
+
+def ring_attention(q, k, v, *, axis: str, causal: bool = False,
+                   sm_scale: Optional[float] = None):
+    """Core ring attention over an already-mapped axis.
+
+    Call inside ``shard_map``/``pjit`` context where ``axis`` is a mesh
+    axis and q/k/v are the *local* sequence shards [B, Tl, H, D].
+    """
+    n = lax.axis_size(axis)
+    my = lax.axis_index(axis)
+    B, Tl, H, D = q.shape
+    scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(D)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    qpos = my * Tl + jnp.arange(Tl)                           # [Tl]
+
+    def body(j, carry):
+        k_cur, v_cur, m, l, acc = carry
+        src = (my - j) % n                                    # owner of k_cur
+        mask = None
+        if causal:
+            kpos = src * Tl + jnp.arange(Tl)
+            mask = (qpos[:, None] >= kpos[None, :])[None, None]  # [1,1,Tq,Tk]
+        m, l, acc = _block_update(q, k_cur, v_cur, m, l, acc, mask, scale)
+        k_nxt = lax.ppermute(k_cur, axis, perm)
+        v_nxt = lax.ppermute(v_cur, axis, perm)
+        return k_nxt, v_nxt, m, l, acc
+
+    m0 = jnp.full((B, H, Tl), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, Tl), jnp.float32)
+    a0 = jnp.zeros((B, Tl, H, D), jnp.float32)
+    _, _, m, l, acc = lax.fori_loop(0, n, body, (k, v, m0, l0, a0))
+    out = acc / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def make_ring_attn_fn(mesh: Mesh, *, sp: str = "sp", dp: Optional[str] = "dp",
+                      tp: Optional[str] = "tp", causal: bool = False):
+    """``attn_fn(q, k, v, mask)`` adapter ([B, T, H, D], T sharded on sp).
+
+    ``dp``/``tp`` name the axes sharding batch and heads (None if unused).
+    Padding masks are not supported (take the XLA path for those); causal
+    is handled inside the ring with global positions.
+    """
+    spec = P(dp, sp, tp, None)
+    inner = functools.partial(ring_attention, axis=sp, causal=causal)
+    mapped = shard_map(lambda q, k, v: inner(q, k, v), mesh=mesh,
+                       in_specs=(spec, spec, spec), out_specs=spec,
+                       check_vma=False)
+
+    def attn_fn(q, k, v, mask=None):
+        if mask is not None:
+            raise ValueError("ring attention supports causal/none masks only")
+        return mapped(q, k, v)
+
+    return attn_fn
+
+
+def ulysses_attention(q, k, v, *, axis: str, causal: bool = False,
+                      sm_scale: Optional[float] = None):
+    """All-to-all sequence parallelism inside a mapped context.
+
+    Local shards [B, Tl, H, D] → all_to_all → [B, T, H/n, D] full-sequence
+    per head group → full attention → all_to_all back. Requires H % n == 0.
+    """
+    n = lax.axis_size(axis)
+    B, Tl, H, D = q.shape
+    if H % n:
+        raise ValueError(f"heads {H} must divide by axis size {n}")
+    # split heads, concat sequence: [B, Tl, H, D] -> [B, n*Tl, H/n, D]
+    a2a = lambda x: lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                                   tiled=True)
+    qf, kf, vf = a2a(q), a2a(k), a2a(v)
+    T = n * Tl
+    scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(D)
+    s = jnp.einsum("bqhd,bkhd->bhqk", qf.astype(jnp.float32),
+                   kf.astype(jnp.float32)) * scale
+    if causal:
+        pos = jnp.arange(T)
+        s = jnp.where((pos[:, None] >= pos[None, :])[None, None], s, _NEG_INF)
+    w = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", w, vf.astype(jnp.float32))
+    # back: [B, T, H/n, D] -> [B, Tl, H, D]
+    out = lax.all_to_all(out.astype(q.dtype), axis, split_axis=1,
+                         concat_axis=2, tiled=True)
+    return out
+
+
+def make_ulysses_attn_fn(mesh: Mesh, *, sp: str = "sp",
+                         dp: Optional[str] = "dp",
+                         tp: Optional[str] = "tp", causal: bool = False):
+    """``attn_fn`` adapter for :func:`ulysses_attention` (same contract as
+    :func:`make_ring_attn_fn`)."""
+    spec = P(dp, sp, tp, None)
+    inner = functools.partial(ulysses_attention, axis=sp, causal=causal)
+    mapped = shard_map(lambda q, k, v: inner(q, k, v), mesh=mesh,
+                       in_specs=(spec, spec, spec), out_specs=spec,
+                       check_vma=False)
+
+    def attn_fn(q, k, v, mask=None):
+        if mask is not None:
+            raise ValueError("ulysses supports causal/none masks only")
+        return mapped(q, k, v)
+
+    return attn_fn
