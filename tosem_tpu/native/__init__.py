@@ -1,0 +1,51 @@
+"""Native (C++) runtime components, built on demand with g++.
+
+The reference keeps its runtime hot paths native (plasma store
+``src/ray/object_manager/plasma/store.cc``, raylet, the DeepSpeech client
+``native_client/deepspeech.cc``); this package is the TPU build's equivalent:
+small C++ cores with a plain C ABI, loaded via ctypes. ``load_library``
+compiles a source file into ``_build/`` the first time (or when the source is
+newer than the cached ``.so``) and returns the loaded ``ctypes.CDLL``.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_NATIVE_DIR = os.path.dirname(os.path.abspath(__file__))
+_BUILD_DIR = os.path.join(_NATIVE_DIR, "_build")
+_lock = threading.Lock()
+_cache = {}
+
+CXX = os.environ.get("TOSEM_CXX", "g++")
+CXXFLAGS = ["-O2", "-std=c++17", "-fPIC", "-shared", "-Wall"]
+LDFLAGS = ["-lpthread", "-lrt"]
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def load_library(stem: str) -> ctypes.CDLL:
+    """Compile ``native/<stem>.cpp`` → ``_build/lib<stem>.so`` and load it."""
+    with _lock:
+        if stem in _cache:
+            return _cache[stem]
+        src = os.path.join(_NATIVE_DIR, f"{stem}.cpp")
+        out = os.path.join(_BUILD_DIR, f"lib{stem}.so")
+        if not os.path.exists(src):
+            raise NativeBuildError(f"no such native source: {src}")
+        if (not os.path.exists(out)
+                or os.path.getmtime(out) < os.path.getmtime(src)):
+            os.makedirs(_BUILD_DIR, exist_ok=True)
+            cmd = [CXX, *CXXFLAGS, "-o", out + ".tmp", src, *LDFLAGS]
+            proc = subprocess.run(cmd, capture_output=True, text=True)
+            if proc.returncode != 0:
+                raise NativeBuildError(
+                    f"g++ failed for {stem}:\n{proc.stderr}")
+            os.replace(out + ".tmp", out)  # atomic: racing procs see old or new
+        lib = ctypes.CDLL(out)
+        _cache[stem] = lib
+        return lib

@@ -65,14 +65,17 @@ def ring_attention(q, k, v, *, axis: str, causal: bool = False,
 
     qpos = my * Tl + jnp.arange(Tl)                           # [Tl]
 
+    def _mask_for(src):
+        if not causal:
+            return None
+        kpos = src * Tl + jnp.arange(Tl)
+        return (qpos[:, None] >= kpos[None, :])[None, None]   # [1,1,Tq,Tk]
+
     def body(j, carry):
         k_cur, v_cur, m, l, acc = carry
         src = (my - j) % n                                    # owner of k_cur
-        mask = None
-        if causal:
-            kpos = src * Tl + jnp.arange(Tl)
-            mask = (qpos[:, None] >= kpos[None, :])[None, None]  # [1,1,Tq,Tk]
-        m, l, acc = _block_update(q, k_cur, v_cur, m, l, acc, mask, scale)
+        m, l, acc = _block_update(q, k_cur, v_cur, m, l, acc, _mask_for(src),
+                                  scale)
         k_nxt = lax.ppermute(k_cur, axis, perm)
         v_nxt = lax.ppermute(v_cur, axis, perm)
         return k_nxt, v_nxt, m, l, acc
@@ -80,7 +83,11 @@ def ring_attention(q, k, v, *, axis: str, causal: bool = False,
     m0 = jnp.full((B, H, Tl), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((B, H, Tl), jnp.float32)
     a0 = jnp.zeros((B, Tl, H, D), jnp.float32)
-    _, _, m, l, acc = lax.fori_loop(0, n, body, (k, v, m0, l0, a0))
+    # n-1 rotations: the last block is consumed without a wasted ppermute pair
+    k_last, v_last, m, l, acc = lax.fori_loop(
+        0, n - 1, body, (k, v, m0, l0, a0))
+    m, l, acc = _block_update(q, k_last, v_last, m, l, acc,
+                              _mask_for((my - (n - 1)) % n), scale)
     out = acc / l.transpose(0, 2, 1)[..., None]
     return out.astype(q.dtype)
 

@@ -1,0 +1,19 @@
+"""Distributed runtime — tasks, actors, shared-memory objects (Ray-lite).
+
+TPU-first re-design of the reference's Ray 1.1.0 core (SURVEY §2.1): a
+single-controller driver schedules tasks/actors onto worker processes, with a
+native C++ shared-memory object store for large payloads. The raylet/GCS/
+Redis daemons collapse into the driver (JAX is single-controller already);
+what remains native is the data plane (:mod:`tosem_tpu.native` objstore).
+"""
+from tosem_tpu.runtime.api import (ActorDiedError, ObjectRef, TaskError,
+                                   WorkerCrashedError, get, init,
+                                   is_initialized, kill, put, remote,
+                                   shutdown, wait)
+from tosem_tpu.runtime.object_store import ObjectID, ObjectStore
+
+__all__ = [
+    "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
+    "kill", "ObjectRef", "ObjectID", "ObjectStore", "TaskError",
+    "WorkerCrashedError", "ActorDiedError",
+]

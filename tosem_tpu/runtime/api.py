@@ -1,0 +1,179 @@
+"""Public runtime API: ``init / remote / get / put / wait / kill / shutdown``.
+
+The surface of the reference's Python API (``python/ray/worker.py:466`` init,
+``:1318`` get, ``:1396`` put, ``:1424`` wait, ``:1680`` remote;
+``python/ray/actor.py:269-280`` actor options) on the single-controller
+runtime in :mod:`tosem_tpu.runtime.runtime`.
+
+    import tosem_tpu.runtime as rt
+
+    rt.init(num_workers=4)
+
+    @rt.remote
+    def f(x):
+        return x * 2
+
+    ref = f.remote(21)
+    assert rt.get(ref) == 42
+
+    @rt.remote(max_restarts=1)
+    class Counter:
+        def __init__(self): self.n = 0
+        def inc(self): self.n += 1; return self.n
+
+    c = Counter.remote()
+    assert rt.get(c.inc.remote()) == 1
+"""
+from __future__ import annotations
+
+import inspect
+import threading
+from typing import Any, List, Optional, Sequence, Tuple, Union
+
+from tosem_tpu.runtime import common
+from tosem_tpu.runtime.common import (ActorDiedError, ObjectRef, TaskError,
+                                      WorkerCrashedError)
+from tosem_tpu.runtime.runtime import Runtime
+
+__all__ = [
+    "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
+    "kill", "ObjectRef", "TaskError", "WorkerCrashedError", "ActorDiedError",
+]
+
+_runtime: Optional[Runtime] = None
+_lock = threading.Lock()
+
+
+def init(num_workers: int = 4, store_capacity: int = 256 << 20,
+         max_task_retries: int = common.DEFAULT_MAX_TASK_RETRIES) -> Runtime:
+    global _runtime
+    with _lock:
+        if _runtime is None:
+            _runtime = Runtime(num_workers=num_workers,
+                               store_capacity=store_capacity,
+                               max_task_retries=max_task_retries)
+        return _runtime
+
+
+def is_initialized() -> bool:
+    return _runtime is not None
+
+
+def shutdown() -> None:
+    global _runtime
+    with _lock:
+        if _runtime is not None:
+            _runtime.shutdown()
+            _runtime = None
+
+
+def _rt() -> Runtime:
+    if _runtime is None:
+        raise RuntimeError("runtime not initialized; call rt.init() first")
+    return _runtime
+
+
+class RemoteFunction:
+    def __init__(self, fn, max_retries: Optional[int] = None):
+        self._fn = fn
+        self._max_retries = max_retries
+        self._fn_id = None
+        self.__name__ = getattr(fn, "__name__", "remote_fn")
+
+    def remote(self, *args, **kwargs) -> ObjectRef:
+        rt = _rt()
+        if self._fn_id is None:
+            self._fn_id = rt.register_fn(common.dumps(self._fn))
+        return rt.submit_task(self._fn_id, args, kwargs,
+                              max_retries=self._max_retries)
+
+    def options(self, max_retries: Optional[int] = None) -> "RemoteFunction":
+        rf = RemoteFunction(self._fn, max_retries=max_retries)
+        rf._fn_id = self._fn_id
+        return rf
+
+    def __call__(self, *a, **k):
+        raise TypeError(f"remote function {self.__name__!r} must be invoked "
+                        f"with .remote()")
+
+
+class ActorMethod:
+    def __init__(self, actor_id: bytes, name: str):
+        self._actor_id = actor_id
+        self._name = name
+
+    def remote(self, *args, **kwargs) -> ObjectRef:
+        return _rt().submit_actor_call(self._actor_id, self._name, args,
+                                       kwargs)
+
+
+class ActorHandle:
+    def __init__(self, actor_id: bytes, method_names: Sequence[str]):
+        self._actor_id = actor_id
+        self._method_names = set(method_names)
+
+    def __getattr__(self, name: str) -> ActorMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if name not in self._method_names:
+            raise AttributeError(f"actor has no public method {name!r}")
+        return ActorMethod(self._actor_id, name)
+
+
+class ActorClass:
+    def __init__(self, cls, max_restarts: int = 0):
+        self._cls = cls
+        self._max_restarts = max_restarts
+        self.__name__ = getattr(cls, "__name__", "Actor")
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        rt = _rt()
+        blob = common.dumps((self._cls, args, kwargs))
+        actor_id = rt.create_actor(blob, self._max_restarts)
+        methods = [n for n, _ in inspect.getmembers(
+            self._cls, predicate=callable) if not n.startswith("_")]
+        return ActorHandle(actor_id, methods)
+
+    def options(self, max_restarts: Optional[int] = None) -> "ActorClass":
+        return ActorClass(self._cls,
+                          self._max_restarts if max_restarts is None
+                          else max_restarts)
+
+    def __call__(self, *a, **k):
+        raise TypeError(f"actor class {self.__name__!r} must be instantiated "
+                        f"with .remote()")
+
+
+def remote(*args, **options):
+    """Decorator: ``@remote`` or ``@remote(max_retries=…, max_restarts=…)``."""
+    def wrap(target):
+        if inspect.isclass(target):
+            return ActorClass(target,
+                              max_restarts=options.get("max_restarts", 0))
+        return RemoteFunction(target,
+                              max_retries=options.get("max_retries"))
+    if len(args) == 1 and callable(args[0]) and not options:
+        return wrap(args[0])
+    return wrap
+
+
+def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
+        timeout: Optional[float] = None) -> Any:
+    rt = _rt()
+    if isinstance(refs, ObjectRef):
+        return rt.get(refs, timeout=timeout)
+    return [rt.get(r, timeout=timeout) for r in refs]
+
+
+def put(value: Any) -> ObjectRef:
+    return _rt().put(value)
+
+
+def wait(refs: Sequence[ObjectRef], num_returns: int = 1,
+         timeout: Optional[float] = None
+         ) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+    return _rt().wait(list(refs), num_returns, timeout)
+
+
+def kill(actor: ActorHandle) -> None:
+    _rt().kill_actor(actor._actor_id)

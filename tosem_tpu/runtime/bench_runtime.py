@@ -1,0 +1,148 @@
+"""Runtime microbenchmarks — the ``ray microbenchmark`` analog.
+
+Mirrors the harness at ``python/ray/ray_perf.py:74-233`` and emits the same
+release-log line format as ``release/release_logs/1.0.1/microbenchmark.txt``
+(``"<name> per second NNNN.NN +- SS.S"``), so the rebuild's numbers sit next
+to the reference anchors in SURVEY §6 (single-client get 30,921/s, put
+26,507/s, tasks sync 1,045/s, tasks async 14,319/s, 1:1 actor sync 1,546/s…).
+Also funnels rows through the study-schema CSV writer.
+"""
+from __future__ import annotations
+
+import statistics
+import time
+from typing import Callable, List, Tuple
+
+import tosem_tpu.runtime as rt
+from tosem_tpu.utils.results import ResultRow
+
+
+def _timeit(name: str, fn: Callable[[], int], trials: int = 3,
+            min_s: float = 0.5) -> Tuple[float, float]:
+    """Run ``fn`` (returns #ops) repeatedly for >= min_s per trial."""
+    rates = []
+    for _ in range(trials):
+        ops = 0
+        t0 = time.perf_counter()
+        while True:
+            ops += fn()
+            dt = time.perf_counter() - t0
+            if dt >= min_s:
+                break
+        rates.append(ops / dt)
+    mean = statistics.mean(rates)
+    sd = statistics.stdev(rates) if len(rates) > 1 else 0.0
+    return mean, sd
+
+
+def _release_line(name: str, mean: float, sd: float) -> str:
+    return f"{name} per second {mean:.2f} +- {sd:.2f}"
+
+
+def run_microbenchmarks(num_workers: int = 4, trials: int = 3,
+                        min_s: float = 0.5, quiet: bool = False
+                        ) -> List[ResultRow]:
+    own_runtime = not rt.is_initialized()
+    if own_runtime:
+        rt.init(num_workers=num_workers)
+    rows: List[ResultRow] = []
+    lines: List[str] = []
+
+    def record(bench_id: str, name: str, mean: float, sd: float,
+               unit: str = "ops/s"):
+        lines.append(_release_line(name, mean, sd))
+        rows.append(ResultRow(project="runtime", config="microbenchmark",
+                              bench_id=bench_id, metric=name.replace(" ", "_"),
+                              value=mean, unit=unit, device="cpu",
+                              n_devices=1, extra={"stddev": sd}))
+
+    # --- object plane (ray_perf.py "single client get/put") ---------------
+    obj = rt.put(b"x" * 1024)
+    BATCH = 1000
+
+    def do_gets():
+        for _ in range(BATCH):
+            rt.get(obj)
+        return BATCH
+    m, s = _timeit("get", do_gets, trials, min_s)
+    record("single_client_get", "single client get calls", m, s)
+
+    payload = b"x" * 1024
+
+    def do_puts():
+        for _ in range(BATCH):
+            rt.put(payload)
+        return BATCH
+    m, s = _timeit("put", do_puts, trials, min_s)
+    record("single_client_put", "single client put calls", m, s)
+
+    # --- put bandwidth (ray_perf "single client put gigabytes") -----------
+    mb = b"x" * (1 << 20)
+
+    def do_put_gb():
+        for _ in range(16):
+            rt.put(mb)
+        return 16
+    m, s = _timeit("put_gb", do_put_gb, trials, min_s)
+    record("single_client_put_gbps", "single client put gigabytes",
+           m / 1024.0, s / 1024.0, unit="GB/s")
+
+    # --- tasks ------------------------------------------------------------
+    @rt.remote
+    def tiny():
+        return b"ok"
+
+    def tasks_sync():
+        for _ in range(100):
+            rt.get(tiny.remote())
+        return 100
+    m, s = _timeit("tasks_sync", tasks_sync, trials, min_s)
+    record("tasks_sync", "tasks synchronous", m, s)
+
+    def tasks_async():
+        rt.get([tiny.remote() for _ in range(1000)])
+        return 1000
+    m, s = _timeit("tasks_async", tasks_async, trials, min_s)
+    record("tasks_async", "tasks async", m, s)
+
+    # --- actors -----------------------------------------------------------
+    @rt.remote
+    class Echo:
+        def ping(self):
+            return b"ok"
+
+    a = Echo.remote()
+    rt.get(a.ping.remote())  # actor warm
+
+    def actor_sync():
+        for _ in range(100):
+            rt.get(a.ping.remote())
+        return 100
+    m, s = _timeit("actor_sync", actor_sync, trials, min_s)
+    record("actor_calls_sync", "1:1 actor calls sync", m, s)
+
+    def actor_async():
+        rt.get([a.ping.remote() for _ in range(1000)])
+        return 1000
+    m, s = _timeit("actor_async", actor_async, trials, min_s)
+    record("actor_calls_async", "1:1 actor calls async", m, s)
+
+    n = max(2, num_workers)
+    actors = [Echo.remote() for _ in range(n)]
+    rt.get([b.ping.remote() for b in actors])
+
+    def nn_actor_async():
+        refs = []
+        for b in actors:
+            refs.extend(b.ping.remote() for _ in range(250))
+        rt.get(refs)
+        return len(refs)
+    m, s = _timeit("nn_actor_async", nn_actor_async, trials, min_s)
+    record("n_n_actor_calls_async", "n:n actor calls async", m, s)
+
+    if not quiet:
+        for ln in lines:
+            print(ln)
+    if own_runtime:
+        rt.shutdown()
+    return rows

@@ -1,0 +1,100 @@
+"""Shared types for the distributed runtime (driver ↔ worker protocol).
+
+The wire protocol plays the role of the reference's task submission path
+(``core_worker.cc:1292`` SubmitTask → ``direct_task_transport.cc:289`` worker
+lease → push-to-worker): here the driver IS the scheduler (single-controller,
+as fits the JAX model), workers are leased processes on pipes, and the plasma
+analog (:mod:`tosem_tpu.runtime.object_store`) carries anything over
+``INLINE_THRESHOLD`` bytes — the same >100KB spill rule as the reference's
+``CoreWorker::Put`` (``core_worker.cc:849``).
+"""
+from __future__ import annotations
+
+import pickle
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import cloudpickle
+
+from tosem_tpu.runtime.object_store import ObjectID
+
+# Objects larger than this go to the shared-memory store instead of riding
+# the control pipe (reference: core_worker.cc:849 plasma threshold).
+INLINE_THRESHOLD = 100 * 1024
+
+HEARTBEAT_INTERVAL_S = 0.2  # scheduler liveness-check cadence
+DEFAULT_MAX_TASK_RETRIES = 3  # reference: ray default task max_retries
+
+
+class RuntimeError_(Exception):
+    pass
+
+
+class TaskError(RuntimeError_):
+    """Remote function raised; carries the remote traceback text."""
+
+    def __init__(self, cause: BaseException, remote_tb: str):
+        super().__init__(f"{type(cause).__name__}: {cause}\n"
+                         f"--- remote traceback ---\n{remote_tb}")
+        self.cause = cause
+        self.remote_tb = remote_tb
+
+
+class WorkerCrashedError(RuntimeError_):
+    """The worker executing the task died (after exhausting retries)."""
+
+
+class ActorDiedError(RuntimeError_):
+    """The actor's process died (and restarts, if any, were exhausted)."""
+
+
+class ObjectRef:
+    """Future for a task result or put object (the ``ray.ObjectRef`` shape)."""
+
+    __slots__ = ("oid", "__weakref__")  # weakref: driver-side table GC
+
+    def __init__(self, oid: ObjectID):
+        self.oid = oid
+
+    def hex(self) -> str:
+        return self.oid.hex()
+
+    def __hash__(self):
+        return hash(self.oid)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and self.oid == other.oid
+
+    def __repr__(self):
+        return f"ObjectRef({self.hex()[:12]}…)"
+
+
+@dataclass
+class StoreRef:
+    """Marker inside serialized args: fetch this id from the shm store."""
+    binary: bytes
+
+
+def dumps(value: Any) -> bytes:
+    """Serialize a value (cloudpickle: closures, lambdas, local classes)."""
+    return cloudpickle.dumps(value, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def loads(blob: bytes) -> Any:
+    return pickle.loads(blob)
+
+
+@dataclass
+class TaskSpec:
+    """Driver-side record of a submitted task, kept until completion so a
+    worker crash can replay it (reference: lineage in
+    ``raylet/reconstruction_policy.h:40``, here driver-held)."""
+    task_id: bytes
+    fn_id: Optional[bytes]      # None for actor method calls
+    method: Optional[str]       # actor method name
+    actor_id: Optional[bytes]
+    args: tuple
+    kwargs: dict
+    result_ref: ObjectRef
+    retries_left: int
+    deps: set                   # unresolved ObjectRefs

@@ -1,0 +1,160 @@
+"""Python client for the native shared-memory object store.
+
+Plasma-client analog (reference: ``src/ray/object_manager/plasma/client.cc``):
+immutable objects keyed by 20-byte ids, zero-copy reads out of the mmap'd
+segment, per-object refcounts, LRU eviction under memory pressure. The store
+itself is C++ (:mod:`tosem_tpu.native` ``objstore.cpp``); this wrapper adds
+object-id generation and memoryview-based zero-copy gets.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import uuid
+from typing import Optional, Tuple
+
+from tosem_tpu.native import load_library
+
+ID_LEN = 20
+
+_ERRORS = {
+    -1: "object already exists (objects are immutable)",
+    -2: "object not found",
+    -3: "store full (and nothing evictable)",
+    -4: "system error",
+    -5: "object larger than store capacity",
+}
+
+
+class ObjectStoreError(RuntimeError):
+    def __init__(self, code: int, what: str = ""):
+        super().__init__(f"{_ERRORS.get(code, f'error {code}')} {what}".strip())
+        self.code = code
+
+
+class ObjectID:
+    """20-byte object id (the shape of Ray's ``ObjectID``)."""
+
+    __slots__ = ("binary",)
+
+    def __init__(self, binary: bytes):
+        if len(binary) != ID_LEN:
+            raise ValueError(f"ObjectID must be {ID_LEN} bytes")
+        self.binary = binary
+
+    @classmethod
+    def random(cls) -> "ObjectID":
+        return cls(uuid.uuid4().bytes + os.urandom(4))
+
+    def hex(self) -> str:
+        return self.binary.hex()
+
+    def __hash__(self):
+        return hash(self.binary)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectID) and self.binary == other.binary
+
+    def __repr__(self):
+        return f"ObjectID({self.hex()[:12]}…)"
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.objstore_create.restype = ctypes.c_void_p
+    lib.objstore_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+    lib.objstore_attach.restype = ctypes.c_void_p
+    lib.objstore_attach.argtypes = [ctypes.c_char_p]
+    lib.objstore_put.restype = ctypes.c_int
+    lib.objstore_put.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                 ctypes.c_char_p, ctypes.c_uint64]
+    lib.objstore_get.restype = ctypes.c_int
+    lib.objstore_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                 ctypes.POINTER(u8p),
+                                 ctypes.POINTER(ctypes.c_uint64)]
+    lib.objstore_release.restype = ctypes.c_int
+    lib.objstore_release.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.objstore_contains.restype = ctypes.c_int
+    lib.objstore_contains.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.objstore_delete.restype = ctypes.c_int
+    lib.objstore_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.objstore_stats.restype = None
+    lib.objstore_stats.argtypes = [ctypes.c_void_p,
+                                   ctypes.POINTER(ctypes.c_uint64),
+                                   ctypes.POINTER(ctypes.c_uint64),
+                                   ctypes.POINTER(ctypes.c_uint64)]
+    lib.objstore_close.restype = None
+    lib.objstore_close.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+class ObjectStore:
+    """One shared-memory segment, created by the driver, attached by workers."""
+
+    def __init__(self, name: str, capacity: int = 256 << 20,
+                 create: bool = True):
+        self._lib = _bind(load_library("objstore"))
+        self.name = name
+        if create:
+            self._h = self._lib.objstore_create(name.encode(), capacity)
+        else:
+            self._h = self._lib.objstore_attach(name.encode())
+        if not self._h:
+            raise ObjectStoreError(-4, f"could not open segment {name!r}")
+
+    def put(self, oid: ObjectID, data: bytes) -> None:
+        rc = self._lib.objstore_put(self._h, oid.binary, data, len(data))
+        if rc != 0:
+            raise ObjectStoreError(rc, f"put {oid!r} ({len(data)} bytes)")
+
+    def get(self, oid: ObjectID) -> Optional[bytes]:
+        """Copying get (safe default). Returns None when absent."""
+        view = self.get_view(oid)
+        if view is None:
+            return None
+        try:
+            return bytes(view)
+        finally:
+            self.release(oid)
+
+    def get_view(self, oid: ObjectID) -> Optional[memoryview]:
+        """Zero-copy view into the segment; caller must :meth:`release`."""
+        ptr = ctypes.POINTER(ctypes.c_uint8)()
+        size = ctypes.c_uint64()
+        rc = self._lib.objstore_get(self._h, oid.binary,
+                                    ctypes.byref(ptr), ctypes.byref(size))
+        if rc == -2:
+            return None
+        if rc != 0:
+            raise ObjectStoreError(rc, f"get {oid!r}")
+        return memoryview((ctypes.c_uint8 * size.value).from_address(
+            ctypes.addressof(ptr.contents))).cast("B")
+
+    def release(self, oid: ObjectID) -> None:
+        self._lib.objstore_release(self._h, oid.binary)
+
+    def contains(self, oid: ObjectID) -> bool:
+        return bool(self._lib.objstore_contains(self._h, oid.binary))
+
+    def delete(self, oid: ObjectID) -> None:
+        self._lib.objstore_delete(self._h, oid.binary)
+
+    def stats(self) -> Tuple[int, int, int]:
+        """(used_bytes, num_objects, capacity)."""
+        used = ctypes.c_uint64()
+        n = ctypes.c_uint64()
+        cap = ctypes.c_uint64()
+        self._lib.objstore_stats(self._h, ctypes.byref(used), ctypes.byref(n),
+                                 ctypes.byref(cap))
+        return used.value, n.value, cap.value
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.objstore_close(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

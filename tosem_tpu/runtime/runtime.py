@@ -1,0 +1,514 @@
+"""Driver-side runtime: worker pool, scheduler loop, failure handling.
+
+Single-controller re-design of the reference's raylet + GCS split: the
+driver process owns scheduling (the reference's
+``raylet/scheduling/cluster_task_manager.cc`` lease loop), the worker pool
+(``raylet/worker_pool.cc``), failure detection (GCS heartbeats,
+``gcs_redis_failure_detector.cc`` — here process sentinels watched by the
+scheduler thread), and task replay on worker death (the lineage-reconstruction
+role of ``raylet/reconstruction_policy.h:40``). A JAX/TPU program has one
+controller anyway, so the distributed control store (Redis/GCS) collapses
+into in-process maps.
+
+Threading model: user threads submit under ``self.lock``; a scheduler thread
+drains worker pipes and watches process sentinels; a dedicated sender thread
+performs ALL pipe writes so no potentially-blocking ``conn.send`` ever runs
+while the runtime lock is held (a blocked write + full return pipe would
+otherwise deadlock driver and worker against each other).
+
+Object lifetime: the driver object table is keyed by raw object-id bytes and
+garbage-collected via ``weakref.finalize`` on the user-facing ObjectRef —
+the single-process analog of the reference's distributed reference counting
+(``core_worker/reference_count.cc``).
+"""
+from __future__ import annotations
+
+import itertools
+import multiprocessing as mp
+import multiprocessing.connection as mpc
+import os
+import queue
+import threading
+import time
+import weakref
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from tosem_tpu.runtime import common
+from tosem_tpu.runtime.common import (ActorDiedError, ObjectRef, StoreRef,
+                                      TaskError, TaskSpec, WorkerCrashedError)
+from tosem_tpu.runtime.object_store import ObjectID, ObjectStore
+
+_START_METHOD = os.environ.get("TOSEM_RT_START_METHOD", "fork")
+
+
+class _Worker:
+    """One worker process + its control pipe (a leased worker slot)."""
+
+    _ids = itertools.count()
+
+    def __init__(self, ctx, store_name: str, actor_id: Optional[bytes] = None):
+        from tosem_tpu.runtime.worker import worker_main
+        self.wid = next(self._ids)
+        self.conn, child_conn = mp.Pipe(duplex=True)
+        self.proc = ctx.Process(target=worker_main,
+                                args=(child_conn, store_name),
+                                daemon=True, name=f"tosem-worker-{self.wid}")
+        self.proc.start()
+        child_conn.close()
+        self.actor_id = actor_id       # None = stateless task worker
+        self.known_fns: Set[bytes] = set()
+        self.inflight: List[bytes] = []   # task_ids in submission order
+        self.ready = False
+
+    def alive(self) -> bool:
+        return self.proc.is_alive()
+
+    def kill(self) -> None:
+        try:
+            self.proc.kill()
+        except Exception:
+            pass
+
+
+class _ActorRecord:
+    def __init__(self, worker: _Worker, init_blob: bytes, max_restarts: int):
+        self.worker = worker
+        self.init_blob = init_blob      # replayed on restart
+        self.max_restarts = max_restarts
+        self.restarts = 0
+        self.dead = False
+
+
+class Runtime:
+    """The per-driver runtime singleton behind :mod:`tosem_tpu.runtime.api`."""
+
+    def __init__(self, num_workers: int = 4,
+                 store_capacity: int = 256 << 20,
+                 max_task_retries: int = common.DEFAULT_MAX_TASK_RETRIES):
+        self.ctx = mp.get_context(_START_METHOD)
+        self.store_name = f"/tosem_rt_{os.getpid()}_{int(time.time()*1e3)%int(1e9)}"
+        self.store = ObjectStore(self.store_name, capacity=store_capacity)
+        self.max_task_retries = max_task_retries
+
+        self.lock = threading.RLock()
+        self.cv = threading.Condition(self.lock)
+        # object table, keyed by raw 20-byte oid (NOT ObjectRef: the table
+        # must not keep user refs alive — finalizers below GC these entries)
+        self.inline: Dict[bytes, bytes] = {}
+        self.in_store: Set[bytes] = set()
+        self.errors: Dict[bytes, BaseException] = {}
+        # task state
+        self.specs: Dict[bytes, TaskSpec] = {}
+        self.pending: List[TaskSpec] = []        # FIFO, deps may be unresolved
+        self.fn_blobs: Dict[bytes, bytes] = {}
+        # workers
+        self.task_workers: List[_Worker] = []
+        self.actors: Dict[bytes, _ActorRecord] = {}
+        self._shutdown = False
+        for _ in range(num_workers):
+            self.task_workers.append(_Worker(self.ctx, self.store_name))
+
+        self._sendq: "queue.SimpleQueue[Optional[Tuple[_Worker, tuple]]]" = \
+            queue.SimpleQueue()
+        self._sender = threading.Thread(target=self._sender_loop, daemon=True,
+                                        name="tosem-sender")
+        self._sender.start()
+        self._thread = threading.Thread(target=self._scheduler_loop,
+                                        daemon=True, name="tosem-scheduler")
+        self._thread.start()
+
+    # ------------------------------------------------------------------ API
+
+    def register_fn(self, blob: bytes) -> bytes:
+        fn_id = os.urandom(16)
+        with self.lock:
+            self.fn_blobs[fn_id] = blob
+        return fn_id
+
+    def submit_task(self, fn_id: bytes, args: tuple, kwargs: dict,
+                    max_retries: Optional[int] = None) -> ObjectRef:
+        ref = self._new_ref()
+        spec = TaskSpec(task_id=os.urandom(16), fn_id=fn_id, method=None,
+                        actor_id=None, args=args, kwargs=kwargs,
+                        result_ref=ref,
+                        retries_left=(self.max_task_retries
+                                      if max_retries is None else max_retries),
+                        deps=self._unresolved_deps(args, kwargs))
+        with self.lock:
+            self.specs[spec.task_id] = spec
+            self.pending.append(spec)
+            self._dispatch_locked()
+        return ref
+
+    def create_actor(self, cls_blob_args: bytes, max_restarts: int) -> bytes:
+        actor_id = os.urandom(16)
+        with self.lock:
+            w = _Worker(self.ctx, self.store_name, actor_id=actor_id)
+            self.actors[actor_id] = _ActorRecord(w, cls_blob_args,
+                                                 max_restarts)
+            self._send(w, ("actor_init", cls_blob_args))
+            self.cv.notify_all()
+        return actor_id
+
+    def submit_actor_call(self, actor_id: bytes, method: str, args: tuple,
+                          kwargs: dict) -> ObjectRef:
+        ref = self._new_ref()
+        spec = TaskSpec(task_id=os.urandom(16), fn_id=None, method=method,
+                        actor_id=actor_id, args=args, kwargs=kwargs,
+                        result_ref=ref, retries_left=0,
+                        deps=self._unresolved_deps(args, kwargs))
+        with self.lock:
+            rec = self.actors.get(actor_id)
+            if rec is None or rec.dead:
+                self.errors[ref.oid.binary] = ActorDiedError("actor is dead")
+                self.cv.notify_all()
+                return ref
+            self.specs[spec.task_id] = spec
+            self.pending.append(spec)
+            self._dispatch_locked()
+        return ref
+
+    def kill_actor(self, actor_id: bytes) -> None:
+        with self.lock:
+            rec = self.actors.get(actor_id)
+            if rec is None or rec.dead:
+                return
+            rec.dead = True            # explicit kill: no restart (ray.kill)
+            # fail everything in flight or queued NOW — once dead the
+            # scheduler stops watching this worker, so nothing else will
+            for tid in list(rec.worker.inflight):
+                spec = self.specs.pop(tid, None)
+                if spec:
+                    self.errors[spec.result_ref.oid.binary] = ActorDiedError(
+                        "actor was killed")
+            rec.worker.inflight.clear()
+            self._fail_actor_tasks_locked(actor_id,
+                                          ActorDiedError("actor was killed"))
+            rec.worker.kill()
+
+    def put(self, value: Any) -> ObjectRef:
+        blob = common.dumps(value)
+        ref = self._new_ref()
+        if len(blob) > common.INLINE_THRESHOLD:
+            self.store.put(ref.oid, blob)
+            with self.lock:
+                self.in_store.add(ref.oid.binary)
+        else:
+            with self.lock:
+                self.inline[ref.oid.binary] = blob
+        return ref
+
+    def get(self, ref: ObjectRef, timeout: Optional[float] = None) -> Any:
+        key = ref.oid.binary
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self.cv:
+            while not self._ready_locked(key):
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    raise TimeoutError(f"get({ref!r}) timed out")
+                self.cv.wait(remaining)
+            if key in self.errors:
+                raise self.errors[key]
+            if key in self.inline:
+                return common.loads(self.inline[key])
+        blob = self.store.get(ref.oid)
+        if blob is None:
+            raise WorkerCrashedError(f"object {ref!r} lost from store "
+                                     f"(evicted under memory pressure?)")
+        return common.loads(blob)
+
+    def wait(self, refs: List[ObjectRef], num_returns: int,
+             timeout: Optional[float]
+             ) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+        if num_returns > len(refs):
+            raise ValueError(f"num_returns={num_returns} exceeds number of "
+                             f"refs ({len(refs)})")
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self.cv:
+            while True:
+                done = [r for r in refs if self._ready_locked(r.oid.binary)]
+                if len(done) >= num_returns:
+                    done = done[:num_returns]
+                    break
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    break
+                self.cv.wait(remaining)
+        done_set = set(done)
+        return done, [r for r in refs if r not in done_set]
+
+    def shutdown(self) -> None:
+        with self.lock:
+            if self._shutdown:
+                return
+            self._shutdown = True
+            workers = list(self.task_workers) + [r.worker
+                                                 for r in self.actors.values()]
+        for w in workers:
+            self._send(w, ("exit",))
+        self._sendq.put(None)
+        self._sender.join(timeout=2.0)
+        self._thread.join(timeout=2.0)
+        for w in workers:
+            w.proc.join(timeout=1.0)
+            if w.proc.is_alive():
+                w.kill()
+        self.store.close()
+
+    # ------------------------------------------------------------ internals
+
+    def _new_ref(self) -> ObjectRef:
+        """Mint an ObjectRef whose driver-table entry dies with it
+        (single-process reference counting, `reference_count.cc` role)."""
+        ref = ObjectRef(ObjectID.random())
+        weakref.finalize(ref, self._release_oid, ref.oid.binary)
+        return ref
+
+    def _release_oid(self, key: bytes) -> None:
+        if self._shutdown:
+            return
+        try:
+            with self.lock:
+                self.inline.pop(key, None)
+                self.errors.pop(key, None)
+                if key in self.in_store:
+                    self.in_store.discard(key)
+                    self.store.delete(ObjectID(key))
+        except Exception:
+            pass  # interpreter teardown / store already closed
+
+    def _send(self, w: _Worker, msg: tuple) -> None:
+        """Queue a pipe write for the sender thread (never blocks)."""
+        self._sendq.put((w, msg))
+
+    def _sender_loop(self) -> None:
+        while True:
+            item = self._sendq.get()
+            if item is None:
+                return
+            w, msg = item
+            try:
+                w.conn.send(msg)
+            except Exception:
+                pass  # dead worker: sentinel handling replays its tasks
+
+    def _unresolved_deps(self, args, kwargs) -> Set[ObjectRef]:
+        deps = set()
+        with self.lock:
+            for v in list(args) + list(kwargs.values()):
+                if isinstance(v, ObjectRef) and \
+                        not self._ready_locked(v.oid.binary):
+                    deps.add(v)
+        return deps
+
+    def _ready_locked(self, key: bytes) -> bool:
+        return key in self.inline or key in self.in_store or key in self.errors
+
+    def _materialize_arg(self, v):
+        """Substitute a ready ObjectRef: inline value or store marker.
+
+        Like the reference, only *top-level* args are resolved
+        (``direct_task_transport.cc`` dependency resolver behaviour).
+        """
+        if not isinstance(v, ObjectRef):
+            return v
+        key = v.oid.binary
+        if key in self.errors:
+            raise self.errors[key]
+        if key in self.inline:
+            return common.loads(self.inline[key])
+        return StoreRef(key)
+
+    def _dispatch_locked(self) -> None:
+        """Push ready pending tasks to idle workers (FIFO)."""
+        if self._shutdown:
+            return
+        still_pending: List[TaskSpec] = []
+        for spec in self.pending:
+            spec.deps = {d for d in spec.deps
+                         if not self._ready_locked(d.oid.binary)}
+            target: Optional[_Worker] = None
+            if spec.deps:
+                still_pending.append(spec)
+                continue
+            if spec.actor_id is not None:
+                rec = self.actors.get(spec.actor_id)
+                if rec is None or rec.dead:
+                    self._fail_task_locked(spec, ActorDiedError("actor died"))
+                    continue
+                target = rec.worker     # actor calls are ordered on its pipe
+            else:
+                idle = [w for w in self.task_workers if not w.inflight]
+                target = idle[0] if idle else None
+            if target is None:
+                still_pending.append(spec)
+                continue
+            try:
+                self._send_task_locked(target, spec)
+            except BaseException as e:  # a dep errored → propagate to result
+                self._fail_task_locked(spec, e)
+        self.pending = still_pending
+
+    def _send_task_locked(self, w: _Worker, spec: TaskSpec) -> None:
+        args = tuple(self._materialize_arg(a) for a in spec.args)
+        kwargs = {k: self._materialize_arg(v) for k, v in spec.kwargs.items()}
+        blob = common.dumps((args, kwargs))
+        if spec.actor_id is not None:
+            self._send(w, ("actor_call", spec.task_id, spec.method,
+                           spec.result_ref.oid.binary, blob))
+        else:
+            if spec.fn_id not in w.known_fns:
+                self._send(w, ("reg_fn", spec.fn_id,
+                               self.fn_blobs[spec.fn_id]))
+                w.known_fns.add(spec.fn_id)
+            self._send(w, ("task", spec.task_id, spec.fn_id,
+                           spec.result_ref.oid.binary, blob))
+        w.inflight.append(spec.task_id)
+
+    def _fail_task_locked(self, spec: TaskSpec, err: BaseException) -> None:
+        self.errors[spec.result_ref.oid.binary] = err
+        self.specs.pop(spec.task_id, None)
+        self.cv.notify_all()
+
+    def _complete_locked(self, w: _Worker, tid: bytes, kind: str,
+                         payload) -> None:
+        if tid in w.inflight:
+            w.inflight.remove(tid)
+        spec = self.specs.pop(tid, None)
+        if spec is None:
+            return
+        if kind == "inline":
+            self.inline[spec.result_ref.oid.binary] = payload
+        elif kind == "store":
+            self.in_store.add(spec.result_ref.oid.binary)
+        self.cv.notify_all()
+        self._dispatch_locked()
+
+    def _scheduler_loop(self) -> None:
+        while True:
+            with self.lock:
+                if self._shutdown:
+                    return
+                workers = list(self.task_workers) + [
+                    r.worker for r in self.actors.values() if not r.dead]
+                conn_by_fd = {w.conn: w for w in workers}
+                sent_by_fd = {w.proc.sentinel: w for w in workers}
+            try:
+                ready = mpc.wait(list(conn_by_fd) + list(sent_by_fd),
+                                 timeout=common.HEARTBEAT_INTERVAL_S)
+            except OSError:
+                ready = []
+            with self.lock:
+                if self._shutdown:
+                    return
+                for obj in ready:
+                    if obj in conn_by_fd:
+                        self._drain_conn_locked(conn_by_fd[obj])
+                for obj in ready:
+                    if obj in sent_by_fd:
+                        self._handle_death_locked(sent_by_fd[obj])
+                # heartbeat-style sweep (catches deaths missed by sentinels)
+                for w in workers:
+                    if not w.alive() and (w.inflight or w.actor_id):
+                        self._handle_death_locked(w)
+
+    def _drain_conn_locked(self, w: _Worker) -> None:
+        try:
+            while w.conn.poll():
+                msg = w.conn.recv()
+                kind = msg[0]
+                if kind == "ready":
+                    w.ready = True
+                    self._dispatch_locked()
+                elif kind == "done":
+                    _, tid, rkind, payload = msg
+                    self._complete_locked(w, tid, rkind, payload)
+                elif kind == "err":
+                    _, tid, blob, tb = msg
+                    if tid in w.inflight:
+                        w.inflight.remove(tid)
+                    spec = self.specs.pop(tid, None)
+                    if spec is not None:
+                        try:
+                            cause = common.loads(blob)
+                        except Exception as e:  # undeserializable exception
+                            cause = RuntimeError(f"(unpicklable) {e}")
+                        self.errors[spec.result_ref.oid.binary] = \
+                            TaskError(cause, tb)
+                        self.cv.notify_all()
+                    self._dispatch_locked()
+                elif kind == "actor_ready":
+                    pass
+                elif kind == "actor_err":
+                    _, blob, tb = msg
+                    rec = self.actors.get(w.actor_id)
+                    if rec is not None:
+                        rec.dead = True
+                        try:
+                            cause = common.loads(blob)
+                        except Exception:
+                            cause = RuntimeError("actor init failed")
+                        err = TaskError(cause, tb)
+                        self._fail_actor_tasks_locked(w.actor_id, err)
+        except (EOFError, OSError):
+            self._handle_death_locked(w)
+
+    def _fail_actor_tasks_locked(self, actor_id: bytes,
+                                 err: BaseException) -> None:
+        for tid, spec in list(self.specs.items()):
+            if spec.actor_id == actor_id:
+                self.specs.pop(tid)
+                self.errors[spec.result_ref.oid.binary] = err
+        self.pending = [s for s in self.pending if s.actor_id != actor_id]
+        self.cv.notify_all()
+
+    def _handle_death_locked(self, w: _Worker) -> None:
+        if w.actor_id is not None:
+            rec = self.actors.get(w.actor_id)
+            if rec is None or rec.worker is not w:
+                return
+            # in-flight calls on the dead process fail (ray semantics)
+            for tid in list(w.inflight):
+                spec = self.specs.pop(tid, None)
+                if spec:
+                    self.errors[spec.result_ref.oid.binary] = ActorDiedError(
+                        "actor process died mid-call")
+            w.inflight.clear()
+            self.cv.notify_all()
+            if rec.dead:
+                return
+            if rec.restarts < rec.max_restarts:
+                # restart policy: python/ray/actor.py:269-280 max_restarts
+                rec.restarts += 1
+                rec.worker = _Worker(self.ctx, self.store_name,
+                                     actor_id=w.actor_id)
+                self._send(rec.worker, ("actor_init", rec.init_blob))
+                self._dispatch_locked()
+            else:
+                rec.dead = True
+                self._fail_actor_tasks_locked(
+                    w.actor_id, ActorDiedError("actor died; restarts "
+                                               "exhausted"))
+            return
+        # stateless task worker: replay or fail its in-flight tasks, respawn
+        if w in self.task_workers:
+            self.task_workers.remove(w)
+            for tid in list(w.inflight):
+                spec = self.specs.get(tid)
+                if spec is None:
+                    continue
+                if spec.retries_left > 0:
+                    spec.retries_left -= 1
+                    self.pending.insert(0, spec)
+                else:
+                    self.specs.pop(tid)
+                    self.errors[spec.result_ref.oid.binary] = \
+                        WorkerCrashedError(
+                            "worker died executing task; retries exhausted")
+            w.inflight.clear()
+            if not self._shutdown:
+                self.task_workers.append(_Worker(self.ctx, self.store_name))
+            self.cv.notify_all()
+            self._dispatch_locked()

@@ -1,0 +1,126 @@
+"""Worker process: executes tasks and hosts actors.
+
+The per-process execution engine — the slim analog of the reference's core
+worker (``src/ray/core_worker/core_worker.h:313``): receive task, resolve
+large args from the shared-memory store, execute, return the result inline
+(small) or via the store (large). One worker hosts either stateless tasks or
+exactly one actor instance (Ray dedicates workers to actors the same way,
+``_raylet.pyx:1093`` create_actor).
+
+Messages in:  ("reg_fn", fn_id, blob) | ("task", tid, fn_id, blob)
+              | ("actor_init", blob) | ("actor_call", tid, method, blob)
+              | ("exit",)
+Messages out: ("ready",) | ("done", tid, kind, payload)
+              | ("err", tid, blob, tb) | ("actor_ready",) |
+              ("actor_err", blob, tb)
+"""
+from __future__ import annotations
+
+import traceback
+from typing import Any, Dict, Optional
+
+from tosem_tpu.runtime import common
+from tosem_tpu.runtime.object_store import (ObjectID, ObjectStore,
+                                            ObjectStoreError)
+
+
+def _resolve(store_name: str, store_box: list, obj: Any) -> Any:
+    """Replace top-level StoreRef markers with values from the shm store."""
+    if isinstance(obj, common.StoreRef):
+        if store_box[0] is None:
+            store_box[0] = ObjectStore(store_name, create=False)
+        blob = store_box[0].get(ObjectID(obj.binary))
+        if blob is None:
+            raise common.RuntimeError_(
+                f"dependency {obj.binary.hex()[:12]} missing from store")
+        return common.loads(blob)
+    return obj
+
+
+def _send_result(conn, store_name: str, store_box: list, tid: bytes,
+                 result_binary: bytes, value: Any) -> None:
+    blob = common.dumps(value)
+    if len(blob) > common.INLINE_THRESHOLD:
+        if store_box[0] is None:
+            store_box[0] = ObjectStore(store_name, create=False)
+        try:
+            store_box[0].put(ObjectID(result_binary), blob)
+        except ObjectStoreError as e:
+            # A retried task whose first attempt stored its result before
+            # dying: the deterministic result id already exists — that IS
+            # success (objects are immutable).
+            if e.code != -1:
+                raise
+        conn.send(("done", tid, "store", result_binary))
+    else:
+        conn.send(("done", tid, "inline", blob))
+
+
+def _dump_exc(e: BaseException) -> bytes:
+    """Serialize an exception, falling back when it is unpicklable (an open
+    socket / lock in its attributes) so the real error isn't masked by a
+    worker crash."""
+    try:
+        return common.dumps(e)
+    except BaseException:
+        return common.dumps(RuntimeError(
+            f"{type(e).__name__}: {e!r} (original exception unpicklable)"))
+
+
+def worker_main(conn, store_name: str) -> None:
+    fns: Dict[bytes, Any] = {}
+    actor: Optional[Any] = None
+    store_box = [None]  # lazy attach; most small-task workers never need it
+
+    conn.send(("ready",))
+    while True:
+        try:
+            msg = conn.recv()
+        except (EOFError, OSError):
+            break
+        kind = msg[0]
+        if kind == "exit":
+            break
+        elif kind == "reg_fn":
+            _, fn_id, blob = msg
+            fns[fn_id] = common.loads(blob)
+        elif kind == "task":
+            _, tid, fn_id, result_binary, blob = msg
+            try:
+                args, kwargs = common.loads(blob)
+                args = tuple(_resolve(store_name, store_box, a) for a in args)
+                kwargs = {k: _resolve(store_name, store_box, v)
+                          for k, v in kwargs.items()}
+                value = fns[fn_id](*args, **kwargs)
+                _send_result(conn, store_name, store_box, tid,
+                             result_binary, value)
+            except BaseException as e:  # noqa: BLE001 — ship to driver
+                conn.send(("err", tid, _dump_exc(e),
+                           traceback.format_exc()))
+        elif kind == "actor_init":
+            _, blob = msg
+            try:
+                cls, args, kwargs = common.loads(blob)
+                args = tuple(_resolve(store_name, store_box, a) for a in args)
+                kwargs = {k: _resolve(store_name, store_box, v)
+                          for k, v in kwargs.items()}
+                actor = cls(*args, **kwargs)
+                conn.send(("actor_ready",))
+            except BaseException as e:  # noqa: BLE001
+                conn.send(("actor_err", _dump_exc(e),
+                           traceback.format_exc()))
+        elif kind == "actor_call":
+            _, tid, method, result_binary, blob = msg
+            try:
+                args, kwargs = common.loads(blob)
+                args = tuple(_resolve(store_name, store_box, a) for a in args)
+                kwargs = {k: _resolve(store_name, store_box, v)
+                          for k, v in kwargs.items()}
+                value = getattr(actor, method)(*args, **kwargs)
+                _send_result(conn, store_name, store_box, tid,
+                             result_binary, value)
+            except BaseException as e:  # noqa: BLE001
+                conn.send(("err", tid, _dump_exc(e),
+                           traceback.format_exc()))
+    if store_box[0] is not None:
+        store_box[0].close()
