@@ -332,6 +332,24 @@ class TestRegressions:
         out = rt.get(refs, timeout=60)
         assert all(o == payload for o in out)
 
+    def test_no_head_of_line_blocking(self, runtime):
+        # fast tasks pipelined behind a long task must be stolen back and
+        # finish on other workers, not wait out the long task
+        @rt.remote
+        def slow():
+            time.sleep(8)
+            return "slow"
+
+        @rt.remote
+        def fast(x):
+            return x
+
+        slow_ref = slow.remote()
+        time.sleep(0.05)
+        fast_refs = [fast.remote(i) for i in range(30)]
+        assert rt.get(fast_refs, timeout=6) == list(range(30))
+        del slow_ref
+
     def test_tiny_store_capacity_is_clamped(self):
         name = f"/tosem_t7_{os.getpid()}"
         with ObjectStore(name, capacity=64 << 10) as s:  # absurdly small

@@ -32,7 +32,8 @@ constexpr uint32_t kIdLen = 20;
 constexpr uint32_t kTableSlots = 1 << 13;  // open-addressed index (~460KB)
 constexpr uint64_t kAlign = 64;            // cache-line aligned payloads
 
-enum SlotState : uint32_t { kEmpty = 0, kUsed = 1, kTombstone = 2 };
+enum SlotState : uint32_t { kEmpty = 0, kUsed = 1, kTombstone = 2,
+                            kCreating = 3 };
 
 struct Slot {
   uint8_t id[kIdLen];
@@ -111,7 +112,8 @@ Slot* find_slot(Handle* h, const uint8_t* id, int for_insert) {
   Slot* first_tomb = nullptr;
   for (uint32_t i = 0; i < kTableSlots; i++) {
     Slot* s = &H->table[(start + i) & (kTableSlots - 1)];
-    if (s->state == kUsed && memcmp(s->id, id, kIdLen) == 0) return s;
+    if ((s->state == kUsed || s->state == kCreating) &&
+        memcmp(s->id, id, kIdLen) == 0) return s;
     if (s->state == kTombstone && !first_tomb) first_tomb = s;
     if (s->state == kEmpty)
       return for_insert ? (first_tomb ? first_tomb : s) : nullptr;
@@ -330,11 +332,68 @@ int objstore_get(void* vh, const uint8_t* id, const uint8_t** out_ptr,
   Header* H = hdr(h);
   if (lock(H) != 0) return OS_ERR_SYS;
   Slot* s = find_slot(h, id, 0);
-  if (!s) { unlock(H); return OS_ERR_NOTFOUND; }
+  if (!s || s->state == kCreating) { unlock(H); return OS_ERR_NOTFOUND; }
   s->refcount++;
   s->lru = ++H->lru_tick;
   *out_ptr = h->base + s->offset;
   *out_size = s->size;
+  unlock(H);
+  return OS_OK;
+}
+
+// Two-phase write (plasma Create/Seal): reserve space, let the caller write
+// the payload directly into the mapping (zero intermediate copies), then
+// seal. Unsealed objects are invisible to get() and not evictable.
+int objstore_reserve(void* vh, const uint8_t* id, uint64_t size,
+                     uint8_t** out_ptr) {
+  Handle* h = static_cast<Handle*>(vh);
+  Header* H = hdr(h);
+  uint64_t need = align_up(sizeof(BlockHeader) + size + sizeof(BlockFooter),
+                           kAlign);
+  if (need > h->capacity - H->data_begin) return OS_ERR_TOOBIG;
+  if (lock(H) != 0) return OS_ERR_SYS;
+  if (find_slot(h, id, 0)) { unlock(H); return OS_ERR_EXISTS; }
+  uint64_t off = alloc_block(h, need);
+  while (!off) {
+    if (evict_lru(h) != 0) { unlock(H); return OS_ERR_FULL; }
+    off = alloc_block(h, need);
+  }
+  Slot* s = find_slot(h, id, 1);
+  if (!s) { free_block(h, off); unlock(H); return OS_ERR_FULL; }
+  memcpy(s->id, id, kIdLen);
+  s->state = kCreating;
+  s->refcount = 0;
+  s->offset = off + sizeof(BlockHeader);
+  s->size = size;
+  s->lru = ++H->lru_tick;
+  *out_ptr = h->base + s->offset;
+  unlock(H);
+  return OS_OK;
+}
+
+int objstore_seal(void* vh, const uint8_t* id) {
+  Handle* h = static_cast<Handle*>(vh);
+  Header* H = hdr(h);
+  if (lock(H) != 0) return OS_ERR_SYS;
+  Slot* s = find_slot(h, id, 0);
+  if (!s || s->state != kCreating) { unlock(H); return OS_ERR_NOTFOUND; }
+  s->state = kUsed;
+  s->lru = ++H->lru_tick;
+  H->used_bytes += s->size;
+  H->num_objects++;
+  unlock(H);
+  return OS_OK;
+}
+
+int objstore_abort(void* vh, const uint8_t* id) {
+  Handle* h = static_cast<Handle*>(vh);
+  Header* H = hdr(h);
+  if (lock(H) != 0) return OS_ERR_SYS;
+  Slot* s = find_slot(h, id, 0);
+  if (!s || s->state != kCreating) { unlock(H); return OS_ERR_NOTFOUND; }
+  uint64_t block_off = s->offset - sizeof(BlockHeader);
+  s->state = kTombstone;
+  free_block(h, block_off);
   unlock(H);
   return OS_OK;
 }
@@ -365,8 +424,10 @@ int objstore_delete(void* vh, const uint8_t* id) {
   if (lock(H) != 0) return OS_ERR_SYS;
   Slot* s = find_slot(h, id, 0);
   if (!s) { unlock(H); return OS_ERR_NOTFOUND; }
-  H->used_bytes -= s->size;
-  H->num_objects--;
+  if (s->state == kUsed) {  // kCreating was never counted
+    H->used_bytes -= s->size;
+    H->num_objects--;
+  }
   uint64_t block_off = s->offset - sizeof(BlockHeader);
   s->state = kTombstone;
   free_block(h, block_off);

@@ -20,6 +20,7 @@ from tosem_tpu.utils.results import ResultRow
 def _timeit(name: str, fn: Callable[[], int], trials: int = 3,
             min_s: float = 0.5) -> Tuple[float, float]:
     """Run ``fn`` (returns #ops) repeatedly for >= min_s per trial."""
+    fn()  # untimed warmup: shm page faults, pipe setup, fn registration
     rates = []
     for _ in range(trials):
         ops = 0

@@ -23,6 +23,8 @@ from tosem_tpu.runtime.object_store import ObjectID
 INLINE_THRESHOLD = 100 * 1024
 
 HEARTBEAT_INTERVAL_S = 0.2  # scheduler liveness-check cadence
+MAX_INFLIGHT_PER_WORKER = 16  # pipeline depth per stateless worker
+STEAL_AFTER_S = 1.0  # reclaim queued tasks from a worker stalled this long
 DEFAULT_MAX_TASK_RETRIES = 3  # reference: ray default task max_retries
 
 
@@ -82,6 +84,72 @@ def dumps(value: Any) -> bytes:
 
 def loads(blob: bytes) -> Any:
     return pickle.loads(blob)
+
+
+# --- large-value path: pickle protocol 5 with out-of-band buffers ----------
+# Raw bytes-likes skip pickling entirely; numpy arrays / anything exposing
+# PickleBuffer keeps its payload out of the pickle stream. Combined with the
+# store's reserve/seal API this makes a large put a single memcpy into shm.
+
+import struct as _struct
+
+_RAW = 0    # parts = [payload]
+_P5 = 1     # parts = [pickle5 header, buffer0, buffer1, ...]
+
+
+def dumps_parts(value: Any):
+    """→ (kind, [buffer-like parts]); no concatenation (no extra copies)."""
+    if isinstance(value, (bytes, bytearray, memoryview)):
+        return _RAW, [value]
+    buffers = []
+    header = cloudpickle.dumps(value, protocol=5,
+                               buffer_callback=buffers.append)
+    return _P5, [header] + [b.raw() for b in buffers]
+
+
+def loads_parts(kind: int, parts) -> Any:
+    if kind == _RAW:
+        return bytes(parts[0])
+    # copy the buffers out: the result must not alias evictable shm pages
+    return pickle.loads(bytes(parts[0]),
+                        buffers=[bytes(p) for p in parts[1:]])
+
+
+def store_put_parts(store, oid, kind: int, parts) -> None:
+    """Write pre-split parts into the shm store in the layout
+    ``[u32 kind][u32 n][u64 sizes…][part0][part1]…``."""
+    views = [p if isinstance(p, memoryview) else memoryview(p) for p in parts]
+    meta = _struct.pack(f"<II{len(views)}Q", kind, len(views),
+                        *[v.nbytes for v in views])
+    store.put_parts(oid, [meta] + views)
+
+
+def store_put_value(store, oid, value) -> None:
+    kind, parts = dumps_parts(value)
+    store_put_parts(store, oid, kind, parts)
+
+
+def store_get_value(store, oid):
+    """→ (found, value); copying read of the parts layout."""
+    view = store.get_view(oid)
+    if view is None:
+        return False, None
+    try:
+        kind, n = _struct.unpack_from("<II", view, 0)
+        sizes = _struct.unpack_from(f"<{n}Q", view, 8)
+        off = 8 + 8 * n
+        parts = []
+        for s in sizes:
+            parts.append(view[off:off + s])
+            off += s
+        return True, loads_parts(kind, parts)
+    finally:
+        store.release(oid)
+
+
+def parts_nbytes(parts) -> int:
+    return sum((p.nbytes if isinstance(p, memoryview) else len(p))
+               for p in parts)
 
 
 @dataclass

@@ -72,6 +72,13 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.objstore_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                  ctypes.POINTER(u8p),
                                  ctypes.POINTER(ctypes.c_uint64)]
+    lib.objstore_reserve.restype = ctypes.c_int
+    lib.objstore_reserve.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_uint64, ctypes.POINTER(u8p)]
+    lib.objstore_seal.restype = ctypes.c_int
+    lib.objstore_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.objstore_abort.restype = ctypes.c_int
+    lib.objstore_abort.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.objstore_release.restype = ctypes.c_int
     lib.objstore_release.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.objstore_contains.restype = ctypes.c_int
@@ -129,6 +136,42 @@ class ObjectStore:
             raise ObjectStoreError(rc, f"get {oid!r}")
         return memoryview((ctypes.c_uint8 * size.value).from_address(
             ctypes.addressof(ptr.contents))).cast("B")
+
+    def reserve(self, oid: ObjectID, size: int) -> memoryview:
+        """Two-phase write (plasma Create/Seal): returns a writable view of
+        ``size`` bytes inside the segment; write then :meth:`seal`."""
+        ptr = ctypes.POINTER(ctypes.c_uint8)()
+        rc = self._lib.objstore_reserve(self._h, oid.binary, size,
+                                        ctypes.byref(ptr))
+        if rc != 0:
+            raise ObjectStoreError(rc, f"reserve {oid!r} ({size} bytes)")
+        return memoryview((ctypes.c_uint8 * size).from_address(
+            ctypes.addressof(ptr.contents))).cast("B")
+
+    def seal(self, oid: ObjectID) -> None:
+        rc = self._lib.objstore_seal(self._h, oid.binary)
+        if rc != 0:
+            raise ObjectStoreError(rc, f"seal {oid!r}")
+
+    def abort(self, oid: ObjectID) -> None:
+        self._lib.objstore_abort(self._h, oid.binary)
+
+    def put_parts(self, oid: ObjectID, parts) -> None:
+        """Single-copy put: writes buffer ``parts`` back-to-back via
+        reserve/seal."""
+        views = [p if isinstance(p, memoryview) else memoryview(p)
+                 for p in parts]
+        total = sum(v.nbytes for v in views)
+        dst = self.reserve(oid, total)
+        try:
+            off = 0
+            for v in views:
+                dst[off:off + v.nbytes] = v.cast("B")
+                off += v.nbytes
+        except BaseException:
+            self.abort(oid)
+            raise
+        self.seal(oid)
 
     def release(self, oid: ObjectID) -> None:
         self._lib.objstore_release(self._h, oid.binary)

@@ -59,6 +59,15 @@ class _Worker:
         self.known_fns: Set[bytes] = set()
         self.inflight: List[bytes] = []   # task_ids in submission order
         self.ready = False
+        self.last_progress = time.monotonic()
+
+    def load_key(self):
+        """Dispatch preference: non-stalled first, then least loaded. A
+        worker grinding a long task must not swallow new work (head-of-line
+        blocking): queued tasks behind it get stolen by the scheduler."""
+        stalled = bool(self.inflight) and (
+            time.monotonic() - self.last_progress > common.STEAL_AFTER_S)
+        return (1 if stalled else 0, len(self.inflight))
 
     def alive(self) -> bool:
         return self.proc.is_alive()
@@ -136,6 +145,16 @@ class Runtime:
                         deps=self._unresolved_deps(args, kwargs))
         with self.lock:
             self.specs[spec.task_id] = spec
+            if not spec.deps:
+                # fast path: straight onto the least-loaded pipeline
+                w = min(self.task_workers, key=_Worker.load_key)
+                if (w.load_key()[0] == 0 and
+                        len(w.inflight) < common.MAX_INFLIGHT_PER_WORKER):
+                    try:
+                        self._send_task_locked(w, spec)
+                    except BaseException as e:
+                        self._fail_task_locked(spec, e)
+                    return ref
             self.pending.append(spec)
             self._dispatch_locked()
         return ref
@@ -164,6 +183,13 @@ class Runtime:
                 self.cv.notify_all()
                 return ref
             self.specs[spec.task_id] = spec
+            if not spec.deps:
+                # fast path: the actor's pipe IS its ordered queue
+                try:
+                    self._send_task_locked(rec.worker, spec)
+                except BaseException as e:
+                    self._fail_task_locked(spec, e)
+                return ref
             self.pending.append(spec)
             self._dispatch_locked()
         return ref
@@ -187,15 +213,16 @@ class Runtime:
             rec.worker.kill()
 
     def put(self, value: Any) -> ObjectRef:
-        blob = common.dumps(value)
+        kind, parts = common.dumps_parts(value)
         ref = self._new_ref()
-        if len(blob) > common.INLINE_THRESHOLD:
-            self.store.put(ref.oid, blob)
+        if common.parts_nbytes(parts) > common.INLINE_THRESHOLD:
+            common.store_put_parts(self.store, ref.oid, kind, parts)
             with self.lock:
                 self.in_store.add(ref.oid.binary)
         else:
             with self.lock:
-                self.inline[ref.oid.binary] = blob
+                self.inline[ref.oid.binary] = \
+                    (kind, [bytes(p) for p in parts])
         return ref
 
     def get(self, ref: ObjectRef, timeout: Optional[float] = None) -> Any:
@@ -211,12 +238,12 @@ class Runtime:
             if key in self.errors:
                 raise self.errors[key]
             if key in self.inline:
-                return common.loads(self.inline[key])
-        blob = self.store.get(ref.oid)
-        if blob is None:
+                return common.loads_parts(*self.inline[key])
+        found, value = common.store_get_value(self.store, ref.oid)
+        if not found:
             raise WorkerCrashedError(f"object {ref!r} lost from store "
                                      f"(evicted under memory pressure?)")
-        return common.loads(blob)
+        return value
 
     def wait(self, refs: List[ObjectRef], num_returns: int,
              timeout: Optional[float]
@@ -318,7 +345,7 @@ class Runtime:
         if key in self.errors:
             raise self.errors[key]
         if key in self.inline:
-            return common.loads(self.inline[key])
+            return common.loads_parts(*self.inline[key])
         return StoreRef(key)
 
     def _dispatch_locked(self) -> None:
@@ -340,8 +367,11 @@ class Runtime:
                     continue
                 target = rec.worker     # actor calls are ordered on its pipe
             else:
-                idle = [w for w in self.task_workers if not w.inflight]
-                target = idle[0] if idle else None
+                w = min(self.task_workers, key=_Worker.load_key,
+                        default=None)
+                target = (w if w is not None and w.load_key()[0] == 0 and
+                          len(w.inflight) < common.MAX_INFLIGHT_PER_WORKER
+                          else None)
             if target is None:
                 still_pending.append(spec)
                 continue
@@ -384,7 +414,8 @@ class Runtime:
         elif kind == "store":
             self.in_store.add(spec.result_ref.oid.binary)
         self.cv.notify_all()
-        self._dispatch_locked()
+        if self.pending:
+            self._dispatch_locked()
 
     def _scheduler_loop(self) -> None:
         while True:
@@ -413,6 +444,31 @@ class Runtime:
                 for w in workers:
                     if not w.alive() and (w.inflight or w.actor_id):
                         self._handle_death_locked(w)
+                self._steal_from_stalled_locked()
+
+    def _steal_from_stalled_locked(self) -> None:
+        """Reclaim unstarted tasks queued behind a long-running one.
+
+        The worker executes FIFO and reports each completion before starting
+        the next, so after draining its pipe everything past inflight[0] is
+        unstarted (modulo a tiny race — a doubly-executed task resolves to
+        the same immutable object, at-least-once like the reference's
+        retries). Plays the role of raylet work-stealing/lease rebalancing.
+        """
+        now = time.monotonic()
+        stole = False
+        for w in self.task_workers:
+            if len(w.inflight) > 1 and \
+                    now - w.last_progress > common.STEAL_AFTER_S:
+                stolen = w.inflight[1:]
+                del w.inflight[1:]
+                for tid in reversed(stolen):
+                    spec = self.specs.get(tid)
+                    if spec is not None:
+                        self.pending.insert(0, spec)
+                        stole = True
+        if stole:
+            self._dispatch_locked()
 
     def _drain_conn_locked(self, w: _Worker) -> None:
         try:
@@ -424,9 +480,11 @@ class Runtime:
                     self._dispatch_locked()
                 elif kind == "done":
                     _, tid, rkind, payload = msg
+                    w.last_progress = time.monotonic()
                     self._complete_locked(w, tid, rkind, payload)
                 elif kind == "err":
                     _, tid, blob, tb = msg
+                    w.last_progress = time.monotonic()
                     if tid in w.inflight:
                         w.inflight.remove(tid)
                     spec = self.specs.pop(tid, None)

@@ -24,27 +24,32 @@ from tosem_tpu.runtime.object_store import (ObjectID, ObjectStore,
                                             ObjectStoreError)
 
 
+def _attach(store_name: str, store_box: list) -> ObjectStore:
+    if store_box[0] is None:
+        store_box[0] = ObjectStore(store_name, create=False)
+    return store_box[0]
+
+
 def _resolve(store_name: str, store_box: list, obj: Any) -> Any:
     """Replace top-level StoreRef markers with values from the shm store."""
     if isinstance(obj, common.StoreRef):
-        if store_box[0] is None:
-            store_box[0] = ObjectStore(store_name, create=False)
-        blob = store_box[0].get(ObjectID(obj.binary))
-        if blob is None:
+        store = _attach(store_name, store_box)
+        found, value = common.store_get_value(store, ObjectID(obj.binary))
+        if not found:
             raise common.RuntimeError_(
                 f"dependency {obj.binary.hex()[:12]} missing from store")
-        return common.loads(blob)
+        return value
     return obj
 
 
 def _send_result(conn, store_name: str, store_box: list, tid: bytes,
                  result_binary: bytes, value: Any) -> None:
-    blob = common.dumps(value)
-    if len(blob) > common.INLINE_THRESHOLD:
-        if store_box[0] is None:
-            store_box[0] = ObjectStore(store_name, create=False)
+    kind, parts = common.dumps_parts(value)
+    if common.parts_nbytes(parts) > common.INLINE_THRESHOLD:
+        store = _attach(store_name, store_box)
         try:
-            store_box[0].put(ObjectID(result_binary), blob)
+            common.store_put_parts(store, ObjectID(result_binary), kind,
+                                   parts)
         except ObjectStoreError as e:
             # A retried task whose first attempt stored its result before
             # dying: the deterministic result id already exists — that IS
@@ -53,7 +58,8 @@ def _send_result(conn, store_name: str, store_box: list, tid: bytes,
                 raise
         conn.send(("done", tid, "store", result_binary))
     else:
-        conn.send(("done", tid, "inline", blob))
+        conn.send(("done", tid, "inline",
+                   (kind, [bytes(p) for p in parts])))
 
 
 def _dump_exc(e: BaseException) -> bytes:
