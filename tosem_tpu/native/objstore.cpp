@@ -20,8 +20,10 @@
 #include <cstring>
 #include <fcntl.h>
 #include <pthread.h>
+#include <signal.h>
 #include <sys/mman.h>
 #include <sys/stat.h>
+#include <sys/types.h>
 #include <unistd.h>
 
 namespace {
@@ -42,7 +44,16 @@ struct Slot {
   uint64_t offset;  // payload offset from segment base
   uint64_t size;    // payload size
   uint64_t lru;     // last-touch tick, for eviction
+  int64_t creator_pid;  // reserver's pid; orphan detection for kCreating
 };
+
+// A kCreating slot whose creator died mid-write is an orphan: nobody can
+// seal it, so it is reclaimable (plasma's disconnect-cleanup role).
+bool slot_is_orphan(const Slot* s) {
+  if (s->state != kCreating) return false;
+  return s->creator_pid > 0 && kill((pid_t)s->creator_pid, 0) != 0 &&
+         errno == ESRCH;
+}
 
 // Block layout in the data region:
 //   [BlockHeader][payload ... ][BlockFooter]
@@ -201,6 +212,17 @@ void free_block(Handle* h, uint64_t off) {
 // after each eviction; coalescing in free_block grows contiguous space.
 int evict_lru(Handle* h) {
   Header* H = hdr(h);
+  // Orphaned kCreating blocks (creator died mid-write) are reclaimed first:
+  // nothing can ever seal them, so they are pure leaks otherwise.
+  for (uint32_t i = 0; i < kTableSlots; i++) {
+    Slot* s = &H->table[i];
+    if (slot_is_orphan(s)) {
+      uint64_t block_off = s->offset - sizeof(BlockHeader);
+      s->state = kTombstone;  // kCreating was never counted in used_bytes
+      free_block(h, block_off);
+      return 0;
+    }
+  }
   Slot* victim = nullptr;
   for (uint32_t i = 0; i < kTableSlots; i++) {
     Slot* s = &H->table[i];
@@ -366,7 +388,34 @@ int objstore_reserve(void* vh, const uint8_t* id, uint64_t size,
   s->offset = off + sizeof(BlockHeader);
   s->size = size;
   s->lru = ++H->lru_tick;
+  s->creator_pid = (int64_t)getpid();
   *out_ptr = h->base + s->offset;
+  unlock(H);
+  return OS_OK;
+}
+
+// 1 = sealed, 0 = mid-write (kCreating), OS_ERR_NOTFOUND = absent.
+int objstore_is_sealed(void* vh, const uint8_t* id) {
+  Handle* h = static_cast<Handle*>(vh);
+  Header* H = hdr(h);
+  if (lock(H) != 0) return OS_ERR_SYS;
+  Slot* s = find_slot(h, id, 0);
+  int r = !s ? OS_ERR_NOTFOUND : (s->state == kUsed ? 1 : 0);
+  unlock(H);
+  return r;
+}
+
+// Reclaim a kCreating slot whose creator is dead; EXISTS if still live.
+int objstore_reclaim_orphan(void* vh, const uint8_t* id) {
+  Handle* h = static_cast<Handle*>(vh);
+  Header* H = hdr(h);
+  if (lock(H) != 0) return OS_ERR_SYS;
+  Slot* s = find_slot(h, id, 0);
+  if (!s || s->state != kCreating) { unlock(H); return OS_ERR_NOTFOUND; }
+  if (!slot_is_orphan(s)) { unlock(H); return OS_ERR_EXISTS; }
+  uint64_t block_off = s->offset - sizeof(BlockHeader);
+  s->state = kTombstone;
+  free_block(h, block_off);
   unlock(H);
   return OS_OK;
 }
@@ -413,7 +462,8 @@ int objstore_contains(void* vh, const uint8_t* id) {
   Handle* h = static_cast<Handle*>(vh);
   Header* H = hdr(h);
   if (lock(H) != 0) return 0;
-  int found = find_slot(h, id, 0) != nullptr;
+  Slot* s = find_slot(h, id, 0);
+  int found = s != nullptr && s->state == kUsed;  // unsealed ⇒ not readable
   unlock(H);
   return found;
 }

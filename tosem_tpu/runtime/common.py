@@ -98,8 +98,12 @@ _P5 = 1     # parts = [pickle5 header, buffer0, buffer1, ...]
 
 
 def dumps_parts(value: Any):
-    """→ (kind, [buffer-like parts]); no concatenation (no extra copies)."""
-    if isinstance(value, (bytes, bytearray, memoryview)):
+    """→ (kind, [buffer-like parts]); no concatenation (no extra copies).
+
+    RAW covers exactly ``bytes`` so the round trip preserves type;
+    bytearray/ndarray ride protocol-5 out-of-band buffers instead.
+    """
+    if isinstance(value, bytes):
         return _RAW, [value]
     buffers = []
     header = cloudpickle.dumps(value, protocol=5,
@@ -127,6 +131,34 @@ def store_put_parts(store, oid, kind: int, parts) -> None:
 def store_put_value(store, oid, value) -> None:
     kind, parts = dumps_parts(value)
     store_put_parts(store, oid, kind, parts)
+
+
+def robust_store_put_parts(store, oid, kind, parts) -> None:
+    """Idempotent store write for retried tasks (deterministic result ids).
+
+    EXISTS may mean (a) a finished earlier attempt — success; (b) an orphaned
+    mid-write slot from a crashed attempt — reclaim and rewrite; (c) a live
+    concurrent duplicate mid-write — poll until it seals (duplicates write
+    identical content, so waiting is correct).
+    """
+    from tosem_tpu.runtime.object_store import ObjectStoreError
+    import time as _time
+    for _ in range(200):
+        try:
+            store_put_parts(store, oid, kind, parts)
+            return
+        except ObjectStoreError as e:
+            if e.code != -1:
+                raise
+        state = store.is_sealed(oid)
+        if state is True:
+            return                       # earlier attempt completed
+        if state is False:
+            if not store.reclaim_orphan(oid):
+                _time.sleep(0.01)        # live duplicate mid-write: wait
+        # state None: slot vanished between checks — retry the put
+    raise RuntimeError_(f"could not store result {oid!r}: slot stuck "
+                        f"mid-write")
 
 
 def store_get_value(store, oid):

@@ -79,6 +79,10 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.objstore_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.objstore_abort.restype = ctypes.c_int
     lib.objstore_abort.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.objstore_is_sealed.restype = ctypes.c_int
+    lib.objstore_is_sealed.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.objstore_reclaim_orphan.restype = ctypes.c_int
+    lib.objstore_reclaim_orphan.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.objstore_release.restype = ctypes.c_int
     lib.objstore_release.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.objstore_contains.restype = ctypes.c_int
@@ -155,6 +159,20 @@ class ObjectStore:
 
     def abort(self, oid: ObjectID) -> None:
         self._lib.objstore_abort(self._h, oid.binary)
+
+    def is_sealed(self, oid: ObjectID) -> Optional[bool]:
+        """True = readable, False = mid-write, None = absent."""
+        rc = self._lib.objstore_is_sealed(self._h, oid.binary)
+        if rc == 1:
+            return True
+        if rc == 0:
+            return False
+        return None
+
+    def reclaim_orphan(self, oid: ObjectID) -> bool:
+        """Free a mid-write slot whose creator process died; False if the
+        creator is still alive (or the slot isn't mid-write)."""
+        return self._lib.objstore_reclaim_orphan(self._h, oid.binary) == 0
 
     def put_parts(self, oid: ObjectID, parts) -> None:
         """Single-copy put: writes buffer ``parts`` back-to-back via

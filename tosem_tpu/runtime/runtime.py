@@ -103,7 +103,8 @@ class Runtime:
         self.cv = threading.Condition(self.lock)
         # object table, keyed by raw 20-byte oid (NOT ObjectRef: the table
         # must not keep user refs alive — finalizers below GC these entries)
-        self.inline: Dict[bytes, bytes] = {}
+        # inline values are (kind, [part bytes, ...]) per common.dumps_parts
+        self.inline: Dict[bytes, Tuple[int, List[bytes]]] = {}
         self.in_store: Set[bytes] = set()
         self.errors: Dict[bytes, BaseException] = {}
         # task state
@@ -354,6 +355,8 @@ class Runtime:
             return
         still_pending: List[TaskSpec] = []
         for spec in self.pending:
+            if spec.task_id not in self.specs:
+                continue  # completed elsewhere (e.g. stolen copy finished)
             spec.deps = {d for d in spec.deps
                          if not self._ready_locked(d.oid.binary)}
             target: Optional[_Worker] = None
@@ -395,6 +398,9 @@ class Runtime:
                 w.known_fns.add(spec.fn_id)
             self._send(w, ("task", spec.task_id, spec.fn_id,
                            spec.result_ref.oid.binary, blob))
+        if not w.inflight:
+            # head task starts now — an idle worker isn't "stalled"
+            w.last_progress = time.monotonic()
         w.inflight.append(spec.task_id)
 
     def _fail_task_locked(self, spec: TaskSpec, err: BaseException) -> None:

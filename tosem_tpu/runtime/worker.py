@@ -20,8 +20,7 @@ import traceback
 from typing import Any, Dict, Optional
 
 from tosem_tpu.runtime import common
-from tosem_tpu.runtime.object_store import (ObjectID, ObjectStore,
-                                            ObjectStoreError)
+from tosem_tpu.runtime.object_store import ObjectID, ObjectStore
 
 
 def _attach(store_name: str, store_box: list) -> ObjectStore:
@@ -47,15 +46,10 @@ def _send_result(conn, store_name: str, store_box: list, tid: bytes,
     kind, parts = common.dumps_parts(value)
     if common.parts_nbytes(parts) > common.INLINE_THRESHOLD:
         store = _attach(store_name, store_box)
-        try:
-            common.store_put_parts(store, ObjectID(result_binary), kind,
-                                   parts)
-        except ObjectStoreError as e:
-            # A retried task whose first attempt stored its result before
-            # dying: the deterministic result id already exists — that IS
-            # success (objects are immutable).
-            if e.code != -1:
-                raise
+        # retry-safe: an earlier attempt of this task may have stored (or
+        # died mid-storing) the same deterministic result id
+        common.robust_store_put_parts(store, ObjectID(result_binary), kind,
+                                      parts)
         conn.send(("done", tid, "store", result_binary))
     else:
         conn.send(("done", tid, "inline",

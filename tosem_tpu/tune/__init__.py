@@ -1,0 +1,28 @@
+"""HPO layer — trial runner, schedulers, search algorithms (Tune/NNI-lite).
+
+TPU-first re-design of the reference's hyperparameter-optimization surface
+(SURVEY §2.1 Ray Tune, §2.4 NNI HPO): trials are actors on the
+:mod:`tosem_tpu.runtime`; schedulers (ASHA, median stopping, PBT) and search
+algorithms (random, grid, TPE-style, evolution) drive them; failed trials
+recover from checkpoints (§5.3 elastic-recovery pattern — checkpoint-restart
+shaped, since TPU slices can't hot-resize).
+"""
+from tosem_tpu.tune.schedulers import (ASHAScheduler, FIFOScheduler,
+                                       MedianStoppingRule, PBTScheduler,
+                                       TrialScheduler)
+from tosem_tpu.tune.search import (Choice, Domain, EvolutionSearch,
+                                   GridSearch, LogUniform, RandInt,
+                                   RandomSearch, SearchAlgorithm, TPESearch,
+                                   Uniform, choice, grid_search, loguniform,
+                                   randint, uniform)
+from tosem_tpu.tune.tune import Analysis, Trainable, Trial, run
+
+__all__ = [
+    "run", "Analysis", "Trainable", "Trial",
+    "TrialScheduler", "FIFOScheduler", "ASHAScheduler", "MedianStoppingRule",
+    "PBTScheduler",
+    "SearchAlgorithm", "RandomSearch", "GridSearch", "TPESearch",
+    "EvolutionSearch",
+    "uniform", "loguniform", "randint", "choice", "grid_search",
+    "Domain", "Uniform", "LogUniform", "RandInt", "Choice",
+]

@@ -1,0 +1,155 @@
+"""Trial schedulers: FIFO, ASHA, median stopping, PBT.
+
+The reference's scheduler surface (SURVEY §2.1: ``python/ray/tune/
+schedulers/`` — ASHA/HyperBand/PBT; §2.4: NNI ``medianstop_assessor.py``,
+``pbt_tuner.py``). A scheduler sees every reported result and decides
+CONTINUE/STOP; PBT additionally issues exploit directives (clone a better
+trial's checkpoint, perturb its config) which the trial runner executes via
+the Trainable save/restore contract.
+"""
+from __future__ import annotations
+
+import math
+import random
+from collections import defaultdict
+from typing import Any, Dict, List, Optional
+
+CONTINUE = "continue"
+STOP = "stop"
+
+
+class TrialScheduler:
+    def set_mode(self, metric: str, mode: str) -> None:
+        self.metric = metric
+        self.mode = mode
+
+    def _score(self, result: Dict[str, Any]) -> float:
+        v = float(result[self.metric])
+        return -v if self.mode == "min" else v
+
+    def on_result(self, trial_id: str, iteration: int,
+                  result: Dict[str, Any]) -> str:
+        return CONTINUE
+
+    def on_complete(self, trial_id: str) -> None:
+        pass
+
+    def exploit_directive(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        """PBT hook: non-None → {'donor': id, 'config': new_config}."""
+        return None
+
+
+class FIFOScheduler(TrialScheduler):
+    pass
+
+
+class ASHAScheduler(TrialScheduler):
+    """Asynchronous successive halving (Tune `ASHAScheduler` role).
+
+    Rungs at ``grace_period * reduction_factor**k``; when a trial reaches a
+    rung it is stopped unless its score is in the top ``1/reduction_factor``
+    of everything recorded at that rung so far.
+    """
+
+    def __init__(self, max_t: int = 100, grace_period: int = 1,
+                 reduction_factor: int = 3):
+        self.max_t = max_t
+        self.grace = grace_period
+        self.rf = reduction_factor
+        self.rungs: List[int] = []
+        t = grace_period
+        while t < max_t:
+            self.rungs.append(t)
+            t *= reduction_factor
+        self.rung_scores: Dict[int, List[float]] = defaultdict(list)
+
+    def on_result(self, trial_id, iteration, result):
+        s = self._score(result)
+        if iteration >= self.max_t:
+            return STOP
+        if iteration in self.rung_scores or iteration in self.rungs:
+            pass
+        if iteration not in self.rungs:
+            return CONTINUE
+        scores = self.rung_scores[iteration]
+        scores.append(s)
+        k = max(1, int(math.ceil(len(scores) / self.rf)))
+        cutoff = sorted(scores, reverse=True)[k - 1]
+        return CONTINUE if s >= cutoff else STOP
+
+
+class MedianStoppingRule(TrialScheduler):
+    """Stop a trial whose running-average score at step t is below the median
+    of other trials' running averages at t (NNI medianstop_assessor role)."""
+
+    def __init__(self, grace_period: int = 5, min_samples: int = 3):
+        self.grace = grace_period
+        self.min_samples = min_samples
+        self.avg: Dict[str, List[float]] = defaultdict(list)  # running sums
+
+    def on_result(self, trial_id, iteration, result):
+        s = self._score(result)
+        hist = self.avg[trial_id]
+        hist.append(s)
+        if iteration < self.grace:
+            return CONTINUE
+        my_avg = sum(hist) / len(hist)
+        others = [sum(h[:len(hist)]) / min(len(h), len(hist))
+                  for tid, h in self.avg.items()
+                  if tid != trial_id and len(h) >= len(hist)]
+        if len(others) < self.min_samples:
+            return CONTINUE
+        others_sorted = sorted(others)
+        median = others_sorted[len(others_sorted) // 2]
+        return STOP if my_avg < median else CONTINUE
+
+
+class PBTScheduler(TrialScheduler):
+    """Population-based training (Tune ``pbt.py`` / NNI ``pbt_tuner.py``).
+
+    Every ``perturbation_interval`` iterations, a trial in the bottom
+    quantile exploits one in the top quantile: the runner clones the donor's
+    checkpoint and perturbs the config (×0.8 / ×1.25 or resample).
+    """
+
+    def __init__(self, hyperparam_mutations: Dict[str, Any],
+                 perturbation_interval: int = 5,
+                 quantile_fraction: float = 0.25,
+                 seed: Optional[int] = None):
+        self.mutations = hyperparam_mutations
+        self.interval = perturbation_interval
+        self.quantile = quantile_fraction
+        self.rng = random.Random(seed)
+        self.latest: Dict[str, float] = {}
+        self.configs: Dict[str, Dict[str, Any]] = {}
+        self.last_perturb: Dict[str, int] = defaultdict(int)
+
+    def register_config(self, trial_id: str, config: Dict[str, Any]) -> None:
+        self.configs[trial_id] = dict(config)
+
+    def on_result(self, trial_id, iteration, result):
+        self.latest[trial_id] = self._score(result)
+        return CONTINUE
+
+    def exploit_directive(self, trial_id):
+        if trial_id not in self.latest or len(self.latest) < 4:
+            return None
+        ranked = sorted(self.latest, key=self.latest.get, reverse=True)
+        k = max(1, int(len(ranked) * self.quantile))
+        bottom = set(ranked[-k:])
+        if trial_id not in bottom:
+            return None
+        donor = self.rng.choice(ranked[:k])
+        if donor == trial_id:
+            return None
+        new_cfg = dict(self.configs.get(donor, {}))
+        for key, spec in self.mutations.items():
+            if isinstance(spec, list):
+                new_cfg[key] = self.rng.choice(spec)
+            elif callable(spec):
+                new_cfg[key] = spec()
+            elif key in new_cfg:
+                factor = self.rng.choice([0.8, 1.25])
+                new_cfg[key] = new_cfg[key] * factor
+        self.configs[trial_id] = new_cfg
+        return {"donor": donor, "config": new_cfg}

@@ -1,0 +1,312 @@
+"""Search spaces and suggestion algorithms for the HPO layer.
+
+Covers the reference's search-algorithm surface (SURVEY §2.1 Ray Tune
+``suggest/``, §2.4 NNI ``nni/algorithms/hpo/``): sampling domains
+(``tune.uniform/loguniform/choice/randint/grid_search``), random and grid
+search, a TPE-style density-ratio suggester (the hyperopt_tuner.py role), and
+a μ+λ evolutionary suggester (evolution_tuner.py / TPOT's eaMuPlusLambda
+role). All numpy-only, deterministic under seed.
+"""
+from __future__ import annotations
+
+import itertools
+import math
+import random
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+# ----------------------------------------------------------------- domains
+
+class Domain:
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+    # numeric domains support vectorized density fitting for TPE
+    def to_unit(self, v) -> Optional[float]:
+        return None
+
+    def from_unit(self, u: float):
+        raise NotImplementedError
+
+
+class Uniform(Domain):
+    def __init__(self, low: float, high: float):
+        self.low, self.high = float(low), float(high)
+
+    def sample(self, rng):
+        return rng.uniform(self.low, self.high)
+
+    def to_unit(self, v):
+        return (v - self.low) / (self.high - self.low)
+
+    def from_unit(self, u):
+        return self.low + u * (self.high - self.low)
+
+
+class LogUniform(Domain):
+    def __init__(self, low: float, high: float):
+        if low <= 0:
+            raise ValueError("loguniform needs low > 0")
+        self.low, self.high = float(low), float(high)
+
+    def sample(self, rng):
+        return math.exp(rng.uniform(math.log(self.low), math.log(self.high)))
+
+    def to_unit(self, v):
+        return (math.log(v) - math.log(self.low)) / (
+            math.log(self.high) - math.log(self.low))
+
+    def from_unit(self, u):
+        return math.exp(math.log(self.low) +
+                        u * (math.log(self.high) - math.log(self.low)))
+
+
+class RandInt(Domain):
+    def __init__(self, low: int, high: int):  # [low, high)
+        self.low, self.high = int(low), int(high)
+
+    def sample(self, rng):
+        return rng.randrange(self.low, self.high)
+
+    def to_unit(self, v):
+        return (v - self.low) / max(1, self.high - 1 - self.low)
+
+    def from_unit(self, u):
+        return int(round(self.low + u * (self.high - 1 - self.low)))
+
+
+class Choice(Domain):
+    def __init__(self, values: Sequence[Any]):
+        self.values = list(values)
+
+    def sample(self, rng):
+        return rng.choice(self.values)
+
+
+class GridValues:
+    """Marker for exhaustive expansion (``tune.grid_search([...])``)."""
+
+    def __init__(self, values: Sequence[Any]):
+        self.values = list(values)
+
+
+def uniform(low, high) -> Uniform:
+    return Uniform(low, high)
+
+
+def loguniform(low, high) -> LogUniform:
+    return LogUniform(low, high)
+
+
+def randint(low, high) -> RandInt:
+    return RandInt(low, high)
+
+
+def choice(values) -> Choice:
+    return Choice(values)
+
+
+def grid_search(values) -> GridValues:
+    return GridValues(values)
+
+
+def sample_config(space: Dict[str, Any], rng: random.Random) -> Dict[str, Any]:
+    out = {}
+    for k, v in space.items():
+        if isinstance(v, Domain):
+            out[k] = v.sample(rng)
+        elif isinstance(v, GridValues):
+            out[k] = rng.choice(v.values)
+        else:
+            out[k] = v
+    return out
+
+
+# ------------------------------------------------------------- suggesters
+
+class SearchAlgorithm:
+    """Suggest trial configs; observe (config, score) to adapt."""
+
+    def set_space(self, space: Dict[str, Any], mode: str) -> None:
+        self.space = space
+        self.mode = mode  # "min" | "max"
+
+    def suggest(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def observe(self, config: Dict[str, Any], score: float) -> None:
+        pass
+
+
+class RandomSearch(SearchAlgorithm):
+    def __init__(self, seed: Optional[int] = None):
+        self.rng = random.Random(seed)
+
+    def suggest(self):
+        return sample_config(self.space, self.rng)
+
+
+class GridSearch(SearchAlgorithm):
+    """Cross-product over ``grid_search`` entries; non-grid Domains sampled."""
+
+    def __init__(self, seed: Optional[int] = None):
+        self.rng = random.Random(seed)
+        self._iter = None
+
+    def set_space(self, space, mode):
+        super().set_space(space, mode)
+        grids = {k: v.values for k, v in space.items()
+                 if isinstance(v, GridValues)}
+        keys = list(grids)
+        combos = itertools.product(*[grids[k] for k in keys]) if keys else [()]
+        self._iter = itertools.cycle([dict(zip(keys, c)) for c in combos])
+
+    def grid_size(self) -> int:
+        n = 1
+        for v in self.space.values():
+            if isinstance(v, GridValues):
+                n *= len(v.values)
+        return n
+
+    def suggest(self):
+        fixed = next(self._iter)
+        cfg = sample_config(
+            {k: v for k, v in self.space.items()
+             if not isinstance(v, GridValues)}, self.rng)
+        cfg.update(fixed)
+        return cfg
+
+
+class TPESearch(SearchAlgorithm):
+    """Tree-of-Parzen-Estimators-style suggester (hyperopt_tuner.py role).
+
+    Splits observations at the ``gamma`` quantile into good/bad sets, fits a
+    per-dimension Parzen (Gaussian-kernel) density to each in unit space, and
+    suggests the candidate maximizing good/bad density ratio. Categorical
+    dims use smoothed empirical frequencies.
+    """
+
+    def __init__(self, seed: Optional[int] = None, n_startup: int = 10,
+                 n_candidates: int = 24, gamma: float = 0.25):
+        self.rng = random.Random(seed)
+        self.np_rng = np.random.default_rng(seed)
+        self.n_startup = n_startup
+        self.n_candidates = n_candidates
+        self.gamma = gamma
+        self.obs: List[Tuple[Dict[str, Any], float]] = []
+
+    def observe(self, config, score):
+        self.obs.append((config, score))
+
+    def _split(self):
+        scores = np.array([s for _, s in self.obs], dtype=float)
+        if self.mode == "max":
+            scores = -scores
+        k = max(1, int(math.ceil(self.gamma * len(scores))))
+        order = np.argsort(scores)
+        good = [self.obs[i][0] for i in order[:k]]
+        bad = [self.obs[i][0] for i in order[k:]] or good
+        return good, bad
+
+    @staticmethod
+    def _parzen_logpdf(x: float, samples: np.ndarray) -> float:
+        if len(samples) == 0:
+            return 0.0
+        bw = max(1.0 / (1 + len(samples)), samples.std() + 1e-3)
+        z = (x - samples) / bw
+        return float(np.log(np.mean(np.exp(-0.5 * z * z) /
+                                    (bw * np.sqrt(2 * np.pi))) + 1e-12))
+
+    def suggest(self):
+        if len(self.obs) < self.n_startup:
+            return sample_config(self.space, self.rng)
+        good, bad = self._split()
+        best_cfg, best_ratio = None, -np.inf
+        for _ in range(self.n_candidates):
+            cfg = {}
+            ratio = 0.0
+            for key, dom in self.space.items():
+                if isinstance(dom, Domain) and dom.to_unit(
+                        good[0].get(key, None) if good else None) is not None:
+                    g = np.array([dom.to_unit(c[key]) for c in good])
+                    b = np.array([dom.to_unit(c[key]) for c in bad])
+                    # sample around a good observation (Parzen draw)
+                    center = float(self.np_rng.choice(g))
+                    bw = max(1.0 / (1 + len(g)), g.std() + 1e-3)
+                    u = float(np.clip(self.np_rng.normal(center, bw), 0, 1))
+                    cfg[key] = dom.from_unit(u)
+                    ratio += (self._parzen_logpdf(u, g) -
+                              self._parzen_logpdf(u, b))
+                elif isinstance(dom, (Choice, GridValues)):
+                    values = (dom.values if isinstance(dom, Choice)
+                              else dom.values)
+                    gc = [c[key] for c in good]
+                    bc = [c[key] for c in bad]
+                    # smoothed empirical frequencies
+                    def freq(v, obs_list):
+                        return (obs_list.count(v) + 1.0) / (
+                            len(obs_list) + len(values))
+                    weights = [freq(v, gc) for v in values]
+                    total = sum(weights)
+                    r = self.np_rng.random() * total
+                    acc = 0.0
+                    pick = values[-1]
+                    for v, w in zip(values, weights):
+                        acc += w
+                        if r <= acc:
+                            pick = v
+                            break
+                    cfg[key] = pick
+                    ratio += math.log(freq(pick, gc) / freq(pick, bc))
+                elif isinstance(dom, Domain):
+                    cfg[key] = dom.sample(self.rng)
+                else:
+                    cfg[key] = dom
+            if ratio > best_ratio:
+                best_ratio, best_cfg = ratio, cfg
+        return best_cfg
+
+
+class EvolutionSearch(SearchAlgorithm):
+    """μ+λ evolutionary suggester (NNI evolution_tuner / TPOT GP loop role):
+    parents = top half of observed; children = crossover + per-key mutation."""
+
+    def __init__(self, seed: Optional[int] = None, population: int = 10,
+                 mutation_prob: float = 0.3):
+        self.rng = random.Random(seed)
+        self.population = population
+        self.mutation_prob = mutation_prob
+        self.obs: List[Tuple[Dict[str, Any], float]] = []
+
+    def observe(self, config, score):
+        self.obs.append((config, score))
+
+    def suggest(self):
+        if len(self.obs) < self.population:
+            return sample_config(self.space, self.rng)
+        ranked = sorted(self.obs, key=lambda cs: cs[1],
+                        reverse=(self.mode == "max"))
+        parents = [c for c, _ in ranked[:max(2, len(ranked) // 2)]]
+        a, b = self.rng.sample(parents, 2)
+        child = {}
+        for k in self.space:
+            child[k] = (a if self.rng.random() < 0.5 else b).get(k)
+            if self.rng.random() < self.mutation_prob:
+                dom = self.space[k]
+                if isinstance(dom, Domain) and \
+                        dom.to_unit(child[k]) is not None:
+                    # local gaussian step in unit space (with a 20% chance
+                    # of a full resample to keep exploring)
+                    if self.rng.random() < 0.2:
+                        child[k] = dom.sample(self.rng)
+                    else:
+                        u = dom.to_unit(child[k])
+                        u = min(1.0, max(0.0, self.rng.gauss(u, 0.08)))
+                        child[k] = dom.from_unit(u)
+                elif isinstance(dom, Domain):
+                    child[k] = dom.sample(self.rng)
+                elif isinstance(dom, GridValues):
+                    child[k] = self.rng.choice(dom.values)
+        return child
