@@ -139,6 +139,29 @@ class TestRun:
         assert all(t.iteration < 30 for t in analysis.trials)
 
 
+class TestAdaptivity:
+    def test_suggester_sees_results_of_earlier_trials(self, runtime):
+        # trials must be created lazily: later suggest() calls observe
+        # earlier results (otherwise TPE/evolution degrade to random)
+        seen = []
+
+        class Spy(tune.RandomSearch):
+            def suggest(self):
+                seen.append(len(self.obs) if hasattr(self, "obs") else
+                            len(getattr(self, "_observed", [])))
+                return super().suggest()
+
+            def observe(self, config, score):
+                self._observed = getattr(self, "_observed", []) + [score]
+
+        analysis = tune.run(quadratic, {"x": tune.uniform(-10, 10)},
+                            metric="loss", mode="min", num_samples=8,
+                            max_iterations=3, max_concurrent=2,
+                            search_alg=Spy(seed=0))
+        assert len(analysis.trials) == 8
+        assert seen[-1] > 0     # last suggestion saw earlier observations
+
+
 class _CountingTrainable(tune.Trainable):
     """Class trainable with real state: counts steps, supports save/load."""
 

@@ -1,0 +1,124 @@
+"""Audio feature pipeline: MFCC extraction + augmentation.
+
+The reference's data plumbing (``training/deepspeech_training/util/
+feeding.py:54`` ``samples_to_mfccs`` via tf.signal, ``util/
+augmentations.py``) re-designed for TPU: the whole featurizer is pure
+``jnp`` — framing as a strided gather, ``jnp.fft.rfft``, a precomputed mel
+filterbank matmul, and a DCT-II matmul — so it jits into the training step
+and runs on-device (no host featurization bottleneck feeding the chip).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _hz_to_mel(f):
+    return 2595.0 * np.log10(1.0 + f / 700.0)
+
+
+def _mel_to_hz(m):
+    return 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+
+
+@functools.lru_cache(maxsize=8)
+def mel_filterbank(n_filters: int, n_fft: int, sample_rate: int,
+                   f_min: float = 20.0,
+                   f_max: Optional[float] = None) -> np.ndarray:
+    """[n_fft//2+1, n_filters] triangular mel filter matrix (host-built
+    once, closed over as a constant by jit)."""
+    f_max = f_max or sample_rate / 2.0
+    mels = np.linspace(_hz_to_mel(f_min), _hz_to_mel(f_max), n_filters + 2)
+    hz = _mel_to_hz(mels)
+    bins = np.floor((n_fft + 1) * hz / sample_rate).astype(int)
+    fb = np.zeros((n_fft // 2 + 1, n_filters), dtype=np.float32)
+    for i in range(n_filters):
+        lo, mid, hi = bins[i], bins[i + 1], bins[i + 2]
+        for j in range(lo, mid):
+            if mid > lo:
+                fb[j, i] = (j - lo) / (mid - lo)
+        for j in range(mid, hi):
+            if hi > mid:
+                fb[j, i] = (hi - j) / (hi - mid)
+    return fb
+
+
+@functools.lru_cache(maxsize=8)
+def dct_matrix(n_out: int, n_in: int) -> np.ndarray:
+    """Orthonormal DCT-II matrix [n_in, n_out]."""
+    k = np.arange(n_out)[None, :]
+    n = np.arange(n_in)[:, None]
+    m = np.cos(np.pi * k * (2 * n + 1) / (2 * n_in))
+    m *= np.sqrt(2.0 / n_in)
+    m[:, 0] *= np.sqrt(0.5)
+    return m.astype(np.float32)
+
+
+def frame_signal(audio: jax.Array, frame_length: int,
+                 frame_step: int) -> jax.Array:
+    """[B, N] → [B, T, frame_length] overlapping frames (strided gather)."""
+    n = audio.shape[-1]
+    T = max(1 + (n - frame_length) // frame_step, 0)
+    idx = (jnp.arange(T)[:, None] * frame_step +
+           jnp.arange(frame_length)[None, :])
+    return audio[..., idx]
+
+
+def mfcc(audio: jax.Array, *, sample_rate: int = 16000, n_mfcc: int = 26,
+         n_filters: int = 40, frame_length_ms: float = 25.0,
+         frame_step_ms: float = 10.0, pre_emphasis: float = 0.97
+         ) -> jax.Array:
+    """[B, N] PCM → [B, T, n_mfcc] MFCC features; jit/TPU friendly."""
+    fl = int(sample_rate * frame_length_ms / 1000)
+    fs = int(sample_rate * frame_step_ms / 1000)
+    n_fft = int(2 ** np.ceil(np.log2(fl)))
+    emphasized = jnp.concatenate(
+        [audio[..., :1], audio[..., 1:] - pre_emphasis * audio[..., :-1]],
+        axis=-1)
+    frames = frame_signal(emphasized, fl, fs)                # [B, T, fl]
+    window = jnp.asarray(np.hamming(fl).astype(np.float32))
+    spec = jnp.fft.rfft(frames * window, n=n_fft, axis=-1)
+    power = (jnp.abs(spec) ** 2) / n_fft                     # [B, T, F]
+    fb = jnp.asarray(mel_filterbank(n_filters, n_fft, sample_rate))
+    mel = jnp.log(power @ fb + 1e-8)                         # [B, T, M]
+    dct = jnp.asarray(dct_matrix(n_mfcc, n_filters))
+    return mel @ dct                                         # [B, T, C]
+
+
+def spec_augment(feats: jax.Array, rng: jax.Array, *,
+                 time_masks: int = 2, time_width: int = 10,
+                 freq_masks: int = 2, freq_width: int = 4) -> jax.Array:
+    """SpecAugment-style time/frequency masking (util/augmentations.py
+    role), fully vectorized so it lives inside the jitted train step."""
+    B, T, F = feats.shape
+    keys = jax.random.split(rng, 4)
+
+    def mask_axis(x, key, n_masks, width, axis_len, axis):
+        starts = jax.random.randint(key, (B, n_masks), 0,
+                                    max(axis_len - width, 1))
+        pos = jnp.arange(axis_len)
+        # [B, n_masks, axis_len] → any-mask-covers
+        cover = ((pos[None, None, :] >= starts[..., None]) &
+                 (pos[None, None, :] < starts[..., None] + width)).any(1)
+        shape = [B, 1, 1]
+        shape[axis] = axis_len
+        return x * (~cover).astype(x.dtype).reshape(shape)
+
+    feats = mask_axis(feats, keys[0], time_masks, time_width, T, 1)
+    feats = mask_axis(feats, keys[1], freq_masks, freq_width, F, 2)
+    return feats
+
+
+ALPHABET = "abcdefghijklmnopqrstuvwxyz '"
+
+
+def text_to_labels(text: str, alphabet: str = ALPHABET) -> list:
+    return [alphabet.index(ch) for ch in text.lower() if ch in alphabet]
+
+
+def labels_to_text(labels, alphabet: str = ALPHABET) -> str:
+    return "".join(alphabet[i] for i in labels if 0 <= i < len(alphabet))

@@ -1,0 +1,230 @@
+"""Streaming speech-to-text model (the DeepSpeech-family member).
+
+Architecture follows the reference's acoustic model
+(``training/deepspeech_training/train.py:163`` ``create_model``): per-frame
+context windows over MFCC features → three clipped-ReLU dense layers with
+dropout → a unidirectional LSTM → dense → CTC logits (vocab + blank). The
+TPU re-design replaces the three RNN backends (``train.py:98,113,140``
+LSTMBlockFused / CudnnLSTM / static-for-streaming) with ONE ``lax.scan``
+LSTM that serves both training (time-major, jit-compiled, bf16-friendly)
+and streaming inference — the scan carry IS the streaming state, so there
+is no cudnn→cpu checkpoint conversion step (``util/checkpoints.py:126``,
+``util/flags.py:67`` in the reference).
+
+Streaming: :meth:`SpeechModel.streaming_init` / :meth:`streaming_step` hold
+(frame buffer, LSTM carry) exactly like the native client's
+``StreamingState`` (``native_client/deepspeech.cc:66``) buffers audio and
+threads RNN state between windows.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tosem_tpu.nn.core import Module, Variables, variables
+from tosem_tpu.nn.layers import Dense
+
+
+@dataclass
+class SpeechConfig:
+    n_input: int = 26          # MFCC coefficients per frame
+    n_context: int = 9         # frames of context each side (window = 19)
+    n_hidden: int = 2048       # dense width (reference n_hidden)
+    n_cell: int = 2048         # LSTM cells
+    vocab_size: int = 28       # a–z, space, apostrophe (reference alphabet)
+    relu_clip: float = 20.0    # train.py clipped_relu bound
+    dropout: float = 0.05
+
+    @classmethod
+    def tiny(cls) -> "SpeechConfig":
+        return cls(n_input=13, n_context=2, n_hidden=64, n_cell=64,
+                   vocab_size=12)
+
+    @property
+    def blank(self) -> int:
+        return self.vocab_size  # CTC blank appended after the alphabet
+
+    @property
+    def n_classes(self) -> int:
+        return self.vocab_size + 1
+
+    @property
+    def window(self) -> int:
+        return 2 * self.n_context + 1
+
+
+def context_windows(x: jax.Array, n_context: int) -> jax.Array:
+    """[B, T, F] → [B, T, (2c+1)*F] overlapping windows, zero-padded edges
+    (the ``create_overlapping_windows`` conv trick in train.py, done as a
+    gather that XLA fuses instead of a conv with an identity kernel)."""
+    B, T, F = x.shape
+    c = n_context
+    padded = jnp.pad(x, ((0, 0), (c, c), (0, 0)))
+    idx = jnp.arange(T)[:, None] + jnp.arange(2 * c + 1)[None, :]  # [T, W]
+    win = padded[:, idx, :]                                   # [B, T, W, F]
+    return win.reshape(B, T, (2 * c + 1) * F)
+
+
+class LSTM(Module):
+    """Unidirectional LSTM as a ``lax.scan`` (time-major inside)."""
+
+    def __init__(self, in_dim: int, n_cell: int):
+        self.in_dim = in_dim
+        self.n_cell = n_cell
+
+    def init(self, key) -> Variables:
+        k1, k2 = jax.random.split(key)
+        scale_i = 1.0 / jnp.sqrt(self.in_dim)
+        scale_h = 1.0 / jnp.sqrt(self.n_cell)
+        bias = jnp.zeros((4 * self.n_cell,))
+        # forget-gate bias 1.0 (standard; keeps early training stable)
+        bias = bias.at[self.n_cell:2 * self.n_cell].set(1.0)
+        return variables({
+            "wi": jax.random.uniform(k1, (self.in_dim, 4 * self.n_cell),
+                                     minval=-scale_i, maxval=scale_i),
+            "wh": jax.random.uniform(k2, (self.n_cell, 4 * self.n_cell),
+                                     minval=-scale_h, maxval=scale_h),
+            "b": bias,
+        })
+
+    def initial_carry(self, batch: int, dtype=jnp.float32):
+        return (jnp.zeros((batch, self.n_cell), dtype),
+                jnp.zeros((batch, self.n_cell), dtype))
+
+    def cell(self, p, carry, xt):
+        h, c = carry
+        z = xt @ p["wi"] + h @ p["wh"] + p["b"]
+        i, f, g, o = jnp.split(z, 4, axis=-1)
+        c = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+        h = jax.nn.sigmoid(o) * jnp.tanh(c)
+        return (h, c), h
+
+    def apply(self, vs, x, *, carry=None, train=False, rng=None):
+        """x: [B, T, D] → ([B, T, n_cell], final_carry) — note: returns the
+        carry (not module state) as the second element; callers thread it."""
+        p = vs["params"]
+        B = x.shape[0]
+        if carry is None:
+            carry = self.initial_carry(B, x.dtype)
+        xs = jnp.swapaxes(x, 0, 1)                            # [T, B, D]
+        carry, hs = lax.scan(lambda c, xt: self.cell(p, c, xt), carry, xs)
+        return jnp.swapaxes(hs, 0, 1), carry
+
+
+class SpeechModel(Module):
+    """create_model (train.py:163) as a functional module."""
+
+    def __init__(self, cfg: SpeechConfig):
+        self.cfg = cfg
+        c = cfg
+        self.d1 = Dense(c.window * c.n_input, c.n_hidden)
+        self.d2 = Dense(c.n_hidden, c.n_hidden)
+        self.d3 = Dense(c.n_hidden, c.n_hidden)
+        self.lstm = LSTM(c.n_hidden, c.n_cell)
+        self.d5 = Dense(c.n_cell, c.n_hidden)
+        self.out = Dense(c.n_hidden, c.n_classes)
+
+    def init(self, key) -> Variables:
+        ks = jax.random.split(key, 6)
+        names = ["d1", "d2", "d3", "lstm", "d5", "out"]
+        mods = [self.d1, self.d2, self.d3, self.lstm, self.d5, self.out]
+        return variables({n: m.init(k)["params"]
+                          for n, m, k in zip(names, mods, ks)})
+
+    def _clip_relu(self, x):
+        return jnp.minimum(jax.nn.relu(x), self.cfg.relu_clip)
+
+    def _dense_stack(self, p, x, train, rng):
+        drop = self.cfg.dropout if train else 0.0
+        keys = (jax.random.split(rng, 3) if rng is not None else [None] * 3)
+        for name, key in zip(("d1", "d2", "d3"), keys):
+            x = self._clip_relu(
+                x @ p[name]["w"] + p[name]["b"])
+            if drop > 0 and key is not None:
+                keep = jax.random.bernoulli(key, 1 - drop, x.shape)
+                x = jnp.where(keep, x / (1 - drop), 0.0)
+        return x
+
+    def apply(self, vs, feats, *, carry=None, train=False, rng=None):
+        """feats: [B, T, n_input] MFCC → (logits [B, T, n_classes], carry)."""
+        p = vs["params"]
+        x = context_windows(feats, self.cfg.n_context)
+        x = self._dense_stack(p, x, train, rng)
+        x, carry = self.lstm.apply(variables(p["lstm"]), x, carry=carry)
+        x = self._clip_relu(x @ p["d5"]["w"] + p["d5"]["b"])
+        logits = x @ p["out"]["w"] + p["out"]["b"]
+        return logits, carry
+
+    # ------------------------------------------------------------ streaming
+
+    def streaming_init(self, batch: int = 1) -> Tuple[Any, jax.Array]:
+        """StreamingState analog: (LSTM carry, frame buffer).
+
+        The buffer starts as the c zero frames of left context, so the LSTM
+        sees exactly the same window sequence as a full (zero-padded)
+        forward pass — the carries stay bit-identical between the two paths.
+        """
+        c = self.cfg.n_context
+        buf = jnp.zeros((batch, c, self.cfg.n_input))
+        return self.lstm.initial_carry(batch), buf
+
+    def streaming_step(self, vs, state, chunk: jax.Array):
+        """Feed frames [B, n, n_input]; emit logits for every frame whose
+        full ±c context is now known (output lags input by c frames; call
+        :meth:`streaming_flush` at end-of-stream for the tail, like the
+        native client finishing its window buffer).
+        """
+        carry, buf = state
+        c = self.cfg.n_context
+        seq = jnp.concatenate([buf, chunk], axis=1)
+        k = seq.shape[1] - 2 * c          # centers with full context
+        if k <= 0:
+            return (jnp.zeros((chunk.shape[0], 0, self.cfg.n_classes)),
+                    (carry, seq))
+        idx = jnp.arange(k)[:, None] + jnp.arange(2 * c + 1)[None, :]
+        win = seq[:, idx, :].reshape(seq.shape[0], k, -1)
+        p = vs["params"]
+        x = self._dense_stack(p, win, False, None)
+        x, carry = self.lstm.apply(variables(p["lstm"]), x, carry=carry)
+        x = self._clip_relu(x @ p["d5"]["w"] + p["d5"]["b"])
+        logits = x @ p["out"]["w"] + p["out"]["b"]
+        new_buf = seq[:, k:, :]           # the trailing 2c frames
+        return logits, (carry, new_buf)
+
+    def streaming_flush(self, vs, state):
+        """End-of-stream: feed c zero frames (the right zero-padding of the
+        full forward pass) to emit the last c logits."""
+        c = self.cfg.n_context
+        batch = state[1].shape[0]
+        zeros = jnp.zeros((batch, c, self.cfg.n_input))
+        return self.streaming_step(vs, state, zeros)
+
+
+# --------------------------------------------------------------- metrics
+
+def edit_distance(a, b) -> int:
+    """Levenshtein distance (host-side, for WER/CER eval)."""
+    if len(a) < len(b):
+        a, b = b, a
+    prev = list(range(len(b) + 1))
+    for i, ca in enumerate(a, 1):
+        cur = [i]
+        for j, cb in enumerate(b, 1):
+            cur.append(min(prev[j] + 1, cur[j - 1] + 1,
+                           prev[j - 1] + (ca != cb)))
+        prev = cur
+    return prev[-1]
+
+
+def wer(ref: str, hyp: str) -> float:
+    """Word error rate (evaluate.py / util/evaluate_tools.py role)."""
+    rw = ref.split()
+    return edit_distance(rw, hyp.split()) / max(1, len(rw))
+
+
+def cer(ref: str, hyp: str) -> float:
+    return edit_distance(list(ref), list(hyp)) / max(1, len(ref))

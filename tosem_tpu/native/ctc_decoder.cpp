@@ -1,0 +1,132 @@
+// CTC prefix beam search decoder (host-side native, like the reference's
+// `native_client/ctcdecode/ctc_beam_search_decoder.cpp` + `path_trie.cpp`).
+//
+// Decoding is control-flow heavy and TPU-hostile (SURVEY §7 hard parts:
+// "keep decode on host"), so — as in the reference — it lives in C++ behind
+// a C ABI. The algorithm is standard prefix beam search over per-frame
+// log-probabilities: each beam tracks (p_blank, p_non_blank) in log space;
+// an optional per-emission score bonus plays the role the KenLM scorer's
+// alpha/beta weights play in the reference (`scorer.cpp`), pluggable from
+// the Python side as a (vocab-sized) bias table.
+//
+// Input:  logp [T, V] row-major float32 (log-softmax already applied),
+//         blank index, beam width.
+// Output: best prefix labels + its log score.
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <vector>
+
+namespace {
+
+constexpr float kNegInf = -1e30f;
+
+inline float log_add(float a, float b) {
+  if (a <= kNegInf) return b;
+  if (b <= kNegInf) return a;
+  float m = a > b ? a : b;
+  return m + std::log1p(std::exp(-(std::fabs(a - b))));
+}
+
+struct Probs {
+  float pb;   // ends in blank
+  float pnb;  // ends in non-blank
+  Probs() : pb(kNegInf), pnb(kNegInf) {}
+  float total() const { return log_add(pb, pnb); }
+};
+
+using Prefix = std::vector<int32_t>;
+
+}  // namespace
+
+extern "C" {
+
+// Returns 0 on success. out_labels has room for max_out entries.
+int ctc_beam_decode(const float* logp, int32_t T, int32_t V, int32_t blank,
+                    int32_t beam_width, const float* bonus /* V or null */,
+                    int32_t* out_labels, int32_t* out_len, float* out_score,
+                    int32_t max_out) {
+  std::map<Prefix, Probs> beams;
+  Probs root;
+  root.pb = 0.0f;  // empty prefix, log P = 0
+  beams[Prefix()] = root;
+
+  for (int32_t t = 0; t < T; t++) {
+    const float* row = logp + (size_t)t * V;
+    std::map<Prefix, Probs> next;
+    for (const auto& kv : beams) {
+      const Prefix& prefix = kv.first;
+      const Probs& p = kv.second;
+      int32_t last = prefix.empty() ? -1 : prefix.back();
+      // 1) emit blank: prefix unchanged, ends-in-blank
+      {
+        Probs& q = next[prefix];
+        q.pb = log_add(q.pb, p.total() + row[blank]);
+      }
+      // 2) repeat last symbol: prefix unchanged, ends-non-blank
+      if (last >= 0) {
+        Probs& q = next[prefix];
+        q.pnb = log_add(q.pnb, p.pnb + row[last]);
+      }
+      // 3) extend with symbol s
+      for (int32_t s = 0; s < V; s++) {
+        if (s == blank) continue;
+        float ps = row[s] + (bonus ? bonus[s] : 0.0f);
+        Prefix ext = prefix;
+        ext.push_back(s);
+        Probs& q = next[ext];
+        if (s == last) {
+          // only the ends-in-blank mass extends into a repeated symbol
+          q.pnb = log_add(q.pnb, p.pb + ps);
+        } else {
+          q.pnb = log_add(q.pnb, p.total() + ps);
+        }
+      }
+    }
+    // prune to beam_width
+    if ((int32_t)next.size() > beam_width) {
+      std::vector<std::pair<float, const Prefix*>> scored;
+      scored.reserve(next.size());
+      for (const auto& kv : next)
+        scored.emplace_back(kv.second.total(), &kv.first);
+      std::nth_element(scored.begin(), scored.begin() + beam_width - 1,
+                       scored.end(),
+                       [](const auto& a, const auto& b) {
+                         return a.first > b.first;
+                       });
+      float cutoff = scored[beam_width - 1].first;
+      std::map<Prefix, Probs> pruned;
+      int32_t kept = 0;
+      for (const auto& kv : next) {
+        if (kv.second.total() >= cutoff && kept < beam_width) {
+          pruned.insert(kv);
+          kept++;
+        }
+      }
+      next.swap(pruned);
+    }
+    beams.swap(next);
+  }
+
+  const Prefix* best = nullptr;
+  float best_score = kNegInf;
+  for (const auto& kv : beams) {
+    float s = kv.second.total();
+    if (s > best_score) {
+      best_score = s;
+      best = &kv.first;
+    }
+  }
+  if (!best) return -1;
+  int32_t n = (int32_t)best->size();
+  if (n > max_out) n = max_out;
+  std::memcpy(out_labels, best->data(), n * sizeof(int32_t));
+  *out_len = n;
+  *out_score = best_score;
+  return 0;
+}
+
+}  // extern "C"

@@ -100,10 +100,11 @@ _P5 = 1     # parts = [pickle5 header, buffer0, buffer1, ...]
 def dumps_parts(value: Any):
     """→ (kind, [buffer-like parts]); no concatenation (no extra copies).
 
-    RAW covers exactly ``bytes`` so the round trip preserves type;
-    bytearray/ndarray ride protocol-5 out-of-band buffers instead.
+    RAW covers ``bytes`` (type-preserving) and ``memoryview`` (unpicklable
+    otherwise; comes back as bytes); bytearray/ndarray ride protocol-5
+    out-of-band buffers with their types intact.
     """
-    if isinstance(value, bytes):
+    if isinstance(value, (bytes, memoryview)):
         return _RAW, [value]
     buffers = []
     header = cloudpickle.dumps(value, protocol=5,
@@ -143,7 +144,11 @@ def robust_store_put_parts(store, oid, kind, parts) -> None:
     """
     from tosem_tpu.runtime.object_store import ObjectStoreError
     import time as _time
-    for _ in range(200):
+    # generous deadline scaled to object size: a live duplicate writer may
+    # legitimately need seconds to memcpy a huge object before sealing
+    nbytes = parts_nbytes(parts)
+    deadline = _time.monotonic() + 10.0 + nbytes / (100 << 20)
+    while True:
         try:
             store_put_parts(store, oid, kind, parts)
             return
@@ -155,10 +160,11 @@ def robust_store_put_parts(store, oid, kind, parts) -> None:
             return                       # earlier attempt completed
         if state is False:
             if not store.reclaim_orphan(oid):
-                _time.sleep(0.01)        # live duplicate mid-write: wait
+                _time.sleep(0.02)        # live duplicate mid-write: wait
         # state None: slot vanished between checks — retry the put
-    raise RuntimeError_(f"could not store result {oid!r}: slot stuck "
-                        f"mid-write")
+        if _time.monotonic() > deadline:
+            raise RuntimeError_(f"could not store result {oid!r}: slot "
+                                f"stuck mid-write")
 
 
 def store_get_value(store, oid):

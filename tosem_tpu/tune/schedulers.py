@@ -67,8 +67,6 @@ class ASHAScheduler(TrialScheduler):
         s = self._score(result)
         if iteration >= self.max_t:
             return STOP
-        if iteration in self.rung_scores or iteration in self.rungs:
-            pass
         if iteration not in self.rungs:
             return CONTINUE
         scores = self.rung_scores[iteration]

@@ -240,8 +240,7 @@ class TPESearch(SearchAlgorithm):
                     ratio += (self._parzen_logpdf(u, g) -
                               self._parzen_logpdf(u, b))
                 elif isinstance(dom, (Choice, GridValues)):
-                    values = (dom.values if isinstance(dom, Choice)
-                              else dom.values)
+                    values = dom.values
                     gc = [c[key] for c in good]
                     bc = [c[key] for c in bad]
                     # smoothed empirical frequencies

@@ -107,6 +107,7 @@ class Trial:
     iteration: int = 0
     last_result: Dict[str, Any] = field(default_factory=dict)
     best_score: float = float("-inf")
+    reported_iter: int = 0          # high-water mark fed to schedulers
     failures: int = 0
     handle: Any = None
     step_ref: Any = None
@@ -122,9 +123,10 @@ class Analysis:
     @property
     def best_trial(self) -> Trial:
         done = [t for t in self.trials if t.last_result]
-        key = lambda t: (t.best_score
-                         if t.best_score > float("-inf") else float("-inf"))
-        return max(done, key=key)
+        if not done:
+            raise RuntimeError("no trial produced a result (all errored "
+                               "before their first report)")
+        return max(done, key=lambda t: t.best_score)
 
     @property
     def best_config(self) -> Dict[str, Any]:
@@ -177,14 +179,21 @@ def run(trainable, config_space: Dict[str, Any], *, metric: str, mode: str,
         rt.init(num_workers=max_concurrent)
     actor_cls = rt.remote(_TrialActor)
 
-    trials = [Trial(trial_id=f"t{i:04d}", config=search_alg.suggest())
-              for i in range(num_samples)]
-    if isinstance(scheduler, PBTScheduler):
-        for t in trials:
-            scheduler.register_config(t.trial_id, t.config)
-    queue = list(trials)
+    # Trials are created LAZILY so adaptive suggesters (TPE, evolution) see
+    # the results of earlier trials before proposing later configs.
+    trials: List[Trial] = []
+    created = 0
     running: List[Trial] = []
     sign = -1.0 if mode == "min" else 1.0
+
+    def next_trial() -> Trial:
+        nonlocal created
+        t = Trial(trial_id=f"t{created:04d}", config=search_alg.suggest())
+        created += 1
+        trials.append(t)
+        if isinstance(scheduler, PBTScheduler):
+            scheduler.register_config(t.trial_id, t.config)
+        return t
 
     def launch(t: Trial, restore: bool = False):
         t.handle = actor_cls.remote(trainable_cls, t.config)
@@ -203,9 +212,9 @@ def run(trainable, config_space: Dict[str, Any], *, metric: str, mode: str,
         t.step_ref = None
         running.remove(t)
 
-    while queue or running:
-        while queue and len(running) < max_concurrent:
-            t = queue.pop(0)
+    while created < num_samples or running:
+        while created < num_samples and len(running) < max_concurrent:
+            t = next_trial()
             launch(t)
             running.append(t)
         refs = [t.step_ref for t in running]
@@ -243,6 +252,12 @@ def run(trainable, config_space: Dict[str, Any], *, metric: str, mode: str,
             t.last_result = result
             score = sign * float(result[metric])
             t.best_score = max(t.best_score, score)
+            if t.iteration <= t.reported_iter:
+                # replayed iteration after checkpoint-restore: don't feed
+                # schedulers/search twice (rung scores would be corrupted)
+                t.step_ref = t.handle.step.remote()
+                continue
+            t.reported_iter = t.iteration
             search_alg.observe(t.config, float(result[metric]))
             decision = scheduler.on_result(t.trial_id, t.iteration, result)
             if stop is not None and stop(result):
