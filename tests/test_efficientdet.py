@@ -1,0 +1,159 @@
+"""Tests for the detection family (EfficientNet + BiFPN + det heads).
+
+Reference testing model (SURVEY §4.6): colocated TF tests
+(``det_model_fn_test.py``, ``efficientdet_arch_test.py``) on tiny shapes +
+the ``--use_fake_data`` input-free pattern (``main.py:86``).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tosem_tpu.models.efficientdet import (BiFPNLayer, EfficientDet,
+                                           EfficientDetConfig, box_iou,
+                                           decode_boxes, detection_loss,
+                                           encode_boxes, generate_anchors,
+                                           nms_host, postprocess)
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = EfficientDetConfig.tiny()
+    model = EfficientDet(cfg)
+    vs = model.init(jax.random.PRNGKey(0))
+    anchors = generate_anchors(cfg)
+    return cfg, model, vs, anchors
+
+
+class TestArchitecture:
+    def test_output_shapes_match_anchors(self, tiny):
+        cfg, model, vs, anchors = tiny
+        (cls, box), _ = model.apply(vs, jnp.zeros((2, 64, 64, 3)))
+        assert cls.shape == (2, anchors.shape[0], cfg.num_classes)
+        assert box.shape == (2, anchors.shape[0], 4)
+
+    def test_initial_class_prior(self, tiny):
+        # focal-loss bias init → initial foreground prob ≈ 0.01
+        cfg, model, vs, anchors = tiny
+        (cls, _), _ = model.apply(vs, jnp.zeros((1, 64, 64, 3)))
+        p = float(jax.nn.sigmoid(cls).mean())
+        assert 0.003 < p < 0.05
+
+    def test_jit_forward(self, tiny):
+        cfg, model, vs, _ = tiny
+        f = jax.jit(lambda v, x: model.apply(v, x)[0])
+        cls, box = f(vs, jnp.zeros((1, 64, 64, 3)))
+        assert bool(jnp.all(jnp.isfinite(cls)))
+
+    def test_bifpn_fusion_weights_normalized(self):
+        layer = BiFPNLayer(3, 8)
+        vs = layer.init(jax.random.PRNGKey(0))
+        feats = [jnp.ones((1, 8 // (2 ** i), 8 // (2 ** i), 8))
+                 for i in range(3)]
+        out, _ = layer.apply(vs, feats)
+        assert [o.shape for o in out] == [f.shape for f in feats]
+        assert all(bool(jnp.all(jnp.isfinite(o))) for o in out)
+
+
+class TestBoxes:
+    def test_iou_known_values(self):
+        a = jnp.array([[0., 0., 2., 2.]])
+        b = jnp.array([[1., 1., 3., 3.], [0., 0., 2., 2.],
+                       [5., 5., 6., 6.]])
+        iou = np.asarray(box_iou(a, b))[0]
+        assert iou[0] == pytest.approx(1 / 7, abs=1e-5)
+        assert iou[1] == pytest.approx(1.0, abs=1e-5)
+        assert iou[2] == 0.0
+
+    def test_encode_decode_roundtrip(self, tiny):
+        _, _, _, anchors = tiny
+        an = jnp.asarray(anchors[:50])
+        gt = an + jnp.array([2.0, -3.0, 5.0, 1.0])    # shifted boxes
+        regs = encode_boxes(gt, an)
+        back = decode_boxes(regs, an)
+        np.testing.assert_allclose(np.asarray(back), np.asarray(gt),
+                                   rtol=1e-4, atol=1e-3)
+
+    def test_anchor_count_formula(self, tiny):
+        cfg, _, _, anchors = tiny
+        expect = sum(max(1, 64 // 2 ** lv) ** 2 * cfg.num_anchors
+                     for lv in cfg.levels)
+        assert anchors.shape[0] == expect
+
+    def test_nms_suppresses_overlaps(self):
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]],
+                         np.float32)
+        scores = np.array([0.9, 0.8, 0.7], np.float32)
+        keep = nms_host(boxes, scores, iou_thresh=0.5)
+        assert keep == [0, 2]
+
+
+class TestLoss:
+    def test_loss_finite_and_decomposes(self, tiny):
+        cfg, model, vs, anchors = tiny
+        (cls, box), _ = model.apply(vs, jnp.zeros((2, 64, 64, 3)))
+        gt_boxes = jnp.array([[[10., 10., 40., 40.]],
+                              [[5., 20., 30., 60.]]])
+        gt_cls = jnp.array([[1], [3]])
+        n_gt = jnp.array([1, 1])
+        out = detection_loss(cls, box, gt_boxes, gt_cls, n_gt,
+                             jnp.asarray(anchors), cfg)
+        assert np.isfinite(float(out["loss"]))
+        assert float(out["loss"]) == pytest.approx(
+            float(out["class_loss"]) + 50.0 * float(out["box_loss"]),
+            rel=1e-5)
+
+    def test_empty_image_only_background(self, tiny):
+        cfg, model, vs, anchors = tiny
+        (cls, box), _ = model.apply(vs, jnp.zeros((1, 64, 64, 3)))
+        gt_boxes = jnp.zeros((1, 1, 4))
+        out = detection_loss(cls, box, gt_boxes, jnp.zeros((1, 1), jnp.int32),
+                             jnp.array([0]), jnp.asarray(anchors), cfg)
+        assert float(out["box_loss"]) == pytest.approx(0.0, abs=1e-6)
+
+
+class TestTrainFakeData:
+    def test_tiny_overfit_single_box(self, tiny):
+        """--use_fake_data style end-to-end: overfit one image + one box
+        until the top detection localizes it."""
+        import optax
+        cfg, model, vs, anchors = tiny
+        rng = jax.random.PRNGKey(1)
+        img = jax.random.normal(rng, (1, 64, 64, 3))
+        target_box = jnp.array([[[12., 16., 44., 52.]]])
+        target_cls = jnp.array([[2]])
+        n_gt = jnp.array([1])
+        anchors_j = jnp.asarray(anchors)
+        opt = optax.adam(2e-3)
+        opt_state = opt.init(vs["params"])
+
+        @jax.jit
+        def step(params, state, opt_state):
+            def loss_fn(p):
+                (cls, box), ns = model.apply({"params": p, "state": state},
+                                             img, train=True)
+                out = detection_loss(cls, box, target_box, target_cls, n_gt,
+                                     anchors_j, cfg)
+                return out["loss"], ns
+            (loss, ns), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params)
+            upd, opt_state = opt.update(grads, opt_state)
+            return optax.apply_updates(params, upd), ns, opt_state, loss
+
+        params, state = vs["params"], vs["state"]
+        first = None
+        for i in range(120):
+            params, state, opt_state, loss = step(params, state, opt_state)
+            if first is None:
+                first = float(loss)
+        final = float(loss)
+        assert final < 0.5 * first
+        (cls, box), _ = model.apply({"params": params, "state": state}, img)
+        dets = postprocess(cls, box, anchors, score_thresh=0.1)
+        boxes, scores, classes = dets[0]
+        assert len(boxes) >= 1
+        iou = np.asarray(box_iou(jnp.asarray(boxes[:1]),
+                                 target_box[0]))[0, 0]
+        assert iou > 0.5
+        assert classes[0] == 2

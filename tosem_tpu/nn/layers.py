@@ -83,6 +83,30 @@ class Conv2D(Module):
         return y, vs["state"]
 
 
+class DepthwiseConv2D(Module):
+    """Per-channel (feature_group_count = C) conv, NHWC."""
+
+    def __init__(self, channels: int, kernel: Tuple[int, int],
+                 stride: int = 1, *, padding: str = "SAME",
+                 dtype=jnp.float32, precision: str = "default"):
+        self.channels = channels
+        self.kernel, self.stride, self.padding = kernel, stride, padding
+        self.dtype, self.precision = dtype, precision
+
+    def init(self, key) -> Variables:
+        kh, kw = self.kernel
+        w = _he_normal(key, (kh, kw, 1, self.channels), kh * kw, self.dtype)
+        return variables({"w": w})
+
+    def apply(self, vs, x, *, train=False, rng=None):
+        y = lax.conv_general_dilated(
+            x, vs["params"]["w"], window_strides=(self.stride, self.stride),
+            padding=self.padding, feature_group_count=self.channels,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            precision=PRECISION[self.precision])
+        return y, vs["state"]
+
+
 class BatchNorm(Module):
     """Batch normalization with moving-average inference stats.
 
