@@ -27,8 +27,7 @@ from tosem_tpu.nn.core import Module, Variables, variables
 from tosem_tpu.nn.layers import BatchNorm, Conv2D, DepthwiseConv2D
 
 
-def swish(x):
-    return x * jax.nn.sigmoid(x)
+swish = jax.nn.silu  # x·σ(x); XLA-fused primitive
 
 
 # ------------------------------------------------------------------ config
@@ -544,11 +543,16 @@ def assign_targets(gt_boxes: jax.Array, gt_classes: jax.Array,
     iou = jnp.where(valid[None, :], iou, -1.0)
     best_gt = jnp.argmax(iou, 1)                           # [A]
     best_iou = jnp.max(iou, 1)
+    # force-match each gt to its best anchor (guarantees ≥1 positive);
+    # the forced anchor's BOX target must follow the forced gt too, or the
+    # class and box heads receive contradictory supervision in crowds
+    best_anchor = jnp.argmax(iou, 0)                       # [G]
+    best_gt = best_gt.at[best_anchor].set(
+        jnp.where(valid, jnp.arange(G), best_gt[best_anchor]))
     cls = jnp.where(best_iou >= pos_iou, gt_classes[best_gt], -1)
     cls = jnp.where((best_iou >= neg_iou) & (best_iou < pos_iou), -2, cls)
-    # force-match each gt to its best anchor (guarantees ≥1 positive)
-    best_anchor = jnp.argmax(jnp.where(valid[None, :], iou, -1.0), 0)  # [G]
-    cls = cls.at[best_anchor].set(jnp.where(valid, gt_classes, cls[best_anchor]))
+    cls = cls.at[best_anchor].set(jnp.where(valid, gt_classes,
+                                            cls[best_anchor]))
     box_t = encode_boxes(gt_boxes[best_gt], anchors)
     pos = cls >= 0
     return cls, box_t, pos

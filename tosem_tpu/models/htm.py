@@ -1,0 +1,324 @@
+"""Hierarchical Temporal Memory: encoders, Spatial Pooler, Temporal Memory,
+anomaly likelihood, SDR classifier (the NuPIC family, SURVEY §2.5).
+
+Reference: ``src/nupic/1.0.5/src/nupic/algorithms/spatial_pooler.py:99``
+(SpatialPooler, ``compute`` at ``:877``), ``temporal_memory.py:48,181``,
+``sdr_classifier.py``, ``anomaly_likelihood.py``; encoders under
+``src/nupic/encoders/``. NuPIC's hot loops are sparse, per-neuron Python/C++
+(the external ``nupic.bindings`` wheel); this re-design is **dense and
+fixed-shape** so every step jits onto the TPU:
+
+- SP: permanences as a dense [columns, inputs] matrix; overlap is one
+  matmul on the MXU; global inhibition is ``top_k``; boosting via duty
+  cycles — all in one jitted ``sp_step``.
+- TM: distal segments as a dense [cells, segs_per_cell, cells] permanence
+  tensor; prediction is an einsum against the previous active-cell vector;
+  bursting/winner selection/segment growth are masked vector ops instead
+  of per-segment Python. Capacity is bounded up front (static shapes) —
+  the TPU trade: memory for compile-time-known parallelism.
+
+State lives in pytrees; every ``*_step`` is ``(state, input) → (state,
+output)`` and composes under ``jax.jit`` / ``lax.scan``.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+# ---------------------------------------------------------------- encoders
+
+def scalar_encoder(value, *, minval: float, maxval: float, n_bits: int = 400,
+                   n_active: int = 21):
+    """Classic scalar encoder (``encoders/scalar.py`` role): a window of
+    ``n_active`` contiguous ON bits positioned by value."""
+    v = jnp.clip((value - minval) / (maxval - minval), 0.0, 1.0)
+    start = jnp.round(v * (n_bits - n_active)).astype(jnp.int32)
+    idx = jnp.arange(n_bits)
+    return ((idx >= start) & (idx < start + n_active)).astype(jnp.float32)
+
+
+def category_encoder(index, n_categories: int, n_active: int = 21):
+    """Non-overlapping category SDRs (``encoders/category.py`` role)."""
+    n_bits = n_categories * n_active
+    idx = jnp.arange(n_bits)
+    start = index * n_active
+    return ((idx >= start) & (idx < start + n_active)).astype(jnp.float32)
+
+
+# ------------------------------------------------------------ spatial pooler
+
+class SPParams(NamedTuple):
+    n_inputs: int
+    n_columns: int
+    n_active_columns: int          # global-inhibition winners (~2% sparsity)
+    potential_pct: float = 0.5
+    perm_connected: float = 0.2
+    perm_inc: float = 0.05
+    perm_dec: float = 0.008
+    boost_strength: float = 2.0
+    duty_decay: float = 0.99
+
+
+class SPState(NamedTuple):
+    permanence: jax.Array          # [columns, inputs]
+    potential: jax.Array           # [columns, inputs] 0/1 mask
+    duty_cycle: jax.Array          # [columns] activation frequency EMA
+
+
+def sp_init(key, p: SPParams) -> SPState:
+    k1, k2 = jax.random.split(key)
+    potential = (jax.random.uniform(k1, (p.n_columns, p.n_inputs))
+                 < p.potential_pct).astype(jnp.float32)
+    perm = jax.random.uniform(k2, (p.n_columns, p.n_inputs),
+                              minval=p.perm_connected - 0.1,
+                              maxval=p.perm_connected + 0.1) * potential
+    duty = jnp.zeros((p.n_columns,))
+    return SPState(perm, potential, duty)
+
+
+@partial(jax.jit, static_argnums=(2, 3))
+def sp_step(state: SPState, inp: jax.Array, p: SPParams,
+            learn: bool = True) -> Tuple[SPState, jax.Array]:
+    """One compute cycle (spatial_pooler.py:877 ``compute``): overlap →
+    boost → global top-k inhibition → Hebbian permanence update.
+
+    inp: [n_inputs] 0/1. Returns (new_state, active_columns [n_columns] 0/1).
+    """
+    connected = (state.permanence >= p.perm_connected).astype(jnp.float32)
+    overlap = connected @ inp                                # [columns] MXU
+    target_duty = p.n_active_columns / p.n_columns
+    boost = jnp.exp(p.boost_strength * (target_duty - state.duty_cycle))
+    boosted = overlap * boost
+    # global inhibition: exactly top-k columns win (top_k breaks ties by
+    # index, so equal-overlap columns can't all sneak in)
+    _, win_idx = jax.lax.top_k(boosted, p.n_active_columns)
+    active = jnp.zeros((p.n_columns,)).at[win_idx].set(1.0)
+    active = jnp.where(boosted > 1e-6, active, 0.0)  # no winners w/o overlap
+    duty = state.duty_cycle * p.duty_decay + active * (1 - p.duty_decay)
+    if learn:
+        # active columns: +inc on ON inputs, -dec on OFF inputs (potential
+        # synapses only) — the vectorized _adaptSynapses
+        delta = (inp[None, :] * (p.perm_inc + p.perm_dec) - p.perm_dec)
+        perm = state.permanence + active[:, None] * delta * state.potential
+        perm = jnp.clip(perm, 0.0, 1.0)
+    else:
+        perm = state.permanence
+    return SPState(perm, state.potential, duty), active
+
+
+# ----------------------------------------------------------- temporal memory
+
+class TMParams(NamedTuple):
+    n_columns: int
+    cells_per_column: int = 8
+    segs_per_cell: int = 8
+    activation_threshold: int = 10  # connected synapses to predict
+    learning_threshold: int = 7     # potential synapses to be "matching"
+    perm_connected: float = 0.5
+    perm_init: float = 0.21
+    perm_inc: float = 0.1
+    perm_dec: float = 0.1
+    predicted_decrement: float = 0.01
+    # (no sample_size: growth connects to all prev winners — dense-tensor
+    # semantics; NuPIC's random subsampling exists to bound sparse-structure
+    # cost, which the fixed-shape design pays up front instead)
+
+    @property
+    def n_cells(self) -> int:
+        return self.n_columns * self.cells_per_column
+
+
+class TMState(NamedTuple):
+    perm: jax.Array        # [cells, segs, cells] distal permanences
+    seg_used: jax.Array    # [cells, segs] has-this-segment-ever-learned
+    active: jax.Array      # [cells] current active cells
+    winners: jax.Array     # [cells] current winner (learning) cells
+    predictive: jax.Array  # [cells] cells predicted for NEXT step
+    drive: jax.Array       # [cells, segs] connected-synapse drive vs active
+    pot_drive: jax.Array   # [cells, segs] potential-synapse drive vs active
+
+
+def tm_init(p: TMParams) -> TMState:
+    z = jnp.zeros
+    return TMState(z((p.n_cells, p.segs_per_cell, p.n_cells)),
+                   z((p.n_cells, p.segs_per_cell)),
+                   z((p.n_cells,)), z((p.n_cells,)), z((p.n_cells,)),
+                   z((p.n_cells, p.segs_per_cell)),
+                   z((p.n_cells, p.segs_per_cell)))
+
+
+@partial(jax.jit, static_argnums=(2, 3))
+def tm_step(state: TMState, active_columns: jax.Array, p: TMParams,
+            learn: bool = True) -> Tuple[TMState, jax.Array]:
+    """One TM timestep (temporal_memory.py:181 ``compute`` re-vectorized).
+
+    active_columns: [n_columns] 0/1 from the SP. Returns (new_state,
+    anomaly_score) where anomaly = fraction of active columns that were NOT
+    predicted (algorithms/anomaly.py role).
+    """
+    C, K, S = p.n_columns, p.cells_per_column, p.segs_per_cell
+    prev_active = state.active
+    prev_winners = state.winners
+
+    # segment drive against previous activity: carried over from the end of
+    # the previous step (same perm, same active — recomputing would double
+    # the dominant [cells, segs, cells] contraction)
+    drive = state.drive
+    seg_active = drive >= p.activation_threshold
+    potential_drive = state.pot_drive
+    seg_matching = potential_drive >= p.learning_threshold
+
+    cell_predicted = seg_active.any(axis=1)                  # [cells]
+    col_of = jnp.arange(p.n_cells) // K
+    col_active = active_columns[col_of] > 0                  # [cells]
+
+    col_predicted = (cell_predicted.reshape(C, K).any(1))    # [columns]
+    col_is_active = active_columns > 0
+    bursting_cols = col_is_active & ~col_predicted
+    anomaly = (jnp.sum(bursting_cols) /
+               jnp.maximum(jnp.sum(col_is_active), 1.0))
+
+    # active cells: predicted cells in active columns; whole column bursts
+    # when nothing was predicted
+    active = jnp.where(col_active & cell_predicted, 1.0, 0.0)
+    active = jnp.where(bursting_cols[col_of] & col_active, 1.0, active)
+
+    # winner cells (learning targets): predicted winners, or in bursting
+    # columns the cell with the best matching segment (fallback: least-used)
+    best_match = jnp.max(jnp.where(seg_matching, potential_drive, -1.0), 1)
+    usage = state.seg_used.sum(1)
+    # per-column winner among its K cells
+    cell_score = jnp.where(best_match >= 0, 1e6 + best_match, -usage)
+    score_by_col = cell_score.reshape(C, K)
+    win_in_col = jnp.argmax(score_by_col, 1)                 # [columns]
+    burst_winner = (jnp.arange(p.n_cells) ==
+                    (jnp.arange(C) * K + win_in_col)[col_of])
+    winners = jnp.where(col_active & cell_predicted, 1.0,
+                        jnp.where(bursting_cols[col_of] & burst_winner,
+                                  1.0, 0.0))
+
+    if learn:
+        # choose ONE learning segment per winner cell: best matching if any,
+        # else the least-used (to grow a new one)
+        seg_score = jnp.where(seg_matching, potential_drive,
+                              -1.0 - state.seg_used)          # [cells, segs]
+        learn_seg = jax.nn.one_hot(jnp.argmax(seg_score, 1), S)  # [cells, S]
+        learn_mask = winners[:, None] * learn_seg             # [cells, segs]
+        # reinforce: +inc toward prev winner cells, -dec for other nonzero
+        # synapses; grow toward prev winners where empty
+        grow_target = jnp.maximum(prev_winners, 0.0)          # [cells]
+        pos = grow_target[None, None, :]
+        has_syn = (state.perm > 0).astype(jnp.float32)
+        delta = (pos * p.perm_inc - (1 - pos) * p.perm_dec) * has_syn
+        grow = pos * (has_syn == 0) * p.perm_init
+        perm = state.perm + learn_mask[:, :, None] * (delta + grow)
+        # punish segments that predicted but whose column didn't activate
+        wrong = seg_active & (~col_active)[:, None]
+        perm = perm - wrong[:, :, None].astype(jnp.float32) * \
+            p.predicted_decrement * (state.perm > 0)
+        perm = jnp.clip(perm, 0.0, 1.0)
+        seg_used = jnp.clip(state.seg_used + learn_mask, 0.0, 1.0)
+    else:
+        perm, seg_used = state.perm, state.seg_used
+
+    # drives for the next step, from the NEW permanences and NEW activity
+    new_connected = (perm >= p.perm_connected).astype(jnp.float32)
+    next_drive = jnp.einsum("xsc,c->xs", new_connected, active)
+    next_pot = jnp.einsum("xsc,c->xs",
+                          (perm > 0).astype(jnp.float32), active)
+    predictive = (next_drive >= p.activation_threshold).any(1)
+
+    return (TMState(perm, seg_used, active, winners,
+                    predictive.astype(jnp.float32), next_drive, next_pot),
+            anomaly)
+
+
+# -------------------------------------------------------- anomaly likelihood
+
+@dataclass
+class AnomalyLikelihood:
+    """Running-Gaussian tail probability of short-term mean anomaly
+    (``anomaly_likelihood.py`` role): likelihood = 1 - Q(recent | history)."""
+    window: int = 100
+    short_window: int = 10
+
+    def __post_init__(self):
+        self.history: list = []
+
+    def update(self, score: float) -> float:
+        self.history.append(float(score))
+        self.history = self.history[-self.window:]  # bounded for streaming
+        hist = self.history
+        if len(hist) < self.short_window + 2:
+            return 0.5
+        mean = float(np.mean(hist))
+        std = float(np.std(hist)) + 1e-6
+        recent = float(np.mean(hist[-self.short_window:]))
+        z = (recent - mean) / std
+        # one-sided normal tail
+        from math import erf, sqrt
+        return 0.5 * (1.0 + erf(z / sqrt(2.0)))
+
+
+# ----------------------------------------------------------- sdr classifier
+
+class SDRClassifier:
+    """Online softmax regression from cell SDRs to bucketed values
+    (``sdr_classifier.py`` role), trained with plain SGD."""
+
+    def __init__(self, n_inputs: int, n_buckets: int, lr: float = 0.1):
+        self.w = jnp.zeros((n_inputs, n_buckets))
+        self.lr = lr
+
+    def infer(self, sdr: jax.Array) -> jax.Array:
+        return jax.nn.softmax(sdr @ self.w)
+
+    def learn(self, sdr: jax.Array, bucket: int) -> None:
+        probs = self.infer(sdr)
+        target = jax.nn.one_hot(bucket, self.w.shape[1])
+        self.w = self.w + self.lr * jnp.outer(sdr, target - probs)
+
+
+# ------------------------------------------------------------------- OPF-ish
+
+class HTMModel:
+    """Encoder → SP → TM → anomaly pipeline (the OPF
+    ``htm_prediction_model.py`` role, scoped to anomaly detection)."""
+
+    def __init__(self, key, *, minval: float, maxval: float,
+                 n_bits: int = 256, n_active_bits: int = 15,
+                 n_columns: int = 256, n_active_columns: int = 10,
+                 cells_per_column: int = 8):
+        self.minval, self.maxval = minval, maxval
+        self.n_bits, self.n_active_bits = n_bits, n_active_bits
+        self.sp_params = SPParams(n_inputs=n_bits, n_columns=n_columns,
+                                  n_active_columns=n_active_columns)
+        self.tm_params = TMParams(n_columns=n_columns,
+                                  cells_per_column=cells_per_column,
+                                  activation_threshold=max(
+                                      2, n_active_columns // 2),
+                                  learning_threshold=max(
+                                      1, n_active_columns // 3))
+        self.sp_state = sp_init(key, self.sp_params)
+        self.tm_state = tm_init(self.tm_params)
+        self.likelihood = AnomalyLikelihood()
+
+    def run(self, value: float, learn: bool = True):
+        """→ dict(anomaly_score, anomaly_likelihood, active_columns)."""
+        sdr = scalar_encoder(value, minval=self.minval, maxval=self.maxval,
+                             n_bits=self.n_bits,
+                             n_active=self.n_active_bits)
+        self.sp_state, cols = sp_step(self.sp_state, sdr, self.sp_params,
+                                      learn)
+        self.tm_state, anomaly = tm_step(self.tm_state, cols,
+                                         self.tm_params, learn)
+        score = float(anomaly)
+        return {"anomaly_score": score,
+                "anomaly_likelihood": self.likelihood.update(score),
+                "active_columns": cols}
