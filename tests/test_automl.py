@@ -1,0 +1,132 @@
+"""Tests for the AutoML layer (estimators, pipelines, search, ensembling).
+
+Reference style (SURVEY §4.6): small synthetic datasets, package-mirroring
+test classes (test_automl/test_evaluation/test_ensemble_builder), resource
+-limited evaluation behavior.
+"""
+import numpy as np
+import pytest
+
+from tosem_tpu.automl import (AutoML, CLASSIFIERS, PREPROCESSORS, Pipeline,
+                              greedy_ensemble, pipeline_space)
+
+
+def make_blobs(n=300, seed=0, spread=1.2):
+    """3-class gaussian blobs with a rotation (make_classification role)."""
+    rng = np.random.default_rng(seed)
+    centers = np.array([[0, 0], [4, 1], [1, 4]], float)
+    y = rng.integers(0, 3, n)
+    X = centers[y] + rng.normal(0, spread, (n, 2))
+    X = np.hstack([X, rng.normal(0, 1, (n, 3))])      # noise features
+    rot = rng.normal(size=(5, 5))
+    q, _ = np.linalg.qr(rot)
+    return (X @ q).astype(np.float32), y
+
+
+class TestEstimators:
+    @pytest.mark.parametrize("name", list(CLASSIFIERS))
+    def test_each_classifier_beats_chance(self, name):
+        X, y = make_blobs(seed=1)
+        Xtr, ytr, Xte, yte = X[:200], y[:200], X[200:], y[200:]
+        clf = CLASSIFIERS[name]().fit(Xtr, ytr)
+        acc = (clf.predict(Xte) == yte).mean()
+        assert acc > 0.6, f"{name}: {acc}"
+        proba = clf.predict_proba(Xte)
+        assert proba.shape == (len(Xte), 3)
+        np.testing.assert_allclose(proba.sum(1), 1.0, atol=1e-4)
+
+    @pytest.mark.parametrize("name", list(PREPROCESSORS))
+    def test_each_preprocessor_roundtrip(self, name):
+        X, y = make_blobs(n=80, seed=2)
+        prep = PREPROCESSORS[name]().fit(X, y)
+        Xt = prep.transform(X)
+        assert Xt.shape[0] == X.shape[0]
+        assert np.all(np.isfinite(Xt))
+
+    def test_logreg_matches_sklearn_ballpark(self):
+        # cross-check against the baked-in sklearn implementation
+        from sklearn.linear_model import LogisticRegression as SkLR
+        X, y = make_blobs(seed=3)
+        Xtr, ytr, Xte, yte = X[:200], y[:200], X[200:], y[200:]
+        ours = CLASSIFIERS["logreg"]().fit(Xtr, ytr)
+        theirs = SkLR(max_iter=500).fit(Xtr, ytr)
+        acc_ours = (ours.predict(Xte) == yte).mean()
+        acc_sk = (theirs.predict(Xte) == yte).mean()
+        assert acc_ours >= acc_sk - 0.08
+
+
+class TestPipeline:
+    def test_fit_predict(self):
+        X, y = make_blobs(seed=4)
+        pipe = Pipeline({"prep": "standard_scaler", "clf": "ridge",
+                         "clf.alpha": 0.5}).fit(X[:200], y[:200])
+        acc = (pipe.predict(X[200:]) == y[200:]).mean()
+        assert acc > 0.6
+
+    def test_space_contains_all_components(self):
+        space = pipeline_space()
+        assert set(space["prep"].values) == set(PREPROCESSORS)
+        assert set(space["clf"].values) == set(CLASSIFIERS)
+        assert "clf.alpha" in space and "clf.k" in space
+
+
+class TestEnsemble:
+    def test_greedy_selection_improves_on_members(self):
+        rng = np.random.default_rng(0)
+        y = rng.integers(0, 3, 200)
+        onehot = np.eye(3)[y]
+        # three noisy experts with independent errors
+        probas = [np.clip(onehot + rng.normal(0, 0.8, onehot.shape),
+                          1e-6, None) for _ in range(3)]
+        probas = [p / p.sum(1, keepdims=True) for p in probas]
+        single = max((np.argmax(p, 1) == y).mean() for p in probas)
+        sel = greedy_ensemble(probas, y, size=6)
+        mixed = np.mean([probas[i] for i in sel], axis=0)
+        ens = (np.argmax(mixed, 1) == y).mean()
+        assert ens >= single - 1e-9
+
+    def test_selection_ignores_bad_models(self):
+        rng = np.random.default_rng(1)
+        y = rng.integers(0, 2, 100)
+        good = np.eye(2)[y] * 0.9 + 0.05
+        bad = np.eye(2)[1 - y] * 0.9 + 0.05      # anti-predictor
+        sel = greedy_ensemble([good, bad], y, size=4)
+        assert set(sel) == {0}
+
+
+class TestAutoMLEndToEnd:
+    def test_fit_predict_evolution(self):
+        X, y = make_blobs(n=240, seed=5)
+        am = AutoML(n_trials=10, searcher="evolution", ensemble_size=3,
+                    max_concurrent=3, seed=0)
+        am.fit(X[:180], y[:180])
+        assert am.score(X[180:], y[180:]) > 0.65
+        assert am.best_score_ > 0.6
+        assert len(am.ensemble_) == 3
+
+    def test_fit_predict_tpe(self):
+        X, y = make_blobs(n=240, seed=6)
+        am = AutoML(n_trials=8, searcher="tpe", ensemble_size=2,
+                    max_concurrent=3, seed=1)
+        am.fit(X[:180], y[:180])
+        assert am.score(X[180:], y[180:]) > 0.6
+
+    def test_crashing_pipeline_does_not_kill_search(self, monkeypatch):
+        # poison one classifier: its trials fail, the search still completes
+        from tosem_tpu.automl import estimators
+
+        class Bomb(estimators.Component):
+            def fit(self, X, y):
+                raise RuntimeError("boom")
+
+        monkeypatch.setitem(estimators.CLASSIFIERS, "bomb", Bomb)
+        try:
+            X, y = make_blobs(n=150, seed=7)
+            am = AutoML(n_trials=8, searcher="evolution", ensemble_size=2,
+                        max_concurrent=2, seed=3)
+            am.fit(X, y)
+            errors = [r for r in am.records if r.error]
+            # search survived; bombs recorded as failures if sampled
+            assert am.best_score_ > 0
+        finally:
+            estimators.CLASSIFIERS.pop("bomb", None)

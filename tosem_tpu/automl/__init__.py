@@ -1,0 +1,21 @@
+"""AutoML layer — pipeline search + ensembling (auto-sklearn/TPOT-lite).
+
+SURVEY §2.6: the four AutoML libraries condense to this: a component
+library of preprocessors/classifiers (JAX math), evolutionary and TPE
+pipeline search reusing the HPO suggesters, resource-limited parallel
+evaluation on the distributed runtime, and Caruana greedy ensembling.
+"""
+from tosem_tpu.automl.automl import (AutoML, Pipeline, TrialRecord,
+                                     greedy_ensemble, pipeline_space)
+from tosem_tpu.automl.estimators import (CLASSIFIERS, PREPROCESSORS,
+                                         KNeighborsClassifier,
+                                         LogisticRegression, MLPClassifier,
+                                         PCA, RidgeClassifier,
+                                         SelectKBest, StandardScaler)
+
+__all__ = [
+    "AutoML", "Pipeline", "TrialRecord", "greedy_ensemble",
+    "pipeline_space", "CLASSIFIERS", "PREPROCESSORS",
+    "LogisticRegression", "RidgeClassifier", "KNeighborsClassifier",
+    "MLPClassifier", "PCA", "StandardScaler", "SelectKBest",
+]

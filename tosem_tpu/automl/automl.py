@@ -1,0 +1,241 @@
+"""AutoML: pipeline search + greedy ensemble over the component library.
+
+The auto-sklearn/TPOT layer (SURVEY §2.6): ``AutoML.fit`` plays
+``autosklearn/automl.py:103`` fit — search pipeline configurations against
+a holdout, then build a greedy ensemble (``ensemble_builder.py`` Caruana
+selection) over the fitted candidates. Two searchers: an evolutionary one
+(TPOT's DEAP ``eaMuPlusLambda``, ``tpot/base.py:816``) and a TPE one
+(auto-sklearn's SMAC BO-loop role), both reusing the HPO layer's suggesters
+over a joint (preprocessor, classifier, hyperparams) space. Candidate
+evaluation runs as runtime tasks with a per-trial timeout — the role of
+auto-sklearn's pynisher resource-limited subprocess evaluation
+(``autosklearn/evaluation/``): a hung or crashed pipeline kills its worker,
+not the experiment.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from tosem_tpu.automl.estimators import CLASSIFIERS, PREPROCESSORS
+from tosem_tpu.tune.search import (Choice, Domain, EvolutionSearch,
+                                   TPESearch, sample_config)
+
+
+@dataclass
+class Pipeline:
+    """preprocessor → classifier, configured by a flat dict."""
+    config: Dict[str, Any]
+    prep: Any = None
+    clf: Any = None
+
+    def fit(self, X, y):
+        prep_cls = PREPROCESSORS[self.config["prep"]]
+        clf_cls = CLASSIFIERS[self.config["clf"]]
+        prep_kw = {k[len("prep."):]: v for k, v in self.config.items()
+                   if k.startswith("prep.")}
+        clf_kw = {k[len("clf."):]: v for k, v in self.config.items()
+                  if k.startswith("clf.")}
+        self.prep = prep_cls(**prep_kw).fit(X, y)
+        Xt = self.prep.transform(X)
+        self.clf = clf_cls(**clf_kw).fit(Xt, y)
+        return self
+
+    def predict(self, X):
+        return self.clf.predict(self.prep.transform(X))
+
+    def predict_proba(self, X):
+        return self.clf.predict_proba(self.prep.transform(X))
+
+
+def pipeline_space() -> Dict[str, Any]:
+    """Joint config space: component choices + every component's
+    hyperparams, prefixed (the flat-space encoding auto-sklearn uses)."""
+    space: Dict[str, Any] = {
+        "prep": Choice(list(PREPROCESSORS)),
+        "clf": Choice(list(CLASSIFIERS)),
+    }
+    for name, cls in PREPROCESSORS.items():
+        for k, dom in cls.config_space().items():
+            space[f"prep.{k}"] = dom
+    for name, cls in CLASSIFIERS.items():
+        for k, dom in cls.config_space().items():
+            space[f"clf.{k}"] = dom
+    return space
+
+
+def _evaluate_pipeline(config, X_tr, y_tr, X_val, y_val, classes):
+    """Runs inside a runtime worker: fit on train, score on holdout.
+    Returns (accuracy, val_probabilities) — probs feed the ensemble.
+    ``classes`` is the FULL label set (train ∪ holdout) so a rare class
+    living only in the holdout can't shift the index mapping."""
+    pipe = Pipeline(config).fit(X_tr, y_tr)
+    proba = pipe.predict_proba(X_val)
+    pred = pipe.clf.classes_[np.argmax(proba, 1)]
+    acc = float((pred == y_val).mean())
+    # re-index probas onto the full class set for the ensemble
+    full = np.zeros((len(proba), len(classes)))
+    cols = np.searchsorted(classes, pipe.clf.classes_)
+    full[:, cols] = proba
+    return acc, full
+
+
+# ------------------------------------------------------------------ ensemble
+
+def greedy_ensemble(val_probas: List[np.ndarray], y_val_idx: np.ndarray,
+                    size: int = 10) -> List[int]:
+    """Caruana greedy selection with replacement (ensemble_builder.py):
+    repeatedly add the model whose inclusion maximizes ensemble accuracy."""
+    chosen: List[int] = []
+    current = np.zeros_like(val_probas[0])
+    for _ in range(size):
+        best_i, best_acc = -1, -1.0
+        for i, p in enumerate(val_probas):
+            acc = float((np.argmax((current + p) / (len(chosen) + 1), 1)
+                         == y_val_idx).mean())
+            if acc > best_acc:
+                best_acc, best_i = acc, i
+        chosen.append(best_i)
+        current = current + val_probas[best_i]
+    return chosen
+
+
+@dataclass
+class TrialRecord:
+    config: Dict[str, Any]
+    accuracy: float
+    proba: Optional[np.ndarray] = None
+    error: Optional[str] = None
+
+
+class AutoML:
+    """``fit(X, y)`` → searched + ensembled classifier.
+
+    searcher: "evolution" (TPOT role) | "tpe" (auto-sklearn BO role)
+    """
+
+    def __init__(self, n_trials: int = 30, searcher: str = "evolution",
+                 ensemble_size: int = 8, holdout: float = 0.33,
+                 trial_timeout: float = 60.0, max_concurrent: int = 4,
+                 seed: int = 0, verbose: bool = False):
+        self.n_trials = n_trials
+        self.searcher = searcher
+        self.ensemble_size = ensemble_size
+        self.holdout = holdout
+        self.trial_timeout = trial_timeout
+        self.max_concurrent = max_concurrent
+        self.seed = seed
+        self.verbose = verbose
+        self.records: List[TrialRecord] = []
+
+    def fit(self, X: np.ndarray, y: np.ndarray) -> "AutoML":
+        import tosem_tpu.runtime as rt
+        rng = np.random.default_rng(self.seed)
+        n = len(X)
+        perm = rng.permutation(n)
+        n_val = max(1, int(n * self.holdout))
+        val_idx, tr_idx = perm[:n_val], perm[n_val:]
+        X_tr, y_tr = X[tr_idx], y[tr_idx]
+        X_val, y_val = X[val_idx], y[val_idx]
+        self.classes_ = np.unique(y)       # FULL label set, not train-only
+        y_val_idx = np.searchsorted(self.classes_, y_val)
+
+        space = pipeline_space()
+        if self.searcher == "tpe":
+            alg = TPESearch(seed=self.seed, n_startup=max(
+                5, self.n_trials // 4))
+        else:
+            alg = EvolutionSearch(seed=self.seed, population=max(
+                4, self.n_trials // 4))
+        alg.set_space(space, "max")
+
+        own_rt = not rt.is_initialized()
+        if own_rt:
+            # spawn: pipeline fits run jax in the workers — forked XLA
+            # clients hang (pynisher-style isolation needs clean children)
+            rt.init(num_workers=self.max_concurrent, start_method="spawn")
+        try:
+            self._search(rt, alg, X_tr, y_tr, X_val, y_val)
+            ok = [r for r in self.records if r.proba is not None]
+            if not ok:
+                raise RuntimeError("every candidate pipeline failed")
+            ok.sort(key=lambda r: -r.accuracy)
+            pool = ok[:max(self.ensemble_size * 2, 5)]
+            sel = greedy_ensemble([r.proba for r in pool], y_val_idx,
+                                  self.ensemble_size)
+            self.ensemble_configs_ = [pool[i].config for i in sel]
+            # refit ensemble members on ALL data (auto-sklearn refit step)
+            self.ensemble_: List[Pipeline] = [
+                Pipeline(cfg).fit(X, y) for cfg in self.ensemble_configs_]
+            self.best_config_ = ok[0].config
+            self.best_score_ = ok[0].accuracy
+        finally:
+            if own_rt:
+                rt.shutdown()
+        return self
+
+    def _search(self, rt, alg, X_tr, y_tr, X_val, y_val) -> None:
+        eval_fn = rt.remote(_evaluate_pipeline)
+        pending: List[Tuple[Dict, Any, float]] = []
+        launched = 0
+        Xtr_ref = rt.put(X_tr)
+        ytr_ref = rt.put(y_tr)
+        Xv_ref = rt.put(X_val)
+        yv_ref = rt.put(y_val)
+        cls_ref = rt.put(self.classes_)
+
+        def launch():
+            nonlocal launched
+            cfg = alg.suggest()
+            ref = eval_fn.options(max_retries=0).remote(
+                cfg, Xtr_ref, ytr_ref, Xv_ref, yv_ref, cls_ref)
+            pending.append((cfg, ref, time.monotonic()))
+            launched += 1
+
+        while launched < self.n_trials or pending:
+            while launched < self.n_trials and \
+                    len(pending) < self.max_concurrent:
+                launch()
+            done, _ = rt.wait([r for _, r, _ in pending], num_returns=1,
+                              timeout=1.0)
+            now = time.monotonic()
+            still = []
+            for cfg, ref, t0 in pending:
+                if ref in done:
+                    try:
+                        acc, proba = rt.get(ref)
+                        self.records.append(TrialRecord(cfg, acc, proba))
+                        alg.observe(cfg, acc)
+                        if self.verbose:
+                            print(f"[automl] {cfg['prep']}+{cfg['clf']} "
+                                  f"acc={acc:.3f}")
+                    except Exception as e:  # crashed pipeline ≠ dead search
+                        self.records.append(TrialRecord(cfg, -1.0,
+                                                        error=str(e)))
+                        alg.observe(cfg, 0.0)
+                elif now - t0 > self.trial_timeout:
+                    # pynisher-style resource limit: kill the hung worker
+                    # (not just abandon the ref, or it wedges its slot)
+                    rt.cancel(ref)
+                    self.records.append(TrialRecord(cfg, -1.0,
+                                                    error="timeout"))
+                    alg.observe(cfg, 0.0)
+                else:
+                    still.append((cfg, ref, t0))
+            pending = still
+
+    def predict_proba(self, X: np.ndarray) -> np.ndarray:
+        total = None
+        for pipe in self.ensemble_:
+            p = pipe.predict_proba(X)
+            total = p if total is None else total + p
+        return total / len(self.ensemble_)
+
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        return self.classes_[np.argmax(self.predict_proba(X), 1)]
+
+    def score(self, X: np.ndarray, y: np.ndarray) -> float:
+        return float((self.predict(X) == y).mean())

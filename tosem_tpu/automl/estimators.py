@@ -1,0 +1,295 @@
+"""Small JAX estimators + preprocessors for the AutoML pipeline space.
+
+The component library the pipeline search composes over — the role of
+auto-sklearn's ``autosklearn/pipeline/components`` (classifiers +
+preprocessors as pluggable config-spaced parts) and TPOT's operator config
+dicts (``tpot/config/``). All are fit/predict objects over numpy arrays
+with the math in JAX (closed forms and full-batch GD jit-compile; on TPU
+the matmuls land on the MXU — the sklearn/C-extension split the reference
+libraries rely on disappears).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class Component:
+    """fit/transform-or-predict base; subclasses declare a config space
+    as {name: tune Domain} via ``config_space()``."""
+
+    @classmethod
+    def config_space(cls):
+        return {}
+
+    def get_params(self):
+        return dict(self._params)
+
+    def __init__(self, **params):
+        self._params = params
+
+
+# ------------------------------------------------------------ preprocessors
+
+class StandardScaler(Component):
+    def fit(self, X, y=None):
+        self.mean_ = X.mean(0)
+        self.std_ = X.std(0) + 1e-8
+        return self
+
+    def transform(self, X):
+        return (X - self.mean_) / self.std_
+
+
+class MinMaxScaler(Component):
+    def fit(self, X, y=None):
+        self.min_ = X.min(0)
+        self.range_ = X.max(0) - self.min_ + 1e-8
+        return self
+
+    def transform(self, X):
+        return (X - self.min_) / self.range_
+
+
+class PCA(Component):
+    @classmethod
+    def config_space(cls):
+        from tosem_tpu.tune.search import Uniform
+        return {"var_keep": Uniform(0.5, 0.99)}
+
+    def fit(self, X, y=None):
+        var_keep = self._params.get("var_keep", 0.95)
+        Xc = jnp.asarray(X - X.mean(0))
+        _, s, vt = jnp.linalg.svd(Xc, full_matrices=False)
+        ratio = np.cumsum(np.asarray(s) ** 2)
+        ratio = ratio / ratio[-1]
+        k = int(np.searchsorted(ratio, var_keep) + 1)
+        self.mean_ = X.mean(0)
+        self.components_ = np.asarray(vt[:k])
+        return self
+
+    def transform(self, X):
+        return np.asarray((X - self.mean_) @ self.components_.T)
+
+
+class PolynomialFeatures(Component):
+    """Degree-2 interactions (TPOT's PolynomialFeatures operator)."""
+
+    def fit(self, X, y=None):
+        return self
+
+    def transform(self, X):
+        n = X.shape[1]
+        cols = [X]
+        iu = np.triu_indices(n)
+        cols.append(X[:, iu[0]] * X[:, iu[1]])
+        return np.concatenate(cols, axis=1)
+
+
+class SelectKBest(Component):
+    """ANOVA-F-style univariate feature selection."""
+
+    @classmethod
+    def config_space(cls):
+        from tosem_tpu.tune.search import Uniform
+        return {"frac": Uniform(0.3, 1.0)}
+
+    def fit(self, X, y):
+        frac = self._params.get("frac", 0.5)
+        classes = np.unique(y)
+        grand = X.mean(0)
+        between = np.zeros(X.shape[1])
+        within = np.zeros(X.shape[1]) + 1e-8
+        for c in classes:
+            Xc = X[y == c]
+            between += len(Xc) * (Xc.mean(0) - grand) ** 2
+            within += ((Xc - Xc.mean(0)) ** 2).sum(0)
+        f = between / within
+        k = max(1, int(round(frac * X.shape[1])))
+        self.idx_ = np.argsort(-f)[:k]
+        return self
+
+    def transform(self, X):
+        return X[:, self.idx_]
+
+
+class Identity(Component):
+    def fit(self, X, y=None):
+        return self
+
+    def transform(self, X):
+        return X
+
+
+# -------------------------------------------------------------- classifiers
+
+def _one_hot(y, k):
+    return np.eye(k)[y]
+
+
+class LogisticRegression(Component):
+    @classmethod
+    def config_space(cls):
+        from tosem_tpu.tune.search import LogUniform
+        return {"C": LogUniform(1e-3, 1e2), "epochs": LogUniform(50, 500)}
+
+    def fit(self, X, y):
+        C = self._params.get("C", 1.0)
+        epochs = int(self._params.get("epochs", 200))
+        self.classes_ = np.unique(y)
+        k = len(self.classes_)
+        yi = np.searchsorted(self.classes_, y)
+        Xj = jnp.asarray(X, jnp.float32)
+        Yj = jnp.asarray(_one_hot(yi, k), jnp.float32)
+        w = jnp.zeros((X.shape[1], k))
+        b = jnp.zeros((k,))
+
+        @jax.jit
+        def epoch(carry, _):
+            w, b = carry
+            logits = Xj @ w + b
+            p = jax.nn.softmax(logits)
+            gw = Xj.T @ (p - Yj) / len(Xj) + w / (C * len(Xj))
+            gb = jnp.mean(p - Yj, 0)
+            return (w - 0.5 * gw, b - 0.5 * gb), None
+
+        (w, b), _ = jax.lax.scan(epoch, (w, b), None, length=epochs)
+        self.w_, self.b_ = np.asarray(w), np.asarray(b)
+        return self
+
+    def predict_proba(self, X):
+        logits = X @ self.w_ + self.b_
+        e = np.exp(logits - logits.max(1, keepdims=True))
+        return e / e.sum(1, keepdims=True)
+
+    def predict(self, X):
+        return self.classes_[np.argmax(self.predict_proba(X), 1)]
+
+
+class RidgeClassifier(Component):
+    @classmethod
+    def config_space(cls):
+        from tosem_tpu.tune.search import LogUniform
+        return {"alpha": LogUniform(1e-3, 1e2)}
+
+    def fit(self, X, y):
+        alpha = self._params.get("alpha", 1.0)
+        self.classes_ = np.unique(y)
+        yi = np.searchsorted(self.classes_, y)
+        Y = _one_hot(yi, len(self.classes_)) * 2 - 1
+        Xb = jnp.asarray(np.hstack([X, np.ones((len(X), 1))]), jnp.float32)
+        A = Xb.T @ Xb + alpha * jnp.eye(Xb.shape[1])
+        self.w_ = np.asarray(jnp.linalg.solve(A, Xb.T @ jnp.asarray(
+            Y, jnp.float32)))
+        return self
+
+    def _scores(self, X):
+        Xb = np.hstack([X, np.ones((len(X), 1))])
+        return Xb @ self.w_
+
+    def predict_proba(self, X):
+        s = self._scores(X)
+        e = np.exp(s - s.max(1, keepdims=True))
+        return e / e.sum(1, keepdims=True)
+
+    def predict(self, X):
+        return self.classes_[np.argmax(self._scores(X), 1)]
+
+
+class KNeighborsClassifier(Component):
+    @classmethod
+    def config_space(cls):
+        from tosem_tpu.tune.search import RandInt
+        return {"k": RandInt(1, 16)}
+
+    def fit(self, X, y):
+        self.X_ = jnp.asarray(X, jnp.float32)
+        self.classes_ = np.unique(y)
+        self.yi_ = np.searchsorted(self.classes_, y)
+        return self
+
+    def predict_proba(self, X):
+        k = min(int(self._params.get("k", 5)), len(self.X_))
+        d = jnp.sum((jnp.asarray(X, jnp.float32)[:, None, :] -
+                     self.X_[None, :, :]) ** 2, -1)
+        _, idx = jax.lax.top_k(-d, k)                 # nearest neighbours
+        votes = self.yi_[np.asarray(idx)]             # [n, k]
+        probs = np.zeros((len(X), len(self.classes_)))
+        for c in range(len(self.classes_)):
+            probs[:, c] = (votes == c).mean(1)
+        return probs
+
+    def predict(self, X):
+        return self.classes_[np.argmax(self.predict_proba(X), 1)]
+
+
+class MLPClassifier(Component):
+    @classmethod
+    def config_space(cls):
+        from tosem_tpu.tune.search import LogUniform, RandInt
+        return {"hidden": RandInt(8, 64), "lr": LogUniform(1e-3, 3e-1),
+                "epochs": LogUniform(100, 600)}
+
+    def fit(self, X, y):
+        hidden = int(self._params.get("hidden", 32))
+        lr = self._params.get("lr", 0.05)
+        epochs = int(self._params.get("epochs", 300))
+        self.classes_ = np.unique(y)
+        k = len(self.classes_)
+        yi = np.searchsorted(self.classes_, y)
+        Xj = jnp.asarray(X, jnp.float32)
+        Yj = jnp.asarray(_one_hot(yi, k), jnp.float32)
+        key = jax.random.PRNGKey(0)
+        k1, k2 = jax.random.split(key)
+        params = {
+            "w1": jax.random.normal(k1, (X.shape[1], hidden)) *
+            (1.0 / np.sqrt(X.shape[1])),
+            "b1": jnp.zeros((hidden,)),
+            "w2": jax.random.normal(k2, (hidden, k)) / np.sqrt(hidden),
+            "b2": jnp.zeros((k,)),
+        }
+
+        def loss(p):
+            h = jnp.tanh(Xj @ p["w1"] + p["b1"])
+            logits = h @ p["w2"] + p["b2"]
+            logp = jax.nn.log_softmax(logits)
+            return -jnp.mean(jnp.sum(Yj * logp, -1))
+
+        @jax.jit
+        def epoch(p, _):
+            g = jax.grad(loss)(p)
+            return jax.tree_util.tree_map(lambda a, b: a - lr * b, p, g), None
+
+        params, _ = jax.lax.scan(epoch, params, None, length=epochs)
+        self.params_ = jax.tree_util.tree_map(np.asarray, params)
+        return self
+
+    def predict_proba(self, X):
+        p = self.params_
+        h = np.tanh(X @ p["w1"] + p["b1"])
+        logits = h @ p["w2"] + p["b2"]
+        e = np.exp(logits - logits.max(1, keepdims=True))
+        return e / e.sum(1, keepdims=True)
+
+    def predict(self, X):
+        return self.classes_[np.argmax(self.predict_proba(X), 1)]
+
+
+PREPROCESSORS = {
+    "none": Identity,
+    "standard_scaler": StandardScaler,
+    "minmax_scaler": MinMaxScaler,
+    "pca": PCA,
+    "poly": PolynomialFeatures,
+    "select_k": SelectKBest,
+}
+
+CLASSIFIERS = {
+    "logreg": LogisticRegression,
+    "ridge": RidgeClassifier,
+    "knn": KNeighborsClassifier,
+    "mlp": MLPClassifier,
+}

@@ -47,13 +47,27 @@ class _Worker:
     _ids = itertools.count()
 
     def __init__(self, ctx, store_name: str, actor_id: Optional[bytes] = None):
+        import sys
         from tosem_tpu.runtime.worker import worker_main
         self.wid = next(self._ids)
         self.conn, child_conn = mp.Pipe(duplex=True)
         self.proc = ctx.Process(target=worker_main,
                                 args=(child_conn, store_name),
                                 daemon=True, name=f"tosem-worker-{self.wid}")
-        self.proc.start()
+        # spawn re-executes __main__ by path; a REPL/heredoc parent has
+        # __file__ = "<stdin>" which the child can't run — hide it
+        main_mod = sys.modules.get("__main__")
+        fake_file = None
+        if ctx.get_start_method() == "spawn" and main_mod is not None:
+            mf = getattr(main_mod, "__file__", None)
+            if mf and not os.path.exists(mf):
+                fake_file = mf
+                del main_mod.__file__
+        try:
+            self.proc.start()
+        finally:
+            if fake_file is not None:
+                main_mod.__file__ = fake_file
         child_conn.close()
         self.actor_id = actor_id       # None = stateless task worker
         self.known_fns: Set[bytes] = set()
@@ -93,8 +107,11 @@ class Runtime:
 
     def __init__(self, num_workers: int = 4,
                  store_capacity: int = 256 << 20,
-                 max_task_retries: int = common.DEFAULT_MAX_TASK_RETRIES):
-        self.ctx = mp.get_context(_START_METHOD)
+                 max_task_retries: int = common.DEFAULT_MAX_TASK_RETRIES,
+                 start_method: Optional[str] = None):
+        # "fork" is fast; use "spawn" when tasks import jax — a forked
+        # child inherits an XLA client whose threadpool died in the fork
+        self.ctx = mp.get_context(start_method or _START_METHOD)
         self.store_name = f"/tosem_rt_{os.getpid()}_{int(time.time()*1e3)%int(1e9)}"
         self.store = ObjectStore(self.store_name, capacity=store_capacity)
         self.max_task_retries = max_task_retries

@@ -68,6 +68,16 @@ def _dump_exc(e: BaseException) -> bytes:
 
 
 def worker_main(conn, store_name: str) -> None:
+    import os
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # spawn-mode worker on a CPU-forced host (tests, CI): the axon
+        # sitecustomize rewrites jax_platforms programmatically, so pin it
+        # back before any task initializes a backend
+        try:
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+        except ImportError:
+            pass
     fns: Dict[bytes, Any] = {}
     actor: Optional[Any] = None
     store_box = [None]  # lazy attach; most small-task workers never need it
