@@ -59,7 +59,7 @@ class TestPipeline:
     def test_fit_predict(self):
         X, y = make_blobs(seed=4)
         pipe = Pipeline({"prep": "standard_scaler", "clf": "ridge",
-                         "clf.alpha": 0.5}).fit(X[:200], y[:200])
+                         "clf.ridge.alpha": 0.5}).fit(X[:200], y[:200])
         acc = (pipe.predict(X[200:]) == y[200:]).mean()
         assert acc > 0.6
 
@@ -67,7 +67,8 @@ class TestPipeline:
         space = pipeline_space()
         assert set(space["prep"].values) == set(PREPROCESSORS)
         assert set(space["clf"].values) == set(CLASSIFIERS)
-        assert "clf.alpha" in space and "clf.k" in space
+        # namespaced per component: same-named hyperparams don't collide
+        assert "clf.ridge.alpha" in space and "clf.knn.k" in space
 
 
 class TestEnsemble:
@@ -110,6 +111,32 @@ class TestAutoMLEndToEnd:
                     max_concurrent=3, seed=1)
         am.fit(X[:180], y[:180])
         assert am.score(X[180:], y[180:]) > 0.6
+
+    def test_hung_trial_times_out_without_killing_fit(self):
+        # pynisher-role test: a trial that never returns must be cancelled
+        # (worker killed + respawned), recorded as a timeout, and the rest
+        # of the search must proceed to a fitted ensemble
+        X, y = make_blobs(n=150, seed=8)
+
+        def flaky_eval(config, X_tr, y_tr, X_val, y_val, classes):
+            import time as _t
+            import numpy as _np
+            if config["clf"] in ("knn", "mlp"):
+                _t.sleep(120)          # deliberately hung trial
+            k = len(classes)
+            proba = _np.full((len(X_val), k), 1.0 / k)
+            return 0.5, proba
+
+        # timeout must comfortably exceed spawn-worker startup, or healthy
+        # trials get cancelled while their worker is still booting
+        am = AutoML(n_trials=6, searcher="evolution", ensemble_size=2,
+                    max_concurrent=2, trial_timeout=8.0, seed=0)
+        am._eval_fn = flaky_eval
+        am.fit(X, y)
+        timeouts = [r for r in am.records if r.error == "timeout"]
+        successes = [r for r in am.records if r.proba is not None]
+        assert timeouts, "no hung trial was sampled — adjust seed"
+        assert successes and am.ensemble_
 
     def test_crashing_pipeline_does_not_kill_search(self, monkeypatch):
         # poison one classifier: its trials fail, the search still completes

@@ -42,6 +42,26 @@ class TestObjectStore:
             used, n, _ = s.stats()
             assert n == 0 and used == 0
 
+    def test_delete_under_reader_is_deferred(self):
+        # get() contract: the zero-copy pointer stays valid until refcount 0,
+        # so delete-under-readers must defer the free to the last release
+        with ObjectStore(f"/tosem_t3d_{os.getpid()}", capacity=4 << 20) as s:
+            oid = ObjectID.random()
+            payload = b"y" * (100 << 10)
+            s.put(oid, payload)
+            view = s.get_view(oid)
+            s.delete(oid)
+            s.delete(oid)                     # double delete: idempotent
+            assert not s.contains(oid)        # invisible to new lookups
+            assert s.get(oid) is None
+            assert bytes(view) == payload     # existing view still valid
+            used, n, _ = s.stats()
+            assert n == 1 and used > 0        # space NOT yet reclaimed
+            del view
+            s.release(oid)                    # last reader → deferred free
+            used, n, _ = s.stats()
+            assert n == 0 and used == 0
+
     def test_lru_eviction_under_pressure(self):
         with ObjectStore(f"/tosem_t4_{os.getpid()}", capacity=4 << 20) as s:
             first = ObjectID.random()
@@ -356,6 +376,71 @@ class TestRegressions:
             oid = ObjectID.random()
             s.put(oid, b"y" * 100_000)  # still fits: clamped to min capacity
             assert s.get(oid) == b"y" * 100_000
+
+
+class TestCancel:
+    def test_cancel_running_task_kills_and_respawns(self, runtime):
+        @rt.remote
+        def hang():
+            time.sleep(120)
+
+        ref = hang.remote()
+        time.sleep(0.5)  # let the worker start grinding
+        rt.cancel(ref)
+        with pytest.raises(rt.TaskCancelledError):
+            rt.get(ref, timeout=10)
+
+        @rt.remote
+        def quick():
+            return 7
+
+        # the killed slot respawned; pool still serves work
+        assert rt.get(quick.remote(), timeout=30) == 7
+
+    def test_cancel_pending_task(self, runtime):
+        @rt.remote
+        def dep():
+            time.sleep(120)
+
+        @rt.remote
+        def child(x):
+            return x
+
+        blocker = dep.remote()
+        ref = child.remote(blocker)   # dep never resolves → stays pending
+        rt.cancel(ref)
+        with pytest.raises(rt.TaskCancelledError):
+            rt.get(ref, timeout=10)
+        rt.cancel(blocker)
+
+    def test_cancel_finished_task_is_noop(self, runtime):
+        @rt.remote
+        def f():
+            return 1
+
+        ref = f.remote()
+        assert rt.get(ref, timeout=30) == 1
+        rt.cancel(ref)
+        assert rt.get(ref) == 1
+
+
+class TestStartMethod:
+    def test_auto_spawn_when_jax_loaded(self):
+        # conftest imports jax before every test, so the fork default must
+        # flip to spawn (forked XLA threadpools deadlock) unless overridden
+        import sys
+        assert "jax" in sys.modules
+        from tosem_tpu.runtime.runtime import _default_start_method
+        assert _default_start_method() == "spawn"
+        prev = os.environ.get("TOSEM_RT_START_METHOD")
+        os.environ["TOSEM_RT_START_METHOD"] = "fork"
+        try:
+            assert _default_start_method() == "fork"
+        finally:
+            if prev is None:
+                del os.environ["TOSEM_RT_START_METHOD"]
+            else:
+                os.environ["TOSEM_RT_START_METHOD"] = prev
 
 
 class TestMicrobench:

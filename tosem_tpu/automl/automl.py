@@ -33,12 +33,19 @@ class Pipeline:
     clf: Any = None
 
     def fit(self, X, y):
-        prep_cls = PREPROCESSORS[self.config["prep"]]
-        clf_cls = CLASSIFIERS[self.config["clf"]]
-        prep_kw = {k[len("prep."):]: v for k, v in self.config.items()
-                   if k.startswith("prep.")}
-        clf_kw = {k[len("clf."):]: v for k, v in self.config.items()
-                  if k.startswith("clf.")}
+        prep_name = self.config["prep"]
+        clf_name = self.config["clf"]
+        prep_cls = PREPROCESSORS[prep_name]
+        clf_cls = CLASSIFIERS[clf_name]
+        # keys are namespaced per component ("clf.<name>.<hp>") so two
+        # classifiers with a same-named hyperparameter get independent
+        # search dimensions; only the chosen component's keys apply
+        prep_pre = f"prep.{prep_name}."
+        clf_pre = f"clf.{clf_name}."
+        prep_kw = {k[len(prep_pre):]: v for k, v in self.config.items()
+                   if k.startswith(prep_pre)}
+        clf_kw = {k[len(clf_pre):]: v for k, v in self.config.items()
+                  if k.startswith(clf_pre)}
         self.prep = prep_cls(**prep_kw).fit(X, y)
         Xt = self.prep.transform(X)
         self.clf = clf_cls(**clf_kw).fit(Xt, y)
@@ -60,10 +67,10 @@ def pipeline_space() -> Dict[str, Any]:
     }
     for name, cls in PREPROCESSORS.items():
         for k, dom in cls.config_space().items():
-            space[f"prep.{k}"] = dom
+            space[f"prep.{name}.{k}"] = dom
     for name, cls in CLASSIFIERS.items():
         for k, dom in cls.config_space().items():
-            space[f"clf.{k}"] = dom
+            space[f"clf.{name}.{k}"] = dom
     return space
 
 
@@ -130,6 +137,9 @@ class AutoML:
         self.seed = seed
         self.verbose = verbose
         self.records: List[TrialRecord] = []
+        # seam for fault-injection tests (hung/crashing evaluation), the
+        # role pynisher's subprocess boundary plays in auto-sklearn
+        self._eval_fn = _evaluate_pipeline
 
     def fit(self, X: np.ndarray, y: np.ndarray) -> "AutoML":
         import tosem_tpu.runtime as rt
@@ -178,7 +188,7 @@ class AutoML:
         return self
 
     def _search(self, rt, alg, X_tr, y_tr, X_val, y_val) -> None:
-        eval_fn = rt.remote(_evaluate_pipeline)
+        eval_fn = rt.remote(self._eval_fn)
         pending: List[Tuple[Dict, Any, float]] = []
         launched = 0
         Xtr_ref = rt.put(X_tr)

@@ -35,7 +35,7 @@ constexpr uint32_t kTableSlots = 1 << 13;  // open-addressed index (~460KB)
 constexpr uint64_t kAlign = 64;            // cache-line aligned payloads
 
 enum SlotState : uint32_t { kEmpty = 0, kUsed = 1, kTombstone = 2,
-                            kCreating = 3 };
+                            kCreating = 3, kPendingDelete = 4 };
 
 struct Slot {
   uint8_t id[kIdLen];
@@ -123,7 +123,8 @@ Slot* find_slot(Handle* h, const uint8_t* id, int for_insert) {
   Slot* first_tomb = nullptr;
   for (uint32_t i = 0; i < kTableSlots; i++) {
     Slot* s = &H->table[(start + i) & (kTableSlots - 1)];
-    if ((s->state == kUsed || s->state == kCreating) &&
+    if ((s->state == kUsed || s->state == kCreating ||
+         s->state == kPendingDelete) &&
         memcmp(s->id, id, kIdLen) == 0) return s;
     if (s->state == kTombstone && !first_tomb) first_tomb = s;
     if (s->state == kEmpty)
@@ -354,7 +355,7 @@ int objstore_get(void* vh, const uint8_t* id, const uint8_t** out_ptr,
   Header* H = hdr(h);
   if (lock(H) != 0) return OS_ERR_SYS;
   Slot* s = find_slot(h, id, 0);
-  if (!s || s->state == kCreating) { unlock(H); return OS_ERR_NOTFOUND; }
+  if (!s || s->state != kUsed) { unlock(H); return OS_ERR_NOTFOUND; }
   s->refcount++;
   s->lru = ++H->lru_tick;
   *out_ptr = h->base + s->offset;
@@ -400,7 +401,11 @@ int objstore_is_sealed(void* vh, const uint8_t* id) {
   Header* H = hdr(h);
   if (lock(H) != 0) return OS_ERR_SYS;
   Slot* s = find_slot(h, id, 0);
-  int r = !s ? OS_ERR_NOTFOUND : (s->state == kUsed ? 1 : 0);
+  // kPendingDelete reads as sealed: the write DID complete (then the
+  // object was deleted under readers) — an idempotent duplicate writer
+  // must treat it as "earlier attempt finished", not wait for a seal
+  int r = !s ? OS_ERR_NOTFOUND
+             : ((s->state == kUsed || s->state == kPendingDelete) ? 1 : 0);
   unlock(H);
   return r;
 }
@@ -454,6 +459,16 @@ int objstore_release(void* vh, const uint8_t* id) {
   Slot* s = find_slot(h, id, 0);
   if (!s) { unlock(H); return OS_ERR_NOTFOUND; }
   if (s->refcount > 0) s->refcount--;
+  if (s->state == kPendingDelete && s->refcount == 0) {
+    // last reader gone: perform the deferred delete (plasma semantics —
+    // the get() contract promises the zero-copy pointer stays valid
+    // until refcount hits 0, so delete-under-readers only marks)
+    H->used_bytes -= s->size;
+    H->num_objects--;
+    uint64_t block_off = s->offset - sizeof(BlockHeader);
+    s->state = kTombstone;
+    free_block(h, block_off);
+  }
   unlock(H);
   return OS_OK;
 }
@@ -474,6 +489,16 @@ int objstore_delete(void* vh, const uint8_t* id) {
   if (lock(H) != 0) return OS_ERR_SYS;
   Slot* s = find_slot(h, id, 0);
   if (!s) { unlock(H); return OS_ERR_NOTFOUND; }
+  if (s->state == kUsed && s->refcount > 0) {
+    // readers hold zero-copy views: defer the free to the last release
+    s->state = kPendingDelete;
+    unlock(H);
+    return OS_OK;
+  }
+  if (s->state == kPendingDelete) {  // double delete: idempotent
+    unlock(H);
+    return OS_OK;
+  }
   if (s->state == kUsed) {  // kCreating was never counted
     H->used_bytes -= s->size;
     H->num_objects--;

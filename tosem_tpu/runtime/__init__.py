@@ -6,14 +6,15 @@ native C++ shared-memory object store for large payloads. The raylet/GCS/
 Redis daemons collapse into the driver (JAX is single-controller already);
 what remains native is the data plane (:mod:`tosem_tpu.native` objstore).
 """
-from tosem_tpu.runtime.api import (ActorDiedError, ObjectRef, TaskError,
-                                   WorkerCrashedError, get, init,
+from tosem_tpu.runtime.api import (ActorDiedError, ObjectRef,
+                                   TaskCancelledError, TaskError,
+                                   WorkerCrashedError, cancel, get, init,
                                    is_initialized, kill, put, remote,
                                    shutdown, wait)
 from tosem_tpu.runtime.object_store import ObjectID, ObjectStore
 
 __all__ = [
     "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
-    "kill", "ObjectRef", "ObjectID", "ObjectStore", "TaskError",
-    "WorkerCrashedError", "ActorDiedError",
+    "kill", "cancel", "ObjectRef", "ObjectID", "ObjectStore", "TaskError",
+    "WorkerCrashedError", "ActorDiedError", "TaskCancelledError",
 ]

@@ -31,13 +31,15 @@ import threading
 from typing import Any, List, Optional, Sequence, Tuple, Union
 
 from tosem_tpu.runtime import common
-from tosem_tpu.runtime.common import (ActorDiedError, ObjectRef, TaskError,
+from tosem_tpu.runtime.common import (ActorDiedError, ObjectRef,
+                                      TaskCancelledError, TaskError,
                                       WorkerCrashedError)
 from tosem_tpu.runtime.runtime import Runtime
 
 __all__ = [
     "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
-    "kill", "ObjectRef", "TaskError", "WorkerCrashedError", "ActorDiedError",
+    "kill", "cancel", "ObjectRef", "TaskError", "WorkerCrashedError",
+    "ActorDiedError", "TaskCancelledError",
 ]
 
 _runtime: Optional[Runtime] = None
@@ -181,3 +183,10 @@ def wait(refs: Sequence[ObjectRef], num_returns: int = 1,
 
 def kill(actor: ActorHandle) -> None:
     _rt().kill_actor(actor._actor_id)
+
+
+def cancel(ref: ObjectRef) -> None:
+    """Cancel the task producing ``ref``; it resolves to
+    :class:`TaskCancelledError`. Best-effort on finished tasks
+    (``ray.cancel`` force semantics)."""
+    _rt().cancel(ref)

@@ -50,6 +50,10 @@ class ActorDiedError(RuntimeError_):
     """The actor's process died (and restarts, if any, were exhausted)."""
 
 
+class TaskCancelledError(RuntimeError_):
+    """The task was cancelled via ``rt.cancel`` (``ray.cancel`` semantics)."""
+
+
 class ObjectRef:
     """Future for a task result or put object (the ``ray.ObjectRef`` shape)."""
 

@@ -35,10 +35,21 @@ from typing import Any, Dict, List, Optional, Set, Tuple
 
 from tosem_tpu.runtime import common
 from tosem_tpu.runtime.common import (ActorDiedError, ObjectRef, StoreRef,
-                                      TaskError, TaskSpec, WorkerCrashedError)
+                                      TaskCancelledError, TaskError, TaskSpec,
+                                      WorkerCrashedError)
 from tosem_tpu.runtime.object_store import ObjectID, ObjectStore
 
-_START_METHOD = os.environ.get("TOSEM_RT_START_METHOD", "fork")
+
+def _default_start_method() -> str:
+    """fork is fastest, but forking a process that already imported JAX
+    duplicates a multithreaded XLA client whose threads are dead in the
+    child (deadlock risk the CPython fork warning is about) — so once jax
+    is loaded we default to spawn. Env var overrides either way."""
+    import sys
+    env = os.environ.get("TOSEM_RT_START_METHOD")
+    if env:
+        return env
+    return "spawn" if "jax" in sys.modules else "fork"
 
 
 class _Worker:
@@ -109,9 +120,7 @@ class Runtime:
                  store_capacity: int = 256 << 20,
                  max_task_retries: int = common.DEFAULT_MAX_TASK_RETRIES,
                  start_method: Optional[str] = None):
-        # "fork" is fast; use "spawn" when tasks import jax — a forked
-        # child inherits an XLA client whose threadpool died in the fork
-        self.ctx = mp.get_context(start_method or _START_METHOD)
+        self.ctx = mp.get_context(start_method or _default_start_method())
         self.store_name = f"/tosem_rt_{os.getpid()}_{int(time.time()*1e3)%int(1e9)}"
         self.store = ObjectStore(self.store_name, capacity=store_capacity)
         self.max_task_retries = max_task_retries
@@ -229,6 +238,68 @@ class Runtime:
             self._fail_actor_tasks_locked(actor_id,
                                           ActorDiedError("actor was killed"))
             rec.worker.kill()
+
+    def cancel(self, ref: ObjectRef) -> None:
+        """Cancel the task producing ``ref`` (``ray.cancel(force=True)``).
+
+        Pending (undispatched) tasks are simply dropped. Once the task has
+        been written to a worker's pipe the worker WILL execute it, so the
+        process is killed: for a stateless worker its other in-flight tasks
+        are re-queued WITHOUT charging a retry (they are victims, not
+        crashes) and a replacement worker is spawned immediately; for an
+        actor the ``max_restarts`` policy applies and concurrent queued
+        calls fail with :class:`ActorDiedError` (documented collateral —
+        the process is the cancellation boundary, as with pynisher/ray
+        force-cancel). The ref resolves to :class:`TaskCancelledError`.
+        Already-finished tasks are untouched (best-effort, like the
+        reference's ``core_worker.cc`` CancelTask path).
+        """
+        key = ref.oid.binary
+        with self.lock:
+            if self._ready_locked(key):
+                return
+            spec = next((s for s in self.specs.values()
+                         if s.result_ref.oid.binary == key), None)
+            if spec is None:
+                return  # not a task ref (e.g. a put), or already GC'd
+            # drain the owning worker's pipe first: a just-delivered "done"
+            # beats the kill (narrowest possible completed-vs-running race)
+            target: Optional[_Worker] = None
+            workers = list(self.task_workers) + [
+                r.worker for r in self.actors.values() if not r.dead]
+            for w in workers:
+                if spec.task_id in w.inflight:
+                    target = w
+                    self._drain_conn_locked(w)
+                    break
+            if self._ready_locked(key) or spec.task_id not in self.specs:
+                return  # completed during the drain
+            self.specs.pop(spec.task_id, None)
+            self.pending = [s for s in self.pending
+                            if s.task_id != spec.task_id]
+            self.errors[key] = TaskCancelledError("task was cancelled")
+            self.cv.notify_all()
+            if target is None or spec.task_id not in target.inflight:
+                return  # never dispatched (or drain re-homed it): dropped
+            target.inflight.remove(spec.task_id)
+            if target.actor_id is not None:
+                target.kill()  # sentinel path applies the restart policy
+                return
+            # stateless: retire the whole worker NOW so the dispatcher
+            # can't route new work to the corpse; re-queue its other
+            # in-flight tasks free of charge
+            if target in self.task_workers:
+                self.task_workers.remove(target)
+                for tid in reversed(target.inflight):
+                    s = self.specs.get(tid)
+                    if s is not None:
+                        self.pending.insert(0, s)
+                target.inflight.clear()
+                target.kill()
+                if not self._shutdown:
+                    self.task_workers.append(
+                        _Worker(self.ctx, self.store_name))
+                self._dispatch_locked()
 
     def put(self, value: Any) -> ObjectRef:
         kind, parts = common.dumps_parts(value)
