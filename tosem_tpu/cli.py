@@ -133,28 +133,35 @@ def run_resnet_train(fs: FlagSet) -> List[Any]:
     n_dev = len(jax.devices())
     batch = fs.batch or (256 if fs.device == "tpu" else 16)
     batch = max(batch // n_dev * n_dev, n_dev)
-    steps = fs.steps
+    steps = max(fs.steps, 1)  # at least one timed step (avoids div-by-0)
     model = resnet50(num_classes=10, small_inputs=True)
     opt = optax.sgd(0.1, momentum=0.9)
     ts = create_train_state(model, jax.random.PRNGKey(0), opt)
     mesh = default_mesh("dp") if n_dev > 1 else None
     step = make_train_step(model, opt, classification_loss, mesh=mesh)
-    batches = cifar_like_batches(batch, steps=steps + 6)
+    batches = list(cifar_like_batches(batch, steps=steps + 3))
     rng = jax.random.PRNGKey(1)
 
-    times = []
-    t_prev = None
-    for i, b in enumerate(batches):
+    # Two sync points only: per-step device_get would add a full tunnel
+    # round trip (~70ms) to every step. Warmup (compile) syncs once, then
+    # the timed block dispatches all steps back-to-back and syncs at the
+    # end — Python dispatch (~0.2ms/step) overlaps device execution.
+    warmup, timed = 3, steps
+    loss = None
+    for i, b in enumerate(batches[:warmup]):
         if mesh is not None:
             b = shard_batch(b, mesh)
         rng, sub = jax.random.split(rng)
         ts, metrics = step(ts, b, sub)
-        loss = float(jax.device_get(metrics["loss"]))  # sync point
-        now = time.perf_counter()
-        if t_prev is not None and i > 5:  # skip compile + warmup steps
-            times.append(now - t_prev)
-        t_prev = now
-    step_s = sorted(times)[len(times) // 2] if times else float("nan")
+    float(jax.device_get(metrics["loss"]))  # end-of-warmup sync
+    t0 = time.perf_counter()
+    for b in batches[warmup:warmup + timed]:
+        if mesh is not None:
+            b = shard_batch(b, mesh)
+        rng, sub = jax.random.split(rng)
+        ts, metrics = step(ts, b, sub)
+    loss = float(jax.device_get(metrics["loss"]))  # end-of-block sync
+    step_s = (time.perf_counter() - t0) / timed
     rows = [
         ResultRow(project="train", config="resnet_train",
                   bench_id=f"resnet50_cifar_b{batch}", metric="step_time_ms",

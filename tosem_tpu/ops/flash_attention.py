@@ -29,6 +29,11 @@ from jax.experimental.pallas import tpu as pltpu
 DEFAULT_BQ = 128
 DEFAULT_BK = 128
 _NEG_INF = -1e30
+# Mosaic requires the last two dims of every block to be (8k, 128k) or the
+# full array dim, so per-row statistics (LSE, delta) are carried broadcast
+# across a 128-lane minor dim (the official TPU flash kernel's MIN_BLOCK_SIZE
+# trick) instead of as rank-2 (rows,) vectors.
+_LANES = 128
 
 
 from tosem_tpu.ops.common import interpret_default as _interpret
@@ -80,7 +85,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bk, sm_scale, causal):
     else:
         m, l, acc = lax.fori_loop(0, n_k, body, (m0, l0, a0))
     o_ref[0] = (acc / l).astype(o_ref.dtype)
-    lse_ref[0] = (m + jnp.log(l))[:, 0]
+    lse_ref[0] = jnp.broadcast_to(m + jnp.log(l), (bq, _LANES))
 
 
 def _flash_fwd(q, k, v, sm_scale, causal, bq, bk):
@@ -106,15 +111,15 @@ def _flash_fwd(q, k, v, sm_scale, causal, bq, bk):
         ],
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, bq), lambda b, i: (b, i)),
+            pl.BlockSpec((1, bq, _LANES), lambda b, i: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B * H, Tq, d), q.dtype),
-            jax.ShapeDtypeStruct((B * H, Tq), jnp.float32),
+            jax.ShapeDtypeStruct((B * H, Tq, _LANES), jnp.float32),
         ],
         interpret=_interpret(),
     )(qr, kr, vr)
-    return out.reshape(B, H, Tq, d), lse.reshape(B, H, Tq)
+    return out.reshape(B, H, Tq, d), lse  # lse stays in lanes layout
 
 
 # ---------------------------------------------------------------------------
@@ -134,8 +139,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         qi = i * bq
         q = q_ref[0, pl.ds(qi, bq), :].astype(jnp.float32) * sm_scale
         do = do_ref[0, pl.ds(qi, bq), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(qi, bq)][:, None]
-        delta = delta_ref[0, pl.ds(qi, bq)][:, None]
+        lse = lse_ref[0, pl.ds(qi, bq), 0:1]     # lanes layout: col 0
+        delta = delta_ref[0, pl.ds(qi, bq), 0:1]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         if causal:
@@ -167,8 +172,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                    dq_ref, *, bk, sm_scale, causal):
     q = q_ref[0].astype(jnp.float32) * sm_scale
     do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0][:, None]
-    delta = delta_ref[0][:, None]
+    lse = lse_ref[0, :, 0:1]                     # lanes layout: col 0
+    delta = delta_ref[0, :, 0:1]
     bq, d = q.shape
     Tk = k_ref.shape[1]
     qi = pl.program_id(1) * bq
@@ -205,14 +210,14 @@ def _flash_bwd(sm_scale, causal, bq, bk, res, g):
     bq = min(bq, Tq)
     bk = min(bk, Tk)
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), -1)
-    shapes = dict(
-        q=q.reshape(B * H, Tq, d), k=k.reshape(B * H, Tk, d),
-        v=v.reshape(B * H, Tk, d), do=do.reshape(B * H, Tq, d),
-        lse=lse.reshape(B * H, Tq), delta=delta.reshape(B * H, Tq))
-    args = [shapes["q"], shapes["k"], shapes["v"], shapes["do"],
-            shapes["lse"], shapes["delta"]]
+    # per-row statistics travel in the (rows, 128)-lane layout (see _LANES)
+    delta_lanes = jnp.broadcast_to(
+        delta.reshape(B * H, Tq)[:, :, None], (B * H, Tq, _LANES))
+    args = [q.reshape(B * H, Tq, d), k.reshape(B * H, Tk, d),
+            v.reshape(B * H, Tk, d), do.reshape(B * H, Tq, d),
+            lse, delta_lanes]
     qspec_full = pl.BlockSpec((1, Tq, d), lambda b, j: (b, 0, 0))
-    vec_full = pl.BlockSpec((1, Tq), lambda b, j: (b, 0))
+    vec_full = pl.BlockSpec((1, Tq, _LANES), lambda b, j: (b, 0, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, bq=bq, sm_scale=sm_scale,
                           causal=causal),
@@ -235,8 +240,8 @@ def _flash_bwd(sm_scale, causal, bq, bk, res, g):
         in_specs=[pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
                   kv_full, kv_full,
                   pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
-                  pl.BlockSpec((1, bq), lambda b, i: (b, i)),
-                  pl.BlockSpec((1, bq), lambda b, i: (b, i))],
+                  pl.BlockSpec((1, bq, _LANES), lambda b, i: (b, i, 0)),
+                  pl.BlockSpec((1, bq, _LANES), lambda b, i: (b, i, 0))],
         out_specs=pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B * H, Tq, d), q.dtype),
         interpret=_interpret(),

@@ -37,6 +37,9 @@ def _rows_grid(n_rows: int) -> Tuple[int, int]:
 # ---------------------------------------------------------------------------
 
 def _ln_fwd_kernel(x_ref, g_ref, b_ref, o_ref, mu_ref, rstd_ref, *, eps):
+    # all operands rank-2: Mosaic rejects rank-1 blocks (XLA tiles 1D
+    # arrays T(1024) vs Mosaic's T(256)); params travel as (1, D) and the
+    # row statistics as (rows, 1)
     x = x_ref[:].astype(jnp.float32)
     mu = jnp.mean(x, -1, keepdims=True)
     xc = x - mu
@@ -45,8 +48,8 @@ def _ln_fwd_kernel(x_ref, g_ref, b_ref, o_ref, mu_ref, rstd_ref, *, eps):
     y = xc * rstd
     o_ref[:] = (y * g_ref[:].astype(jnp.float32)
                 + b_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
-    mu_ref[:] = mu[:, 0]
-    rstd_ref[:] = rstd[:, 0]
+    mu_ref[:] = mu
+    rstd_ref[:] = rstd
 
 
 def _ln_bwd_kernel(x_ref, g_ref, mu_ref, rstd_ref, dy_ref,
@@ -54,17 +57,22 @@ def _ln_bwd_kernel(x_ref, g_ref, mu_ref, rstd_ref, dy_ref,
     x = x_ref[:].astype(jnp.float32)
     g = g_ref[:].astype(jnp.float32)
     dy = dy_ref[:].astype(jnp.float32)
-    mu = mu_ref[:][:, None]
-    rstd = rstd_ref[:][:, None]
+    mu = mu_ref[:]
+    rstd = rstd_ref[:]
     xhat = (x - mu) * rstd
     wdy = dy * g
     c1 = jnp.mean(wdy, -1, keepdims=True)
     c2 = jnp.mean(wdy * xhat, -1, keepdims=True)
     dx = (wdy - c1 - xhat * c2) * rstd
     dx_ref[:] = dx.astype(dx_ref.dtype)
-    # per-block partial reductions for dgamma/dbeta (summed outside)
-    dg_ref[:] = jnp.sum(dy * xhat, 0, keepdims=True)
-    db_ref[:] = jnp.sum(dy, 0, keepdims=True)
+    # dgamma/dbeta accumulate across the sequential TPU grid into one
+    # (1, D) block (constant index_map revisits it each iteration)
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        dg_ref[:] = jnp.zeros_like(dg_ref)
+        db_ref[:] = jnp.zeros_like(db_ref)
+    dg_ref[:] += jnp.sum(dy * xhat, 0, keepdims=True)
+    db_ref[:] += jnp.sum(dy, 0, keepdims=True)
 
 
 def _ln_fwd(x2, gamma, beta, eps):
@@ -74,16 +82,16 @@ def _ln_fwd(x2, gamma, beta, eps):
         functools.partial(_ln_fwd_kernel, eps=eps),
         grid=(n_blocks,),
         in_specs=[pl.BlockSpec((br, D), lambda i: (i, 0)),
-                  pl.BlockSpec((D,), lambda i: (0,)),
-                  pl.BlockSpec((D,), lambda i: (0,))],
+                  pl.BlockSpec((1, D), lambda i: (0, 0)),
+                  pl.BlockSpec((1, D), lambda i: (0, 0))],
         out_specs=[pl.BlockSpec((br, D), lambda i: (i, 0)),
-                   pl.BlockSpec((br,), lambda i: (i,)),
-                   pl.BlockSpec((br,), lambda i: (i,))],
+                   pl.BlockSpec((br, 1), lambda i: (i, 0)),
+                   pl.BlockSpec((br, 1), lambda i: (i, 0))],
         out_shape=[jax.ShapeDtypeStruct((R, D), x2.dtype),
-                   jax.ShapeDtypeStruct((R,), jnp.float32),
-                   jax.ShapeDtypeStruct((R,), jnp.float32)],
+                   jax.ShapeDtypeStruct((R, 1), jnp.float32),
+                   jax.ShapeDtypeStruct((R, 1), jnp.float32)],
         interpret=_interpret(),
-    )(x2, gamma, beta)
+    )(x2, gamma.reshape(1, D), beta.reshape(1, D))
     return out, mu, rstd
 
 
@@ -106,24 +114,24 @@ def _ln_vjp_bwd(eps, res, dy):
     R, D = x2.shape
     dy2 = dy.reshape(R, D)
     br, n_blocks = _rows_grid(R)
-    dx, dg_parts, db_parts = pl.pallas_call(
+    dx, dg2, db2 = pl.pallas_call(
         _ln_bwd_kernel,
         grid=(n_blocks,),
         in_specs=[pl.BlockSpec((br, D), lambda i: (i, 0)),
-                  pl.BlockSpec((D,), lambda i: (0,)),
-                  pl.BlockSpec((br,), lambda i: (i,)),
-                  pl.BlockSpec((br,), lambda i: (i,)),
+                  pl.BlockSpec((1, D), lambda i: (0, 0)),
+                  pl.BlockSpec((br, 1), lambda i: (i, 0)),
+                  pl.BlockSpec((br, 1), lambda i: (i, 0)),
                   pl.BlockSpec((br, D), lambda i: (i, 0))],
         out_specs=[pl.BlockSpec((br, D), lambda i: (i, 0)),
-                   pl.BlockSpec((1, D), lambda i: (i, 0)),
-                   pl.BlockSpec((1, D), lambda i: (i, 0))],
+                   pl.BlockSpec((1, D), lambda i: (0, 0)),
+                   pl.BlockSpec((1, D), lambda i: (0, 0))],
         out_shape=[jax.ShapeDtypeStruct((R, D), x2.dtype),
-                   jax.ShapeDtypeStruct((n_blocks, D), jnp.float32),
-                   jax.ShapeDtypeStruct((n_blocks, D), jnp.float32)],
+                   jax.ShapeDtypeStruct((1, D), jnp.float32),
+                   jax.ShapeDtypeStruct((1, D), jnp.float32)],
         interpret=_interpret(),
-    )(x2, gamma, mu, rstd, dy2)
-    dg = jnp.sum(dg_parts, 0).astype(gamma.dtype)
-    db = jnp.sum(db_parts, 0).astype(gamma.dtype)
+    )(x2, gamma.reshape(1, D), mu, rstd, dy2)
+    dg = dg2[0].astype(gamma.dtype)
+    db = db2[0].astype(gamma.dtype)
     return dx.reshape(orig_shape), dg, db
 
 
