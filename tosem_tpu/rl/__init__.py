@@ -1,0 +1,20 @@
+"""RL family — PPO with in-graph rollouts and distributed workers.
+
+TPU-first redesign of the reference's RLlib layer (SURVEY §2.1): the
+sampling loop compiles into ``lax.scan`` on device (:mod:`.env`, :mod:`.ppo`)
+and the DD-PPO topology maps to a GSPMD data-parallel update fed by actor
+rollout workers (:mod:`.workers`).
+"""
+from tosem_tpu.rl.env import CartPole, EnvSpec, batch_reset, batch_step
+from tosem_tpu.rl.gae import gae_advantages
+from tosem_tpu.rl.policy import ActorCritic, entropy, log_prob, sample_action
+from tosem_tpu.rl.ppo import (PPOConfig, Trajectory, flatten_trajectory,
+                              make_ppo_update, ppo_loss, rollout, train_ppo)
+from tosem_tpu.rl.workers import DistributedPPO, RolloutWorker
+
+__all__ = [
+    "CartPole", "EnvSpec", "batch_reset", "batch_step", "gae_advantages",
+    "ActorCritic", "entropy", "log_prob", "sample_action", "PPOConfig",
+    "Trajectory", "flatten_trajectory", "make_ppo_update", "ppo_loss",
+    "rollout", "train_ppo", "DistributedPPO", "RolloutWorker",
+]

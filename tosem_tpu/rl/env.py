@@ -1,0 +1,103 @@
+"""Pure-function JAX environments for in-graph rollouts.
+
+The reference's RL layer (SURVEY §2.1 RLlib) samples with CPU rollout
+workers stepping Python envs (``rllib/evaluation/rollout_worker.py``). The
+TPU-first redesign makes the environment itself a pure jittable function so
+the ENTIRE rollout — policy forward, sampling, env dynamics, auto-reset —
+compiles into one ``lax.scan`` on device: no host↔device ping-pong per step.
+The classic-control dynamics below match the Gym ``CartPole-v1`` constants
+so learning curves are comparable to the reference's tuned examples
+(``rllib/tuned_examples/``).
+
+Host-process rollout workers (the faithful DD-PPO topology) live in
+``tosem_tpu.rl.workers`` and reuse these same pure functions on CPU.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class EnvSpec(NamedTuple):
+    obs_dim: int
+    n_actions: int
+    max_steps: int
+
+
+class CartPole:
+    """CartPole-v1 dynamics as pure functions over a state pytree.
+
+    State: {"phys": (4,) float32, "t": int32, "key": PRNGKey}.
+    ``step`` auto-resets on termination (the standard vectorized-env
+    convention) and reports the pre-reset ``done``/``reward``.
+    """
+
+    spec = EnvSpec(obs_dim=4, n_actions=2, max_steps=500)
+
+    GRAVITY = 9.8
+    CART_MASS = 1.0
+    POLE_MASS = 0.1
+    TOTAL_MASS = CART_MASS + POLE_MASS
+    LENGTH = 0.5                      # half pole length
+    POLE_ML = POLE_MASS * LENGTH
+    FORCE = 10.0
+    DT = 0.02
+    X_LIMIT = 2.4
+    THETA_LIMIT = 12 * 2 * jnp.pi / 360
+
+    @classmethod
+    def _sample_phys(cls, key):
+        return jax.random.uniform(key, (4,), jnp.float32, -0.05, 0.05)
+
+    @classmethod
+    def reset(cls, key) -> dict:
+        k1, k2 = jax.random.split(key)
+        return {"phys": cls._sample_phys(k1), "t": jnp.zeros((), jnp.int32),
+                "key": k2}
+
+    @classmethod
+    def obs(cls, state) -> jax.Array:
+        return state["phys"]
+
+    @classmethod
+    def step(cls, state, action) -> Tuple[dict, jax.Array, jax.Array,
+                                          jax.Array]:
+        """→ (next_state, obs, reward, done); auto-resets when done."""
+        x, x_dot, th, th_dot = (state["phys"][0], state["phys"][1],
+                                state["phys"][2], state["phys"][3])
+        force = jnp.where(action == 1, cls.FORCE, -cls.FORCE)
+        cos, sin = jnp.cos(th), jnp.sin(th)
+        temp = (force + cls.POLE_ML * th_dot ** 2 * sin) / cls.TOTAL_MASS
+        th_acc = (cls.GRAVITY * sin - cos * temp) / (
+            cls.LENGTH * (4.0 / 3.0 - cls.POLE_MASS * cos ** 2
+                          / cls.TOTAL_MASS))
+        x_acc = temp - cls.POLE_ML * th_acc * cos / cls.TOTAL_MASS
+        x = x + cls.DT * x_dot
+        x_dot = x_dot + cls.DT * x_acc
+        th = th + cls.DT * th_dot
+        th_dot = th_dot + cls.DT * th_acc
+        phys = jnp.stack([x, x_dot, th, th_dot])
+        t = state["t"] + 1
+        done = ((jnp.abs(x) > cls.X_LIMIT)
+                | (jnp.abs(th) > cls.THETA_LIMIT)
+                | (t >= cls.spec.max_steps))
+        reward = jnp.float32(1.0)
+        # auto-reset: where done, swap in a fresh episode
+        k_reset, k_next = jax.random.split(state["key"])
+        fresh = cls._sample_phys(k_reset)
+        phys = jnp.where(done, fresh, phys)
+        t = jnp.where(done, 0, t)
+        nxt = {"phys": phys, "t": t, "key": k_next}
+        return nxt, phys, reward, done
+
+
+def batch_reset(env, key, n_envs: int):
+    """Vectorized reset: n independent env states."""
+    return jax.vmap(env.reset)(jax.random.split(key, n_envs))
+
+
+def batch_step(env, states, actions):
+    """Vectorized step over the leading env axis."""
+    return jax.vmap(env.step)(states, actions)

@@ -1,0 +1,37 @@
+"""Generalized advantage estimation as a reverse ``lax.scan``.
+
+The reference computes GAE in NumPy per rollout slice
+(``rllib/evaluation/postprocessing.py:34`` ``compute_advantages``); here it
+is a jitted time-reversed scan so it fuses into the update step and runs on
+device over [T, B] trajectory tensors.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def gae_advantages(rewards, values, dones, last_value, *,
+                   gamma: float = 0.99, lam: float = 0.95
+                   ) -> Tuple[jax.Array, jax.Array]:
+    """rewards/values/dones: [T, ...]; last_value: [...] (bootstrap).
+
+    ``dones[t]`` marks that the transition at t ENDED an episode: the
+    bootstrap value of the next state is masked.
+    → (advantages [T, ...], returns [T, ...]) with returns = adv + values.
+    """
+    next_values = jnp.concatenate([values[1:], last_value[None]], 0)
+    not_done = 1.0 - dones.astype(values.dtype)
+    deltas = rewards + gamma * next_values * not_done - values
+
+    def back(carry, xs):
+        delta, nd = xs
+        adv = delta + gamma * lam * nd * carry
+        return adv, adv
+
+    _, advs = lax.scan(back, jnp.zeros_like(last_value),
+                       (deltas, not_done), reverse=True)
+    return advs, advs + values

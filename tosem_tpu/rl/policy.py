@@ -1,0 +1,66 @@
+"""Actor-critic policy on the nn module system.
+
+The reference's policy-model role (``rllib/models/tf/fcnet.py`` — the
+default two-hidden-layer tanh net shared by PPO configs) on
+:mod:`tosem_tpu.nn.core`: one torso, two heads, everything a pure function
+of the params pytree so rollouts and updates jit/shard cleanly.
+"""
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tosem_tpu.nn.core import Module, variables
+from tosem_tpu.nn.layers import Dense
+
+
+class ActorCritic(Module):
+    """obs → (logits over actions, value)."""
+
+    def __init__(self, obs_dim: int, n_actions: int,
+                 hidden: Sequence[int] = (64, 64)):
+        self.obs_dim = obs_dim
+        self.n_actions = n_actions
+        dims = [obs_dim] + list(hidden)
+        self.torso = [Dense(i, o) for i, o in zip(dims[:-1], dims[1:])]
+        self.pi_head = Dense(dims[-1], n_actions)
+        self.v_head = Dense(dims[-1], 1)
+
+    def init(self, key):
+        ks = jax.random.split(key, len(self.torso) + 2)
+        params = {
+            "torso": {str(i): m.init(k)["params"]
+                      for i, (m, k) in enumerate(zip(self.torso, ks))},
+            "pi": self.pi_head.init(ks[-2])["params"],
+            "v": self.v_head.init(ks[-1])["params"],
+        }
+        return variables(params)
+
+    def apply(self, vs, obs, *, train=False, rng=None):
+        x = obs
+        for i, m in enumerate(self.torso):
+            x, _ = m.apply(variables(vs["params"]["torso"][str(i)]), x)
+            x = jnp.tanh(x)
+        logits, _ = self.pi_head.apply(variables(vs["params"]["pi"]), x)
+        value, _ = self.v_head.apply(variables(vs["params"]["v"]), x)
+        return (logits, value[..., 0]), vs["state"]
+
+
+def sample_action(key, logits) -> Tuple[jax.Array, jax.Array]:
+    """→ (action, log_prob) from categorical logits."""
+    action = jax.random.categorical(key, logits)
+    logp = jax.nn.log_softmax(logits)
+    return action, jnp.take_along_axis(
+        logp, action[..., None], axis=-1)[..., 0]
+
+
+def log_prob(logits, action) -> jax.Array:
+    logp = jax.nn.log_softmax(logits)
+    return jnp.take_along_axis(logp, action[..., None], axis=-1)[..., 0]
+
+
+def entropy(logits) -> jax.Array:
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.sum(jnp.exp(logp) * logp, axis=-1)

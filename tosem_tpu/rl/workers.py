@@ -1,0 +1,116 @@
+"""Distributed rollout workers on the actor runtime (DD-PPO topology).
+
+The faithful analog of the reference's sampling architecture: RLlib rollout
+workers are long-lived actor processes that step environments and ship
+sample batches to the learner (``rllib/evaluation/rollout_worker.py``;
+DD-PPO wiring at ``rllib/agents/ppo/ddppo.py:66``). Here each worker is a
+:mod:`tosem_tpu.runtime` actor running the SAME pure-function env + policy
+on CPU; the learner gathers batches, runs the (optionally mesh-sharded)
+PPO update, and broadcasts fresh params — learning stays centralized on
+the TPU program while sampling scales across host processes.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import tosem_tpu.runtime as rt
+from tosem_tpu.rl.ppo import PPOConfig, flatten_trajectory, make_ppo_update
+
+
+@rt.remote(max_restarts=2)
+class RolloutWorker:
+    """Holds env states + a policy copy; collects one rollout per call."""
+
+    def __init__(self, env_name: str, n_envs: int, rollout_len: int,
+                 hidden: Tuple[int, ...], seed: int):
+        import jax
+        jax.config.update("jax_platforms", "cpu")  # workers sample on host
+        from tosem_tpu.rl.env import CartPole, batch_reset
+        from tosem_tpu.rl.policy import ActorCritic
+        envs = {"cartpole": CartPole}
+        self.env = envs[env_name]
+        self.model = ActorCritic(self.env.spec.obs_dim,
+                                 self.env.spec.n_actions, hidden)
+        import functools
+        from tosem_tpu.rl.ppo import rollout
+        self.rollout_len = rollout_len
+        self.key = jax.random.PRNGKey(seed)
+        self.key, k_env = jax.random.split(self.key)
+        self.states = batch_reset(self.env, k_env, n_envs)
+        self._roll = jax.jit(functools.partial(rollout, self.model,
+                                               env=self.env,
+                                               length=rollout_len))
+
+    def sample(self, params) -> Dict[str, np.ndarray]:
+        """Collect one rollout under ``params`` → numpy trajectory dict."""
+        import jax
+        self.key, k = jax.random.split(self.key)
+        traj, self.states, last_value = self._roll(
+            params, env_states=self.states, key=k)
+        out = {f: np.asarray(getattr(traj, f)) for f in traj._fields}
+        out["last_value"] = np.asarray(last_value)
+        return out
+
+
+class DistributedPPO:
+    """Learner + N rollout-worker actors (``ddppo.py:157-203`` shape)."""
+
+    def __init__(self, env, env_name: str = "cartpole", *,
+                 n_workers: int = 2, cfg: PPOConfig = PPOConfig(),
+                 hidden=(64, 64), seed: int = 0, mesh=None):
+        import jax
+        import optax
+        from tosem_tpu.rl.policy import ActorCritic
+        self.env = env
+        self.cfg = cfg
+        self.model = ActorCritic(env.spec.obs_dim, env.spec.n_actions,
+                                 hidden)
+        self.params = self.model.init(jax.random.PRNGKey(seed))["params"]
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(cfg.max_grad_norm),
+            optax.adam(cfg.lr))
+        self.opt_state = self.optimizer.init(self.params)
+        self.update = make_ppo_update(self.model, self.optimizer, cfg,
+                                      mesh=mesh)
+        self.mesh = mesh
+        per_worker = max(cfg.n_envs // n_workers, 1)
+        self.workers = [
+            RolloutWorker.remote(env_name, per_worker, cfg.rollout_len,
+                                 tuple(hidden), seed + 1 + i)
+            for i in range(n_workers)]
+
+    def train_iteration(self) -> Dict[str, float]:
+        """One sync round: broadcast params → gather → update epochs."""
+        import jax
+        import jax.numpy as jnp
+        from tosem_tpu.rl.ppo import Trajectory, shard_minibatch
+        params_ref = rt.put(jax.device_get(self.params))
+        samples = rt.get([w.sample.remote(params_ref)
+                          for w in self.workers], timeout=120.0)
+        # concatenate worker batches along the env axis
+        traj = Trajectory(*[
+            jnp.concatenate([jnp.asarray(s[f]) for s in samples], axis=1)
+            for f in Trajectory._fields])
+        last_value = jnp.concatenate(
+            [jnp.asarray(s["last_value"]) for s in samples], axis=0)
+        batch = flatten_trajectory(traj, last_value, self.cfg)
+        n = batch["obs"].shape[0]
+        mb = n // self.cfg.minibatches
+        key = jax.random.PRNGKey(int(traj.rewards.sum()) + n)
+        metrics = {}
+        for _ in range(self.cfg.epochs):
+            key, k = jax.random.split(key)
+            perm = jax.random.permutation(k, n)
+            for i in range(self.cfg.minibatches):
+                idx = perm[i * mb:(i + 1) * mb]
+                minib = {k2: v[idx] for k2, v in batch.items()}
+                if self.mesh is not None:
+                    minib = shard_minibatch(minib, self.mesh)
+                self.params, self.opt_state, metrics = self.update(
+                    self.params, self.opt_state, minib)
+        ep = float(traj.dones.sum())
+        return {"mean_return": float(traj.rewards.sum()) / max(ep, 1.0),
+                "pg_loss": float(metrics["pg_loss"]),
+                "entropy": float(metrics["entropy"])}

@@ -120,7 +120,13 @@ class Runtime:
                  store_capacity: int = 256 << 20,
                  max_task_retries: int = common.DEFAULT_MAX_TASK_RETRIES,
                  start_method: Optional[str] = None):
-        self.ctx = mp.get_context(start_method or _default_start_method())
+        # a pinned method (arg or env) is honored forever; otherwise the
+        # context is re-picked at every worker spawn — a Runtime created
+        # before jax was imported must still switch to spawn for workers
+        # forked AFTER jax arrives (respawns, new actors)
+        self._pinned_method = start_method or os.environ.get(
+            "TOSEM_RT_START_METHOD")
+        self.ctx = self._make_ctx()
         self.store_name = f"/tosem_rt_{os.getpid()}_{int(time.time()*1e3)%int(1e9)}"
         self.store = ObjectStore(self.store_name, capacity=store_capacity)
         self.max_task_retries = max_task_retries
@@ -152,6 +158,10 @@ class Runtime:
         self._thread = threading.Thread(target=self._scheduler_loop,
                                         daemon=True, name="tosem-scheduler")
         self._thread.start()
+
+    def _make_ctx(self):
+        return mp.get_context(self._pinned_method
+                              or _default_start_method())
 
     # ------------------------------------------------------------------ API
 
@@ -189,7 +199,7 @@ class Runtime:
     def create_actor(self, cls_blob_args: bytes, max_restarts: int) -> bytes:
         actor_id = os.urandom(16)
         with self.lock:
-            w = _Worker(self.ctx, self.store_name, actor_id=actor_id)
+            w = _Worker(self._make_ctx(), self.store_name, actor_id=actor_id)
             self.actors[actor_id] = _ActorRecord(w, cls_blob_args,
                                                  max_restarts)
             self._send(w, ("actor_init", cls_blob_args))
@@ -279,8 +289,19 @@ class Runtime:
                             if s.task_id != spec.task_id]
             self.errors[key] = TaskCancelledError("task was cancelled")
             self.cv.notify_all()
-            if target is None or spec.task_id not in target.inflight:
-                return  # never dispatched (or drain re-homed it): dropped
+            # re-locate the task: the drain may have re-homed it (worker
+            # died mid-drain → death handler re-queued and re-dispatched
+            # it onto a DIFFERENT worker). Killing only the original
+            # target would leave the hung task grinding its new slot.
+            target = None
+            for w in (list(self.task_workers)
+                      + [r.worker for r in self.actors.values()
+                         if not r.dead]):
+                if spec.task_id in w.inflight:
+                    target = w
+                    break
+            if target is None:
+                return  # never dispatched (or dropped back to pending)
             target.inflight.remove(spec.task_id)
             if target.actor_id is not None:
                 target.kill()  # sentinel path applies the restart policy
@@ -298,7 +319,7 @@ class Runtime:
                 target.kill()
                 if not self._shutdown:
                     self.task_workers.append(
-                        _Worker(self.ctx, self.store_name))
+                        _Worker(self._make_ctx(), self.store_name))
                 self._dispatch_locked()
 
     def put(self, value: Any) -> ObjectRef:
@@ -634,7 +655,7 @@ class Runtime:
             if rec.restarts < rec.max_restarts:
                 # restart policy: python/ray/actor.py:269-280 max_restarts
                 rec.restarts += 1
-                rec.worker = _Worker(self.ctx, self.store_name,
+                rec.worker = _Worker(self._make_ctx(), self.store_name,
                                      actor_id=w.actor_id)
                 self._send(rec.worker, ("actor_init", rec.init_blob))
                 self._dispatch_locked()
@@ -661,6 +682,6 @@ class Runtime:
                             "worker died executing task; retries exhausted")
             w.inflight.clear()
             if not self._shutdown:
-                self.task_workers.append(_Worker(self.ctx, self.store_name))
+                self.task_workers.append(_Worker(self._make_ctx(), self.store_name))
             self.cv.notify_all()
             self._dispatch_locked()
