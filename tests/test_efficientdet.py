@@ -157,3 +157,10 @@ class TestTrainFakeData:
                                  target_box[0]))[0, 0]
         assert iou > 0.5
         assert classes[0] == 2
+        # BASELINE.md's metric: COCO-style AP on the overfit image
+        from tosem_tpu.models.detection_eval import evaluate_detections
+        ap = evaluate_detections(
+            [{"boxes": boxes, "scores": scores, "classes": classes}],
+            [{"boxes": np.asarray(target_box[0]),
+              "classes": np.asarray(target_cls[0])}])
+        assert ap["AP50"] > 0.9, ap

@@ -18,8 +18,10 @@ class TestCartPole:
         states = batch_reset(CartPole, jax.random.PRNGKey(0), 5)
         assert states["phys"].shape == (5, 4)
         actions = jnp.ones((5,), jnp.int32)
-        states, obs, reward, done = batch_step(CartPole, states, actions)
-        assert obs.shape == (5, 4) and reward.shape == (5,)
+        states, nobs, reward, term, trunc = batch_step(CartPole, states,
+                                                       actions)
+        assert nobs.shape == (5, 4) and reward.shape == (5,)
+        assert term.shape == (5,) and trunc.shape == (5,)
         assert bool(jnp.all(reward == 1.0))
 
     def test_pole_falls_without_control(self):
@@ -28,9 +30,9 @@ class TestCartPole:
         states = batch_reset(CartPole, jax.random.PRNGKey(1), 3)
         done_any = jnp.zeros((3,), bool)
         for _ in range(300):
-            states, _, _, done = batch_step(
+            states, _, _, term, trunc = batch_step(
                 CartPole, states, jnp.ones((3,), jnp.int32))
-            done_any = done_any | done
+            done_any = done_any | term | trunc
         assert bool(jnp.all(done_any))
 
     def test_auto_reset_on_done(self):
@@ -38,8 +40,10 @@ class TestCartPole:
         state = CartPole.reset(jax.random.PRNGKey(2))
         # force a terminal state: x beyond the limit
         state["phys"] = jnp.array([5.0, 0.0, 0.0, 0.0])
-        nxt, obs, reward, done = CartPole.step(state, jnp.int32(0))
-        assert bool(done)
+        nxt, nobs, reward, term, trunc = CartPole.step(state, jnp.int32(0))
+        assert bool(term) and not bool(trunc)
+        # nobs is the true (pre-reset) s'; the state carries the reset
+        assert float(jnp.abs(nobs[0])) > 2.4
         assert float(jnp.abs(nxt["phys"][0])) < 0.1  # fresh episode
         assert int(nxt["t"]) == 0
 

@@ -1,0 +1,19 @@
+"""Observability: metric registry, Prometheus export, memory/log monitors.
+
+The reference's stats + monitoring plane (``src/ray/stats/metric_defs.h``,
+``python/ray/metrics_agent.py`` / ``prometheus_exporter.py``,
+``memory_monitor.py``, ``log_monitor.py``) collapsed to the
+single-controller topology (SURVEY §5.5).
+"""
+from tosem_tpu.obs import metrics
+from tosem_tpu.obs.log_monitor import LogMonitor
+from tosem_tpu.obs.memory_monitor import MemoryMonitor
+from tosem_tpu.obs.metrics import (Counter, Gauge, Histogram, MetricsServer,
+                                   Registry, counter, gauge, histogram,
+                                   prometheus_text)
+
+__all__ = [
+    "metrics", "Counter", "Gauge", "Histogram", "Registry", "MetricsServer",
+    "counter", "gauge", "histogram", "prometheus_text", "MemoryMonitor",
+    "LogMonitor",
+]

@@ -1,0 +1,227 @@
+"""Metric registry + Prometheus text exporter.
+
+The reference defines its runtime metrics centrally
+(``src/ray/stats/metric_defs.h``) and exports them to Prometheus via an
+agent (``python/ray/metrics_agent.py``, ``prometheus_exporter.py``). Same
+shape here: typed metric objects registered in a (default-global) registry,
+rendered in the Prometheus text exposition format, optionally served over
+HTTP. The runtime increments task/actor/store counters through this module.
+
+Thread-safe; label sets are materialized lazily per label-values tuple.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+_DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0,
+                    50.0, float("inf"))
+
+
+def _escape(v: str) -> str:
+    return (v.replace("\\", "\\\\").replace("\n", "\\n")
+            .replace('"', '\\"'))
+
+
+def _fmt_labels(names: Sequence[str], values: Tuple[str, ...]) -> str:
+    if not names:
+        return ""
+    inner = ",".join(f'{n}="{_escape(v)}"' for n, v in zip(names, values))
+    return "{" + inner + "}"
+
+
+class Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, description: str = "",
+                 labels: Sequence[str] = ()):
+        self.name = name
+        self.description = description
+        self.label_names = tuple(labels)
+        self._lock = threading.Lock()
+        self._series: Dict[Tuple[str, ...], float] = {}
+
+    def _key(self, label_values) -> Tuple[str, ...]:
+        vals = tuple(str(v) for v in label_values)
+        if len(vals) != len(self.label_names):
+            raise ValueError(f"{self.name}: expected labels "
+                             f"{self.label_names}, got {vals}")
+        return vals
+
+    def collect(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.description}",
+               f"# TYPE {self.name} {self.kind}"]
+        with self._lock:
+            series = dict(self._series)
+        for vals, v in sorted(series.items()):
+            out.append(f"{self.name}"
+                       f"{_fmt_labels(self.label_names, vals)} {v}")
+        return out
+
+
+class Counter(Metric):
+    kind = "counter"
+
+    def inc(self, amount: float = 1.0, labels: Sequence[str] = ()) -> None:
+        if amount < 0:
+            raise ValueError("counters only go up")
+        k = self._key(labels)
+        with self._lock:
+            self._series[k] = self._series.get(k, 0.0) + amount
+
+    def value(self, labels: Sequence[str] = ()) -> float:
+        with self._lock:
+            return self._series.get(self._key(labels), 0.0)
+
+
+class Gauge(Metric):
+    kind = "gauge"
+
+    def set(self, value: float, labels: Sequence[str] = ()) -> None:
+        with self._lock:
+            self._series[self._key(labels)] = float(value)
+
+    def inc(self, amount: float = 1.0, labels: Sequence[str] = ()) -> None:
+        k = self._key(labels)
+        with self._lock:
+            self._series[k] = self._series.get(k, 0.0) + amount
+
+    def value(self, labels: Sequence[str] = ()) -> float:
+        with self._lock:
+            return self._series.get(self._key(labels), 0.0)
+
+
+class Histogram(Metric):
+    kind = "histogram"
+
+    def __init__(self, name: str, description: str = "",
+                 labels: Sequence[str] = (),
+                 buckets: Sequence[float] = _DEFAULT_BUCKETS):
+        super().__init__(name, description, labels)
+        self.buckets = tuple(sorted(set(buckets) | {float("inf")}))
+        self._counts: Dict[Tuple[str, ...], List[int]] = {}
+        self._sums: Dict[Tuple[str, ...], float] = {}
+
+    def observe(self, value: float, labels: Sequence[str] = ()) -> None:
+        k = self._key(labels)
+        with self._lock:
+            counts = self._counts.setdefault(k, [0] * len(self.buckets))
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    counts[i] += 1
+                    break
+            self._sums[k] = self._sums.get(k, 0.0) + value
+
+    def collect(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.description}",
+               f"# TYPE {self.name} histogram"]
+        with self._lock:
+            items = [(k, list(c), self._sums.get(k, 0.0))
+                     for k, c in self._counts.items()]
+        for vals, counts, total in sorted(items):
+            cum = 0
+            for b, c in zip(self.buckets, counts):
+                cum += c
+                le = "+Inf" if b == float("inf") else repr(b)
+                lbls = _fmt_labels(self.label_names + ("le",),
+                                   vals + (le,))
+                out.append(f"{self.name}_bucket{lbls} {cum}")
+            base = _fmt_labels(self.label_names, vals)
+            out.append(f"{self.name}_sum{base} {total}")
+            out.append(f"{self.name}_count{base} {cum}")
+        return out
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, Metric] = {}
+
+    def register(self, metric: Metric) -> Metric:
+        with self._lock:
+            cur = self._metrics.get(metric.name)
+            if cur is not None:
+                if type(cur) is not type(metric):
+                    raise ValueError(f"metric {metric.name!r} already "
+                                     "registered with a different type")
+                return cur
+            self._metrics[metric.name] = metric
+            return metric
+
+    def counter(self, name, description="", labels=()) -> Counter:
+        return self.register(Counter(name, description, labels))
+
+    def gauge(self, name, description="", labels=()) -> Gauge:
+        return self.register(Gauge(name, description, labels))
+
+    def histogram(self, name, description="", labels=(),
+                  buckets=_DEFAULT_BUCKETS) -> Histogram:
+        return self.register(Histogram(name, description, labels, buckets))
+
+    def get(self, name: str) -> Optional[Metric]:
+        return self._metrics.get(name)
+
+    def prometheus_text(self) -> str:
+        with self._lock:
+            metrics = list(self._metrics.values())
+        lines: List[str] = []
+        for m in metrics:
+            lines.extend(m.collect())
+        return "\n".join(lines) + "\n"
+
+
+DEFAULT = Registry()
+
+
+def counter(name, description="", labels=()):
+    return DEFAULT.counter(name, description, labels)
+
+
+def gauge(name, description="", labels=()):
+    return DEFAULT.gauge(name, description, labels)
+
+
+def histogram(name, description="", labels=(), buckets=_DEFAULT_BUCKETS):
+    return DEFAULT.histogram(name, description, labels, buckets)
+
+
+def prometheus_text() -> str:
+    return DEFAULT.prometheus_text()
+
+
+class MetricsServer:
+    """Tiny /metrics HTTP endpoint (prometheus_exporter.py role)."""
+
+    def __init__(self, registry: Optional[Registry] = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        import http.server
+        reg = registry or DEFAULT
+
+        class _H(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                body = reg.prometheus_text().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._server = http.server.ThreadingHTTPServer((host, port), _H)
+        self.host, self.port = self._server.server_address[:2]
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True, name="metrics-http")
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}/metrics"
+
+    def shutdown(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=2.0)

@@ -6,7 +6,11 @@ from jax import lax
 PRECISION = {
     "float32": lax.Precision.HIGHEST,
     "tensorfloat32": lax.Precision.HIGH,
-    "default": lax.Precision.DEFAULT,
+    # None (NOT Precision.DEFAULT): an explicit precision argument
+    # overrides ``jax.default_matmul_precision`` contexts, so "default"
+    # must stay unset for callers to be able to opt whole models into
+    # fp32 (small-model training is bf16-sensitive)
+    "default": None,
 }
 
 

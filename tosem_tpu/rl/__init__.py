@@ -9,12 +9,13 @@ from tosem_tpu.rl.env import CartPole, EnvSpec, batch_reset, batch_step
 from tosem_tpu.rl.gae import gae_advantages
 from tosem_tpu.rl.policy import ActorCritic, entropy, log_prob, sample_action
 from tosem_tpu.rl.ppo import (PPOConfig, Trajectory, flatten_trajectory,
-                              make_ppo_update, ppo_loss, rollout, train_ppo)
+                              make_ppo_update, ppo_loss, rollout,
+                              run_epochs, train_ppo)
 from tosem_tpu.rl.workers import DistributedPPO, RolloutWorker
 
 __all__ = [
     "CartPole", "EnvSpec", "batch_reset", "batch_step", "gae_advantages",
     "ActorCritic", "entropy", "log_prob", "sample_action", "PPOConfig",
     "Trajectory", "flatten_trajectory", "make_ppo_update", "ppo_loss",
-    "rollout", "train_ppo", "DistributedPPO", "RolloutWorker",
+    "rollout", "run_epochs", "train_ppo", "DistributedPPO", "RolloutWorker",
 ]

@@ -62,9 +62,17 @@ class CartPole:
         return state["phys"]
 
     @classmethod
-    def step(cls, state, action) -> Tuple[dict, jax.Array, jax.Array,
-                                          jax.Array]:
-        """→ (next_state, obs, reward, done); auto-resets when done."""
+    def step(cls, state, action):
+        """→ (next_state, nobs, reward, terminated, truncated).
+
+        ``terminated`` = physical episode end (pole fell / cart out of
+        bounds): the value bootstrap must be masked. ``truncated`` = time
+        limit only: the episode is CUT, not ended — GAE must still
+        bootstrap through it. ``nobs`` is the PRE-reset next observation
+        (the true s′ of this transition) so the learner can evaluate
+        V(s′) even across the auto-reset boundary; the post-reset state
+        lives in ``next_state``.
+        """
         x, x_dot, th, th_dot = (state["phys"][0], state["phys"][1],
                                 state["phys"][2], state["phys"][3])
         force = jnp.where(action == 1, cls.FORCE, -cls.FORCE)
@@ -80,17 +88,19 @@ class CartPole:
         th_dot = th_dot + cls.DT * th_acc
         phys = jnp.stack([x, x_dot, th, th_dot])
         t = state["t"] + 1
-        done = ((jnp.abs(x) > cls.X_LIMIT)
-                | (jnp.abs(th) > cls.THETA_LIMIT)
-                | (t >= cls.spec.max_steps))
+        terminated = ((jnp.abs(x) > cls.X_LIMIT)
+                      | (jnp.abs(th) > cls.THETA_LIMIT))
+        truncated = (t >= cls.spec.max_steps) & ~terminated
+        done = terminated | truncated
         reward = jnp.float32(1.0)
+        nobs = phys                       # true s' of this transition
         # auto-reset: where done, swap in a fresh episode
         k_reset, k_next = jax.random.split(state["key"])
         fresh = cls._sample_phys(k_reset)
         phys = jnp.where(done, fresh, phys)
         t = jnp.where(done, 0, t)
         nxt = {"phys": phys, "t": t, "key": k_next}
-        return nxt, phys, reward, done
+        return nxt, nobs, reward, terminated, truncated
 
 
 def batch_reset(env, key, n_envs: int):

@@ -15,23 +15,33 @@ from jax import lax
 
 
 def gae_advantages(rewards, values, dones, last_value, *,
-                   gamma: float = 0.99, lam: float = 0.95
+                   gamma: float = 0.99, lam: float = 0.95,
+                   next_values=None, terminated=None
                    ) -> Tuple[jax.Array, jax.Array]:
     """rewards/values/dones: [T, ...]; last_value: [...] (bootstrap).
 
-    ``dones[t]`` marks that the transition at t ENDED an episode: the
-    bootstrap value of the next state is masked.
+    ``dones[t]`` marks any episode boundary at t (termination OR time-limit
+    truncation): the advantage recursion never flows across it. The VALUE
+    bootstrap is masked only where ``terminated`` (defaults to ``dones``) —
+    a truncated episode still bootstraps with γ·V(s′), so time limits don't
+    bias value targets low. Pass ``next_values`` (V(s′) per step, e.g.
+    evaluated on pre-reset observations) for exact truncation handling;
+    default shifts ``values`` and appends ``last_value``.
     → (advantages [T, ...], returns [T, ...]) with returns = adv + values.
     """
-    next_values = jnp.concatenate([values[1:], last_value[None]], 0)
+    if next_values is None:
+        next_values = jnp.concatenate([values[1:], last_value[None]], 0)
+    if terminated is None:
+        terminated = dones
+    not_term = 1.0 - terminated.astype(values.dtype)
     not_done = 1.0 - dones.astype(values.dtype)
-    deltas = rewards + gamma * next_values * not_done - values
+    deltas = rewards + gamma * next_values * not_term - values
 
     def back(carry, xs):
         delta, nd = xs
         adv = delta + gamma * lam * nd * carry
         return adv, adv
 
-    _, advs = lax.scan(back, jnp.zeros_like(last_value),
+    _, advs = lax.scan(back, jnp.zeros_like(deltas[0]),
                        (deltas, not_done), reverse=True)
     return advs, advs + values

@@ -49,32 +49,43 @@ class PPOConfig(NamedTuple):
 
 
 class Trajectory(NamedTuple):
-    """[T, B, ...] tensors collected under the behavior policy."""
+    """[T, B, ...] tensors collected under the behavior policy.
+
+    ``nobs`` is the PRE-reset next observation of each transition so the
+    learner can evaluate V(s′) exactly, including across auto-resets;
+    ``terminated`` masks the value bootstrap, ``truncated`` only cuts the
+    advantage recursion (time limits are not real episode ends).
+    """
     obs: jax.Array
     actions: jax.Array
     logp: jax.Array
     rewards: jax.Array
-    dones: jax.Array
+    terminated: jax.Array
+    truncated: jax.Array
     values: jax.Array
+    nobs: jax.Array
+
+    @property
+    def dones(self):
+        return self.terminated | self.truncated
 
 
 def rollout(model: ActorCritic, params, env, env_states, key,
-            length: int) -> Tuple[Trajectory, Any, jax.Array]:
-    """One in-graph rollout: → (traj, new_env_states, last_value)."""
+            length: int) -> Tuple[Trajectory, Any]:
+    """One in-graph rollout: → (traj, new_env_states)."""
 
     def step_fn(carry, k):
         states = carry
         obs = jax.vmap(env.obs)(states)
         (logits, value), _ = model.apply(variables(params), obs)
         action, logp = sample_action(k, logits)
-        states, _, reward, done = batch_step(env, states, action)
-        return states, Trajectory(obs, action, logp, reward, done, value)
+        states, nobs, reward, term, trunc = batch_step(env, states, action)
+        return states, Trajectory(obs, action, logp, reward, term, trunc,
+                                  value, nobs)
 
     keys = jax.random.split(key, length)
     env_states, traj = lax.scan(step_fn, env_states, keys)
-    last_obs = jax.vmap(env.obs)(env_states)
-    (_, last_value), _ = model.apply(variables(params), last_obs)
-    return traj, env_states, last_value
+    return traj, env_states
 
 
 def ppo_loss(model: ActorCritic, params, batch: Dict[str, jax.Array],
@@ -127,15 +138,47 @@ def shard_minibatch(batch: Dict[str, jax.Array], mesh: Mesh,
     return {k: jax.device_put(v, sh) for k, v in batch.items()}
 
 
-def flatten_trajectory(traj: Trajectory, last_value, cfg: PPOConfig
-                       ) -> Dict[str, jax.Array]:
-    """[T, B] → flat [T*B] training arrays with normalized advantages."""
+def flatten_trajectory(model: ActorCritic, params, traj: Trajectory,
+                       cfg: PPOConfig) -> Dict[str, jax.Array]:
+    """[T, B] → flat [T*B] training arrays with normalized advantages.
+
+    V(s′) is evaluated on the pre-reset next observations in one batched
+    forward, so truncated episodes bootstrap exactly.
+    """
+    T, B = traj.rewards.shape
+    (_, nvals), _ = model.apply(variables(params),
+                                traj.nobs.reshape((T * B,) +
+                                                  traj.nobs.shape[2:]))
     adv, ret = gae_advantages(traj.rewards, traj.values, traj.dones,
-                              last_value, gamma=cfg.gamma, lam=cfg.lam)
+                              None, gamma=cfg.gamma, lam=cfg.lam,
+                              next_values=nvals.reshape(T, B),
+                              terminated=traj.terminated)
     adv = (adv - adv.mean()) / (adv.std() + 1e-8)
     flat = lambda x: x.reshape((-1,) + x.shape[2:])
     return {"obs": flat(traj.obs), "actions": flat(traj.actions),
             "logp": flat(traj.logp), "adv": flat(adv), "ret": flat(ret)}
+
+
+def run_epochs(update, batch: Dict[str, jax.Array], cfg: PPOConfig, key,
+               params, opt_state, mesh: Optional[Mesh] = None):
+    """Shared epoch/minibatch loop → (params, opt_state, last_metrics).
+
+    Used by both the in-graph driver and the distributed learner so
+    shuffle/shard/update semantics can never drift apart.
+    """
+    n = batch["obs"].shape[0]
+    mb = n // cfg.minibatches
+    metrics: Dict[str, jax.Array] = {}
+    for _ in range(cfg.epochs):
+        key, k_ep = jax.random.split(key)
+        perm = jax.random.permutation(k_ep, n)
+        for i in range(cfg.minibatches):
+            idx = perm[i * mb:(i + 1) * mb]
+            minib = {k: v[idx] for k, v in batch.items()}
+            if mesh is not None:
+                minib = shard_minibatch(minib, mesh)
+            params, opt_state, metrics = update(params, opt_state, minib)
+    return params, opt_state, metrics
 
 
 def train_ppo(env, *, cfg: PPOConfig = PPOConfig(), iterations: int = 30,
@@ -160,25 +203,15 @@ def train_ppo(env, *, cfg: PPOConfig = PPOConfig(), iterations: int = 30,
                                      length=cfg.rollout_len))
 
     history = {"mean_return": [], "loss": []}
-    n = cfg.rollout_len * cfg.n_envs
-    mb = n // cfg.minibatches
     for it in range(iterations):
-        key, k_roll, k_perm = jax.random.split(key, 3)
-        traj, env_states, last_value = roll(params, env_states=env_states,
-                                            key=k_roll)
-        batch = flatten_trajectory(traj, last_value, cfg)
+        key, k_roll, k_epochs = jax.random.split(key, 3)
+        traj, env_states = roll(params, env_states=env_states, key=k_roll)
+        batch = flatten_trajectory(model, params, traj, cfg)
         ep_ends = float(traj.dones.sum())
         mean_ret = float(traj.rewards.sum()) / max(ep_ends, 1.0)
         history["mean_return"].append(mean_ret)
-        for _ in range(cfg.epochs):
-            key, k_ep = jax.random.split(key)
-            perm = jax.random.permutation(k_ep, n)
-            for i in range(cfg.minibatches):
-                idx = perm[i * mb:(i + 1) * mb]
-                minib = {k: v[idx] for k, v in batch.items()}
-                if mesh is not None:
-                    minib = shard_minibatch(minib, mesh)
-                params, opt_state, metrics = update(params, opt_state, minib)
+        params, opt_state, metrics = run_epochs(
+            update, batch, cfg, k_epochs, params, opt_state, mesh=mesh)
         history["loss"].append(float(metrics["pg_loss"]))
         if log_every and (it + 1) % log_every == 0:
             print(f"[ppo] iter {it + 1}: mean_return={mean_ret:.1f}")

@@ -23,14 +23,13 @@ from tosem_tpu.rl.ppo import PPOConfig, flatten_trajectory, make_ppo_update
 class RolloutWorker:
     """Holds env states + a policy copy; collects one rollout per call."""
 
-    def __init__(self, env_name: str, n_envs: int, rollout_len: int,
+    def __init__(self, env, n_envs: int, rollout_len: int,
                  hidden: Tuple[int, ...], seed: int):
         import jax
         jax.config.update("jax_platforms", "cpu")  # workers sample on host
-        from tosem_tpu.rl.env import CartPole, batch_reset
+        from tosem_tpu.rl.env import batch_reset
         from tosem_tpu.rl.policy import ActorCritic
-        envs = {"cartpole": CartPole}
-        self.env = envs[env_name]
+        self.env = env            # the env class ships in the actor blob
         self.model = ActorCritic(self.env.spec.obs_dim,
                                  self.env.spec.n_actions, hidden)
         import functools
@@ -47,22 +46,23 @@ class RolloutWorker:
         """Collect one rollout under ``params`` → numpy trajectory dict."""
         import jax
         self.key, k = jax.random.split(self.key)
-        traj, self.states, last_value = self._roll(
-            params, env_states=self.states, key=k)
-        out = {f: np.asarray(getattr(traj, f)) for f in traj._fields}
-        out["last_value"] = np.asarray(last_value)
-        return out
+        traj, self.states = self._roll(params, env_states=self.states,
+                                       key=k)
+        return {f: np.asarray(getattr(traj, f)) for f in traj._fields}
 
 
 class DistributedPPO:
     """Learner + N rollout-worker actors (``ddppo.py:157-203`` shape)."""
 
-    def __init__(self, env, env_name: str = "cartpole", *,
-                 n_workers: int = 2, cfg: PPOConfig = PPOConfig(),
-                 hidden=(64, 64), seed: int = 0, mesh=None):
+    def __init__(self, env, *, n_workers: int = 2,
+                 cfg: PPOConfig = PPOConfig(), hidden=(64, 64),
+                 seed: int = 0, mesh=None):
         import jax
         import optax
         from tosem_tpu.rl.policy import ActorCritic
+        if cfg.n_envs % n_workers:
+            raise ValueError(f"n_envs={cfg.n_envs} must divide evenly "
+                             f"across n_workers={n_workers}")
         self.env = env
         self.cfg = cfg
         self.model = ActorCritic(env.spec.obs_dim, env.spec.n_actions,
@@ -75,9 +75,10 @@ class DistributedPPO:
         self.update = make_ppo_update(self.model, self.optimizer, cfg,
                                       mesh=mesh)
         self.mesh = mesh
-        per_worker = max(cfg.n_envs // n_workers, 1)
+        self._key = jax.random.PRNGKey(seed + 10_000)
+        per_worker = cfg.n_envs // n_workers
         self.workers = [
-            RolloutWorker.remote(env_name, per_worker, cfg.rollout_len,
+            RolloutWorker.remote(env, per_worker, cfg.rollout_len,
                                  tuple(hidden), seed + 1 + i)
             for i in range(n_workers)]
 
@@ -85,7 +86,7 @@ class DistributedPPO:
         """One sync round: broadcast params → gather → update epochs."""
         import jax
         import jax.numpy as jnp
-        from tosem_tpu.rl.ppo import Trajectory, shard_minibatch
+        from tosem_tpu.rl.ppo import Trajectory, run_epochs
         params_ref = rt.put(jax.device_get(self.params))
         samples = rt.get([w.sample.remote(params_ref)
                           for w in self.workers], timeout=120.0)
@@ -93,23 +94,11 @@ class DistributedPPO:
         traj = Trajectory(*[
             jnp.concatenate([jnp.asarray(s[f]) for s in samples], axis=1)
             for f in Trajectory._fields])
-        last_value = jnp.concatenate(
-            [jnp.asarray(s["last_value"]) for s in samples], axis=0)
-        batch = flatten_trajectory(traj, last_value, self.cfg)
-        n = batch["obs"].shape[0]
-        mb = n // self.cfg.minibatches
-        key = jax.random.PRNGKey(int(traj.rewards.sum()) + n)
-        metrics = {}
-        for _ in range(self.cfg.epochs):
-            key, k = jax.random.split(key)
-            perm = jax.random.permutation(k, n)
-            for i in range(self.cfg.minibatches):
-                idx = perm[i * mb:(i + 1) * mb]
-                minib = {k2: v[idx] for k2, v in batch.items()}
-                if self.mesh is not None:
-                    minib = shard_minibatch(minib, self.mesh)
-                self.params, self.opt_state, metrics = self.update(
-                    self.params, self.opt_state, minib)
+        batch = flatten_trajectory(self.model, self.params, traj, self.cfg)
+        self._key, k_epochs = jax.random.split(self._key)
+        self.params, self.opt_state, metrics = run_epochs(
+            self.update, batch, self.cfg, k_epochs, self.params,
+            self.opt_state, mesh=self.mesh)
         ep = float(traj.dones.sum())
         return {"mean_return": float(traj.rewards.sum()) / max(ep, 1.0),
                 "pg_loss": float(metrics["pg_loss"]),

@@ -37,7 +37,18 @@ from tosem_tpu.runtime import common
 from tosem_tpu.runtime.common import (ActorDiedError, ObjectRef, StoreRef,
                                       TaskCancelledError, TaskError, TaskSpec,
                                       WorkerCrashedError)
+from tosem_tpu.obs import metrics as _metrics
 from tosem_tpu.runtime.object_store import ObjectID, ObjectStore
+
+# runtime metric definitions (the src/ray/stats/metric_defs.h role)
+M_TASKS_SUBMITTED = _metrics.counter(
+    "rt_tasks_submitted_total", "tasks submitted to the runtime")
+M_TASKS_FINISHED = _metrics.counter(
+    "rt_tasks_finished_total", "task completions by outcome", ["outcome"])
+M_ACTORS = _metrics.counter(
+    "rt_actor_events_total", "actor lifecycle events", ["event"])
+M_WORKERS_ALIVE = _metrics.gauge(
+    "rt_workers_alive", "stateless worker processes in the pool")
 
 
 def _default_start_method() -> str:
@@ -149,6 +160,7 @@ class Runtime:
         self._shutdown = False
         for _ in range(num_workers):
             self.task_workers.append(_Worker(self.ctx, self.store_name))
+        M_WORKERS_ALIVE.set(len(self.task_workers))
 
         self._sendq: "queue.SimpleQueue[Optional[Tuple[_Worker, tuple]]]" = \
             queue.SimpleQueue()
@@ -180,6 +192,7 @@ class Runtime:
                         retries_left=(self.max_task_retries
                                       if max_retries is None else max_retries),
                         deps=self._unresolved_deps(args, kwargs))
+        M_TASKS_SUBMITTED.inc()
         with self.lock:
             self.specs[spec.task_id] = spec
             if not spec.deps:
@@ -198,6 +211,7 @@ class Runtime:
 
     def create_actor(self, cls_blob_args: bytes, max_restarts: int) -> bytes:
         actor_id = os.urandom(16)
+        M_ACTORS.inc(labels=["created"])
         with self.lock:
             w = _Worker(self._make_ctx(), self.store_name, actor_id=actor_id)
             self.actors[actor_id] = _ActorRecord(w, cls_blob_args,
@@ -320,6 +334,7 @@ class Runtime:
                 if not self._shutdown:
                     self.task_workers.append(
                         _Worker(self._make_ctx(), self.store_name))
+                M_WORKERS_ALIVE.set(len(self.task_workers))
                 self._dispatch_locked()
 
     def put(self, value: Any) -> ObjectRef:
@@ -381,6 +396,7 @@ class Runtime:
             if self._shutdown:
                 return
             self._shutdown = True
+            M_WORKERS_ALIVE.set(0)
             workers = list(self.task_workers) + [r.worker
                                                  for r in self.actors.values()]
         for w in workers:
@@ -515,6 +531,7 @@ class Runtime:
     def _fail_task_locked(self, spec: TaskSpec, err: BaseException) -> None:
         self.errors[spec.result_ref.oid.binary] = err
         self.specs.pop(spec.task_id, None)
+        M_TASKS_FINISHED.inc(labels=[type(err).__name__])
         self.cv.notify_all()
 
     def _complete_locked(self, w: _Worker, tid: bytes, kind: str,
@@ -528,6 +545,7 @@ class Runtime:
             self.inline[spec.result_ref.oid.binary] = payload
         elif kind == "store":
             self.in_store.add(spec.result_ref.oid.binary)
+        M_TASKS_FINISHED.inc(labels=["ok"])
         self.cv.notify_all()
         if self.pending:
             self._dispatch_locked()
@@ -655,6 +673,7 @@ class Runtime:
             if rec.restarts < rec.max_restarts:
                 # restart policy: python/ray/actor.py:269-280 max_restarts
                 rec.restarts += 1
+                M_ACTORS.inc(labels=["restarted"])
                 rec.worker = _Worker(self._make_ctx(), self.store_name,
                                      actor_id=w.actor_id)
                 self._send(rec.worker, ("actor_init", rec.init_blob))
@@ -683,5 +702,6 @@ class Runtime:
             w.inflight.clear()
             if not self._shutdown:
                 self.task_workers.append(_Worker(self._make_ctx(), self.store_name))
+            M_WORKERS_ALIVE.set(len(self.task_workers))
             self.cv.notify_all()
             self._dispatch_locked()

@@ -1,0 +1,17 @@
+"""Serving layer (L6): deployments, router, HTTP ingress, streaming C API.
+
+Ray Serve's controller/router/replica architecture (SURVEY §2.1) rebuilt on
+the single-controller actor runtime, plus the DeepSpeech native-client
+streaming surface (``deepspeech.h:107-358``) as a real C ABI
+(``native/speech_api.cpp``) fed by JAX callbacks.
+"""
+from tosem_tpu.serve.core import Deployment, Handle, Serve, ServeFuture
+from tosem_tpu.serve.http import HttpIngress
+from tosem_tpu.serve.speech import (CStreamingModel, SpeechStreamBackend,
+                                    StreamingClient, greedy_ctc_text)
+
+__all__ = [
+    "Serve", "Deployment", "Handle", "ServeFuture", "HttpIngress",
+    "CStreamingModel", "SpeechStreamBackend", "StreamingClient",
+    "greedy_ctc_text",
+]

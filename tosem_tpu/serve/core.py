@@ -1,0 +1,154 @@
+"""Serve-lite: deployments, replica routing, retries — on the actor runtime.
+
+The reference's serving stack (SURVEY §2.1 Ray Serve) is a controller that
+deploys backend classes as replica actors, a router that load-balances
+requests over them, and an HTTP proxy in front
+(``python/ray/serve/api.py:36,210,361``; ``serve/router.py``;
+``serve/backend_worker.py``). This is the same architecture on
+:mod:`tosem_tpu.runtime`: replicas are runtime actors with restart policies,
+the router is driver-side (single-controller — no distributed router actors
+needed), and failures re-dispatch to surviving replicas.
+
+    serve = Serve()
+    serve.deploy("echo", EchoBackend, num_replicas=2)
+    h = serve.get_handle("echo")
+    fut = h.remote({"x": 1})
+    fut.result(timeout=5)
+
+Backend contract: a class whose ``call(self, request)`` handles one request
+(the ``__call__`` of a Serve backend).
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import tosem_tpu.runtime as rt
+from tosem_tpu.runtime.common import (ActorDiedError, TaskCancelledError,
+                                      WorkerCrashedError)
+
+RETRYABLE = (ActorDiedError, WorkerCrashedError)
+
+
+class ServeFuture:
+    """A routed request: retries on replica death, like the reference's
+    router re-submitting to another worker replica."""
+
+    def __init__(self, deployment: "Deployment", request: Any,
+                 max_retries: int, pin: Optional[int] = None):
+        self._dep = deployment
+        self._request = request
+        self._retries_left = max_retries
+        self._pin = pin
+        self._ref = deployment._dispatch(request, pin=pin)
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            remaining = (None if deadline is None
+                         else max(deadline - time.monotonic(), 0.001))
+            try:
+                return rt.get(self._ref, timeout=remaining)
+            except RETRYABLE:
+                if self._retries_left <= 0:
+                    raise
+                self._retries_left -= 1
+                self._ref = self._dep._dispatch(self._request, pin=self._pin)
+
+
+class Deployment:
+    """One named backend: N replica actors + a round-robin pointer."""
+
+    def __init__(self, name: str, backend_cls, num_replicas: int,
+                 init_args: Tuple, init_kwargs: Dict,
+                 max_restarts: int, max_retries: int):
+        self.name = name
+        self.backend_cls = backend_cls
+        self.max_retries = max_retries
+        self._init_args = init_args
+        self._init_kwargs = init_kwargs
+        self._actor_cls = rt.remote(max_restarts=max_restarts)(backend_cls)
+        self._lock = threading.Lock()
+        self._replicas: List[Any] = [
+            self._actor_cls.remote(*init_args, **init_kwargs)
+            for _ in range(num_replicas)]
+        self._rr = itertools.count()
+
+    def _dispatch(self, request: Any, pin: Optional[int] = None):
+        with self._lock:
+            replicas = list(self._replicas)
+        i = (next(self._rr) if pin is None else pin) % len(replicas)
+        return replicas[i].call.remote(request)
+
+    def handle(self, pin: Optional[int] = None) -> "Handle":
+        """``pin``: route every request of this handle to one replica —
+        session affinity for stateful backends (streaming)."""
+        return Handle(self, pin=pin)
+
+    def scale(self, num_replicas: int) -> None:
+        """Add/remove replicas (the controller's autoscale entry point)."""
+        if num_replicas < 1:
+            raise ValueError("a deployment needs at least one replica; "
+                             "use Serve.delete to tear it down")
+        with self._lock:
+            cur = len(self._replicas)
+            if num_replicas > cur:
+                self._replicas.extend(
+                    self._actor_cls.remote(*self._init_args,
+                                           **self._init_kwargs)
+                    for _ in range(num_replicas - cur))
+            else:
+                for h in self._replicas[num_replicas:]:
+                    rt.kill(h)
+                del self._replicas[num_replicas:]
+
+
+class Handle:
+    """Client-side handle (``serve.get_handle`` role)."""
+
+    def __init__(self, deployment: Deployment, pin: Optional[int] = None):
+        self._dep = deployment
+        self._pin = pin
+
+    def remote(self, request: Any) -> ServeFuture:
+        return ServeFuture(self._dep, request, self._dep.max_retries,
+                           pin=self._pin)
+
+    def call(self, request: Any, timeout: Optional[float] = None) -> Any:
+        return self.remote(request).result(timeout)
+
+
+class Serve:
+    """The controller: name → deployment registry (serve/api.py:36 role)."""
+
+    def __init__(self):
+        if not rt.is_initialized():
+            rt.init()
+        self._deployments: Dict[str, Deployment] = {}
+        self._lock = threading.Lock()
+
+    def deploy(self, name: str, backend_cls, *, num_replicas: int = 1,
+               init_args: Tuple = (), init_kwargs: Optional[Dict] = None,
+               max_restarts: int = 2, max_retries: int = 3) -> Deployment:
+        with self._lock:
+            if name in self._deployments:
+                raise ValueError(f"deployment {name!r} already exists")
+            dep = Deployment(name, backend_cls, num_replicas, init_args,
+                             init_kwargs or {}, max_restarts, max_retries)
+            self._deployments[name] = dep
+            return dep
+
+    def get_handle(self, name: str) -> Handle:
+        return self._deployments[name].handle()
+
+    def delete(self, name: str) -> None:
+        with self._lock:
+            dep = self._deployments.pop(name, None)
+        if dep is not None:
+            for h in dep._replicas:
+                rt.kill(h)
+
+    def list_deployments(self) -> List[str]:
+        return sorted(self._deployments)

@@ -1,0 +1,70 @@
+"""HTTP ingress for Serve-lite (the reference's proxy role).
+
+``python/ray/serve/api.py:210`` starts an HTTP proxy actor translating
+``POST /<endpoint>`` into router calls; single-controller here, so the
+proxy is a threaded stdlib HTTP server in the driver process. JSON in,
+JSON out; backend errors map to 500, unknown endpoints to 404.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from tosem_tpu.serve.core import Serve
+
+
+class HttpIngress:
+    def __init__(self, serve: Serve, host: str = "127.0.0.1",
+                 port: int = 0, request_timeout: float = 30.0):
+        ingress = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):     # quiet
+                pass
+
+            def do_POST(self):
+                name = self.path.strip("/")
+                if name not in serve._deployments:
+                    self._reply(404, {"error": f"no endpoint {name!r}"})
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    request = json.loads(self.rfile.read(n) or b"null")
+                    handle = serve.get_handle(name)
+                    result = handle.call(request,
+                                         timeout=ingress.request_timeout)
+                    self._reply(200, {"result": result})
+                except Exception as e:  # backend failure → 500, not a crash
+                    self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+
+            def do_GET(self):
+                if self.path.rstrip("/") in ("", "/-", "/-/routes"):
+                    self._reply(200, {"routes": serve.list_deployments()})
+                else:
+                    self._reply(404, {"error": "POST to /<endpoint>"})
+
+            def _reply(self, code: int, payload) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.request_timeout = request_timeout
+        self._server = ThreadingHTTPServer((host, port), _Handler)
+        self.host, self.port = self._server.server_address[:2]
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True, name="serve-http")
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def shutdown(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=2.0)

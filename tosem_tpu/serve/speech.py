@@ -1,0 +1,277 @@
+"""Streaming speech recognition behind the C ABI + a Serve backend.
+
+Two integration layers over :class:`tosem_tpu.models.speech.SpeechModel`:
+
+- :class:`CStreamingModel` — registers the JAX streaming functions as the
+  callback vtable of ``native/speech_api.cpp`` and drives recognition
+  through the C calls (``sp_create_stream`` / ``sp_feed`` /
+  ``sp_intermediate`` / ``sp_finish``), the exact surface of the
+  reference's ``native_client/deepspeech.h:107-358``.
+- :class:`SpeechStreamBackend` — a Serve-lite backend multiplexing many
+  C-API streams behind session ids, so HTTP/handle clients can feed audio
+  incrementally. Replica loss mid-stream is recovered CLIENT-side by
+  replaying buffered audio to a fresh session (:class:`StreamingClient`),
+  the way the reference's client retries a broken stream.
+"""
+from __future__ import annotations
+
+import ctypes
+import itertools
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+_STREAM_INIT = ctypes.CFUNCTYPE(ctypes.c_void_p, ctypes.c_void_p)
+_STREAM_FREE = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_void_p)
+_INFER = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p, ctypes.c_void_p,
+                          ctypes.POINTER(ctypes.c_float), ctypes.c_int32,
+                          ctypes.POINTER(ctypes.c_float),
+                          ctypes.POINTER(ctypes.c_int32))
+_FLUSH = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p, ctypes.c_void_p,
+                          ctypes.POINTER(ctypes.c_float),
+                          ctypes.POINTER(ctypes.c_int32))
+# NB: the out buffer must be POINTER(c_char), NOT c_char_p — ctypes hands a
+# c_char_p callback arg to Python as an immutable bytes copy, so writes
+# through it never reach the C buffer
+_DECODE = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p,
+                           ctypes.POINTER(ctypes.c_float), ctypes.c_int32,
+                           ctypes.POINTER(ctypes.c_char), ctypes.c_int32)
+
+
+def _bind(lib):
+    lib.sp_create_model.restype = ctypes.c_void_p
+    lib.sp_create_model.argtypes = [ctypes.c_int32, ctypes.c_int32,
+                                    ctypes.c_int32, ctypes.c_int32,
+                                    _STREAM_INIT, _STREAM_FREE, _INFER,
+                                    _FLUSH, _DECODE, ctypes.c_void_p]
+    lib.sp_free_model.argtypes = [ctypes.c_void_p]
+    lib.sp_create_stream.restype = ctypes.c_void_p
+    lib.sp_create_stream.argtypes = [ctypes.c_void_p]
+    lib.sp_free_stream.argtypes = [ctypes.c_void_p]
+    lib.sp_feed.argtypes = [ctypes.c_void_p,
+                            ctypes.POINTER(ctypes.c_float), ctypes.c_int32]
+    lib.sp_intermediate.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                    ctypes.c_int32]
+    lib.sp_finish.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                              ctypes.c_int32]
+    lib.sp_stream_frames_emitted.argtypes = [ctypes.c_void_p]
+    lib.sp_stream_frames_emitted.restype = ctypes.c_int32
+    return lib
+
+
+def greedy_ctc_text(logits: np.ndarray, alphabet: str, blank: int) -> str:
+    """Greedy CTC collapse (repeat-merge then blank-drop)."""
+    ids = logits.argmax(-1)
+    out = []
+    prev = -1
+    for i in ids:
+        if i != prev and i != blank:
+            out.append(alphabet[i] if i < len(alphabet) else "?")
+        prev = i
+    return "".join(out)
+
+
+class CStreamingModel:
+    """DeepSpeech-native-client surface over the JAX streaming model."""
+
+    def __init__(self, model, params, alphabet: str,
+                 chunk_frames: int = 16):
+        import jax
+        import jax.numpy as jnp
+        from tosem_tpu.native import load_library
+        from tosem_tpu.nn.core import variables
+
+        self.model = model
+        self.alphabet = alphabet
+        cfg = model.cfg
+        self.lib = _bind(load_library("speech_api"))
+        self._states: Dict[int, Any] = {}
+        self._next = itertools.count(1)
+        self._lock = threading.Lock()
+        vs = variables(params)
+
+        def stream_init(_):
+            sid = next(self._next)
+            with self._lock:
+                self._states[sid] = model.streaming_init(batch=1)
+            return sid
+
+        def stream_free(_, sid):
+            with self._lock:
+                self._states.pop(sid, None)
+
+        def infer(_, sid, frames_p, n_frames, out_p, out_n):
+            try:
+                x = np.ctypeslib.as_array(
+                    frames_p, (n_frames, cfg.n_input)).copy()
+                with self._lock:
+                    state = self._states[sid]
+                logits, state = model.streaming_step(
+                    vs, state, jnp.asarray(x[None]))
+                with self._lock:
+                    self._states[sid] = state
+                arr = np.asarray(logits[0], np.float32)
+                out = np.ctypeslib.as_array(
+                    out_p, (n_frames + cfg.n_context, cfg.n_classes))
+                out[:arr.shape[0]] = arr
+                out_n[0] = arr.shape[0]
+                return 0
+            except Exception:
+                return -1
+
+        def flush(_, sid, out_p, out_n):
+            try:
+                with self._lock:
+                    state = self._states[sid]
+                logits, state = model.streaming_flush(vs, state)
+                with self._lock:
+                    self._states[sid] = state
+                arr = np.asarray(logits[0], np.float32)
+                out = np.ctypeslib.as_array(
+                    out_p, (cfg.n_context + 1, cfg.n_classes))
+                out[:arr.shape[0]] = arr
+                out_n[0] = arr.shape[0]
+                return 0
+            except Exception:
+                return -1
+
+        def decode(_, logits_p, n_frames, out, cap):
+            try:
+                arr = np.ctypeslib.as_array(
+                    logits_p, (n_frames, cfg.n_classes))
+                text = greedy_ctc_text(arr, alphabet, cfg.blank)
+                data = text.encode()[:cap - 1]
+                ctypes.memmove(out, data + b"\0", len(data) + 1)
+                return 0
+            except Exception:
+                return -1
+
+        # keep callback objects alive for the model's lifetime
+        self._cbs = (_STREAM_INIT(stream_init), _STREAM_FREE(stream_free),
+                     _INFER(infer), _FLUSH(flush), _DECODE(decode))
+        self._model_p = self.lib.sp_create_model(
+            cfg.n_input, cfg.n_classes, chunk_frames, cfg.n_context,
+            *self._cbs, None)
+        if not self._model_p:
+            raise RuntimeError("sp_create_model failed")
+
+    # -- the four-call C surface -------------------------------------------
+    def create_stream(self) -> int:
+        p = self.lib.sp_create_stream(self._model_p)
+        if not p:
+            raise RuntimeError("sp_create_stream failed")
+        return p
+
+    def feed(self, stream: int, frames: np.ndarray) -> None:
+        f = np.ascontiguousarray(frames, np.float32)
+        rc = self.lib.sp_feed(
+            stream, f.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            f.shape[0])
+        if rc != 0:
+            raise RuntimeError(f"sp_feed rc={rc}")
+
+    def intermediate(self, stream: int, cap: int = 4096) -> str:
+        buf = ctypes.create_string_buffer(cap)
+        rc = self.lib.sp_intermediate(stream, buf, cap)
+        if rc != 0:
+            raise RuntimeError(f"sp_intermediate rc={rc}")
+        return buf.value.decode()
+
+    def finish(self, stream: int, cap: int = 4096) -> str:
+        buf = ctypes.create_string_buffer(cap)
+        rc = self.lib.sp_finish(stream, buf, cap)
+        self.lib.sp_free_stream(stream)   # free even on failure — no leak
+        if rc != 0:
+            raise RuntimeError(f"sp_finish rc={rc}")
+        return buf.value.decode()
+
+    def close(self) -> None:
+        if self._model_p:
+            self.lib.sp_free_model(self._model_p)
+            self._model_p = None
+
+
+class SpeechStreamBackend:
+    """Serve backend: {op: create|feed|intermediate|finish} session calls."""
+
+    def __init__(self, cfg_name: str = "tiny", seed: int = 0,
+                 chunk_frames: int = 8):
+        import jax
+        from tosem_tpu.models.speech import SpeechConfig, SpeechModel
+        cfg = (SpeechConfig.tiny() if cfg_name == "tiny" else SpeechConfig())
+        model = SpeechModel(cfg)
+        params = model.init(jax.random.PRNGKey(seed))["params"]
+        alphabet = "abcdefghijklmnopqrstuvwxyz' -"[:cfg.n_classes - 1]
+        self.cm = CStreamingModel(model, params, alphabet,
+                                  chunk_frames=chunk_frames)
+        self._sessions: Dict[str, int] = {}
+
+    def call(self, request: Dict[str, Any]) -> Any:
+        op = request["op"]
+        if op == "create":
+            sid = request["session"]
+            self._sessions[sid] = self.cm.create_stream()
+            return {"ok": True}
+        stream = self._sessions.get(request["session"])
+        if stream is None:
+            raise KeyError(f"unknown session {request['session']!r} "
+                           "(replica restarted?)")
+        if op == "feed":
+            self.cm.feed(stream, np.asarray(request["frames"], np.float32))
+            return {"ok": True}
+        if op == "intermediate":
+            return {"text": self.cm.intermediate(stream)}
+        if op == "finish":
+            text = self.cm.finish(stream)
+            del self._sessions[request["session"]]
+            return {"text": text}
+        raise ValueError(f"unknown op {op!r}")
+
+
+class StreamingClient:
+    """Client-side stream with replay recovery (broken-stream retry).
+
+    Pins a session to whichever replica answers; if the replica dies
+    mid-stream (KeyError/ActorDiedError surfaces through the handle), the
+    client re-creates the session and replays every buffered chunk — the
+    stream survives replica loss at the cost of recomputation.
+    """
+
+    def __init__(self, handle, session: str):
+        self.handle = handle
+        self.session = session
+        self._fed: list = []
+        self._call({"op": "create", "session": session})
+
+    def _call(self, req, retried: bool = False):
+        try:
+            return self.handle.call(req, timeout=60.0)
+        except Exception:
+            if retried:
+                raise
+            # replica lost: fresh session, replay every ACKNOWLEDGED chunk
+            # (the in-flight request is NOT in _fed yet — replay-then-retry
+            # applies it exactly once in the new session; whatever the dead
+            # replica partially applied died with its session)
+            self.handle.call({"op": "create", "session": self.session},
+                             timeout=60.0)
+            for frames in self._fed:
+                self.handle.call({"op": "feed", "session": self.session,
+                                  "frames": frames}, timeout=60.0)
+            if req["op"] == "create":
+                return {"ok": True}
+            return self._call(req, retried=True)
+
+    def feed(self, frames) -> None:
+        frames = np.asarray(frames, np.float32).tolist()
+        self._call({"op": "feed", "session": self.session,
+                    "frames": frames})
+        self._fed.append(frames)   # buffer only after the ack
+
+    def intermediate(self) -> str:
+        return self._call({"op": "intermediate",
+                           "session": self.session})["text"]
+
+    def finish(self) -> str:
+        return self._call({"op": "finish", "session": self.session})["text"]
