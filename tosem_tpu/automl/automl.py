@@ -205,6 +205,7 @@ class AutoML:
             pending.append((cfg, ref, time.monotonic()))
             launched += 1
 
+        any_completed = False
         while launched < self.n_trials or pending:
             while launched < self.n_trials and \
                     len(pending) < self.max_concurrent:
@@ -212,11 +213,18 @@ class AutoML:
             done, _ = rt.wait([r for _, r, _ in pending], num_returns=1,
                               timeout=1.0)
             now = time.monotonic()
+            # spawn-worker boot (python + jax import) is charged to the
+            # first trials' clocks; until the pool has proven itself with
+            # one completion, give 3x the budget so a loaded machine
+            # doesn't misclassify booting workers as hung trials
+            effective_timeout = (self.trial_timeout if any_completed
+                                 else self.trial_timeout * 3)
             still = []
             for cfg, ref, t0 in pending:
                 if ref in done:
                     try:
                         acc, proba = rt.get(ref)
+                        any_completed = True
                         self.records.append(TrialRecord(cfg, acc, proba))
                         alg.observe(cfg, acc)
                         if self.verbose:
@@ -226,7 +234,7 @@ class AutoML:
                         self.records.append(TrialRecord(cfg, -1.0,
                                                         error=str(e)))
                         alg.observe(cfg, 0.0)
-                elif now - t0 > self.trial_timeout:
+                elif now - t0 > effective_timeout:
                     # pynisher-style resource limit: kill the hung worker
                     # (not just abandon the ref, or it wedges its slot)
                     rt.cancel(ref)

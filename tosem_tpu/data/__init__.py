@@ -1,2 +1,21 @@
-from tosem_tpu.data.synthetic import (cifar_like_batches, mlm_batches,
-                                      SyntheticImageDataset)
+"""Data layer: synthetic datasets, audio front end, manifests + feeding.
+
+The DeepSpeech data stack (SURVEY §2.3) rebuilt TPU-first: CSV manifests
+and sample collections (``util/sample_collections.py``), a synthetic-corpus
+importer (``bin/import_*.py`` role), and length-bucketed fixed-shape
+batching (``util/feeding.py``) so XLA compiles one program per bucket.
+"""
+from tosem_tpu.data.feeding import (Batch, BucketedBatcher, Sample,
+                                    SampleCollection, bucket_boundaries,
+                                    import_synthetic_corpus,
+                                    read_csv_manifest, speech_batches,
+                                    write_csv_manifest)
+from tosem_tpu.data.synthetic import (SyntheticImageDataset,
+                                      cifar_like_batches, mlm_batches)
+
+__all__ = [
+    "SyntheticImageDataset", "cifar_like_batches", "mlm_batches",
+    "Sample", "SampleCollection", "Batch", "BucketedBatcher",
+    "bucket_boundaries", "import_synthetic_corpus", "read_csv_manifest",
+    "write_csv_manifest", "speech_batches",
+]

@@ -1,0 +1,14 @@
+"""Streaming dataflow / component-DAG engine on the actor runtime.
+
+The only reference-present parallelism strategy the framework lacked
+(SURVEY §2.7): Apollo Cyber's component model — callbacks wired by typed
+channels under a scheduler (``cyber/component/component.h:58-136``) — and
+Ray Streaming's stage dataflow with credit-based backpressure
+(``streaming/src/data_writer.cc``). Single-controller TPU shape: stages
+are runtime actors (stateful, restartable) or stateless task fans; the
+driver owns routing, credits, and end-of-stream propagation.
+"""
+from tosem_tpu.dataflow.graph import (Stage, StreamGraph, keyed, rebalance,
+                                      broadcast)
+
+__all__ = ["StreamGraph", "Stage", "keyed", "rebalance", "broadcast"]

@@ -41,6 +41,10 @@ class LogMonitor:
                 size = os.path.getsize(path)
             except OSError:
                 continue
+            if size < off:          # truncated/rotated: restart at 0
+                with self._lock:
+                    self._files[path] = 0
+                off = 0
             if size <= off:
                 continue
             try:

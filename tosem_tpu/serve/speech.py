@@ -186,6 +186,10 @@ class CStreamingModel:
             raise RuntimeError(f"sp_finish rc={rc}")
         return buf.value.decode()
 
+    def abort(self, stream: int) -> None:
+        """Free a stream without decoding (failed/abandoned session)."""
+        self.lib.sp_free_stream(stream)
+
     def close(self) -> None:
         if self._model_p:
             self.lib.sp_free_model(self._model_p)
@@ -211,6 +215,9 @@ class SpeechStreamBackend:
         op = request["op"]
         if op == "create":
             sid = request["session"]
+            old = self._sessions.pop(sid, None)
+            if old is not None:       # client recovery re-creates: free old
+                self.cm.abort(old)
             self._sessions[sid] = self.cm.create_stream()
             return {"ok": True}
         stream = self._sessions.get(request["session"])
@@ -223,8 +230,11 @@ class SpeechStreamBackend:
         if op == "intermediate":
             return {"text": self.cm.intermediate(stream)}
         if op == "finish":
-            text = self.cm.finish(stream)
+            # the C stream is freed by finish() even on failure — the
+            # session mapping must go with it or the next call would use
+            # a dangling pointer
             del self._sessions[request["session"]]
+            text = self.cm.finish(stream)
             return {"text": text}
         raise ValueError(f"unknown op {op!r}")
 

@@ -7,22 +7,24 @@ algorithms (random, grid, TPE-style, evolution) drive them; failed trials
 recover from checkpoints (§5.3 elastic-recovery pattern — checkpoint-restart
 shaped, since TPU slices can't hot-resize).
 """
-from tosem_tpu.tune.schedulers import (ASHAScheduler, FIFOScheduler,
+from tosem_tpu.tune.schedulers import (ASHAScheduler, CurveFittingAssessor,
+                                       FIFOScheduler, HyperBandScheduler,
                                        MedianStoppingRule, PBTScheduler,
                                        TrialScheduler)
-from tosem_tpu.tune.search import (Choice, Domain, EvolutionSearch,
-                                   GridSearch, LogUniform, RandInt,
-                                   RandomSearch, SearchAlgorithm, TPESearch,
-                                   Uniform, choice, grid_search, loguniform,
-                                   randint, uniform)
+from tosem_tpu.tune.search import (BOHBSearch, Choice, Domain,
+                                   EvolutionSearch, GPSearch, GridSearch,
+                                   LogUniform, RandInt, RandomSearch,
+                                   SearchAlgorithm, TPESearch, Uniform,
+                                   choice, grid_search, loguniform, randint,
+                                   uniform)
 from tosem_tpu.tune.tune import Analysis, Trainable, Trial, run
 
 __all__ = [
     "run", "Analysis", "Trainable", "Trial",
     "TrialScheduler", "FIFOScheduler", "ASHAScheduler", "MedianStoppingRule",
-    "PBTScheduler",
+    "PBTScheduler", "HyperBandScheduler", "CurveFittingAssessor",
     "SearchAlgorithm", "RandomSearch", "GridSearch", "TPESearch",
-    "EvolutionSearch",
+    "EvolutionSearch", "GPSearch", "BOHBSearch",
     "uniform", "loguniform", "randint", "choice", "grid_search",
     "Domain", "Uniform", "LogUniform", "RandInt", "Choice",
 ]

@@ -14,6 +14,17 @@ import random
 from collections import defaultdict
 from typing import Any, Dict, List, Optional
 
+import numpy as np
+
+
+def _rung_decision(scores: List[float], s: float, rf: int) -> str:
+    """Shared async-successive-halving rule: record ``s`` at the rung and
+    keep it only if it sits in the running top ``1/rf``."""
+    scores.append(s)
+    k = max(1, int(math.ceil(len(scores) / rf)))
+    cutoff = sorted(scores, reverse=True)[k - 1]
+    return CONTINUE if s >= cutoff else STOP
+
 CONTINUE = "continue"
 STOP = "stop"
 
@@ -69,11 +80,7 @@ class ASHAScheduler(TrialScheduler):
             return STOP
         if iteration not in self.rungs:
             return CONTINUE
-        scores = self.rung_scores[iteration]
-        scores.append(s)
-        k = max(1, int(math.ceil(len(scores) / self.rf)))
-        cutoff = sorted(scores, reverse=True)[k - 1]
-        return CONTINUE if s >= cutoff else STOP
+        return _rung_decision(self.rung_scores[iteration], s, self.rf)
 
 
 class MedianStoppingRule(TrialScheduler):
@@ -151,3 +158,115 @@ class PBTScheduler(TrialScheduler):
                 new_cfg[key] = new_cfg[key] * factor
         self.configs[trial_id] = new_cfg
         return {"donor": donor, "config": new_cfg}
+
+
+class HyperBandScheduler(TrialScheduler):
+    """HyperBand: several successive-halving brackets run side by side.
+
+    The bracket half of the reference's BOHB advisor
+    (``nni/algorithms/hpo/bohb_advisor/``; Tune ``hyperband.py``): bracket
+    ``s`` starts trials at ``grace * rf**s`` and halves at every rung up to
+    ``max_t``, so aggressive early stopping and conservative full runs
+    coexist. Trials are assigned to brackets round-robin at first report.
+    """
+
+    def __init__(self, max_t: int = 81, reduction_factor: int = 3,
+                 grace_period: int = 1):
+        self.max_t = max_t
+        self.rf = reduction_factor
+        self.grace = grace_period
+        s_max = 0
+        t = grace_period
+        while t * reduction_factor <= max_t:
+            t *= reduction_factor
+            s_max += 1
+        # bracket s: rungs at grace*rf^s, grace*rf^(s+1), ..., max_t
+        self.brackets: List[List[int]] = []
+        for s in range(s_max + 1):
+            rungs = []
+            t = grace_period * (reduction_factor ** s)
+            while t < max_t:
+                rungs.append(t)
+                t *= reduction_factor
+            self.brackets.append(rungs)
+        self.assignment: Dict[str, int] = {}
+        self._next_bracket = 0
+        self.rung_scores: Dict[tuple, List[float]] = defaultdict(list)
+
+    def on_result(self, trial_id, iteration, result):
+        if trial_id not in self.assignment:
+            self.assignment[trial_id] = self._next_bracket
+            self._next_bracket = (self._next_bracket + 1) % len(
+                self.brackets)
+        b = self.assignment[trial_id]
+        s = self._score(result)
+        if iteration >= self.max_t:
+            return STOP
+        if iteration not in self.brackets[b]:
+            return CONTINUE
+        return _rung_decision(self.rung_scores[(b, iteration)], s, self.rf)
+
+
+class CurveFittingAssessor(TrialScheduler):
+    """Learning-curve extrapolation stopper.
+
+    The reference's ``nni/algorithms/hpo/curvefitting_assessor/`` fits a
+    parametric model ensemble to the partial metric history and stops the
+    trial when the PREDICTED final value cannot beat the best final seen.
+    Here: least-squares fits of two saturating families —
+    ``y = a - b * exp(-c t)`` and ``y = a - b * t**-c`` — over a coarse
+    ``c`` grid (each fit is then linear in a, b), averaged into one
+    prediction at ``target_iteration``.
+    """
+
+    def __init__(self, target_iteration: int = 100, grace_period: int = 6,
+                 margin: float = 0.02, min_completed: int = 1):
+        self.target = target_iteration
+        self.grace = grace_period
+        self.margin = margin
+        self.min_completed = min_completed
+        self.hist: Dict[str, List[float]] = defaultdict(list)
+        self.finals: List[float] = []
+
+    def predict_final(self, ys: List[float]) -> float:
+        t = np.arange(1, len(ys) + 1, dtype=float)
+        y = np.asarray(ys, float)
+        fits = []   # (sse, prediction) per family — combined by fit quality
+        for basis in ("exp", "pow"):
+            best = None
+            for c in (0.01, 0.03, 0.1, 0.3, 1.0):
+                f = np.exp(-c * t) if basis == "exp" else t ** (-c)
+                A = np.stack([np.ones_like(t), -f], 1)
+                coef, res, _, _ = np.linalg.lstsq(A, y, rcond=None)
+                sse = float(((A @ coef - y) ** 2).sum())
+                if best is None or sse < best[0]:
+                    ft = (math.exp(-c * self.target) if basis == "exp"
+                          else self.target ** (-c))
+                    best = (sse, coef[0] - coef[1] * ft)
+            fits.append(best)
+        # inverse-SSE weighting: a family that fits the history an order of
+        # magnitude better should dominate the extrapolation
+        ws = [1.0 / (sse + 1e-12) for sse, _ in fits]
+        return float(sum(w * p for w, (_, p) in zip(ws, fits)) / sum(ws))
+
+    def on_result(self, trial_id, iteration, result):
+        s = self._score(result)
+        self.hist[trial_id].append(s)
+        if iteration >= self.target:
+            self.finals.append(s)
+            return STOP
+        if (iteration < self.grace
+                or len(self.finals) < self.min_completed):
+            return CONTINUE
+        pred = self.predict_final(self.hist[trial_id])
+        best_final = max(self.finals)
+        span = abs(best_final) + 1e-9
+        if pred < best_final - self.margin * span:
+            self.finals.append(s)   # record truncated final for reference
+            return STOP
+        return CONTINUE
+
+    def on_complete(self, trial_id):
+        h = self.hist.get(trial_id)
+        if h:
+            self.finals.append(h[-1])

@@ -136,7 +136,10 @@ class SearchAlgorithm:
     def suggest(self) -> Dict[str, Any]:
         raise NotImplementedError
 
-    def observe(self, config: Dict[str, Any], score: float) -> None:
+    def observe(self, config: Dict[str, Any], score: float,
+                budget: Optional[float] = None) -> None:
+        """``budget``: fidelity of the observation (training iteration) —
+        consumed by multi-fidelity suggesters (BOHB), ignored elsewhere."""
         pass
 
 
@@ -197,7 +200,7 @@ class TPESearch(SearchAlgorithm):
         self.gamma = gamma
         self.obs: List[Tuple[Dict[str, Any], float]] = []
 
-    def observe(self, config, score):
+    def observe(self, config, score, budget=None):
         self.obs.append((config, score))
 
     def _split(self):
@@ -279,7 +282,7 @@ class EvolutionSearch(SearchAlgorithm):
         self.mutation_prob = mutation_prob
         self.obs: List[Tuple[Dict[str, Any], float]] = []
 
-    def observe(self, config, score):
+    def observe(self, config, score, budget=None):
         self.obs.append((config, score))
 
     def suggest(self):
@@ -309,3 +312,184 @@ class EvolutionSearch(SearchAlgorithm):
                 elif isinstance(dom, GridValues):
                     child[k] = self.rng.choice(dom.values)
         return child
+
+
+# -------------------------------------------------- model-based suggesters
+
+def _space_encoder(space: Dict[str, Any]):
+    """Build encode/decode between configs and a unit hypercube.
+
+    Numeric domains map through ``to_unit``; ``Choice``/``GridValues``
+    expand to one-hot blocks (the encoding SMAC-style surrogates use for
+    categoricals). → (encode(cfg) -> np.ndarray, dim, columns) where
+    columns[j] = (key, kind, payload) for decoding.
+    """
+    cols: List[Tuple[str, str, Any]] = []
+    constants: Dict[str, Any] = {}
+    for k in sorted(space):
+        dom = space[k]
+        if isinstance(dom, (GridValues, Choice)):
+            for v in dom.values:
+                cols.append((k, "onehot", v))
+        elif not isinstance(dom, Domain):
+            constants[k] = dom      # fixed value: no search dimension
+        elif dom.to_unit(dom.sample(random.Random(0))) is not None:
+            cols.append((k, "unit", dom))
+        else:  # pragma: no cover - exotic custom domain
+            cols.append((k, "raw", None))
+
+    def encode(cfg: Dict[str, Any]) -> np.ndarray:
+        x = np.zeros(len(cols))
+        for j, (k, kind, payload) in enumerate(cols):
+            if kind == "onehot":
+                x[j] = 1.0 if cfg.get(k) == payload else 0.0
+            elif kind == "unit":
+                x[j] = float(np.clip(payload.to_unit(cfg[k]), 0.0, 1.0))
+            else:
+                x[j] = float(cfg.get(k, 0.0))
+        return x
+
+    return encode, len(cols), cols, constants
+
+
+class GPSearch(SearchAlgorithm):
+    """Gaussian-process surrogate + expected improvement.
+
+    The model-based BO role of the reference's SMAC/GP/Metis tuners
+    (``nni/algorithms/hpo/smac_tuner/``, ``gp_tuner/``,
+    ``metis_tuner/``): RBF-kernel GP over unit-cube-encoded configs
+    (categoricals one-hot), EI acquisition maximized over a random
+    candidate pool. Pure NumPy — Cholesky posterior, no dependencies.
+    """
+
+    def __init__(self, seed: Optional[int] = None, n_startup: int = 8,
+                 n_candidates: int = 256, lengthscale: float = 0.3,
+                 noise: float = 1e-6):
+        self.rng = random.Random(seed)
+        self.np_rng = np.random.default_rng(seed)
+        self.n_startup = n_startup
+        self.n_candidates = n_candidates
+        self.ls = lengthscale
+        self.noise = noise
+        self.X: List[np.ndarray] = []
+        self.y: List[float] = []
+
+    def set_space(self, space, mode):
+        super().set_space(space, mode)
+        self._encode, self._dim, self._cols, self._consts = \
+            _space_encoder(space)
+
+    def observe(self, config, score, budget=None):
+        s = float(score)
+        self.X.append(self._encode(config))
+        self.y.append(-s if self.mode == "min" else s)
+
+    def _kernel(self, A: np.ndarray, B: np.ndarray) -> np.ndarray:
+        d2 = ((A[:, None, :] - B[None, :, :]) ** 2).sum(-1)
+        return np.exp(-0.5 * d2 / (self.ls ** 2))
+
+    def suggest(self):
+        if len(self.y) < self.n_startup:
+            return sample_config(self.space, self.rng)
+        X = np.stack(self.X)
+        y = np.asarray(self.y)
+        mu_y, sd_y = y.mean(), y.std() + 1e-9
+        yn = (y - mu_y) / sd_y
+        K = self._kernel(X, X) + self.noise * np.eye(len(X))
+        L = np.linalg.cholesky(K)
+        alpha = np.linalg.solve(L.T, np.linalg.solve(L, yn))
+        cands = [sample_config(self.space, self.rng)
+                 for _ in range(self.n_candidates)]
+        C = np.stack([self._encode(c) for c in cands])
+        Ks = self._kernel(C, X)                       # [m, n]
+        mu = Ks @ alpha
+        v = np.linalg.solve(L, Ks.T)                  # [n, m]
+        var = np.maximum(1.0 - (v ** 2).sum(0), 1e-12)
+        sd = np.sqrt(var)
+        best = yn.max()
+        z = (mu - best) / sd
+        # EI with the standard normal via erf (no scipy dependency)
+        pdf = np.exp(-0.5 * z ** 2) / math.sqrt(2 * math.pi)
+        cdf = 0.5 * (1.0 + np.vectorize(math.erf)(z / math.sqrt(2)))
+        ei = sd * (z * cdf + pdf)
+        return cands[int(np.argmax(ei))]
+
+
+class BOHBSearch(SearchAlgorithm):
+    """KDE-guided multi-fidelity suggester (the BOHB model).
+
+    The reference's ``nni/algorithms/hpo/bohb_advisor/`` fits TPE-style
+    good/bad kernel-density models PER BUDGET and samples configs that
+    maximize the density ratio, falling back to random with probability
+    ``random_fraction``. Pair with :class:`~tosem_tpu.tune.schedulers.
+    HyperBandScheduler` for the bracket half of BOHB — the tune runner
+    feeds ``observe(config, score, budget=iteration)`` so the model of the
+    highest sufficiently-populated budget drives sampling.
+    """
+
+    def __init__(self, seed: Optional[int] = None, min_points: int = 8,
+                 top_fraction: float = 0.25, random_fraction: float = 0.2,
+                 n_samples: int = 64, bandwidth: float = 0.1):
+        self.rng = random.Random(seed)
+        self.np_rng = np.random.default_rng(seed)
+        self.min_points = min_points
+        self.top_fraction = top_fraction
+        self.random_fraction = random_fraction
+        self.n_samples = n_samples
+        self.bw = bandwidth
+        self.obs: Dict[float, List[Tuple[np.ndarray, float]]] = {}
+
+    def set_space(self, space, mode):
+        super().set_space(space, mode)
+        self._encode, self._dim, self._cols, self._consts = \
+            _space_encoder(space)
+
+    def observe(self, config, score, budget=None):
+        s = float(score)
+        if self.mode == "min":
+            s = -s
+        b = float(budget if budget is not None else 1.0)
+        self.obs.setdefault(b, []).append((self._encode(config), s))
+
+    def _model_budget(self) -> Optional[float]:
+        for b in sorted(self.obs, reverse=True):
+            if len(self.obs[b]) >= self.min_points:
+                return b
+        return None
+
+    def _log_kde(self, X: np.ndarray, q: np.ndarray) -> np.ndarray:
+        d2 = ((q[:, None, :] - X[None, :, :]) ** 2).sum(-1)
+        return np.log(np.exp(-0.5 * d2 / self.bw ** 2).mean(1) + 1e-300)
+
+    def suggest(self):
+        b = self._model_budget()
+        if b is None or self.rng.random() < self.random_fraction:
+            return sample_config(self.space, self.rng)
+        pts = self.obs[b]
+        pts_sorted = sorted(pts, key=lambda p: -p[1])
+        n_good = max(2, int(len(pts) * self.top_fraction))
+        good = np.stack([p[0] for p in pts_sorted[:n_good]])
+        bad = np.stack([p[0] for p in pts_sorted[n_good:]]) \
+            if len(pts) > n_good else good
+        # candidates: jitter around good points (BOHB's sample-from-l(x))
+        centers = good[self.np_rng.integers(0, len(good), self.n_samples)]
+        cands = centers + self.np_rng.normal(0, self.bw,
+                                             centers.shape)
+        ratio = self._log_kde(good, cands) - self._log_kde(bad, cands)
+        best = cands[int(np.argmax(ratio))]
+        return self._decode(np.clip(best, 0.0, 1.0))
+
+    def _decode(self, x: np.ndarray) -> Dict[str, Any]:
+        cfg: Dict[str, Any] = {}
+        onehot: Dict[str, List[Tuple[float, Any]]] = {}
+        for j, (k, kind, payload) in enumerate(self._cols):
+            if kind == "onehot":
+                onehot.setdefault(k, []).append((x[j], payload))
+            elif kind == "unit":
+                cfg[k] = payload.from_unit(float(np.clip(x[j], 0, 1)))
+            else:
+                cfg[k] = float(x[j])
+        for k, opts in onehot.items():
+            cfg[k] = max(opts, key=lambda o: o[0])[1]
+        cfg.update(self._consts)
+        return cfg

@@ -171,6 +171,12 @@ def run(trainable, config_space: Dict[str, Any], *, metric: str, mode: str,
                        for v in config_space.values())
         search_alg = GridSearch() if has_grid else RandomSearch()
     search_alg.set_space(config_space, mode)
+    # older/user suggesters may define observe(config, score) without the
+    # budget kwarg — detect once and call compatibly
+    _observe_params = inspect.signature(search_alg.observe).parameters
+    _wants_budget = ("budget" in _observe_params
+                     or any(p.kind is inspect.Parameter.VAR_KEYWORD
+                            for p in _observe_params.values()))
     if isinstance(search_alg, GridSearch):
         num_samples = max(num_samples, search_alg.grid_size())
 
@@ -211,6 +217,7 @@ def run(trainable, config_space: Dict[str, Any], *, metric: str, mode: str,
             t.handle = None
         t.step_ref = None
         running.remove(t)
+        scheduler.on_complete(t.trial_id)
 
     while created < num_samples or running:
         while created < num_samples and len(running) < max_concurrent:
@@ -258,7 +265,11 @@ def run(trainable, config_space: Dict[str, Any], *, metric: str, mode: str,
                 t.step_ref = t.handle.step.remote()
                 continue
             t.reported_iter = t.iteration
-            search_alg.observe(t.config, float(result[metric]))
+            if _wants_budget:
+                search_alg.observe(t.config, float(result[metric]),
+                                   budget=t.iteration)
+            else:
+                search_alg.observe(t.config, float(result[metric]))
             decision = scheduler.on_result(t.trial_id, t.iteration, result)
             if stop is not None and stop(result):
                 decision = STOP
