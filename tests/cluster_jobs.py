@@ -1,0 +1,96 @@
+"""Job targets run inside LocalCluster child processes.
+
+Kept in an importable module (not the test file) because cluster workers
+are fresh interpreters that import jobs by ``"module:function"`` name —
+the same constraint Ray puts on remote functions under spawn.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+def _global_mesh() -> Mesh:
+    return Mesh(np.array(jax.devices()), ("dp",))
+
+
+def allreduce_job(workdir: str):
+    """Cross-process psum over the global device set: each device
+    contributes (process_index + 1); the replicated sum proves the
+    collective crossed process boundaries."""
+    mesh = _global_mesh()
+    n = jax.device_count()
+    dp = NamedSharding(mesh, PartitionSpec("dp"))
+    rep = NamedSharding(mesh, PartitionSpec())
+    x = jax.make_array_from_callback(
+        (n,), dp,
+        lambda idx: np.array([float(jax.process_index() + 1)], np.float32))
+    total = jax.jit(jnp.sum, out_shardings=rep)(x)
+    return {"total": float(total.addressable_data(0)), "n_devices": n}
+
+
+def spin_job(workdir: str, seconds: float = 60.0):
+    """Joins, signals readiness, then idles — the kill-target job."""
+    _global_mesh()
+    rank = jax.process_index()
+    open(os.path.join(workdir, f"ready_p{rank}"), "w").close()
+    t0 = time.time()
+    while time.time() - t0 < seconds:
+        time.sleep(0.1)
+    return {"done": True}
+
+
+def train_job(workdir: str, steps: int = 5, crash_rank: int = 1,
+              crash_at: int = 2):
+    """Toy distributed SGD with per-step checkpointing; crashes once.
+
+    Rank ``crash_rank`` hard-exits after step ``crash_at`` the first time
+    the job runs in ``workdir`` (sentinel-guarded). A relaunched generation
+    restores from the last checkpoint and finishes — the cluster-wide
+    version of tune's checkpoint-relaunch recovery.
+    """
+    mesh = _global_mesh()
+    rank = jax.process_index()
+    n = jax.device_count()
+    dp = NamedSharding(mesh, PartitionSpec("dp"))
+    rep = NamedSharding(mesh, PartitionSpec())
+
+    ckpt = os.path.join(workdir, "ckpt.json")
+    start, w = 0, np.zeros(4, np.float32)
+    if os.path.exists(ckpt):
+        with open(ckpt) as f:
+            d = json.load(f)
+        start, w = d["step"], np.array(d["w"], np.float32)
+
+    # fixed global batch: device i holds target row full of (i + 1)
+    x = jax.make_array_from_callback(
+        (n, 4), dp,
+        lambda idx: np.full((1, 4), float(idx[0].start) + 1.0, np.float32))
+
+    def _step(w_rep, xs):
+        g = jnp.mean(xs - w_rep[None, :], axis=0)   # all-reduce over dp
+        return w_rep + 0.5 * g
+
+    step_fn = jax.jit(_step, out_shardings=rep)
+    w_arr = jax.device_put(w, rep)
+    w_host = w
+    sentinel = os.path.join(workdir, "crashed_once")
+    for s in range(start, steps):
+        w_arr = step_fn(w_arr, x)
+        w_host = np.asarray(w_arr.addressable_data(0))
+        if rank == 0:
+            tmp = ckpt + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump({"step": s + 1, "w": w_host.tolist()}, f)
+            os.replace(tmp, ckpt)
+        if (s + 1 == crash_at and rank == crash_rank
+                and not os.path.exists(sentinel)):
+            open(sentinel, "w").close()
+            os._exit(17)
+    return {"start_step": start, "final_w": w_host.tolist()}

@@ -1,5 +1,6 @@
 from tosem_tpu.parallel.mesh import (MeshSpec, make_mesh, default_mesh,
                                      multihost_init)
+from tosem_tpu.parallel.cluster import ClusterResult, LocalCluster
 from tosem_tpu.parallel.collectives import (CollectiveSpec, collective_bench,
                                             bus_bandwidth_factor,
                                             DEFAULT_COLLECTIVE_SWEEP,

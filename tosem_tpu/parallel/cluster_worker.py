@@ -1,0 +1,55 @@
+"""Child-process entry point for :mod:`tosem_tpu.parallel.cluster`.
+
+The per-"host" bootstrap (the role ``ray start``'s worker bring-up plays,
+``python/ray/_private/services.py``): force the CPU platform, join the
+coordinator through :func:`multihost_init`'s real branch, import the named
+job target, run it, and persist the JSON result for the driver.
+"""
+from __future__ import annotations
+
+import importlib
+import json
+import os
+import sys
+
+
+def main() -> int:
+    spec_path = os.environ["TOSEM_CLUSTER_SPEC"]
+    with open(spec_path) as f:
+        spec = json.load(f)
+    for p in spec.get("extra_sys_path", []):
+        if p not in sys.path:
+            sys.path.insert(0, p)
+
+    # conftest recipe (see tests/conftest.py): env alone is not enough when
+    # a sitecustomize rewrites jax_platforms — force it via config too,
+    # before any device query or distributed init.
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    # cross-process CPU collectives ride gloo (the NCCL-stand-in on host)
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+    from tosem_tpu.parallel.mesh import multihost_init
+    joined = multihost_init()
+    rank = jax.process_index()
+
+    mod_name, fn_name = spec["target"].split(":")
+    fn = getattr(importlib.import_module(mod_name), fn_name)
+    out = fn(workdir=spec["workdir"], **spec["kwargs"])
+
+    result = {"joined": joined, "rank": rank,
+              "n_global_devices": jax.device_count(),
+              "n_local_devices": jax.local_device_count(),
+              "out": out}
+    res_path = os.path.join(
+        spec["workdir"], f"result_{spec['run']}_p{rank}.json")
+    tmp = res_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(result, f)
+    os.replace(tmp, res_path)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
