@@ -1,0 +1,7 @@
+from tosem_tpu.nas.graph import (Graph, GraphModule, GraphValidationError,
+                                 NodeSpec, chain_graph, node)
+from tosem_tpu.nas.mutator import (AddSkip, InsertNode, Mutator, RemoveNode,
+                                   ResizeDense, SearchSpace, SwapActivation,
+                                   default_mutators, mutate, random_graph)
+from tosem_tpu.nas.search import (SearchResult, evolution_search,
+                                  make_train_evaluator, random_search)

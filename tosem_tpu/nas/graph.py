@@ -1,0 +1,237 @@
+"""Graph IR for neural architecture search.
+
+The role of Retiarii's model graph (``nni/retiarii/graph.py``: ``Model`` /
+``Graph`` / ``Node`` with ops and edges, serialized via ``_dump``/``_load``)
+and AutoKeras's block graph (``autokeras/graph.py``, ``auto_model.py:55``).
+TPU-first differences from both:
+
+- The IR **compiles to a pure functional Module** (params-as-pytrees), so a
+  candidate architecture jits exactly like a hand-written model — no graph
+  interpreter at run time, XLA sees a static program per candidate.
+- Shape inference is **explicit and static**: every node's output dim is
+  known at build time; multi-input nodes sum their inputs, auto-projecting
+  mismatched dims with a Dense (AutoKeras-merge style), so any well-formed
+  graph lowers to a valid static-shape program.
+- Serialization is a plain JSON-able dict (``to_config``/``from_config``),
+  the Retiarii ``_dump`` analog, so search history and checkpoints reuse
+  the framework's results/checkpoint plumbing.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tosem_tpu.nn.core import Module, variables
+from tosem_tpu.nn.layers import Dense, LayerNorm, gelu, relu
+
+ACTIVATIONS: Dict[str, Callable] = {
+    "relu": relu,
+    "gelu": gelu,
+    "tanh": jnp.tanh,
+    "identity": lambda x: x,
+}
+
+
+@dataclass(frozen=True)
+class NodeSpec:
+    """One operator instance in the graph (Retiarii ``Node`` analog)."""
+    name: str
+    op: str                      # "dense" | "identity" | "layernorm"
+    config: Tuple[Tuple[str, Any], ...] = ()
+    inputs: Tuple[str, ...] = ()
+
+    def cfg(self) -> Dict[str, Any]:
+        return dict(self.config)
+
+    def with_config(self, **updates) -> "NodeSpec":
+        cfg = self.cfg()
+        cfg.update(updates)
+        return NodeSpec(self.name, self.op, tuple(sorted(cfg.items())),
+                        self.inputs)
+
+
+def node(name: str, op: str, inputs: Sequence[str] = (), **config) -> NodeSpec:
+    return NodeSpec(name, op, tuple(sorted(config.items())), tuple(inputs))
+
+
+class GraphValidationError(ValueError):
+    pass
+
+
+@dataclass
+class Graph:
+    """A DAG of :class:`NodeSpec` with a single distinguished output.
+
+    ``"input"`` is the implicit source node name; ``input_dim`` is its
+    feature width. Node order in ``nodes`` must be topological (enforced
+    by :meth:`validate`).
+    """
+    input_dim: int
+    nodes: List[NodeSpec] = field(default_factory=list)
+    output: str = ""
+
+    # -- structure -----------------------------------------------------
+
+    def names(self) -> List[str]:
+        return [n.name for n in self.nodes]
+
+    def get(self, name: str) -> NodeSpec:
+        for n in self.nodes:
+            if n.name == name:
+                return n
+        raise KeyError(name)
+
+    def validate(self) -> None:
+        seen = {"input"}
+        if not self.nodes:
+            raise GraphValidationError("empty graph")
+        for n in self.nodes:
+            if n.name in seen:
+                raise GraphValidationError(f"duplicate node {n.name!r}")
+            if n.op not in ("dense", "identity", "layernorm"):
+                raise GraphValidationError(f"unknown op {n.op!r}")
+            if not n.inputs:
+                raise GraphValidationError(f"node {n.name!r} has no inputs")
+            if n.op == "dense":
+                dim = n.cfg().get("dim")
+                if not isinstance(dim, int) or dim <= 0:
+                    raise GraphValidationError(
+                        f"dense node {n.name!r} needs a positive int 'dim', "
+                        f"got {dim!r}")
+            for src in n.inputs:
+                if src not in seen:
+                    raise GraphValidationError(
+                        f"node {n.name!r} reads {src!r} before definition "
+                        "(graph must be topologically ordered)")
+            seen.add(n.name)
+        if self.output not in seen or self.output == "input":
+            raise GraphValidationError(f"bad output node {self.output!r}")
+
+    def out_dims(self) -> Dict[str, int]:
+        """Static shape inference: feature width of every node."""
+        dims = {"input": self.input_dim}
+        for n in self.nodes:
+            in_dim = max(dims[s] for s in n.inputs)
+            if n.op == "dense":
+                dims[n.name] = int(n.cfg()["dim"])
+            else:                      # identity / layernorm preserve width
+                dims[n.name] = in_dim
+        return dims
+
+    # -- serialization (retiarii _dump/_load analog) -------------------
+
+    def to_config(self) -> Dict[str, Any]:
+        return {
+            "input_dim": self.input_dim,
+            "output": self.output,
+            "nodes": [
+                {"name": n.name, "op": n.op, "config": n.cfg(),
+                 "inputs": list(n.inputs)}
+                for n in self.nodes
+            ],
+        }
+
+    @classmethod
+    def from_config(cls, cfg: Dict[str, Any]) -> "Graph":
+        g = cls(input_dim=int(cfg["input_dim"]),
+                nodes=[NodeSpec(d["name"], d["op"],
+                                tuple(sorted(d["config"].items())),
+                                tuple(d["inputs"]))
+                       for d in cfg["nodes"]],
+                output=cfg["output"])
+        g.validate()
+        return g
+
+    def key(self) -> str:
+        """Stable dedup key for search history."""
+        import json
+        return json.dumps(self.to_config(), sort_keys=True)
+
+    # -- compilation ---------------------------------------------------
+
+    def build(self, out_dim: Optional[int] = None) -> "GraphModule":
+        """Lower the IR to a jittable Module (optionally with a final
+        Dense head to ``out_dim``)."""
+        self.validate()
+        return GraphModule(self, out_dim)
+
+
+class GraphModule(Module):
+    """Compiled form of a :class:`Graph`.
+
+    Construction resolves every node to a concrete sub-module and every
+    dim-mismatched skip input to a Dense projection, so ``apply`` is a
+    fixed sequence of calls — fully static under ``jit``.
+    """
+
+    def __init__(self, graph: Graph, out_dim: Optional[int] = None):
+        self.graph = graph
+        dims = graph.out_dims()
+        self._mods: Dict[str, Optional[Module]] = {}
+        self._projs: Dict[str, Module] = {}       # "node<-src" projections
+        self._acts: Dict[str, Callable] = {}
+        for n in graph.nodes:
+            in_dim = max(dims[s] for s in n.inputs)
+            for src in n.inputs:
+                if dims[src] != in_dim:
+                    self._projs[f"{n.name}<-{src}"] = Dense(dims[src], in_dim)
+            cfg = n.cfg()
+            if n.op == "dense":
+                self._mods[n.name] = Dense(in_dim, int(cfg["dim"]))
+                self._acts[n.name] = ACTIVATIONS[cfg.get("act", "relu")]
+            elif n.op == "layernorm":
+                self._mods[n.name] = LayerNorm(in_dim)
+            else:
+                self._mods[n.name] = None          # identity
+        self.head = (Dense(dims[graph.output], out_dim)
+                     if out_dim is not None else None)
+
+    def init(self, key: jax.Array) -> Dict[str, Any]:
+        parts = list(self._mods.items()) + list(self._projs.items())
+        keys = jax.random.split(key, len(parts) + 1)
+        params: Dict[str, Any] = {}
+        for (name, m), k in zip(parts, keys[:-1]):
+            if m is not None:
+                params[name] = m.init(k)["params"]
+        if self.head is not None:
+            params["__head__"] = self.head.init(keys[-1])["params"]
+        return variables(params)
+
+    def apply(self, vs, x, *, train: bool = False, rng=None):
+        p = vs["params"]
+        acts = {"input": x}
+        for n in self.graph.nodes:
+            ins = []
+            for src in n.inputs:
+                h = acts[src]
+                proj = self._projs.get(f"{n.name}<-{src}")
+                if proj is not None:
+                    h, _ = proj.apply(variables(p[f"{n.name}<-{src}"]), h)
+                ins.append(h)
+            h = ins[0] if len(ins) == 1 else sum(ins)
+            m = self._mods[n.name]
+            if m is not None:
+                h, _ = m.apply(variables(p[n.name]), h)
+                if n.name in self._acts:
+                    h = self._acts[n.name](h)
+            acts[n.name] = h
+        out = acts[self.graph.output]
+        if self.head is not None:
+            out, _ = self.head.apply(variables(p["__head__"]), out)
+        return out, vs["state"]
+
+
+def chain_graph(input_dim: int, dims: Sequence[int],
+                act: str = "relu") -> Graph:
+    """Plain MLP chain — the canonical seed architecture."""
+    nodes, prev = [], "input"
+    for i, d in enumerate(dims):
+        name = f"n{i}"
+        nodes.append(node(name, "dense", [prev], dim=int(d), act=act))
+        prev = name
+    g = Graph(input_dim=input_dim, nodes=nodes, output=prev)
+    g.validate()
+    return g

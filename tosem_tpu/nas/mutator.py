@@ -1,0 +1,197 @@
+"""Architecture mutators (``nni/retiarii/mutator.py`` analog).
+
+Retiarii expresses a search space as a base model plus ``Mutator`` objects
+whose ``mutate(model)`` picks among candidates; sampling a model = applying
+every mutator once. Same contract here, over the JSON-able :class:`Graph`
+IR: each mutator is a pure function ``Graph -> Graph`` (graphs are never
+mutated in place — the functional-transform idiom), and a
+:class:`SearchSpace` bundles the palette plus the mutator set. Mutators
+preserve validity by construction: they re-topologize and re-validate
+before returning.
+"""
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Callable, List, Sequence, Tuple
+
+from tosem_tpu.nas.graph import (ACTIVATIONS, Graph, GraphValidationError,
+                                 NodeSpec, chain_graph, node)
+
+
+@dataclass(frozen=True)
+class SearchSpace:
+    """Palette the mutators draw from."""
+    input_dim: int = 8
+    dim_palette: Tuple[int, ...] = (16, 32, 64, 128)
+    act_palette: Tuple[str, ...] = ("relu", "gelu", "tanh")
+    min_depth: int = 1
+    max_depth: int = 8
+
+
+class Mutator:
+    """Base mutator: ``apply(graph, rng) -> Graph`` (pure)."""
+
+    def apply(self, g: Graph, rng: random.Random) -> Graph:
+        raise NotImplementedError
+
+
+def _fresh_name(g: Graph, rng: random.Random) -> str:
+    names = set(g.names())
+    while True:
+        cand = f"n{rng.randrange(10_000_000)}"
+        if cand not in names:
+            return cand
+
+
+def _dense_nodes(g: Graph) -> List[NodeSpec]:
+    return [n for n in g.nodes if n.op == "dense"]
+
+
+class SwapActivation(Mutator):
+    def __init__(self, space: SearchSpace):
+        self.space = space
+
+    def apply(self, g, rng):
+        dense = _dense_nodes(g)
+        if not dense:
+            return g
+        target = rng.choice(dense)
+        act = rng.choice(self.space.act_palette)
+        nodes = [n.with_config(act=act) if n.name == target.name else n
+                 for n in g.nodes]
+        return Graph(g.input_dim, nodes, g.output)
+
+
+class ResizeDense(Mutator):
+    def __init__(self, space: SearchSpace):
+        self.space = space
+
+    def apply(self, g, rng):
+        dense = _dense_nodes(g)
+        if not dense:
+            return g
+        target = rng.choice(dense)
+        dim = rng.choice(self.space.dim_palette)
+        nodes = [n.with_config(dim=int(dim)) if n.name == target.name else n
+                 for n in g.nodes]
+        return Graph(g.input_dim, nodes, g.output)
+
+
+class InsertNode(Mutator):
+    """Depth growth: splice a fresh dense node onto one edge."""
+
+    def __init__(self, space: SearchSpace):
+        self.space = space
+
+    def apply(self, g, rng):
+        if len(_dense_nodes(g)) >= self.space.max_depth:
+            return g
+        # pick a node; the new node takes its place as consumer input
+        idx = rng.randrange(len(g.nodes))
+        target = g.nodes[idx]
+        new = node(_fresh_name(g, rng), "dense", [target.name],
+                   dim=int(rng.choice(self.space.dim_palette)),
+                   act=rng.choice(self.space.act_palette))
+        nodes = list(g.nodes)
+        nodes.insert(idx + 1, new)
+        # rewire: consumers after the insertion point that read target now
+        # read the new node (single-edge splice keeps the rest intact)
+        out = g.output
+        rewired = []
+        for i, n in enumerate(nodes):
+            if i > idx + 1 and target.name in n.inputs:
+                n = NodeSpec(n.name, n.op, n.config,
+                             tuple(new.name if s == target.name else s
+                                   for s in n.inputs))
+                # only splice the first consumer; deeper fan-out stays
+                rewired.append(n)
+                rewired.extend(nodes[i + 1:])
+                break
+            rewired.append(n)
+        else:
+            # target was the output — new node becomes the output
+            out = new.name if g.output == target.name else g.output
+        return Graph(g.input_dim, rewired, out)
+
+
+class RemoveNode(Mutator):
+    """Depth shrink: drop a dense node, rewiring consumers to its input."""
+
+    def __init__(self, space: SearchSpace):
+        self.space = space
+
+    def apply(self, g, rng):
+        dense = _dense_nodes(g)
+        if len(dense) <= self.space.min_depth:
+            return g
+        target = rng.choice(dense)
+        replacement = target.inputs[0]
+        nodes = []
+        for n in g.nodes:
+            if n.name == target.name:
+                continue
+            if target.name in n.inputs:
+                new_inputs = tuple(replacement if s == target.name else s
+                                   for s in n.inputs)
+                # collapse duplicates introduced by the rewire
+                seen, dedup = set(), []
+                for s in new_inputs:
+                    if s not in seen:
+                        seen.add(s)
+                        dedup.append(s)
+                n = NodeSpec(n.name, n.op, n.config, tuple(dedup))
+            nodes.append(n)
+        out = replacement if g.output == target.name else g.output
+        if out == "input":
+            return g                     # would leave a bare passthrough
+        return Graph(g.input_dim, nodes, out)
+
+
+class AddSkip(Mutator):
+    """Add a skip connection from an earlier node (InputChoice analog)."""
+
+    def apply(self, g, rng):
+        if len(g.nodes) < 2:
+            return g
+        idx = rng.randrange(1, len(g.nodes))
+        target = g.nodes[idx]
+        earlier = ["input"] + [n.name for n in g.nodes[:idx]]
+        src = rng.choice(earlier)
+        if src in target.inputs:
+            return g
+        nodes = list(g.nodes)
+        nodes[idx] = NodeSpec(target.name, target.op, target.config,
+                              target.inputs + (src,))
+        return Graph(g.input_dim, nodes, g.output)
+
+
+def default_mutators(space: SearchSpace) -> List[Mutator]:
+    return [SwapActivation(space), ResizeDense(space), InsertNode(space),
+            RemoveNode(space), AddSkip()]
+
+
+def random_graph(space: SearchSpace, rng: random.Random) -> Graph:
+    """Sample a fresh architecture: random-depth chain + random skips."""
+    depth = rng.randint(space.min_depth, space.max_depth)
+    dims = [rng.choice(space.dim_palette) for _ in range(depth)]
+    g = chain_graph(space.input_dim, dims, act=rng.choice(space.act_palette))
+    skips = AddSkip()
+    for _ in range(rng.randint(0, 2)):
+        g = skips.apply(g, rng)
+    g.validate()
+    return g
+
+
+def mutate(g: Graph, space: SearchSpace, rng: random.Random,
+           mutators: Sequence[Mutator] = None) -> Graph:
+    """One mutation step; falls back to the parent on a no-op/invalid
+    proposal so callers always get a valid graph."""
+    muts = list(mutators) if mutators else default_mutators(space)
+    m = rng.choice(muts)
+    child = m.apply(g, rng)
+    try:
+        child.validate()
+    except GraphValidationError:
+        return g
+    return child

@@ -1,0 +1,138 @@
+"""NAS search loops + trained-accuracy evaluator.
+
+The exploration strategies of Retiarii (``nni/retiarii/strategy/``:
+random, regularized evolution) and AutoKeras's tuner-driven loop
+(``auto_model.py:203`` fit→tuner.search). Regularized (aging) evolution is
+the searcher — sample-k, mutate the best, kill the oldest — because it
+maps cleanly onto the Graph IR's pure mutators and needs no surrogate
+model. Evaluation is pluggable: the unit tests use a cheap oracle; the
+integration path trains each candidate for a few hundred jitted SGD steps
+on device (every candidate compiles to a static XLA program, so the whole
+evaluation is one ``lax``-friendly train loop per arch).
+"""
+from __future__ import annotations
+
+import collections
+import random
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tosem_tpu.nas.graph import Graph
+from tosem_tpu.nas.mutator import (Mutator, SearchSpace, default_mutators,
+                                   mutate, random_graph)
+
+
+@dataclass
+class SearchResult:
+    best: Graph
+    best_score: float
+    history: List[Tuple[str, float]] = field(default_factory=list)
+    evaluations: int = 0         # true evaluate() calls (history includes
+                                 # memo hits, so len(history) can exceed it)
+
+
+def random_search(space: SearchSpace,
+                  evaluate: Callable[[Graph], float],
+                  budget: int, seed: int = 0) -> SearchResult:
+    """Baseline: i.i.d. samples from the space (the control arm)."""
+    rng = random.Random(seed)
+    best, best_score, hist = None, float("-inf"), []
+    for _ in range(budget):
+        g = random_graph(space, rng)
+        s = float(evaluate(g))
+        hist.append((g.key(), s))
+        if s > best_score:
+            best, best_score = g, s
+    return SearchResult(best, best_score, hist, evaluations=budget)
+
+
+def evolution_search(space: SearchSpace,
+                     evaluate: Callable[[Graph], float],
+                     budget: int,
+                     population_size: int = 16,
+                     sample_size: int = 4,
+                     seed: int = 0,
+                     mutators: Optional[Sequence[Mutator]] = None,
+                     seen_cache: bool = True) -> SearchResult:
+    """Regularized evolution (Real et al.; retiarii's evolution strategy).
+
+    Aging: population is a FIFO; each step tournament-samples
+    ``sample_size`` members, mutates the fittest, evaluates the child and
+    retires the oldest. A key-level memo avoids re-evaluating identical
+    architectures (mutators may no-op).
+    """
+    rng = random.Random(seed)
+    muts = list(mutators) if mutators else default_mutators(space)
+    memo: Dict[str, float] = {}
+    hist: List[Tuple[str, float]] = []
+    best, best_score = None, float("-inf")
+    spent = calls = 0
+    # termination backstop: a space smaller than the budget (every sample
+    # a memo hit) must exhaust attempts, not spin forever
+    max_calls = max(budget * 20, 100)
+
+    def score(g: Graph) -> float:
+        nonlocal spent, calls, best, best_score
+        calls += 1
+        k = g.key()
+        if not (seen_cache and k in memo):
+            memo[k] = float(evaluate(g))
+            spent += 1
+        s = memo[k]
+        hist.append((k, s))
+        if s > best_score:
+            best, best_score = g, s
+        return s
+
+    population: collections.deque = collections.deque()
+    while (len(population) < population_size and spent < budget
+           and calls < max_calls):
+        g = random_graph(space, rng)
+        population.append((g, score(g)))
+    while spent < budget and calls < max_calls:
+        contenders = [population[rng.randrange(len(population))]
+                      for _ in range(min(sample_size, len(population)))]
+        parent = max(contenders, key=lambda t: t[1])[0]
+        child = mutate(parent, space, rng, muts)
+        population.append((child, score(child)))
+        population.popleft()                      # aging
+    return SearchResult(best, best_score, hist, evaluations=spent)
+
+
+# -- trained-accuracy evaluator ---------------------------------------
+
+
+def make_train_evaluator(x: jax.Array, y: jax.Array,
+                         out_dim: int,
+                         steps: int = 200,
+                         lr: float = 1e-2,
+                         seed: int = 0) -> Callable[[Graph], float]:
+    """Score = −final MSE after ``steps`` of full-batch SGD.
+
+    Each candidate lowers to one static XLA program; the train loop is a
+    ``lax.scan`` so the whole evaluation is a single device execution —
+    the TPU-shaped version of AutoKeras's per-trial ``model.fit``.
+    """
+    def evaluate(g: Graph) -> float:
+        model = g.build(out_dim=out_dim)
+        vs = model.init(jax.random.key(seed))
+
+        def loss_fn(params):
+            pred, _ = model.apply({"params": params, "state": {}}, x)
+            return jnp.mean((pred - y) ** 2)
+
+        @jax.jit
+        def run(params):
+            def step(p, _):
+                grad = jax.grad(loss_fn)(p)
+                return jax.tree_util.tree_map(
+                    lambda w, dw: w - lr * dw, p, grad), None
+            final, _ = jax.lax.scan(step, params, None, length=steps)
+            return loss_fn(final)
+
+        return -float(run(vs["params"]))
+
+    return evaluate
