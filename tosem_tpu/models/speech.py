@@ -228,3 +228,35 @@ def wer(ref: str, hyp: str) -> float:
 
 def cer(ref: str, hyp: str) -> float:
     return edit_distance(list(ref), list(hyp)) / max(1, len(ref))
+
+
+def transcribe(log_probs, blank: int, beam_width: int = 32,
+               scorer=None, bonus=None) -> str:
+    """Decode one utterance to text — with an optional external scorer
+    this is the reference's LM-rescored eval path
+    (``evaluate.py`` + ``ctc_beam_search_decoder`` with ``Scorer``)."""
+    from tosem_tpu.data.audio import labels_to_text
+    from tosem_tpu.ops.ctc import beam_search_decode
+
+    labels, _ = beam_search_decode(log_probs, blank=blank,
+                                   beam_width=beam_width, bonus=bonus,
+                                   scorer=scorer)
+    return labels_to_text(labels)
+
+
+def evaluate_wer(batch_log_probs, lengths, refs, blank: int,
+                 beam_width: int = 32, scorer=None) -> dict:
+    """Mean WER/CER over a batch (``evaluate.py:calculate_and_print_report``
+    role). ``batch_log_probs``: [B, T, V] log-softmax; ``lengths``: [B]."""
+    import numpy as np
+    lp = np.asarray(batch_log_probs)
+    ln = np.asarray(lengths)
+    wers, cers, hyps = [], [], []
+    for i, ref in enumerate(refs):
+        hyp = transcribe(lp[i, :int(ln[i])], blank=blank,
+                         beam_width=beam_width, scorer=scorer)
+        hyps.append(hyp)
+        wers.append(wer(ref, hyp))
+        cers.append(cer(ref, hyp))
+    return {"wer": float(np.mean(wers)), "cer": float(np.mean(cers)),
+            "hypotheses": hyps}

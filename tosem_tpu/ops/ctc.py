@@ -89,37 +89,141 @@ def ctc_loss_mean(logits, labels, input_lengths, label_lengths,
     return jnp.mean(nll)
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=None)
+def _decoder_lib():
+    import ctypes
+
+    from tosem_tpu.native import load_library
+
+    lib = load_library("ctc_decoder")
+    i32, f32, ptr = ctypes.c_int32, ctypes.c_float, ctypes.c_void_p
+    out_args = [ptr, ctypes.POINTER(i32), ctypes.POINTER(f32), i32]
+    lib.ctc_beam_decode.restype = ctypes.c_int
+    lib.ctc_beam_decode.argtypes = [ptr, i32, i32, i32, i32, ptr] + out_args
+    lib.ctc_beam_decode_lm.restype = ctypes.c_int
+    lib.ctc_beam_decode_lm.argtypes = ([ptr, i32, i32, i32, i32, ptr,
+                                        f32, f32, i32, ptr] + out_args)
+    lib.tosem_lm_load.restype = ctypes.c_void_p
+    lib.tosem_lm_load.argtypes = [ctypes.c_char_p]
+    lib.tosem_lm_free.argtypes = [ctypes.c_void_p]
+    lib.tosem_lm_order.restype = ctypes.c_int32
+    lib.tosem_lm_order.argtypes = [ctypes.c_void_p]
+    lib.tosem_lm_n_words.restype = ctypes.c_int32
+    lib.tosem_lm_n_words.argtypes = [ctypes.c_void_p]
+    lib.tosem_lm_score.restype = ctypes.c_float
+    lib.tosem_lm_score.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                   ctypes.c_int32, ctypes.c_int32]
+    lib.tosem_lm_word_id.restype = ctypes.c_int32
+    lib.tosem_lm_word_id.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                     ctypes.c_int32]
+    return lib
+
+
+class Scorer:
+    """Loaded n-gram LM with α/β weights (the KenLM ``Scorer`` analog,
+    ``native_client/ctcdecode/scorer.cpp:349``; model files come from
+    :func:`tosem_tpu.data.scorer.build_scorer`)."""
+
+    def __init__(self, path: str, alpha: float = 1.8, beta: float = 0.8,
+                 space_index: Optional[int] = None):
+        from tosem_tpu.data.audio import ALPHABET
+        self.alpha = float(alpha)
+        self.beta = float(beta)
+        self.space_index = (ALPHABET.index(" ") if space_index is None
+                            else int(space_index))
+        self._lib = _decoder_lib()
+        self._h = self._lib.tosem_lm_load(str(path).encode())
+        if not self._h:
+            raise FileNotFoundError(f"cannot load scorer package: {path}")
+
+    def _handle(self):
+        if not getattr(self, "_h", None):
+            raise ValueError("Scorer is closed")
+        return self._h
+
+    @property
+    def order(self) -> int:
+        return int(self._lib.tosem_lm_order(self._handle()))
+
+    @property
+    def n_words(self) -> int:
+        return int(self._lib.tosem_lm_n_words(self._handle()))
+
+    def word_id(self, word: str, alphabet: str = None) -> int:
+        """Label-trie lookup; -1 = OOV."""
+        import ctypes
+
+        import numpy as np
+
+        from tosem_tpu.data.audio import ALPHABET, text_to_labels
+        labels = np.asarray(
+            text_to_labels(word, alphabet or ALPHABET), np.int32)
+        return int(self._lib.tosem_lm_word_id(
+            self._handle(), labels.ctypes.data_as(ctypes.c_void_p),
+            len(labels)))
+
+    def score(self, context_ids, word_id: int) -> float:
+        """Raw ``logP(word | context)`` (unweighted)."""
+        import ctypes
+
+        import numpy as np
+        ctx = np.asarray(list(context_ids), np.int32)
+        return float(self._lib.tosem_lm_score(
+            self._handle(), ctx.ctypes.data_as(ctypes.c_void_p), len(ctx),
+            int(word_id)))
+
+    def close(self):
+        if getattr(self, "_h", None):
+            self._lib.tosem_lm_free(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
 def beam_search_decode(log_probs, blank: int, beam_width: int = 32,
-                       bonus=None) -> Tuple[list, float]:
+                       bonus=None,
+                       scorer: Optional[Scorer] = None) -> Tuple[list, float]:
     """Prefix beam search via the native decoder
     (:mod:`tosem_tpu.native` ``ctc_decoder.cpp`` — the
     ``ctc_beam_search_decoder.cpp`` analog; host-side, TPU-hostile control
     flow stays off-device).
 
     log_probs: [T, V] log-softmax scores (numpy or jax array).
-    bonus: optional [V] per-symbol additive score (the LM-scorer hook).
+    bonus: optional [V] per-symbol additive score (hot-word biasing).
+    scorer: optional :class:`Scorer` — word-boundary LM rescoring with the
+        scorer's α/β weights (the reference's external-scorer decode path).
     Returns (labels, log_score).
     """
     import ctypes
 
     import numpy as np
 
-    from tosem_tpu.native import load_library
-
-    lib = load_library("ctc_decoder")
-    lib.ctc_beam_decode.restype = ctypes.c_int
+    lib = _decoder_lib()
     lp = np.ascontiguousarray(np.asarray(log_probs), dtype=np.float32)
     T, V = lp.shape
-    out = np.zeros(T, dtype=np.int32)
+    out = np.zeros(max(T, 1), dtype=np.int32)
     out_len = ctypes.c_int32()
     out_score = ctypes.c_float()
     b = (np.ascontiguousarray(np.asarray(bonus), dtype=np.float32)
          if bonus is not None else None)
-    rc = lib.ctc_beam_decode(
-        lp.ctypes.data_as(ctypes.c_void_p), T, V, blank, beam_width,
-        b.ctypes.data_as(ctypes.c_void_p) if b is not None else None,
-        out.ctypes.data_as(ctypes.c_void_p), ctypes.byref(out_len),
-        ctypes.byref(out_score), T)
+    b_ptr = b.ctypes.data_as(ctypes.c_void_p) if b is not None else None
+    common = (lp.ctypes.data_as(ctypes.c_void_p), T, V, blank, beam_width)
+    outs = (out.ctypes.data_as(ctypes.c_void_p), ctypes.byref(out_len),
+            ctypes.byref(out_score), T)
+    if scorer is None:
+        rc = lib.ctc_beam_decode(*common, b_ptr, *outs)
+    else:
+        rc = lib.ctc_beam_decode_lm(
+            *common, ctypes.c_void_p(scorer._handle()),
+            ctypes.c_float(scorer.alpha), ctypes.c_float(scorer.beta),
+            scorer.space_index, b_ptr, *outs)
     if rc != 0:
         raise RuntimeError("ctc_beam_decode failed")
     return out[:out_len.value].tolist(), float(out_score.value)
