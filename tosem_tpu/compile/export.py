@@ -1,0 +1,168 @@
+"""StableHLO export for the native PJRT driver.
+
+The reference deploys compiled artifacts into native hosts (Apollo's
+mainboard loads built modules, `cyber/mainboard/mainboard.cc:27`;
+DeepSpeech exports frozen graphs for the native client,
+`training/deepspeech_training/train.py` export path). The TPU equivalent
+of a deployable artifact is a StableHLO module: :func:`export_program`
+lowers a jitted function, writing
+
+- ``<name>.mlir``  — StableHLO text (PJRT ``format="mlir"``),
+- ``<name>.copts`` — serialized XLA CompileOptions proto,
+- ``<name>.meta``  — one ``in/out <role> <dtype> [dims...]`` line per
+  argument, the contract ``native/pjrt_driver.cpp`` fills buffers from.
+
+Roles tell the driver how to treat each input: ``niter`` (loop trip
+count — triggers DeviceLoopBench-style timing), ``eps`` (runtime-zero
+feedback scalar), ``data`` (deterministic pattern fill, mirrored by
+:func:`pattern_fill` for host-side cross-checks).
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+_DTYPE_NAMES = {
+    np.dtype(np.float32): "f32",
+    np.dtype(np.int32): "s32",
+}
+
+
+def _dtype_name(dt) -> str:
+    if dt == jnp.bfloat16:
+        return "bf16"
+    return _DTYPE_NAMES[np.dtype(dt)]
+
+
+def pattern_fill(shape, dtype=np.float32) -> np.ndarray:
+    """The driver's deterministic input fill (pjrt_driver.cpp pattern())."""
+    n = int(np.prod(shape)) if shape else 1
+    vals = ((np.arange(n) % 251) - 125).astype(np.float32) * 1e-3
+    arr = vals.reshape(shape) if shape else vals[0]
+    if dtype == jnp.bfloat16:
+        return np.asarray(jnp.asarray(arr, jnp.bfloat16))
+    return np.asarray(arr, dtype)
+
+
+def export_program(fn: Callable, example_args: Sequence[Any],
+                   out_dir: str, name: str,
+                   roles: Optional[Sequence[str]] = None) -> Dict[str, str]:
+    """Lower ``jit(fn)`` at the given arg shapes and write the artifact
+    triple. ``roles[i]`` defaults to ``data``."""
+    os.makedirs(out_dir, exist_ok=True)
+    lowered = jax.jit(fn).lower(*example_args)
+    mlir_text = lowered.as_text()
+    from jax._src.lib import _jax
+    copts = _jax.CompileOptions().SerializeAsString()
+
+    flat_in, _ = jax.tree_util.tree_flatten(tuple(example_args))
+    out_shape = jax.eval_shape(fn, *example_args)
+    flat_out, _ = jax.tree_util.tree_flatten(out_shape)
+    roles = list(roles or [])
+    roles += ["data"] * (len(flat_in) - len(roles))
+
+    lines = []
+    for spec, role in zip(flat_in, roles):
+        dims = " ".join(str(int(d)) for d in spec.shape)
+        lines.append(f"in {role} {_dtype_name(spec.dtype)} {dims}".rstrip())
+    for spec in flat_out:
+        dims = " ".join(str(int(d)) for d in spec.shape)
+        lines.append(f"out data {_dtype_name(spec.dtype)} {dims}".rstrip())
+
+    paths = {
+        "mlir": os.path.join(out_dir, f"{name}.mlir"),
+        "copts": os.path.join(out_dir, f"{name}.copts"),
+        "meta": os.path.join(out_dir, f"{name}.meta"),
+    }
+    with open(paths["mlir"], "w") as f:
+        f.write(mlir_text)
+    with open(paths["copts"], "wb") as f:
+        f.write(copts)
+    with open(paths["meta"], "w") as f:
+        f.write("\n".join(lines) + "\n")
+    return paths
+
+
+def gemm_loop_fn(dtype=jnp.float32):
+    """The GEMM kernel under DeviceLoopBench semantics (utils/timing.py:108):
+    n_iter chained matmuls, eps=0 feedback defeats hoisting; the exported
+    module is timed identically from Python and from the C++ driver."""
+
+    def run(n_iter, eps, a, b):
+        def body(i, s):
+            a2 = a + (eps * s).astype(a.dtype)
+            out = a2 @ b
+            return jnp.mean(out.astype(jnp.float32))
+
+        return lax.fori_loop(0, n_iter, body, jnp.float32(0.0))
+
+    return run
+
+
+def export_gemm_loop(out_dir: str, n: int = 1024, dtype=jnp.float32,
+                     name: Optional[str] = None) -> Dict[str, str]:
+    sds = jax.ShapeDtypeStruct
+    args = (sds((), jnp.int32), sds((), jnp.float32),
+            sds((n, n), dtype), sds((n, n), dtype))
+    return export_program(
+        gemm_loop_fn(dtype), args, out_dir,
+        name or f"gemm_loop_{n}_{_dtype_name(dtype)}",
+        roles=["niter", "eps", "data", "data"])
+
+
+def export_gemm(out_dir: str, n: int = 256, dtype=jnp.float32,
+                name: Optional[str] = None) -> Dict[str, str]:
+    """Plain single GEMM returning the mean — the numeric cross-check
+    module (driver prints out0; Python recomputes with pattern_fill)."""
+    sds = jax.ShapeDtypeStruct
+
+    def f(a, b):
+        return jnp.mean((a @ b).astype(jnp.float32))
+
+    args = (sds((n, n), dtype), sds((n, n), dtype))
+    return export_program(f, args, out_dir,
+                          name or f"gemm_{n}_{_dtype_name(dtype)}")
+
+
+def export_resnet_train_step(out_dir: str, batch: int = 4,
+                             num_classes: int = 10,
+                             name: str = "resnet_step") -> Dict[str, str]:
+    """Full supervised train step (fwd + bwd + SGD update) as one module.
+
+    Parameters enter as flat leaves so the native host owns all state —
+    the mainboard-hosts-the-module relationship. Returns (loss, *new
+    leaves); returning the updated params keeps XLA from dead-code
+    eliminating the backward pass.
+    """
+    from tosem_tpu.models.resnet import resnet18_ish
+
+    model = resnet18_ish(num_classes=num_classes, dtype=jnp.float32)
+    vs_shape = jax.eval_shape(model.init, jax.random.key(0))
+    flat, treedef = jax.tree_util.tree_flatten(vs_shape)
+
+    def step(x, y, *leaves):
+        vs = jax.tree_util.tree_unflatten(treedef, leaves)
+
+        def loss_fn(params):
+            logits, new_state = model.apply(
+                {"params": params, "state": vs["state"]}, x, train=True)
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+            loss = -jnp.mean(jnp.take_along_axis(logp, y[:, None], 1))
+            return loss, new_state
+
+        (loss, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            vs["params"])
+        new_params = jax.tree_util.tree_map(
+            lambda p, g: p - 0.01 * g, vs["params"], grads)
+        return (loss,) + tuple(jax.tree_util.tree_leaves(new_params))
+
+    sds = jax.ShapeDtypeStruct
+    args = (sds((batch, 32, 32, 3), jnp.float32),
+            sds((batch,), jnp.int32)) + tuple(
+                sds(l.shape, l.dtype) for l in flat)
+    return export_program(step, args, out_dir, name)

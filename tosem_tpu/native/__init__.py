@@ -28,24 +28,50 @@ class NativeBuildError(RuntimeError):
     pass
 
 
+def _src_mtime(src: str) -> float:
+    """Newest mtime among the source and local headers it can include —
+    a header edit must invalidate the cached artifact too."""
+    times = [os.path.getmtime(src)]
+    for d in (_NATIVE_DIR, os.path.join(_NATIVE_DIR, "third_party")):
+        if os.path.isdir(d):
+            times += [os.path.getmtime(os.path.join(d, f))
+                      for f in os.listdir(d) if f.endswith(".h")]
+    return max(times)
+
+
+def _compile(stem: str, out: str, flags, extra_ldflags=()) -> str:
+    src = os.path.join(_NATIVE_DIR, f"{stem}.cpp")
+    if not os.path.exists(src):
+        raise NativeBuildError(f"no such native source: {src}")
+    if (not os.path.exists(out)
+            or os.path.getmtime(out) < _src_mtime(src)):
+        os.makedirs(_BUILD_DIR, exist_ok=True)
+        cmd = [CXX, *flags, f"-I{_NATIVE_DIR}", "-o", out + ".tmp", src,
+               *extra_ldflags, *LDFLAGS]
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise NativeBuildError(f"g++ failed for {stem}:\n{proc.stderr}")
+        os.replace(out + ".tmp", out)  # atomic: racing procs see old or new
+    return out
+
+
+def build_binary(stem: str) -> str:
+    """Compile ``native/<stem>.cpp`` → ``_build/<stem>`` (an executable,
+    not a shared object — e.g. the PJRT driver binary) and return its
+    path."""
+    with _lock:
+        flags = [f for f in CXXFLAGS if f not in ("-shared", "-fPIC")]
+        return _compile(stem, os.path.join(_BUILD_DIR, stem), flags,
+                        extra_ldflags=["-ldl"])
+
+
 def load_library(stem: str) -> ctypes.CDLL:
     """Compile ``native/<stem>.cpp`` → ``_build/lib<stem>.so`` and load it."""
     with _lock:
         if stem in _cache:
             return _cache[stem]
-        src = os.path.join(_NATIVE_DIR, f"{stem}.cpp")
-        out = os.path.join(_BUILD_DIR, f"lib{stem}.so")
-        if not os.path.exists(src):
-            raise NativeBuildError(f"no such native source: {src}")
-        if (not os.path.exists(out)
-                or os.path.getmtime(out) < os.path.getmtime(src)):
-            os.makedirs(_BUILD_DIR, exist_ok=True)
-            cmd = [CXX, *CXXFLAGS, "-o", out + ".tmp", src, *LDFLAGS]
-            proc = subprocess.run(cmd, capture_output=True, text=True)
-            if proc.returncode != 0:
-                raise NativeBuildError(
-                    f"g++ failed for {stem}:\n{proc.stderr}")
-            os.replace(out + ".tmp", out)  # atomic: racing procs see old or new
+        out = _compile(stem, os.path.join(_BUILD_DIR, f"lib{stem}.so"),
+                       CXXFLAGS)
         lib = ctypes.CDLL(out)
         _cache[stem] = lib
         return lib

@@ -1,0 +1,434 @@
+// Native PJRT driver: a C++ binary that loads compiled StableHLO and runs
+// it on the TPU through the PJRT C API — no Python in the execution path.
+//
+// This fills the role of the reference's native executors: Apollo's
+// mainboard binary that hosts and drives compiled modules
+// (`cyber/mainboard/mainboard.cc:27`) and its raw CUDA benchmark drivers
+// (`modules/perception/inference/utils/gemm.cu:114`). TPU-first shape:
+// instead of hand-written device kernels, the artifact is a
+// StableHLO module exported by `tosem_tpu/compile/export.py` (XLA compiles
+// it to the same program Python gets), and the binary talks to the chip
+// through the stable PJRT C ABI (`third_party/pjrt_c_api.h`, OpenXLA),
+// so one driver serves CPU/TPU plugins alike.
+//
+// Usage:
+//   pjrt_driver <plugin.so> <prog.mlir> <prog.copts> <prog.meta>
+//               [n_iter] [reps] [opt:int:key=v | opt:str:key=v ...]
+//
+// Trailing `opt:` args become PJRT_NamedValue client-create options, so
+// plugin-specific bring-up (e.g. the axon tunnel's topology/session
+// options) stays in the caller — the binary is plugin-agnostic.
+//
+// prog.meta lines: "in <role> <dtype> [dims...]" / "out <role> <dtype> ..."
+// with roles: niter (loop trip-count scalar, s32), eps (f32 feedback
+// scalar), data (pattern-filled array). A module with a `niter` input is
+// timed DeviceLoopBench-style — (t_N - t_1)/(N-1) cancels dispatch — and
+// otherwise timed as whole-program executions.
+//
+// Output: ONE JSON line on stdout (the bench.py / results-CSV contract).
+
+#include <dlfcn.h>
+
+#include <chrono>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include "third_party/pjrt_c_api.h"
+
+namespace {
+
+const PJRT_Api* g_api = nullptr;
+
+[[noreturn]] void die(const std::string& what, PJRT_Error* err = nullptr) {
+  std::string msg = what;
+  if (err != nullptr && g_api != nullptr) {
+    PJRT_Error_Message_Args m;
+    std::memset(&m, 0, sizeof(m));
+    m.struct_size = PJRT_Error_Message_Args_STRUCT_SIZE;
+    m.error = err;
+    g_api->PJRT_Error_Message(&m);
+    msg += ": " + std::string(m.message, m.message_size);
+    PJRT_Error_Destroy_Args d;
+    std::memset(&d, 0, sizeof(d));
+    d.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+    d.error = err;
+    g_api->PJRT_Error_Destroy(&d);
+  }
+  std::fprintf(stderr, "pjrt_driver: %s\n", msg.c_str());
+  std::printf("{\"error\": \"%s\"}\n", what.c_str());
+  std::exit(1);
+}
+
+void check(PJRT_Error* err, const char* what) {
+  if (err != nullptr) die(what, err);
+}
+
+void await_and_destroy(PJRT_Event* ev, const char* what) {
+  if (ev == nullptr) return;
+  PJRT_Event_Await_Args a;
+  std::memset(&a, 0, sizeof(a));
+  a.struct_size = PJRT_Event_Await_Args_STRUCT_SIZE;
+  a.event = ev;
+  check(g_api->PJRT_Event_Await(&a), what);
+  PJRT_Event_Destroy_Args d;
+  std::memset(&d, 0, sizeof(d));
+  d.struct_size = PJRT_Event_Destroy_Args_STRUCT_SIZE;
+  d.event = ev;
+  check(g_api->PJRT_Event_Destroy(&d), "event destroy");
+}
+
+std::string slurp(const char* path) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) die(std::string("cannot read ") + path);
+  std::ostringstream ss;
+  ss << f.rdbuf();
+  return ss.str();
+}
+
+// deterministic fill shared with tosem_tpu/compile/driver.py
+inline float pattern(size_t i) { return ((float)(i % 251) - 125.0f) * 1e-3f; }
+
+inline uint16_t f32_to_bf16(float v) {  // round-to-nearest-even
+  uint32_t u;
+  std::memcpy(&u, &v, 4);
+  uint32_t rounded = (u + 0x7fffu + ((u >> 16) & 1u)) >> 16;
+  return (uint16_t)rounded;
+}
+
+struct ArgSpec {
+  std::string role;   // niter | eps | data
+  std::string dtype;  // s32 | f32 | bf16
+  std::vector<int64_t> dims;
+  size_t elems() const {
+    size_t n = 1;
+    for (int64_t d : dims) n *= (size_t)d;
+    return n;
+  }
+};
+
+PJRT_Buffer_Type buffer_type(const std::string& dt) {
+  if (dt == "f32") return PJRT_Buffer_Type_F32;
+  if (dt == "bf16") return PJRT_Buffer_Type_BF16;
+  if (dt == "s32") return PJRT_Buffer_Type_S32;
+  die("unsupported dtype " + dt);
+}
+
+size_t dtype_bytes(const std::string& dt) { return dt == "bf16" ? 2 : 4; }
+
+PJRT_Buffer* to_device(PJRT_Client* client, PJRT_Device* device,
+                       const void* data, const ArgSpec& s) {
+  PJRT_Client_BufferFromHostBuffer_Args a;
+  std::memset(&a, 0, sizeof(a));
+  a.struct_size = PJRT_Client_BufferFromHostBuffer_Args_STRUCT_SIZE;
+  a.client = client;
+  a.data = data;
+  a.type = buffer_type(s.dtype);
+  a.dims = s.dims.data();
+  a.num_dims = s.dims.size();
+  a.host_buffer_semantics =
+      PJRT_HostBufferSemantics_kImmutableUntilTransferCompletes;
+  a.device = device;
+  check(g_api->PJRT_Client_BufferFromHostBuffer(&a), "h2d");
+  await_and_destroy(a.done_with_host_buffer, "h2d done");
+  return a.buffer;
+}
+
+double now_s() {
+  return std::chrono::duration<double>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+struct Executor {
+  PJRT_LoadedExecutable* exec;
+  size_t num_outputs;
+  std::vector<PJRT_Buffer*> args;
+
+  // Runs once, blocking until device completion; returns host copy of
+  // output 0 as f32 (scalar modules) or its first element.
+  float run(bool fetch) {
+    std::vector<PJRT_Buffer*> outs(num_outputs, nullptr);
+    PJRT_Buffer** out_list = outs.data();
+    PJRT_Buffer* const* arg_list = args.data();
+    PJRT_Event* done = nullptr;
+    PJRT_ExecuteOptions opts;
+    std::memset(&opts, 0, sizeof(opts));
+    opts.struct_size = PJRT_ExecuteOptions_STRUCT_SIZE;
+    PJRT_LoadedExecutable_Execute_Args e;
+    std::memset(&e, 0, sizeof(e));
+    e.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;
+    e.executable = exec;
+    e.options = &opts;
+    e.argument_lists = &arg_list;
+    e.num_devices = 1;
+    e.num_args = args.size();
+    e.output_lists = &out_list;
+    e.device_complete_events = &done;
+    check(g_api->PJRT_LoadedExecutable_Execute(&e), "execute");
+    await_and_destroy(done, "execute done");
+    float v = 0.0f;
+    if (fetch && num_outputs > 0) {
+      PJRT_Buffer_ToHostBuffer_Args t;
+      std::memset(&t, 0, sizeof(t));
+      t.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
+      t.src = outs[0];
+      check(g_api->PJRT_Buffer_ToHostBuffer(&t), "d2h size");
+      std::vector<uint8_t> host(t.dst_size);
+      t.dst = host.data();
+      check(g_api->PJRT_Buffer_ToHostBuffer(&t), "d2h");
+      await_and_destroy(t.event, "d2h done");
+      if (host.size() >= 4) std::memcpy(&v, host.data(), 4);
+    }
+    for (PJRT_Buffer* b : outs) {
+      if (b == nullptr) continue;
+      PJRT_Buffer_Destroy_Args d;
+      std::memset(&d, 0, sizeof(d));
+      d.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+      d.buffer = b;
+      check(g_api->PJRT_Buffer_Destroy(&d), "buffer destroy");
+    }
+    return v;
+  }
+};
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 5) {
+    std::fprintf(stderr,
+                 "usage: pjrt_driver <plugin.so> <prog.mlir> <prog.copts> "
+                 "<prog.meta> [n_iter] [reps]\n");
+    return 2;
+  }
+  const char* plugin_path = argv[1];
+  int64_t n_iter = 64;
+  int reps = 3;
+  std::vector<std::string> opt_keys, opt_strs;
+  std::vector<int64_t> opt_ints;
+  std::vector<bool> opt_is_str;
+  int pos = 0;
+  for (int i = 5; i < argc; i++) {
+    if (std::strncmp(argv[i], "opt:", 4) == 0) {
+      const char* spec = argv[i] + 4;
+      bool is_str = std::strncmp(spec, "str:", 4) == 0;
+      if (!is_str && std::strncmp(spec, "int:", 4) != 0)
+        die(std::string("bad option arg: ") + argv[i]);
+      const char* kv = spec + 4;
+      const char* eq = std::strchr(kv, '=');
+      if (eq == nullptr) die(std::string("bad option arg: ") + argv[i]);
+      opt_keys.emplace_back(kv, eq - kv);
+      opt_is_str.push_back(is_str);
+      opt_strs.emplace_back(is_str ? eq + 1 : "");
+      opt_ints.push_back(is_str ? 0 : std::atoll(eq + 1));
+    } else if (pos == 0) {
+      n_iter = std::atoll(argv[i]);
+      pos++;
+    } else {
+      reps = std::atoi(argv[i]);
+    }
+  }
+
+  void* handle = dlopen(plugin_path, RTLD_NOW | RTLD_LOCAL);
+  if (handle == nullptr) die(std::string("dlopen failed: ") + dlerror());
+  auto get_api = (const PJRT_Api* (*)())dlsym(handle, "GetPjrtApi");
+  if (get_api == nullptr) die("plugin has no GetPjrtApi symbol");
+  g_api = get_api();
+  if (g_api == nullptr || g_api->pjrt_api_version.major_version != 0)
+    die("incompatible PJRT API version");
+
+  {
+    PJRT_Plugin_Initialize_Args a;
+    std::memset(&a, 0, sizeof(a));
+    a.struct_size = PJRT_Plugin_Initialize_Args_STRUCT_SIZE;
+    check(g_api->PJRT_Plugin_Initialize(&a), "plugin init");
+  }
+  PJRT_Client* client = nullptr;
+  {
+    std::vector<PJRT_NamedValue> nvs(opt_keys.size());
+    for (size_t i = 0; i < opt_keys.size(); i++) {
+      std::memset(&nvs[i], 0, sizeof(PJRT_NamedValue));
+      nvs[i].struct_size = PJRT_NamedValue_STRUCT_SIZE;
+      nvs[i].name = opt_keys[i].c_str();
+      nvs[i].name_size = opt_keys[i].size();
+      if (opt_is_str[i]) {
+        nvs[i].type = PJRT_NamedValue_kString;
+        nvs[i].string_value = opt_strs[i].c_str();
+        nvs[i].value_size = opt_strs[i].size();
+      } else {
+        nvs[i].type = PJRT_NamedValue_kInt64;
+        nvs[i].int64_value = opt_ints[i];
+        nvs[i].value_size = 1;
+      }
+    }
+    PJRT_Client_Create_Args a;
+    std::memset(&a, 0, sizeof(a));
+    a.struct_size = PJRT_Client_Create_Args_STRUCT_SIZE;
+    a.create_options = nvs.data();
+    a.num_options = nvs.size();
+    check(g_api->PJRT_Client_Create(&a), "client create");
+    client = a.client;
+  }
+  PJRT_Device* device = nullptr;
+  {
+    PJRT_Client_AddressableDevices_Args a;
+    std::memset(&a, 0, sizeof(a));
+    a.struct_size = PJRT_Client_AddressableDevices_Args_STRUCT_SIZE;
+    a.client = client;
+    check(g_api->PJRT_Client_AddressableDevices(&a), "devices");
+    if (a.num_addressable_devices == 0) die("no addressable devices");
+    device = a.addressable_devices[0];
+  }
+
+  std::string mlir = slurp(argv[2]);
+  std::string copts = slurp(argv[3]);
+
+  double t_compile0 = now_s();
+  PJRT_LoadedExecutable* exec = nullptr;
+  {
+    PJRT_Program prog;
+    std::memset(&prog, 0, sizeof(prog));
+    prog.struct_size = PJRT_Program_STRUCT_SIZE;
+    prog.code = mlir.data();
+    prog.code_size = mlir.size();
+    prog.format = "mlir";
+    prog.format_size = 4;
+    PJRT_Client_Compile_Args a;
+    std::memset(&a, 0, sizeof(a));
+    a.struct_size = PJRT_Client_Compile_Args_STRUCT_SIZE;
+    a.client = client;
+    a.program = &prog;
+    a.compile_options = copts.data();
+    a.compile_options_size = copts.size();
+    check(g_api->PJRT_Client_Compile(&a), "compile");
+    exec = a.executable;
+  }
+  double compile_s = now_s() - t_compile0;
+
+  size_t num_outputs = 0;
+  {
+    PJRT_LoadedExecutable_GetExecutable_Args g;
+    std::memset(&g, 0, sizeof(g));
+    g.struct_size = PJRT_LoadedExecutable_GetExecutable_Args_STRUCT_SIZE;
+    g.loaded_executable = exec;
+    check(g_api->PJRT_LoadedExecutable_GetExecutable(&g), "get exec");
+    PJRT_Executable_NumOutputs_Args n;
+    std::memset(&n, 0, sizeof(n));
+    n.struct_size = PJRT_Executable_NumOutputs_Args_STRUCT_SIZE;
+    n.executable = g.executable;
+    check(g_api->PJRT_Executable_NumOutputs(&n), "num outputs");
+    num_outputs = n.num_outputs;
+  }
+
+  // parse meta + build input buffers
+  std::vector<ArgSpec> inputs;
+  {
+    std::istringstream meta(slurp(argv[4]));
+    std::string line;
+    while (std::getline(meta, line)) {
+      std::istringstream ls(line);
+      std::string kind, role, dtype;
+      if (!(ls >> kind >> role >> dtype)) continue;
+      if (kind != "in") continue;
+      ArgSpec s;
+      s.role = role;
+      s.dtype = dtype;
+      int64_t d;
+      while (ls >> d) s.dims.push_back(d);
+      inputs.push_back(std::move(s));
+    }
+  }
+  bool loop_mode = false;
+  int niter_idx = -1;
+  std::vector<std::vector<uint8_t>> host_data(inputs.size());
+  Executor ex{exec, num_outputs, {}};
+  for (size_t i = 0; i < inputs.size(); i++) {
+    const ArgSpec& s = inputs[i];
+    size_t bytes = s.elems() * dtype_bytes(s.dtype);
+    host_data[i].assign(bytes, 0);
+    if (s.role == "niter") {
+      loop_mode = true;
+      niter_idx = (int)i;
+      int32_t one = 1;
+      std::memcpy(host_data[i].data(), &one, 4);
+    } else if (s.role == "eps") {
+      // zero: numerics exact, but XLA can't hoist the loop body
+    } else if (s.dtype == "f32") {
+      float* p = (float*)host_data[i].data();
+      for (size_t k = 0; k < s.elems(); k++) p[k] = pattern(k);
+    } else if (s.dtype == "bf16") {
+      uint16_t* p = (uint16_t*)host_data[i].data();
+      for (size_t k = 0; k < s.elems(); k++) p[k] = f32_to_bf16(pattern(k));
+    } else if (s.dtype == "s32") {
+      int32_t* p = (int32_t*)host_data[i].data();
+      for (size_t k = 0; k < s.elems(); k++) p[k] = (int32_t)(k % 97);
+    }
+    ex.args.push_back(to_device(client, device, host_data[i].data(), s));
+  }
+
+  if (loop_mode) {
+    // DeviceLoopBench protocol: time n=1 and n=N, difference cancels
+    // per-dispatch overhead (utils/timing.py:108 semantics).
+    double t1 = 1e30, tn = 1e30;
+    float result = ex.run(true);  // warm (n=1 buffer already loaded)
+    for (int r = 0; r < reps; r++) {
+      double t0 = now_s();
+      result = ex.run(true);
+      t1 = std::min(t1, now_s() - t0);
+    }
+    // swap trip count to N
+    {
+      PJRT_Buffer_Destroy_Args d;
+      std::memset(&d, 0, sizeof(d));
+      d.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+      d.buffer = ex.args[niter_idx];
+      check(g_api->PJRT_Buffer_Destroy(&d), "niter destroy");
+      int32_t n32 = (int32_t)n_iter;
+      std::memcpy(host_data[niter_idx].data(), &n32, 4);
+      ex.args[niter_idx] = to_device(client, device,
+                                     host_data[niter_idx].data(),
+                                     inputs[niter_idx]);
+    }
+    ex.run(true);  // warm N
+    for (int r = 0; r < reps; r++) {
+      double t0 = now_s();
+      result = ex.run(true);
+      tn = std::min(tn, now_s() - t0);
+    }
+    double per_op = (tn - t1) / (double)(n_iter - 1);
+    std::printf(
+        "{\"mode\": \"loop\", \"n_iter\": %lld, \"t1_s\": %.6e, "
+        "\"tn_s\": %.6e, \"per_op_s\": %.6e, \"result\": %.6e, "
+        "\"compile_s\": %.3f}\n",
+        (long long)n_iter, t1, tn, per_op, (double)result, compile_s);
+  } else {
+    float out0 = ex.run(true);  // warm + correctness fetch
+    double best = 1e30;
+    for (int r = 0; r < reps; r++) {
+      double t0 = now_s();
+      ex.run(false);
+      best = std::min(best, now_s() - t0);
+    }
+    std::printf(
+        "{\"mode\": \"single\", \"exec_s\": %.6e, \"out0\": %.6e, "
+        "\"compile_s\": %.3f}\n",
+        best, (double)out0, compile_s);
+  }
+
+  PJRT_LoadedExecutable_Destroy_Args xd;
+  std::memset(&xd, 0, sizeof(xd));
+  xd.struct_size = PJRT_LoadedExecutable_Destroy_Args_STRUCT_SIZE;
+  xd.executable = exec;
+  check(g_api->PJRT_LoadedExecutable_Destroy(&xd), "exec destroy");
+  PJRT_Client_Destroy_Args cd;
+  std::memset(&cd, 0, sizeof(cd));
+  cd.struct_size = PJRT_Client_Destroy_Args_STRUCT_SIZE;
+  cd.client = client;
+  check(g_api->PJRT_Client_Destroy(&cd), "client destroy");
+  return 0;
+}
