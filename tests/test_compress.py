@@ -1,0 +1,152 @@
+"""Model compression tests (SURVEY §2.4 'Model compression' row)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tosem_tpu.compress import (SparsityScheduler, apply_masks,
+                                dequantize_params, fake_quant,
+                                magnitude_masks, make_pruned_train_step,
+                                qat_params, quantize_params,
+                                shrink_dense_pair, sparsity_of, to_bf16)
+
+
+def _params(key, d=32):
+    k1, k2, k3 = jax.random.split(key, 3)
+    return {
+        "l1": {"w": jax.random.normal(k1, (d, d)), "b": jnp.zeros(d)},
+        "l2": {"w": jax.random.normal(k2, (d, 4)), "b": jnp.zeros(4)},
+        "norm": {"scale": jnp.ones(d)},
+    }
+
+
+def test_global_magnitude_mask_hits_target_sparsity():
+    p = _params(jax.random.key(0))
+    masks = magnitude_masks(p, 0.5)
+    # biases / 1-d leaves are never pruned
+    assert bool(jnp.all(masks["l1"]["b"]))
+    assert bool(jnp.all(masks["norm"]["scale"]))
+    w_total = p["l1"]["w"].size + p["l2"]["w"].size
+    kept = int(jnp.sum(masks["l1"]["w"])) + int(jnp.sum(masks["l2"]["w"]))
+    assert abs(kept / w_total - 0.5) < 0.02
+    # masked values really zero out
+    mp = apply_masks(p, masks)
+    assert float(jnp.sum(mp["l1"]["w"] == 0)) >= 0.4 * p["l1"]["w"].size
+
+
+def test_global_mask_keeps_largest():
+    p = {"w": jnp.arange(100.0).reshape(10, 10) - 50.0}
+    masks = magnitude_masks(p, 0.9)
+    kept_vals = jnp.abs(p["w"][masks["w"]])
+    dropped = jnp.abs(p["w"][~masks["w"]])
+    assert float(kept_vals.min()) >= float(dropped.max())
+
+
+def test_agp_schedule_shape():
+    sch = SparsityScheduler(0.8, begin_step=10, end_step=110)
+    assert sch(0) == 0.0
+    assert sch(10) == 0.0
+    assert sch(110) == pytest.approx(0.8)
+    assert sch(200) == pytest.approx(0.8)
+    mid = [sch(s) for s in range(10, 111, 10)]
+    assert all(a <= b + 1e-9 for a, b in zip(mid, mid[1:]))  # monotone
+
+
+def test_iterative_pruning_trains_under_jit():
+    key = jax.random.key(1)
+    p = _params(key)
+    x = jax.random.normal(jax.random.key(2), (64, 32))
+    y = jnp.sum(x[:, :4], axis=1, keepdims=True) @ jnp.ones((1, 4))
+
+    def fwd(params, x):
+        h = jnp.tanh(x @ params["l1"]["w"] + params["l1"]["b"])
+        return h @ params["l2"]["w"] + params["l2"]["b"]
+
+    @jax.jit
+    def base_step(params, x, y):
+        def loss(p):
+            return jnp.mean((fwd(p, x) - y) ** 2)
+        l, g = jax.value_and_grad(loss)(params)
+        params = jax.tree_util.tree_map(lambda p_, g_: p_ - 0.05 * g_,
+                                        params, g)
+        return params, {"loss": l}
+
+    step = make_pruned_train_step(base_step,
+                                  SparsityScheduler(0.6, 0, 80),
+                                  remask_every=20)
+    losses = []
+    for _ in range(100):
+        p, m = step(p, x, y)
+        losses.append(float(m["loss"]))
+    assert m["sparsity"] == pytest.approx(0.6, abs=0.05)
+    assert losses[-1] < losses[0]
+    # pruned weights stay pruned after training
+    assert float(jnp.mean(p["l1"]["w"] == 0)) > 0.4
+
+
+def test_structured_shrink_preserves_top_channels():
+    k = jax.random.key(3)
+    w1 = jax.random.normal(k, (16, 8)) * jnp.array(
+        [10, 10, 10, 10, 1e-3, 1e-3, 1e-3, 1e-3])   # 4 strong channels
+    b1 = jnp.zeros(8)
+    w2 = jax.random.normal(jax.random.key(4), (8, 2))
+    sw1, sb1, sw2 = shrink_dense_pair(w1, b1, w2, keep=4)
+    assert sw1.shape == (16, 4) and sb1.shape == (4,) and sw2.shape == (4, 2)
+    x = jax.random.normal(jax.random.key(5), (6, 16))
+    full = jnp.tanh(x @ w1 + b1) @ w2
+    small = jnp.tanh(x @ sw1 + sb1) @ sw2
+    # weak channels contribute ~nothing through tanh ≈ linear regime
+    assert float(jnp.max(jnp.abs(full - small))) < 0.2
+
+
+def test_fake_quant_ste_gradients():
+    x = jnp.linspace(-2.0, 2.0, 64)
+    scale = jnp.float32(1.5 / 127)
+
+    def f(x):
+        return jnp.sum(fake_quant(x, scale) ** 2)
+
+    g = jax.grad(f)(x)
+    assert np.all(np.isfinite(np.asarray(g)))
+    # in-range points pass gradient through; saturated points clip to 0
+    assert float(jnp.abs(g[32])) > 0
+    assert float(g[0]) == 0.0 and float(g[-1]) == 0.0
+
+
+def test_qat_reduces_loss():
+    key = jax.random.key(6)
+    w = jax.random.normal(key, (16, 1))
+    x = jax.random.normal(jax.random.key(7), (128, 16))
+    y = x @ w
+    params = {"w": jnp.zeros((16, 1))}
+
+    @jax.jit
+    def step(params):
+        def loss(p):
+            qp = qat_params(p, bits=8)
+            return jnp.mean((x @ qp["w"] - y) ** 2)
+        l, g = jax.value_and_grad(loss)(params)
+        return jax.tree_util.tree_map(lambda a, b: a - 0.1 * b, params, g), l
+
+    losses = []
+    for _ in range(60):
+        params, l = step(params)
+        losses.append(float(l))
+    assert losses[-1] < 0.05 * losses[0]
+
+
+def test_ptq_roundtrip_and_size():
+    p = _params(jax.random.key(8))
+    qp, scales, stats = quantize_params(p)
+    assert stats["bytes_after"] < 0.4 * stats["bytes_before"]
+    dp = dequantize_params(qp, scales)
+    err = jnp.max(jnp.abs(dp["l1"]["w"] - p["l1"]["w"]))
+    assert float(err) < float(jnp.max(jnp.abs(p["l1"]["w"]))) / 100
+    # non-weight leaves untouched
+    assert dp["norm"]["scale"].dtype == p["norm"]["scale"].dtype
+
+
+def test_bf16_cast():
+    p = _params(jax.random.key(9))
+    bp = to_bf16(p)
+    assert bp["l1"]["w"].dtype == jnp.bfloat16

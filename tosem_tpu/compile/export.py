@@ -49,6 +49,30 @@ def pattern_fill(shape, dtype=np.float32) -> np.ndarray:
     return np.asarray(arr, dtype)
 
 
+def _serialized_compile_options() -> bytes:
+    """Default XLA CompileOptions proto bytes for PJRT_Client_Compile.
+
+    jaxlib has renamed its binding module across versions, so try the
+    known homes in order rather than pinning one private path.
+    """
+    last_err = None
+    for importer in (
+            lambda: __import__("jax._src.lib", fromlist=["_jax"])._jax,
+            lambda: __import__("jaxlib.xla_extension",
+                               fromlist=["CompileOptions"]),
+            lambda: __import__("jaxlib.xla_client",
+                               fromlist=["CompileOptions"]),
+    ):
+        try:
+            mod = importer()
+            return mod.CompileOptions().SerializeAsString()
+        except (ImportError, AttributeError) as e:
+            last_err = e
+    raise RuntimeError(
+        "cannot locate jaxlib CompileOptions for PJRT export; "
+        f"last error: {last_err}")
+
+
 def export_program(fn: Callable, example_args: Sequence[Any],
                    out_dir: str, name: str,
                    roles: Optional[Sequence[str]] = None) -> Dict[str, str]:
@@ -57,8 +81,7 @@ def export_program(fn: Callable, example_args: Sequence[Any],
     os.makedirs(out_dir, exist_ok=True)
     lowered = jax.jit(fn).lower(*example_args)
     mlir_text = lowered.as_text()
-    from jax._src.lib import _jax
-    copts = _jax.CompileOptions().SerializeAsString()
+    copts = _serialized_compile_options()
 
     flat_in, _ = jax.tree_util.tree_flatten(tuple(example_args))
     out_shape = jax.eval_shape(fn, *example_args)

@@ -1,0 +1,8 @@
+from tosem_tpu.compress.pruning import (SparsityScheduler, apply_masks,
+                                        channel_keep_indices,
+                                        magnitude_masks,
+                                        make_pruned_train_step,
+                                        shrink_dense_pair, sparsity_of)
+from tosem_tpu.compress.quantization import (dequantize_params, fake_quant,
+                                             qat_params, quantize_params,
+                                             to_bf16)

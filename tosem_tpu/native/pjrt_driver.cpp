@@ -45,6 +45,24 @@ namespace {
 
 const PJRT_Api* g_api = nullptr;
 
+std::string json_escape(const std::string& s) {
+  std::string out;
+  out.reserve(s.size() + 8);
+  for (unsigned char c : s) {
+    if (c == '"' || c == '\\') {
+      out += '\\';
+      out += (char)c;
+    } else if (c < 0x20) {
+      char buf[8];
+      std::snprintf(buf, sizeof(buf), "\\u%04x", c);
+      out += buf;
+    } else {
+      out += (char)c;
+    }
+  }
+  return out;
+}
+
 [[noreturn]] void die(const std::string& what, PJRT_Error* err = nullptr) {
   std::string msg = what;
   if (err != nullptr && g_api != nullptr) {
@@ -61,7 +79,7 @@ const PJRT_Api* g_api = nullptr;
     g_api->PJRT_Error_Destroy(&d);
   }
   std::fprintf(stderr, "pjrt_driver: %s\n", msg.c_str());
-  std::printf("{\"error\": \"%s\"}\n", what.c_str());
+  std::printf("{\"error\": \"%s\"}\n", json_escape(msg).c_str());
   std::exit(1);
 }
 
@@ -228,6 +246,7 @@ int main(int argc, char** argv) {
       opt_ints.push_back(is_str ? 0 : std::atoll(eq + 1));
     } else if (pos == 0) {
       n_iter = std::atoll(argv[i]);
+      if (n_iter < 2) n_iter = 2;  // loop-mode math divides by n_iter - 1
       pos++;
     } else {
       reps = std::atoi(argv[i]);
@@ -401,6 +420,7 @@ int main(int argc, char** argv) {
       tn = std::min(tn, now_s() - t0);
     }
     double per_op = (tn - t1) / (double)(n_iter - 1);
+    if (!std::isfinite(per_op)) per_op = 0.0;  // keep the JSON line valid
     std::printf(
         "{\"mode\": \"loop\", \"n_iter\": %lld, \"t1_s\": %.6e, "
         "\"tn_s\": %.6e, \"per_op_s\": %.6e, \"result\": %.6e, "
