@@ -113,6 +113,15 @@ def test_fake_quant_ste_gradients():
     assert float(g[0]) == 0.0 and float(g[-1]) == 0.0
 
 
+def test_fake_quant_per_channel_scale_differentiates():
+    x = jax.random.normal(jax.random.key(10), (8, 4))
+    scales = jnp.full((4,), 0.02)
+
+    g = jax.grad(lambda x_: jnp.sum(fake_quant(x_, scales) ** 2))(x)
+    assert g.shape == x.shape
+    assert np.all(np.isfinite(np.asarray(g)))
+
+
 def test_qat_reduces_loss():
     key = jax.random.key(6)
     w = jax.random.normal(key, (16, 1))

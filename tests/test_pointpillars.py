@@ -1,0 +1,141 @@
+"""PointPillars family tests (SURVEY §2.2 PointPillars / CNNSeg rows)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tosem_tpu.models.pointpillars import (PillarGrid, PillarFeatureNet,
+                                           PointPillarsDetector, device_nms,
+                                           iou_matrix, to_canvas, voxelize)
+
+GRID = PillarGrid(x_min=0, x_max=8, y_min=0, y_max=8, nx=4, ny=4,
+                  max_points_per_pillar=3)
+
+
+def test_voxelize_assigns_points_to_pillars():
+    pts = jnp.array([
+        [0.5, 0.5, 1.0, 0.1],      # pillar (0,0) → id 0
+        [0.7, 0.9, 2.0, 0.2],      # pillar (0,0)
+        [7.9, 7.9, 3.0, 0.3],      # pillar (3,3) → id 15
+        [-1.0, 2.0, 0.0, 0.0],     # out of range → dropped
+        [9.0, 1.0, 0.0, 0.0],      # out of range → dropped
+    ])
+    pillars, mask = voxelize(pts, GRID)
+    assert pillars.shape == (16, 3, 8)      # C=4 plus 4 offset features
+    assert int(mask.sum()) == 3
+    assert int(mask[0].sum()) == 2          # two points in pillar 0
+    assert int(mask[15].sum()) == 1
+    # original features preserved in the first C channels
+    got = np.asarray(pillars[0, :2, :4])
+    assert sorted(got[:, 2].tolist()) == [1.0, 2.0]
+
+
+def test_voxelize_capacity_overflow_drops_extras():
+    pts = jnp.concatenate([
+        jnp.full((10, 1), 0.5), jnp.full((10, 1), 0.5),
+        jnp.arange(10.0)[:, None], jnp.zeros((10, 1))], axis=1)
+    pillars, mask = voxelize(pts, GRID)
+    assert int(mask[0].sum()) == 3          # capacity P=3 enforced
+    assert int(mask.sum()) == 3
+
+
+def test_voxelize_overflow_mean_uses_stored_points_only():
+    # 5 points in one pillar, capacity 3: mean must be over the 3 kept
+    xs = jnp.array([0.1, 0.2, 0.3, 0.7, 0.7])
+    pts = jnp.stack([xs, jnp.full(5, 0.5), jnp.zeros(5), jnp.zeros(5)], 1)
+    pillars, mask = voxelize(pts, GRID)
+    offs_x = np.asarray(pillars[0, :3, 4])          # offset-from-mean (x)
+    np.testing.assert_allclose(sorted(offs_x), [-0.1, 0.0, 0.1], atol=1e-6)
+
+
+def test_voxelize_offset_features():
+    pts = jnp.array([[1.0, 1.0, 0.0, 0.0], [1.5, 1.5, 0.0, 0.0]])
+    pillars, mask = voxelize(pts, PillarGrid(0, 8, 0, 8, 4, 4, 4))
+    # offsets from the pillar point-mean (1.25, 1.25)
+    offs = np.asarray(pillars[0, :2, 4:6])
+    np.testing.assert_allclose(sorted(offs[:, 0]), [-0.25, 0.25], atol=1e-6)
+
+
+def test_voxelize_jits():
+    pts = jax.random.uniform(jax.random.key(0), (128, 4)) * 8.0
+    f = jax.jit(lambda p: voxelize(p, GRID))
+    pillars, mask = f(pts)
+    assert pillars.shape == (16, 3, 8)
+    # all in-range points beyond capacity are dropped, none corrupted
+    assert int(mask.sum()) <= 16 * 3
+
+
+def test_pfn_masked_max():
+    pfn = PillarFeatureNet(in_dim=8, feat_dim=16)
+    params = pfn.init(jax.random.key(0))
+    pillars = jax.random.normal(jax.random.key(1), (16, 3, 8))
+    mask = jnp.zeros((16, 3), bool).at[0, 0].set(True).at[0, 1].set(True)
+    feats = pfn.apply(params, pillars, mask)
+    assert feats.shape == (16, 16)
+    assert float(jnp.abs(feats[1:]).max()) == 0.0      # empty pillars → 0
+    # masked max only over real points
+    h = jax.nn.relu(pillars[0] @ params["w"] + params["b"])
+    want = jnp.max(h[:2], axis=0)
+    np.testing.assert_allclose(np.asarray(feats[0]), np.asarray(want),
+                               rtol=1e-5)
+
+
+def test_canvas_shape():
+    feats = jnp.arange(16 * 5, dtype=jnp.float32).reshape(16, 5)
+    canvas = to_canvas(feats, GRID)
+    assert canvas.shape == (4, 4, 5)
+    assert float(canvas[0, 1, 0]) == float(feats[1, 0])
+
+
+def _host_nms(boxes, scores, iou_t, score_t):
+    idx = np.argsort(-scores)
+    keep = np.zeros(len(boxes), bool)
+    iou = np.asarray(iou_matrix(jnp.asarray(boxes)))
+    alive = scores > score_t
+    for i in idx:
+        if not alive[i]:
+            continue
+        keep[i] = True
+        for j in idx:
+            if j != i and alive[j] and iou[i, j] > iou_t:
+                alive[j] = False
+        alive[i] = False
+    return keep
+
+
+def test_device_nms_matches_host():
+    rng = np.random.default_rng(0)
+    for _ in range(10):
+        n = 32
+        xy = rng.uniform(0, 10, (n, 2))
+        wh = rng.uniform(0.5, 3, (n, 2))
+        boxes = np.concatenate([xy, xy + wh], axis=1).astype(np.float32)
+        scores = rng.uniform(0, 1, n).astype(np.float32)
+        keep = np.asarray(jax.jit(device_nms)(jnp.asarray(boxes),
+                                              jnp.asarray(scores)))
+        want = _host_nms(boxes, scores, 0.5, 0.0)
+        np.testing.assert_array_equal(keep, want)
+
+
+def test_detector_end_to_end_jit_and_grads():
+    grid = PillarGrid(0, 8, 0, 8, 4, 4, 8)
+    det = PointPillarsDetector(grid)
+    params = det.init(jax.random.key(0))
+    pts = jax.random.uniform(jax.random.key(1), (64, 4)) * 8.0
+
+    boxes, scores, keep = jax.jit(det.detect)(params, pts)
+    assert boxes.shape == (16, 4) and scores.shape == (16,)
+    assert keep.dtype == jnp.bool_
+
+    # gradients flow end to end (train a cell score toward 1)
+    def loss(p):
+        _, s = det.apply(p, pts)
+        return jnp.mean((s - 1.0) ** 2)
+
+    g = jax.grad(loss)(params)
+    assert float(jnp.abs(g["pfn"]["w"]).sum()) > 0
+    l0 = float(loss(params))
+    for _ in range(20):
+        g = jax.grad(loss)(params)
+        params = jax.tree_util.tree_map(lambda a, b: a - 0.5 * b, params, g)
+    assert float(loss(params)) < l0

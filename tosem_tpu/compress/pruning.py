@@ -48,6 +48,9 @@ def magnitude_masks(params: Params, sparsity: float, *,
     """
     if not 0.0 <= sparsity < 1.0:
         raise ValueError(f"sparsity must be in [0, 1), got {sparsity}")
+    if scope not in ("global", "per_tensor"):
+        raise ValueError(f"scope must be 'global' or 'per_tensor', "
+                         f"got {scope!r}")
     leaves, treedef = _flatten_with_paths(params)
 
     if scope == "global":
@@ -85,7 +88,12 @@ def apply_masks(params: Params, masks: Masks) -> Params:
 
 
 def sparsity_of(masks: Masks, prunable_only: bool = False) -> float:
+    """Fraction of zeroed entries. ``prunable_only`` restricts the count
+    to maskable leaves (ndim ≥ 2) so never-pruned biases/scales don't
+    dilute the reported sparsity."""
     leaves = jax.tree_util.tree_leaves(masks)
+    if prunable_only:
+        leaves = [l for l in leaves if l.ndim >= 2]
     total = sum(l.size for l in leaves)
     kept = sum(int(jnp.sum(l)) for l in leaves)
     return 1.0 - kept / max(total, 1)
@@ -118,18 +126,21 @@ def make_pruned_train_step(step_fn: Callable, scheduler: SparsityScheduler,
     compiled program; the mask multiply runs inside the caller's jit via
     :func:`apply_masks` on the updated params.
     """
-    state = {"step": 0, "masks": None}
+    state = {"step": 0, "masks": None, "sparsity": 0.0}
 
     def step(params, *args):
         s = state["step"]
         if state["masks"] is None or s % remask_every == 0:
             state["masks"] = magnitude_masks(params, scheduler(s),
                                              prunable=prunable)
+            # computed only at remask time: it forces a host sync, and
+            # masks are constant in between
+            state["sparsity"] = sparsity_of(state["masks"])
         params, metrics = step_fn(apply_masks(params, state["masks"]), *args)
         params = apply_masks(params, state["masks"])
         state["step"] = s + 1
         metrics = dict(metrics)
-        metrics["sparsity"] = sparsity_of(state["masks"])
+        metrics["sparsity"] = state["sparsity"]
         return params, metrics
 
     return step

@@ -44,13 +44,14 @@ def fake_quant(x, scale, bits: int = 8):
 def _fq_fwd(x, scale, bits):
     qmax = 2.0 ** (bits - 1) - 1.0
     in_range = jnp.abs(x.astype(jnp.float32) / scale) <= qmax
-    return fake_quant(x, scale, bits), in_range
+    return fake_quant(x, scale, bits), (in_range, scale)
 
 
-def _fq_bwd(bits, in_range, g):
+def _fq_bwd(bits, res, g):
+    in_range, scale = res
     # straight-through: pass gradient where the value was representable,
     # clip outside (the QAT_Quantizer STE rule); scale gets no gradient
-    return g * in_range.astype(g.dtype), jnp.zeros((), jnp.float32)
+    return g * in_range.astype(g.dtype), jnp.zeros_like(scale)
 
 
 fake_quant.defvjp(_fq_fwd, _fq_bwd)
@@ -79,18 +80,17 @@ def quantize_params(params: Params, bits: int = 8
     if bits != 8:
         raise ValueError("only int8 PTQ is supported")
 
-    def q(p):
+    scales = jax.tree_util.tree_map(
+        lambda p: _scale_for(p, bits) if p.ndim >= 2 else jnp.float32(1.0),
+        params)
+
+    def q(p, s):
         if p.ndim < 2:
             return p
-        s = _scale_for(p, bits)
         return jnp.clip(jnp.round(p.astype(jnp.float32) / s),
                         -127, 127).astype(jnp.int8)
 
-    def scale(p):
-        return _scale_for(p, bits) if p.ndim >= 2 else jnp.float32(1.0)
-
-    qp = jax.tree_util.tree_map(q, params)
-    scales = jax.tree_util.tree_map(scale, params)
+    qp = jax.tree_util.tree_map(q, params, scales)
     before = sum(l.size * l.dtype.itemsize
                  for l in jax.tree_util.tree_leaves(params))
     after = sum(l.size * l.dtype.itemsize
