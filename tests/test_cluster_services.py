@@ -1,0 +1,212 @@
+"""Cluster services tests (SURVEY §2.1 GCS/autoscaler rows, §2.2
+service-discovery + record/replay rows, §2.8 Redis/MySQL-queue rows)."""
+import os
+import time
+
+import pytest
+
+import tosem_tpu.runtime as rt
+from tosem_tpu.cluster import (Autoscaler, AutoscalerConfig, KVStore,
+                               Recorder, Registry, get_actor, register_actor,
+                               replay, replay_source)
+
+
+# ------------------------------------------------------------------- KV
+
+class TestKV:
+    def test_roundtrip_and_prefix(self, tmp_path):
+        kv = KVStore(str(tmp_path / "s.db"))
+        kv.put("ns", "a/1", b"x")
+        kv.put("ns", "a/2", b"y")
+        kv.put("ns", "b/1", b"z")
+        kv.put("other", "a/1", b"w")
+        assert kv.get("ns", "a/1") == b"x"
+        assert kv.get("ns", "missing") is None
+        assert kv.keys("ns", "a/") == ["a/1", "a/2"]
+        assert kv.delete("ns", "a/1") and not kv.delete("ns", "a/1")
+        kv.close()
+
+    def test_persistence_across_reopen(self, tmp_path):
+        path = str(tmp_path / "p.db")
+        kv = KVStore(path)
+        kv.put("exp", "state", b"round-7")
+        kv.close()
+        kv2 = KVStore(path)
+        assert kv2.get("exp", "state") == b"round-7"
+        kv2.close()
+
+    def test_cas(self):
+        kv = KVStore()
+        assert kv.cas("n", "k", None, b"v1")
+        assert not kv.cas("n", "k", None, b"v2")     # already exists
+        assert kv.cas("n", "k", b"v1", b"v2")
+        assert kv.get("n", "k") == b"v2"
+
+    def test_queue_lease_ack_reap(self):
+        kv = KVStore()
+        i1 = kv.push("jobs", b"one")
+        kv.push("jobs", b"two")
+        assert kv.qsize("jobs") == 2
+        got = kv.pop("jobs")
+        assert got == (i1, b"one")
+        assert kv.qsize("jobs") == 1                 # leased, not ready
+        kv.ack(got[0])
+        assert kv.pop("jobs")[1] == b"two"
+        assert kv.pop("jobs") is None
+        # expired lease returns to ready
+        assert kv.reap("jobs", lease_timeout=0.0) == 1
+        assert kv.pop("jobs")[1] == b"two"
+
+
+# ------------------------------------------------------------ discovery
+
+class TestDiscovery:
+    def test_register_lookup_list(self):
+        reg = Registry()
+        assert reg.register("channel", "lidar", {"port": 1})
+        assert reg.register("channel", "camera", {"port": 2})
+        assert reg.lookup("channel", "lidar") == {"port": 1}
+        assert reg.list("channel") == ["camera", "lidar"]
+        assert reg.deregister("channel", "lidar")
+        assert reg.lookup("channel", "lidar") is None
+
+    def test_unique_registration(self):
+        reg = Registry()
+        assert reg.register("svc", "router", {"v": 1}, unique=True)
+        assert not reg.register("svc", "router", {"v": 2}, unique=True)
+        assert reg.lookup("svc", "router") == {"v": 1}
+
+
+# ---------------------------------------------------------- autoscaler
+
+class FakePool:
+    def __init__(self, workers=1, backlog=0):
+        self.workers, self.backlog = workers, backlog
+
+    def stats(self):
+        return {"num_workers": self.workers, "pending": self.backlog,
+                "inflight": 0, "num_actors": 0}
+
+    def add(self):
+        self.workers += 1
+        return self.workers
+
+    def remove(self):
+        if self.workers > 1:
+            self.workers -= 1
+            return True
+        return False
+
+
+class TestAutoscaler:
+    def _mk(self, pool, **cfg):
+        return Autoscaler(AutoscalerConfig(**cfg), stats_fn=pool.stats,
+                          add_fn=pool.add, remove_fn=pool.remove)
+
+    def test_scales_up_under_backlog(self):
+        pool = FakePool(workers=1, backlog=10)
+        a = self._mk(pool, max_workers=4, max_scale_up_per_tick=2)
+        a.tick()
+        assert pool.workers == 3
+        a.tick()
+        assert pool.workers == 4                     # capped at max
+        a.tick()
+        assert pool.workers == 4
+
+    def test_scales_down_after_idle(self):
+        pool = FakePool(workers=4, backlog=0)
+        a = self._mk(pool, min_workers=1, idle_ticks_before_downscale=2)
+        a.tick()
+        assert pool.workers == 4                     # not yet
+        a.tick()
+        assert pool.workers == 3                     # after 2 idle ticks
+        a.tick()
+        a.tick()
+        assert pool.workers == 2
+
+    def test_busy_resets_idle_counter(self):
+        pool = FakePool(workers=2, backlog=0)
+        a = self._mk(pool, idle_ticks_before_downscale=2,
+                     backlog_per_worker=10)
+        a.tick()
+        pool.backlog = 5                             # busy again (no scale)
+        a.tick()
+        pool.backlog = 0
+        a.tick()
+        assert pool.workers == 2                     # counter was reset
+        a.tick()
+        assert pool.workers == 1
+
+
+# -------------------------------------------- named actors + elasticity
+
+@pytest.fixture(scope="module")
+def runtime():
+    rt.init(num_workers=2)
+    yield
+    rt.shutdown()
+
+
+class Counter:
+    def __init__(self, start=0):
+        self.n = start
+
+    def inc(self):
+        self.n += 1
+        return self.n
+
+
+class TestRuntimeIntegration:
+    def test_named_actor_roundtrip(self, runtime):
+        kv = KVStore()
+        h = rt.remote(Counter).remote(10)
+        assert register_actor("counter", h, kv=kv)
+        assert not register_actor("counter", h, kv=kv)   # unique
+        h2 = get_actor("counter", kv=kv)
+        assert rt.get(h2.inc.remote()) == 11
+        assert rt.get(h.inc.remote()) == 12              # same actor
+        with pytest.raises(KeyError):
+            get_actor("missing", kv=kv)
+
+    def test_stats_and_elastic_pool(self, runtime):
+        s = rt.stats()
+        assert s["num_workers"] == 2
+        rt.add_worker()
+        assert rt.stats()["num_workers"] == 3
+        # new worker actually executes tasks
+        f = rt.remote(lambda x: x * 2)
+        assert sorted(rt.get([f.remote(i) for i in range(8)])) == \
+            [0, 2, 4, 6, 8, 10, 12, 14]
+        # retire back down; all idle now
+        time.sleep(0.2)
+        assert rt.remove_idle_worker()
+        assert rt.stats()["num_workers"] == 2
+        # pool still functional afterwards
+        assert rt.get(f.remote(21)) == 42
+
+
+# -------------------------------------------------------- record/replay
+
+class TestRecordReplay:
+    def test_write_topics_replay_order(self, tmp_path):
+        path = str(tmp_path / "run.record")
+        rec = Recorder(path)
+        rec.write("lidar", {"n": 1}, t=1.0)
+        rec.write("camera", {"n": 2}, t=1.5)
+        rec.write("lidar", {"n": 3}, t=2.0)
+        assert rec.topics() == ["camera", "lidar"]
+        assert rec.count("lidar") == 2
+        rec.close()
+        msgs = list(replay(path))
+        assert [m[2]["n"] for m in msgs] == [1, 2, 3]
+        lidar = replay_source(path, "lidar")
+        assert [m["n"] for m in lidar] == [1, 3]
+
+    def test_tap_records_dataflow_items(self, tmp_path):
+        path = str(tmp_path / "tap.record")
+        rec = Recorder(path)
+        op = rec.tap("stage1", lambda x: x + 1)
+        out = [op(i) for i in range(5)]
+        assert out == [1, 2, 3, 4, 5]
+        rec.close()
+        assert [m for _, _, m in replay(path, "stage1")] == [0, 1, 2, 3, 4]

@@ -1,0 +1,89 @@
+"""Demand-based autoscaler for the runtime worker pool.
+
+The reference's autoscaler (`python/ray/autoscaler/` — monitor reads
+load metrics from the GCS, `resource_demand_scheduler` converts backlog
+into node launches, idle nodes terminate after a timeout). Single-host
+TPU translation: the "nodes" are runtime worker processes, demand is the
+scheduler's pending+inflight backlog from ``rt.stats()``, and scaling
+calls ``rt.add_worker()`` / ``rt.remove_idle_worker()``. Deterministic
+``tick()`` (no background thread by default) keeps tests exact; a
+``run()`` loop provides the monitor-daemon behavior.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+
+@dataclass
+class AutoscalerConfig:
+    min_workers: int = 1
+    max_workers: int = 8
+    # scale up when backlog exceeds this many tasks per current worker
+    backlog_per_worker: float = 2.0
+    # consecutive idle ticks before a down-scale
+    idle_ticks_before_downscale: int = 3
+    max_scale_up_per_tick: int = 2
+
+
+class Autoscaler:
+    def __init__(self, config: Optional[AutoscalerConfig] = None, *,
+                 stats_fn: Optional[Callable[[], Dict[str, int]]] = None,
+                 add_fn: Optional[Callable[[], int]] = None,
+                 remove_fn: Optional[Callable[[], bool]] = None):
+        import tosem_tpu.runtime as rt
+        self.cfg = config if config is not None else AutoscalerConfig()
+        self._stats = stats_fn or rt.stats
+        self._add = add_fn or rt.add_worker
+        self._remove = remove_fn or rt.remove_idle_worker
+        self._idle_ticks = 0
+        self.history: List[Dict[str, int]] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def tick(self) -> Dict[str, int]:
+        """One monitor round: read demand, scale, record the decision."""
+        s = self._stats()
+        workers = s["num_workers"]
+        # dispatchable demand only — dep-blocked/actor-bound pending work
+        # can't drain onto added task workers (falls back to raw pending
+        # for stats sources that don't report readiness)
+        backlog = s.get("pending_ready", s["pending"]) + s["inflight"]
+        added = removed = 0
+        if backlog > self.cfg.backlog_per_worker * workers:
+            self._idle_ticks = 0
+            want = min(self.cfg.max_workers - workers,
+                       self.cfg.max_scale_up_per_tick)
+            for _ in range(max(want, 0)):
+                self._add()
+                added += 1
+        elif backlog == 0 and workers > self.cfg.min_workers:
+            self._idle_ticks += 1
+            if self._idle_ticks >= self.cfg.idle_ticks_before_downscale:
+                if self._remove():
+                    removed = 1
+                self._idle_ticks = 0
+        else:
+            self._idle_ticks = 0
+        decision = {**s, "added": added, "removed": removed}
+        self.history.append(decision)
+        return decision
+
+    def run(self, interval: float = 1.0) -> None:
+        """Background monitor loop (the autoscaler daemon role)."""
+        def loop():
+            while not self._stop.wait(interval):
+                try:
+                    self.tick()
+                except Exception:
+                    pass  # a dead runtime must not crash the monitor
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="tosem-autoscaler")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)

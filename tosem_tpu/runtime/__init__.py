@@ -8,13 +8,15 @@ what remains native is the data plane (:mod:`tosem_tpu.native` objstore).
 """
 from tosem_tpu.runtime.api import (ActorDiedError, ObjectRef,
                                    TaskCancelledError, TaskError,
-                                   WorkerCrashedError, cancel, get, init,
-                                   is_initialized, kill, put, remote,
-                                   shutdown, wait)
+                                   WorkerCrashedError, add_worker, cancel,
+                                   get, init, is_initialized, kill, put,
+                                   remote, remove_idle_worker, shutdown,
+                                   stats, wait)
 from tosem_tpu.runtime.object_store import ObjectID, ObjectStore
 
 __all__ = [
     "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
-    "kill", "cancel", "ObjectRef", "ObjectID", "ObjectStore", "TaskError",
+    "kill", "cancel", "stats", "add_worker", "remove_idle_worker",
+    "ObjectRef", "ObjectID", "ObjectStore", "TaskError",
     "WorkerCrashedError", "ActorDiedError", "TaskCancelledError",
 ]

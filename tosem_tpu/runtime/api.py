@@ -190,3 +190,18 @@ def cancel(ref: ObjectRef) -> None:
     :class:`TaskCancelledError`. Best-effort on finished tasks
     (``ray.cancel`` force semantics)."""
     _rt().cancel(ref)
+
+
+def stats() -> dict:
+    """Scheduler load snapshot (pending/inflight/worker counts)."""
+    return _rt().stats()
+
+
+def add_worker() -> int:
+    """Grow the worker pool by one; returns the new worker id."""
+    return _rt().add_worker()
+
+
+def remove_idle_worker() -> bool:
+    """Retire one idle worker; False if all busy or pool is at 1."""
+    return _rt().remove_idle_worker()

@@ -391,6 +391,65 @@ class Runtime:
         done_set = set(done)
         return done, [r for r in refs if r not in done_set]
 
+    # --------------------------------------------------- elasticity
+
+    def stats(self) -> Dict[str, int]:
+        """Scheduler load snapshot (the autoscaler's demand signal —
+        ``monitor.py``/``resource_demand_scheduler`` read the same shape
+        of data from the GCS in the reference)."""
+        with self.lock:
+            # only dep-resolved stateless tasks can drain onto new task
+            # workers — dep-blocked or actor-bound work must not drive
+            # up-scaling (it wouldn't dispatch to the added workers)
+            ready = sum(1 for s in self.pending
+                        if s.actor_id is None
+                        and not self._unresolved_deps(s.args, s.kwargs))
+            return {
+                "num_workers": len(self.task_workers),
+                "pending": len(self.pending),
+                "pending_ready": ready,
+                "inflight": sum(len(w.inflight)
+                                for w in self.task_workers),
+                "num_actors": len(self.actors),
+            }
+
+    def add_worker(self) -> int:
+        """Grow the pool by one (autoscaler up-scale)."""
+        with self.lock:
+            if self._shutdown:
+                raise RuntimeError("runtime is shut down")
+            # _make_ctx, not the init-time ctx: jax may have been imported
+            # since (fork → spawn re-pick, see __init__)
+            w = _Worker(self._make_ctx(), self.store_name)
+            self.task_workers.append(w)
+            M_WORKERS_ALIVE.set(len(self.task_workers))
+            self.cv.notify_all()
+            return w.wid
+
+    def remove_idle_worker(self) -> bool:
+        """Retire one idle worker (autoscaler down-scale). Only workers
+        with no inflight tasks are eligible, so nothing needs replay;
+        returns False when every worker is busy or the pool is at 1."""
+        with self.lock:
+            if len(self.task_workers) <= 1:
+                return False
+            for i, w in enumerate(self.task_workers):
+                if not w.inflight:
+                    self.task_workers.pop(i)
+                    M_WORKERS_ALIVE.set(len(self.task_workers))
+                    victim = w
+                    break
+            else:
+                return False
+        try:
+            self._send(victim, ("exit",))
+        except Exception:
+            pass
+        victim.proc.join(timeout=0.5)      # let the graceful exit land
+        if victim.proc.is_alive():
+            victim.kill()
+        return True
+
     def shutdown(self) -> None:
         with self.lock:
             if self._shutdown:
