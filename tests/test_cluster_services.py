@@ -40,7 +40,24 @@ class TestKV:
         assert kv.cas("n", "k", None, b"v1")
         assert not kv.cas("n", "k", None, b"v2")     # already exists
         assert kv.cas("n", "k", b"v1", b"v2")
+        assert not kv.cas("n", "k", b"v1", b"v3")    # stale expect
         assert kv.get("n", "k") == b"v2"
+
+    def test_cas_atomic_across_instances(self, tmp_path):
+        path = str(tmp_path / "shared.db")
+        a, b = KVStore(path), KVStore(path)
+        assert a.cas("n", "leader", None, b"a")
+        assert not b.cas("n", "leader", None, b"b")  # single winner
+        assert b.get("n", "leader") == b"a"
+        a.close(); b.close()
+
+    def test_keys_prefix_escapes_like_wildcards(self):
+        kv = KVStore()
+        kv.put("ns", "trial_1", b"x")
+        kv.put("ns", "trialX1", b"y")
+        assert kv.keys("ns", "trial_") == ["trial_1"]
+        kv.put("ns", "a%b", b"z")
+        assert kv.keys("ns", "a%") == ["a%b"]
 
     def test_queue_lease_ack_reap(self):
         kv = KVStore()

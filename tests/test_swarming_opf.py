@@ -1,0 +1,115 @@
+"""PSO swarming + OPF experiment runner tests (SURVEY §2.5 Swarming/OPF)."""
+import numpy as np
+import pytest
+
+from tosem_tpu.models.opf import detection_f1, run_opf_experiment
+from tosem_tpu.tune import PSOSearch, RandomSearch, choice, uniform
+from tosem_tpu.utils.results import read_results
+
+
+# ------------------------------------------------------------- PSO
+
+SPACE = {"x": uniform(-1.0, 1.0), "y": uniform(-1.0, 1.0),
+         "kind": choice(["a", "b"])}
+
+
+def _objective(cfg):
+    # smooth bowl with a categorical bonus: optimum x=0.3, y=-0.2, kind="b"
+    return (-(cfg["x"] - 0.3) ** 2 - (cfg["y"] + 0.2) ** 2
+            + (0.5 if cfg["kind"] == "b" else 0.0))
+
+
+def _drive(algo, budget):
+    algo.set_space(SPACE, "max")
+    best = -np.inf
+    for _ in range(budget):
+        cfg = algo.suggest()
+        s = _objective(cfg)
+        algo.observe(cfg, s)
+        best = max(best, s)
+    return best
+
+
+def test_pso_converges_toward_optimum():
+    best = _drive(PSOSearch(seed=0, n_particles=6), 120)
+    assert best > 0.45                        # near the 0.5 optimum
+
+
+def test_pso_beats_random_at_equal_budget():
+    wins = 0
+    for seed in range(3):
+        pso = _drive(PSOSearch(seed=seed, n_particles=6), 90)
+        rnd = _drive(RandomSearch(seed=seed), 90)
+        wins += pso >= rnd
+    assert wins >= 2
+
+
+def test_pso_min_mode():
+    algo = PSOSearch(seed=1, n_particles=4)
+    algo.set_space({"x": uniform(0.0, 4.0)}, "min")
+    best = np.inf
+    for _ in range(60):
+        cfg = algo.suggest()
+        s = (cfg["x"] - 3.0) ** 2
+        algo.observe(cfg, s)
+        best = min(best, s)
+    assert best < 0.05
+
+
+def test_pso_categorical_only_space_keeps_all_particles_moving():
+    # many particles decode to the same config; FIFO mapping must route
+    # every observation to its own particle
+    algo = PSOSearch(seed=3, n_particles=8)
+    algo.set_space({"k": choice(["a", "b"])}, "max")
+    for _ in range(4):
+        cfgs = [algo.suggest() for _ in range(8)]
+        for c in cfgs:
+            algo.observe(c, 1.0 if c["k"] == "b" else 0.0)
+    assert not algo._pending                  # every observation consumed
+    assert algo.gbest_score == 1.0
+
+
+def test_pso_ignores_foreign_observations():
+    algo = PSOSearch(seed=2)
+    algo.set_space(SPACE, "max")
+    algo.observe({"x": 0.0, "y": 0.0, "kind": "a"}, 1.0)   # never suggested
+    cfg = algo.suggest()                                    # must not crash
+    assert set(cfg) == {"x", "y", "kind"}
+
+
+# ------------------------------------------------------------- OPF
+
+def _signal(n=400, anomalies=(250, 320)):
+    t = np.arange(n)
+    x = np.sin(2 * np.pi * t / 25)
+    for a in anomalies:
+        x[a:a + 3] += 4.0                    # spike anomalies
+    return x
+
+
+def test_opf_runner_detects_injected_anomalies(tmp_path):
+    csv = str(tmp_path / "opf.csv")
+    desc = {"model": {"minval": -2.0, "maxval": 6.0},
+            "probation": 150, "anomaly_threshold": 0.7, "seed": 0}
+    res = run_opf_experiment(desc, _signal(), results_csv=csv)
+    assert len(res.rows) == 400
+    assert res.metrics["records"] == 400
+    f1 = detection_f1(res.detections, [250, 320], window=6)
+    assert f1["recall"] >= 0.5               # at least one spike caught
+    rows = read_results(csv)
+    assert {r["metric"] for r in rows} >= {"mean_anomaly_score",
+                                           "n_detections"}
+
+
+def test_opf_requires_bounds():
+    with pytest.raises(ValueError):
+        run_opf_experiment({"model": {}}, [1.0, 2.0])
+
+
+def test_detection_f1_scoring():
+    m = detection_f1([10, 50, 90], [12, 52], window=3)
+    assert m["tp"] == 2 and m["fp"] == 1 and m["fn"] == 0
+    assert m["recall"] == 1.0
+    assert m["precision"] == pytest.approx(2 / 3)
+    none = detection_f1([], [5], window=3)
+    assert none["f1"] == 0.0 and none["fn"] == 1

@@ -68,23 +68,32 @@ class KVStore:
             return cur.rowcount > 0
 
     def keys(self, ns: str, prefix: str = "") -> List[str]:
+        # escape LIKE metacharacters so '_'/'%' in a prefix match literally
+        esc = (prefix.replace("\\", "\\\\").replace("%", "\\%")
+               .replace("_", "\\_"))
         with self._lock:
             rows = self._db.execute(
-                "SELECT k FROM kv WHERE ns=? AND k LIKE ? ORDER BY k",
-                (ns, prefix + "%")).fetchall()
+                "SELECT k FROM kv WHERE ns=? AND k LIKE ? ESCAPE '\\' "
+                "ORDER BY k", (ns, esc + "%")).fetchall()
         return [r[0] for r in rows]
 
     def cas(self, ns: str, key: str, expect: Optional[bytes],
             value: bytes) -> bool:
         """Compare-and-swap: write only if the current value matches
-        ``expect`` (None = key must not exist). The primitive behind
-        leader election / unique named registration."""
+        ``expect`` (None = key must not exist). Single-statement SQL, so
+        it is atomic across processes sharing the db file — the primitive
+        behind leader election / unique named registration."""
         with self._lock:
-            cur = self.get(ns, key)
-            if cur != expect:
-                return False
-            self.put(ns, key, value)
-            return True
+            if expect is None:
+                cur = self._db.execute(
+                    "INSERT OR IGNORE INTO kv (ns, k, v, updated) "
+                    "VALUES (?, ?, ?, ?)", (ns, key, value, time.time()))
+            else:
+                cur = self._db.execute(
+                    "UPDATE kv SET v=?, updated=? WHERE ns=? AND k=? "
+                    "AND v=?", (value, time.time(), ns, key, expect))
+            self._db.commit()
+            return cur.rowcount > 0
 
     # ------------------------------------------------ durable queue
 

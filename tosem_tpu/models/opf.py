@@ -1,0 +1,118 @@
+"""OPF-style experiment runner for the HTM family.
+
+The reference's Online Prediction Framework (`nupic/frameworks/opf/
+experiment_runner.py` runExperiment, `opf_basic_environment.py`,
+`prediction_metrics_manager.py`) drives a model description over a data
+source and emits a metrics stream. Same contract here, TPU-era shape:
+the description is a plain dict (JSON-able, so tune/swarming can search
+over it — exactly how NuPIC swarming permutes OPF descriptions), the
+model is :class:`~tosem_tpu.models.htm.HTMModel`, and results funnel
+through the framework's study-schema CSV writer.
+
+Description schema::
+
+    {
+      "model": {minval, maxval, n_bits?, n_columns?, ...},   # HTMModel kwargs
+      "probation": 100,            # records before metrics count
+      "anomaly_threshold": 0.8,    # likelihood above which we flag
+      "seed": 0,
+    }
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterable, List, Optional
+
+import jax
+import numpy as np
+
+from tosem_tpu.models.htm import HTMModel
+from tosem_tpu.utils.results import ResultRow, ResultWriter
+
+
+@dataclass
+class OPFResult:
+    rows: List[Dict[str, float]] = field(default_factory=list)
+    metrics: Dict[str, float] = field(default_factory=dict)
+    detections: List[int] = field(default_factory=list)   # record indices
+
+
+def run_opf_experiment(description: Dict[str, Any],
+                       data: Iterable[float], *,
+                       learn: bool = True,
+                       results_csv: Optional[str] = None) -> OPFResult:
+    """Run one OPF experiment (the ``runExperiment`` entry point).
+
+    Streams ``data`` through encoder→SP→TM→anomaly-likelihood, flags
+    records whose likelihood exceeds the threshold after the probation
+    window, and aggregates the metrics suite (mean/max score and
+    likelihood, detection count/indices).
+    """
+    desc = dict(description)
+    model_kw = dict(desc.get("model", {}))
+    if "minval" not in model_kw or "maxval" not in model_kw:
+        raise ValueError("description['model'] needs minval/maxval")
+    probation = int(desc.get("probation", 100))
+    threshold = float(desc.get("anomaly_threshold", 0.8))
+    seed = int(desc.get("seed", 0))
+
+    model = HTMModel(jax.random.key(seed), **model_kw)
+    out = OPFResult()
+    scores, likes = [], []
+    for i, value in enumerate(data):
+        r = model.run(float(value), learn=learn)
+        row = {"record": i, "value": float(value),
+               "anomaly_score": r["anomaly_score"],
+               "anomaly_likelihood": r["anomaly_likelihood"]}
+        out.rows.append(row)
+        if i >= probation:
+            scores.append(r["anomaly_score"])
+            likes.append(r["anomaly_likelihood"])
+            if r["anomaly_likelihood"] >= threshold:
+                out.detections.append(i)
+
+    out.metrics = {
+        "records": float(len(out.rows)),
+        "mean_anomaly_score": float(np.mean(scores)) if scores else 0.0,
+        "max_anomaly_score": float(np.max(scores)) if scores else 0.0,
+        "mean_anomaly_likelihood": float(np.mean(likes)) if likes else 0.0,
+        "max_anomaly_likelihood": float(np.max(likes)) if likes else 0.0,
+        "n_detections": float(len(out.detections)),
+    }
+
+    if results_csv is not None:
+        w = ResultWriter(results_csv)
+        for name, val in out.metrics.items():
+            w.add(ResultRow(project="models", config="opf_htm",
+                            bench_id=f"opf_{name}", metric=name, value=val,
+                            unit="count" if name.startswith("n_") else "score",
+                            device="cpu",
+                            extra={"description": {
+                                k: v for k, v in desc.items()
+                                if k != "data"}}))
+        w.flush()
+    return out
+
+
+def detection_f1(detections: List[int], truth: List[int],
+                 window: int = 5) -> Dict[str, float]:
+    """Window-tolerant detection scoring (the NAB-style evaluation the
+    reference's anomaly benchmarks use): a detection within ``window``
+    records of a true anomaly counts as a hit."""
+    truth = sorted(truth)
+    matched_truth = set()
+    tp = 0
+    for d in detections:
+        for t in truth:
+            if t not in matched_truth and abs(d - t) <= window:
+                matched_truth.add(t)
+                tp += 1
+                break
+    fp = len(detections) - tp
+    fn = len(truth) - len(matched_truth)
+    precision = tp / max(tp + fp, 1)
+    recall = tp / max(tp + fn, 1)
+    f1 = (2 * precision * recall / max(precision + recall, 1e-9)
+          if tp else 0.0)
+    return {"precision": precision, "recall": recall, "f1": f1,
+            "tp": tp, "fp": fp, "fn": fn}

@@ -448,6 +448,10 @@ class Runtime:
         victim.proc.join(timeout=0.5)      # let the graceful exit land
         if victim.proc.is_alive():
             victim.kill()
+        try:
+            victim.conn.close()            # no fd leak across scale cycles
+        except Exception:
+            pass
         return True
 
     def shutdown(self) -> None:

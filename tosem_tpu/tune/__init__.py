@@ -13,10 +13,10 @@ from tosem_tpu.tune.schedulers import (ASHAScheduler, CurveFittingAssessor,
                                        TrialScheduler)
 from tosem_tpu.tune.search import (BOHBSearch, Choice, Domain,
                                    EvolutionSearch, GPSearch, GridSearch,
-                                   LogUniform, RandInt, RandomSearch,
-                                   SearchAlgorithm, TPESearch, Uniform,
-                                   choice, grid_search, loguniform, randint,
-                                   uniform)
+                                   LogUniform, PSOSearch, RandInt,
+                                   RandomSearch, SearchAlgorithm, TPESearch,
+                                   Uniform, choice, grid_search, loguniform,
+                                   randint, uniform)
 from tosem_tpu.tune.tune import Analysis, Trainable, Trial, run
 
 __all__ = [
@@ -24,7 +24,7 @@ __all__ = [
     "TrialScheduler", "FIFOScheduler", "ASHAScheduler", "MedianStoppingRule",
     "PBTScheduler", "HyperBandScheduler", "CurveFittingAssessor",
     "SearchAlgorithm", "RandomSearch", "GridSearch", "TPESearch",
-    "EvolutionSearch", "GPSearch", "BOHBSearch",
+    "EvolutionSearch", "GPSearch", "BOHBSearch", "PSOSearch",
     "uniform", "loguniform", "randint", "choice", "grid_search",
     "Domain", "Uniform", "LogUniform", "RandInt", "Choice",
 ]

@@ -480,16 +480,94 @@ class BOHBSearch(SearchAlgorithm):
         return self._decode(np.clip(best, 0.0, 1.0))
 
     def _decode(self, x: np.ndarray) -> Dict[str, Any]:
-        cfg: Dict[str, Any] = {}
-        onehot: Dict[str, List[Tuple[float, Any]]] = {}
-        for j, (k, kind, payload) in enumerate(self._cols):
-            if kind == "onehot":
-                onehot.setdefault(k, []).append((x[j], payload))
-            elif kind == "unit":
-                cfg[k] = payload.from_unit(float(np.clip(x[j], 0, 1)))
-            else:
-                cfg[k] = float(x[j])
-        for k, opts in onehot.items():
-            cfg[k] = max(opts, key=lambda o: o[0])[1]
-        cfg.update(self._consts)
+        return _decode_vector(x, self._cols, self._consts)
+
+
+def _decode_vector(x: np.ndarray, cols, constants) -> Dict[str, Any]:
+    """Inverse of ``_space_encoder``'s encode: unit-cube point → config
+    (one-hot blocks decode by argmax)."""
+    cfg: Dict[str, Any] = {}
+    onehot: Dict[str, List[Tuple[float, Any]]] = {}
+    for j, (k, kind, payload) in enumerate(cols):
+        if kind == "onehot":
+            onehot.setdefault(k, []).append((x[j], payload))
+        elif kind == "unit":
+            cfg[k] = payload.from_unit(float(np.clip(x[j], 0, 1)))
+        else:
+            cfg[k] = float(x[j])
+    for k, opts in onehot.items():
+        cfg[k] = max(opts, key=lambda o: o[0])[1]
+    cfg.update(constants)
+    return cfg
+
+
+class PSOSearch(SearchAlgorithm):
+    """Particle-swarm suggester — the NuPIC swarming algorithm.
+
+    The reference's swarming/HyperSearch (``nupic/swarming/hypersearch/
+    particle.py``, ``permutations_runner.py``) *is* particle-swarm
+    optimization over permutation variables; this is the same dynamics
+    over the unit-cube encoding: ``v ← ω·v + c1·r1·(pbest − x) +
+    c2·r2·(gbest − x)``, asynchronous (each observe updates one particle
+    and steps it), categoricals riding the one-hot block relaxation.
+    """
+
+    def __init__(self, seed: Optional[int] = None, n_particles: int = 8,
+                 inertia: float = 0.7, c1: float = 1.4, c2: float = 1.4,
+                 v_max: float = 0.25):
+        self.rng = random.Random(seed)
+        self.np_rng = np.random.default_rng(seed)
+        self.n_particles = n_particles
+        self.w, self.c1, self.c2, self.v_max = inertia, c1, c2, v_max
+        self._next = 0
+
+    def set_space(self, space, mode):
+        super().set_space(space, mode)
+        self._encode, self._dim, self._cols, self._consts = \
+            _space_encoder(space)
+        d = max(self._dim, 1)
+        self.x = self.np_rng.uniform(0, 1, (self.n_particles, d))
+        self.v = self.np_rng.uniform(-0.1, 0.1, (self.n_particles, d))
+        self.pbest = self.x.copy()
+        self.pbest_score = np.full(self.n_particles, -np.inf)
+        self.gbest = self.x[0].copy()
+        self.gbest_score = -np.inf
+        # FIFO per config key: distinct particles can decode to the SAME
+        # config (categorical-heavy spaces), and each observation must
+        # step its own particle, not overwrite a dict slot
+        self._pending: Dict[Tuple, List[int]] = {}
+
+    @staticmethod
+    def _key(cfg: Dict[str, Any]) -> Tuple:
+        return tuple(sorted((k, repr(v)) for k, v in cfg.items()))
+
+    def suggest(self):
+        i = self._next % self.n_particles
+        self._next += 1
+        cfg = _decode_vector(self.x[i], self._cols, self._consts)
+        self._pending.setdefault(self._key(cfg), []).append(i)
         return cfg
+
+    def observe(self, config, score, budget=None):
+        s = float(score)
+        if self.mode == "min":
+            s = -s
+        fifo = self._pending.get(self._key(config))
+        if not fifo:
+            return                      # observation from another searcher
+        i = fifo.pop(0)
+        if not fifo:
+            del self._pending[self._key(config)]
+        if s > self.pbest_score[i]:
+            self.pbest_score[i] = s
+            self.pbest[i] = self.x[i].copy()
+        if s > self.gbest_score:
+            self.gbest_score = s
+            self.gbest = self.x[i].copy()
+        r1 = self.np_rng.uniform(size=self.x[i].shape)
+        r2 = self.np_rng.uniform(size=self.x[i].shape)
+        self.v[i] = (self.w * self.v[i]
+                     + self.c1 * r1 * (self.pbest[i] - self.x[i])
+                     + self.c2 * r2 * (self.gbest - self.x[i]))
+        self.v[i] = np.clip(self.v[i], -self.v_max, self.v_max)
+        self.x[i] = np.clip(self.x[i] + self.v[i], 0.0, 1.0)
