@@ -38,6 +38,20 @@ def test_gemm_end_to_end_csv(tmp_path):
     assert json.loads(json.dumps(r["extra"]))["m"] == 256
 
 
+@pytest.mark.slow
+def test_detection_infer_end_to_end(tmp_path):
+    out = tmp_path / "di.csv"
+    rc = main(["--device=cpu", "--config=detection_infer",
+               f"--results_csv={out}"])
+    assert rc == 0
+    rows = read_results(str(out))
+    metrics = {r["metric"]: r["value"] for r in rows}
+    assert metrics["latency_ms"] > 0
+    assert metrics["postprocess_ms"] > 0
+    assert metrics["stablehlo_kb"] > 10
+    assert (tmp_path / "export" / "efficientdet_infer.mlir").exists()
+
+
 def test_manifest_drives_run(tmp_path):
     out = tmp_path / "m.csv"
     mpath = tmp_path / "exp.yaml"

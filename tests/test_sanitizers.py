@@ -1,0 +1,26 @@
+"""Sanitizer gate for the native components (SURVEY §5.2).
+
+The reference runs plasma/raylet under ASAN/TSAN in CI; these tests
+build the stress harness with each sanitizer and fail on any report.
+"""
+import pytest
+
+from tosem_tpu.native.sanitize import SANITIZERS, build_stress, run_stress
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("suite,san", [
+    ("objstore", "asan"),
+    ("decoder", "asan"),
+    ("objstore", "tsan"),
+    ("decoder", "tsan"),
+])
+def test_native_stress_clean(suite, san):
+    rc, out = run_stress(suite, san, iters=150)
+    assert rc == 0, f"{suite}/{san} failed:\n{out[-4000:]}"
+    assert "ERROR: " not in out and "WARNING: ThreadSanitizer" not in out
+
+
+def test_unknown_sanitizer_rejected():
+    with pytest.raises(ValueError):
+        build_stress("msan")

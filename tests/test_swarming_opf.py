@@ -57,16 +57,31 @@ def test_pso_min_mode():
 
 
 def test_pso_categorical_only_space_keeps_all_particles_moving():
-    # many particles decode to the same config; FIFO mapping must route
-    # every observation to its own particle
+    # many particles decode to the same config; observations must reach
+    # every pending particle with that key, not just one dict slot
     algo = PSOSearch(seed=3, n_particles=8)
     algo.set_space({"k": choice(["a", "b"])}, "max")
     for _ in range(4):
         cfgs = [algo.suggest() for _ in range(8)]
         for c in cfgs:
             algo.observe(c, 1.0 if c["k"] == "b" else 0.0)
-    assert not algo._pending                  # every observation consumed
     assert algo.gbest_score == 1.0
+    # every particle received scores and participates in the swarm
+    assert np.all(np.isfinite(algo.pbest_score))
+
+
+def test_pso_uses_best_iteration_score_not_first():
+    # tune reports every training iteration; the swarm must act on the
+    # best score of a suggestion, applied at the particle's next turn
+    algo = PSOSearch(seed=4, n_particles=2)
+    algo.set_space({"x": uniform(0.0, 1.0)}, "max")
+    cfg = algo.suggest()
+    algo.observe(cfg, 0.1)      # early iteration
+    algo.observe(cfg, 0.9)      # converged iteration
+    algo.observe(cfg, 0.5)      # late wobble
+    algo.suggest()              # other particle
+    algo.suggest()              # particle 0's next turn applies the max
+    assert algo.gbest_score == pytest.approx(0.9)
 
 
 def test_pso_ignores_foreign_observations():

@@ -25,7 +25,7 @@ from typing import Any, Dict, List
 from tosem_tpu.utils.flags import FlagSet
 
 CONFIGS = ("gemm", "conv_sweep", "allreduce", "resnet_train",
-           "bert_kernels", "detection_train")
+           "bert_kernels", "detection_train", "detection_infer")
 
 
 def make_flags() -> FlagSet:
@@ -278,6 +278,79 @@ def run_detection_train(fs: FlagSet) -> List[Any]:
     return rows
 
 
+def run_detection_infer(fs: FlagSet) -> List[Any]:
+    """EfficientDet inference latency + StableHLO export (the reference's
+    ``model_inspect.py`` bm/export runmodes: device forward timed with
+    the dispatch-cancelling loop, host NMS timed separately, and the
+    deployable artifact written via :mod:`tosem_tpu.compile`)."""
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from tosem_tpu.compile.export import export_program
+    from tosem_tpu.models.efficientdet import (EfficientDet,
+                                               EfficientDetConfig,
+                                               generate_anchors, postprocess)
+    from tosem_tpu.utils.results import ResultRow
+    from tosem_tpu.utils.timing import DeviceLoopBench
+
+    cfg = EfficientDetConfig.tiny()
+    model = EfficientDet(cfg)
+    vs = model.init(jax.random.PRNGKey(0))
+    anchors = generate_anchors(cfg)
+    B = max(fs.batch, 1) if fs.batch else 1
+    imgs = jnp.asarray(np.random.default_rng(0).normal(
+        size=(B, cfg.image_size, cfg.image_size, 3)).astype(np.float32))
+
+    def fwd(x):
+        (cls_logits, box_regs), _ = model.apply(vs, x, train=False)
+        # single-array output for the loop harness; concat keeps both
+        # heads live so neither gets dead-code eliminated
+        return jnp.concatenate(
+            [cls_logits.reshape(B, -1), box_regs.reshape(B, -1)], axis=1)
+
+    bench = DeviceLoopBench(op=fwd, args=(imgs,), perturb=0)
+    sec = bench.time(reps=3)
+    platform = jax.devices()[0].platform
+
+    # host postprocess (decode + NMS) latency on real logits
+    (cls_logits, box_regs), _ = jax.jit(
+        lambda v, x: model.apply(v, x, train=False))(vs, imgs)
+    cls_np, box_np = np.asarray(cls_logits), np.asarray(box_regs)
+    t0 = _time.perf_counter()
+    for b in range(B):
+        postprocess(cls_np[b:b + 1], box_np[b:b + 1], anchors)
+    post_s = (_time.perf_counter() - t0) / B
+
+    export_dir = os.path.join(os.path.dirname(fs.results_csv) or ".",
+                              "export")
+    paths = export_program(
+        lambda x: model.apply(vs, x, train=False)[0], (imgs,),
+        export_dir, "efficientdet_infer")
+    mlir_kb = os.path.getsize(paths["mlir"]) / 1024.0
+
+    rows = [
+        ResultRow(project="models", config="detection_infer",
+                  bench_id=f"effdet_tiny_fwd_b{B}",
+                  metric="latency_ms", value=sec * 1e3, unit="ms",
+                  device=platform,
+                  extra={"image_size": cfg.image_size, "batch": B}),
+        ResultRow(project="models", config="detection_infer",
+                  bench_id=f"effdet_tiny_post_b{B}",
+                  metric="postprocess_ms", value=post_s * 1e3, unit="ms",
+                  device="cpu", extra={"nms": "host"}),
+        ResultRow(project="models", config="detection_infer",
+                  bench_id="effdet_tiny_export",
+                  metric="stablehlo_kb", value=mlir_kb, unit="KiB",
+                  device=platform,
+                  extra={"paths": sorted(paths.values())}),
+    ]
+    for r in rows:
+        print(f"  {r.bench_id}: {r.value:.2f} {r.unit}")
+    return rows
+
+
 RUNNERS = {
     "gemm": run_gemm,
     "conv_sweep": run_conv_sweep,
@@ -285,6 +358,7 @@ RUNNERS = {
     "resnet_train": run_resnet_train,
     "bert_kernels": run_bert_kernels,
     "detection_train": run_detection_train,
+    "detection_infer": run_detection_infer,
 }
 
 

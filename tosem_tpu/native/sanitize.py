@@ -1,0 +1,57 @@
+"""Sanitizer builds of the native components (SURVEY §5.2).
+
+The reference runs its native runtime under ASAN/TSAN in CI (Ray's
+sanitizer jobs over the plasma store and raylet; Apollo's cyber
+sanitizer configs). Here :func:`build_stress` links
+``sanitize_stress.cpp`` with the objstore and decoder translation units
+under the requested ``-fsanitize=`` mode, and :func:`run_stress`
+executes a suite — any memory error, UB, leak, or data race turns into
+a nonzero exit that fails the test gate.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+from typing import Tuple
+
+from tosem_tpu.native import (CXX, NativeBuildError, _BUILD_DIR, _NATIVE_DIR,
+                              _src_mtime)
+
+SANITIZERS = {
+    "asan": ["-fsanitize=address,undefined", "-fno-sanitize-recover=all"],
+    "tsan": ["-fsanitize=thread"],
+}
+
+_SOURCES = ["sanitize_stress.cpp", "objstore.cpp", "ctc_decoder.cpp"]
+
+
+def build_stress(sanitizer: str) -> str:
+    if sanitizer not in SANITIZERS:
+        raise ValueError(f"sanitizer must be one of {sorted(SANITIZERS)}")
+    out = os.path.join(_BUILD_DIR, f"stress_{sanitizer}")
+    srcs = [os.path.join(_NATIVE_DIR, s) for s in _SOURCES]
+    newest = max(_src_mtime(s) for s in srcs)
+    if not os.path.exists(out) or os.path.getmtime(out) < newest:
+        os.makedirs(_BUILD_DIR, exist_ok=True)
+        cmd = [CXX, "-std=c++17", "-g", "-O1", "-fno-omit-frame-pointer",
+               *SANITIZERS[sanitizer], "-o", out + ".tmp", *srcs,
+               "-lpthread", "-lrt"]
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise NativeBuildError(
+                f"sanitizer build failed ({sanitizer}):\n{proc.stderr}")
+        os.replace(out + ".tmp", out)
+    return out
+
+
+def run_stress(suite: str, sanitizer: str, iters: int = 0,
+               timeout: float = 300.0) -> Tuple[int, str]:
+    """Build + run one stress suite; returns (rc, combined output)."""
+    binary = build_stress(sanitizer)
+    env = dict(os.environ)
+    env.setdefault("ASAN_OPTIONS", "detect_leaks=1:abort_on_error=0")
+    env.setdefault("UBSAN_OPTIONS", "print_stacktrace=1")
+    cmd = [binary, suite] + ([str(iters)] if iters else [])
+    proc = subprocess.run(cmd, capture_output=True, text=True,
+                          timeout=timeout, env=env)
+    return proc.returncode, proc.stdout + proc.stderr

@@ -1,0 +1,228 @@
+// Sanitizer stress harness for the native components (SURVEY §5.2).
+//
+// The reference gates its native runtime under sanitizers and race
+// detection (Ray's ASAN/TSAN CI jobs over plasma/raylet, Apollo's
+// cyber sanitizer builds). This binary links the objstore and CTC
+// decoder translation units directly and hammers them from multiple
+// threads; it is compiled by tosem_tpu/native/sanitize.py with
+// -fsanitize=address,undefined or -fsanitize=thread, so memory errors,
+// UB, and data races fail the build's exit code rather than lurking.
+//
+// Usage: sanitize_stress <objstore|decoder> [iters]
+
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+void* objstore_create(const char* name, uint64_t capacity);
+void* objstore_attach(const char* name);
+int objstore_put(void* h, const uint8_t* id, const uint8_t* data,
+                 uint64_t size);
+int objstore_get(void* h, const uint8_t* id, const uint8_t** out_ptr,
+                 uint64_t* out_size);
+int objstore_release(void* h, const uint8_t* id);
+int objstore_contains(void* h, const uint8_t* id);
+int objstore_delete(void* h, const uint8_t* id);
+int objstore_reserve(void* h, const uint8_t* id, uint64_t size,
+                     uint8_t** out_ptr);
+int objstore_seal(void* h, const uint8_t* id);
+int objstore_abort(void* h, const uint8_t* id);
+void objstore_stats(void* h, uint64_t* used, uint64_t* nobj,
+                    uint64_t* capacity);
+void objstore_close(void* h);
+
+int ctc_beam_decode(const float* logp, int32_t T, int32_t V, int32_t blank,
+                    int32_t beam_width, const float* bonus,
+                    int32_t* out_labels, int32_t* out_len, float* out_score,
+                    int32_t max_out);
+int ctc_beam_decode_lm(const float* logp, int32_t T, int32_t V,
+                       int32_t blank, int32_t beam_width, void* lm,
+                       float alpha, float beta, int32_t space,
+                       const float* bonus, int32_t* out_labels,
+                       int32_t* out_len, float* out_score, int32_t max_out);
+void* tosem_lm_load(const char* path);
+void tosem_lm_free(void* lm);
+}
+
+namespace {
+
+void make_id(uint8_t* id, uint32_t thread, uint32_t n) {
+  std::memset(id, 0, 20);
+  std::memcpy(id, &thread, 4);
+  std::memcpy(id + 4, &n, 4);
+}
+
+int run_objstore(int iters) {
+  std::string name = "/tosem_sanstress_" + std::to_string(getpid());
+  void* store = objstore_create(name.c_str(), 4ull << 20);
+  if (!store) {
+    std::fprintf(stderr, "create failed\n");
+    return 2;
+  }
+  const int kThreads = 4;
+  std::vector<std::thread> ts;
+  std::vector<int> fails(kThreads, 0);
+  for (int k = 0; k < kThreads; k++) {
+    ts.emplace_back([&, k]() {
+      // each thread attaches its own handle — the cross-client pattern
+      void* h = (k == 0) ? store : objstore_attach(name.c_str());
+      if (!h) {
+        fails[k] = 1;
+        return;
+      }
+      std::mt19937 rng(k);
+      std::vector<uint8_t> buf(64 << 10);
+      uint8_t id[20];
+      for (int i = 0; i < iters; i++) {
+        uint32_t n = rng() % 64;
+        make_id(id, (uint32_t)k, n);
+        uint64_t size = 1 + rng() % buf.size();
+        for (uint64_t j = 0; j < size; j++)
+          buf[j] = (uint8_t)(id[4] + j);
+        int rc = objstore_put(h, id, buf.data(), size);
+        if (rc == 0 || rc == -1 /* exists */) {
+          const uint8_t* p = nullptr;
+          uint64_t got = 0;
+          if (objstore_get(h, id, &p, &got) == 0) {
+            // verify while holding the ref, then release
+            for (uint64_t j = 0; j < got; j += 977)
+              if (p[j] != (uint8_t)(id[4] + j)) {
+                fails[k] = 2;
+              }
+            objstore_release(h, id);
+          }
+        }
+        if (rng() % 4 == 0) objstore_delete(h, id);
+        if (rng() % 8 == 0) {
+          // two-phase write path
+          make_id(id, (uint32_t)k, 1000 + n);
+          uint8_t* wp = nullptr;
+          if (objstore_reserve(h, id, 4096, &wp) == 0) {
+            std::memset(wp, k, 4096);
+            if (rng() % 2)
+              objstore_seal(h, id);
+            else
+              objstore_abort(h, id);
+          }
+          objstore_delete(h, id);
+        }
+        objstore_contains(h, id);
+      }
+      if (k != 0) objstore_close(h);
+    });
+  }
+  for (auto& t : ts) t.join();
+  uint64_t used, nobj, cap;
+  objstore_stats(store, &used, &nobj, &cap);
+  std::printf("objstore stress: used=%llu objects=%llu capacity=%llu\n",
+              (unsigned long long)used, (unsigned long long)nobj,
+              (unsigned long long)cap);
+  objstore_close(store);
+  for (int f : fails)
+    if (f) return 3;
+  return 0;
+}
+
+std::string write_toy_lm() {
+  std::string path = "/tmp/tosem_sanstress_lm_" +
+                     std::to_string(getpid()) + ".bin";
+  FILE* f = std::fopen(path.c_str(), "wb");
+  int32_t order = 2, n_words = 2, n;
+  float unk = -10.0f, backoff = -0.9f, p;
+  std::fwrite("TLM1", 1, 4, f);
+  std::fwrite(&order, 4, 1, f);
+  std::fwrite(&n_words, 4, 1, f);
+  std::fwrite(&unk, 4, 1, f);
+  std::fwrite(&backoff, 4, 1, f);
+  int32_t w0[] = {0, 1}, w1[] = {1, 0};  // "ab", "ba"
+  n = 2;
+  std::fwrite(&n, 4, 1, f);
+  std::fwrite(w0, 4, 2, f);
+  std::fwrite(&n, 4, 1, f);
+  std::fwrite(w1, 4, 2, f);
+  int32_t n_entries = 3;
+  std::fwrite(&n_entries, 4, 1, f);
+  int32_t g0[] = {0};
+  n = 1;
+  p = -0.5f;
+  std::fwrite(&n, 4, 1, f);
+  std::fwrite(g0, 4, 1, f);
+  std::fwrite(&p, 4, 1, f);
+  int32_t g1[] = {1};
+  std::fwrite(&n, 4, 1, f);
+  std::fwrite(g1, 4, 1, f);
+  std::fwrite(&p, 4, 1, f);
+  int32_t g2[] = {0, 1};
+  n = 2;
+  p = -0.2f;
+  std::fwrite(&n, 4, 1, f);
+  std::fwrite(g2, 4, 2, f);
+  std::fwrite(&p, 4, 1, f);
+  std::fclose(f);
+  return path;
+}
+
+int run_decoder(int iters) {
+  std::string lm_path = write_toy_lm();
+  void* lm = tosem_lm_load(lm_path.c_str());
+  if (!lm) {
+    std::fprintf(stderr, "lm load failed\n");
+    return 2;
+  }
+  std::mt19937 rng(7);
+  std::normal_distribution<float> nd(0.0f, 2.0f);
+  for (int i = 0; i < iters; i++) {
+    int32_t T = 1 + (int32_t)(rng() % 40);
+    int32_t V = 4 + (int32_t)(rng() % 26);
+    std::vector<float> logp((size_t)T * V);
+    for (auto& v : logp) v = nd(rng);
+    std::vector<int32_t> out(T);
+    int32_t out_len = 0;
+    float score = 0.0f;
+    int32_t blank = (int32_t)(rng() % V);
+    int32_t beam = 1 + (int32_t)(rng() % 24);
+    int rc;
+    if (rng() % 2) {
+      rc = ctc_beam_decode(logp.data(), T, V, blank, beam, nullptr,
+                           out.data(), &out_len, &score, T);
+    } else {
+      int32_t space = 2 % V;
+      rc = ctc_beam_decode_lm(logp.data(), T, V, blank, beam, lm, 1.2f,
+                              0.4f, space, nullptr, out.data(), &out_len,
+                              &score, T);
+    }
+    if (rc != 0) {
+      tosem_lm_free(lm);
+      return 3;
+    }
+  }
+  tosem_lm_free(lm);
+  std::remove(lm_path.c_str());
+  std::printf("decoder stress: %d decodes clean\n", iters);
+  return 0;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    std::fprintf(stderr, "usage: sanitize_stress <objstore|decoder> "
+                         "[iters]\n");
+    return 2;
+  }
+  int iters = argc > 2 ? std::atoi(argv[2]) : 0;
+  if (std::strcmp(argv[1], "objstore") == 0)
+    return run_objstore(iters > 0 ? iters : 500);
+  if (std::strcmp(argv[1], "decoder") == 0)
+    return run_decoder(iters > 0 ? iters : 120);
+  std::fprintf(stderr, "unknown suite %s\n", argv[1]);
+  return 2;
+}

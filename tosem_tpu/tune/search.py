@@ -533,31 +533,24 @@ class PSOSearch(SearchAlgorithm):
         self.gbest = self.x[0].copy()
         self.gbest_score = -np.inf
         # FIFO per config key: distinct particles can decode to the SAME
-        # config (categorical-heavy spaces), and each observation must
-        # step its own particle, not overwrite a dict slot
+        # config (categorical-heavy spaces). Observations accumulate into
+        # the particle's per-suggestion best; the velocity step happens
+        # lazily at the particle's NEXT suggest — tune reports a score
+        # every training iteration, and only the best of them should
+        # drive the swarm (not iteration-1 noise).
         self._pending: Dict[Tuple, List[int]] = {}
+        self._assigned: Dict[int, Tuple] = {}      # particle -> active key
+        self._obs = np.full(self.n_particles, np.nan)
 
     @staticmethod
     def _key(cfg: Dict[str, Any]) -> Tuple:
         return tuple(sorted((k, repr(v)) for k, v in cfg.items()))
 
-    def suggest(self):
-        i = self._next % self.n_particles
-        self._next += 1
-        cfg = _decode_vector(self.x[i], self._cols, self._consts)
-        self._pending.setdefault(self._key(cfg), []).append(i)
-        return cfg
-
-    def observe(self, config, score, budget=None):
-        s = float(score)
-        if self.mode == "min":
-            s = -s
-        fifo = self._pending.get(self._key(config))
-        if not fifo:
-            return                      # observation from another searcher
-        i = fifo.pop(0)
-        if not fifo:
-            del self._pending[self._key(config)]
+    def _step_particle(self, i: int) -> None:
+        """Apply the completed suggestion's best score, then move."""
+        s = self._obs[i]
+        if np.isnan(s):
+            return                      # errored/unreported trial: no move
         if s > self.pbest_score[i]:
             self.pbest_score[i] = s
             self.pbest[i] = self.x[i].copy()
@@ -571,3 +564,35 @@ class PSOSearch(SearchAlgorithm):
                      + self.c2 * r2 * (self.gbest - self.x[i]))
         self.v[i] = np.clip(self.v[i], -self.v_max, self.v_max)
         self.x[i] = np.clip(self.x[i] + self.v[i], 0.0, 1.0)
+
+    def suggest(self):
+        i = self._next % self.n_particles
+        self._next += 1
+        self._step_particle(i)
+        # retire the previous suggestion's routing entry for this particle
+        old = self._assigned.pop(i, None)
+        if old is not None:
+            fifo = self._pending.get(old, [])
+            if i in fifo:
+                fifo.remove(i)
+            if not fifo:
+                self._pending.pop(old, None)
+        cfg = _decode_vector(self.x[i], self._cols, self._consts)
+        key = self._key(cfg)
+        self._pending.setdefault(key, []).append(i)
+        self._assigned[i] = key
+        self._obs[i] = np.nan
+        return cfg
+
+    def observe(self, config, score, budget=None):
+        s = float(score)
+        if self.mode == "min":
+            s = -s
+        fifo = self._pending.get(self._key(config))
+        if not fifo:
+            return                      # observation from another searcher
+        # every pending particle with this key proposed the identical
+        # config, so the result is a valid evaluation for each of them
+        for i in fifo:
+            self._obs[i] = (s if np.isnan(self._obs[i])
+                            else max(self._obs[i], s))
