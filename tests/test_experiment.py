@@ -1,0 +1,133 @@
+"""Experiment manager + hpo_cli tests (SURVEY §2.4 Experiment API/CLI,
+NNI-manager and training-service rows)."""
+import json
+
+import pytest
+
+from tosem_tpu.cluster.kv import KVStore
+from tosem_tpu.hpo_cli import main as hpo_main
+from tosem_tpu.tune.experiment import (ExperimentManager, space_from_json,
+                                       space_to_json)
+from tosem_tpu.tune.search import Choice, LogUniform, RandInt, Uniform
+
+SPEC = {
+    "name": "quad",
+    "trainable": "tosem_tpu.tune.examples:quadratic",
+    "space": {"x": {"type": "uniform", "low": -5, "high": 5},
+              "lr": {"type": "loguniform", "low": 1e-2, "high": 1.0}},
+    "metric": "loss",
+    "mode": "min",
+    "num_samples": 6,
+    "max_iterations": 8,
+    "scheduler": "asha",
+    "search": "random",
+}
+
+
+def test_space_json_roundtrip():
+    space = space_from_json({
+        "a": {"type": "uniform", "low": 0, "high": 1},
+        "b": {"type": "loguniform", "low": 0.1, "high": 10},
+        "c": {"type": "randint", "low": 1, "high": 9},
+        "d": {"type": "choice", "values": ["x", "y"]},
+        "e": 42,
+    })
+    assert isinstance(space["a"], Uniform)
+    assert isinstance(space["b"], LogUniform)
+    assert isinstance(space["c"], RandInt)
+    assert isinstance(space["d"], Choice)
+    assert space["e"] == 42
+    again = space_from_json(space_to_json(space))
+    assert again["a"].low == 0.0 and again["c"].high == 9
+    with pytest.raises(ValueError):
+        space_from_json({"z": {"type": "mystery"}})
+
+
+class TestManagerCRUD:
+    def test_create_validates(self):
+        mgr = ExperimentManager()
+        with pytest.raises(ValueError):
+            mgr.create({"name": "x"})                     # missing fields
+        bad = dict(SPEC, scheduler="nope")
+        with pytest.raises(ValueError):
+            mgr.create(bad)
+        mgr.create(dict(SPEC))
+        with pytest.raises(ValueError):
+            mgr.create(dict(SPEC))                        # duplicate name
+        assert mgr.status("quad")["status"] == "created"
+        assert [e["name"] for e in mgr.list()] == ["quad"]
+        assert mgr.delete("quad") and not mgr.delete("quad")
+
+    def test_state_shared_across_instances(self, tmp_path):
+        path = str(tmp_path / "hpo.db")
+        ExperimentManager(path=path).create(dict(SPEC))
+        other = ExperimentManager(path=path)
+        assert other.spec("quad")["metric"] == "loss"
+
+
+@pytest.mark.slow
+class TestRun:
+    def test_run_records_results(self, tmp_path):
+        mgr = ExperimentManager(path=str(tmp_path / "hpo.db"))
+        mgr.create(dict(SPEC))
+        state = mgr.run("quad")
+        assert state["status"] == "done"
+        assert state["n_trials"] == 6
+        assert -5 <= state["best_config"]["x"] <= 5
+        # raw metric (a loss): positive, and best ≤ every trial's best
+        assert 0 < state["best_score"] < 50.0
+        per_trial = [t["best_score"] for t in state["trials"]
+                     if t["best_score"] is not None]
+        assert state["best_score"] == pytest.approx(min(per_trial))
+        # persisted: a fresh manager sees the finished run
+        again = ExperimentManager(path=str(tmp_path / "hpo.db"))
+        assert again.status("quad")["status"] == "done"
+        assert len(again.results("quad")) == 6
+
+    def test_cli_end_to_end(self, tmp_path, capsys):
+        spec_path = tmp_path / "exp.json"
+        spec_path.write_text(json.dumps(dict(SPEC, name="cli-exp",
+                                             num_samples=4)))
+        db = str(tmp_path / "cli.db")
+        assert hpo_main(["create", "--spec", str(spec_path),
+                         "--db", db]) == 0
+        assert hpo_main(["run", "--name", "cli-exp", "--db", db]) == 0
+        assert hpo_main(["status", "--name", "cli-exp", "--db", db]) == 0
+        out = capsys.readouterr().out
+        assert '"status": "done"' in out
+        assert hpo_main(["results", "--name", "cli-exp", "--db", db,
+                         "--top", "2"]) == 0
+        assert len(capsys.readouterr().out.strip().splitlines()) == 2
+        assert hpo_main(["list", "--db", db]) == 0
+        assert "cli-exp" in capsys.readouterr().out
+        assert hpo_main(["delete", "--name", "cli-exp", "--db", db]) == 0
+
+    def test_failed_run_marks_state(self, tmp_path):
+        mgr = ExperimentManager(path=str(tmp_path / "f.db"))
+        spec = dict(SPEC, name="bad",
+                    trainable="tosem_tpu.tune.examples:does_not_exist")
+        mgr.create(spec)
+        with pytest.raises(AttributeError):
+            mgr.run("bad")
+        assert mgr.status("bad")["status"] == "failed"
+        # lock released: a retry is allowed (and fails the same way)
+        with pytest.raises(AttributeError):
+            mgr.run("bad")
+
+    def test_all_trials_erroring_marks_failed(self, tmp_path):
+        mgr = ExperimentManager(path=str(tmp_path / "e.db"))
+        spec = dict(SPEC, name="allerr", num_samples=2,
+                    trainable="tosem_tpu.tune.examples:always_crashes")
+        mgr.create(spec)
+        with pytest.raises(RuntimeError):
+            mgr.run("allerr")
+        assert mgr.status("allerr")["status"] == "failed"
+
+    def test_concurrent_run_guard(self, tmp_path):
+        mgr = ExperimentManager(path=str(tmp_path / "g.db"))
+        mgr.create(dict(SPEC, name="locked"))
+        # simulate another process holding the run lock
+        from tosem_tpu.tune.experiment import _NS_LOCK
+        assert mgr.kv.cas(_NS_LOCK, "locked", None, b"running")
+        with pytest.raises(RuntimeError, match="already running"):
+            mgr.run("locked")

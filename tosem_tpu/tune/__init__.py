@@ -17,6 +17,8 @@ from tosem_tpu.tune.search import (BOHBSearch, Choice, Domain,
                                    RandomSearch, SearchAlgorithm, TPESearch,
                                    Uniform, choice, grid_search, loguniform,
                                    randint, uniform)
+from tosem_tpu.tune.experiment import (ExperimentManager, space_from_json,
+                                       space_to_json)
 from tosem_tpu.tune.tune import Analysis, Trainable, Trial, run
 
 __all__ = [
@@ -27,4 +29,5 @@ __all__ = [
     "EvolutionSearch", "GPSearch", "BOHBSearch", "PSOSearch",
     "uniform", "loguniform", "randint", "choice", "grid_search",
     "Domain", "Uniform", "LogUniform", "RandInt", "Choice",
+    "ExperimentManager", "space_from_json", "space_to_json",
 ]

@@ -1,0 +1,32 @@
+"""Example trainables for the experiment CLI (the ``nni/examples/trials``
+role: self-contained targets a spec file can reference by import path)."""
+from __future__ import annotations
+
+
+def quadratic(config):
+    """Converging quadratic: loss = (x - 2)^2 shrunk each iteration by a
+    config-controlled rate — exercises schedulers (early iterations are
+    informative) without touching a device."""
+    x = float(config.get("x", 0.0))
+    lr = float(config.get("lr", 0.1))
+    loss = (x - 2.0) ** 2 + 1e-3
+    for _ in range(1000):
+        loss *= (1.0 - min(lr, 0.9) * 0.5)
+        yield {"loss": loss}
+
+
+def always_crashes(config):
+    """Deliberately failing trainable (failure-path tests)."""
+    raise RuntimeError("synthetic trial failure")
+    yield  # pragma: no cover — makes this a generator function
+
+
+def noisy_branin(config):
+    """2-D Branin-like surface for searcher comparisons."""
+    import math
+    x = float(config.get("x", 0.0))
+    y = float(config.get("y", 0.0))
+    val = ((y - 0.1 * x * x + x - 6.0) ** 2
+           + 10.0 * (1 - 1 / (8 * math.pi)) * math.cos(x) + 10.0)
+    while True:
+        yield {"loss": val}

@@ -1,0 +1,243 @@
+"""Experiment manager — the NNI experiment API / ``nnictl`` role.
+
+The reference manages HPO experiments as durable entities (`nni/
+experiment/experiment.py` Experiment.start/resume/stop, `nnictl
+create/status/list` backed by the experiment sqlite + manager service).
+Here an experiment is a JSON spec persisted in the cluster
+:class:`~tosem_tpu.cluster.kv.KVStore`; running one materializes the
+search space / scheduler / search algorithm from their registry names
+and drives :func:`tosem_tpu.tune.run`, writing status transitions and
+the trial table back to the store — so ``status``/``results`` work from
+any process over the shared db file.
+
+Spec schema (JSON/YAML)::
+
+    name: quad-demo
+    trainable: tosem_tpu.tune.examples:quadratic    # module:function/class
+    space:
+      x:   {type: uniform, low: -5, high: 5}
+      lr:  {type: loguniform, low: 1.e-3, high: 1.0}
+      arm: {type: choice, values: [a, b]}
+    metric: loss
+    mode: min
+    num_samples: 16
+    max_iterations: 20
+    scheduler: asha          # fifo|asha|median|pbt|hyperband|curvefit
+    search: tpe              # random|grid|tpe|evolution|gp|bohb|pso
+"""
+from __future__ import annotations
+
+import importlib
+import json
+import time
+from typing import Any, Dict, List, Optional
+
+from tosem_tpu.cluster.kv import KVStore
+from tosem_tpu.tune.schedulers import (ASHAScheduler, CurveFittingAssessor,
+                                       FIFOScheduler, HyperBandScheduler,
+                                       MedianStoppingRule, PBTScheduler)
+from tosem_tpu.tune.search import (BOHBSearch, Choice, EvolutionSearch,
+                                   GPSearch, GridSearch, GridValues,
+                                   LogUniform, PSOSearch, RandInt,
+                                   RandomSearch, TPESearch, Uniform)
+
+_NS_SPEC = "hpo/spec"
+_NS_STATE = "hpo/state"
+_NS_LOCK = "hpo/lock"
+
+SCHEDULERS = {
+    "fifo": FIFOScheduler,
+    "asha": ASHAScheduler,
+    "median": MedianStoppingRule,
+    "pbt": PBTScheduler,
+    "hyperband": HyperBandScheduler,
+    "curvefit": CurveFittingAssessor,
+}
+
+SEARCHERS = {
+    "random": RandomSearch,
+    "grid": GridSearch,
+    "tpe": TPESearch,
+    "evolution": EvolutionSearch,
+    "gp": GPSearch,
+    "bohb": BOHBSearch,
+    "pso": PSOSearch,
+}
+
+
+# ------------------------------------------------- space serialization
+
+def space_from_json(spec: Dict[str, Any]) -> Dict[str, Any]:
+    """JSON search-space description → Domain objects (the
+    ``search_space.json`` convention of the reference)."""
+    out: Dict[str, Any] = {}
+    for key, d in spec.items():
+        if not isinstance(d, dict) or "type" not in d:
+            out[key] = d                       # constant
+            continue
+        t = d["type"]
+        if t == "uniform":
+            out[key] = Uniform(float(d["low"]), float(d["high"]))
+        elif t == "loguniform":
+            out[key] = LogUniform(float(d["low"]), float(d["high"]))
+        elif t == "randint":
+            out[key] = RandInt(int(d["low"]), int(d["high"]))
+        elif t == "choice":
+            out[key] = Choice(list(d["values"]))
+        elif t == "grid":
+            out[key] = GridValues(list(d["values"]))
+        else:
+            raise ValueError(f"unknown domain type {t!r} for {key!r}")
+    return out
+
+
+def space_to_json(space: Dict[str, Any]) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for key, dom in space.items():
+        if isinstance(dom, Uniform):
+            out[key] = {"type": "uniform", "low": dom.low, "high": dom.high}
+        elif isinstance(dom, LogUniform):
+            out[key] = {"type": "loguniform", "low": dom.low,
+                        "high": dom.high}
+        elif isinstance(dom, RandInt):
+            out[key] = {"type": "randint", "low": dom.low, "high": dom.high}
+        elif isinstance(dom, Choice):
+            out[key] = {"type": "choice", "values": list(dom.values)}
+        elif isinstance(dom, GridValues):
+            out[key] = {"type": "grid", "values": list(dom.values)}
+        else:
+            out[key] = dom
+    return out
+
+
+def _resolve_target(ref: str):
+    mod, _, attr = ref.partition(":")
+    if not attr:
+        raise ValueError(f"trainable must be 'module:attr', got {ref!r}")
+    return getattr(importlib.import_module(mod), attr)
+
+
+class ExperimentManager:
+    """CRUD + run over persisted experiment specs."""
+
+    def __init__(self, kv: Optional[KVStore] = None,
+                 path: Optional[str] = None):
+        self.kv = kv or KVStore(path or ":memory:")
+
+    # ----------------------------------------------------------- CRUD
+
+    def create(self, spec: Dict[str, Any]) -> str:
+        name = spec.get("name")
+        if not name:
+            raise ValueError("experiment spec needs a 'name'")
+        for req in ("trainable", "space", "metric", "mode"):
+            if req not in spec:
+                raise ValueError(f"experiment spec needs {req!r}")
+        space_from_json(spec["space"])          # validate early
+        if spec.get("scheduler", "fifo") not in SCHEDULERS:
+            raise ValueError(f"unknown scheduler {spec['scheduler']!r}")
+        if spec.get("search", "random") not in SEARCHERS:
+            raise ValueError(f"unknown search {spec['search']!r}")
+        if not self.kv.cas(_NS_SPEC, name, None,
+                           json.dumps(spec, sort_keys=True).encode()):
+            raise ValueError(f"experiment {name!r} already exists")
+        self._set_state(name, {"status": "created",
+                               "created_at": time.time()})
+        return name
+
+    def list(self) -> List[Dict[str, Any]]:
+        out = []
+        for n in self.kv.keys(_NS_SPEC):
+            try:
+                out.append(dict(self.status(n), name=n))
+            except KeyError:
+                pass            # deleted concurrently by another process
+        return out
+
+    def spec(self, name: str) -> Dict[str, Any]:
+        blob = self.kv.get(_NS_SPEC, name)
+        if blob is None:
+            raise KeyError(f"no experiment {name!r}")
+        return json.loads(blob)
+
+    def status(self, name: str) -> Dict[str, Any]:
+        self.spec(name)                         # existence check
+        blob = self.kv.get(_NS_STATE, name)
+        return json.loads(blob) if blob else {"status": "created"}
+
+    def delete(self, name: str) -> bool:
+        self.kv.delete(_NS_STATE, name)
+        return self.kv.delete(_NS_SPEC, name)
+
+    def results(self, name: str) -> List[Dict[str, Any]]:
+        blob = self.kv.get(_NS_STATE, name)
+        st = json.loads(blob) if blob else {}
+        return st.get("trials", [])
+
+    # ------------------------------------------------------------ run
+
+    def run(self, name: str, verbose: bool = False) -> Dict[str, Any]:
+        from tosem_tpu.tune.tune import run as tune_run
+        spec = self.spec(name)
+        # single-runner guard: CAS on a lock key, so a second concurrent
+        # `run` of the same experiment fails fast instead of clobbering
+        # the first one's results (the nnictl one-manager-per-experiment
+        # invariant)
+        if not self.kv.cas(_NS_LOCK, name, None, b"running"):
+            raise RuntimeError(f"experiment {name!r} is already running")
+        self._set_state(name, {"status": "running",
+                               "started_at": time.time()})
+        try:
+            trainable = _resolve_target(spec["trainable"])
+            space = space_from_json(spec["space"])
+            sched_kw = dict(spec.get("scheduler_args", {}))
+            search_kw = dict(spec.get("search_args", {}))
+            analysis = tune_run(
+                trainable, space,
+                metric=spec["metric"], mode=spec["mode"],
+                num_samples=int(spec.get("num_samples", 10)),
+                max_iterations=int(spec.get("max_iterations", 100)),
+                scheduler=SCHEDULERS[spec.get("scheduler", "fifo")](
+                    **sched_kw),
+                search_alg=SEARCHERS[spec.get("search", "random")](
+                    **search_kw),
+                max_concurrent=int(spec.get("max_concurrent", 4)),
+                verbose=verbose)
+
+            # Trial.best_score is sign-internalized (higher is better);
+            # persist the RAW metric value so status/results read
+            # naturally. best_trial raises when every trial errored —
+            # that must land in the 'failed' state too.
+            sign = -1.0 if spec["mode"] == "min" else 1.0
+
+            def raw(s):
+                return (None if s in (None, float("-inf"), float("inf"))
+                        else float(sign * s))
+
+            trials = [{
+                "trial_id": t.trial_id,
+                "config": t.config,
+                "status": t.status,
+                "iterations": t.iteration,
+                "best_score": raw(t.best_score),
+            } for t in analysis.trials]
+            state = {
+                "status": "done",
+                "ended_at": time.time(),
+                "best_config": analysis.best_config,
+                "best_score": raw(analysis.best_trial.best_score),
+                "n_trials": len(trials),
+                "trials": trials,
+            }
+        except BaseException as e:
+            self._set_state(name, {"status": "failed", "error": repr(e),
+                                   "ended_at": time.time()})
+            raise
+        finally:
+            self.kv.delete(_NS_LOCK, name)
+        self._set_state(name, state)
+        return state
+
+    def _set_state(self, name: str, state: Dict[str, Any]) -> None:
+        self.kv.put(_NS_STATE, name,
+                    json.dumps(state, sort_keys=True, default=str).encode())
