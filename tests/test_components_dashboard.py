@@ -1,0 +1,150 @@
+"""Cyber component model + dashboard tests (SURVEY §2.2 component/DAG +
+timer rows, §2.1 dashboard row)."""
+import json
+import urllib.request
+
+import pytest
+
+from tosem_tpu.dataflow import (Component, ComponentRuntime, TimerComponent)
+from tosem_tpu.obs import (DashboardServer, counter, render_html,
+                           render_text, snapshot)
+
+
+# ---------------------------------------------------------- components
+
+class Fuser(Component):
+    def __init__(self):
+        super().__init__("fuser", ["lidar", "camera"])
+        self.calls = []
+
+    def proc(self, lidar, camera=None):
+        self.calls.append((lidar, camera))
+
+
+class Ticker(TimerComponent):
+    def __init__(self, interval=0.1):
+        super().__init__("ticker", interval)
+        self.fired = []
+
+    def on_init(self, ctx):
+        self.ctx = ctx
+
+    def proc(self):
+        self.fired.append(self.ctx.now)
+
+
+class TestComponents:
+    def test_fused_readers_primary_drives(self):
+        rtc = ComponentRuntime()
+        f = Fuser()
+        rtc.add(f)
+        lidar_w = rtc.writer("lidar")
+        cam_w = rtc.writer("camera")
+        lidar_w("L1")                     # no camera yet → fused None
+        cam_w("C1")                       # secondary alone: no proc
+        lidar_w("L2")                     # fuses latest camera
+        rtc.run_until(1.0)
+        assert f.calls == [("L1", None), ("L2", "C1")]
+
+    def test_timer_component_fires_on_schedule(self):
+        rtc = ComponentRuntime()
+        t = Ticker(interval=0.25)
+        rtc.add(t)
+        rtc.run_until(1.0)
+        assert t.fired == pytest.approx([0.25, 0.5, 0.75, 1.0])
+        rtc.run_until(1.5)                # continues across calls
+        assert len(t.fired) == 6
+
+    def test_event_ordering_deterministic_with_latency(self):
+        rtc = ComponentRuntime()
+        f = Fuser()
+        rtc.add(f)
+        lidar_w = rtc.writer("lidar")
+        cam_w = rtc.writer("camera")
+        cam_w("C-late", latency=0.5)
+        lidar_w("L-early", latency=0.1)
+        lidar_w("L-late", latency=0.9)
+        rtc.run_until(2.0)
+        assert f.calls == [("L-early", None), ("L-late", "C-late")]
+
+    def test_clock_rewind_rejected(self):
+        rtc = ComponentRuntime()
+        rtc.run_until(1.0)
+        with pytest.raises(ValueError):
+            rtc.run_until(0.5)
+
+    def test_channels_discoverable(self):
+        rtc = ComponentRuntime()
+        rtc.add(Fuser())
+        rtc.writer("radar")
+        assert set(rtc.channels()) >= {"lidar", "camera", "radar"}
+
+    def test_timer_pipeline_feeds_component(self):
+        rtc = ComponentRuntime()
+
+        class Source(TimerComponent):
+            def __init__(self):
+                super().__init__("src", 0.2)
+                self.n = 0
+
+            def on_init(self, ctx):
+                self.write = ctx.writer("lidar")
+
+            def proc(self):
+                self.n += 1
+                self.write(f"scan{self.n}")
+
+        f = Fuser()
+        rtc.add(f)
+        rtc.add(Source())
+        rtc.run_until(1.0)
+        assert [c[0] for c in f.calls] == ["scan1", "scan2", "scan3",
+                                           "scan4", "scan5"]
+        assert rtc.proc_counts()["fuser"] == 5
+
+
+# ----------------------------------------------------------- dashboard
+
+class TestDashboard:
+    def test_snapshot_and_renderers(self, tmp_path):
+        c = counter("dash_test_total", "test counter")
+        c.inc(3)
+        snap = snapshot()
+        assert any(m["series"].startswith("dash_test_total")
+                   for m in snap["metrics"])
+        assert snap["memory"]["rss_bytes"] > 0
+        txt = render_text(snap)
+        assert "dash_test_total" in txt and "memory" in txt
+        page = render_html(snap)
+        assert "<html>" in page and "dash_test_total" in page
+
+    def test_malformed_results_csv_degrades(self, tmp_path):
+        bad = tmp_path / "bad.csv"
+        bad.write_text("not,a,results\nschema,at,all\n")
+        snap = snapshot(results_csv=str(bad))
+        assert snap["results"] == []
+        assert "results_error" in snap
+        render_text(snap)                 # must not raise
+        render_html(snap)
+
+    def test_server_endpoints(self, tmp_path):
+        from tosem_tpu.tune.experiment import ExperimentManager
+        db = str(tmp_path / "hpo.db")
+        ExperimentManager(path=db).create({
+            "name": "dash-exp",
+            "trainable": "tosem_tpu.tune.examples:quadratic",
+            "space": {"x": {"type": "uniform", "low": 0, "high": 1}},
+            "metric": "loss", "mode": "min"})
+        srv = DashboardServer(kv_path=db)
+        try:
+            api = json.loads(urllib.request.urlopen(
+                srv.url + "/api", timeout=10).read())
+            assert api["experiments"][0]["name"] == "dash-exp"
+            html_page = urllib.request.urlopen(
+                srv.url + "/", timeout=10).read().decode()
+            assert "dash-exp" in html_page
+            prom = urllib.request.urlopen(
+                srv.url + "/metrics", timeout=10).read().decode()
+            assert "# TYPE" in prom or prom.strip()
+        finally:
+            srv.shutdown()

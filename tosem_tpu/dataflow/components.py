@@ -1,0 +1,168 @@
+"""Cyber-style component model: fused readers + timer components.
+
+The reference's component runtime (`cyber/component/component.h:58-136`
+``Component<M0..M3>`` — proc fires on the primary channel's message with
+the latest fused message from each secondary channel;
+`timer_component.h` — periodic proc; channels resolved through topology
+discovery). TPU-era translation: a **deterministic virtual-time event
+loop** instead of croutine scheduling — messages and timer firings are
+(time, seq) events in one priority queue, so pipelines replay exactly
+(the property record/replay and CI need), while heavy math inside a
+``proc`` stays jitted JAX like everywhere else in the framework.
+
+Channels register in the cluster :class:`~tosem_tpu.cluster.discovery.
+Registry` (kind ``"channel"``), so writers/readers are discoverable the
+way Cyber's topology manager exposes them.
+"""
+from __future__ import annotations
+
+import heapq
+import itertools
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from tosem_tpu.cluster.discovery import Registry
+
+
+class Component:
+    """Fused-reader component (``Component<M0, M1...>`` analog).
+
+    Subclass and override :meth:`proc`. The first declared channel is the
+    primary: ``proc`` runs once per primary message, receiving the
+    message plus the *latest* message seen on each secondary channel
+    (``None`` until one arrives) — Apollo's fusion semantics.
+    """
+
+    def __init__(self, name: str, channels: Sequence[str]):
+        if not channels:
+            raise ValueError("component needs at least one channel")
+        self.name = name
+        self.channels = list(channels)
+
+    def on_init(self, ctx: "ComponentContext") -> None:
+        pass
+
+    def proc(self, primary: Any, *fused: Any) -> None:
+        raise NotImplementedError
+
+
+class TimerComponent:
+    """Periodic component (``timer_component.h`` analog)."""
+
+    def __init__(self, name: str, interval: float):
+        if interval <= 0:
+            raise ValueError("interval must be positive")
+        self.name = name
+        self.interval = float(interval)
+
+    def on_init(self, ctx: "ComponentContext") -> None:
+        pass
+
+    def proc(self) -> None:
+        raise NotImplementedError
+
+
+@dataclass
+class ComponentContext:
+    """Handed to components at init: write access + the current clock."""
+    runtime: "ComponentRuntime"
+
+    def writer(self, channel: str) -> Callable[[Any], None]:
+        return self.runtime.writer(channel, owner="component")
+
+    @property
+    def now(self) -> float:
+        return self.runtime.now
+
+
+class ComponentRuntime:
+    """Deterministic single-process component host.
+
+    Events (message deliveries, timer firings) execute in (time, seq)
+    order on a virtual clock; :meth:`run_until` advances it. Writers
+    enqueue at the current virtual time plus an optional latency.
+    """
+
+    def __init__(self, registry: Optional[Registry] = None):
+        self.registry = registry or Registry()
+        self.now = 0.0
+        self._seq = itertools.count()
+        self._events: List[Tuple[float, int, Callable[[], None]]] = []
+        self._components: List[Any] = []
+        self._latest: Dict[str, Any] = {}          # channel -> last message
+        self._subs: Dict[str, List[Component]] = {}
+        self._stats: Dict[str, int] = {}
+
+    # ------------------------------------------------------- channels
+
+    def writer(self, channel: str, owner: str = "external"
+               ) -> Callable[[Any], None]:
+        """Create a channel writer (``node->CreateWriter`` analog);
+        registers the channel for discovery."""
+        self.registry.register("channel", channel,
+                               {"owner": owner}, unique=False)
+
+        def write(message: Any, *, latency: float = 0.0) -> None:
+            self._push(self.now + max(latency, 0.0),
+                       lambda: self._deliver(channel, message))
+        return write
+
+    def channels(self) -> List[str]:
+        return self.registry.list("channel")
+
+    # ----------------------------------------------------- components
+
+    def add(self, comp: Any) -> None:
+        if isinstance(comp, TimerComponent):
+            self._components.append(comp)
+            comp.on_init(ComponentContext(self))
+            self._schedule_timer(comp, self.now + comp.interval)
+        elif isinstance(comp, Component):
+            self._components.append(comp)
+            for ch in comp.channels:
+                self.registry.register("channel", ch,
+                                       {"owner": "reader"}, unique=False)
+                self._subs.setdefault(ch, [])
+            self._subs[comp.channels[0]].append(comp)
+            comp.on_init(ComponentContext(self))
+        else:
+            raise TypeError(f"not a component: {comp!r}")
+
+    # ------------------------------------------------------ execution
+
+    def _push(self, t: float, fn: Callable[[], None]) -> None:
+        heapq.heappush(self._events, (t, next(self._seq), fn))
+
+    def _schedule_timer(self, comp: TimerComponent, t: float) -> None:
+        def fire():
+            comp.proc()
+            self._stats[comp.name] = self._stats.get(comp.name, 0) + 1
+            self._schedule_timer(comp, t + comp.interval)
+        self._push(t, fire)
+
+    def _deliver(self, channel: str, message: Any) -> None:
+        self._latest[channel] = message
+        for comp in self._subs.get(channel, []):
+            fused = [self._latest.get(ch) for ch in comp.channels[1:]]
+            comp.proc(message, *fused)
+            self._stats[comp.name] = self._stats.get(comp.name, 0) + 1
+
+    def run_until(self, t: float) -> int:
+        """Advance the virtual clock to ``t``; returns events executed.
+        Timer events beyond ``t`` stay queued for the next call. The
+        clock is monotonic: a ``t`` in the past is a caller bug (it
+        would let new writes deliver before already-executed events)."""
+        if t < self.now:
+            raise ValueError(f"run_until({t}) would rewind the clock "
+                             f"(now={self.now})")
+        executed = 0
+        while self._events and self._events[0][0] <= t:
+            when, _, fn = heapq.heappop(self._events)
+            self.now = when
+            fn()
+            executed += 1
+        self.now = t
+        return executed
+
+    def proc_counts(self) -> Dict[str, int]:
+        return dict(self._stats)

@@ -6,6 +6,8 @@ The reference's stats + monitoring plane (``src/ray/stats/metric_defs.h``,
 single-controller topology (SURVEY §5.5).
 """
 from tosem_tpu.obs import metrics
+from tosem_tpu.obs.dashboard import (DashboardServer, render_html,
+                                     render_text, snapshot)
 from tosem_tpu.obs.log_monitor import LogMonitor
 from tosem_tpu.obs.memory_monitor import MemoryMonitor
 from tosem_tpu.obs.metrics import (Counter, Gauge, Histogram, MetricsServer,
@@ -15,5 +17,6 @@ from tosem_tpu.obs.metrics import (Counter, Gauge, Histogram, MetricsServer,
 __all__ = [
     "metrics", "Counter", "Gauge", "Histogram", "Registry", "MetricsServer",
     "counter", "gauge", "histogram", "prometheus_text", "MemoryMonitor",
-    "LogMonitor",
+    "LogMonitor", "DashboardServer", "snapshot", "render_text",
+    "render_html",
 ]

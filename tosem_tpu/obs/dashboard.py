@@ -1,0 +1,168 @@
+"""Cluster/experiment dashboard (the Ray dashboard role, minimal).
+
+The reference ships a web dashboard fed by per-node agents
+(`python/ray/new_dashboard/` — node stats, actor tables, metrics
+graphs). Single-host translation: one :func:`snapshot` gathers runtime
+scheduler stats, the metrics registry, process memory, experiment state
+from the shared KV, and recent study-schema result rows; renderers emit
+plain text (terminal) or a self-contained HTML page; and
+:class:`DashboardServer` serves ``/`` (HTML), ``/api`` (JSON), and
+``/metrics`` (Prometheus text) from a background thread.
+"""
+from __future__ import annotations
+
+import html
+import json
+import time
+from typing import Any, Dict, List, Optional
+
+from tosem_tpu.obs import metrics as _metrics
+from tosem_tpu.obs.memory_monitor import read_available_bytes, read_rss_bytes
+
+
+def snapshot(*, kv_path: Optional[str] = None,
+             results_csv: Optional[str] = None,
+             max_results: int = 20) -> Dict[str, Any]:
+    """One coherent view of the system (the dashboard's data plane)."""
+    snap: Dict[str, Any] = {"timestamp": time.time()}
+
+    try:
+        import tosem_tpu.runtime as rt
+        snap["runtime"] = rt.stats() if rt.is_initialized() else None
+    except Exception as e:           # a dying runtime must not kill the UI
+        snap["runtime"] = {"error": repr(e)}
+
+    snap["memory"] = {"rss_bytes": read_rss_bytes(),
+                      "available_bytes": read_available_bytes()}
+
+    metr: List[Dict[str, Any]] = []
+    for line in _metrics.prometheus_text().splitlines():
+        if line and not line.startswith("#"):
+            name, _, value = line.rpartition(" ")
+            metr.append({"series": name, "value": float(value)})
+    snap["metrics"] = metr
+
+    if kv_path is not None:
+        try:
+            from tosem_tpu.tune.experiment import ExperimentManager
+            snap["experiments"] = [
+                {k: e.get(k) for k in ("name", "status", "best_score",
+                                       "n_trials")}
+                for e in ExperimentManager(path=kv_path).list()]
+        except Exception as e:
+            snap["experiments"] = [{"error": repr(e)}]
+    else:
+        snap["experiments"] = []
+
+    if results_csv is not None:
+        try:
+            from tosem_tpu.utils.results import read_results
+            rows = read_results(results_csv)[-max_results:]
+            snap["results"] = [{k: r.get(k) for k in
+                                ("config", "bench_id", "metric", "value",
+                                 "unit", "device")} for r in rows]
+        except Exception as e:       # a malformed CSV must not 500 the UI
+            snap["results"] = []
+            snap["results_error"] = repr(e)
+    else:
+        snap["results"] = []
+    return snap
+
+
+def render_text(snap: Dict[str, Any]) -> str:
+    lines = [f"== tosem_tpu dashboard @ {time.ctime(snap['timestamp'])}"]
+    rtm = snap.get("runtime")
+    if rtm:
+        lines.append("-- runtime: " + " ".join(
+            f"{k}={v}" for k, v in sorted(rtm.items())))
+    else:
+        lines.append("-- runtime: (not initialized)")
+    mem = snap["memory"]
+    lines.append(f"-- memory: rss={mem['rss_bytes']/1e6:.1f}MB "
+                 f"available={mem['available_bytes']/1e9:.2f}GB")
+    if snap["metrics"]:
+        lines.append("-- metrics:")
+        for m in snap["metrics"]:
+            lines.append(f"   {m['series']} = {m['value']:g}")
+    if snap["experiments"]:
+        lines.append("-- experiments:")
+        for e in snap["experiments"]:
+            lines.append(f"   {e.get('name', '?'):24s} "
+                         f"{e.get('status', '?'):8s} "
+                         f"best={e.get('best_score')}")
+    if snap["results"]:
+        lines.append("-- recent results:")
+        for r in snap["results"]:
+            val = r.get("value")
+            val_s = f"{val:.4g}" if isinstance(val, (int, float)) else "?"
+            lines.append(f"   {str(r.get('bench_id')):28s} "
+                         f"{str(r.get('metric')):16s} "
+                         f"{val_s} {r.get('unit') or ''}")
+    return "\n".join(lines)
+
+
+def _table(rows: List[Dict[str, Any]], cols: List[str]) -> str:
+    if not rows:
+        return "<p><em>none</em></p>"
+    head = "".join(f"<th>{html.escape(c)}</th>" for c in cols)
+    body = "".join(
+        "<tr>" + "".join(f"<td>{html.escape(str(r.get(c, '')))}</td>"
+                         for c in cols) + "</tr>"
+        for r in rows)
+    return f"<table><tr>{head}</tr>{body}</table>"
+
+
+def render_html(snap: Dict[str, Any]) -> str:
+    rtm = snap.get("runtime") or {}
+    rt_rows = [{"key": k, "value": v} for k, v in sorted(rtm.items())]
+    mem = snap["memory"]
+    return f"""<!doctype html>
+<html><head><title>tosem_tpu dashboard</title>
+<style>
+ body {{ font-family: monospace; margin: 2em; }}
+ table {{ border-collapse: collapse; margin: 0.5em 0 1.5em; }}
+ th, td {{ border: 1px solid #999; padding: 2px 8px; text-align: left; }}
+ h2 {{ margin-bottom: 0.2em; }}
+</style></head><body>
+<h1>tosem_tpu dashboard</h1>
+<p>{html.escape(time.ctime(snap['timestamp']))} &mdash;
+rss {mem['rss_bytes']/1e6:.1f} MB, available
+{mem['available_bytes']/1e9:.2f} GB</p>
+<h2>Runtime</h2>{_table(rt_rows, ["key", "value"])}
+<h2>Metrics</h2>{_table(snap['metrics'], ["series", "value"])}
+<h2>Experiments</h2>{_table(snap['experiments'],
+                            ["name", "status", "best_score", "n_trials"])}
+<h2>Recent results</h2>{_table(snap['results'],
+                               ["config", "bench_id", "metric", "value",
+                                "unit", "device"])}
+</body></html>"""
+
+
+class DashboardServer:
+    """Serves the dashboard over HTTP (shared RouteServer scaffold)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, *,
+                 kv_path: Optional[str] = None,
+                 results_csv: Optional[str] = None):
+        from tosem_tpu.obs.httpd import RouteServer
+        kw = {"kv_path": kv_path, "results_csv": results_csv}
+
+        def route(path: str):
+            if path.startswith("/metrics"):
+                return (200, "text/plain; version=0.0.4",
+                        _metrics.prometheus_text().encode())
+            if path.startswith("/api"):
+                return (200, "application/json",
+                        json.dumps(snapshot(**kw)).encode())
+            return (200, "text/html", render_html(snapshot(**kw)).encode())
+
+        self._server = RouteServer(route, host, port,
+                                   name="tosem-dashboard")
+        self.host, self.port = self._server.host, self._server.port
+
+    @property
+    def url(self) -> str:
+        return self._server.url
+
+    def shutdown(self) -> None:
+        self._server.shutdown()

@@ -195,33 +195,19 @@ class MetricsServer:
 
     def __init__(self, registry: Optional[Registry] = None,
                  host: str = "127.0.0.1", port: int = 0):
-        import http.server
+        from tosem_tpu.obs.httpd import RouteServer
         reg = registry or DEFAULT
 
-        class _H(http.server.BaseHTTPRequestHandler):
-            def log_message(self, *a):
-                pass
+        def route(path):
+            return (200, "text/plain; version=0.0.4",
+                    reg.prometheus_text().encode())
 
-            def do_GET(self):
-                body = reg.prometheus_text().encode()
-                self.send_response(200)
-                self.send_header("Content-Type",
-                                 "text/plain; version=0.0.4")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-
-        self._server = http.server.ThreadingHTTPServer((host, port), _H)
-        self.host, self.port = self._server.server_address[:2]
-        self._thread = threading.Thread(target=self._server.serve_forever,
-                                        daemon=True, name="metrics-http")
-        self._thread.start()
+        self._server = RouteServer(route, host, port, name="metrics-http")
+        self.host, self.port = self._server.host, self._server.port
 
     @property
     def url(self) -> str:
-        return f"http://{self.host}:{self.port}/metrics"
+        return f"{self._server.url}/metrics"
 
     def shutdown(self) -> None:
         self._server.shutdown()
-        self._server.server_close()
-        self._thread.join(timeout=2.0)
