@@ -1,0 +1,127 @@
+"""RPC control plane + node agent tests (SURVEY §2.1 RPC-layer row,
+§2.8 gRPC-control-plane row, §3 cross-host story)."""
+import os
+import pickle
+import threading
+
+import pytest
+
+TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+
+from tosem_tpu.cluster import RemoteNode, RpcClient, RpcError, RpcServer
+
+# module-level so spawn-mode agent workers can import them
+def square(x):
+    return x * x
+
+
+def boom(_x):
+    raise ValueError("synthetic remote failure")
+
+
+class MathService:
+    def add(self, a, b):
+        return a + b
+
+    def fail(self):
+        raise RuntimeError("service error")
+
+    def _private(self):
+        return "hidden"
+
+
+class TestRpc:
+    def test_dict_and_object_handlers(self):
+        srv = RpcServer({"echo": lambda x: x})
+        try:
+            with RpcClient(srv.address) as c:
+                assert c.call("echo", {"deep": [1, 2, 3]}) == \
+                    {"deep": [1, 2, 3]}
+        finally:
+            srv.shutdown()
+        srv2 = RpcServer(MathService())
+        try:
+            with RpcClient(srv2.address) as c:
+                assert c.add(20, 22) == 42          # attribute sugar
+                with pytest.raises(RpcError, match="service error") as ei:
+                    c.fail()
+                assert "RuntimeError" in ei.value.remote_traceback
+                with pytest.raises(RpcError, match="no such RPC method"):
+                    c.call("_private")
+        finally:
+            srv2.shutdown()
+
+    def test_many_sequential_and_concurrent_calls(self):
+        srv = RpcServer({"inc": lambda x: x + 1})
+        try:
+            c = RpcClient(srv.address)
+            for i in range(200):
+                assert c.call("inc", i) == i + 1
+            c.close()
+            # concurrent clients over separate connections
+            errs = []
+
+            def worker():
+                try:
+                    with RpcClient(srv.address) as cc:
+                        for i in range(50):
+                            assert cc.call("inc", i) == i + 1
+                except Exception as e:
+                    errs.append(e)
+            ts = [threading.Thread(target=worker) for _ in range(4)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            assert not errs
+        finally:
+            srv.shutdown()
+
+    def test_dead_server_raises_connection_error(self):
+        srv = RpcServer({"ping": lambda: "pong"})
+        c = RpcClient(srv.address)
+        assert c.call("ping") == "pong"
+        srv.shutdown()
+        with pytest.raises(ConnectionError):
+            for _ in range(3):          # first call may drain a buffer
+                c.call("ping")
+
+
+@pytest.mark.slow
+class TestNodeAgent:
+    def test_spawn_submit_map_stats(self):
+        node = RemoteNode.spawn_local(num_workers=2, extra_sys_path=[TESTS_DIR])
+        try:
+            assert node.alive()
+            assert node.submit(square, 7) == 49
+            assert node.map(square, range(6)) == [0, 1, 4, 9, 16, 25]
+            st = node.stats()
+            assert st["num_workers"] == 2 and st["tasks_done"] == 7
+            with pytest.raises(RpcError, match="synthetic remote failure"):
+                node.submit(boom, 1)
+        finally:
+            node.close()
+
+    def test_node_failure_detected(self):
+        node = RemoteNode.spawn_local(num_workers=1, extra_sys_path=[TESTS_DIR])
+        try:
+            assert node.submit(square, 3) == 9
+            node.kill()                 # simulated host loss
+            assert not node.alive()
+            with pytest.raises(ConnectionError):
+                node.submit(square, 3)
+        finally:
+            node.close()
+
+    def test_two_nodes_independent(self):
+        a = RemoteNode.spawn_local(num_workers=1, extra_sys_path=[TESTS_DIR])
+        b = RemoteNode.spawn_local(num_workers=1, extra_sys_path=[TESTS_DIR])
+        try:
+            assert a.submit(square, 2) == 4
+            assert b.submit(square, 3) == 9
+            a.kill()
+            assert not a.alive() and b.alive()
+            assert b.submit(square, 5) == 25    # survivor unaffected
+        finally:
+            a.close()
+            b.close()

@@ -1,0 +1,208 @@
+"""Node agent — the per-host daemon of the cross-host control plane.
+
+The reference's raylet/node-manager answers driver RPCs to lease
+workers, execute tasks, and report health (`src/ray/raylet/
+node_manager.cc` + NodeManagerService). Here a :class:`NodeAgent` is a
+standalone process hosting a spawn-mode process pool; the driver talks
+to it through :class:`~tosem_tpu.cluster.rpc.RpcClient` via
+:class:`RemoteNode` (submit/map/health/stats), and
+:func:`RemoteNode.spawn_local` boots one as a subprocess for tests and
+single-box multi-daemon topologies (`cluster_utils` style). Functions
+ship as pickled blobs, so the remote side needs the same code
+importable — the multiprocessing-spawn contract, cluster-wide.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import subprocess
+import sys
+import time
+from concurrent.futures import ProcessPoolExecutor
+from typing import Any, Callable, Dict, List, Optional
+
+from tosem_tpu.cluster.rpc import RpcClient, RpcServer
+
+
+def _run_blob(blob: bytes) -> bytes:
+    fn, args, kwargs = pickle.loads(blob)
+    return pickle.dumps(fn(*args, **kwargs))
+
+
+class _AgentHandlers:
+    """RPC surface of one node (the NodeManagerService analog)."""
+
+    def __init__(self, num_workers: int):
+        import multiprocessing as mp
+        self._pool = ProcessPoolExecutor(
+            max_workers=num_workers, mp_context=mp.get_context("spawn"))
+        self._num_workers = num_workers
+        self._started = time.time()
+        self._tasks_done = 0
+
+    def health(self) -> Dict[str, Any]:
+        return {"ok": True, "pid": os.getpid(),
+                "uptime_s": time.time() - self._started}
+
+    def stats(self) -> Dict[str, Any]:
+        return {"num_workers": self._num_workers,
+                "tasks_done": self._tasks_done}
+
+    def run_task(self, blob: bytes) -> bytes:
+        out = self._pool.submit(_run_blob, blob).result()
+        self._tasks_done += 1
+        return out
+
+    def run_batch(self, blobs: List[bytes]) -> List[bytes]:
+        futs = [self._pool.submit(_run_blob, b) for b in blobs]
+        outs = [f.result() for f in futs]
+        self._tasks_done += len(outs)
+        return outs
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=False, cancel_futures=True)
+
+
+def serve(port: int = 0, num_workers: int = 2,
+          announce_fd: Optional[int] = None,
+          extra_sys_path: Optional[List[str]] = None) -> None:
+    """Run a node agent until killed (the daemon entry point).
+    ``extra_sys_path`` makes caller code importable here and in the
+    spawn-mode pool workers (multiprocessing forwards sys.path)."""
+    for p in extra_sys_path or []:
+        if p not in sys.path:
+            sys.path.insert(0, p)
+    handlers = _AgentHandlers(num_workers)
+    server = RpcServer(handlers, port=port)
+    line = f"{server.address}\n".encode()
+    if announce_fd is not None:
+        os.write(announce_fd, line)
+        os.close(announce_fd)
+    else:
+        sys.stdout.write(line.decode())
+        sys.stdout.flush()
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.shutdown()
+        handlers.close()
+
+
+class RemoteNode:
+    """Driver-side handle to a node agent."""
+
+    def __init__(self, address: str, timeout: float = 60.0):
+        self.address = address
+        self._client = RpcClient(address, timeout=timeout)
+        self._proc: Optional[subprocess.Popen] = None
+
+    # -- control plane -------------------------------------------------
+
+    def health(self) -> Dict[str, Any]:
+        return self._client.call("health")
+
+    def stats(self) -> Dict[str, Any]:
+        return self._client.call("stats")
+
+    def alive(self) -> bool:
+        try:
+            return bool(self.health().get("ok"))
+        except Exception:
+            return False
+
+    # -- data plane ----------------------------------------------------
+
+    def submit(self, fn: Callable, *args, **kwargs) -> Any:
+        blob = pickle.dumps((fn, args, kwargs))
+        return pickle.loads(self._client.call("run_task", blob))
+
+    def map(self, fn: Callable, items) -> List[Any]:
+        blobs = [pickle.dumps((fn, (it,), {})) for it in items]
+        return [pickle.loads(b)
+                for b in self._client.call("run_batch", blobs)]
+
+    # -- lifecycle -----------------------------------------------------
+
+    @classmethod
+    def spawn_local(cls, num_workers: int = 2,
+                    startup_timeout: float = 60.0,
+                    extra_sys_path: Optional[List[str]] = None
+                    ) -> "RemoteNode":
+        """Boot an agent subprocess on this host and connect to it."""
+        r, w = os.pipe()
+        env = dict(os.environ)
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get(
+            "PYTHONPATH", "")
+        path_args = []
+        for p in extra_sys_path or []:
+            path_args += ["--path", p]
+        # -c (not -m): runpy re-executing an already-imported module
+        # warns and can double-run module state
+        proc = subprocess.Popen(
+            [sys.executable, "-c",
+             "from tosem_tpu.cluster.node import main; main()",
+             "--num-workers", str(num_workers), "--announce-fd", str(w),
+             *path_args],
+            pass_fds=(w,), env=env)
+        os.close(w)
+        line = b""
+        deadline = time.monotonic() + startup_timeout
+        with os.fdopen(r, "rb") as f:
+            while time.monotonic() < deadline and not line.endswith(b"\n"):
+                chunk = f.readline()
+                if not chunk:
+                    break
+                line += chunk
+        if not line:
+            proc.kill()
+            raise RuntimeError("node agent failed to announce its address")
+        node = cls(line.decode().strip())
+        node._proc = proc
+        return node
+
+    def kill(self) -> None:
+        """Simulated node failure."""
+        if self._proc is not None and self._proc.poll() is None:
+            self._proc.kill()
+            self._proc.wait()
+        self._client.close()
+
+    def close(self) -> None:
+        self._client.close()
+        if self._proc is not None and self._proc.poll() is None:
+            self._proc.terminate()
+            try:
+                self._proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self._proc.kill()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    port, num_workers, announce_fd = 0, 2, None
+    paths: List[str] = []
+    i = 0
+    while i < len(args):
+        if args[i] == "--port":
+            port = int(args[i + 1]); i += 2
+        elif args[i] == "--num-workers":
+            num_workers = int(args[i + 1]); i += 2
+        elif args[i] == "--announce-fd":
+            announce_fd = int(args[i + 1]); i += 2
+        elif args[i] == "--path":
+            paths.append(args[i + 1]); i += 2
+        else:
+            print(f"unknown arg {args[i]}", file=sys.stderr)
+            return 2
+    serve(port=port, num_workers=num_workers, announce_fd=announce_fd,
+          extra_sys_path=paths)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

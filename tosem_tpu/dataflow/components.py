@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import heapq
 import itertools
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from tosem_tpu.cluster.discovery import Registry
@@ -135,9 +135,12 @@ class ComponentRuntime:
 
     def _schedule_timer(self, comp: TimerComponent, t: float) -> None:
         def fire():
-            comp.proc()
-            self._stats[comp.name] = self._stats.get(comp.name, 0) + 1
+            # reschedule BEFORE proc: a raising proc must not silently
+            # unschedule the timer (message components stay subscribed
+            # through failures; timers get the same semantics)
             self._schedule_timer(comp, t + comp.interval)
+            self._stats[comp.name] = self._stats.get(comp.name, 0) + 1
+            comp.proc()
         self._push(t, fire)
 
     def _deliver(self, channel: str, message: Any) -> None:

@@ -22,7 +22,8 @@ from tosem_tpu.obs.memory_monitor import read_available_bytes, read_rss_bytes
 
 def snapshot(*, kv_path: Optional[str] = None,
              results_csv: Optional[str] = None,
-             max_results: int = 20) -> Dict[str, Any]:
+             max_results: int = 20,
+             experiments_manager: Any = None) -> Dict[str, Any]:
     """One coherent view of the system (the dashboard's data plane)."""
     snap: Dict[str, Any] = {"timestamp": time.time()}
 
@@ -42,13 +43,16 @@ def snapshot(*, kv_path: Optional[str] = None,
             metr.append({"series": name, "value": float(value)})
     snap["metrics"] = metr
 
-    if kv_path is not None:
+    mgr = experiments_manager
+    if mgr is None and kv_path is not None:
+        from tosem_tpu.tune.experiment import ExperimentManager
+        mgr = ExperimentManager(path=kv_path)
+    if mgr is not None:
         try:
-            from tosem_tpu.tune.experiment import ExperimentManager
             snap["experiments"] = [
                 {k: e.get(k) for k in ("name", "status", "best_score",
                                        "n_trials")}
-                for e in ExperimentManager(path=kv_path).list()]
+                for e in mgr.list()]
         except Exception as e:
             snap["experiments"] = [{"error": repr(e)}]
     else:
@@ -145,7 +149,13 @@ class DashboardServer:
                  kv_path: Optional[str] = None,
                  results_csv: Optional[str] = None):
         from tosem_tpu.obs.httpd import RouteServer
-        kw = {"kv_path": kv_path, "results_csv": results_csv}
+        mgr = None
+        if kv_path is not None:
+            # one manager (one sqlite connection) for the server's life,
+            # not a fresh connect + DDL per request
+            from tosem_tpu.tune.experiment import ExperimentManager
+            mgr = ExperimentManager(path=kv_path)
+        kw = {"results_csv": results_csv, "experiments_manager": mgr}
 
         def route(path: str):
             if path.startswith("/metrics"):
