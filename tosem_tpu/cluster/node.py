@@ -33,11 +33,15 @@ class _AgentHandlers:
     """RPC surface of one node (the NodeManagerService analog)."""
 
     def __init__(self, num_workers: int):
+        import itertools
         import multiprocessing as mp
+        import threading
         self._pool = ProcessPoolExecutor(
             max_workers=num_workers, mp_context=mp.get_context("spawn"))
         self._num_workers = num_workers
         self._started = time.time()
+        # connections are served on separate threads: count atomically
+        self._done_lock = threading.Lock()
         self._tasks_done = 0
 
     def health(self) -> Dict[str, Any]:
@@ -50,13 +54,15 @@ class _AgentHandlers:
 
     def run_task(self, blob: bytes) -> bytes:
         out = self._pool.submit(_run_blob, blob).result()
-        self._tasks_done += 1
+        with self._done_lock:
+            self._tasks_done += 1
         return out
 
     def run_batch(self, blobs: List[bytes]) -> List[bytes]:
         futs = [self._pool.submit(_run_blob, b) for b in blobs]
         outs = [f.result() for f in futs]
-        self._tasks_done += len(outs)
+        with self._done_lock:
+            self._tasks_done += len(outs)
         return outs
 
     def close(self) -> None:
@@ -96,6 +102,7 @@ class RemoteNode:
 
     def __init__(self, address: str, timeout: float = 60.0):
         self.address = address
+        # unbounded call timeout: remote tasks may legitimately run long
         self._client = RpcClient(address, timeout=timeout)
         self._proc: Optional[subprocess.Popen] = None
 
@@ -107,9 +114,14 @@ class RemoteNode:
     def stats(self) -> Dict[str, Any]:
         return self._client.call("stats")
 
-    def alive(self) -> bool:
+    def alive(self, timeout: float = 5.0) -> bool:
+        # a bounded, independent probe connection: a long task holding
+        # the main client's lock (or a wedged agent) must not make the
+        # liveness check hang or lie
         try:
-            return bool(self.health().get("ok"))
+            with RpcClient(self.address, timeout=timeout,
+                           call_timeout=timeout) as probe:
+                return bool(probe.call("health").get("ok"))
         except Exception:
             return False
 
@@ -150,17 +162,30 @@ class RemoteNode:
              *path_args],
             pass_fds=(w,), env=env)
         os.close(w)
+        # select-bounded read: a wedged child (stuck import, bind
+        # deadlock) must not block past startup_timeout
+        import select
         line = b""
         deadline = time.monotonic() + startup_timeout
-        with os.fdopen(r, "rb") as f:
-            while time.monotonic() < deadline and not line.endswith(b"\n"):
-                chunk = f.readline()
-                if not chunk:
+        try:
+            while not line.endswith(b"\n"):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
                     break
+                ready, _, _ = select.select([r], [], [], remaining)
+                if not ready:
+                    break
+                chunk = os.read(r, 256)
+                if not chunk:
+                    break                    # EOF: child died pre-announce
                 line += chunk
-        if not line:
+        finally:
+            os.close(r)
+        if not line.endswith(b"\n"):
             proc.kill()
-            raise RuntimeError("node agent failed to announce its address")
+            proc.wait()
+            raise RuntimeError("node agent failed to announce its address "
+                               f"within {startup_timeout}s")
         node = cls(line.decode().strip())
         node._proc = proc
         return node

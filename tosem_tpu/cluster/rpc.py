@@ -131,12 +131,20 @@ class RpcServer:
 
 class RpcClient:
     """Persistent-connection caller; thread-safe (one in-flight call at
-    a time per client, the simple-stub model)."""
+    a time per client, the simple-stub model).
 
-    def __init__(self, address: str, timeout: float = 30.0):
+    ``timeout`` bounds connection establishment; ``call_timeout`` bounds
+    each request/response round trip (None = wait forever — the right
+    default for long-running remote tasks; pass a bound for health
+    probes so a wedged peer can't hang the caller).
+    """
+
+    def __init__(self, address: str, timeout: float = 30.0,
+                 call_timeout: Optional[float] = None):
         host, _, port = address.rpartition(":")
         self._addr = (host or "127.0.0.1", int(port))
         self._timeout = timeout
+        self._call_timeout = call_timeout
         self._lock = threading.Lock()
         self._sock: Optional[socket.socket] = None
 
@@ -144,6 +152,9 @@ class RpcClient:
         if self._sock is None:
             s = socket.create_connection(self._addr, timeout=self._timeout)
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            # the connect timeout must not linger as a read timeout: a
+            # slow handler is not a dead peer
+            s.settimeout(self._call_timeout)
             self._sock = s
         return self._sock
 
@@ -153,6 +164,10 @@ class RpcClient:
                 sock = self._connect()
                 _send_frame(sock, (method, args, kwargs))
                 status, *rest = _recv_frame(sock)
+            except socket.timeout:
+                self.close()
+                raise TimeoutError(
+                    f"rpc to {self._addr} timed out ({method})")
             except (ConnectionError, OSError):
                 self.close()
                 raise ConnectionError(

@@ -137,10 +137,11 @@ class ComponentRuntime:
         def fire():
             # reschedule BEFORE proc: a raising proc must not silently
             # unschedule the timer (message components stay subscribed
-            # through failures; timers get the same semantics)
+            # through failures; timers get the same semantics). Stats
+            # count successful procs only, matching _deliver.
             self._schedule_timer(comp, t + comp.interval)
-            self._stats[comp.name] = self._stats.get(comp.name, 0) + 1
             comp.proc()
+            self._stats[comp.name] = self._stats.get(comp.name, 0) + 1
         self._push(t, fire)
 
     def _deliver(self, channel: str, message: Any) -> None:

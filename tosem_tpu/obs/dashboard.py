@@ -43,20 +43,20 @@ def snapshot(*, kv_path: Optional[str] = None,
             metr.append({"series": name, "value": float(value)})
     snap["metrics"] = metr
 
-    mgr = experiments_manager
-    if mgr is None and kv_path is not None:
-        from tosem_tpu.tune.experiment import ExperimentManager
-        mgr = ExperimentManager(path=kv_path)
-    if mgr is not None:
-        try:
+    try:
+        mgr = experiments_manager
+        if mgr is None and kv_path is not None:
+            from tosem_tpu.tune.experiment import ExperimentManager
+            mgr = ExperimentManager(path=kv_path)
+        if mgr is not None:
             snap["experiments"] = [
                 {k: e.get(k) for k in ("name", "status", "best_score",
                                        "n_trials")}
                 for e in mgr.list()]
-        except Exception as e:
-            snap["experiments"] = [{"error": repr(e)}]
-    else:
-        snap["experiments"] = []
+        else:
+            snap["experiments"] = []
+    except Exception as e:       # bad/locked db must not kill the UI
+        snap["experiments"] = [{"error": repr(e)}]
 
     if results_csv is not None:
         try:
@@ -152,10 +152,15 @@ class DashboardServer:
         mgr = None
         if kv_path is not None:
             # one manager (one sqlite connection) for the server's life,
-            # not a fresh connect + DDL per request
-            from tosem_tpu.tune.experiment import ExperimentManager
-            mgr = ExperimentManager(path=kv_path)
-        kw = {"results_csv": results_csv, "experiments_manager": mgr}
+            # not a fresh connect + DDL per request; a bad path degrades
+            # to snapshot's per-request error row instead of failing boot
+            try:
+                from tosem_tpu.tune.experiment import ExperimentManager
+                mgr = ExperimentManager(path=kv_path)
+            except Exception:
+                mgr = None
+        kw = {"results_csv": results_csv, "experiments_manager": mgr,
+              "kv_path": kv_path if mgr is None else None}
 
         def route(path: str):
             if path.startswith("/metrics"):
