@@ -56,6 +56,24 @@ def test_kill_one_process_detected():
 
 
 @pytest.mark.slow
+def test_cross_process_collective_sweep():
+    c = _mk(n=2, dev=2)
+    try:
+        res = c.run("tosem_tpu.parallel.jobs:collective_sweep_job",
+                    kwargs={"sizes": [1 << 14], "n_iter": 4, "reps": 1},
+                    timeout=240)
+        assert res.ok, (res, c.log(0), c.log(1))
+        out = res.results[0]["out"]
+        assert out["n_processes"] == 2 and out["n_devices"] == 4
+        assert len(out["rows"]) == 2            # all_reduce + all_gather
+        for row in out["rows"]:
+            assert row["bus_bw_gbps"] > 0
+        assert os.path.exists(os.path.join(c.workdir, "dcn_sweep.csv"))
+    finally:
+        c.stop()
+
+
+@pytest.mark.slow
 def test_elastic_restart_resumes_from_checkpoint():
     c = _mk()
     try:

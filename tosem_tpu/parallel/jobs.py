@@ -1,0 +1,59 @@
+"""Multi-process cluster jobs shipped with the framework.
+
+Job targets for :class:`~tosem_tpu.parallel.cluster.LocalCluster` — the
+cross-host analogs of the single-process benchmarks. Living in the
+package (not a test file) because cluster workers import jobs by
+``module:function`` name, and because the DCN-path evidence these
+produce belongs to the framework's bench surface (SURVEY §5.8: the
+reference sweeps NCCL *and* Gloo; the in-process ICI sweep lives in
+``parallel/collectives.py``, this is its cross-process Gloo/DCN twin).
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, Sequence
+
+
+def collective_sweep_job(workdir: str,
+                         sizes: Sequence[int] = (1 << 16, 1 << 20),
+                         names: Sequence[str] = ("all_reduce",
+                                                 "all_gather"),
+                         n_iter: int = 10,
+                         reps: int = 2) -> Dict:
+    """Cross-process collective bandwidth sweep over the global mesh.
+
+    Every rank executes the identical program (SPMD: ``n_iter``/``reps``
+    are pinned — adaptive growth would diverge across ranks and deadlock
+    the collective); rank 0 persists the study-schema CSV.
+    """
+    if n_iter <= 0 or reps <= 0:
+        # n_iter=0 would re-enable DeviceLoopBench's adaptive growth,
+        # which picks trip counts per rank — divergent SPMD programs
+        # deadlock the collective
+        raise ValueError("n_iter and reps must be positive (pinned "
+                         "identically on every rank)")
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from tosem_tpu.parallel.collectives import (CollectiveSpec,
+                                                collective_bench)
+    from tosem_tpu.utils.results import ResultWriter
+
+    mesh = Mesh(np.array(jax.devices()), ("x",))
+    rows = []
+    for name in names:
+        for b in sizes:
+            spec = CollectiveSpec(name=name, bytes_per_device=int(b))
+            row = collective_bench(spec, mesh, n_iter=n_iter, reps=reps)
+            row.config = "dcn_collective_sweep"
+            row.extra["n_processes"] = jax.process_count()
+            rows.append(row)
+    if jax.process_index() == 0:
+        w = ResultWriter(os.path.join(workdir, "dcn_sweep.csv"))
+        w.add_many(rows)
+        w.flush()
+    return {"rows": [{"bench_id": r.bench_id, "bus_bw_gbps": r.value,
+                      "time_us": r.extra["time_us"]} for r in rows],
+            "n_processes": jax.process_count(),
+            "n_devices": jax.device_count()}
