@@ -1,0 +1,84 @@
+"""HTM network engine tests (SURVEY §2.5 Network-engine row)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tosem_tpu.models.htm import HTMModel
+from tosem_tpu.models.htm_network import (AnomalyLikelihoodRegion,
+                                          ClassifierRegion, Network, Region,
+                                          ScalarEncoderRegion, SPRegion,
+                                          TMRegion, anomaly_network)
+from tosem_tpu.models.htm import SPParams
+
+
+def test_link_validation():
+    net = Network()
+    net.add_region("enc", ScalarEncoderRegion(0, 1, n_bits=64, n_active=5))
+    net.add_region("sp", SPRegion(jax.random.key(0), SPParams(
+        n_inputs=64, n_columns=64, n_active_columns=4)))
+    with pytest.raises(ValueError):
+        net.link("enc", "nope", "sp", "sdr")
+    with pytest.raises(ValueError):
+        net.link("enc", "sdr", "sp", "nope")
+    with pytest.raises(KeyError):
+        net.link("missing", "sdr", "sp", "sdr")
+    net.link("enc", "sdr", "sp", "sdr")
+    with pytest.raises(ValueError):
+        net.add_region("enc", ScalarEncoderRegion(0, 1))
+
+
+def test_cycle_detected():
+    class Loop(Region):
+        inputs = ("x",)
+        outputs = ("x",)
+
+        def compute(self, inputs, *, learn=True):
+            return {"x": inputs["x"]}
+
+    net = Network()
+    net.add_region("a", Loop())
+    net.add_region("b", Loop())
+    net.link("a", "x", "b", "x")
+    net.link("b", "x", "a", "x")
+    with pytest.raises(ValueError, match="cycle"):
+        net.run_step({"x": 1})
+    with pytest.raises(ValueError, match="cycle"):
+        net.link("a", "x", "a", "x")          # self-link rejected early
+
+
+def test_network_matches_monolithic_htmmodel():
+    # HTMModel IS the canonical network; composition must be bit-equal
+    sig = np.sin(np.arange(150) / 6.0) * 2.0
+    sig[120:123] += 5.0
+    model = HTMModel(jax.random.key(7), minval=-3, maxval=8,
+                     n_bits=128, n_active_bits=9, n_columns=128,
+                     n_active_columns=6, cells_per_column=4)
+    net = anomaly_network(jax.random.key(7), minval=-3, maxval=8,
+                          n_bits=128, n_active_bits=9, n_columns=128,
+                          n_active_columns=6, cells_per_column=4)
+    for v in sig:
+        want = model.run(float(v))
+        got = net.run_step({"value": float(v)})
+        assert got["tm"]["anomaly_score"] == pytest.approx(
+            want["anomaly_score"])
+        assert got["likelihood"]["anomaly_likelihood"] == pytest.approx(
+            want["anomaly_likelihood"])
+
+
+def test_classifier_region_learns_sequence():
+    # repeating sequence: after training, the TM cell SDR predicts the
+    # current bucket with high accuracy
+    net = anomaly_network(jax.random.key(1), minval=0, maxval=4,
+                          n_bits=128, n_active_bits=9, n_columns=128,
+                          n_active_columns=6, cells_per_column=4)
+    net.add_region("clf", ClassifierRegion(n_inputs=128 * 4, n_buckets=4))
+    net.link("tm", "active_cells", "clf", "active_cells")
+    seq = [0, 1, 2, 3] * 40
+    correct = total = 0
+    for i, b in enumerate(seq):
+        out = net.run_step({"value": float(b), "bucket": b})
+        if i > 120:
+            total += 1
+            correct += out["clf"]["predicted_bucket"] == b
+    assert correct / total > 0.8

@@ -22,20 +22,8 @@ def default_plugin() -> Optional[str]:
     return path if os.path.exists(path) else None
 
 
-def tunnel_alive(port: int = 8083, timeout: float = 2.0) -> bool:
-    """Probe the axon relay's stateless port. The tunnel can drop for the
-    whole box (relay stops listening); callers should skip hardware runs
-    rather than hang in the plugin's dial-retry loop."""
-    import socket
-    s = socket.socket()
-    s.settimeout(timeout)
-    try:
-        s.connect(("127.0.0.1", port))
-        return True
-    except OSError:
-        return False
-    finally:
-        s.close()
+# single source of truth for the relay probe (bench.py shares it)
+from tosem_tpu.utils.net import tunnel_alive  # noqa: E402,F401
 
 
 def _axon_setup(plugin: str):

@@ -1,0 +1,210 @@
+"""HTM network engine: regions linked into a dataflow graph.
+
+The NuPIC network API (`nupic/engine/network.py` Network.addRegion/
+link/run, `nupic/regions/` SPRegion/TMRegion/...) lets pipelines be
+composed from typed regions instead of hard-wired model classes. Same
+contract here over the framework's jitted HTM primitives: a
+:class:`Region` maps named inputs → named outputs and owns its state; a
+:class:`Network` wires outputs to inputs, topo-sorts once, and executes
+one step per record. :class:`~tosem_tpu.models.htm.HTMModel` is exactly
+the canonical encoder→SP→TM network, so composition parity is testable.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tosem_tpu.models.htm import (AnomalyLikelihood, SDRClassifier,
+                                  SPParams, TMParams, scalar_encoder,
+                                  sp_init, sp_step, tm_init, tm_step)
+
+
+class Region:
+    """One node: ``compute(inputs) -> outputs`` over named arrays."""
+
+    inputs: Tuple[str, ...] = ()
+    outputs: Tuple[str, ...] = ()
+
+    def compute(self, inputs: Dict[str, Any], *,
+                learn: bool = True) -> Dict[str, Any]:
+        raise NotImplementedError
+
+
+class ScalarEncoderRegion(Region):
+    inputs = ("value",)
+    outputs = ("sdr",)
+
+    def __init__(self, minval: float, maxval: float, n_bits: int = 256,
+                 n_active: int = 15):
+        self.kw = dict(minval=minval, maxval=maxval, n_bits=n_bits,
+                       n_active=n_active)
+        self.n_bits = n_bits
+
+    def compute(self, inputs, *, learn=True):
+        return {"sdr": scalar_encoder(float(inputs["value"]), **self.kw)}
+
+
+class SPRegion(Region):
+    inputs = ("sdr",)
+    outputs = ("active_columns",)
+
+    def __init__(self, key, params: SPParams):
+        self.params = params
+        self.state = sp_init(key, params)
+
+    def compute(self, inputs, *, learn=True):
+        self.state, active = sp_step(self.state, inputs["sdr"],
+                                     self.params, learn)
+        return {"active_columns": active}
+
+
+class TMRegion(Region):
+    inputs = ("active_columns",)
+    outputs = ("anomaly_score", "active_cells")
+
+    def __init__(self, params: TMParams):
+        self.params = params
+        self.state = tm_init(params)
+
+    def compute(self, inputs, *, learn=True):
+        self.state, anomaly = tm_step(self.state,
+                                      inputs["active_columns"],
+                                      self.params, learn)
+        return {"anomaly_score": float(anomaly),
+                "active_cells": self.state.active}
+
+
+class AnomalyLikelihoodRegion(Region):
+    inputs = ("anomaly_score",)
+    outputs = ("anomaly_likelihood",)
+
+    def __init__(self, **kw):
+        self.likelihood = AnomalyLikelihood(**kw)
+
+    def compute(self, inputs, *, learn=True):
+        return {"anomaly_likelihood":
+                self.likelihood.update(inputs["anomaly_score"])}
+
+
+class ClassifierRegion(Region):
+    """Predicts the current record's bucket from the TM's cell SDR."""
+    inputs = ("active_cells", "bucket")
+    outputs = ("probs", "predicted_bucket")
+
+    def __init__(self, n_inputs: int, n_buckets: int, lr: float = 0.1):
+        self.clf = SDRClassifier(n_inputs, n_buckets, lr)
+
+    def compute(self, inputs, *, learn=True):
+        sdr = inputs["active_cells"].astype(jnp.float32)
+        probs = self.clf.infer(sdr)
+        if learn and inputs.get("bucket") is not None:
+            self.clf.learn(sdr, int(inputs["bucket"]))
+        return {"probs": probs,
+                "predicted_bucket": int(jnp.argmax(probs))}
+
+
+class Network:
+    """Region graph with named links (Network.link analog).
+
+    Links are (src_region, src_output) → (dst_region, dst_input);
+    network-level inputs feed any unlinked region input by name.
+    """
+
+    def __init__(self):
+        self._regions: Dict[str, Region] = {}
+        self._links: Dict[Tuple[str, str], Tuple[str, str]] = {}
+        self._order: Optional[List[str]] = None
+
+    def add_region(self, name: str, region: Region) -> Region:
+        if name in self._regions:
+            raise ValueError(f"duplicate region {name!r}")
+        self._regions[name] = region
+        self._order = None
+        return region
+
+    def link(self, src: str, src_output: str, dst: str,
+             dst_input: str) -> None:
+        for n in (src, dst):
+            if n not in self._regions:
+                raise KeyError(f"no region {n!r}")
+        if src == dst:
+            # toposort skips self-edges, so this would surface later as a
+            # confusing KeyError mid-run instead of a cycle error here
+            raise ValueError(f"cycle through region {src!r} (self-link)")
+        if src_output not in self._regions[src].outputs:
+            raise ValueError(f"{src!r} has no output {src_output!r}")
+        if dst_input not in self._regions[dst].inputs:
+            raise ValueError(f"{dst!r} has no input {dst_input!r}")
+        self._links[(dst, dst_input)] = (src, src_output)
+        self._order = None
+
+    def _toposort(self) -> List[str]:
+        deps: Dict[str, set] = {n: set() for n in self._regions}
+        for (dst, _), (src, _) in self._links.items():
+            if src != dst:
+                deps[dst].add(src)
+        order, done = [], set()
+
+        def visit(n, stack):
+            if n in done:
+                return
+            if n in stack:
+                raise ValueError(f"cycle through region {n!r}")
+            stack.add(n)
+            for d in sorted(deps[n]):
+                visit(d, stack)
+            stack.discard(n)
+            done.add(n)
+            order.append(n)
+
+        for n in sorted(self._regions):
+            visit(n, set())
+        return order
+
+    def run_step(self, network_inputs: Dict[str, Any], *,
+                 learn: bool = True) -> Dict[str, Dict[str, Any]]:
+        """One record through every region; returns all region outputs."""
+        if self._order is None:
+            self._order = self._toposort()
+        produced: Dict[str, Dict[str, Any]] = {}
+        for name in self._order:
+            region = self._regions[name]
+            ins: Dict[str, Any] = {}
+            for inp in region.inputs:
+                link = self._links.get((name, inp))
+                if link is not None:
+                    src, out = link
+                    ins[inp] = produced[src][out]
+                else:
+                    ins[inp] = network_inputs.get(inp)
+            produced[name] = region.compute(ins, learn=learn)
+        return produced
+
+    def run(self, records, *, learn: bool = True
+            ) -> List[Dict[str, Dict[str, Any]]]:
+        return [self.run_step(r, learn=learn) for r in records]
+
+
+def anomaly_network(key, *, minval: float, maxval: float,
+                    n_bits: int = 256, n_active_bits: int = 15,
+                    n_columns: int = 256, n_active_columns: int = 10,
+                    cells_per_column: int = 8) -> Network:
+    """The canonical encoder→SP→TM→likelihood wiring (HTMModel's
+    topology, expressed as a network)."""
+    net = Network()
+    net.add_region("encoder", ScalarEncoderRegion(
+        minval, maxval, n_bits=n_bits, n_active=n_active_bits))
+    net.add_region("sp", SPRegion(key, SPParams(
+        n_inputs=n_bits, n_columns=n_columns,
+        n_active_columns=n_active_columns)))
+    net.add_region("tm", TMRegion(TMParams(
+        n_columns=n_columns, cells_per_column=cells_per_column,
+        activation_threshold=max(2, n_active_columns // 2),
+        learning_threshold=max(1, n_active_columns // 3))))
+    net.add_region("likelihood", AnomalyLikelihoodRegion())
+    net.link("encoder", "sdr", "sp", "sdr")
+    net.link("sp", "active_columns", "tm", "active_columns")
+    net.link("tm", "anomaly_score", "likelihood", "anomaly_score")
+    return net

@@ -1,0 +1,19 @@
+"""Stdlib-only network probes shared by bench/driver preflights."""
+from __future__ import annotations
+
+import socket
+
+
+def tunnel_alive(port: int = 8083, timeout: float = 2.0) -> bool:
+    """Probe the axon relay's stateless port. The tunnel can drop for the
+    whole box (relay stops listening); callers should fail fast rather
+    than hang in the PJRT plugin's dial-retry loop."""
+    s = socket.socket()
+    s.settimeout(timeout)
+    try:
+        s.connect(("127.0.0.1", port))
+        return True
+    except OSError:
+        return False
+    finally:
+        s.close()
