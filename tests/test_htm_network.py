@@ -24,8 +24,12 @@ def test_link_validation():
     with pytest.raises(KeyError):
         net.link("missing", "sdr", "sp", "sdr")
     net.link("enc", "sdr", "sp", "sdr")
+    with pytest.raises(ValueError, match="already linked"):
+        net.link("enc", "sdr", "sp", "sdr")       # no silent rewire
     with pytest.raises(ValueError):
         net.add_region("enc", ScalarEncoderRegion(0, 1))
+    with pytest.raises(KeyError, match="neither linked nor provided"):
+        net.run_step({})                          # 'value' unfed
 
 
 def test_cycle_detected():

@@ -279,8 +279,12 @@ class SDRClassifier:
     def infer(self, sdr: jax.Array) -> jax.Array:
         return jax.nn.softmax(sdr @ self.w)
 
-    def learn(self, sdr: jax.Array, bucket: int) -> None:
-        probs = self.infer(sdr)
+    def learn(self, sdr: jax.Array, bucket: int,
+              probs: Optional[jax.Array] = None) -> None:
+        """``probs`` may pass along an already-computed ``infer(sdr)``
+        (streaming callers infer then learn on the same record)."""
+        if probs is None:
+            probs = self.infer(sdr)
         target = jax.nn.one_hot(bucket, self.w.shape[1])
         self.w = self.w + self.lr * jnp.outer(sdr, target - probs)
 

@@ -100,7 +100,7 @@ class ClassifierRegion(Region):
         sdr = inputs["active_cells"].astype(jnp.float32)
         probs = self.clf.infer(sdr)
         if learn and inputs.get("bucket") is not None:
-            self.clf.learn(sdr, int(inputs["bucket"]))
+            self.clf.learn(sdr, int(inputs["bucket"]), probs=probs)
         return {"probs": probs,
                 "predicted_bucket": int(jnp.argmax(probs))}
 
@@ -137,6 +137,10 @@ class Network:
             raise ValueError(f"{src!r} has no output {src_output!r}")
         if dst_input not in self._regions[dst].inputs:
             raise ValueError(f"{dst!r} has no input {dst_input!r}")
+        if (dst, dst_input) in self._links:
+            old = self._links[(dst, dst_input)]
+            raise ValueError(f"input {dst!r}.{dst_input!r} is already "
+                             f"linked from {old[0]!r}.{old[1]!r}")
         self._links[(dst, dst_input)] = (src, src_output)
         self._order = None
 
@@ -177,8 +181,14 @@ class Network:
                 if link is not None:
                     src, out = link
                     ins[inp] = produced[src][out]
+                elif inp in network_inputs:
+                    # explicit None is allowed (optional inputs like the
+                    # classifier's 'bucket' label)
+                    ins[inp] = network_inputs[inp]
                 else:
-                    ins[inp] = network_inputs.get(inp)
+                    raise KeyError(
+                        f"region {name!r} input {inp!r} is neither linked "
+                        "nor provided in network_inputs")
             produced[name] = region.compute(ins, learn=learn)
         return produced
 

@@ -98,13 +98,23 @@ def make_train_step(model: Module,
         return jax.tree_util.tree_map(
             lambda x: data if getattr(x, "ndim", 0) >= 1 else repl, batch)
 
-    # in_shardings depend on the batch pytree structure → build the jitted
-    # program lazily on first call and reuse it (stable structure assumed)
-    cache: Dict[str, Any] = {}
+    # in_shardings depend on the batch pytree structure → cache one jitted
+    # program PER (train-state treedef, batch treedef/shapes/dtypes): a
+    # second batch structure must get its own shardings, not silently
+    # reuse the first program's
+    cache: Dict[Any, Any] = {}
+
+    def _cache_key(ts, batch):
+        leaves, treedef = jax.tree_util.tree_flatten(batch)
+        return (jax.tree_util.tree_structure(ts), treedef,
+                tuple((getattr(l, "shape", ()),
+                       str(getattr(l, "dtype", type(l).__name__)))
+                      for l in leaves))
 
     def wrapper(ts, batch, rng):
-        if "jitted" not in cache:
-            cache["jitted"] = jax.jit(
+        key = _cache_key(ts, batch)
+        if key not in cache:
+            cache[key] = jax.jit(
                 step,
                 in_shardings=(jax.tree_util.tree_map(lambda _: repl, ts),
                               batch_sharding(batch), repl),
@@ -112,7 +122,7 @@ def make_train_step(model: Module,
                                repl),
                 donate_argnums=(0,) if donate else (),
             )
-        return cache["jitted"](ts, batch, rng)
+        return cache[key](ts, batch, rng)
 
     return wrapper
 
