@@ -1,0 +1,55 @@
+"""Image op tests (SURVEY §2.2 camera-kernel rows)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tosem_tpu.ops.image import letterbox, normalize_image, resize_bilinear
+
+
+def test_matches_jax_image_bilinear():
+    img = jax.random.uniform(jax.random.key(0), (13, 17, 3))
+    for out_h, out_w in [(26, 34), (7, 9), (13, 17), (32, 8)]:
+        got = resize_bilinear(img, out_h, out_w)
+        want = jax.image.resize(img, (out_h, out_w, 3), "bilinear",
+                                antialias=False)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-5)
+
+
+def test_batched_and_jitted():
+    imgs = jax.random.uniform(jax.random.key(1), (2, 8, 8, 3))
+    f = jax.jit(lambda x: resize_bilinear(x, 16, 16))
+    out = f(imgs)
+    assert out.shape == (2, 16, 16, 3)
+    # identity resize is exact
+    same = resize_bilinear(imgs, 8, 8)
+    np.testing.assert_allclose(np.asarray(same), np.asarray(imgs),
+                               atol=1e-6)
+
+
+def test_grads_flow():
+    img = jax.random.uniform(jax.random.key(2), (6, 6, 1))
+    g = jax.grad(lambda x: jnp.sum(resize_bilinear(x, 12, 12) ** 2))(img)
+    assert np.all(np.isfinite(np.asarray(g)))
+    assert float(jnp.abs(g).sum()) > 0
+
+
+def test_normalize():
+    img = jnp.full((4, 4, 3), 128.0)
+    out = normalize_image(img, mean=[0.485, 0.456, 0.406],
+                          std=[0.229, 0.224, 0.225], scale=1 / 255.0)
+    want = (128 / 255.0 - np.array([0.485, 0.456, 0.406])) / \
+        np.array([0.229, 0.224, 0.225])
+    np.testing.assert_allclose(np.asarray(out[0, 0]), want, rtol=1e-5)
+
+
+def test_letterbox_preserves_aspect():
+    img = jnp.ones((10, 20, 3))
+    canvas, s = letterbox(img, 32)
+    assert canvas.shape == (32, 32, 3)
+    assert s == pytest.approx(32 / 20)
+    # content occupies 16 rows; the rest is padding
+    assert float(canvas[15, 0, 0]) == pytest.approx(1.0, abs=1e-5)
+    assert float(canvas[20, 0, 0]) == 0.0
+    assert float(canvas[0, 31, 0]) == pytest.approx(1.0, abs=1e-5)

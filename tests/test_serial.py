@@ -1,0 +1,137 @@
+"""Zero-copy pytree codec + HTM state serialization tests
+(SURVEY §2.5 capnp-serialization row)."""
+import jax
+import numpy as np
+import pytest
+
+from tosem_tpu.utils.serial import (dump_tree, load_tree, open_tree,
+                                    save_tree)
+
+
+def _tree():
+    return {
+        "params": {"w": np.arange(12, dtype=np.float32).reshape(3, 4),
+                   "b": np.zeros(4, np.float64)},
+        "meta": {"step": 7, "name": "exp", "flag": True, "none": None,
+                 "ratio": 0.5},
+        "history": [np.int32(3), np.arange(5, dtype=np.int64)],
+        "shape_tuple": (1, 2, 3),
+    }
+
+
+def test_roundtrip_structure_and_values():
+    t = _tree()
+    got = load_tree(dump_tree(t))
+    assert got["meta"] == t["meta"]
+    assert got["shape_tuple"] == (1, 2, 3)
+    np.testing.assert_array_equal(got["params"]["w"], t["params"]["w"])
+    assert got["params"]["b"].dtype == np.float64
+    np.testing.assert_array_equal(got["history"][1], t["history"][1])
+
+
+def test_zero_copy_views():
+    blob = dump_tree({"x": np.arange(16, dtype=np.float32)})
+    got = load_tree(blob)
+    # zero-copy: read-only view over the blob's memory
+    assert not got["x"].flags.writeable
+    with pytest.raises(ValueError):
+        got["x"][0] = 1.0
+    owned = load_tree(blob, zero_copy=False)["x"]
+    owned[0] = 42.0                               # copies are mutable
+    assert owned[0] == 42.0
+
+
+def test_alignment():
+    blob = dump_tree({"a": np.ones(3, np.int8), "b": np.ones(5, np.float64)})
+    got = load_tree(blob)
+    np.testing.assert_array_equal(got["b"], np.ones(5))
+
+
+def test_file_and_mmap(tmp_path):
+    path = str(tmp_path / "t.tpt")
+    n = save_tree(_tree(), path)
+    assert n > 0
+    got = open_tree(path)
+    np.testing.assert_array_equal(got["params"]["w"],
+                                  _tree()["params"]["w"])
+
+
+def test_bad_magic_rejected():
+    with pytest.raises(ValueError):
+        load_tree(b"NOPE" + b"\x00" * 64)
+
+
+def test_bfloat16_roundtrip():
+    import jax.numpy as jnp
+    t = {"w": jnp.asarray([1.5, -2.25, 0.125], jnp.bfloat16)}
+    got = load_tree(dump_tree(t))
+    assert str(got["w"].dtype) == "bfloat16"
+    np.testing.assert_array_equal(np.asarray(t["w"], np.float32),
+                                  np.asarray(got["w"], np.float32))
+
+
+def test_non_string_keys_rejected():
+    with pytest.raises(TypeError, match="keys must be strings"):
+        dump_tree({0: np.ones(2)})
+
+
+def test_jax_leaves_serializable():
+    import jax.numpy as jnp
+    t = {"w": jnp.arange(6, dtype=jnp.float32).reshape(2, 3)}
+    got = load_tree(dump_tree(t))
+    np.testing.assert_array_equal(np.asarray(t["w"]), got["w"])
+
+
+def test_htm_network_save_restore_bit_exact(tmp_path):
+    from tosem_tpu.models.htm_network import anomaly_network
+    sig = np.sin(np.arange(200) / 7.0) * 2.0
+    kw = dict(minval=-3, maxval=3, n_bits=128, n_active_bits=9,
+              n_columns=128, n_active_columns=6, cells_per_column=4)
+    a = anomaly_network(jax.random.key(3), **kw)
+    for v in sig[:120]:
+        a.run_step({"value": float(v)})
+    path = str(tmp_path / "net.tpt")
+    a.save(path)
+
+    b = anomaly_network(jax.random.key(99), **kw)   # different init
+    b.load(path)
+    for v in sig[120:]:
+        out_a = a.run_step({"value": float(v)})
+        out_b = b.run_step({"value": float(v)})
+        assert out_b["tm"]["anomaly_score"] == pytest.approx(
+            out_a["tm"]["anomaly_score"])
+        assert out_b["likelihood"]["anomaly_likelihood"] == pytest.approx(
+            out_a["likelihood"]["anomaly_likelihood"])
+
+
+def test_htm_network_load_rejects_incomplete_state(tmp_path):
+    from tosem_tpu.models.htm_network import ClassifierRegion, anomaly_network
+    path = str(tmp_path / "old.tpt")
+    net = anomaly_network(jax.random.key(0), minval=0, maxval=1)
+    net.save(path)
+    grown = anomaly_network(jax.random.key(0), minval=0, maxval=1)
+    grown.add_region("clf", ClassifierRegion(n_inputs=256 * 8, n_buckets=4))
+    grown.link("tm", "active_cells", "clf", "active_cells")
+    with pytest.raises(ValueError, match="lacks regions"):
+        grown.load(path)
+
+
+def test_classifier_bucket_optional_at_inference(tmp_path):
+    from tosem_tpu.models.htm_network import ClassifierRegion, anomaly_network
+    net = anomaly_network(jax.random.key(0), minval=0, maxval=4,
+                          n_bits=64, n_active_bits=5, n_columns=64,
+                          n_active_columns=4, cells_per_column=2)
+    net.add_region("clf", ClassifierRegion(n_inputs=64 * 2, n_buckets=4))
+    net.link("tm", "active_cells", "clf", "active_cells")
+    out = net.run_step({"value": 1.0}, learn=False)   # no label provided
+    assert 0 <= out["clf"]["predicted_bucket"] < 4
+
+
+def test_htm_network_load_rejects_unknown_regions(tmp_path):
+    from tosem_tpu.models.htm_network import Network, anomaly_network
+    from tosem_tpu.utils.serial import save_tree
+    path = str(tmp_path / "bad.tpt")
+    save_tree({"mystery": {"w": np.zeros(2)}}, path)
+    net = anomaly_network(jax.random.key(0), minval=0, maxval=1)
+    with pytest.raises(ValueError, match="unknown regions"):
+        net.load(path)

@@ -22,14 +22,27 @@ from tosem_tpu.models.htm import (AnomalyLikelihood, SDRClassifier,
 
 
 class Region:
-    """One node: ``compute(inputs) -> outputs`` over named arrays."""
+    """One node: ``compute(inputs) -> outputs`` over named arrays.
+
+    Inputs listed in ``optional_inputs`` default to ``None`` when
+    neither linked nor provided (e.g. a label that is only present
+    during training)."""
 
     inputs: Tuple[str, ...] = ()
+    optional_inputs: Tuple[str, ...] = ()
     outputs: Tuple[str, ...] = ()
 
     def compute(self, inputs: Dict[str, Any], *,
                 learn: bool = True) -> Dict[str, Any]:
         raise NotImplementedError
+
+    # serialization hooks (the capnp read/write methods of
+    # nupic.serializable; stateless regions use the defaults)
+    def state_dict(self) -> Dict[str, Any]:
+        return {}
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        pass
 
 
 class ScalarEncoderRegion(Region):
@@ -46,7 +59,18 @@ class ScalarEncoderRegion(Region):
         return {"sdr": scalar_encoder(float(inputs["value"]), **self.kw)}
 
 
-class SPRegion(Region):
+class _NamedTupleStateRegion(Region):
+    """Serialization for regions whose state is a NamedTuple of arrays."""
+
+    def state_dict(self):
+        return {k: jnp.asarray(v) for k, v in self.state._asdict().items()}
+
+    def load_state_dict(self, state):
+        self.state = type(self.state)(**{
+            k: jnp.asarray(state[k]) for k in self.state._fields})
+
+
+class SPRegion(_NamedTupleStateRegion):
     inputs = ("sdr",)
     outputs = ("active_columns",)
 
@@ -60,7 +84,7 @@ class SPRegion(Region):
         return {"active_columns": active}
 
 
-class TMRegion(Region):
+class TMRegion(_NamedTupleStateRegion):
     inputs = ("active_columns",)
     outputs = ("anomaly_score", "active_cells")
 
@@ -87,10 +111,20 @@ class AnomalyLikelihoodRegion(Region):
         return {"anomaly_likelihood":
                 self.likelihood.update(inputs["anomaly_score"])}
 
+    def state_dict(self):
+        import numpy as np
+        return {"history": np.asarray(self.likelihood.history,
+                                      np.float64)}
+
+    def load_state_dict(self, state):
+        self.likelihood.history = [float(v) for v in state["history"]]
+
 
 class ClassifierRegion(Region):
-    """Predicts the current record's bucket from the TM's cell SDR."""
+    """Predicts the current record's bucket from the TM's cell SDR.
+    ``bucket`` (the label) is optional: inference-only runs omit it."""
     inputs = ("active_cells", "bucket")
+    optional_inputs = ("bucket",)
     outputs = ("probs", "predicted_bucket")
 
     def __init__(self, n_inputs: int, n_buckets: int, lr: float = 0.1):
@@ -103,6 +137,12 @@ class ClassifierRegion(Region):
             self.clf.learn(sdr, int(inputs["bucket"]), probs=probs)
         return {"probs": probs,
                 "predicted_bucket": int(jnp.argmax(probs))}
+
+    def state_dict(self):
+        return {"w": jnp.asarray(self.clf.w)}
+
+    def load_state_dict(self, state):
+        self.clf.w = jnp.asarray(state["w"])
 
 
 class Network:
@@ -182,9 +222,9 @@ class Network:
                     src, out = link
                     ins[inp] = produced[src][out]
                 elif inp in network_inputs:
-                    # explicit None is allowed (optional inputs like the
-                    # classifier's 'bucket' label)
                     ins[inp] = network_inputs[inp]
+                elif inp in region.optional_inputs:
+                    ins[inp] = None
                 else:
                     raise KeyError(
                         f"region {name!r} input {inp!r} is neither linked "
@@ -195,6 +235,37 @@ class Network:
     def run(self, records, *, learn: bool = True
             ) -> List[Dict[str, Dict[str, Any]]]:
         return [self.run_step(r, learn=learn) for r in records]
+
+    # -- serialization (nupic.serializable capnp read/write role) ------
+
+    def save(self, path: str) -> int:
+        """Persist every region's learned state via the zero-copy pytree
+        codec (:mod:`tosem_tpu.utils.serial`); topology is NOT saved —
+        the loader rebuilds the same network and restores state into it,
+        the proto-schema contract."""
+        from tosem_tpu.utils.serial import save_tree
+        import numpy as np
+        state = {name: {k: np.asarray(v)
+                        for k, v in region.state_dict().items()}
+                 for name, region in self._regions.items()}
+        return save_tree(state, path)
+
+    def load(self, path: str) -> None:
+        from tosem_tpu.utils.serial import open_tree
+        state = open_tree(path, zero_copy=False)
+        unknown = set(state) - set(self._regions)
+        if unknown:
+            raise ValueError(f"saved state has unknown regions {unknown}")
+        # save() writes an entry for EVERY region (stateless ones included),
+        # so an absent region means the file predates this topology — a
+        # silently-random region is worse than an error
+        missing = set(self._regions) - set(state)
+        if missing:
+            raise ValueError(
+                f"saved state lacks regions {missing} present in this "
+                "network (topology changed since the save?)")
+        for name, region in self._regions.items():
+            region.load_state_dict(state[name])
 
 
 def anomaly_network(key, *, minval: float, maxval: float,

@@ -1,0 +1,72 @@
+"""Image preprocessing ops (Apollo camera-kernel analogs).
+
+The reference preprocesses camera frames with handwritten CUDA
+(`modules/perception/inference/utils/resize.cu` bilinear resize,
+`util.cu` mean/std normalization into NCHW planes). TPU form: the
+resize is two gathers + lerps over precomputed index/weight vectors
+(XLA fuses the whole thing; no per-pixel kernel), normalization is one
+fused elementwise expression, and everything is shape-static under jit
+so it composes into detection models without host round trips.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _axis_indices(in_size: int, out_size: int):
+    """Half-pixel-center source coordinates for one axis → (lo, hi, w)."""
+    scale = in_size / out_size
+    src = (jnp.arange(out_size) + 0.5) * scale - 0.5
+    src = jnp.clip(src, 0.0, in_size - 1)
+    lo = jnp.floor(src).astype(jnp.int32)
+    hi = jnp.minimum(lo + 1, in_size - 1)
+    w = (src - lo).astype(jnp.float32)
+    return lo, hi, w
+
+
+def resize_bilinear(img: jax.Array, out_h: int, out_w: int) -> jax.Array:
+    """Half-pixel bilinear resize of ``[..., H, W, C]`` (the resize.cu
+    kernel; matches ``jax.image.resize(..., 'bilinear',
+    antialias=False)``)."""
+    if img.ndim < 3:
+        raise ValueError("expected [..., H, W, C]")
+    H, W = img.shape[-3], img.shape[-2]
+    ylo, yhi, wy = _axis_indices(H, out_h)
+    xlo, xhi, wx = _axis_indices(W, out_w)
+    dtype = img.dtype
+    f = img.astype(jnp.float32)
+    top = jnp.take(f, ylo, axis=-3)
+    bot = jnp.take(f, yhi, axis=-3)
+    rows = top + (bot - top) * wy[:, None, None]        # [..., out_h, W, C]
+    left = jnp.take(rows, xlo, axis=-2)
+    right = jnp.take(rows, xhi, axis=-2)
+    out = left + (right - left) * wx[:, None]
+    return out.astype(dtype)
+
+
+def normalize_image(img: jax.Array,
+                    mean: Sequence[float],
+                    std: Sequence[float],
+                    scale: float = 1.0) -> jax.Array:
+    """Per-channel ``(img * scale - mean) / std`` (util.cu normalization,
+    one fused elementwise op)."""
+    mean_a = jnp.asarray(mean, jnp.float32)
+    std_a = jnp.asarray(std, jnp.float32)
+    return (img.astype(jnp.float32) * scale - mean_a) / std_a
+
+
+def letterbox(img: jax.Array, size: int,
+              pad_value: float = 0.0) -> Tuple[jax.Array, float]:
+    """Aspect-preserving resize into a ``size``×``size`` canvas (the
+    detector input convention). Static output shape: scale is resolved
+    at trace time from the input's static dims. Returns (canvas, scale)."""
+    H, W = img.shape[-3], img.shape[-2]
+    s = min(size / H, size / W)
+    new_h, new_w = int(round(H * s)), int(round(W * s))
+    resized = resize_bilinear(img, new_h, new_w)
+    pad_h, pad_w = size - new_h, size - new_w
+    pads = [(0, 0)] * (img.ndim - 3) + [(0, pad_h), (0, pad_w), (0, 0)]
+    return jnp.pad(resized, pads, constant_values=pad_value), s

@@ -1,0 +1,145 @@
+"""Compact zero-copy pytree serialization (the capnp role).
+
+NuPIC serializes algorithm state through Cap'n Proto schemas
+(`nupic/serializable.py`, `src/nupic/proto/*.capnp`) so a trained
+SP/TM restores bit-exactly and cheaply. The TPU-era equivalent of that
+need is a flat, self-describing binary for **array pytrees**: a JSON
+header (tree structure + per-leaf dtype/shape/offset) followed by the
+raw little-endian buffers, 64-byte aligned so :func:`load_tree` can
+return numpy views straight into the file's buffer (``zero_copy=True``)
+— no per-leaf pickling, no copies, mmap-friendly, and safe to stash in
+the shared-memory object store.
+
+Format::
+
+    magic b"TPT1" | u32 header_len | header_json | pad | buffers...
+
+Header: ``{"tree": <nested lists/dicts with {"__leaf__": i} markers>,
+"leaves": [{"dtype": "<f4", "shape": [..], "offset": N}, ...]}``.
+Scalars (int/float/str/bool/None) are inlined in the tree.
+"""
+from __future__ import annotations
+
+import json
+import struct
+from typing import Any, List, Tuple
+
+import numpy as np
+
+MAGIC = b"TPT1"
+_ALIGN = 64
+
+
+def _dtype_name(dt: np.dtype) -> str:
+    # .name survives ml_dtypes (bfloat16 → 'bfloat16'); .str would record
+    # the raw void layout ('<V2') and corrupt the round trip
+    return np.dtype(dt).name
+
+
+def _resolve_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _plan(obj: Any, leaves: List[np.ndarray]):
+    """Tree → JSON-able skeleton with leaf markers; collects arrays."""
+    if isinstance(obj, dict):
+        for k in obj:
+            if not isinstance(k, str):
+                raise TypeError(
+                    f"dict keys must be strings (got {k!r}); non-string "
+                    "keys would be silently stringified on round-trip")
+        return {"__map__": {k: _plan(v, leaves)
+                            for k, v in sorted(obj.items())}}
+    if isinstance(obj, (list, tuple)):
+        kind = "__list__" if isinstance(obj, list) else "__tuple__"
+        return {kind: [_plan(v, leaves) for v in obj]}
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return {"__scalar__": obj}
+    arr = np.asarray(obj)
+    leaves.append(np.ascontiguousarray(arr))
+    return {"__leaf__": len(leaves) - 1}
+
+
+def _align(n: int) -> int:
+    return (n + _ALIGN - 1) // _ALIGN * _ALIGN
+
+
+def dump_tree(tree: Any) -> bytes:
+    leaves: List[np.ndarray] = []
+    skeleton = _plan(tree, leaves)
+    offset = 0
+    table = []
+    for arr in leaves:
+        offset = _align(offset)
+        table.append({"dtype": _dtype_name(arr.dtype),
+                      "shape": list(arr.shape), "offset": offset})
+        offset += arr.nbytes
+    header = json.dumps({"tree": skeleton, "leaves": table},
+                        separators=(",", ":")).encode()
+    prefix_len = len(MAGIC) + 4 + len(header)
+    data_start = _align(prefix_len)
+    out = bytearray(data_start + offset)
+    out[:4] = MAGIC
+    struct.pack_into("<I", out, 4, len(header))
+    out[8:8 + len(header)] = header
+    for arr, meta in zip(leaves, table):
+        start = data_start + meta["offset"]
+        out[start:start + arr.nbytes] = arr.tobytes()
+    return bytes(out)
+
+
+def _rebuild(node: Any, leaves: List[np.ndarray]):
+    if "__map__" in node:
+        return {k: _rebuild(v, leaves) for k, v in node["__map__"].items()}
+    if "__list__" in node:
+        return [_rebuild(v, leaves) for v in node["__list__"]]
+    if "__tuple__" in node:
+        return tuple(_rebuild(v, leaves) for v in node["__tuple__"])
+    if "__scalar__" in node:
+        return node["__scalar__"]
+    return leaves[node["__leaf__"]]
+
+
+def load_tree(blob: bytes, *, zero_copy: bool = True) -> Any:
+    """Parse a :func:`dump_tree` blob. ``zero_copy=True`` returns
+    read-only numpy views into ``blob``; pass False for owned copies
+    (needed if the caller will mutate leaves or outlive the buffer)."""
+    if blob[:4] != MAGIC:
+        raise ValueError("not a TPT1 pytree blob")
+    (header_len,) = struct.unpack_from("<I", blob, 4)
+    header = json.loads(blob[8:8 + header_len].decode())
+    data_start = _align(8 + header_len)
+    mv = memoryview(blob)
+    leaves: List[np.ndarray] = []
+    for meta in header["leaves"]:
+        dtype = _resolve_dtype(meta["dtype"])
+        shape = tuple(meta["shape"])
+        start = data_start + meta["offset"]
+        nbytes = dtype.itemsize * int(np.prod(shape)) if shape else \
+            dtype.itemsize
+        arr = np.frombuffer(mv[start:start + nbytes], dtype=dtype)
+        arr = arr.reshape(shape)
+        if not zero_copy:
+            arr = arr.copy()
+        leaves.append(arr)
+    return _rebuild(header["tree"], leaves)
+
+
+def save_tree(tree: Any, path: str) -> int:
+    blob = dump_tree(tree)
+    with open(path, "wb") as f:
+        f.write(blob)
+    return len(blob)
+
+
+def open_tree(path: str, *, zero_copy: bool = True) -> Any:
+    """mmap the file and rebuild; with ``zero_copy`` the leaves are views
+    over the mapping (the capnp read-without-parse property)."""
+    import mmap
+    with open(path, "rb") as f:
+        mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+    return load_tree(mm, zero_copy=zero_copy)
