@@ -185,6 +185,23 @@ class TestRuntimeIntegration:
         with pytest.raises(KeyError):
             get_actor("missing", kv=kv)
 
+    def test_memory_watchdog_samples_store(self, runtime):
+        # the watchdog thread is wired to the runtime's store; one direct
+        # check() must populate store gauges and react to a 0 threshold
+        from tosem_tpu.runtime.api import _rt
+        mon = _rt()._memmon
+        assert mon is not None
+        saved = (mon.on_pressure, mon.threshold, mon.cooldown_s)
+        fired = []
+        try:
+            mon.on_pressure, mon.threshold, mon.cooldown_s = \
+                fired.append, 0.0, 0.0
+            snap = mon.check()
+        finally:  # the fixture's daemon thread keeps sampling: restore
+            mon.on_pressure, mon.threshold, mon.cooldown_s = saved
+        assert snap["store_capacity"] > 0
+        assert "rss_bytes" in snap and fired
+
     def test_stats_and_elastic_pool(self, runtime):
         s = rt.stats()
         assert s["num_workers"] == 2

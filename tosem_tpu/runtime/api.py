@@ -48,16 +48,19 @@ _lock = threading.Lock()
 
 def init(num_workers: int = 4, store_capacity: int = 256 << 20,
          max_task_retries: int = common.DEFAULT_MAX_TASK_RETRIES,
-         start_method: Optional[str] = None) -> Runtime:
+         start_method: Optional[str] = None,
+         memory_monitor: bool = True) -> Runtime:
     """start_method: None (env/fork default) | "spawn" — use spawn when
-    remote tasks import jax (forked XLA clients hang)."""
+    remote tasks import jax (forked XLA clients hang).
+    memory_monitor: run the RSS/object-store watchdog thread."""
     global _runtime
     with _lock:
         if _runtime is None:
             _runtime = Runtime(num_workers=num_workers,
                                store_capacity=store_capacity,
                                max_task_retries=max_task_retries,
-                               start_method=start_method)
+                               start_method=start_method,
+                               memory_monitor=memory_monitor)
         return _runtime
 
 

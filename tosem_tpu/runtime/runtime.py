@@ -28,6 +28,7 @@ import multiprocessing as mp
 import multiprocessing.connection as mpc
 import os
 import queue
+import sys
 import threading
 import time
 import weakref
@@ -47,6 +48,9 @@ M_TASKS_FINISHED = _metrics.counter(
     "rt_tasks_finished_total", "task completions by outcome", ["outcome"])
 M_ACTORS = _metrics.counter(
     "rt_actor_events_total", "actor lifecycle events", ["event"])
+M_MEM_PRESSURE = _metrics.counter(
+    "runtime_memory_pressure_total",
+    "high-watermark firings of the runtime memory watchdog")
 M_WORKERS_ALIVE = _metrics.gauge(
     "rt_workers_alive", "stateless worker processes in the pool")
 
@@ -130,7 +134,8 @@ class Runtime:
     def __init__(self, num_workers: int = 4,
                  store_capacity: int = 256 << 20,
                  max_task_retries: int = common.DEFAULT_MAX_TASK_RETRIES,
-                 start_method: Optional[str] = None):
+                 start_method: Optional[str] = None,
+                 memory_monitor: bool = True):
         # a pinned method (arg or env) is honored forever; otherwise the
         # context is re-picked at every worker spawn — a Runtime created
         # before jax was imported must still switch to spawn for workers
@@ -170,6 +175,23 @@ class Runtime:
         self._thread = threading.Thread(target=self._scheduler_loop,
                                         daemon=True, name="tosem-scheduler")
         self._thread.start()
+
+        # memory watchdog (ray memory_monitor role): samples RSS + the
+        # shared store into the metrics registry and counts pressure
+        # events; cheap daemon thread, disable via memory_monitor=False
+        self._memmon = None
+        if memory_monitor:
+            from tosem_tpu.obs.memory_monitor import MemoryMonitor
+
+            def _on_pressure(snap):
+                M_MEM_PRESSURE.inc()
+                print(f"[tosem_tpu] memory pressure: "
+                      f"rss={snap['rss_bytes']/1e9:.2f}GB "
+                      f"available={snap['available_bytes']/1e9:.2f}GB",
+                      file=sys.stderr)
+            self._memmon = MemoryMonitor(
+                threshold=0.92, interval_s=5.0, store=self.store,
+                on_pressure=_on_pressure).start()
 
     def _make_ctx(self):
         return mp.get_context(self._pinned_method
@@ -462,6 +484,8 @@ class Runtime:
             M_WORKERS_ALIVE.set(0)
             workers = list(self.task_workers) + [r.worker
                                                  for r in self.actors.values()]
+        if self._memmon is not None:
+            self._memmon.stop()
         for w in workers:
             self._send(w, ("exit",))
         self._sendq.put(None)
