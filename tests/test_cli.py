@@ -52,6 +52,19 @@ def test_detection_infer_end_to_end(tmp_path):
     assert (tmp_path / "export" / "efficientdet_infer.mlir").exists()
 
 
+@pytest.mark.slow
+def test_speech_train_end_to_end(tmp_path):
+    out = tmp_path / "sp.csv"
+    rc = main(["--device=cpu", "--config=speech_train", "--steps=3",
+               f"--results_csv={out}"])
+    assert rc == 0
+    rows = read_results(str(out))
+    by_id = {r["bench_id"]: r["value"] for r in rows}
+    assert by_id["speech_ctc_loss"] > 0
+    for mode in ("greedy", "beam", "beam_lm"):
+        assert 0.0 <= by_id[f"speech_wer_{mode}"] <= 1.0
+
+
 def test_manifest_drives_run(tmp_path):
     out = tmp_path / "m.csv"
     mpath = tmp_path / "exp.yaml"

@@ -25,7 +25,8 @@ from typing import Any, Dict, List
 from tosem_tpu.utils.flags import FlagSet
 
 CONFIGS = ("gemm", "conv_sweep", "allreduce", "resnet_train",
-           "bert_kernels", "detection_train", "detection_infer")
+           "bert_kernels", "detection_train", "detection_infer",
+           "speech_train")
 
 
 def make_flags() -> FlagSet:
@@ -351,6 +352,108 @@ def run_detection_infer(fs: FlagSet) -> List[Any]:
     return rows
 
 
+def run_speech_train(fs: FlagSet) -> List[Any]:
+    """DeepSpeech-family end-to-end: synthetic corpus import → bucketed
+    batches → CTC training → WER eval with greedy, beam, and LM-scored
+    beam decode (the ``DeepSpeech.py`` train + ``evaluate.py`` roles,
+    hermetic via the importer's fabricated WAVs)."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from tosem_tpu.data.audio import labels_to_text
+    from tosem_tpu.data.feeding import (import_synthetic_corpus,
+                                        read_csv_manifest, speech_batches)
+    from tosem_tpu.data.scorer import build_scorer
+    from tosem_tpu.models.speech import (SpeechConfig, SpeechModel,
+                                         evaluate_wer, wer)
+    from tosem_tpu.ops.ctc import Scorer, ctc_loss_mean, greedy_decode
+    from tosem_tpu.utils.results import ResultRow
+
+    with tempfile.TemporaryDirectory(prefix="tosem_speech_") as tmp:
+        n_utts = 6 if fs.device == "cpu" else 16
+        manifest = import_synthetic_corpus(tmp, n=n_utts, seed=0)
+        refs = [s.transcript for s in read_csv_manifest(manifest)]
+
+        cfg = SpeechConfig(n_input=26, n_context=2, n_hidden=96, n_cell=96,
+                           vocab_size=28, dropout=0.0)
+        model = SpeechModel(cfg)
+        vs = model.init(jax.random.PRNGKey(0))
+        params, state = vs["params"], vs["state"]
+        opt = optax.adam(3e-3)
+        opt_state = opt.init(params)
+
+        @jax.jit
+        def step(params, opt_state, feats, labels, il, ll):
+            def loss_fn(p):
+                logits, _ = model.apply({"params": p, "state": state},
+                                        feats)
+                return ctc_loss_mean(logits, labels, il, ll,
+                                     blank=cfg.blank)
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = opt.update(grads, opt_state)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        epochs = max(fs.steps, 1) * (6 if fs.device == "tpu" else 1)
+        last_loss = first_loss = None
+        for _ in range(epochs):
+            for b in speech_batches(manifest, batch_size=4, n_buckets=2,
+                                    max_label_len=24):
+                params, opt_state, loss = step(
+                    params, opt_state, jnp.asarray(b.features),
+                    jnp.asarray(b.labels), jnp.asarray(b.feature_lengths),
+                    jnp.asarray(b.label_lengths))
+                last_loss = float(loss)
+                first_loss = (first_loss if first_loss is not None
+                              else last_loss)
+
+        # eval: one padded batch of every utterance, three decode modes
+        # (beam/beam+LM reuse the library's evaluate_wer)
+        batch = next(speech_batches(manifest, batch_size=n_utts,
+                                    n_buckets=1, max_label_len=24,
+                                    sort_by_size=False))
+        feats = jnp.asarray(batch.features)
+        logits, _ = model.apply({"params": params, "state": state}, feats)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        lengths = jnp.asarray(batch.feature_lengths)
+        ref_texts = [labels_to_text(
+            [int(x) for x in batch.labels[i][:int(batch.label_lengths[i])]])
+            for i in range(n_utts)]
+
+        scorer_path = f"{tmp}/corpus.scorer"
+        build_scorer(refs, scorer_path, order=2)
+        scorer = Scorer(scorer_path, alpha=1.0, beta=0.3)
+        beam = evaluate_wer(logp, lengths, ref_texts, blank=cfg.blank,
+                            beam_width=16)
+        beam_lm = evaluate_wer(logp, lengths, ref_texts, blank=cfg.blank,
+                               beam_width=16, scorer=scorer)
+        scorer.close()
+        dec, n_dec = greedy_decode(logits, lengths, blank=cfg.blank)
+        greedy = float(np.mean([
+            wer(ref_texts[i], labels_to_text(
+                [int(x) for x in np.asarray(dec[i][:int(n_dec[i])])]))
+            for i in range(n_utts)]))
+
+        platform = "tpu" if fs.device == "tpu" else "cpu"
+        rows = [ResultRow(project="models", config="speech_train",
+                          bench_id="speech_ctc_loss", metric="ctc_loss",
+                          value=last_loss, unit="nats", device=platform,
+                          extra={"first_loss": first_loss, "epochs": epochs,
+                                 "n_utts": n_utts})]
+        for mode, val in [("greedy", greedy), ("beam", beam["wer"]),
+                          ("beam_lm", beam_lm["wer"])]:
+            rows.append(ResultRow(
+                project="models", config="speech_train",
+                bench_id=f"speech_wer_{mode}", metric="wer",
+                value=float(val), unit="ratio", device=platform,
+                extra={"decoder": mode, "n_utts": n_utts}))
+        for r in rows:
+            print(f"  {r.bench_id}: {r.value:.4f} {r.unit}")
+        return rows
+
+
 RUNNERS = {
     "gemm": run_gemm,
     "conv_sweep": run_conv_sweep,
@@ -359,6 +462,7 @@ RUNNERS = {
     "bert_kernels": run_bert_kernels,
     "detection_train": run_detection_train,
     "detection_infer": run_detection_infer,
+    "speech_train": run_speech_train,
 }
 
 
