@@ -172,6 +172,54 @@ class TestCStreamingAPI:
         assert text == expect
         assert expect.startswith(mid) or mid in expect
 
+    def test_external_scorer_enable_disable(self, tmp_path, monkeypatch):
+        # DS_EnableExternalScorer parity on a model whose alphabet has a
+        # real space, with the LM beam path provably executed
+        import jax
+        import tosem_tpu.ops.ctc as ctc_mod
+        from tosem_tpu.data.audio import ALPHABET
+        from tosem_tpu.data.scorer import build_scorer
+        from tosem_tpu.models.speech import SpeechConfig, SpeechModel
+        from tosem_tpu.serve import CStreamingModel
+
+        cfg = SpeechConfig(n_input=8, n_context=1, n_hidden=32, n_cell=32,
+                           vocab_size=28, dropout=0.0)
+        model = SpeechModel(cfg)
+        params = model.init(jax.random.PRNGKey(0))["params"]
+        cm = CStreamingModel(model, params, ALPHABET, chunk_frames=8)
+        try:
+            path = str(tmp_path / "toy.scorer")
+            build_scorer(["the dog ran", "dog dog"], path, order=2)
+            calls = []
+            real_beam = ctc_mod.beam_search_decode
+
+            def spy(*a, **k):
+                calls.append(k.get("scorer"))
+                return real_beam(*a, **k)
+
+            monkeypatch.setattr(ctc_mod, "beam_search_decode", spy)
+            cm.enable_external_scorer(path, alpha=1.0, beta=0.2)
+            assert cm._scorer.space_index == ALPHABET.index(" ")
+            rng = np.random.default_rng(2)
+            feats = rng.normal(size=(20, cfg.n_input)).astype(np.float32)
+            s = cm.create_stream()
+            cm.feed(s, feats)
+            text_lm = cm.finish(s)
+            assert isinstance(text_lm, str)
+            assert calls and calls[0] is not None     # LM beam really ran
+            # swap to a bad path keeps the working scorer
+            with pytest.raises(FileNotFoundError):
+                cm.enable_external_scorer(str(tmp_path / "nope.scorer"))
+            assert cm._scorer is not None
+            cm.disable_external_scorer()
+            assert cm._scorer is None
+            s2 = cm.create_stream()
+            cm.feed(s2, feats)
+            assert isinstance(cm.finish(s2), str)     # greedy restored
+            assert len(calls) == 1                    # no beam after disable
+        finally:
+            cm.close()
+
     def test_finish_twice_is_error(self, cmodel):
         cm = cmodel[0]
         s = cm.create_stream()

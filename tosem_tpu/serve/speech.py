@@ -60,6 +60,11 @@ def _bind(lib):
     return lib
 
 
+def _logsumexp(arr: np.ndarray) -> np.ndarray:
+    m = arr.max(axis=-1, keepdims=True)
+    return m + np.log(np.exp(arr - m).sum(axis=-1, keepdims=True))
+
+
 def greedy_ctc_text(logits: np.ndarray, alphabet: str, blank: int) -> str:
     """Greedy CTC collapse (repeat-merge then blank-drop)."""
     ids = logits.argmax(-1)
@@ -136,11 +141,29 @@ class CStreamingModel:
             except Exception:
                 return -1
 
+        self._scorer = None
+        self._beam_width = 16
+
         def decode(_, logits_p, n_frames, out, cap):
             try:
                 arr = np.ctypeslib.as_array(
                     logits_p, (n_frames, cfg.n_classes))
-                text = greedy_ctc_text(arr, alphabet, cfg.blank)
+                # the lock pins the scorer for the whole decode: a
+                # concurrent disable/enable must not free the native LM
+                # handle mid-beam (use-after-free)
+                with self._lock:
+                    scorer = self._scorer
+                    if scorer is not None:
+                        # DS_EnableExternalScorer path: LM-scored beam
+                        from tosem_tpu.data.audio import labels_to_text
+                        from tosem_tpu.ops.ctc import beam_search_decode
+                        logp = arr - _logsumexp(arr)
+                        labels, _ = beam_search_decode(
+                            logp, blank=cfg.blank,
+                            beam_width=self._beam_width, scorer=scorer)
+                        text = labels_to_text(labels, alphabet)
+                if scorer is None:
+                    text = greedy_ctc_text(arr, alphabet, cfg.blank)
                 data = text.encode()[:cap - 1]
                 ctypes.memmove(out, data + b"\0", len(data) + 1)
                 return 0
@@ -155,6 +178,32 @@ class CStreamingModel:
             *self._cbs, None)
         if not self._model_p:
             raise RuntimeError("sp_create_model failed")
+
+    # -- external scorer (DS_EnableExternalScorer:208 parity) --------------
+
+    def enable_external_scorer(self, path: str, alpha: float = 1.8,
+                               beta: float = 0.8,
+                               beam_width: int = 16) -> None:
+        """Attach an n-gram scorer package (see
+        :func:`tosem_tpu.data.scorer.build_scorer`): decodes switch from
+        greedy to LM-scored beam search. Word boundaries use THIS
+        model's alphabet (not the global default); an alphabet without a
+        space gets end-of-utterance scoring only."""
+        from tosem_tpu.ops.ctc import Scorer
+        space = (self.alphabet.index(" ") if " " in self.alphabet else -1)
+        new = Scorer(path, alpha=alpha, beta=beta, space_index=space)
+        # construct first, then swap: a failed load keeps the old scorer
+        with self._lock:
+            old, self._scorer = self._scorer, new
+            self._beam_width = beam_width
+        if old is not None:
+            old.close()
+
+    def disable_external_scorer(self) -> None:
+        with self._lock:
+            old, self._scorer = self._scorer, None
+        if old is not None:
+            old.close()
 
     # -- the four-call C surface -------------------------------------------
     def create_stream(self) -> int:
@@ -191,6 +240,7 @@ class CStreamingModel:
         self.lib.sp_free_stream(stream)
 
     def close(self) -> None:
+        self.disable_external_scorer()
         if self._model_p:
             self.lib.sp_free_model(self._model_p)
             self._model_p = None
