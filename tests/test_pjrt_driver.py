@@ -34,6 +34,21 @@ def test_export_gemm_loop_meta(tmp_path):
     assert meta[2] == "in data f32 32 32"
 
 
+@pytest.mark.slow
+def test_export_bert_and_resnet_artifacts(tmp_path):
+    from tosem_tpu.compile import export_bert_encoder
+    from tosem_tpu.compile.export import export_resnet_train_step
+    p1 = export_bert_encoder(str(tmp_path), batch=1, seq=8)
+    meta1 = open(p1["meta"]).read().splitlines()
+    assert meta1[0] == "in data s32 1 8"        # token ids
+    assert "stablehlo" in open(p1["mlir"]).read()[:4000]
+    p2 = export_resnet_train_step(str(tmp_path), batch=2)
+    meta2 = open(p2["meta"]).read().splitlines()
+    assert meta2[0] == "in data f32 2 32 32 3"
+    # loss + every updated param leaf come back out
+    assert sum(1 for l in meta2 if l.startswith("out")) > 10
+
+
 def test_driver_binary_builds():
     binary = build_binary("pjrt_driver")
     assert os.access(binary, os.X_OK)

@@ -49,6 +49,18 @@ def scorer_path(tmp_path_factory):
     return path
 
 
+def test_alphabet_stamp_roundtrip(scorer_path, tmp_path):
+    from tosem_tpu.data.scorer import read_scorer_alphabet
+    assert read_scorer_alphabet(scorer_path) == ALPHABET
+    # unstamped (older) package: truncate the trailing stamp → None
+    blob = open(scorer_path, "rb").read()
+    stamp_len = 4 + len(ALPHABET.encode())
+    old = tmp_path / "old.scorer"
+    old.write_bytes(blob[:-stamp_len])
+    assert read_scorer_alphabet(str(old)) is None
+    Scorer(str(old)).close()              # still loads in the decoder
+
+
 def test_scorer_loads_and_scores(scorer_path):
     sc = Scorer(scorer_path)
     assert sc.order == 3

@@ -217,6 +217,11 @@ class TestCStreamingAPI:
             cm.feed(s2, feats)
             assert isinstance(cm.finish(s2), str)     # greedy restored
             assert len(calls) == 1                    # no beam after disable
+            # alphabet-mismatch packages are rejected at enable time
+            bad = str(tmp_path / "mismatch.scorer")
+            build_scorer(["abc abc"], bad, alphabet="abcdef ")
+            with pytest.raises(ValueError, match="alphabet"):
+                cm.enable_external_scorer(bad)
         finally:
             cm.close()
 

@@ -1,5 +1,5 @@
 from tosem_tpu.compile.driver import default_plugin, run_driver
-from tosem_tpu.compile.export import (export_gemm, export_gemm_loop,
-                                      export_program,
+from tosem_tpu.compile.export import (export_bert_encoder, export_gemm,
+                                      export_gemm_loop, export_program,
                                       export_resnet_train_step,
                                       gemm_loop_fn, pattern_fill)

@@ -152,6 +152,28 @@ def export_gemm(out_dir: str, n: int = 256, dtype=jnp.float32,
                           name or f"gemm_{n}_{_dtype_name(dtype)}")
 
 
+def export_bert_encoder(out_dir: str, batch: int = 2, seq: int = 32,
+                        name: str = "bert_encoder") -> Dict[str, str]:
+    """BERT encoder forward as a deployable module (params as flat
+    leaves, the same native-host contract as the ResNet step)."""
+    from tosem_tpu.models.bert import bert_tiny
+
+    model = bert_tiny()
+    vs_shape = jax.eval_shape(model.init, jax.random.key(0))
+    flat, treedef = jax.tree_util.tree_flatten(vs_shape)
+
+    def encode(ids, mask, *leaves):
+        vs = jax.tree_util.tree_unflatten(treedef, leaves)
+        out, _ = model.apply(vs, ids, mask=mask)
+        return out.astype(jnp.float32)
+
+    sds = jax.ShapeDtypeStruct
+    args = (sds((batch, seq), jnp.int32),
+            sds((batch, seq), jnp.int32)) + tuple(
+                sds(l.shape, l.dtype) for l in flat)
+    return export_program(encode, args, out_dir, name)
+
+
 def export_resnet_train_step(out_dir: str, batch: int = 4,
                              num_classes: int = 10,
                              name: str = "resnet_step") -> Dict[str, str]:

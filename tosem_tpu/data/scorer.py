@@ -16,7 +16,7 @@ from __future__ import annotations
 import collections
 import math
 import struct
-from typing import Dict, Iterable, List, Sequence, Tuple
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 from tosem_tpu.data.audio import ALPHABET, text_to_labels
 
@@ -82,4 +82,32 @@ def build_scorer(texts: Iterable[str], path: str, *,
             f.write(struct.pack("<i", len(gram)))
             f.write(struct.pack(f"<{len(gram)}i", *gram))
             f.write(struct.pack("<f", logp))
+        # trailing alphabet stamp: the C++ loader reads exactly the
+        # entries above and ignores this; Python readers use it to
+        # reject packages built against a different label mapping
+        ab = alphabet.encode()
+        f.write(struct.pack("<I", len(ab)))
+        f.write(ab)
     return vocab
+
+
+def read_scorer_alphabet(path: str) -> Optional[str]:
+    """Return the alphabet a scorer package was built with (None for
+    packages predating the stamp)."""
+    with open(path, "rb") as f:
+        if f.read(4) != MAGIC:
+            raise ValueError(f"not a scorer package: {path}")
+        order, n_words, _, _ = struct.unpack("<iiff", f.read(16))
+        for _ in range(n_words):
+            (n,) = struct.unpack("<i", f.read(4))
+            f.seek(4 * n, 1)
+        (n_entries,) = struct.unpack("<i", f.read(4))
+        for _ in range(n_entries):
+            (n,) = struct.unpack("<i", f.read(4))
+            f.seek(4 * n + 4, 1)
+        tail = f.read(4)
+        if len(tail) < 4:
+            return None
+        (ab_len,) = struct.unpack("<I", tail)
+        ab = f.read(ab_len)
+        return ab.decode() if len(ab) == ab_len else None

@@ -148,11 +148,13 @@ class CStreamingModel:
             try:
                 arr = np.ctypeslib.as_array(
                     logits_p, (n_frames, cfg.n_classes))
-                # the lock pins the scorer for the whole decode: a
-                # concurrent disable/enable must not free the native LM
-                # handle mid-beam (use-after-free)
-                with self._lock:
-                    scorer = self._scorer
+                # refcounted acquire: the lock covers only the pointer
+                # grab, not the whole beam search — a concurrent
+                # disable/enable defers the native free until the last
+                # in-flight decode releases (no use-after-free, no
+                # global stall of other streams' infer callbacks)
+                scorer = self._acquire_scorer()
+                try:
                     if scorer is not None:
                         # DS_EnableExternalScorer path: LM-scored beam
                         from tosem_tpu.data.audio import labels_to_text
@@ -162,8 +164,11 @@ class CStreamingModel:
                             logp, blank=cfg.blank,
                             beam_width=self._beam_width, scorer=scorer)
                         text = labels_to_text(labels, alphabet)
-                if scorer is None:
-                    text = greedy_ctc_text(arr, alphabet, cfg.blank)
+                    else:
+                        text = greedy_ctc_text(arr, alphabet, cfg.blank)
+                finally:
+                    if scorer is not None:
+                        self._release_scorer(scorer)
                 data = text.encode()[:cap - 1]
                 ctypes.memmove(out, data + b"\0", len(data) + 1)
                 return 0
@@ -181,6 +186,28 @@ class CStreamingModel:
 
     # -- external scorer (DS_EnableExternalScorer:208 parity) --------------
 
+    def _acquire_scorer(self):
+        with self._lock:
+            sc = self._scorer
+            if sc is not None:
+                sc._refs = getattr(sc, "_refs", 0) + 1
+            return sc
+
+    def _release_scorer(self, sc) -> None:
+        with self._lock:
+            sc._refs -= 1
+            close_now = getattr(sc, "_retired", False) and sc._refs == 0
+        if close_now:
+            sc.close()
+
+    def _retire(self, sc) -> None:
+        """Close a swapped-out scorer once no decode holds it."""
+        with self._lock:
+            sc._retired = True
+            close_now = getattr(sc, "_refs", 0) == 0
+        if close_now:
+            sc.close()
+
     def enable_external_scorer(self, path: str, alpha: float = 1.8,
                                beta: float = 0.8,
                                beam_width: int = 16) -> None:
@@ -188,8 +215,16 @@ class CStreamingModel:
         :func:`tosem_tpu.data.scorer.build_scorer`): decodes switch from
         greedy to LM-scored beam search. Word boundaries use THIS
         model's alphabet (not the global default); an alphabet without a
-        space gets end-of-utterance scoring only."""
+        space gets end-of-utterance scoring only. A package stamped with
+        a different alphabet is rejected — mismatched label mappings
+        would silently degrade every word to OOV."""
+        from tosem_tpu.data.scorer import read_scorer_alphabet
         from tosem_tpu.ops.ctc import Scorer
+        stamped = read_scorer_alphabet(path)
+        if stamped is not None and stamped != self.alphabet:
+            raise ValueError(
+                f"scorer package was built with alphabet {stamped!r}, "
+                f"model uses {self.alphabet!r}")
         space = (self.alphabet.index(" ") if " " in self.alphabet else -1)
         new = Scorer(path, alpha=alpha, beta=beta, space_index=space)
         # construct first, then swap: a failed load keeps the old scorer
@@ -197,13 +232,13 @@ class CStreamingModel:
             old, self._scorer = self._scorer, new
             self._beam_width = beam_width
         if old is not None:
-            old.close()
+            self._retire(old)
 
     def disable_external_scorer(self) -> None:
         with self._lock:
             old, self._scorer = self._scorer, None
         if old is not None:
-            old.close()
+            self._retire(old)
 
     # -- the four-call C surface -------------------------------------------
     def create_stream(self) -> int:
