@@ -1,0 +1,116 @@
+"""Meta-learning warm start tests (SURVEY §2.6 auto-sklearn
+metalearning role)."""
+import numpy as np
+import pytest
+
+from tosem_tpu.automl import AutoML, MetaStore, metafeatures
+
+
+def _dataset(seed, n=120, d=6, classes=3, scale=1.0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d)) * scale
+    w = rng.normal(size=(d, classes))
+    y = np.argmax(X @ w + 0.3 * rng.normal(size=(n, classes)), axis=1)
+    return X.astype(np.float32), y
+
+
+class TestMetafeatures:
+    def test_signature_shape_and_determinism(self):
+        X, y = _dataset(0)
+        mf = metafeatures(X, y)
+        assert mf == metafeatures(X, y)
+        assert mf["n_classes"] == 3.0
+        assert mf["log_n_samples"] == pytest.approx(np.log(120))
+        assert 0.0 <= mf["class_entropy"] <= 1.0
+
+    def test_signature_separates_dataset_shapes(self):
+        Xa, ya = _dataset(0, n=120, d=6)
+        Xb, yb = _dataset(0, n=2000, d=40)
+        a, b = metafeatures(Xa, ya), metafeatures(Xb, yb)
+        assert a["log_n_features"] != b["log_n_features"]
+
+
+class TestMetaStore:
+    def test_record_suggest_nearest(self, tmp_path):
+        store = MetaStore(path=str(tmp_path / "meta.db"))
+        Xs, ys = _dataset(1, n=100, d=5)           # small family
+        Xl, yl = _dataset(2, n=3000, d=50)         # large family
+        cfg_small = {"clf": "knn", "prep": "scale"}
+        cfg_large = {"clf": "mlp", "prep": "pca"}
+        store.record(metafeatures(Xs, ys), cfg_small, 0.9)
+        store.record(metafeatures(Xl, yl), cfg_large, 0.8)
+        # a new dataset shaped like the small family → its config first
+        Xq, yq = _dataset(3, n=110, d=5)
+        got = store.suggest(metafeatures(Xq, yq), k=2)
+        assert got[0] == cfg_small
+        assert got[1] == cfg_large
+        # dedup: same config recorded twice suggests once
+        store.record(metafeatures(Xs, ys), cfg_small, 0.91,
+                     dataset_id="again")
+        assert store.suggest(metafeatures(Xq, yq), k=3) == \
+            [cfg_small, cfg_large]
+
+    def test_empty_store_suggests_nothing(self):
+        assert MetaStore().suggest({"log_n_samples": 1.0}) == []
+
+    def test_persists_across_instances(self, tmp_path):
+        path = str(tmp_path / "shared.db")
+        X, y = _dataset(4)
+        MetaStore(path=path).record(metafeatures(X, y), {"clf": "c"}, 0.5)
+        assert len(MetaStore(path=path).entries()) == 1
+
+    def test_concurrent_recorders_never_collide(self, tmp_path):
+        path = str(tmp_path / "c.db")
+        a, b = MetaStore(path=path), MetaStore(path=path)
+        X, y = _dataset(7)
+        mf = metafeatures(X, y)
+        # both instances see the same row count, record "simultaneously"
+        a.record(mf, {"clf": "a"}, 0.5)
+        b.record(mf, {"clf": "b"}, 0.6)
+        assert len(a.entries()) == 2           # no silent overwrite
+
+
+@pytest.mark.slow
+def test_partial_stored_config_completed_for_tpe(tmp_path):
+    # a stored config predating the current space (or hand-written,
+    # missing namespaced hyperparams) must be completed, not crash the
+    # TPE observation path
+    store = MetaStore(path=str(tmp_path / "p.db"))
+    X, y = _dataset(8)
+    store.record(metafeatures(X, y), {"clf": "logreg", "prep": "standard_scaler"},
+                 0.9)
+    a = AutoML(n_trials=6, max_concurrent=2, trial_timeout=120, seed=0,
+               searcher="tpe", meta_store=store, warm_starts=1)
+    a.fit(X, y)                                # must not raise
+    assert a.best_score_ > 0
+
+
+@pytest.mark.slow
+def test_warm_starts_zero_still_records(tmp_path):
+    store = MetaStore(path=str(tmp_path / "z.db"))
+    X, y = _dataset(9)
+    AutoML(n_trials=3, max_concurrent=2, trial_timeout=120, seed=0,
+           meta_store=store, warm_starts=0).fit(X, y)
+    assert len(store.entries()) == 1
+
+
+@pytest.mark.slow
+def test_automl_warm_start_uses_store(tmp_path):
+    store = MetaStore(path=str(tmp_path / "exp.db"))
+    X, y = _dataset(5)
+    # first fit populates the experience base
+    a1 = AutoML(n_trials=4, max_concurrent=2, trial_timeout=120,
+                seed=0, meta_store=store)
+    a1.fit(X, y)
+    assert len(store.entries()) == 1
+    recorded = store.entries()[0]["config"]
+    # second fit on a sibling dataset: the recorded winner is evaluated
+    # first (warm start) before the searcher's own suggestions
+    X2, y2 = _dataset(6)
+    a2 = AutoML(n_trials=2, max_concurrent=2, trial_timeout=120,
+                seed=1, meta_store=store, warm_starts=1)
+    a2.fit(X2, y2)
+    tried = [r.config for r in a2.records]
+    assert recorded in tried
+    assert a2.score(X2, y2) > 0.4
+    assert len(store.entries()) == 2

@@ -7,6 +7,7 @@ evaluation on the distributed runtime, and Caruana greedy ensembling.
 """
 from tosem_tpu.automl.automl import (AutoML, Pipeline, TrialRecord,
                                      greedy_ensemble, pipeline_space)
+from tosem_tpu.automl.metalearning import (MetaStore, metafeatures)
 from tosem_tpu.automl.estimators import (CLASSIFIERS, PREPROCESSORS,
                                          KNeighborsClassifier,
                                          LogisticRegression, MLPClassifier,
@@ -18,4 +19,5 @@ __all__ = [
     "pipeline_space", "CLASSIFIERS", "PREPROCESSORS",
     "LogisticRegression", "RidgeClassifier", "KNeighborsClassifier",
     "MLPClassifier", "PCA", "StandardScaler", "SelectKBest",
+    "MetaStore", "metafeatures",
 ]

@@ -14,6 +14,7 @@ not the experiment.
 """
 from __future__ import annotations
 
+import random
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
@@ -127,7 +128,8 @@ class AutoML:
     def __init__(self, n_trials: int = 30, searcher: str = "evolution",
                  ensemble_size: int = 8, holdout: float = 0.33,
                  trial_timeout: float = 60.0, max_concurrent: int = 4,
-                 seed: int = 0, verbose: bool = False):
+                 seed: int = 0, verbose: bool = False,
+                 meta_store=None, warm_starts: int = 3):
         self.n_trials = n_trials
         self.searcher = searcher
         self.ensemble_size = ensemble_size
@@ -136,6 +138,11 @@ class AutoML:
         self.max_concurrent = max_concurrent
         self.seed = seed
         self.verbose = verbose
+        # metalearning warm start (autosklearn metalearning role): the
+        # store's nearest-dataset configs are evaluated before the
+        # searcher's own suggestions; fit() records the winner back
+        self.meta_store = meta_store
+        self.warm_starts = warm_starts
         self.records: List[TrialRecord] = []
         # seam for fault-injection tests (hung/crashing evaluation), the
         # role pynisher's subprocess boundary plays in auto-sklearn
@@ -162,6 +169,29 @@ class AutoML:
                 4, self.n_trials // 4))
         alg.set_space(space, "max")
 
+        self._warm_configs: List[Dict[str, Any]] = []
+        self._mf = None
+        if self.meta_store is not None:
+            # metafeatures whenever a store is attached: warm_starts=0
+            # must still RECORD experience even if it consumes none
+            from tosem_tpu.automl.metalearning import metafeatures
+            self._mf = metafeatures(X, y)
+            if self.warm_starts > 0:
+                # stored configs can predate space changes (new
+                # estimators/hyperparams) or be partial: complete every
+                # warm config against the CURRENT space so searchers can
+                # observe it without KeyErrors
+                warm_rng = random.Random(self.seed)
+                for cfg in self.meta_store.suggest(self._mf,
+                                                   k=self.warm_starts):
+                    full = sample_config(space, warm_rng)
+                    full.update({k: v for k, v in cfg.items()
+                                 if k in space})
+                    self._warm_configs.append(full)
+            if self.verbose and self._warm_configs:
+                print(f"[automl] {len(self._warm_configs)} metalearning "
+                      "warm starts")
+
         own_rt = not rt.is_initialized()
         if own_rt:
             # spawn: pipeline fits run jax in the workers — forked XLA
@@ -182,6 +212,9 @@ class AutoML:
                 Pipeline(cfg).fit(X, y) for cfg in self.ensemble_configs_]
             self.best_config_ = ok[0].config
             self.best_score_ = ok[0].accuracy
+            if self.meta_store is not None and self._mf is not None:
+                self.meta_store.record(self._mf, self.best_config_,
+                                       self.best_score_)
         finally:
             if own_rt:
                 rt.shutdown()
@@ -197,9 +230,13 @@ class AutoML:
         yv_ref = rt.put(y_val)
         cls_ref = rt.put(self.classes_)
 
+        warm = list(getattr(self, "_warm_configs", []))
+
         def launch():
             nonlocal launched
-            cfg = alg.suggest()
+            # metalearning warm starts first, then the searcher's own
+            # suggestions (initial_configurations_via_metalearning order)
+            cfg = warm.pop(0) if warm else alg.suggest()
             ref = eval_fn.options(max_retries=0).remote(
                 cfg, Xtr_ref, ytr_ref, Xv_ref, yv_ref, cls_ref)
             pending.append((cfg, ref, time.monotonic()))

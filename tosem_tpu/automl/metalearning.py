@@ -1,0 +1,107 @@
+"""Meta-learning warm starts (the auto-sklearn metalearning subsystem).
+
+auto-sklearn seeds its Bayesian optimization with configurations that
+worked on the k nearest datasets by metafeature distance
+(`autosklearn/metalearning/` — metafeature computation +
+k-nearest-datasets + `initial_configurations_via_metalearning`). Same
+design here: :func:`metafeatures` computes a cheap numeric signature,
+:class:`MetaStore` persists (signature → best config, score) rows in the
+cluster KV (so experience accumulates across processes and sessions),
+and ``suggest`` returns the best configs of the nearest datasets for
+:class:`~tosem_tpu.automl.automl.AutoML` to evaluate before the
+searcher takes over.
+"""
+from __future__ import annotations
+
+import json
+import math
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from tosem_tpu.cluster.kv import KVStore
+
+_NS = "metalearn"
+
+# normalization scales so no single metafeature dominates the distance
+_FEATURES = ("log_n_samples", "log_n_features", "n_classes",
+             "class_entropy", "imbalance", "mean_std", "mean_abs_skew")
+
+
+def metafeatures(X: np.ndarray, y: np.ndarray) -> Dict[str, float]:
+    """Cheap dataset signature (the metafeature-subset auto-sklearn's
+    KND actually uses: dims, class shape, simple moments)."""
+    X = np.asarray(X, np.float64)
+    y = np.asarray(y)
+    n, d = X.shape
+    _, counts = np.unique(y, return_counts=True)
+    p = counts / counts.sum()
+    entropy = float(-(p * np.log(p + 1e-12)).sum() / math.log(max(len(p), 2)))
+    std = X.std(axis=0)
+    centered = X - X.mean(axis=0)
+    skew = np.where(std > 1e-12,
+                    (centered ** 3).mean(axis=0) / (std ** 3 + 1e-12), 0.0)
+    return {
+        "log_n_samples": math.log(max(n, 1)),
+        "log_n_features": math.log(max(d, 1)),
+        "n_classes": float(len(counts)),
+        "class_entropy": entropy,
+        "imbalance": float(counts.max() / max(counts.min(), 1)),
+        "mean_std": float(std.mean()),
+        "mean_abs_skew": float(np.abs(skew).mean()),
+    }
+
+
+def _vector(mf: Dict[str, float]) -> np.ndarray:
+    return np.array([float(mf.get(k, 0.0)) for k in _FEATURES])
+
+
+class MetaStore:
+    """Experience base: dataset signatures and their best pipelines."""
+
+    def __init__(self, kv: Optional[KVStore] = None,
+                 path: Optional[str] = None):
+        self.kv = kv or KVStore(path or ":memory:")
+
+    def record(self, mf: Dict[str, float], config: Dict[str, Any],
+               score: float, dataset_id: Optional[str] = None) -> str:
+        import uuid
+        # uuid keys, not a count: concurrent recorders sharing the db
+        # must never compute the same key and silently overwrite
+        key = dataset_id or f"ds_{uuid.uuid4().hex[:12]}"
+        blob = json.dumps({"metafeatures": mf, "config": config,
+                           "score": float(score)}, sort_keys=True).encode()
+        self.kv.put(_NS, key, blob)
+        return key
+
+    def entries(self) -> List[Dict[str, Any]]:
+        out = []
+        for k in self.kv.keys(_NS):
+            blob = self.kv.get(_NS, k)
+            if blob is not None:
+                out.append(dict(json.loads(blob), dataset_id=k))
+        return out
+
+    def suggest(self, mf: Dict[str, float], k: int = 3
+                ) -> List[Dict[str, Any]]:
+        """Configs of the k nearest datasets (deduped, nearest first) —
+        ``initial_configurations_via_metalearning``."""
+        rows = self.entries()
+        if not rows:
+            return []
+        target = _vector(mf)
+        vecs = np.stack([_vector(r["metafeatures"]) for r in rows])
+        # per-dimension robust scale over the experience base
+        scale = np.maximum(np.abs(vecs).max(axis=0), 1e-9)
+        dist = np.linalg.norm((vecs - target) / scale, axis=1)
+        order = np.argsort(dist)
+        seen, out = set(), []
+        for i in order:
+            cfg = rows[int(i)]["config"]
+            key = json.dumps(cfg, sort_keys=True)
+            if key not in seen:
+                seen.add(key)
+                out.append(cfg)
+            if len(out) >= k:
+                break
+        return out
