@@ -5,6 +5,9 @@ sampling loop compiles into ``lax.scan`` on device (:mod:`.env`, :mod:`.ppo`)
 and the DD-PPO topology maps to a GSPMD data-parallel update fed by actor
 rollout workers (:mod:`.workers`).
 """
+from tosem_tpu.rl.dqn import (DQNConfig, QNetwork, ReplayState, dqn_loss,
+                              make_dqn_update, replay_add, replay_init,
+                              replay_sample, train_dqn)
 from tosem_tpu.rl.env import CartPole, EnvSpec, batch_reset, batch_step
 from tosem_tpu.rl.gae import gae_advantages
 from tosem_tpu.rl.policy import ActorCritic, entropy, log_prob, sample_action
@@ -18,4 +21,6 @@ __all__ = [
     "ActorCritic", "entropy", "log_prob", "sample_action", "PPOConfig",
     "Trajectory", "flatten_trajectory", "make_ppo_update", "ppo_loss",
     "rollout", "run_epochs", "train_ppo", "DistributedPPO", "RolloutWorker",
+    "DQNConfig", "QNetwork", "ReplayState", "dqn_loss", "make_dqn_update",
+    "replay_add", "replay_init", "replay_sample", "train_dqn",
 ]

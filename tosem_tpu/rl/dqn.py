@@ -1,0 +1,227 @@
+"""DQN with a device-resident replay buffer (the RLlib DQN family).
+
+The reference's DQN stack (`rllib/agents/dqn/` — replay buffer in host
+memory, worker rollouts, target-network sync, double-DQN TD loss). TPU
+re-design:
+
+- **The replay buffer is a pytree of preallocated device arrays**
+  (`ReplayState`) updated functionally inside jit: insertion is a
+  vectorized wraparound `.at[].set`, sampling is one `randint` gather —
+  no host round trips in the act→store→sample→learn cycle, the whole
+  iteration is a handful of compiled programs.
+- **Epsilon-greedy collection runs as a `lax.scan`** over vectorized
+  envs, like the PPO rollouts.
+- **Double DQN + Huber** by default; the target network is a second
+  params pytree synced by tree copy every ``target_sync_every`` updates.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+
+from tosem_tpu.rl.env import batch_reset, batch_step
+from tosem_tpu.nn.core import Module, variables
+from tosem_tpu.nn.layers import Dense, relu
+
+
+class DQNConfig(NamedTuple):
+    gamma: float = 0.99
+    lr: float = 1e-3
+    batch_size: int = 128
+    buffer_capacity: int = 10_000
+    min_buffer: int = 500            # learn only after this many rows
+    eps_start: float = 1.0
+    eps_end: float = 0.05
+    eps_decay_steps: int = 2_000
+    target_sync_every: int = 200     # updates between target copies
+    double_dqn: bool = True
+    n_envs: int = 8
+    rollout_len: int = 32
+    updates_per_iter: int = 8        # learner/actor ratio
+    hidden: int = 64
+
+
+class QNetwork(Module):
+    """MLP obs → Q-values."""
+
+    def __init__(self, obs_dim: int, n_actions: int, hidden: int = 64):
+        self.l1 = Dense(obs_dim, hidden)
+        self.l2 = Dense(hidden, hidden)
+        self.head = Dense(hidden, n_actions)
+
+    def init(self, key):
+        k1, k2, k3 = jax.random.split(key, 3)
+        return variables({"l1": self.l1.init(k1)["params"],
+                          "l2": self.l2.init(k2)["params"],
+                          "head": self.head.init(k3)["params"]})
+
+    def apply(self, vs, x, *, train=False, rng=None):
+        p = vs["params"]
+        h, _ = self.l1.apply(variables(p["l1"]), x)
+        h = relu(h)
+        h, _ = self.l2.apply(variables(p["l2"]), h)
+        h = relu(h)
+        q, _ = self.head.apply(variables(p["head"]), h)
+        return q, vs["state"]
+
+
+# ------------------------------------------------------------- replay
+
+class ReplayState(NamedTuple):
+    obs: jax.Array          # [cap, obs_dim]
+    actions: jax.Array      # [cap] int32
+    rewards: jax.Array      # [cap]
+    next_obs: jax.Array     # [cap, obs_dim]
+    terminated: jax.Array   # [cap] bool — bootstrap mask (not truncation)
+    size: jax.Array         # [] int32
+    pos: jax.Array          # [] int32
+
+
+def replay_init(capacity: int, obs_dim: int) -> ReplayState:
+    z = jnp.zeros
+    return ReplayState(z((capacity, obs_dim)), z((capacity,), jnp.int32),
+                       z((capacity,)), z((capacity, obs_dim)),
+                       z((capacity,), bool), jnp.int32(0), jnp.int32(0))
+
+
+def replay_add(rs: ReplayState, obs, actions, rewards, next_obs,
+               terminated) -> ReplayState:
+    """Vectorized circular insert of n transitions (wraparound gather)."""
+    cap = rs.obs.shape[0]
+    n = obs.shape[0]
+    if n > cap:
+        # repeated scatter indices have unspecified write order — the
+        # buffer would silently become nondeterministic
+        raise ValueError(f"batch of {n} exceeds buffer capacity {cap}; "
+                         "grow the buffer or shrink the rollout")
+    idx = (rs.pos + jnp.arange(n)) % cap
+    return ReplayState(
+        rs.obs.at[idx].set(obs),
+        rs.actions.at[idx].set(actions.astype(jnp.int32)),
+        rs.rewards.at[idx].set(rewards),
+        rs.next_obs.at[idx].set(next_obs),
+        rs.terminated.at[idx].set(terminated),
+        jnp.minimum(rs.size + n, cap),
+        (rs.pos + n) % cap,
+    )
+
+
+def replay_sample(rs: ReplayState, key, batch: int) -> Dict[str, jax.Array]:
+    idx = jax.random.randint(key, (batch,), 0, jnp.maximum(rs.size, 1))
+    return {"obs": rs.obs[idx], "actions": rs.actions[idx],
+            "rewards": rs.rewards[idx], "next_obs": rs.next_obs[idx],
+            "terminated": rs.terminated[idx]}
+
+
+# ------------------------------------------------------------- learning
+
+def dqn_loss(model: QNetwork, params, target_params,
+             batch: Dict[str, jax.Array], cfg: DQNConfig) -> jax.Array:
+    q, _ = model.apply(variables(params), batch["obs"])
+    q_sa = jnp.take_along_axis(q, batch["actions"][:, None], 1)[:, 0]
+    q_next_t, _ = model.apply(variables(target_params), batch["next_obs"])
+    if cfg.double_dqn:
+        # online net picks the action, target net evaluates it
+        q_next_o, _ = model.apply(variables(params), batch["next_obs"])
+        a_star = jnp.argmax(q_next_o, axis=1)
+        next_v = jnp.take_along_axis(q_next_t, a_star[:, None], 1)[:, 0]
+    else:
+        next_v = jnp.max(q_next_t, axis=1)
+    target = batch["rewards"] + cfg.gamma * next_v * (
+        1.0 - batch["terminated"].astype(jnp.float32))
+    # Huber (delta=1): the DQN-paper gradient clipping
+    return jnp.mean(optax.huber_loss(q_sa, lax.stop_gradient(target),
+                                     delta=1.0))
+
+
+def make_dqn_update(model: QNetwork, optimizer, cfg: DQNConfig):
+    @jax.jit
+    def update(params, target_params, opt_state, batch):
+        loss, grads = jax.value_and_grad(
+            lambda p: dqn_loss(model, p, target_params, batch, cfg))(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+    return update
+
+
+def epsilon(cfg: DQNConfig, step) -> jax.Array:
+    frac = jnp.clip(step / cfg.eps_decay_steps, 0.0, 1.0)
+    return cfg.eps_start + frac * (cfg.eps_end - cfg.eps_start)
+
+
+def make_collect(model: QNetwork, env, cfg: DQNConfig):
+    """lax.scan epsilon-greedy rollout over vectorized envs; returns the
+    transitions plus episode-return bookkeeping."""
+
+    @jax.jit
+    def collect(params, env_states, key, eps, ep_ret, ep_done_ret):
+        def body(carry, k):
+            states, ep_ret, done_ret = carry
+            obs = jax.vmap(env.obs)(states)
+            q, _ = model.apply(variables(params), obs)
+            ka, ke = jax.random.split(k)
+            greedy = jnp.argmax(q, axis=1)
+            rand = jax.random.randint(ka, greedy.shape, 0,
+                                      env.spec.n_actions)
+            explore = jax.random.uniform(ke, greedy.shape) < eps
+            act = jnp.where(explore, rand, greedy)
+            nxt, nobs, rew, term, trunc = batch_step(env, states, act)
+            ep_ret = ep_ret + rew
+            done = term | trunc
+            done_ret = jnp.where(done, ep_ret, done_ret)
+            ep_ret = jnp.where(done, 0.0, ep_ret)
+            return (nxt, ep_ret, done_ret), (obs, act, rew, nobs, term)
+
+        keys = jax.random.split(key, cfg.rollout_len)
+        (states, ep_ret, ep_done_ret), tr = lax.scan(
+            body, (env_states, ep_ret, ep_done_ret), keys)
+        obs, act, rew, nobs, term = jax.tree_util.tree_map(
+            lambda x: x.reshape((-1,) + x.shape[2:]), tr)
+        return states, ep_ret, ep_done_ret, obs, act, rew, nobs, term
+
+    return collect
+
+
+def train_dqn(env, *, cfg: DQNConfig = DQNConfig(), iterations: int = 60,
+              seed: int = 0):
+    """→ (params, model, per-iteration mean finished-episode returns)."""
+    key = jax.random.key(seed)
+    k_init, k_env, key = jax.random.split(key, 3)
+    model = QNetwork(env.spec.obs_dim, env.spec.n_actions, cfg.hidden)
+    params = model.init(k_init)["params"]
+    target_params = jax.tree_util.tree_map(jnp.copy, params)
+    optimizer = optax.adam(cfg.lr)
+    opt_state = optimizer.init(params)
+    update = make_dqn_update(model, optimizer, cfg)
+    collect = make_collect(model, env, cfg)
+
+    rs = replay_init(cfg.buffer_capacity, env.spec.obs_dim)
+    add = jax.jit(replay_add)
+    sample = jax.jit(replay_sample, static_argnums=(2,))
+    env_states = batch_reset(env, k_env, cfg.n_envs)
+    ep_ret = jnp.zeros(cfg.n_envs)
+    ep_done_ret = jnp.zeros(cfg.n_envs)
+    returns, env_steps, n_updates = [], 0, 0
+    for _ in range(iterations):
+        key, kc = jax.random.split(key)
+        eps = epsilon(cfg, env_steps)
+        (env_states, ep_ret, ep_done_ret, obs, act, rew, nobs,
+         term) = collect(params, env_states, kc, eps, ep_ret, ep_done_ret)
+        rs = add(rs, obs, act, rew, nobs, term)
+        env_steps += cfg.n_envs * cfg.rollout_len
+        if int(rs.size) >= cfg.min_buffer:
+            for _ in range(cfg.updates_per_iter):
+                key, ks = jax.random.split(key)
+                batch = sample(rs, ks, cfg.batch_size)
+                params, opt_state, _ = update(params, target_params,
+                                              opt_state, batch)
+                n_updates += 1
+                if n_updates % cfg.target_sync_every == 0:
+                    target_params = jax.tree_util.tree_map(jnp.copy,
+                                                           params)
+        returns.append(float(jnp.mean(ep_done_ret)))
+    return params, model, returns
