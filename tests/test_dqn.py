@@ -35,6 +35,13 @@ class TestReplay:
         # only filled rows are sampled (all ones, never zeros)
         assert float(b["obs"].min()) == 1.0
 
+    def test_oversized_batch_rejected(self):
+        rs = replay_init(4, 2)
+        obs = jnp.ones((6, 2))
+        with pytest.raises(ValueError, match="exceeds buffer capacity"):
+            replay_add(rs, obs, jnp.zeros(6, jnp.int32), jnp.ones(6),
+                       obs, jnp.zeros(6, bool))
+
     def test_replay_ops_jit(self):
         rs = replay_init(8, 2)
         add = jax.jit(replay_add)
@@ -68,13 +75,6 @@ class TestLoss:
         # the bootstrap MUST change the loss; equality means the
         # (1 - terminated) factor is gone
         assert float(l_term) != float(l_boot)
-
-    def test_oversized_batch_rejected(self):
-        rs = replay_init(4, 2)
-        obs = jnp.ones((6, 2))
-        with pytest.raises(ValueError, match="exceeds buffer capacity"):
-            replay_add(rs, obs, jnp.zeros(6, jnp.int32), jnp.ones(6),
-                       obs, jnp.zeros(6, bool))
 
     def test_gradients_flow(self):
         model, params, batch = self._setup()

@@ -1,0 +1,109 @@
+"""Pipeline parallelism tests (pp axis — exceeds the reference's
+parallelism portfolio; the GPipe/ppermute pattern)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from tosem_tpu.parallel.pipeline import (make_pipeline_fn, microbatch,
+                                         stack_stage_params, unmicrobatch)
+
+D = 8
+
+
+def stage_fn(p, x):
+    return jax.nn.relu(x @ p["w"] + p["b"])
+
+
+def _params(key, n_stages):
+    ks = jax.random.split(key, n_stages)
+    per_stage = [{"w": jax.random.normal(k, (D, D)) * 0.4,
+                  "b": jnp.zeros(D)} for k in ks]
+    return per_stage, stack_stage_params(per_stage)
+
+
+def _sequential(per_stage, x):
+    for p in per_stage:
+        x = stage_fn(p, x)
+    return x
+
+
+@pytest.fixture
+def pp_mesh(devices8):
+    return Mesh(np.array(devices8[:4]), ("pp",))
+
+
+class TestPipeline:
+    @pytest.mark.parametrize("n_micro", [1, 2, 8])
+    def test_matches_sequential(self, pp_mesh, n_micro):
+        per_stage, stacked = _params(jax.random.key(0), 4)
+        B = 16
+        x = jax.random.normal(jax.random.key(1), (B, D))
+        want = _sequential(per_stage, x)
+        fwd = make_pipeline_fn(stage_fn, pp_mesh, n_micro=n_micro)
+        got = unmicrobatch(fwd(stacked, microbatch(x, n_micro)))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_jit_and_grads_match_sequential(self, pp_mesh):
+        per_stage, stacked = _params(jax.random.key(2), 4)
+        x = jax.random.normal(jax.random.key(3), (8, D))
+        y = jax.random.normal(jax.random.key(4), (8, D))
+        fwd = make_pipeline_fn(stage_fn, pp_mesh, n_micro=4)
+
+        def loss_pipe(p):
+            out = unmicrobatch(fwd(p, microbatch(x, 4)))
+            return jnp.mean((out - y) ** 2)
+
+        def loss_seq(ps):
+            return jnp.mean((_sequential(ps, x) - y) ** 2)
+
+        g_pipe = jax.jit(jax.grad(loss_pipe))(stacked)
+        g_seq = jax.grad(loss_seq)(per_stage)
+        for s in range(4):
+            np.testing.assert_allclose(
+                np.asarray(g_pipe["w"][s]), np.asarray(g_seq[s]["w"]),
+                rtol=1e-4, atol=1e-5)
+
+    def test_pipelined_training_step(self, pp_mesh):
+        per_stage, stacked = _params(jax.random.key(5), 4)
+        x = jax.random.normal(jax.random.key(6), (16, D))
+        # realizable target: another pipeline net's output (loss → ~0)
+        teacher, _ = _params(jax.random.key(7), 4)
+        y = _sequential(teacher, x)
+        fwd = make_pipeline_fn(stage_fn, pp_mesh, n_micro=8)
+
+        @jax.jit
+        def step(p):
+            def loss(p):
+                out = unmicrobatch(fwd(p, microbatch(x, 8)))
+                return jnp.mean((out - y) ** 2)
+            l, g = jax.value_and_grad(loss)(p)
+            return jax.tree_util.tree_map(lambda a, b: a - 0.05 * b,
+                                          p, g), l
+
+        losses = []
+        for _ in range(100):
+            stacked, l = step(stacked)
+            losses.append(float(l))
+        # steady monotone-ish improvement is the contract here; gradient
+        # EXACTNESS vs the sequential net is pinned by the test above
+        assert losses[-1] < 0.75 * losses[0], (losses[0], losses[-1])
+        assert losses[-1] == min(losses)
+
+    def test_microbatch_count_mismatch_rejected(self, pp_mesh):
+        _, stacked = _params(jax.random.key(8), 4)
+        fwd = make_pipeline_fn(stage_fn, pp_mesh, n_micro=4)
+        x = jax.random.normal(jax.random.key(9), (16, D))
+        with pytest.raises(ValueError, match="microbatches"):
+            fwd(stacked, microbatch(x, 8))    # 8 fed, built for 4
+
+    def test_microbatch_helpers(self):
+        x = jnp.arange(24.0).reshape(12, 2)
+        mb = microbatch(x, 4)
+        assert mb.shape == (4, 3, 2)
+        np.testing.assert_array_equal(np.asarray(unmicrobatch(mb)),
+                                      np.asarray(x))
+        with pytest.raises(ValueError):
+            microbatch(x, 5)

@@ -1,6 +1,8 @@
 from tosem_tpu.parallel.mesh import (MeshSpec, make_mesh, default_mesh,
                                      multihost_init)
 from tosem_tpu.parallel.cluster import ClusterResult, LocalCluster
+from tosem_tpu.parallel.pipeline import (make_pipeline_fn, microbatch,
+                                         stack_stage_params, unmicrobatch)
 from tosem_tpu.parallel.collectives import (CollectiveSpec, collective_bench,
                                             bus_bandwidth_factor,
                                             DEFAULT_COLLECTIVE_SWEEP,
