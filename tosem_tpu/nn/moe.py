@@ -1,0 +1,130 @@
+"""Mixture-of-Experts with expert parallelism (the ``ep`` mesh axis).
+
+Completes the framework's parallelism portfolio (dp/tp/sp/pp elsewhere).
+GShard/Switch-style design, TPU-first throughout:
+
+- **Dense dispatch**: routing materializes one-hot dispatch/combine
+  tensors and moves tokens with einsums — static shapes, no gather
+  scatter with dynamic sizes, so XLA lowers the whole layer to MXU
+  matmuls. Capacity ``C`` bounds per-expert work; overflow tokens are
+  dropped deterministically by position (their combine weight is 0 and
+  the residual path carries them).
+- **Expert parallelism via GSPMD**: expert-stacked params ``[E, ...]``
+  annotated ``P("ep")`` make XLA insert the token all-to-alls; the
+  layer's math is identical on one device or an ``ep`` mesh
+  (:func:`moe_rules` gives the partition specs, tested for parity).
+- **Load-balancing aux loss** (Switch §2.2 shape): E · Σ_e f_e · p_e,
+  minimized when routing is uniform — add it to the task loss.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tosem_tpu.nn.core import Module, variables
+from tosem_tpu.nn.layers import _he_normal, gelu
+
+
+class MoELayer(Module):
+    """Top-k routed expert MLP block: [N, d] → [N, d].
+
+    ``capacity_factor``: C = ceil(k·N/E · factor). ``k``: experts per
+    token (2 = GShard, 1 = Switch).
+    """
+
+    def __init__(self, dim: int, n_experts: int, *, hidden: int = 0,
+                 k: int = 2, capacity_factor: float = 1.25,
+                 dtype=jnp.float32):
+        if k > n_experts:
+            raise ValueError(f"k={k} routed experts per token exceeds "
+                             f"n_experts={n_experts}")
+        self.dim = dim
+        self.n_experts = n_experts
+        self.hidden = hidden or 4 * dim
+        self.k = k
+        self.capacity_factor = capacity_factor
+        self.dtype = dtype
+
+    def init(self, key) -> Dict[str, Any]:
+        kg, k1, k2 = jax.random.split(key, 3)
+        E, d, h = self.n_experts, self.dim, self.hidden
+        return variables({
+            "gate": _he_normal(kg, (d, E), d, self.dtype),
+            "w1": _he_normal(k1, (E, d, h), d, self.dtype),
+            "b1": jnp.zeros((E, h), self.dtype),
+            "w2": _he_normal(k2, (E, h, d), h, self.dtype),
+            "b2": jnp.zeros((E, d), self.dtype),
+        })
+
+    def capacity(self, n_tokens: int) -> int:
+        import math
+        return max(1, math.ceil(self.k * n_tokens / self.n_experts
+                                * self.capacity_factor))
+
+    def apply(self, vs, x, *, train: bool = False, rng=None):
+        """→ ((y, aux_loss), state). ``x``: [N, dim] flat tokens."""
+        p = vs["params"]
+        N, d = x.shape
+        E, k = self.n_experts, self.k
+        C = self.capacity(N)
+
+        logits = x @ p["gate"]                          # [N, E]
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        top_p, top_e = jax.lax.top_k(probs, k)          # [N, k]
+        top_p = top_p / jnp.maximum(
+            top_p.sum(-1, keepdims=True), 1e-9)         # renormalize
+
+        # position of each (token, choice) within its expert's queue:
+        # deterministic priority by (token index, choice rank)
+        sel = jax.nn.one_hot(top_e, E, dtype=jnp.float32)   # [N, k, E]
+        flat_sel = sel.reshape(N * k, E)                # row-major order
+        pos = jnp.cumsum(flat_sel, axis=0) - flat_sel   # rank in queue
+        pos = (pos * flat_sel).sum(-1).reshape(N, k)    # [N, k]
+        keep = pos < C                                  # overflow dropped
+        slot = jax.nn.one_hot(pos.astype(jnp.int32), C,
+                              dtype=jnp.float32)        # [N, k, C]
+
+        dispatch = jnp.einsum("nke,nkc,nk->nec", sel, slot,
+                              keep.astype(jnp.float32))  # [N, E, C]
+        combine = jnp.einsum("nec,nk,nke->nec", dispatch, top_p,
+                             sel)                        # weighted
+
+        xin = jnp.einsum("nec,nd->ecd", dispatch,
+                         x.astype(jnp.float32))          # [E, C, d]
+        h = gelu(jnp.einsum("ecd,edh->ech", xin,
+                            p["w1"].astype(jnp.float32))
+                 + p["b1"][:, None, :])
+        out = (jnp.einsum("ech,ehd->ecd", h,
+                          p["w2"].astype(jnp.float32))
+               + p["b2"][:, None, :])                    # [E, C, d]
+        y = jnp.einsum("nec,ecd->nd", combine, out).astype(x.dtype)
+
+        # Switch load-balance loss: E * sum_e f_e * p_e (f = token
+        # fraction routed to e by top-1, p = mean gate prob)
+        f = jnp.mean(jax.nn.one_hot(top_e[:, 0], E), axis=0)
+        pbar = jnp.mean(probs, axis=0)
+        aux = E * jnp.sum(f * pbar)
+        return (y, aux), vs["state"]
+
+
+def moe_rules(ep_axis: str = "ep"):
+    """Partition specs for the expert-stacked params: experts sharded
+    over ``ep``, everything else replicated — GSPMD inserts the token
+    all-to-alls around the expert einsums."""
+    from jax.sharding import PartitionSpec as P
+    return {
+        "gate": P(),
+        "w1": P(ep_axis, None, None),
+        "b1": P(ep_axis, None),
+        "w2": P(ep_axis, None, None),
+        "b2": P(ep_axis, None),
+    }
+
+
+def shard_moe_params(params, mesh, ep_axis: str = "ep"):
+    from jax.sharding import NamedSharding
+    rules = moe_rules(ep_axis)
+    return {kk: jax.device_put(v, NamedSharding(mesh, rules[kk]))
+            for kk, v in params.items()}

@@ -31,14 +31,8 @@ from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
-from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-
-try:                                   # jax >= 0.8
-    from jax import shard_map
-except ImportError:                    # pragma: no cover - older jax
-    from jax.experimental.shard_map import shard_map
+from jax import lax, shard_map
+from jax.sharding import Mesh, PartitionSpec as P
 
 
 def stack_stage_params(per_stage_params) -> Any:
@@ -103,11 +97,9 @@ def make_pipeline_fn(stage_fn: Callable, mesh: Mesh, *, n_micro: int,
     """
     pspec = param_spec or P(axis)
     body = partial(_pipeline_body, stage_fn, n_micro, axis)
-    kw = dict(mesh=mesh, in_specs=(pspec, P()), out_specs=P())
-    try:                      # per-device divergent control needs the
-        return shard_map(body, check_vma=False, **kw)   # jax >= 0.8
-    except TypeError:
-        return shard_map(body, check_rep=False, **kw)   # older jax
+    # check_vma off: per-device divergent control (stage-indexed wheres)
+    return shard_map(body, mesh=mesh, in_specs=(pspec, P()),
+                     out_specs=P(), check_vma=False)
 
 
 def microbatch(x: jax.Array, n_micro: int) -> jax.Array:
