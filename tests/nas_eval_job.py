@@ -1,0 +1,18 @@
+"""Worker-importable NAS evaluators (spawn-mode workers import these by
+module path, like cluster_jobs.py)."""
+
+
+def oracle_eval(cfg):
+    """Same hill-climbable landscape as test_nas._oracle, over the
+    serialized config form the parallel searcher ships to workers."""
+    from tosem_tpu.nas import Graph
+    g = Graph.from_config(cfg)
+    dense = [n for n in g.nodes if n.op == "dense"]
+    score = 0.0
+    for n in dense:
+        c = n.cfg()
+        score += (1.0 if c.get("dim") == 64 else 0.0)
+        score += (1.0 if c.get("act") == "gelu" else 0.0)
+    score += sum(len(n.inputs) - 1 for n in g.nodes)
+    score -= abs(len(dense) - 4) * 0.5
+    return score

@@ -4,4 +4,5 @@ from tosem_tpu.nas.mutator import (AddSkip, InsertNode, Mutator, RemoveNode,
                                    ResizeDense, SearchSpace, SwapActivation,
                                    default_mutators, mutate, random_graph)
 from tosem_tpu.nas.search import (SearchResult, evolution_search,
-                                  make_train_evaluator, random_search)
+                                  make_train_evaluator,
+                                  parallel_evolution_search, random_search)

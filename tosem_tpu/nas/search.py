@@ -102,6 +102,113 @@ def evolution_search(space: SearchSpace,
     return SearchResult(best, best_score, hist, evaluations=spent)
 
 
+def parallel_evolution_search(space: SearchSpace,
+                              evaluate_target: str,
+                              budget: int,
+                              population_size: int = 16,
+                              sample_size: int = 4,
+                              seed: int = 0,
+                              max_concurrent: int = 4,
+                              evaluate_kwargs: Optional[dict] = None
+                              ) -> SearchResult:
+    """Asynchronous regularized evolution with evaluations fanned out to
+    the distributed runtime (the Retiarii execution-engine role: the
+    strategy proposes, trial jobs evaluate in parallel workers).
+
+    ``evaluate_target``: a ``"module:function"`` path resolving to
+    ``fn(config_dict, **evaluate_kwargs) -> float`` — workers are fresh
+    processes, so the evaluator must be importable (it receives
+    ``Graph.to_config()`` and rebuilds via ``Graph.from_config``).
+    Async aging: up to ``max_concurrent`` candidates evaluate at once;
+    each completion observes and breeds the next child.
+    """
+    import importlib
+
+    import tosem_tpu.runtime as rt
+
+    mod, _, attr = evaluate_target.partition(":")
+    if not attr:
+        raise ValueError("evaluate_target must be 'module:function'")
+    getattr(importlib.import_module(mod), attr)   # validate early
+
+    def _eval(cfg, target, kw):
+        import importlib as _il
+        m, _, a = target.partition(":")
+        fn = getattr(_il.import_module(m), a)
+        return float(fn(cfg, **(kw or {})))
+
+    rng = random.Random(seed)
+    muts = default_mutators(space)
+    memo: Dict[str, float] = {}
+    hist: List[Tuple[str, float]] = []
+    best, best_score = None, float("-inf")
+    population: collections.deque = collections.deque()
+    max_proposals = max(budget * 20, 100)   # saturated-space backstop
+
+    own_rt = not rt.is_initialized()
+    if own_rt:
+        rt.init(num_workers=max_concurrent, start_method="spawn")
+    try:
+        remote_eval = rt.remote(_eval)
+        pending: List[Tuple[Graph, Any]] = []
+        spent = proposals = 0
+
+        def observe(g: Graph, s: float):
+            nonlocal best, best_score
+            memo[g.key()] = s
+            hist.append((g.key(), s))
+            if s > best_score:
+                best, best_score = g, s
+            population.append((g, s))
+            if len(population) > population_size:
+                population.popleft()              # aging
+
+        def propose() -> Graph:
+            nonlocal proposals
+            proposals += 1
+            if len(population) < max(2, sample_size // 2):
+                return random_graph(space, rng)
+            k = min(sample_size, len(population))
+            contenders = [population[rng.randrange(len(population))]
+                          for _ in range(k)]
+            parent = max(contenders, key=lambda t: t[1])[0]
+            return mutate(parent, space, rng, muts)
+
+        def reap_one():
+            nonlocal spent
+            done, _ = rt.wait([r for _, r in pending], num_returns=1)
+            for i, (g, r) in enumerate(pending):
+                if r in done:
+                    pending.pop(i)
+                    spent += 1
+                    try:
+                        observe(g, float(rt.get(r)))
+                    except Exception:
+                        # one crashed candidate must not abort the
+                        # search or enter the breeding population
+                        hist.append((g.key(), float("nan")))
+                    return
+
+        while (spent + len(pending) < budget or pending) \
+                and proposals < max_proposals:
+            while (spent + len(pending) < budget
+                   and len(pending) < max_concurrent
+                   and proposals < max_proposals):
+                g = propose()
+                k = g.key()
+                if k in memo:                     # no-op mutation: free
+                    hist.append((k, memo[k]))
+                    continue
+                pending.append((g, remote_eval.remote(
+                    g.to_config(), evaluate_target, evaluate_kwargs)))
+            if pending:
+                reap_one()
+    finally:
+        if own_rt:
+            rt.shutdown()
+    return SearchResult(best, best_score, hist, evaluations=spent)
+
+
 # -- trained-accuracy evaluator ---------------------------------------
 
 
