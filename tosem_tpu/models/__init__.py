@@ -1,5 +1,6 @@
 from tosem_tpu.models.resnet import ResNet, resnet50, resnet18_ish
-from tosem_tpu.models.bert import Bert, BertConfig, bert_base, bert_tiny
+from tosem_tpu.models.bert import (Bert, BertConfig, bert_base, bert_tiny,
+                                   bert_tiny_moe)
 from tosem_tpu.models.pointpillars import (PillarFeatureNet, PillarGrid,
                                            PointPillarsDetector, device_nms,
                                            voxelize)

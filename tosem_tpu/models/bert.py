@@ -30,6 +30,12 @@ class BertConfig:
     dropout: float = 0.1
     dtype: str = "bfloat16"
     precision: str = "default"
+    # MoE variant (0 experts = dense FFN everywhere): every
+    # ``moe_every``-th layer swaps its MLP for a routed expert layer
+    moe_experts: int = 0
+    moe_every: int = 2
+    moe_k: int = 2
+    moe_capacity_factor: float = 1.25
 
     @classmethod
     def base(cls) -> "BertConfig":
@@ -82,6 +88,55 @@ class EncoderLayer(Module):
         return x + h, vs["state"]
 
 
+class MoEEncoderLayer(Module):
+    """Encoder layer whose FFN is a routed expert layer (the MoE-BERT
+    block). ``apply`` surfaces the load-balance aux loss through the
+    returned state (``{"moe_aux": scalar}``) so training adds it to the
+    task loss; experts shard over an ``ep`` axis via
+    :func:`tosem_tpu.nn.moe.shard_moe_params`."""
+
+    def __init__(self, cfg: BertConfig):
+        from tosem_tpu.nn.moe import MoELayer
+        dt = jnp.dtype(cfg.dtype)
+        self.ln1 = LayerNorm(cfg.dim, dtype=dt)
+        self.attn = MultiHeadAttention(cfg.dim, cfg.heads,
+                                       dropout=cfg.dropout, dtype=dt,
+                                       precision=cfg.precision)
+        self.ln2 = LayerNorm(cfg.dim, dtype=dt)
+        # clamp here (the mechanism), not in one helper: configs from
+        # NAS/HPO sweeps may set moe_experts below the default moe_k
+        self.moe = MoELayer(cfg.dim, cfg.moe_experts, hidden=cfg.mlp_dim,
+                            k=min(cfg.moe_k, cfg.moe_experts),
+                            capacity_factor=cfg.moe_capacity_factor,
+                            dtype=dt)
+        self.drop = Dropout(cfg.dropout)
+
+    def init(self, key) -> Variables:
+        ks = jax.random.split(key, 4)
+        return variables({
+            "ln1": self.ln1.init(ks[0])["params"],
+            "attn": self.attn.init(ks[1])["params"],
+            "ln2": self.ln2.init(ks[2])["params"],
+            "moe": self.moe.init(ks[3])["params"],
+        })
+
+    def apply(self, vs, x, *, mask=None, train=False, rng=None,
+              attn_fn=None):
+        p = vs["params"]
+        r1, r2 = split_key(rng, 2)
+        h, _ = self.ln1.apply(variables(p["ln1"]), x)
+        h, _ = self.attn.apply(variables(p["attn"]), h, mask=mask,
+                               train=train, rng=r1, attn_fn=attn_fn)
+        x = x + h
+        h, _ = self.ln2.apply(variables(p["ln2"]), x)
+        B, T, D = h.shape
+        (y, aux), _ = self.moe.apply(variables(p["moe"]),
+                                     h.reshape(B * T, D))
+        y = y.reshape(B, T, D)
+        y, _ = self.drop.apply(variables({}), y, train=train, rng=r2)
+        return x + y, {"moe_aux": aux}
+
+
 class Bert(Module):
     def __init__(self, cfg: BertConfig):
         self.cfg = cfg
@@ -90,7 +145,13 @@ class Bert(Module):
         self.pos = Embedding(cfg.max_len, cfg.dim, dtype=dt)
         self.seg = Embedding(2, cfg.dim, dtype=dt)
         self.ln_emb = LayerNorm(cfg.dim, dtype=dt)
-        self.layers = [EncoderLayer(cfg) for _ in range(cfg.layers)]
+
+        def make_layer(i):
+            if cfg.moe_experts and i % cfg.moe_every == cfg.moe_every - 1:
+                return MoEEncoderLayer(cfg)
+            return EncoderLayer(cfg)
+
+        self.layers = [make_layer(i) for i in range(cfg.layers)]
         self.ln_out = LayerNorm(cfg.dim, dtype=dt)
         self.drop = Dropout(cfg.dropout)
 
@@ -126,11 +187,18 @@ class Bert(Module):
             attn_mask = mask[:, None, None, :].astype(bool)
         rngs = split_key(rng, len(self.layers) + 1)
         h, _ = self.drop.apply(variables({}), h, train=train, rng=rngs[0])
+        moe_aux = jnp.float32(0.0)
         for i, l in enumerate(self.layers):
-            h, _ = l.apply(variables(p[f"layer{i}"]), h, mask=attn_mask,
-                           train=train, rng=rngs[i + 1], attn_fn=attn_fn)
+            h, lstate = l.apply(variables(p[f"layer{i}"]), h,
+                                mask=attn_mask, train=train,
+                                rng=rngs[i + 1], attn_fn=attn_fn)
+            if isinstance(lstate, dict) and "moe_aux" in lstate:
+                moe_aux = moe_aux + lstate["moe_aux"]
         h, _ = self.ln_out.apply(variables(p["ln_out"]), h)
-        return h, vs["state"]
+        state = dict(vs["state"])
+        if self.cfg.moe_experts:
+            state["moe_aux"] = moe_aux
+        return h, state
 
     def mlm_logits(self, vs, encodings):
         """Tied-embedding masked-LM head."""
@@ -144,3 +212,10 @@ def bert_base() -> Bert:
 
 def bert_tiny() -> Bert:
     return Bert(BertConfig.tiny())
+
+
+def bert_tiny_moe(n_experts: int = 4) -> Bert:
+    """CI-sized MoE-BERT: every second layer routed."""
+    from dataclasses import replace
+    return Bert(replace(BertConfig.tiny(), moe_experts=n_experts,
+                        moe_k=min(2, n_experts)))

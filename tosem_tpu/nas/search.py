@@ -189,8 +189,10 @@ def parallel_evolution_search(space: SearchSpace,
                         hist.append((g.key(), float("nan")))
                     return
 
-        while (spent + len(pending) < budget or pending) \
-                and proposals < max_proposals:
+        # drain term FIRST: hitting the proposal backstop must still reap
+        # everything in flight (results counted, no orphaned tasks)
+        while pending or (spent + len(pending) < budget
+                          and proposals < max_proposals):
             while (spent + len(pending) < budget
                    and len(pending) < max_concurrent
                    and proposals < max_proposals):
@@ -199,6 +201,8 @@ def parallel_evolution_search(space: SearchSpace,
                 if k in memo:                     # no-op mutation: free
                     hist.append((k, memo[k]))
                     continue
+                if any(k == pg.key() for pg, _ in pending):
+                    continue       # identical candidate already in flight
                 pending.append((g, remote_eval.remote(
                     g.to_config(), evaluate_target, evaluate_kwargs)))
             if pending:

@@ -80,15 +80,21 @@ def shard_tree(tree: Any, mesh: Mesh, rules: Rules, default: P = P()) -> Any:
 # Canonical rule sets
 
 
-def bert_rules(tp: str = "tp") -> List[Tuple[str, P]]:
+def bert_rules(tp: str = "tp",
+               ep: Optional[str] = None) -> List[Tuple[str, P]]:
     """Megatron-style tensor parallelism for the BERT encoder
     (``tosem_tpu.models.bert``): QKV and the MLP up-projection are
     column-parallel (output features sharded), the attention output and MLP
     down-projection are row-parallel (contraction dim sharded, XLA emits the
     AllReduce), embeddings shard the feature dim. Everything else
     (layernorms, biases of row-parallel layers) replicates.
+
+    ``ep``: mesh axis for MoE-BERT expert stacks (``layer*/moe/*``) —
+    REQUIRED for a mesh hosting an MoE variant, otherwise the E-times
+    FFN weights replicate onto every device (the dominant block). Pass
+    ``ep=None`` for dense models / meshes without an expert axis.
     """
-    return [
+    rules = [
         (r"attn/(q|k|v)/w$", P(None, tp)),
         (r"attn/(q|k|v)/b$", P(tp)),
         (r"attn/o/w$", P(tp, None)),
@@ -97,6 +103,13 @@ def bert_rules(tp: str = "tp") -> List[Tuple[str, P]]:
         (r"fc2/w$", P(tp, None)),
         (r"(tok|pos|seg)/table$", P(None, tp)),
     ]
+    if ep is not None:
+        rules += [
+            (r"moe/gate$", P()),
+            (r"moe/(w1|w2)$", P(ep, None, None)),
+            (r"moe/(b1|b2)$", P(ep, None)),
+        ]
+    return rules
 
 
 def seq_batch_rules(dp: str = "dp", sp: Optional[str] = "sp"
