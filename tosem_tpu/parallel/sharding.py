@@ -104,11 +104,11 @@ def bert_rules(tp: str = "tp",
         (r"(tok|pos|seg)/table$", P(None, tp)),
     ]
     if ep is not None:
-        rules += [
-            (r"moe/gate$", P()),
-            (r"moe/(w1|w2)$", P(ep, None, None)),
-            (r"moe/(b1|b2)$", P(ep, None)),
-        ]
+        # derive from the MoE layer's own spec table so the two can't
+        # silently desync when expert params change
+        from tosem_tpu.nn.moe import moe_rules
+        rules += [(rf"moe/{name}$", spec)
+                  for name, spec in moe_rules(ep).items()]
     return rules
 
 

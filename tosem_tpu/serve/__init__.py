@@ -5,6 +5,7 @@ the single-controller actor runtime, plus the DeepSpeech native-client
 streaming surface (``deepspeech.h:107-358``) as a real C ABI
 (``native/speech_api.cpp``) fed by JAX callbacks.
 """
+from tosem_tpu.serve.autoscale import ServeAutoscaler, ServeScaleConfig
 from tosem_tpu.serve.core import Deployment, Handle, Serve, ServeFuture
 from tosem_tpu.serve.http import HttpIngress
 from tosem_tpu.serve.speech import (CStreamingModel, SpeechStreamBackend,

@@ -1,0 +1,97 @@
+"""Deployment autoscaling (the Ray Serve autoscaler role).
+
+Serve's controller scales replica counts from queue-length metrics
+(`python/ray/serve/autoscaling_policy.py` — target in-flight requests
+per replica with upper/lower bounds). Same policy here over
+:meth:`Deployment.load`: scale up when in-flight demand exceeds
+``target_inflight_per_replica`` × replicas, scale down after sustained
+idleness. Deterministic ``tick()`` for tests; ``run()`` for the
+controller-loop behavior.
+"""
+from __future__ import annotations
+
+import collections
+import math
+import threading
+from dataclasses import dataclass
+from typing import Deque, Dict, Optional
+
+from tosem_tpu.serve.core import Serve
+
+
+@dataclass
+class ServeScaleConfig:
+    min_replicas: int = 1
+    max_replicas: int = 8
+    target_inflight_per_replica: float = 2.0
+    idle_ticks_before_downscale: int = 3
+    max_up_per_tick: int = 2
+
+
+class ServeAutoscaler:
+    def __init__(self, serve: Serve,
+                 configs: Optional[Dict[str, ServeScaleConfig]] = None,
+                 default: Optional[ServeScaleConfig] = None):
+        self.serve = serve
+        self.configs = dict(configs or {})
+        self.default = default or ServeScaleConfig()
+        self._low: Dict[str, int] = {}      # consecutive want-lower ticks
+        self.history: Deque[Dict[str, int]] = collections.deque(
+            maxlen=1000)                    # bounded: run() is long-lived
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _cfg(self, name: str) -> ServeScaleConfig:
+        return self.configs.get(name, self.default)
+
+    def tick(self) -> list:
+        decisions = []
+        for name in self.serve.list_deployments():
+            dep = self.serve._deployments.get(name)
+            if dep is None:      # deleted between list and lookup
+                continue
+            cfg = self._cfg(name)
+            load = dep.load()
+            n = dep.num_replicas
+            # target replica count from demand (the autoscaling_policy
+            # shape): enough replicas for target in-flight each
+            desired = max(cfg.min_replicas,
+                          min(cfg.max_replicas, math.ceil(
+                              load / cfg.target_inflight_per_replica)))
+            want = n
+            if desired > n:
+                self._low[name] = 0
+                want = min(n + cfg.max_up_per_tick, desired)
+            elif desired < n:
+                # hysteresis: shrink one step only after the demand has
+                # stayed below the current size for consecutive ticks —
+                # a trickle of traffic still scales down toward desired
+                self._low[name] = self._low.get(name, 0) + 1
+                if self._low[name] >= cfg.idle_ticks_before_downscale:
+                    want = n - 1
+                    self._low[name] = 0
+            else:
+                self._low[name] = 0
+            if want != n:
+                dep.scale(want)
+            d = {"deployment": name, "load": load, "replicas": n,
+                 "new_replicas": want}
+            decisions.append(d)
+            self.history.append(d)
+        return decisions
+
+    def run(self, interval: float = 1.0) -> None:
+        def loop():
+            while not self._stop.wait(interval):
+                try:
+                    self.tick()
+                except Exception:
+                    pass          # a torn-down serve must not crash it
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="serve-autoscaler")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)

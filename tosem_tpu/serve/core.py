@@ -75,12 +75,60 @@ class Deployment:
             self._actor_cls.remote(*init_args, **init_kwargs)
             for _ in range(num_replicas)]
         self._rr = itertools.count()
+        # (ref, replica) pairs not yet observed done — drives both the
+        # least-loaded dispatch and the autoscaler's demand signal.
+        # Pruned in load() and amortized in _dispatch so results don't
+        # stay pinned when no autoscaler polls.
+        self._outstanding: List[Any] = []
 
     def _dispatch(self, request: Any, pin: Optional[int] = None):
         with self._lock:
             replicas = list(self._replicas)
-        i = (next(self._rr) if pin is None else pin) % len(replicas)
-        return replicas[i].call.remote(request)
+            if pin is None:
+                # least-loaded (by un-pruned in-flight count), round
+                # robin as the tiebreaker: a freshly added replica picks
+                # up new traffic immediately. NOTE: already-submitted
+                # calls stay with their replica (actor queues preserve
+                # stateful ordering) — scale-up helps future requests.
+                counts = {id(r): 0 for r in replicas}
+                for _, rep in self._outstanding:
+                    if id(rep) in counts:
+                        counts[id(rep)] += 1
+                order = next(self._rr)
+                i = min(range(len(replicas)),
+                        key=lambda j: (counts[id(replicas[j])],
+                                       (j - order) % len(replicas)))
+            else:
+                i = pin % len(replicas)
+            replica = replicas[i]
+        ref = replica.call.remote(request)
+        with self._lock:
+            self._outstanding.append((ref, replica))
+            needs_prune = len(self._outstanding) > 256
+        if needs_prune:
+            self.load()                # amortized: keep refs unpinned
+        return ref
+
+    @property
+    def num_replicas(self) -> int:
+        with self._lock:
+            return len(self._replicas)
+
+    def load(self) -> int:
+        """In-flight request count (the autoscaler's demand signal, the
+        replica queue-length metric Serve's controller scrapes). Prunes
+        refs that completed since the last call."""
+        with self._lock:
+            pairs = list(self._outstanding)
+        if not pairs:
+            return 0
+        refs = [r for r, _ in pairs]
+        done, _ = rt.wait(refs, num_returns=len(refs), timeout=0.0)
+        done_set = set(done)
+        with self._lock:
+            self._outstanding = [(r, rep) for r, rep in self._outstanding
+                                 if r not in done_set]
+            return len(self._outstanding)
 
     def handle(self, pin: Optional[int] = None) -> "Handle":
         """``pin``: route every request of this handle to one replica —
