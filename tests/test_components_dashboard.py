@@ -127,6 +127,28 @@ class TestDashboard:
         render_text(snap)                 # must not raise
         render_html(snap)
 
+    @pytest.mark.slow
+    def test_snapshot_includes_serve_deployments(self):
+        import tosem_tpu.runtime as rt
+        from tosem_tpu.serve import Serve
+        rt.init(num_workers=1)
+        try:
+            s = Serve()
+
+            class Echo:
+                def call(self, r):
+                    return r
+
+            s.deploy("dash-echo", Echo, num_replicas=2)
+            snap = snapshot(serve=s)
+            dep = next(d for d in snap["deployments"]
+                       if d["name"] == "dash-echo")
+            assert dep["replicas"] == 2 and dep["load"] == 0
+            assert "dash-echo" in render_text(snap)
+            assert "dash-echo" in render_html(snap)
+        finally:
+            rt.shutdown()
+
     def test_server_endpoints(self, tmp_path):
         from tosem_tpu.tune.experiment import ExperimentManager
         db = str(tmp_path / "hpo.db")

@@ -61,6 +61,7 @@ class TestServeAutoscaler:
             target_inflight_per_replica=2.0,
             idle_ticks_before_downscale=2)})
         h = serve.get_handle("slow")
+        h.remote({"s": 0.01}).result(timeout=120)   # cold-boot warmup
         futs = [h.remote({"s": 0.5}) for _ in range(8)]
         d = a.tick()
         assert d[0]["load"] >= 6
@@ -69,7 +70,7 @@ class TestServeAutoscaler:
         a.tick()
         assert dep.num_replicas <= 3             # capped
         for f in futs:
-            f.result(timeout=30)
+            f.result(timeout=120)
         # drained: after idle ticks, scale back toward min
         import time
         time.sleep(0.2)
@@ -97,6 +98,27 @@ class TestServeAutoscaler:
             a.tick()
         assert dep.num_replicas < 4
         serve.delete("trickle")
+
+    def test_scale_after_delete_is_noop(self, serve):
+        dep = serve.deploy("gone", Echo, num_replicas=1)
+        serve.delete("gone")
+        dep.scale(3)                 # late autoscaler tick: must not
+        assert dep.num_replicas == 0  # resurrect unreachable actors
+
+    def test_scale_down_retires_idle_replica_first(self, serve):
+        dep = serve.deploy("busy", Slow, num_replicas=2)
+        h0 = serve.get_handle("busy")
+        # occupy replica 0 via a pinned long request
+        pinned = dep.handle(pin=0)
+        f = pinned.remote({"s": 1.5})
+        import time
+        time.sleep(0.2)
+        busy_replica = dep._replicas[0]
+        dep.scale(1)                 # must retire the IDLE replica 1
+        assert dep.num_replicas == 1
+        assert dep._replicas[0] is busy_replica
+        assert f.result(timeout=60) == "done"   # in-flight unharmed
+        serve.delete("busy")
 
     def test_load_prunes_completed(self, serve):
         dep = serve.deploy("quick", Echo, num_replicas=1)

@@ -23,7 +23,8 @@ from tosem_tpu.obs.memory_monitor import read_available_bytes, read_rss_bytes
 def snapshot(*, kv_path: Optional[str] = None,
              results_csv: Optional[str] = None,
              max_results: int = 20,
-             experiments_manager: Any = None) -> Dict[str, Any]:
+             experiments_manager: Any = None,
+             serve: Any = None) -> Dict[str, Any]:
     """One coherent view of the system (the dashboard's data plane)."""
     snap: Dict[str, Any] = {"timestamp": time.time()}
 
@@ -57,6 +58,17 @@ def snapshot(*, kv_path: Optional[str] = None,
             snap["experiments"] = []
     except Exception as e:       # bad/locked db must not kill the UI
         snap["experiments"] = [{"error": repr(e)}]
+
+    try:
+        if serve is not None:
+            snap["deployments"] = [
+                {"name": n, "replicas": dep.num_replicas,
+                 "load": dep.load()}
+                for n, dep in sorted(serve.deployments().items())]
+        else:
+            snap["deployments"] = []
+    except Exception as e:       # torn-down serve must not kill the UI
+        snap["deployments"] = [{"error": repr(e)}]
 
     if results_csv is not None:
         try:
@@ -94,6 +106,12 @@ def render_text(snap: Dict[str, Any]) -> str:
             lines.append(f"   {e.get('name', '?'):24s} "
                          f"{e.get('status', '?'):8s} "
                          f"best={e.get('best_score')}")
+    if snap.get("deployments"):
+        lines.append("-- deployments:")
+        for d in snap["deployments"]:
+            lines.append(f"   {str(d.get('name')):24s} "
+                         f"replicas={d.get('replicas')} "
+                         f"load={d.get('load')}")
     if snap["results"]:
         lines.append("-- recent results:")
         for r in snap["results"]:
@@ -136,6 +154,8 @@ rss {mem['rss_bytes']/1e6:.1f} MB, available
 <h2>Metrics</h2>{_table(snap['metrics'], ["series", "value"])}
 <h2>Experiments</h2>{_table(snap['experiments'],
                             ["name", "status", "best_score", "n_trials"])}
+<h2>Deployments</h2>{_table(snap.get('deployments', []),
+                            ["name", "replicas", "load"])}
 <h2>Recent results</h2>{_table(snap['results'],
                                ["config", "bench_id", "metric", "value",
                                 "unit", "device"])}
@@ -147,7 +167,8 @@ class DashboardServer:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0, *,
                  kv_path: Optional[str] = None,
-                 results_csv: Optional[str] = None):
+                 results_csv: Optional[str] = None,
+                 serve: Any = None):
         from tosem_tpu.obs.httpd import RouteServer
         mgr = None
         if kv_path is not None:
@@ -160,7 +181,8 @@ class DashboardServer:
             except Exception:
                 mgr = None
         kw = {"results_csv": results_csv, "experiments_manager": mgr,
-              "kv_path": kv_path if mgr is None else None}
+              "kv_path": kv_path if mgr is None else None,
+              "serve": serve}
 
         def route(path: str):
             if path.startswith("/metrics"):

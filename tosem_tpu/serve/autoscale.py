@@ -46,10 +46,7 @@ class ServeAutoscaler:
 
     def tick(self) -> list:
         decisions = []
-        for name in self.serve.list_deployments():
-            dep = self.serve._deployments.get(name)
-            if dep is None:      # deleted between list and lookup
-                continue
+        for name, dep in self.serve.deployments().items():
             cfg = self._cfg(name)
             load = dep.load()
             n = dep.num_replicas
@@ -82,11 +79,20 @@ class ServeAutoscaler:
 
     def run(self, interval: float = 1.0) -> None:
         def loop():
+            import sys
+            warned = set()
             while not self._stop.wait(interval):
                 try:
                     self.tick()
-                except Exception:
-                    pass          # a torn-down serve must not crash it
+                except Exception as e:
+                    # keep the controller alive through teardown races,
+                    # but surface genuine bugs once per error type —
+                    # silently-disabled autoscaling is invisible
+                    key = type(e).__name__
+                    if key not in warned:
+                        warned.add(key)
+                        print(f"[serve-autoscaler] tick failed: {e!r}",
+                              file=sys.stderr)
         self._thread = threading.Thread(target=loop, daemon=True,
                                         name="serve-autoscaler")
         self._thread.start()

@@ -75,38 +75,46 @@ class Deployment:
             self._actor_cls.remote(*init_args, **init_kwargs)
             for _ in range(num_replicas)]
         self._rr = itertools.count()
+        self._closed = False
         # (ref, replica) pairs not yet observed done — drives both the
         # least-loaded dispatch and the autoscaler's demand signal.
-        # Pruned in load() and amortized in _dispatch so results don't
-        # stay pinned when no autoscaler polls.
+        # Pruned on every dispatch and load() call, so counts are true
+        # in-flight numbers and results never stay pinned.
         self._outstanding: List[Any] = []
 
-    def _dispatch(self, request: Any, pin: Optional[int] = None):
+    def _inflight_counts(self) -> Dict[int, int]:
+        """Prune completed refs, return live count per replica id.
+        Caller must NOT hold self._lock."""
+        self.load()
         with self._lock:
-            replicas = list(self._replicas)
-            if pin is None:
-                # least-loaded (by un-pruned in-flight count), round
-                # robin as the tiebreaker: a freshly added replica picks
-                # up new traffic immediately. NOTE: already-submitted
-                # calls stay with their replica (actor queues preserve
-                # stateful ordering) — scale-up helps future requests.
-                counts = {id(r): 0 for r in replicas}
-                for _, rep in self._outstanding:
-                    if id(rep) in counts:
-                        counts[id(rep)] += 1
+            counts: Dict[int, int] = {id(r): 0 for r in self._replicas}
+            for _, rep in self._outstanding:
+                if id(rep) in counts:
+                    counts[id(rep)] += 1
+            return counts
+
+    def _dispatch(self, request: Any, pin: Optional[int] = None):
+        if pin is None:
+            # least-loaded by TRUE in-flight count (pruned first), round
+            # robin as the tiebreaker: fresh replicas absorb new traffic
+            # without starving existing ones on stale counts. NOTE:
+            # already-submitted calls stay with their replica (actor
+            # queues preserve stateful ordering) — scale-up helps future
+            # requests.
+            counts = self._inflight_counts()
+            with self._lock:
+                replicas = list(self._replicas)
                 order = next(self._rr)
                 i = min(range(len(replicas)),
-                        key=lambda j: (counts[id(replicas[j])],
+                        key=lambda j: (counts.get(id(replicas[j]), 0),
                                        (j - order) % len(replicas)))
-            else:
-                i = pin % len(replicas)
-            replica = replicas[i]
+                replica = replicas[i]
+        else:
+            with self._lock:
+                replica = self._replicas[pin % len(self._replicas)]
         ref = replica.call.remote(request)
         with self._lock:
             self._outstanding.append((ref, replica))
-            needs_prune = len(self._outstanding) > 256
-        if needs_prune:
-            self.load()                # amortized: keep refs unpinned
         return ref
 
     @property
@@ -136,21 +144,44 @@ class Deployment:
         return Handle(self, pin=pin)
 
     def scale(self, num_replicas: int) -> None:
-        """Add/remove replicas (the controller's autoscale entry point)."""
+        """Add/remove replicas (the controller's autoscale entry point).
+
+        Scale-down retires the LEAST-LOADED replicas (ideally idle ones)
+        rather than a fixed tail — killing a mid-request replica forces
+        client-visible retries. No-op after delete() (a late autoscaler
+        tick must not spawn unreachable actors).
+        """
         if num_replicas < 1:
             raise ValueError("a deployment needs at least one replica; "
                              "use Serve.delete to tear it down")
+        counts = self._inflight_counts()
         with self._lock:
+            if self._closed:
+                return
             cur = len(self._replicas)
             if num_replicas > cur:
                 self._replicas.extend(
                     self._actor_cls.remote(*self._init_args,
                                            **self._init_kwargs)
                     for _ in range(num_replicas - cur))
-            else:
-                for h in self._replicas[num_replicas:]:
-                    rt.kill(h)
-                del self._replicas[num_replicas:]
+            elif num_replicas < cur:
+                victims = sorted(self._replicas,
+                                 key=lambda r: counts.get(id(r), 0))[
+                                     :cur - num_replicas]
+                victim_ids = {id(v) for v in victims}
+                self._replicas = [r for r in self._replicas
+                                  if id(r) not in victim_ids]
+                for v in victims:
+                    rt.kill(v)
+
+    def close(self) -> None:
+        """Kill every replica and refuse further scaling (delete path)."""
+        with self._lock:
+            self._closed = True
+            victims = list(self._replicas)
+            self._replicas = []
+        for v in victims:
+            rt.kill(v)
 
 
 class Handle:
@@ -195,8 +226,18 @@ class Serve:
         with self._lock:
             dep = self._deployments.pop(name, None)
         if dep is not None:
-            for h in dep._replicas:
-                rt.kill(h)
+            dep.close()          # marks closed: late scale() calls no-op
 
     def list_deployments(self) -> List[str]:
-        return sorted(self._deployments)
+        with self._lock:
+            return sorted(self._deployments)
+
+    def get_deployment(self, name: str) -> Optional[Deployment]:
+        """Public registry accessor (autoscaler/dashboard use this, not
+        the private dict)."""
+        with self._lock:
+            return self._deployments.get(name)
+
+    def deployments(self) -> Dict[str, Deployment]:
+        with self._lock:
+            return dict(self._deployments)
