@@ -100,10 +100,14 @@ class TestServeAutoscaler:
         serve.delete("trickle")
 
     def test_scale_after_delete_is_noop(self, serve):
+        from tosem_tpu.runtime import ActorDiedError
         dep = serve.deploy("gone", Echo, num_replicas=1)
+        h = serve.get_handle("gone")
         serve.delete("gone")
         dep.scale(3)                 # late autoscaler tick: must not
         assert dep.num_replicas == 0  # resurrect unreachable actors
+        with pytest.raises(ActorDiedError, match="no replicas"):
+            h.remote({"x": 1})       # clear signal, not min()/mod-0 crash
 
     def test_scale_down_retires_idle_replica_first(self, serve):
         dep = serve.deploy("busy", Slow, num_replicas=2)

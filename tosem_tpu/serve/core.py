@@ -82,36 +82,50 @@ class Deployment:
         # in-flight numbers and results never stay pinned.
         self._outstanding: List[Any] = []
 
-    def _inflight_counts(self) -> Dict[int, int]:
-        """Prune completed refs, return live count per replica id.
-        Caller must NOT hold self._lock."""
-        self.load()
+    def _counts_locked(self) -> Dict[int, int]:
+        """Per-replica outstanding counts from the current (possibly
+        slightly stale) list. Caller holds self._lock."""
+        counts: Dict[int, int] = {id(r): 0 for r in self._replicas}
+        for _, rep in self._outstanding:
+            if id(rep) in counts:
+                counts[id(rep)] += 1
+        return counts
+
+    def _prune_amortized(self) -> None:
+        """Bound both count staleness and pinned-result growth without an
+        O(outstanding) rt.wait on every request: prune once the list
+        exceeds a few requests per replica."""
         with self._lock:
-            counts: Dict[int, int] = {id(r): 0 for r in self._replicas}
-            for _, rep in self._outstanding:
-                if id(rep) in counts:
-                    counts[id(rep)] += 1
-            return counts
+            threshold = max(32, 4 * len(self._replicas))
+            needs = len(self._outstanding) > threshold
+        if needs:
+            self.load()
 
     def _dispatch(self, request: Any, pin: Optional[int] = None):
-        if pin is None:
-            # least-loaded by TRUE in-flight count (pruned first), round
-            # robin as the tiebreaker: fresh replicas absorb new traffic
-            # without starving existing ones on stale counts. NOTE:
-            # already-submitted calls stay with their replica (actor
-            # queues preserve stateful ordering) — scale-up helps future
-            # requests.
-            counts = self._inflight_counts()
-            with self._lock:
-                replicas = list(self._replicas)
+        self._prune_amortized()
+        with self._lock:
+            replicas = list(self._replicas)
+            if not replicas:
+                # deleted deployment: a clear terminal signal, not a
+                # min()-of-empty / mod-zero crash inside a retry loop
+                raise ActorDiedError(
+                    f"deployment {self.name!r} has no replicas "
+                    "(deleted?)")
+            if pin is None:
+                # least-loaded with round-robin tiebreak: fresh replicas
+                # absorb new traffic. Counts may include a few completed
+                # -but-unpruned refs (bounded by _prune_amortized), which
+                # only biases toward spreading. NOTE: already-submitted
+                # calls stay with their replica (actor queues preserve
+                # stateful ordering) — scale-up helps future requests.
+                counts = self._counts_locked()
                 order = next(self._rr)
                 i = min(range(len(replicas)),
                         key=lambda j: (counts.get(id(replicas[j]), 0),
                                        (j - order) % len(replicas)))
-                replica = replicas[i]
-        else:
-            with self._lock:
-                replica = self._replicas[pin % len(self._replicas)]
+            else:
+                i = pin % len(replicas)
+            replica = replicas[i]
         ref = replica.call.remote(request)
         with self._lock:
             self._outstanding.append((ref, replica))
@@ -154,7 +168,7 @@ class Deployment:
         if num_replicas < 1:
             raise ValueError("a deployment needs at least one replica; "
                              "use Serve.delete to tear it down")
-        counts = self._inflight_counts()
+        self.load()              # prune so counts below are near-exact
         with self._lock:
             if self._closed:
                 return
@@ -165,6 +179,10 @@ class Deployment:
                                            **self._init_kwargs)
                     for _ in range(num_replicas - cur))
             elif num_replicas < cur:
+                # counts computed UNDER the lock: a dispatch racing this
+                # scale-down either lands before (counted, replica looks
+                # busy and survives) or after (sees the shrunken list)
+                counts = self._counts_locked()
                 victims = sorted(self._replicas,
                                  key=lambda r: counts.get(id(r), 0))[
                                      :cur - num_replicas]

@@ -26,7 +26,7 @@ class HttpIngress:
 
             def do_POST(self):
                 name = self.path.strip("/")
-                if name not in serve._deployments:
+                if serve.get_deployment(name) is None:
                     self._reply(404, {"error": f"no endpoint {name!r}"})
                     return
                 try:
