@@ -124,10 +124,66 @@ class TestRun:
         assert mgr.status("allerr")["status"] == "failed"
 
     def test_concurrent_run_guard(self, tmp_path):
+        import json as _json
+        import os
         mgr = ExperimentManager(path=str(tmp_path / "g.db"))
         mgr.create(dict(SPEC, name="locked"))
-        # simulate another process holding the run lock
+        # a LIVE holder (this process) blocks a second run
         from tosem_tpu.tune.experiment import _NS_LOCK
-        assert mgr.kv.cas(_NS_LOCK, "locked", None, b"running")
+        live = _json.dumps({"pid": os.getpid(), "t": 0}).encode()
+        assert mgr.kv.cas(_NS_LOCK, "locked", None, live)
         with pytest.raises(RuntimeError, match="already running"):
             mgr.run("locked")
+        mgr.kv.delete(_NS_LOCK, "locked")
+
+    def test_dead_holder_lock_reclaimed(self, tmp_path):
+        import json as _json
+        mgr = ExperimentManager(path=str(tmp_path / "d.db"))
+        mgr.create(dict(SPEC, name="crashed", num_samples=2,
+                        max_iterations=3))
+        from tosem_tpu.tune.experiment import _NS_LOCK
+        # a lock whose holder pid no longer exists must be taken over
+        dead = _json.dumps({"pid": 2 ** 22 + 12345, "t": 0}).encode()
+        assert mgr.kv.cas(_NS_LOCK, "crashed", None, dead)
+        state = mgr.run("crashed")          # reclaims, runs to completion
+        assert state["status"] == "done"
+
+    def test_force_takes_over_live_lock(self, tmp_path):
+        import json as _json
+        import os
+        mgr = ExperimentManager(path=str(tmp_path / "f2.db"))
+        mgr.create(dict(SPEC, name="forced", num_samples=2,
+                        max_iterations=3))
+        from tosem_tpu.tune.experiment import _NS_LOCK
+        live = _json.dumps({"pid": os.getpid(), "t": 0}).encode()
+        assert mgr.kv.cas(_NS_LOCK, "forced", None, live)
+        state = mgr.run("forced", force=True)
+        assert state["status"] == "done"
+
+    def test_unreadable_lock_requires_force(self, tmp_path):
+        # a pre-upgrade b"running" lock may belong to a LIVE process:
+        # never hijack it silently
+        mgr = ExperimentManager(path=str(tmp_path / "u.db"))
+        mgr.create(dict(SPEC, name="legacy", num_samples=2,
+                        max_iterations=3))
+        from tosem_tpu.tune.experiment import _NS_LOCK
+        assert mgr.kv.cas(_NS_LOCK, "legacy", None, b"running")
+        with pytest.raises(RuntimeError, match="already running"):
+            mgr.run("legacy")
+        state = mgr.run("legacy", force=True)
+        assert state["status"] == "done"
+
+    def test_displaced_runner_does_not_release_successor_lock(
+            self, tmp_path):
+        mgr = ExperimentManager(path=str(tmp_path / "dl.db"))
+        mgr.create(dict(SPEC, name="dl"))
+        from tosem_tpu.tune.experiment import _NS_LOCK
+        mine = mgr._try_lock("dl", force=False)
+        assert mine is not None
+        # a forcing runner displaces us
+        theirs = mgr._try_lock("dl", force=True)
+        assert theirs is not None and theirs != mine
+        # our conditional release must be a no-op on THEIR lock
+        assert not mgr.kv.delete_if(_NS_LOCK, "dl", mine)
+        assert mgr.kv.get(_NS_LOCK, "dl") == theirs
+        assert mgr.kv.delete_if(_NS_LOCK, "dl", theirs)

@@ -67,6 +67,18 @@ class KVStore:
             self._db.commit()
             return cur.rowcount > 0
 
+    def delete_if(self, ns: str, key: str, expect: bytes) -> bool:
+        """Atomic compare-and-delete (single statement — safe across
+        processes): removes the row only if it still holds ``expect``.
+        The lock-release primitive: a displaced holder must not delete
+        its successor's lock."""
+        with self._lock:
+            cur = self._db.execute(
+                "DELETE FROM kv WHERE ns=? AND k=? AND v=?",
+                (ns, key, expect))
+            self._db.commit()
+            return cur.rowcount > 0
+
     def keys(self, ns: str, prefix: str = "") -> List[str]:
         # escape LIKE metacharacters so '_'/'%' in a prefix match literally
         esc = (prefix.replace("\\", "\\\\").replace("%", "\\%")

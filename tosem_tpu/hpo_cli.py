@@ -32,12 +32,16 @@ def _parse(argv: List[str]) -> Dict[str, Any]:
                          "[--name N] [--spec FILE] [--db FILE] [--top K]")
     opts: Dict[str, Any] = {"cmd": argv[0], "db": DEFAULT_DB,
                             "name": None, "spec": None, "top": 0,
-                            "verbose": False}
+                            "verbose": False, "force": False}
     i = 1
     while i < len(argv):
         a = argv[i]
         if a == "--verbose":
             opts["verbose"] = True
+            i += 1
+            continue
+        if a == "--force":
+            opts["force"] = True
             i += 1
             continue
         if a.startswith("--") and "=" in a:
@@ -95,7 +99,8 @@ def _main(argv: Optional[List[str]] = None) -> int:
         raise SystemExit(f"{cmd} needs --name")
     name = opts["name"]
     if cmd == "run":
-        state = mgr.run(name, verbose=opts["verbose"])
+        state = mgr.run(name, verbose=opts["verbose"],
+                        force=opts["force"])
         print(f"done: best_score={state['best_score']:.6g} "
               f"best_config={json.dumps(state['best_config'])}")
         return 0

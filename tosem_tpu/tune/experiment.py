@@ -176,14 +176,48 @@ class ExperimentManager:
 
     # ------------------------------------------------------------ run
 
-    def run(self, name: str, verbose: bool = False) -> Dict[str, Any]:
+    def _try_lock(self, name: str, force: bool) -> Optional[bytes]:
+        """Acquire the run lock; returns this runner's payload (for the
+        conditional release) or None. A lock whose holder process is
+        verifiably dead (same host) or that the caller forces is taken
+        over — a crashed runner must not wedge the experiment; an
+        UNREADABLE lock is treated as held (the holder may be alive)."""
+        import os
+        payload = json.dumps({"pid": os.getpid(),
+                              "t": time.time()}).encode()
+        if self.kv.cas(_NS_LOCK, name, None, payload):
+            return payload
+        blob = self.kv.get(_NS_LOCK, name)
+        if blob is None:                       # released between calls
+            return (payload if self.kv.cas(_NS_LOCK, name, None, payload)
+                    else None)
+        stale = force
+        if not stale:
+            try:
+                holder = json.loads(blob)
+                os.kill(int(holder["pid"]), 0)  # raises if dead
+            except ProcessLookupError:
+                stale = True                    # holder crashed
+            except PermissionError:
+                pass                            # alive, other user
+            except (ValueError, KeyError, TypeError):
+                pass          # unreadable: assume held, require --force
+        if stale and self.kv.cas(_NS_LOCK, name, blob, payload):
+            return payload
+        return None
+
+    def run(self, name: str, verbose: bool = False,
+            force: bool = False) -> Dict[str, Any]:
+        """``force=True`` takes over a live lock (operator override);
+        locks held by dead processes are reclaimed automatically."""
         from tosem_tpu.tune.tune import run as tune_run
         spec = self.spec(name)
         # single-runner guard: CAS on a lock key, so a second concurrent
         # `run` of the same experiment fails fast instead of clobbering
         # the first one's results (the nnictl one-manager-per-experiment
         # invariant)
-        if not self.kv.cas(_NS_LOCK, name, None, b"running"):
+        my_lock = self._try_lock(name, force)
+        if my_lock is None:
             raise RuntimeError(f"experiment {name!r} is already running")
         self._set_state(name, {"status": "running",
                                "started_at": time.time()})
@@ -234,7 +268,9 @@ class ExperimentManager:
                                    "ended_at": time.time()})
             raise
         finally:
-            self.kv.delete(_NS_LOCK, name)
+            # conditional: a displaced runner (someone force-took the
+            # lock) must not delete its successor's lock
+            self.kv.delete_if(_NS_LOCK, name, my_lock)
         self._set_state(name, state)
         return state
 
