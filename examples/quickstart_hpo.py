@@ -1,0 +1,46 @@
+"""Quickstart: hyperparameter search with trials as runtime actors.
+
+ASHA early stopping over a TPE suggester — the Tune/NNI workflow in ten
+lines.
+
+    python examples/quickstart_hpo.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))           # run from anywhere
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax                                                    # noqa: E402
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
+from tosem_tpu import tune                                    # noqa: E402
+
+
+def trainable(config):
+    """Generator trainable: yield one metrics dict per iteration."""
+    x, lr = config["x"], config["lr"]
+    loss = (x - 2.0) ** 2 + 1.0
+    for _ in range(30):
+        loss *= (1.0 - min(lr, 0.9) * 0.3)
+        yield {"loss": loss}
+
+
+def main():
+    analysis = tune.run(
+        trainable,
+        {"x": tune.uniform(-5, 5), "lr": tune.loguniform(1e-3, 1.0)},
+        metric="loss", mode="min", num_samples=12,
+        scheduler=tune.ASHAScheduler(max_t=30, grace_period=3),
+        search_alg=tune.TPESearch(seed=0),
+        max_concurrent=4)
+    print(f"best loss={analysis.best_trial.best_score * -1:.5f} "
+          f"config={analysis.best_config}")
+
+
+if __name__ == "__main__":
+    main()

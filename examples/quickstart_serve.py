@@ -1,0 +1,74 @@
+"""Quickstart: serve a model over HTTP with autoscaling replicas.
+
+    python examples/quickstart_serve.py
+
+Deploys a tiny classifier behind the router + HTTP ingress, posts a few
+requests, and shows the autoscaler reacting to load.
+"""
+import json
+import os
+import sys
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))           # run from anywhere
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax                                                    # noqa: E402
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np                                            # noqa: E402
+
+import tosem_tpu.runtime as rt                                # noqa: E402
+from tosem_tpu.serve import (HttpIngress, Serve,              # noqa: E402
+                             ServeAutoscaler, ServeScaleConfig)
+
+
+class Classifier:
+    """Replica backend: loads the model once, serves many requests."""
+
+    def __init__(self):
+        import jax.numpy as jnp
+        from tosem_tpu.models import resnet18_ish
+        self.model = resnet18_ish(num_classes=10,
+                                  dtype=jnp.float32)
+        self.vs = self.model.init(jax.random.PRNGKey(0))
+        self.fwd = jax.jit(
+            lambda vs, x: self.model.apply(vs, x)[0])
+
+    def call(self, request):
+        x = np.asarray(request["image"], np.float32)[None]
+        logits = self.fwd(self.vs, x)
+        return {"class": int(np.argmax(logits[0]))}
+
+
+def main():
+    rt.init(num_workers=2)
+    try:
+        serve = Serve()
+        dep = serve.deploy("classify", Classifier, num_replicas=1)
+        ingress = HttpIngress(serve)
+        scaler = ServeAutoscaler(serve, default=ServeScaleConfig(
+            max_replicas=3))
+        scaler.run(interval=0.5)
+
+        img = np.zeros((8, 8, 3), np.float32).tolist()
+        for i in range(3):
+            req = urllib.request.Request(
+                f"{ingress.url}/classify",
+                data=json.dumps({"image": img}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=120) as r:
+                print(f"request {i}: {json.loads(r.read())}")
+        print(f"replicas: {dep.num_replicas}")
+        scaler.stop()
+        ingress.shutdown()
+    finally:
+        rt.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,56 @@
+"""Quickstart: data-parallel ResNet training on a device mesh.
+
+Runs anywhere: on a TPU slice the mesh spans real chips; on a CPU box
+set ``XLA_FLAGS=--xla_force_host_platform_device_count=8`` (done below
+when no accelerator is present) and the same program runs on 8 virtual
+devices.
+
+    python examples/quickstart_train.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))           # run from anywhere
+
+if "xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8"
+                               ).strip()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax                                                    # noqa: E402
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
+import optax                                                  # noqa: E402
+
+from tosem_tpu.data import cifar_like_batches                 # noqa: E402
+from tosem_tpu.models import resnet18_ish                     # noqa: E402
+from tosem_tpu.parallel.mesh import default_mesh              # noqa: E402
+from tosem_tpu.train import (create_train_state,              # noqa: E402
+                             make_train_step, shard_batch)
+from tosem_tpu.train.trainer import classification_loss      # noqa: E402
+
+
+def main():
+    mesh = default_mesh("dp")
+    print(f"devices: {len(jax.devices())} × {jax.devices()[0].platform}")
+    model = resnet18_ish(num_classes=10, dtype=jax.numpy.float32)
+    opt = optax.adamw(1e-3)
+    ts = create_train_state(model, jax.random.PRNGKey(0), opt)
+    step = make_train_step(model, opt, classification_loss, mesh=mesh)
+    rng = jax.random.PRNGKey(1)
+    for i, batch in enumerate(cifar_like_batches(32, steps=20)):
+        rng, sub = jax.random.split(rng)
+        ts, metrics = step(ts, shard_batch(batch, mesh), sub)
+        if i % 5 == 0:
+            print(f"step {i:3d} loss={float(metrics['loss']):.4f} "
+                  f"acc={float(metrics['accuracy']):.3f}")
+    print("done")
+
+
+if __name__ == "__main__":
+    main()

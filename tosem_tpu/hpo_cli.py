@@ -29,7 +29,8 @@ COMMANDS = ("create", "run", "status", "results", "list", "delete")
 def _parse(argv: List[str]) -> Dict[str, Any]:
     if not argv or argv[0] not in COMMANDS:
         raise SystemExit(f"usage: hpo_cli <{'|'.join(COMMANDS)}> "
-                         "[--name N] [--spec FILE] [--db FILE] [--top K]")
+                         "[--name N] [--spec FILE] [--db FILE] [--top K] "
+                         "[--force] [--verbose]")
     opts: Dict[str, Any] = {"cmd": argv[0], "db": DEFAULT_DB,
                             "name": None, "spec": None, "top": 0,
                             "verbose": False, "force": False}

@@ -185,25 +185,30 @@ class ExperimentManager:
         import os
         payload = json.dumps({"pid": os.getpid(),
                               "t": time.time()}).encode()
-        if self.kv.cas(_NS_LOCK, name, None, payload):
-            return payload
-        blob = self.kv.get(_NS_LOCK, name)
-        if blob is None:                       # released between calls
-            return (payload if self.kv.cas(_NS_LOCK, name, None, payload)
-                    else None)
-        stale = force
-        if not stale:
-            try:
-                holder = json.loads(blob)
-                os.kill(int(holder["pid"]), 0)  # raises if dead
-            except ProcessLookupError:
-                stale = True                    # holder crashed
-            except PermissionError:
-                pass                            # alive, other user
-            except (ValueError, KeyError, TypeError):
-                pass          # unreadable: assume held, require --force
-        if stale and self.kv.cas(_NS_LOCK, name, blob, payload):
-            return payload
+        # small retry loop: the observed lock value can change between
+        # the read and the CAS (holder releasing, another takeover) —
+        # force in particular must not lose to that race
+        for _ in range(4):
+            if self.kv.cas(_NS_LOCK, name, None, payload):
+                return payload
+            blob = self.kv.get(_NS_LOCK, name)
+            if blob is None:                   # released between calls
+                continue
+            stale = force
+            if not stale:
+                try:
+                    holder = json.loads(blob)
+                    os.kill(int(holder["pid"]), 0)  # raises if dead
+                except ProcessLookupError:
+                    stale = True                    # holder crashed
+                except PermissionError:
+                    return None                     # alive, other user
+                except (ValueError, KeyError, TypeError):
+                    return None   # unreadable: assume held, need force
+            if not stale:
+                return None                         # holder is alive
+            if self.kv.cas(_NS_LOCK, name, blob, payload):
+                return payload
         return None
 
     def run(self, name: str, verbose: bool = False,
@@ -264,15 +269,26 @@ class ExperimentManager:
                 "trials": trials,
             }
         except BaseException as e:
-            self._set_state(name, {"status": "failed", "error": repr(e),
-                                   "ended_at": time.time()})
-            raise
-        finally:
-            # conditional: a displaced runner (someone force-took the
-            # lock) must not delete its successor's lock
+            if self._owns_lock(name, my_lock):
+                self._set_state(name, {"status": "failed",
+                                       "error": repr(e),
+                                       "ended_at": time.time()})
             self.kv.delete_if(_NS_LOCK, name, my_lock)
-        self._set_state(name, state)
+            raise
+        # a displaced runner (someone force-took the lock) must write
+        # NEITHER the lock nor the state — its results are unwanted
+        owns = self._owns_lock(name, my_lock)
+        if owns:
+            self._set_state(name, state)
+        self.kv.delete_if(_NS_LOCK, name, my_lock)
+        if not owns:
+            import sys
+            print(f"[experiment] {name!r}: displaced by a forced "
+                  "takeover; results not persisted", file=sys.stderr)
         return state
+
+    def _owns_lock(self, name: str, my_lock: bytes) -> bool:
+        return self.kv.get(_NS_LOCK, name) == my_lock
 
     def _set_state(self, name: str, state: Dict[str, Any]) -> None:
         self.kv.put(_NS_STATE, name,
