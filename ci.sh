@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# CI gate — the reference's build/test pipeline role (Ray's bazel test
+# jobs + sanitizer jobs, DeepSpeech's taskcluster, NNI's azure
+# pipelines), collapsed to one script. Everything runs on a virtual
+# 8-device CPU mesh; no accelerator required.
+set -euo pipefail
+cd "$(dirname "$0")"
+
+echo "== byte-compile (syntax gate)"
+python -m compileall -q tosem_tpu tests examples bench.py __graft_entry__.py
+
+echo "== native builds (objstore, decoder, speech API, PJRT driver)"
+python - <<'EOF'
+from tosem_tpu.native import build_binary, load_library
+for stem in ("objstore", "ctc_decoder", "speech_api"):
+    load_library(stem)
+build_binary("pjrt_driver")
+print("native artifacts built")
+EOF
+
+echo "== unit + integration tests (virtual 8-device CPU mesh)"
+python -m pytest tests/ -q
+
+echo "== sanitizer gates (ASAN/UBSAN/LSAN + TSAN)"
+python - <<'EOF'
+from tosem_tpu.native.sanitize import run_stress
+for suite, san in (("objstore", "asan"), ("decoder", "asan"),
+                   ("objstore", "tsan"), ("decoder", "tsan")):
+    rc, out = run_stress(suite, san, iters=150)
+    assert rc == 0, f"{suite}/{san} failed:\n{out[-2000:]}"
+    print(f"{suite}/{san}: clean")
+EOF
+
+echo "== multichip dryrun (8 virtual devices: dp/tp/sp + pp + ep)"
+python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+echo "== CI green"

@@ -1,0 +1,29 @@
+"""Shared example bootstrap.
+
+Examples default to the hermetic virtual-device CPU mesh so they run
+identically on any box (the conftest recipe: env var AND jax.config,
+because a sitecustomize may preset the platform — a preset
+``JAX_PLATFORMS`` is machine config, not a user choice, so it is NOT
+treated as opting in). To run an example on real hardware, set
+``TOSEM_EXAMPLE_PLATFORM=tpu`` (or your accelerator).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def setup(virtual_devices: int = 8) -> None:
+    explicit = os.environ.get("TOSEM_EXAMPLE_PLATFORM", "")
+    if explicit not in ("", "cpu"):
+        os.environ["JAX_PLATFORMS"] = explicit
+        return                      # user chose real hardware: honor it
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count="
+            f"{virtual_devices}").strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"     # force, not setdefault
+    import jax
+    jax.config.update("jax_platforms", "cpu")

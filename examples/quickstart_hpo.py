@@ -1,22 +1,13 @@
 """Quickstart: hyperparameter search with trials as runtime actors.
 
 ASHA early stopping over a TPE suggester — the Tune/NNI workflow in ten
-lines.
+lines. Hermetic CPU by default; set TOSEM_EXAMPLE_PLATFORM for hardware.
 
     python examples/quickstart_hpo.py
 """
-import os
-import sys
+import _bootstrap
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))))           # run from anywhere
-
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-
-import jax                                                    # noqa: E402
-
-if os.environ.get("JAX_PLATFORMS") == "cpu":
-    jax.config.update("jax_platforms", "cpu")
+_bootstrap.setup()
 
 from tosem_tpu import tune                                    # noqa: E402
 
@@ -38,7 +29,7 @@ def main():
         scheduler=tune.ASHAScheduler(max_t=30, grace_period=3),
         search_alg=tune.TPESearch(seed=0),
         max_concurrent=4)
-    print(f"best loss={analysis.best_trial.best_score * -1:.5f} "
+    print(f"best loss={-analysis.best_trial.best_score:.5f} "
           f"config={analysis.best_config}")
 
 

@@ -3,22 +3,15 @@
     python examples/quickstart_serve.py
 
 Deploys a tiny classifier behind the router + HTTP ingress, posts a few
-requests, and shows the autoscaler reacting to load.
+requests, and shows the autoscaler reacting to load. Hermetic CPU by
+default; set TOSEM_EXAMPLE_PLATFORM for hardware.
 """
 import json
-import os
-import sys
 import urllib.request
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))))           # run from anywhere
+import _bootstrap
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-
-import jax                                                    # noqa: E402
-
-if os.environ.get("JAX_PLATFORMS") == "cpu":
-    jax.config.update("jax_platforms", "cpu")
+_bootstrap.setup()
 
 import numpy as np                                            # noqa: E402
 
@@ -31,13 +24,12 @@ class Classifier:
     """Replica backend: loads the model once, serves many requests."""
 
     def __init__(self):
+        import jax
         import jax.numpy as jnp
         from tosem_tpu.models import resnet18_ish
-        self.model = resnet18_ish(num_classes=10,
-                                  dtype=jnp.float32)
+        self.model = resnet18_ish(num_classes=10, dtype=jnp.float32)
         self.vs = self.model.init(jax.random.PRNGKey(0))
-        self.fwd = jax.jit(
-            lambda vs, x: self.model.apply(vs, x)[0])
+        self.fwd = jax.jit(lambda vs, x: self.model.apply(vs, x)[0])
 
     def call(self, request):
         x = np.asarray(request["image"], np.float32)[None]
@@ -50,18 +42,21 @@ def main():
     try:
         serve = Serve()
         dep = serve.deploy("classify", Classifier, num_replicas=1)
-        ingress = HttpIngress(serve)
+        # warm the replica BEFORE serving: actor boot + jit compile can
+        # take the better part of a minute on a cold CPU box
+        img = np.zeros((8, 8, 3), np.float32).tolist()
+        serve.get_handle("classify").call({"image": img}, timeout=300)
+        ingress = HttpIngress(serve, request_timeout=180)
         scaler = ServeAutoscaler(serve, default=ServeScaleConfig(
             max_replicas=3))
         scaler.run(interval=0.5)
 
-        img = np.zeros((8, 8, 3), np.float32).tolist()
         for i in range(3):
             req = urllib.request.Request(
                 f"{ingress.url}/classify",
                 data=json.dumps({"image": img}).encode(),
                 headers={"Content-Type": "application/json"})
-            with urllib.request.urlopen(req, timeout=120) as r:
+            with urllib.request.urlopen(req, timeout=200) as r:
                 print(f"request {i}: {json.loads(r.read())}")
         print(f"replicas: {dep.num_replicas}")
         scaler.stop()

@@ -1,30 +1,16 @@
 """Quickstart: data-parallel ResNet training on a device mesh.
 
-Runs anywhere: on a TPU slice the mesh spans real chips; on a CPU box
-set ``XLA_FLAGS=--xla_force_host_platform_device_count=8`` (done below
-when no accelerator is present) and the same program runs on 8 virtual
-devices.
+Defaults to a hermetic 8-virtual-device CPU mesh so it runs on any box;
+set ``TOSEM_EXAMPLE_PLATFORM=tpu`` (or your accelerator) to span real
+chips with the SAME program.
 
     python examples/quickstart_train.py
 """
-import os
-import sys
+import _bootstrap
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))))           # run from anywhere
-
-if "xla_force_host_platform_device_count" not in os.environ.get(
-        "XLA_FLAGS", ""):
-    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
-                               + " --xla_force_host_platform_device_count=8"
-                               ).strip()
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_bootstrap.setup()
 
 import jax                                                    # noqa: E402
-
-if os.environ.get("JAX_PLATFORMS") == "cpu":
-    jax.config.update("jax_platforms", "cpu")
-
 import optax                                                  # noqa: E402
 
 from tosem_tpu.data import cifar_like_batches                 # noqa: E402
@@ -37,7 +23,7 @@ from tosem_tpu.train.trainer import classification_loss      # noqa: E402
 
 def main():
     mesh = default_mesh("dp")
-    print(f"devices: {len(jax.devices())} × {jax.devices()[0].platform}")
+    print(f"devices: {len(jax.devices())} x {jax.devices()[0].platform}")
     model = resnet18_ish(num_classes=10, dtype=jax.numpy.float32)
     opt = optax.adamw(1e-3)
     ts = create_train_state(model, jax.random.PRNGKey(0), opt)

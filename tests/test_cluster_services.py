@@ -59,6 +59,21 @@ class TestKV:
         kv.put("ns", "a%b", b"z")
         assert kv.keys("ns", "a%") == ["a%b"]
 
+    def test_put_if_other_guarded_write(self):
+        kv = KVStore()
+        kv.put("lock", "e", b"holder-A")
+        # guard satisfied: write lands (insert then update)
+        assert kv.put_if_other("state", "e", b"s1", "lock", "e",
+                               b"holder-A")
+        assert kv.put_if_other("state", "e", b"s2", "lock", "e",
+                               b"holder-A")
+        assert kv.get("state", "e") == b"s2"
+        # guard fails (lock taken over): write is atomically refused
+        kv.put("lock", "e", b"holder-B")
+        assert not kv.put_if_other("state", "e", b"s3", "lock", "e",
+                                   b"holder-A")
+        assert kv.get("state", "e") == b"s2"
+
     def test_queue_lease_ack_reap(self):
         kv = KVStore()
         i1 = kv.push("jobs", b"one")

@@ -67,6 +67,25 @@ class KVStore:
             self._db.commit()
             return cur.rowcount > 0
 
+    def put_if_other(self, ns: str, key: str, value: bytes,
+                     guard_ns: str, guard_key: str,
+                     guard_expect: bytes) -> bool:
+        """Upsert (ns, key) only while another row still holds an
+        expected value — one SQL statement, so it is atomic across
+        processes. The write-while-holding-the-lock primitive (a
+        displaced lock holder must not clobber its successor's state)."""
+        with self._lock:
+            cur = self._db.execute(
+                "INSERT INTO kv (ns, k, v, updated) "
+                "SELECT ?, ?, ?, ? WHERE EXISTS "
+                "(SELECT 1 FROM kv WHERE ns=? AND k=? AND v=?) "
+                "ON CONFLICT (ns, k) DO UPDATE SET v=excluded.v, "
+                "updated=excluded.updated",
+                (ns, key, value, time.time(),
+                 guard_ns, guard_key, guard_expect))
+            self._db.commit()
+            return cur.rowcount > 0
+
     def delete_if(self, ns: str, key: str, expect: bytes) -> bool:
         """Atomic compare-and-delete (single statement — safe across
         processes): removes the row only if it still holds ``expect``.

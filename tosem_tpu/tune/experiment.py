@@ -269,17 +269,17 @@ class ExperimentManager:
                 "trials": trials,
             }
         except BaseException as e:
-            if self._owns_lock(name, my_lock):
-                self._set_state(name, {"status": "failed",
-                                       "error": repr(e),
-                                       "ended_at": time.time()})
+            self._set_state_if_owner(name, my_lock,
+                                     {"status": "failed",
+                                      "error": repr(e),
+                                      "ended_at": time.time()})
             self.kv.delete_if(_NS_LOCK, name, my_lock)
             raise
         # a displaced runner (someone force-took the lock) must write
-        # NEITHER the lock nor the state — its results are unwanted
-        owns = self._owns_lock(name, my_lock)
-        if owns:
-            self._set_state(name, state)
+        # NEITHER the lock nor the state — its results are unwanted.
+        # The write is atomically guarded on still holding the lock
+        # (put_if_other), so there is no check-then-write window.
+        owns = self._set_state_if_owner(name, my_lock, state)
         self.kv.delete_if(_NS_LOCK, name, my_lock)
         if not owns:
             import sys
@@ -287,8 +287,11 @@ class ExperimentManager:
                   "takeover; results not persisted", file=sys.stderr)
         return state
 
-    def _owns_lock(self, name: str, my_lock: bytes) -> bool:
-        return self.kv.get(_NS_LOCK, name) == my_lock
+    def _set_state_if_owner(self, name: str, my_lock: bytes,
+                            state: Dict[str, Any]) -> bool:
+        blob = json.dumps(state, sort_keys=True, default=str).encode()
+        return self.kv.put_if_other(_NS_STATE, name, blob,
+                                    _NS_LOCK, name, my_lock)
 
     def _set_state(self, name: str, state: Dict[str, Any]) -> None:
         self.kv.put(_NS_STATE, name,
