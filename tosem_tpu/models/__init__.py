@@ -1,6 +1,8 @@
 from tosem_tpu.models.resnet import ResNet, resnet50, resnet18_ish
 from tosem_tpu.models.bert import (Bert, BertConfig, bert_base, bert_tiny,
                                    bert_tiny_moe)
+from tosem_tpu.models.bert_pipeline import (make_bert_pipeline_fn,
+                                            stack_layer_params)
 from tosem_tpu.models.pointpillars import (PillarFeatureNet, PillarGrid,
                                            PointPillarsDetector, device_nms,
                                            voxelize)

@@ -1,0 +1,84 @@
+"""Pipeline-parallel BERT: the flagship encoder over a ``pp`` mesh.
+
+Combines :mod:`tosem_tpu.parallel.pipeline` (GPipe microbatching via
+ppermute) with the BERT encoder: embeddings and the output head stay
+replicated; the homogeneous encoder stack is split into ``pp``
+contiguous stages whose stacked params shard ``P("pp")``; inside each
+stage a ``lax.scan`` applies that stage's layers (layers are
+structurally identical, so their params stack into one pytree). The
+result is numerically identical to the sequential model — pinned by
+tests — with the encoder's weights and FLOPs distributed across the
+pipeline.
+
+Scope: dense BERT (MoE layers break stage homogeneity), no padding mask
+inside the pipelined stack (the common fixed-length pretraining shape;
+masked serving goes through the GSPMD path instead). Dropout off (the
+deterministic inference/eval form).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh
+
+from tosem_tpu.models.bert import Bert, EncoderLayer
+from tosem_tpu.nn.core import variables
+from tosem_tpu.parallel.pipeline import (make_pipeline_fn, microbatch,
+                                         stack_stage_params, unmicrobatch)
+
+
+def stack_layer_params(params: Dict[str, Any], n_layers: int,
+                       n_stages: int) -> Any:
+    """``layer{i}`` subtrees → one pytree [n_stages, per_stage, ...]."""
+    if n_layers % n_stages:
+        raise ValueError(f"{n_layers} layers not divisible into "
+                         f"{n_stages} stages")
+    per = n_layers // n_stages
+    stacked = stack_stage_params(
+        [params[f"layer{i}"] for i in range(n_layers)])
+    return jax.tree_util.tree_map(
+        lambda x: x.reshape((n_stages, per) + x.shape[1:]), stacked)
+
+
+def make_bert_pipeline_fn(model: Bert, mesh: Mesh, *, n_micro: int,
+                          axis: str = "pp"):
+    """→ ``fwd(params, ids) -> encodings [B, T, dim]`` pipelined over
+    ``mesh[axis]``. ``params`` is the model's normal params pytree; the
+    layer stack is stacked/sharded internally per call (cheap: device
+    puts of already-device-resident arrays)."""
+    cfg = model.cfg
+    if cfg.moe_experts:
+        raise ValueError(
+            "pipeline BERT requires a homogeneous (dense) encoder "
+            "stack; MoE layers have a different param structure — use "
+            "the GSPMD ep path for MoE-BERT")
+    n_stages = mesh.shape[axis]
+    layer_module = EncoderLayer(cfg)
+
+    def stage_fn(stage_params, h):
+        # stage_params: [per_stage, ...] — scan applies each layer
+        def body(h, lp):
+            out, _ = layer_module.apply(variables(lp), h, mask=None,
+                                        train=False)
+            return out, None
+        h, _ = lax.scan(body, h, stage_params)
+        return h
+
+    pipe = make_pipeline_fn(stage_fn, mesh, n_micro=n_micro, axis=axis)
+
+    def fwd(params, ids):
+        B, T = ids.shape
+        pos_ids = jnp.arange(T)[None, :]
+        h, _ = model.tok.apply(variables(params["tok"]), ids)
+        hp, _ = model.pos.apply(variables(params["pos"]), pos_ids)
+        h = h + hp
+        h, _ = model.ln_emb.apply(variables(params["ln_emb"]), h)
+        stacked = stack_layer_params(params, cfg.layers, n_stages)
+        h = unmicrobatch(pipe(stacked, microbatch(h, n_micro)))
+        h, _ = model.ln_out.apply(variables(params["ln_out"]), h)
+        return h
+
+    return fwd
