@@ -82,6 +82,57 @@ def test_jax_leaves_serializable():
     np.testing.assert_array_equal(np.asarray(t["w"]), got["w"])
 
 
+def test_fuzz_random_trees_roundtrip():
+    import jax.numpy as jnp
+    rng = np.random.default_rng(0)
+    dtypes = [np.float32, np.float64, np.int32, np.int64, np.int8,
+              np.uint8, np.bool_]
+
+    def rand_tree(depth):
+        kind = rng.integers(0, 6 if depth < 3 else 3)
+        if kind == 0:
+            shape = tuple(rng.integers(0, 5, rng.integers(0, 4)))
+            dt = dtypes[rng.integers(len(dtypes))]
+            return (rng.random(shape) * 10).astype(dt)
+        if kind == 1:
+            return jnp.asarray(rng.random((2, 3)), jnp.bfloat16)
+        if kind == 2:
+            return [None, True, 7, -1.5, "text"][rng.integers(5)]
+        if kind == 3:
+            return {f"k{i}": rand_tree(depth + 1)
+                    for i in range(rng.integers(0, 4))}
+        if kind == 4:
+            return [rand_tree(depth + 1)
+                    for _ in range(rng.integers(0, 4))]
+        return tuple(rand_tree(depth + 1)
+                     for _ in range(rng.integers(0, 3)))
+
+    def assert_same(a, b):
+        if isinstance(a, dict):
+            assert set(a) == set(b)
+            for k in a:
+                assert_same(a[k], b[k])
+        elif isinstance(a, (list, tuple)):
+            assert type(a) is type(b) and len(a) == len(b)
+            for x, y in zip(a, b):
+                assert_same(x, y)
+        elif hasattr(a, "dtype"):
+            assert str(np.asarray(b).dtype) == str(np.asarray(a).dtype)
+            assert np.asarray(b).shape == np.asarray(a).shape
+            np.testing.assert_array_equal(np.asarray(a, np.float64)
+                                          if a.dtype != np.bool_
+                                          else np.asarray(a),
+                                          np.asarray(b, np.float64)
+                                          if a.dtype != np.bool_
+                                          else np.asarray(b))
+        else:
+            assert a == b and type(a) is type(b)
+
+    for _ in range(40):
+        t = {"root": rand_tree(0)}
+        assert_same(t, load_tree(dump_tree(t)))
+
+
 def test_htm_network_save_restore_bit_exact(tmp_path):
     from tosem_tpu.models.htm_network import anomaly_network
     sig = np.sin(np.arange(200) / 7.0) * 2.0
