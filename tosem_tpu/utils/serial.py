@@ -59,8 +59,9 @@ def _plan(obj: Any, leaves: List[np.ndarray]):
         return {kind: [_plan(v, leaves) for v in obj]}
     if obj is None or isinstance(obj, (bool, int, float, str)):
         return {"__scalar__": obj}
-    arr = np.asarray(obj)
-    leaves.append(np.ascontiguousarray(arr))
+    # order="C" forces contiguity WITHOUT ascontiguousarray's 0-d→(1,)
+    # promotion (which silently corrupted scalar-leaf shapes)
+    leaves.append(np.asarray(obj, order="C"))
     return {"__leaf__": len(leaves) - 1}
 
 
