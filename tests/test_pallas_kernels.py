@@ -111,6 +111,38 @@ class TestFlashAttention:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=5e-4, rtol=5e-3, err_msg=name)
 
+    def test_bf16_fwd_matches_reference(self):
+        """bf16 operands must stay bf16 into the MXU (native rate); parity
+        vs the XLA path computed at the same operand precision."""
+        q, k, v = _qkv(B=2, H=4, T=128, D=64, dtype=jnp.bfloat16)
+        out = flash_attention(q, k, v, None, False, 64, 64)
+        tr = lambda x: x.transpose(0, 2, 1, 3)
+        ref = tr(dot_product_attention(tr(q), tr(k), tr(v),
+                                       precision="default"))
+        assert out.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref, np.float32),
+            atol=2e-2, rtol=2e-2)
+
+    def test_bf16_grads_match_fp32_grads(self):
+        """bf16 grads track the fp32 reference within bf16 resolution."""
+        q, k, v = _qkv(B=1, H=2, T=64, D=64)
+
+        def loss(fn, *xs):
+            return jnp.sum(fn(*xs).astype(jnp.float32) ** 2)
+
+        gf = jax.grad(
+            lambda a, b, c: loss(
+                lambda *x: flash_attention(*x, None, False, 32, 32),
+                a.astype(jnp.bfloat16), b.astype(jnp.bfloat16),
+                c.astype(jnp.bfloat16)), (0, 1, 2))(q, k, v)
+        gr = jax.grad(lambda a, b, c: loss(_ref_attention, a, b, c),
+                      (0, 1, 2))(q, k, v)
+        for a, b, name in zip(gf, gr, "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(a, np.float32), np.asarray(b, np.float32),
+                atol=0.5, rtol=5e-2, err_msg=name)
+
     def test_rejects_indivisible_lengths(self):
         q, k, v = _qkv(T=100)
         with pytest.raises(ValueError):

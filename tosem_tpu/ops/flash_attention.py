@@ -11,6 +11,16 @@ Layout: [B, H, T, D]. Grid (B·H, Tq/bq); K/V stream through VMEM in bk
 chunks inside a fori_loop. All statistics in fp32. Backward uses the
 standard recompute-from-logsumexp scheme (two kernels: dKV and dQ).
 
+Dtype discipline (the MXU contract): matmul *operands* stay in the input
+dtype — bf16 inputs hit the MXU at the native single-pass rate with fp32
+accumulation via ``preferred_element_type``; fp32 inputs keep full fp32
+operands. Softmax statistics (max/sum/lse/delta) are always fp32; the
+probability matrix is cast back to the operand dtype only for the PV-style
+matmuls. The softmax scale is applied to the fp32 scores, never to the
+operands. (Upcasting bf16 operands to fp32 before the dots — the round-3
+kernel — forces every matmul onto the 6-pass fp32-emulation path, ~6×
+slower than native bf16.)
+
 The XLA reference implementation for parity tests lives in
 ``tosem_tpu.nn.attention.dot_product_attention``.
 """
@@ -38,6 +48,10 @@ _LANES = 128
 
 from tosem_tpu.ops.common import interpret_default as _interpret
 
+# every grid cell is independent in all three kernels (the K/V loop is a
+# fori_loop *inside* the cell), so Mosaic may overlap/reorder cells freely
+_PARALLEL = pltpu.CompilerParams(dimension_semantics=("parallel", "parallel"))
+
 
 def _causal_mask(bq: int, bk: int, qi: int, kj: int):
     rows = lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + qi
@@ -50,18 +64,19 @@ def _causal_mask(bq: int, bk: int, qi: int, kj: int):
 # ---------------------------------------------------------------------------
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bk, sm_scale, causal):
-    q = q_ref[0].astype(jnp.float32) * sm_scale          # (bq, d)
+    q = q_ref[0]                                         # (bq, d), native dtype
     bq, d = q.shape
+    cdt = q.dtype                                        # MXU operand dtype
     Tk = k_ref.shape[1]
     qi = pl.program_id(1) * bq
 
     def body(j, carry):
         m, l, acc = carry
         kj = j * bk
-        k = k_ref[0, pl.ds(kj, bk), :].astype(jnp.float32)   # (bk, d)
-        v = v_ref[0, pl.ds(kj, bk), :].astype(jnp.float32)
+        k = k_ref[0, pl.ds(kj, bk), :]                   # (bk, d)
+        v = v_ref[0, pl.ds(kj, bk), :]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32) * sm_scale
         if causal:
             s = jnp.where(_causal_mask(bq, bk, qi, kj), s, _NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, -1, keepdims=True))
@@ -69,7 +84,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bk, sm_scale, causal):
         alpha = jnp.exp(m - m_new)
         l = l * alpha + jnp.sum(p, -1, keepdims=True)
         acc = acc * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(cdt), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return m_new, l, acc
 
@@ -117,6 +132,7 @@ def _flash_fwd(q, k, v, sm_scale, causal, bq, bk):
             jax.ShapeDtypeStruct((B * H, Tq, d), q.dtype),
             jax.ShapeDtypeStruct((B * H, Tq, _LANES), jnp.float32),
         ],
+        compiler_params=_PARALLEL,
         interpret=_interpret(),
     )(qr, kr, vr)
     return out.reshape(B, H, Tq, d), lse  # lse stays in lanes layout
@@ -128,8 +144,9 @@ def _flash_fwd(q, k, v, sm_scale, causal, bq, bk):
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, *, bq, sm_scale, causal):
-    k = k_ref[0].astype(jnp.float32)                     # (bk, d)
-    v = v_ref[0].astype(jnp.float32)
+    k = k_ref[0]                                         # (bk, d), native
+    v = v_ref[0]
+    cdt = k.dtype
     bk, d = k.shape
     Tq = q_ref.shape[1]
     kj = pl.program_id(1) * bk
@@ -137,21 +154,24 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def body(i, carry):
         dk, dv = carry
         qi = i * bq
-        q = q_ref[0, pl.ds(qi, bq), :].astype(jnp.float32) * sm_scale
-        do = do_ref[0, pl.ds(qi, bq), :].astype(jnp.float32)
+        q = q_ref[0, pl.ds(qi, bq), :]                   # native, unscaled
+        do = do_ref[0, pl.ds(qi, bq), :]
         lse = lse_ref[0, pl.ds(qi, bq), 0:1]     # lanes layout: col 0
         delta = delta_ref[0, pl.ds(qi, bq), 0:1]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32) * sm_scale
         if causal:
             s = jnp.where(_causal_mask(bq, bk, qi, kj), s, _NEG_INF)
-        p = jnp.exp(s - lse)                              # (bq, bk)
-        dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+        p = jnp.exp(s - lse)                              # (bq, bk) fp32
+        dv = dv + jax.lax.dot_general(p.astype(cdt), do,
+                                      (((0,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)                             # (bq, bk)
-        dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+        # ds carries the softmax scale (q is loaded unscaled)
+        ds = p * (dp - delta) * sm_scale                  # (bq, bk)
+        dk = dk + jax.lax.dot_general(ds.astype(cdt), q,
+                                      (((0,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32)
         return dk, dv
 
@@ -162,16 +182,15 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk, dv = lax.fori_loop(start, Tq // bq, body, (dk0, dv0))
     else:
         dk, dv = lax.fori_loop(0, Tq // bq, body, (dk0, dv0))
-    # q was loaded pre-scaled, so dk = ds^T @ (scale*q) already carries the
-    # softmax scale — no extra factor here
     dk_ref[0] = dk.astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                    dq_ref, *, bk, sm_scale, causal):
-    q = q_ref[0].astype(jnp.float32) * sm_scale
-    do = do_ref[0].astype(jnp.float32)
+    q = q_ref[0]                                         # native, unscaled
+    do = do_ref[0]
+    cdt = q.dtype
     lse = lse_ref[0, :, 0:1]                     # lanes layout: col 0
     delta = delta_ref[0, :, 0:1]
     bq, d = q.shape
@@ -180,17 +199,18 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     def body(j, dq):
         kj = j * bk
-        k = k_ref[0, pl.ds(kj, bk), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(kj, bk), :].astype(jnp.float32)
+        k = k_ref[0, pl.ds(kj, bk), :]
+        v = v_ref[0, pl.ds(kj, bk), :]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32) * sm_scale
         if causal:
             s = jnp.where(_causal_mask(bq, bk, qi, kj), s, _NEG_INF)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
-        return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+        return dq + jax.lax.dot_general(ds.astype(cdt), k,
+                                        (((1,), (0,)), ((), ())),
                                         preferred_element_type=jnp.float32)
 
     dq0 = jnp.zeros((bq, d), jnp.float32)
@@ -230,6 +250,7 @@ def _flash_bwd(sm_scale, causal, bq, bk, res, g):
                    pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0))],
         out_shape=[jax.ShapeDtypeStruct((B * H, Tk, d), k.dtype),
                    jax.ShapeDtypeStruct((B * H, Tk, d), v.dtype)],
+        compiler_params=_PARALLEL,
         interpret=_interpret(),
     )(*args)
     kv_full = pl.BlockSpec((1, Tk, d), lambda b, i: (b, 0, 0))
@@ -244,6 +265,7 @@ def _flash_bwd(sm_scale, causal, bq, bk, res, g):
                   pl.BlockSpec((1, bq, _LANES), lambda b, i: (b, i, 0))],
         out_specs=pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B * H, Tq, d), q.dtype),
+        compiler_params=_PARALLEL,
         interpret=_interpret(),
     )(*args)
     return (dq.reshape(B, H, Tq, d), dk.reshape(B, H, Tk, d),
