@@ -1,0 +1,160 @@
+"""Study analysis layer (L8): the RQ3/RQ4 consumer over our own repo.
+
+Role model: the reference's analysis outputs ``RQs/RQ4/tests_methods_v3.csv``
+(header ``Test_methods,total_cases,percentage,correlate,Strategy,Repos``) and
+``RQs/RQ3/tests_correlate_rq3.csv`` (strategy rows × quality-property columns
+with ``project:(pct%)`` cells). These tests pin our emitted schema to those
+shapes so the study's downstream analysis stays compatible.
+"""
+import csv
+import os
+import textwrap
+
+from tosem_tpu.analysis import (
+    bench_correlate, bench_summary, classify_tests, run_study,
+)
+from tosem_tpu.analysis.study import METHODS, PROPERTIES, RQ4_HEADER
+
+REPO_TESTS = os.path.dirname(os.path.abspath(__file__))
+
+
+def _write_sample_suite(tmp_path):
+    tmp_path.mkdir(parents=True, exist_ok=True)
+    (tmp_path / "test_sample.py").write_text(textwrap.dedent('''
+        import pytest
+        import numpy as np
+        from tosem_tpu.ops.gemm import gemm
+
+        def test_matches_reference():
+            np.testing.assert_allclose([1.0], [1.0], atol=1e-6)
+
+        def test_rejects_bad_shape():
+            with pytest.raises(ValueError):
+                raise ValueError()
+
+        def test_regression_overflow():
+            """Regression: large inputs must not overflow."""
+            assert abs(2.0 - 2.0) < 1e-9
+
+        def test_end_to_end_pipeline():
+            assert 1 == 1
+    '''))
+    return str(tmp_path)
+
+
+class TestClassification:
+    def test_sample_suite_taxonomy(self, tmp_path):
+        cases = classify_tests(_write_sample_suite(tmp_path))
+        by_name = {c.name: c for c in cases}
+        assert len(cases) == 4
+        assert by_name["test_matches_reference"].method == "unit_test"
+        assert "absolute_relative_tolerence" in \
+            by_name["test_matches_reference"].strategies
+        assert "pseaudo_oracle" in by_name["test_matches_reference"].strategies
+        assert "negative_test" in by_name["test_rejects_bad_shape"].strategies
+        assert "value_error" in by_name["test_rejects_bad_shape"].strategies
+        assert by_name["test_regression_overflow"].method == "regression"
+        assert "error_bounding" in \
+            by_name["test_regression_overflow"].strategies
+        assert by_name["test_end_to_end_pipeline"].method == "end_to_end"
+        assert all(c.project == "ops" for c in cases)
+        assert all("Correctness" in c.properties for c in cases)
+
+    def test_real_suite_classifies(self):
+        """The analyzer must digest this very repo's suite: hundreds of
+        tests, mostly unit, nearly all carrying at least one strategy."""
+        cases = classify_tests(REPO_TESTS)
+        assert len(cases) > 300
+        methods = {c.method for c in cases}
+        assert "unit_test" in methods and "integration" in methods
+        with_strategy = sum(1 for c in cases if c.strategies)
+        assert with_strategy / len(cases) > 0.9
+        assert len({c.project for c in cases}) >= 10
+
+
+class TestSchemas:
+    def test_rq4_and_rq3_headers(self, tmp_path):
+        out = tmp_path / "analysis"
+        summary = run_study(_write_sample_suite(tmp_path / "suite"),
+                            [], str(out))
+        assert summary["n_tests"] == 4
+        with open(out / "tests_methods.csv", newline="") as f:
+            rows = list(csv.reader(f))
+        # exact RQ4 schema (tests_methods_v3.csv)
+        assert rows[0] == RQ4_HEADER
+        assert [r[0] for r in rows[1:]] == METHODS
+        total = sum(int(r[1]) for r in rows[1:])
+        assert total == 4
+        pct = sum(float(r[2]) for r in rows[1:])
+        assert abs(pct - 100.0) < 0.1
+        with open(out / "tests_correlate.csv", newline="") as f:
+            rows = list(csv.reader(f))
+        # exact RQ3 column set (tests_correlate_rq3.csv)
+        assert rows[0] == ["Tests"] + PROPERTIES
+        # cells are 0 or "project:(pct%), " lists
+        for row in rows[1:]:
+            for cell in row[1:]:
+                assert cell == "0" or "%)" in cell
+
+    def test_strategy_and_properties_tables(self, tmp_path):
+        out = tmp_path / "analysis"
+        run_study(_write_sample_suite(tmp_path / "suite"), [], str(out))
+        with open(out / "tests_strategy.csv", newline="") as f:
+            rows = list(csv.reader(f))
+        assert rows[0][0] == "Tests" and rows[0][-1] == "MEAN"
+        with open(out / "properties.csv", newline="") as f:
+            rows = list(csv.reader(f))
+        assert rows[0][0] == "Repos"
+        assert any(r[0] == "Correctness" for r in rows[1:])
+
+
+class TestBenchIngestion:
+    def _bench_csv(self, tmp_path):
+        path = tmp_path / "bench.csv"
+        with open(path, "w", newline="") as f:
+            w = csv.writer(f)
+            w.writerow(["timestamp", "project", "config", "bench_id",
+                        "metric", "value", "unit", "device", "n_devices",
+                        "extra"])
+            # value perfectly tracks mfu, anti-tracks time_us
+            for i, v in enumerate([100.0, 200.0, 300.0, 400.0]):
+                w.writerow([0, "ops", "gemm", f"g{i}", "gflops", v,
+                            "GFLOPS", "tpu", 1,
+                            '{"mfu": %f, "time_us": %f}' % (v / 1000,
+                                                            1e6 / v)])
+        return str(path)
+
+    def test_bench_summary(self, tmp_path):
+        header, rows = bench_summary([self._bench_csv(tmp_path)])
+        assert header[:3] == ["config", "unit", "n_rows"]
+        assert rows[0][0] == "gemm" and rows[0][2] == "4"
+        assert float(rows[0][5]) == 400.0  # max
+        assert rows[0][6] == "g3"          # best row id
+
+    def test_bench_correlate_signs(self, tmp_path):
+        header, rows = bench_correlate([self._bench_csv(tmp_path)])
+        assert header == ["config", "metric", "field", "n", "pearson",
+                          "spearman"]
+        by_field = {r[2]: r for r in rows}
+        assert float(by_field["mfu"][4]) > 0.999     # perfect +corr
+        assert float(by_field["mfu"][5]) > 0.999
+        assert float(by_field["time_us"][5]) < -0.999  # rank anti-corr
+
+    def test_missing_csv_is_empty_not_error(self):
+        header, rows = bench_correlate(["/nonexistent/never.csv"])
+        assert rows == []
+
+
+class TestRepoAnalysisEndToEnd:
+    def test_end_to_end_run_study_on_repo(self, tmp_path):
+        """Full L8 loop: this repo's tests + its results CSVs in, RQ tables
+        out — the analog of running the study's R scripts."""
+        results = [os.path.join(os.path.dirname(REPO_TESTS), "results", n)
+                   for n in ("tpu_full.csv", "smoke.csv")]
+        summary = run_study(REPO_TESTS, results, str(tmp_path / "out"))
+        assert summary["n_tests"] > 300
+        assert summary["with_strategy_pct"] > 90
+        for name in ("tests_methods.csv", "tests_correlate.csv",
+                     "tests_strategy.csv", "properties.csv",
+                     "bench_summary.csv", "bench_correlate.csv"):
+            assert (tmp_path / "out" / name).exists(), name

@@ -1,0 +1,11 @@
+from tosem_tpu.analysis.study import (
+    TestCase, classify_tests, methods_table, correlate_table,
+    strategy_table, properties_table, bench_summary, bench_correlate,
+    run_study,
+)
+
+__all__ = [
+    "TestCase", "classify_tests", "methods_table", "correlate_table",
+    "strategy_table", "properties_table", "bench_summary",
+    "bench_correlate", "run_study",
+]

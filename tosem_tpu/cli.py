@@ -26,7 +26,7 @@ from tosem_tpu.utils.flags import FlagSet
 
 CONFIGS = ("gemm", "conv_sweep", "allreduce", "resnet_train",
            "bert_kernels", "detection_train", "detection_infer",
-           "speech_train")
+           "speech_train", "analysis")
 
 
 def make_flags() -> FlagSet:
@@ -46,6 +46,10 @@ def make_flags() -> FlagSet:
     fs.define_string("dtype", "", "dtype override for sweeps")
     fs.define_bool("fake_data", True,
                    "use synthetic data (the --use_fake_data pattern)")
+    fs.define_string("tests_dir", "tests",
+                     "test-suite directory for the analysis config")
+    fs.define_string("analysis_out", "results/analysis",
+                     "output directory for the analysis config's RQ tables")
     return fs
 
 
@@ -454,6 +458,43 @@ def run_speech_train(fs: FlagSet) -> List[Any]:
         return rows
 
 
+def run_analysis(fs: FlagSet) -> List[Any]:
+    """Study analysis layer (L8): classify this repo's test suite into the
+    RQ3/RQ4 taxonomy and correlate the bench CSVs — the consumer role of
+    ``RQs/RQ3/tests_correlate_rq3.csv`` / ``RQs/RQ4/tests_methods_v3.csv``."""
+    import glob
+
+    from tosem_tpu.analysis import run_study
+    from tosem_tpu.utils.results import ResultRow
+
+    out_dir = fs.analysis_out
+    # scan both the default results dir and wherever this run is writing;
+    # rows with config=="analysis" are filtered at load so the analysis
+    # never re-ingests its own output
+    bench_csvs = sorted(set(glob.glob("results/*.csv"))
+                        | set(glob.glob(os.path.join(
+                            os.path.dirname(fs.results_csv) or ".",
+                            "*.csv"))))
+    summary = run_study(fs.tests_dir, bench_csvs, out_dir)
+    rows = [ResultRow(project="analysis", config="analysis",
+                      bench_id=f"tests_{m}", metric="test_count",
+                      value=float(n), unit="tests", device="host",
+                      extra={"out_dir": out_dir})
+            for m, n in sorted(summary["by_method"].items())]
+    rows.append(ResultRow(
+        project="analysis", config="analysis",
+        bench_id="tests_with_strategy", metric="pct",
+        value=float(summary["with_strategy_pct"]), unit="%", device="host",
+        extra={"n_tests": summary["n_tests"],
+               "n_strategies": summary["n_strategies"],
+               "n_projects": summary["n_projects"],
+               "bench_correlations": summary["bench_correlations"]}))
+    for r in rows:
+        print(f"  {r.bench_id}: {r.value:g} {r.unit}")
+    print(f"  tables -> {out_dir}/")
+    return rows
+
+
 RUNNERS = {
     "gemm": run_gemm,
     "conv_sweep": run_conv_sweep,
@@ -463,6 +504,7 @@ RUNNERS = {
     "detection_train": run_detection_train,
     "detection_infer": run_detection_infer,
     "speech_train": run_speech_train,
+    "analysis": run_analysis,
 }
 
 
