@@ -7,6 +7,7 @@ watchdog threshold behavior (``memory_monitor.py``), log tailing
 (``log_monitor.py``), and AP protocol checks against hand-computable
 box configurations (``efficientdet/coco_metric.py``).
 """
+import urllib.error
 import urllib.request
 
 import numpy as np
@@ -59,6 +60,11 @@ class TestMetrics:
             with urllib.request.urlopen(srv.url, timeout=10) as r:
                 body = r.read().decode()
             assert "hits 7" in body
+            # unknown paths must 404, not silently serve the metrics text
+            base = srv.url.rsplit("/", 1)[0]
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(base + "/typo", timeout=10)
+            assert ei.value.code == 404
         finally:
             srv.shutdown()
 

@@ -125,3 +125,20 @@ class TestNodeAgent:
         finally:
             a.close()
             b.close()
+
+
+class TestBindGuard:
+    def test_public_bind_warns(self):
+        """The pickle protocol is RCE by design; non-loopback/non-private
+        binds must warn loudly (loopback/private stay silent)."""
+        import warnings
+        from tosem_tpu.cluster.rpc import _check_bind_host
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")       # silence expected
+            _check_bind_host("127.0.0.1")
+            _check_bind_host("10.0.0.7")
+            _check_bind_host("localhost")
+        with pytest.warns(RuntimeWarning):
+            _check_bind_host("0.0.0.0")
+        with pytest.warns(RuntimeWarning):
+            _check_bind_host("8.8.8.8")

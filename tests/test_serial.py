@@ -186,3 +186,12 @@ def test_htm_network_load_rejects_unknown_regions(tmp_path):
     net = anomaly_network(jax.random.key(0), minval=0, maxval=1)
     with pytest.raises(ValueError, match="unknown regions"):
         net.load(path)
+
+
+def test_unserializable_dtype_rejected_at_dump():
+    """Regression: unicode/bytes leaves used to dump cleanly but fail to
+    load (dtype name 'str224' resolves to nothing) — reject at dump."""
+    with pytest.raises(TypeError, match="round-trip|unserializable"):
+        dump_tree({"bad": np.array(["a", "bb"])})
+    with pytest.raises(TypeError):
+        dump_tree({"bad": np.array([b"x", b"yy"])})

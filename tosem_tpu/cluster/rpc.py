@@ -26,6 +26,40 @@ _LEN = struct.Struct(">I")
 MAX_FRAME = 256 << 20
 
 
+def _check_bind_host(host: str) -> None:
+    """Pickle frames are remote code execution by design (trusted-network
+    protocol, see module docstring) — refuse to let that surface reach a
+    public interface silently. Loopback and RFC1918/link-local binds pass;
+    anything else (including 0.0.0.0) gets a loud warning."""
+    import ipaddress
+    import warnings
+    if host == "":
+        # empty host binds INADDR_ANY — same exposure as 0.0.0.0
+        warnings.warn(
+            "RpcServer binding to all interfaces (host=\"\"): this exposes "
+            "an unauthenticated pickle-RPC (remote-code-execution) surface "
+            "beyond loopback/private networks", RuntimeWarning,
+            stacklevel=3)
+        return
+    try:
+        addr = ipaddress.ip_address(host)
+    except ValueError:
+        if host == "localhost":
+            return
+        warnings.warn(
+            f"RpcServer binding to non-address host {host!r}: the pickle "
+            "RPC protocol executes arbitrary objects from the wire and "
+            "must never face an untrusted network", RuntimeWarning,
+            stacklevel=3)
+        return
+    if addr.is_loopback or (addr.is_private and not addr.is_unspecified):
+        return
+    warnings.warn(
+        f"RpcServer binding to {host}: this exposes an unauthenticated "
+        "pickle-RPC (remote-code-execution) surface beyond loopback/"
+        "private networks", RuntimeWarning, stacklevel=3)
+
+
 class RpcError(RuntimeError):
     """Remote handler raised; carries the remote traceback."""
 
@@ -74,6 +108,7 @@ class RpcServer:
                 n: getattr(handlers, n) for n in dir(handlers)
                 if not n.startswith("_")
                 and callable(getattr(handlers, n))}
+        _check_bind_host(host)
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))

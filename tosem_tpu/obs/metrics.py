@@ -199,6 +199,8 @@ class MetricsServer:
         reg = registry or DEFAULT
 
         def route(path):
+            if path.split("?", 1)[0] not in ("/", "/metrics"):
+                return (404, "text/plain", b"not found\n")
             return (200, "text/plain; version=0.0.4",
                     reg.prometheus_text().encode())
 

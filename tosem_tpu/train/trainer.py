@@ -114,6 +114,14 @@ def make_train_step(model: Module,
     def wrapper(ts, batch, rng):
         key = _cache_key(ts, batch)
         if key not in cache:
+            if len(cache) == 16:  # warn once, at the threshold crossing
+                import warnings
+                warnings.warn(
+                    "dp_train_step has compiled 16 distinct programs — "
+                    "batch shapes/dtypes look dynamic. Pad batches to a "
+                    "fixed shape (the static-shape contract) or each new "
+                    "shape recompiles and is cached forever.",
+                    RuntimeWarning, stacklevel=2)
             cache[key] = jax.jit(
                 step,
                 in_shardings=(jax.tree_util.tree_map(lambda _: repl, ts),

@@ -41,7 +41,12 @@ def _resolve_dtype(name: str) -> np.dtype:
         return np.dtype(name)
     except TypeError:
         import ml_dtypes
-        return np.dtype(getattr(ml_dtypes, name))
+        try:
+            return np.dtype(getattr(ml_dtypes, name))
+        except AttributeError:
+            raise ValueError(
+                f"unsupported leaf dtype {name!r}: not a numpy or "
+                "ml_dtypes dtype") from None
 
 
 def _plan(obj: Any, leaves: List[np.ndarray]):
@@ -61,7 +66,12 @@ def _plan(obj: Any, leaves: List[np.ndarray]):
         return {"__scalar__": obj}
     # order="C" forces contiguity WITHOUT ascontiguousarray's 0-d→(1,)
     # promotion (which silently corrupted scalar-leaf shapes)
-    leaves.append(np.asarray(obj, order="C"))
+    arr = np.asarray(obj, order="C")
+    if not arr.dtype.isnative:
+        # dtype *names* don't carry byte order ('>f4'.name == 'float32'),
+        # so normalize to native order rather than reject at dump time
+        arr = arr.astype(arr.dtype.newbyteorder("="))
+    leaves.append(arr)
     return {"__leaf__": len(leaves) - 1}
 
 
@@ -76,7 +86,20 @@ def dump_tree(tree: Any) -> bytes:
     table = []
     for arr in leaves:
         offset = _align(offset)
-        table.append({"dtype": _dtype_name(arr.dtype),
+        name = _dtype_name(arr.dtype)
+        # validate at DUMP time that the recorded name loads back to the
+        # same dtype — otherwise a blob that saves cleanly (e.g. unicode
+        # leaves, dtype name 'str224') could never be loaded
+        try:
+            resolved = _resolve_dtype(name)
+        except ValueError:
+            resolved = None
+        if resolved != arr.dtype:
+            raise TypeError(
+                f"unserializable leaf dtype {arr.dtype!r} (name {name!r} "
+                "does not round-trip); supported: numeric numpy and "
+                "ml_dtypes leaves")
+        table.append({"dtype": name,
                       "shape": list(arr.shape), "offset": offset})
         offset += arr.nbytes
     header = json.dumps({"tree": skeleton, "leaves": table},
