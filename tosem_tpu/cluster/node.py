@@ -33,7 +33,6 @@ class _AgentHandlers:
     """RPC surface of one node (the NodeManagerService analog)."""
 
     def __init__(self, num_workers: int):
-        import itertools
         import multiprocessing as mp
         import threading
         self._pool = ProcessPoolExecutor(
@@ -43,23 +42,98 @@ class _AgentHandlers:
         # connections are served on separate threads: count atomically
         self._done_lock = threading.Lock()
         self._tasks_done = 0
+        # gang slots (placement-group bundles on this node): reserved
+        # capacity is withheld from general tasks, and tasks tagged with
+        # a group are admitted only up to its reservation
+        self._adm = threading.Condition()
+        self._reserved: Dict[str, int] = {}
+        self._active_general = 0
+        self._active_pg: Dict[str, int] = {}
 
     def health(self) -> Dict[str, Any]:
         return {"ok": True, "pid": os.getpid(),
                 "uptime_s": time.time() - self._started}
 
     def stats(self) -> Dict[str, Any]:
+        with self._adm:
+            reserved = sum(self._reserved.values())
         return {"num_workers": self._num_workers,
-                "tasks_done": self._tasks_done}
+                "tasks_done": self._tasks_done,
+                "reserved_slots": reserved,
+                "free_slots": self._num_workers - reserved}
 
-    def run_task(self, blob: bytes) -> bytes:
-        out = self._pool.submit(_run_blob, blob).result()
+    # -- gang slots ----------------------------------------------------
+
+    def reserve(self, pg: str, n: int) -> bool:
+        """All-or-nothing reservation of ``n`` slots for group ``pg``.
+        Idempotent per (pg): a second reserve for the same id replaces the
+        first. Returns False (no partial state) when capacity is short."""
+        if n <= 0:
+            return False
+        with self._adm:
+            other = sum(v for k, v in self._reserved.items() if k != pg)
+            if n > self._num_workers - other:
+                return False
+            self._reserved[pg] = n
+            self._adm.notify_all()
+            return True
+
+    def release(self, pg: str) -> int:
+        with self._adm:
+            n = self._reserved.pop(pg, 0)
+            self._adm.notify_all()
+            return n
+
+    def _admit(self, pg: Optional[str]) -> None:
+        with self._adm:
+            while True:
+                if pg is None:
+                    free = self._num_workers - sum(self._reserved.values())
+                    if self._active_general < free:
+                        self._active_general += 1
+                        return
+                else:
+                    cap = self._reserved.get(pg)
+                    if cap is None:
+                        raise KeyError(
+                            f"no reservation for placement group {pg!r} "
+                            "on this node")
+                    if self._active_pg.get(pg, 0) < cap:
+                        self._active_pg[pg] = self._active_pg.get(pg, 0) + 1
+                        return
+                self._adm.wait(1.0)
+
+    def _leave(self, pg: Optional[str]) -> None:
+        with self._adm:
+            if pg is None:
+                self._active_general -= 1
+            else:
+                self._active_pg[pg] = self._active_pg.get(pg, 1) - 1
+            self._adm.notify_all()
+
+    # -- task plane ----------------------------------------------------
+
+    def run_task(self, blob: bytes, pg: Optional[str] = None) -> bytes:
+        self._admit(pg)
+        try:
+            out = self._pool.submit(_run_blob, blob).result()
+        finally:
+            self._leave(pg)
         with self._done_lock:
             self._tasks_done += 1
         return out
 
-    def run_batch(self, blobs: List[bytes]) -> List[bytes]:
-        futs = [self._pool.submit(_run_blob, b) for b in blobs]
+    def run_batch(self, blobs: List[bytes],
+                  pg: Optional[str] = None) -> List[bytes]:
+        # each task's slot frees as ITS future completes (done-callback),
+        # never after the whole batch — admitting a batch larger than the
+        # pool up-front with one bulk release would deadlock the admission
+        futs = []
+        for b in blobs:
+            self._admit(pg)
+            fut = self._pool.submit(_run_blob, b)
+            fut.add_done_callback(lambda _f, pg=pg: self._leave(pg))
+            futs.append(fut)
         outs = [f.result() for f in futs]
         with self._done_lock:
             self._tasks_done += len(outs)
@@ -125,10 +199,22 @@ class RemoteNode:
         except Exception:
             return False
 
+    # -- gang slots ----------------------------------------------------
+
+    def reserve(self, pg: str, n: int) -> bool:
+        """All-or-nothing reservation of ``n`` slots on this node."""
+        return bool(self._client.call("reserve", pg, n))
+
+    def release(self, pg: str) -> int:
+        return int(self._client.call("release", pg))
+
     # -- data plane ----------------------------------------------------
 
     def submit(self, fn: Callable, *args, **kwargs) -> Any:
+        pg = kwargs.pop("_pg", None)
         blob = pickle.dumps((fn, args, kwargs))
+        if pg is not None:
+            return pickle.loads(self._client.call("run_task", blob, pg))
         return pickle.loads(self._client.call("run_task", blob))
 
     def map(self, fn: Callable, items) -> List[Any]:

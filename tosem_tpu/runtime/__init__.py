@@ -7,16 +7,20 @@ Redis daemons collapse into the driver (JAX is single-controller already);
 what remains native is the data plane (:mod:`tosem_tpu.native` objstore).
 """
 from tosem_tpu.runtime.api import (ActorDiedError, ObjectRef,
+                                   PlacementGroup, PlacementTimeout,
                                    TaskCancelledError, TaskError,
                                    WorkerCrashedError, add_worker, cancel,
-                                   get, init, is_initialized, kill, put,
-                                   remote, remove_idle_worker, shutdown,
+                                   get, init, is_initialized, kill,
+                                   placement_group, put, remote,
+                                   remove_idle_worker,
+                                   remove_placement_group, shutdown,
                                    stats, wait)
 from tosem_tpu.runtime.object_store import ObjectID, ObjectStore
 
 __all__ = [
     "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
     "kill", "cancel", "stats", "add_worker", "remove_idle_worker",
-    "ObjectRef", "ObjectID", "ObjectStore", "TaskError",
+    "placement_group", "remove_placement_group", "PlacementGroup",
+    "PlacementTimeout", "ObjectRef", "ObjectID", "ObjectStore", "TaskError",
     "WorkerCrashedError", "ActorDiedError", "TaskCancelledError",
 ]

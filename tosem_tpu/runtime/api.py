@@ -32,14 +32,15 @@ from typing import Any, List, Optional, Sequence, Tuple, Union
 
 from tosem_tpu.runtime import common
 from tosem_tpu.runtime.common import (ActorDiedError, ObjectRef,
-                                      TaskCancelledError, TaskError,
-                                      WorkerCrashedError)
+                                      PlacementTimeout, TaskCancelledError,
+                                      TaskError, WorkerCrashedError)
 from tosem_tpu.runtime.runtime import Runtime
 
 __all__ = [
     "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
     "kill", "cancel", "ObjectRef", "TaskError", "WorkerCrashedError",
-    "ActorDiedError", "TaskCancelledError",
+    "ActorDiedError", "TaskCancelledError", "PlacementGroup",
+    "PlacementTimeout", "placement_group", "remove_placement_group",
 ]
 
 _runtime: Optional[Runtime] = None
@@ -82,10 +83,51 @@ def _rt() -> Runtime:
     return _runtime
 
 
+class PlacementGroup:
+    """Handle to an atomic gang reservation of worker slots.
+
+    Usable as a context manager; on exit the reservation is released and
+    actors placed in it are killed (reference semantics of
+    ``ray.util.placement_group`` / ``remove_placement_group``)."""
+
+    def __init__(self, pg_id: bytes, n_slots: int, strategy: str):
+        self._pg_id = pg_id
+        self.n_slots = n_slots
+        self.strategy = strategy
+
+    def remove(self) -> None:
+        if _runtime is not None:
+            _runtime.remove_placement_group(self._pg_id)
+
+    def __enter__(self) -> "PlacementGroup":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.remove()
+
+    def __repr__(self):
+        return (f"PlacementGroup(slots={self.n_slots}, "
+                f"strategy={self.strategy!r})")
+
+
+def placement_group(n_slots: int, strategy: str = "pack",
+                    timeout: Optional[float] = None) -> PlacementGroup:
+    """Atomically reserve ``n_slots`` worker slots (all-or-nothing, FIFO;
+    ``timeout=0`` = try-acquire, raising :class:`PlacementTimeout`)."""
+    pg_id = _rt().create_placement_group(n_slots, strategy, timeout)
+    return PlacementGroup(pg_id, n_slots, strategy)
+
+
+def remove_placement_group(pg: PlacementGroup) -> None:
+    pg.remove()
+
+
 class RemoteFunction:
-    def __init__(self, fn, max_retries: Optional[int] = None):
+    def __init__(self, fn, max_retries: Optional[int] = None,
+                 placement_group: Optional[PlacementGroup] = None):
         self._fn = fn
         self._max_retries = max_retries
+        self._pg = placement_group
         self._fn_id = None
         self.__name__ = getattr(fn, "__name__", "remote_fn")
 
@@ -93,11 +135,15 @@ class RemoteFunction:
         rt = _rt()
         if self._fn_id is None:
             self._fn_id = rt.register_fn(common.dumps(self._fn))
-        return rt.submit_task(self._fn_id, args, kwargs,
-                              max_retries=self._max_retries)
+        return rt.submit_task(
+            self._fn_id, args, kwargs, max_retries=self._max_retries,
+            pg=self._pg._pg_id if self._pg is not None else None)
 
-    def options(self, max_retries: Optional[int] = None) -> "RemoteFunction":
-        rf = RemoteFunction(self._fn, max_retries=max_retries)
+    def options(self, max_retries: Optional[int] = None,
+                placement_group: Optional[PlacementGroup] = None
+                ) -> "RemoteFunction":
+        rf = RemoteFunction(self._fn, max_retries=max_retries,
+                            placement_group=placement_group)
         rf._fn_id = self._fn_id
         return rf
 
@@ -130,23 +176,30 @@ class ActorHandle:
 
 
 class ActorClass:
-    def __init__(self, cls, max_restarts: int = 0):
+    def __init__(self, cls, max_restarts: int = 0,
+                 placement_group: Optional[PlacementGroup] = None):
         self._cls = cls
         self._max_restarts = max_restarts
+        self._pg = placement_group
         self.__name__ = getattr(cls, "__name__", "Actor")
 
     def remote(self, *args, **kwargs) -> ActorHandle:
         rt = _rt()
         blob = common.dumps((self._cls, args, kwargs))
-        actor_id = rt.create_actor(blob, self._max_restarts)
+        actor_id = rt.create_actor(
+            blob, self._max_restarts,
+            pg=self._pg._pg_id if self._pg is not None else None)
         methods = [n for n, _ in inspect.getmembers(
             self._cls, predicate=callable) if not n.startswith("_")]
         return ActorHandle(actor_id, methods)
 
-    def options(self, max_restarts: Optional[int] = None) -> "ActorClass":
+    def options(self, max_restarts: Optional[int] = None,
+                placement_group: Optional[PlacementGroup] = None
+                ) -> "ActorClass":
         return ActorClass(self._cls,
                           self._max_restarts if max_restarts is None
-                          else max_restarts)
+                          else max_restarts,
+                          placement_group=placement_group)
 
     def __call__(self, *a, **k):
         raise TypeError(f"actor class {self.__name__!r} must be instantiated "

@@ -50,6 +50,10 @@ class ActorDiedError(RuntimeError_):
     """The actor's process died (and restarts, if any, were exhausted)."""
 
 
+class PlacementTimeout(RuntimeError_):
+    """create_placement_group could not reserve its slots in time."""
+
+
 class TaskCancelledError(RuntimeError_):
     """The task was cancelled via ``rt.cancel`` (``ray.cancel`` semantics)."""
 
@@ -208,3 +212,4 @@ class TaskSpec:
     result_ref: ObjectRef
     retries_left: int
     deps: set                   # unresolved ObjectRefs
+    pg: Optional[bytes] = None  # placement group id (gang scheduling)

@@ -35,7 +35,8 @@ import weakref
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from tosem_tpu.runtime import common
-from tosem_tpu.runtime.common import (ActorDiedError, ObjectRef, StoreRef,
+from tosem_tpu.runtime.common import (ActorDiedError, ObjectRef,
+                                      PlacementTimeout, StoreRef,
                                       TaskCancelledError, TaskError, TaskSpec,
                                       WorkerCrashedError)
 from tosem_tpu.obs import metrics as _metrics
@@ -100,6 +101,11 @@ class _Worker:
         self.inflight: List[bytes] = []   # task_ids in submission order
         self.ready = False
         self.last_progress = time.monotonic()
+        # gang scheduling (placement groups): a reserved worker only runs
+        # tasks tagged with its group; a parked worker backs an actor
+        # placed in the group and runs nothing until the actor dies
+        self.reserved_by: Optional[bytes] = None
+        self.parked = False
 
     def load_key(self):
         """Dispatch preference: non-stalled first, then least loaded. A
@@ -162,6 +168,11 @@ class Runtime:
         # workers
         self.task_workers: List[_Worker] = []
         self.actors: Dict[bytes, _ActorRecord] = {}
+        # placement groups: pg_id → record; the FIFO queue gives gang
+        # requests head-of-line all-or-nothing grants (no partial holds,
+        # therefore no deadlock between concurrent gangs)
+        self.placement_groups: Dict[bytes, Dict[str, Any]] = {}
+        self._pg_queue: List[Any] = []
         self._shutdown = False
         for _ in range(num_workers):
             self.task_workers.append(_Worker(self.ctx, self.store_name))
@@ -206,21 +217,28 @@ class Runtime:
         return fn_id
 
     def submit_task(self, fn_id: bytes, args: tuple, kwargs: dict,
-                    max_retries: Optional[int] = None) -> ObjectRef:
+                    max_retries: Optional[int] = None,
+                    pg: Optional[bytes] = None) -> ObjectRef:
         ref = self._new_ref()
         spec = TaskSpec(task_id=os.urandom(16), fn_id=fn_id, method=None,
                         actor_id=None, args=args, kwargs=kwargs,
                         result_ref=ref,
                         retries_left=(self.max_task_retries
                                       if max_retries is None else max_retries),
-                        deps=self._unresolved_deps(args, kwargs))
+                        deps=self._unresolved_deps(args, kwargs), pg=pg)
         M_TASKS_SUBMITTED.inc()
         with self.lock:
+            if pg is not None and pg not in self.placement_groups:
+                self.errors[ref.oid.binary] = ValueError(
+                    "unknown or removed placement group")
+                self.cv.notify_all()
+                return ref
             self.specs[spec.task_id] = spec
             if not spec.deps:
-                # fast path: straight onto the least-loaded pipeline
-                w = min(self.task_workers, key=_Worker.load_key)
-                if (w.load_key()[0] == 0 and
+                # fast path: straight onto the least-loaded eligible pipeline
+                w = min(self._eligible_locked(pg), key=_Worker.load_key,
+                        default=None)
+                if (w is not None and w.load_key()[0] == 0 and
                         len(w.inflight) < common.MAX_INFLIGHT_PER_WORKER):
                     try:
                         self._send_task_locked(w, spec)
@@ -231,16 +249,133 @@ class Runtime:
             self._dispatch_locked()
         return ref
 
-    def create_actor(self, cls_blob_args: bytes, max_restarts: int) -> bytes:
+    def create_actor(self, cls_blob_args: bytes, max_restarts: int,
+                     pg: Optional[bytes] = None) -> bytes:
         actor_id = os.urandom(16)
         M_ACTORS.inc(labels=["created"])
+        # ONE lock hold for slot consumption + actor registration: a gap
+        # between them would let a concurrent remove_placement_group miss
+        # the actor (it would outlive its removed group)
         with self.lock:
-            w = _Worker(self._make_ctx(), self.store_name, actor_id=actor_id)
+            victim = None
+            if pg is not None:
+                rec = self.placement_groups.get(pg)
+                if rec is None:
+                    raise ValueError("unknown or removed placement group")
+                # an actor consumes one bundle slot: park one reserved
+                # worker (idle preferred) — it runs nothing while the
+                # actor lives, keeping the gang's slot accounting honest
+                candidates = [w for w in self.task_workers
+                              if w.reserved_by == pg and not w.parked]
+                if not candidates:
+                    raise ValueError(
+                        "placement group has no free slot for an actor")
+                victim = min(candidates, key=lambda w: len(w.inflight))
+                victim.parked = True
+                rec["actors"].add(actor_id)
+            try:
+                w = _Worker(self._make_ctx(), self.store_name,
+                            actor_id=actor_id)
+            except BaseException:
+                if pg is not None:       # roll the slot back, don't leak it
+                    victim.parked = False
+                    rec["actors"].discard(actor_id)
+                raise
             self.actors[actor_id] = _ActorRecord(w, cls_blob_args,
                                                  max_restarts)
             self._send(w, ("actor_init", cls_blob_args))
             self.cv.notify_all()
         return actor_id
+
+    # ------------------------------------------------ placement groups
+
+    def create_placement_group(self, n_slots: int,
+                               strategy: str = "pack",
+                               timeout: Optional[float] = None) -> bytes:
+        """Atomically reserve ``n_slots`` task workers (gang scheduling).
+
+        All-or-nothing with FIFO head-of-line granting: a request never
+        holds a partial reservation while waiting, so two concurrent gangs
+        that each need more than half the pool cannot deadlock — one gets
+        everything, the other waits its turn. ``timeout=0`` is a
+        try-acquire. TPU-first collapse of the reference's placement
+        groups (``gcs_placement_group_scheduler.cc``,
+        ``python/ray/util/placement_group.py``): one controller, one
+        resource kind (worker slots), so PACK/SPREAD only matter at the
+        cluster layer (:mod:`tosem_tpu.cluster.gang`).
+        """
+        if n_slots <= 0:
+            raise ValueError("n_slots must be positive")
+        if strategy not in ("pack", "spread", "strict_pack",
+                            "strict_spread"):
+            raise ValueError(f"unknown strategy {strategy!r}")
+        token = object()
+        deadline = (time.monotonic() + timeout
+                    if timeout is not None else None)
+        with self.cv:
+            self._pg_queue.append(token)
+            try:
+                while True:
+                    if self._shutdown:
+                        raise RuntimeError("runtime is shut down")
+                    if n_slots > len(self.task_workers):
+                        raise ValueError(
+                            f"placement group of {n_slots} slots can never "
+                            f"be satisfied by a {len(self.task_workers)}-"
+                            "worker pool")
+                    if self._pg_queue[0] is token:
+                        free = [w for w in self.task_workers
+                                if w.reserved_by is None]
+                        if len(free) >= n_slots:
+                            pg_id = os.urandom(16)
+                            for w in free[:n_slots]:
+                                w.reserved_by = pg_id
+                            self.placement_groups[pg_id] = {
+                                "n_slots": n_slots, "strategy": strategy,
+                                "actors": set()}
+                            return pg_id
+                    if deadline is not None:
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            raise PlacementTimeout(
+                                f"no {n_slots} free slots within "
+                                f"{timeout}s")
+                        self.cv.wait(min(remaining, 1.0))
+                    else:
+                        self.cv.wait(1.0)
+            finally:
+                self._pg_queue.remove(token)
+                self.cv.notify_all()
+
+    def remove_placement_group(self, pg_id: bytes) -> None:
+        """Release the gang's workers. Actors placed in the group are
+        killed (the reference's remove_placement_group semantics)."""
+        with self.lock:
+            rec = self.placement_groups.pop(pg_id, None)
+            if rec is None:
+                return
+            actors = list(rec["actors"])
+        for aid in actors:
+            self.kill_actor(aid)
+        with self.cv:
+            for w in self.task_workers:
+                if w.reserved_by == pg_id:
+                    w.reserved_by = None
+                    w.parked = False
+            # pending tasks tagged with the dead group can never run
+            for spec in [s for s in self.pending if s.pg == pg_id]:
+                self._fail_task_locked(spec, ValueError(
+                    "placement group was removed"))
+            self.pending = [s for s in self.pending if s.pg != pg_id]
+            self.cv.notify_all()
+            self._dispatch_locked()
+
+    def _eligible_locked(self, spec_pg: Optional[bytes]) -> List[_Worker]:
+        """Workers a task tagged ``spec_pg`` may run on."""
+        if spec_pg is None:
+            return [w for w in self.task_workers if w.reserved_by is None]
+        return [w for w in self.task_workers
+                if w.reserved_by == spec_pg and not w.parked]
 
     def submit_actor_call(self, actor_id: bytes, method: str, args: tuple,
                           kwargs: dict) -> ObjectRef:
@@ -267,12 +402,25 @@ class Runtime:
             self._dispatch_locked()
         return ref
 
+    def _unpark_for_actor_locked(self, actor_id: bytes) -> None:
+        """Return the bundle slot an actor consumed to its group."""
+        for pg_id, rec in self.placement_groups.items():
+            if actor_id in rec["actors"]:
+                rec["actors"].discard(actor_id)
+                for w in self.task_workers:
+                    if w.reserved_by == pg_id and w.parked:
+                        w.parked = False
+                        break
+                self.cv.notify_all()
+                return
+
     def kill_actor(self, actor_id: bytes) -> None:
         with self.lock:
             rec = self.actors.get(actor_id)
             if rec is None or rec.dead:
                 return
             rec.dead = True            # explicit kill: no restart (ray.kill)
+            self._unpark_for_actor_locked(actor_id)
             # fail everything in flight or queued NOW — once dead the
             # scheduler stops watching this worker, so nothing else will
             for tid in list(rec.worker.inflight):
@@ -456,7 +604,7 @@ class Runtime:
             if len(self.task_workers) <= 1:
                 return False
             for i, w in enumerate(self.task_workers):
-                if not w.inflight:
+                if not w.inflight and w.reserved_by is None:
                     self.task_workers.pop(i)
                     M_WORKERS_ALIVE.set(len(self.task_workers))
                     victim = w
@@ -484,6 +632,7 @@ class Runtime:
             M_WORKERS_ALIVE.set(0)
             workers = list(self.task_workers) + [r.worker
                                                  for r in self.actors.values()]
+            self.cv.notify_all()   # wake blocked placement-group waiters
         if self._memmon is not None:
             self._memmon.stop()
         for w in workers:
@@ -582,8 +731,8 @@ class Runtime:
                     continue
                 target = rec.worker     # actor calls are ordered on its pipe
             else:
-                w = min(self.task_workers, key=_Worker.load_key,
-                        default=None)
+                w = min(self._eligible_locked(spec.pg),
+                        key=_Worker.load_key, default=None)
                 target = (w if w is not None and w.load_key()[0] == 0 and
                           len(w.inflight) < common.MAX_INFLIGHT_PER_WORKER
                           else None)
@@ -767,6 +916,7 @@ class Runtime:
                 self._dispatch_locked()
             else:
                 rec.dead = True
+                self._unpark_for_actor_locked(w.actor_id)
                 self._fail_actor_tasks_locked(
                     w.actor_id, ActorDiedError("actor died; restarts "
                                                "exhausted"))
@@ -788,7 +938,11 @@ class Runtime:
                             "worker died executing task; retries exhausted")
             w.inflight.clear()
             if not self._shutdown:
-                self.task_workers.append(_Worker(self._make_ctx(), self.store_name))
+                repl = _Worker(self._make_ctx(), self.store_name)
+                # a reserved worker's replacement inherits the gang slot
+                repl.reserved_by = w.reserved_by
+                repl.parked = w.parked
+                self.task_workers.append(repl)
             M_WORKERS_ALIVE.set(len(self.task_workers))
             self.cv.notify_all()
             self._dispatch_locked()

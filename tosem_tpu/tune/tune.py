@@ -112,6 +112,7 @@ class Trial:
     handle: Any = None
     step_ref: Any = None
     snapshot: Any = None                 # last known-good checkpoint
+    pg: Any = None                       # placement-group bundle (if any)
 
 
 class Analysis:
@@ -154,11 +155,16 @@ def run(trainable, config_space: Dict[str, Any], *, metric: str, mode: str,
         max_concurrent: int = 4, max_failures: int = 2,
         checkpoint_freq: int = 5,
         stop: Optional[Callable[[Dict[str, Any]], bool]] = None,
+        slots_per_trial: int = 0,
         verbose: bool = False) -> Analysis:
     """Run an HPO experiment; returns an :class:`Analysis`.
 
     ``trainable``: a :class:`Trainable` subclass or a generator function.
     ``num_samples``: trial count (for pure grid search: grid size × samples).
+    ``slots_per_trial``: when > 0, each trial atomically reserves a
+    placement-group bundle of that many worker slots before launching (gang
+    scheduling: concurrent distributed trials cannot half-acquire and
+    deadlock); trials wait in PENDING while no bundle fits.
     """
     if mode not in ("min", "max"):
         raise ValueError("mode must be 'min' or 'max'")
@@ -201,8 +207,21 @@ def run(trainable, config_space: Dict[str, Any], *, metric: str, mode: str,
             scheduler.register_config(t.trial_id, t.config)
         return t
 
+    def acquire_bundle() -> Any:
+        """Try-acquire a gang bundle for one trial (non-blocking: the
+        driver loop must keep polling running trials, so a trial that
+        cannot get its bundle now simply stays unlaunched)."""
+        if not slots_per_trial:
+            return None
+        try:
+            return rt.placement_group(slots_per_trial, timeout=0)
+        except rt.PlacementTimeout:
+            return False
+
     def launch(t: Trial, restore: bool = False):
-        t.handle = actor_cls.remote(trainable_cls, t.config)
+        cls = (actor_cls.options(placement_group=t.pg)
+               if t.pg else actor_cls)
+        t.handle = cls.remote(trainable_cls, t.config)
         if restore and t.snapshot is not None:
             rt.get(t.handle.restore.remote(t.snapshot))
             if verbose:
@@ -215,13 +234,22 @@ def run(trainable, config_space: Dict[str, Any], *, metric: str, mode: str,
         if t.handle is not None:
             rt.kill(t.handle)
             t.handle = None
+        if t.pg:
+            t.pg.remove()
+            t.pg = None
         t.step_ref = None
         running.remove(t)
         scheduler.on_complete(t.trial_id)
 
     while created < num_samples or running:
         while created < num_samples and len(running) < max_concurrent:
+            bundle = acquire_bundle()
+            if bundle is False:
+                if not running:
+                    time.sleep(0.25)     # bundles held elsewhere: back off
+                break                    # no free bundle: retry next tick
             t = next_trial()
+            t.pg = bundle
             launch(t)
             running.append(t)
         refs = [t.step_ref for t in running]
