@@ -1,0 +1,45 @@
+"""Mesh-shape sweep for the multichip dryrun.
+
+The driver validates ``__graft_entry__.dryrun_multichip`` at one n; these
+tests pin the widened behavior: several (dp, tp, sp) factorings per n with
+a cross-factoring loss-parity assert, combined dp×pp and dp×ep meshes, and
+a non-power-of-2 device count (6 = dp2·pp3). Runs on the virtual 8-device
+CPU mesh from ``tests/conftest.py``; n=16 re-execs in a subprocess with
+its own device-count flag (the dryrun does this itself).
+"""
+import subprocess
+import sys
+import os
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ENTRY = os.path.join(REPO, "__graft_entry__.py")
+
+
+def test_factoring_plan_shapes():
+    sys.path.insert(0, REPO)
+    try:
+        import __graft_entry__ as ge
+    finally:
+        sys.path.pop(0)
+    f8 = ge._trainer_factorings(8, 16, 32)
+    assert (8, 1, 1) in f8 and (4, 2, 1) in f8 and (1, 2, 4) in f8
+    # n=6: tp×sp (1,2,3) must be filtered (3 does not divide T=32)
+    f6 = ge._trainer_factorings(6, 12, 32)
+    assert (6, 1, 1) in f6 and (1, 2, 3) not in f6
+    assert all(12 % dp == 0 and 32 % sp == 0 for dp, _, sp in f6)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("n", [6, 8])
+def test_dryrun_sweep_in_subprocess(n):
+    """Full sweep at n devices (6 = the non-power-of-2 leg). Subprocess so
+    the device-count flag is fresh regardless of this process's jax."""
+    env = dict(os.environ)
+    env.pop("_GRAFT_DRYRUN_CHILD", None)
+    proc = subprocess.run([sys.executable, ENTRY, str(n)], env=env,
+                          capture_output=True, text=True, timeout=900)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert f"dryrun_multichip ok: n={n}" in proc.stdout
+    assert "parity spread" in proc.stdout

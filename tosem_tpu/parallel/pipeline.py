@@ -89,17 +89,22 @@ def _pipeline_body(stage_fn: Callable, n_micro: int, axis: str,
 
 def make_pipeline_fn(stage_fn: Callable, mesh: Mesh, *, n_micro: int,
                      axis: str = "pp",
-                     param_spec: Optional[P] = None) -> Callable:
+                     param_spec: Optional[P] = None,
+                     batch_spec: Optional[P] = None) -> Callable:
     """Build ``fwd(params, x) -> y`` pipelined over ``mesh[axis]``.
 
     params: stacked [n_stages, ...] pytree, sharded on the stage axis.
-    x: [M, mb, ...] microbatched input, replicated.
+    x: [M, mb, ...] microbatched input — replicated by default; pass
+    ``batch_spec`` (e.g. ``P(None, "dp")``) to shard the microbatch dim
+    over a data-parallel axis of a 2-D ``(dp, pp)`` mesh: each dp slice
+    pipelines its own batch shard, grads reduce outside as usual.
     """
     pspec = param_spec or P(axis)
+    bspec = batch_spec if batch_spec is not None else P()
     body = partial(_pipeline_body, stage_fn, n_micro, axis)
     # check_vma off: per-device divergent control (stage-indexed wheres)
-    return shard_map(body, mesh=mesh, in_specs=(pspec, P()),
-                     out_specs=P(), check_vma=False)
+    return shard_map(body, mesh=mesh, in_specs=(pspec, bspec),
+                     out_specs=bspec, check_vma=False)
 
 
 def microbatch(x: jax.Array, n_micro: int) -> jax.Array:
