@@ -231,3 +231,81 @@ class TestMetrics:
         assert wer("the cat sat", "the bat sat") == pytest.approx(1 / 3)
         assert cer("abc", "axc") == pytest.approx(1 / 3)
         assert wer("a b", "") == 1.0
+
+
+class TestSampleCollections:
+    """SDB bundle + LDC93S1 importer (sample_collections.py /
+    bin/import_ldc93s1.py roles)."""
+
+    def test_sdb_roundtrip_and_random_access(self, tmp_path):
+        from tosem_tpu.data.sample_collections import SDBReader, SDBWriter
+        rng = np.random.default_rng(0)
+        waves = [rng.uniform(-0.5, 0.5, rng.integers(800, 2000))
+                 .astype(np.float32) for _ in range(5)]
+        path = str(tmp_path / "c.sdb")
+        with SDBWriter(path) as w:
+            for i, a in enumerate(waves):
+                w.add(a, f"utt {i}", sample_id=f"u{i}")
+        r = SDBReader(path)
+        try:
+            assert len(r) == 5
+            # random access, out of order
+            for i in (3, 0, 4):
+                got = r[i].load_audio()
+                assert r[i].transcript == f"utt {i}"
+                np.testing.assert_allclose(got, waves[i], atol=1.5 / 32768)
+            sizes = [s.size_bytes for s in r.sorted_by_size()]
+            assert sizes == sorted(sizes)
+        finally:
+            r.close()
+
+    def test_csv_to_sdb_and_open_collection(self, tmp_path):
+        from tosem_tpu.data.feeding import (import_synthetic_corpus,
+                                            read_csv_manifest)
+        from tosem_tpu.data.sample_collections import (csv_to_sdb,
+                                                       open_collection)
+        manifest = import_synthetic_corpus(str(tmp_path), n=3, seed=1)
+        sdb = csv_to_sdb(manifest, str(tmp_path / "c.sdb"))
+        csv_coll = read_csv_manifest(manifest)
+        sdb_coll = open_collection(sdb)
+        assert [s.transcript for s in sdb_coll] == \
+            [s.transcript for s in csv_coll]
+        a = csv_coll[0].load_audio()
+        b = sdb_coll[0].load_audio()
+        np.testing.assert_allclose(a, b, atol=1.5 / 32768)
+        # sniffing: the CSV path opens as a CSV collection
+        assert len(open_collection(manifest)) == 3
+
+    def test_speech_batches_accepts_sdb(self, tmp_path):
+        from tosem_tpu.data.feeding import (import_synthetic_corpus,
+                                            speech_batches)
+        from tosem_tpu.data.sample_collections import csv_to_sdb
+        manifest = import_synthetic_corpus(str(tmp_path), n=4, seed=2)
+        sdb = csv_to_sdb(manifest, str(tmp_path / "c.sdb"))
+        batches = list(speech_batches(sdb, batch_size=2, n_buckets=1,
+                                      max_label_len=24))
+        assert batches and all(b.features.ndim == 3 for b in batches)
+
+    def test_import_ldc93s1_fabricated(self, tmp_path):
+        from tosem_tpu.data.feeding import read_csv_manifest
+        from tosem_tpu.data.sample_collections import import_ldc93s1
+        manifest = import_ldc93s1(str(tmp_path), fabricate=True)
+        coll = read_csv_manifest(manifest)
+        assert len(coll) == 1
+        # the reference's normalization: leading range tokens dropped,
+        # lowercase, no periods
+        assert coll[0].transcript == ("she had your dark suit in greasy "
+                                      "wash water all year")
+        assert coll[0].load_audio().size > 0
+
+    def test_import_ldc93s1_requires_files_or_fabricate(self, tmp_path):
+        from tosem_tpu.data.sample_collections import import_ldc93s1
+        with pytest.raises(FileNotFoundError):
+            import_ldc93s1(str(tmp_path / "empty"))
+
+    def test_corrupt_sdb_rejected(self, tmp_path):
+        from tosem_tpu.data.sample_collections import SDBReader
+        p = tmp_path / "bad.sdb"
+        p.write_bytes(b"NOTMAGIC" + b"\x00" * 64)
+        with pytest.raises(ValueError):
+            SDBReader(str(p))

@@ -46,6 +46,9 @@ def make_flags() -> FlagSet:
     fs.define_string("dtype", "", "dtype override for sweeps")
     fs.define_bool("fake_data", True,
                    "use synthetic data (the --use_fake_data pattern)")
+    fs.define_string("speech_data", "",
+                     "speech_train data: '' = synthetic, 'ldc93s1' = the "
+                     "LDC93S1 import path, else a CSV manifest / SDB path")
     fs.define_string("tests_dir", "tests",
                      "test-suite directory for the analysis config")
     fs.define_string("analysis_out", "results/analysis",
@@ -369,7 +372,7 @@ def run_speech_train(fs: FlagSet) -> List[Any]:
     import optax
     from tosem_tpu.data.audio import labels_to_text
     from tosem_tpu.data.feeding import (import_synthetic_corpus,
-                                        read_csv_manifest, speech_batches)
+                                        speech_batches)
     from tosem_tpu.data.scorer import build_scorer
     from tosem_tpu.models.speech import (SpeechConfig, SpeechModel,
                                          evaluate_wer, wer)
@@ -377,9 +380,27 @@ def run_speech_train(fs: FlagSet) -> List[Any]:
     from tosem_tpu.utils.results import ResultRow
 
     with tempfile.TemporaryDirectory(prefix="tosem_speech_") as tmp:
-        n_utts = 6 if fs.device == "cpu" else 16
-        manifest = import_synthetic_corpus(tmp, n=n_utts, seed=0)
-        refs = [s.transcript for s in read_csv_manifest(manifest)]
+        # data source (--speech_data): "" = synthetic corpus (hermetic);
+        # "ldc93s1" = the import_ldc93s1.py path (local files, fabricated
+        # stand-in when absent); anything else = a CSV manifest or SDB
+        # bundle path (sample_collections.open_collection sniffs)
+        from tosem_tpu.data.sample_collections import (import_ldc93s1,
+                                                       open_collection)
+        if fs.speech_data == "ldc93s1":
+            manifest = import_ldc93s1(tmp, fabricate=True)
+        elif fs.speech_data:
+            manifest = fs.speech_data
+        else:
+            n_synth = 6 if fs.device == "cpu" else 16
+            manifest = import_synthetic_corpus(tmp, n=n_synth, seed=0)
+        coll = open_collection(manifest)
+        refs = [s.transcript for s in coll]
+        n_utts = len(refs)
+        if not refs:
+            raise ValueError(f"no samples in speech data {manifest!r}")
+        # label capacity must fit the longest transcript (real corpora
+        # exceed the synthetic default)
+        max_label = max(24, max(len(r) for r in refs) + 1)
 
         cfg = SpeechConfig(n_input=26, n_context=2, n_hidden=96, n_cell=96,
                            vocab_size=28, dropout=0.0)
@@ -404,7 +425,7 @@ def run_speech_train(fs: FlagSet) -> List[Any]:
         last_loss = first_loss = None
         for _ in range(epochs):
             for b in speech_batches(manifest, batch_size=4, n_buckets=2,
-                                    max_label_len=24):
+                                    max_label_len=max_label):
                 params, opt_state, loss = step(
                     params, opt_state, jnp.asarray(b.features),
                     jnp.asarray(b.labels), jnp.asarray(b.feature_lengths),
@@ -416,7 +437,7 @@ def run_speech_train(fs: FlagSet) -> List[Any]:
         # eval: one padded batch of every utterance, three decode modes
         # (beam/beam+LM reuse the library's evaluate_wer)
         batch = next(speech_batches(manifest, batch_size=n_utts,
-                                    n_buckets=1, max_label_len=24,
+                                    n_buckets=1, max_label_len=max_label,
                                     sort_by_size=False))
         feats = jnp.asarray(batch.features)
         logits, _ = model.apply({"params": params, "state": state}, feats)

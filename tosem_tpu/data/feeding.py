@@ -224,7 +224,8 @@ def speech_batches(manifest_path: str, *, batch_size: int = 8,
     """
     import jax.numpy as jnp
     from tosem_tpu.data.audio import mfcc
-    coll = read_csv_manifest(manifest_path)
+    from tosem_tpu.data.sample_collections import open_collection
+    coll = open_collection(manifest_path)   # CSV manifest or SDB bundle
     if sort_by_size:
         coll = coll.sorted_by_size()
     if featurize is None:
