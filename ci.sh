@@ -3,22 +3,45 @@
 # jobs + sanitizer jobs, DeepSpeech's taskcluster, NNI's azure
 # pipelines), collapsed to one script. Everything runs on a virtual
 # 8-device CPU mesh; no accelerator required.
+#
+# Tiers:
+#   ./ci.sh          full release gate (tests + native + sanitizers +
+#                    C++ client + multichip dryrun) — slow (~40 min)
+#   ./ci.sh --quick  iteration tier (< 5 min): syntax gate + the pure
+#                    numerics/unit files, no process-spawning suites
 set -euo pipefail
 cd "$(dirname "$0")"
+
+QUICK=0
+[[ "${1:-}" == "--quick" ]] && QUICK=1
 
 echo "== byte-compile (syntax gate)"
 python -m compileall -q tosem_tpu tests examples bench.py __graft_entry__.py
 
-echo "== native builds (objstore, decoder, speech API, PJRT driver)"
+if [[ "$QUICK" == "1" ]]; then
+  echo "== quick tier: numerics + unit tests (no process spawns)"
+  python -m pytest -q -m "not slow" \
+    tests/test_ops.py tests/test_pallas_kernels.py tests/test_nn.py \
+    tests/test_sharding.py tests/test_serial.py tests/test_utils.py \
+    tests/test_analysis.py tests/test_image_ops.py tests/test_htm.py \
+    tests/test_compress.py tests/test_scorer.py tests/test_ring.py \
+    tests/test_moe.py tests/test_pipeline.py
+  echo "== quick CI green"
+  exit 0
+fi
+
+echo "== native builds (objstore, decoder, speech API, PJRT driver, client)"
 python - <<'EOF'
 from tosem_tpu.native import build_binary, load_library
 for stem in ("objstore", "ctc_decoder", "speech_api"):
     load_library(stem)
 build_binary("pjrt_driver")
+build_binary("client")
 print("native artifacts built")
 EOF
 
-echo "== unit + integration tests (virtual 8-device CPU mesh)"
+echo "== unit + integration tests (virtual 8-device CPU mesh,"
+echo "   incl. the C++ client legs in tests/test_native_client.py)"
 python -m pytest tests/ -q
 
 echo "== sanitizer gates (ASAN/UBSAN/LSAN + TSAN)"
@@ -31,7 +54,7 @@ for suite, san in (("objstore", "asan"), ("decoder", "asan"),
     print(f"{suite}/{san}: clean")
 EOF
 
-echo "== multichip dryrun (8 virtual devices: dp/tp/sp + pp + ep)"
+echo "== multichip dryrun (8 virtual devices: factoring sweep + pp + ep)"
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
 echo "== CI green"

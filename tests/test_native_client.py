@@ -1,0 +1,71 @@
+"""Non-Python (C++) client over the two public non-Python surfaces.
+
+Role model: ``native_client/client.cc`` — the reference proves its C ABI
+with a real C++ host binary, not just in-language tests. Here
+``native/client.cpp`` (built on demand) drives:
+
+- the speech streaming C ABI (``speech_api.cpp``) end-to-end from a pure
+  C++ process (dlopen, C++ vtable, uneven chunk feeds, CTC decode), and
+- the Serve-lite HTTP ingress with a raw-socket POST against a live
+  deployment backed by the runtime.
+"""
+import json
+import subprocess
+
+import pytest
+
+import tosem_tpu.runtime as rt
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture(scope="module")
+def client_bin():
+    from tosem_tpu.native import build_binary
+    return build_binary("client")
+
+
+def test_cpp_client_drives_speech_c_abi(client_bin):
+    from tosem_tpu.native import load_library
+    lib = load_library("speech_api")
+    proc = subprocess.run([client_bin, "abi", lib._name],
+                         capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    assert "final: tpunative" in proc.stdout
+    assert "abi ok" in proc.stdout
+
+
+def test_cpp_client_posts_to_serve_http(client_bin):
+    from tosem_tpu.serve import HttpIngress, Serve
+
+    class Doubler:
+        def call(self, request):
+            return {"doubled": [2 * x for x in request["xs"]]}
+
+    own = not rt.is_initialized()
+    if own:
+        rt.init(num_workers=2)
+    serve = Serve()
+    ingress = None
+    try:
+        serve.deploy("double", Doubler, num_replicas=1)
+        ingress = HttpIngress(serve)
+        proc = subprocess.run(
+            [client_bin, "http", ingress.host, str(ingress.port),
+             "double", json.dumps({"xs": [1, 2, 3]})],
+            capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stderr
+        assert json.loads(proc.stdout)["result"]["doubled"] == [2, 4, 6]
+        # non-200 propagates as a nonzero exit (scriptable failure)
+        bad = subprocess.run(
+            [client_bin, "http", ingress.host, str(ingress.port),
+             "nosuch", "{}"],
+            capture_output=True, text=True, timeout=120)
+        assert bad.returncode != 0
+    finally:
+        if ingress is not None:
+            ingress.shutdown()
+        for name in list(serve.list_deployments()):
+            serve.delete(name)
+        if own:
+            rt.shutdown()

@@ -1,0 +1,257 @@
+// Non-Python client — the `native_client/client.cc` role.
+//
+// The reference treats multi-language access as first-class (DeepSpeech
+// ships C++/JS/.NET/Java/Swift clients over one C ABI; Ray ships a Java
+// API). This binary is the cross-language proof for this framework's two
+// public non-Python surfaces:
+//
+//   abi  <libspeech_api.so>         drive the full streaming-session state
+//                                   machine of speech_api.cpp from C++
+//                                   through its public C ABI (dlopen, no
+//                                   Python anywhere in the process): create
+//                                   model -> stream -> feed chunks ->
+//                                   intermediate -> finish, asserting the
+//                                   decoded text. Proves struct layout,
+//                                   callback conventions and buffering
+//                                   semantics hold for a C++ embedder.
+//
+//   http <host> <port> <endpoint> <json>
+//                                   POST a JSON request to the Serve-lite
+//                                   ingress (serve/http.py) over a raw
+//                                   POSIX socket and print the response —
+//                                   the path a non-Python product service
+//                                   uses to call deployed models.
+//
+// Exit code 0 = success; nonzero with a message on stderr otherwise.
+
+#include <arpa/inet.h>
+#include <dlfcn.h>
+#include <netdb.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------- abi mode
+
+// Mirror of the speech_api.cpp vtable types (the public C ABI contract).
+typedef void* (*sp_stream_init_fn)(void*);
+typedef void (*sp_stream_free_fn)(void*, void*);
+typedef int (*sp_infer_fn)(void*, void*, const float*, int32_t, float*,
+                           int32_t*);
+typedef int (*sp_flush_fn)(void*, void*, float*, int32_t*);
+typedef int (*sp_decode_fn)(void*, const float*, int32_t, char*, int32_t);
+
+typedef void* (*sp_create_model_fn)(int32_t, int32_t, int32_t, int32_t,
+                                    sp_stream_init_fn, sp_stream_free_fn,
+                                    sp_infer_fn, sp_flush_fn, sp_decode_fn,
+                                    void*);
+typedef void (*sp_free_model_fn)(void*);
+typedef void* (*sp_create_stream_fn)(void*);
+typedef void (*sp_free_stream_fn)(void*);
+typedef int (*sp_feed_fn)(void*, const float*, int32_t);
+typedef int (*sp_intermediate_fn)(void*, char*, int32_t);
+typedef int (*sp_finish_fn)(void*, char*, int32_t);
+
+// Deterministic embedder "model": vocab = 27 (a-z + blank 26). Each frame's
+// feature[0] holds a letter index; infer emits one-hot logits per frame
+// (identity acoustic model), decode collapses repeats/blanks CTC-style.
+constexpr int32_t kFeat = 4;
+constexpr int32_t kVocab = 27;
+constexpr int32_t kBlank = 26;
+
+void* StreamInit(void*) { return new int(0); }
+void StreamFree(void*, void* s) { delete static_cast<int*>(s); }
+
+int Infer(void*, void*, const float* frames, int32_t n, float* out,
+          int32_t* out_n) {
+  for (int32_t i = 0; i < n; ++i) {
+    int idx = static_cast<int>(frames[i * kFeat]);
+    for (int32_t v = 0; v < kVocab; ++v)
+      out[i * kVocab + v] = (v == idx) ? 10.0f : 0.0f;
+  }
+  *out_n = n;
+  return 0;
+}
+
+int Flush(void*, void*, float*, int32_t* out_n) {
+  *out_n = 0;  // no lookahead in the stub embedder
+  return 0;
+}
+
+int Decode(void*, const float* logits, int32_t n, char* out, int32_t cap) {
+  std::string text;
+  int prev = -1;
+  for (int32_t i = 0; i < n; ++i) {
+    int best = 0;
+    for (int32_t v = 1; v < kVocab; ++v)
+      if (logits[i * kVocab + v] > logits[i * kVocab + best]) best = v;
+    if (best != prev && best != kBlank) text.push_back('a' + best);
+    prev = best;
+  }
+  if (static_cast<int32_t>(text.size()) + 1 > cap) return -4;
+  std::memcpy(out, text.c_str(), text.size() + 1);
+  return 0;
+}
+
+template <typename T>
+T Sym(void* lib, const char* name) {
+  T fn = reinterpret_cast<T>(dlsym(lib, name));
+  if (!fn) {
+    std::fprintf(stderr, "missing symbol %s: %s\n", name, dlerror());
+    std::exit(3);
+  }
+  return fn;
+}
+
+int RunAbi(const char* so_path) {
+  void* lib = dlopen(so_path, RTLD_NOW);
+  if (!lib) {
+    std::fprintf(stderr, "dlopen %s failed: %s\n", so_path, dlerror());
+    return 2;
+  }
+  auto create_model = Sym<sp_create_model_fn>(lib, "sp_create_model");
+  auto free_model = Sym<sp_free_model_fn>(lib, "sp_free_model");
+  auto create_stream = Sym<sp_create_stream_fn>(lib, "sp_create_stream");
+  auto free_stream = Sym<sp_free_stream_fn>(lib, "sp_free_stream");
+  auto feed = Sym<sp_feed_fn>(lib, "sp_feed");
+  auto intermediate = Sym<sp_intermediate_fn>(lib, "sp_intermediate");
+  auto finish = Sym<sp_finish_fn>(lib, "sp_finish");
+
+  void* model = create_model(kFeat, kVocab, /*chunk_frames=*/4,
+                             /*lookahead=*/0, StreamInit, StreamFree, Infer,
+                             Flush, Decode, nullptr);
+  if (!model) {
+    std::fprintf(stderr, "sp_create_model failed\n");
+    return 2;
+  }
+  void* stream = create_stream(model);
+  if (!stream) {
+    std::fprintf(stderr, "sp_create_stream failed\n");
+    free_model(model);
+    return 2;
+  }
+
+  // "tpu native": letters with blanks between repeats, fed in uneven
+  // chunks so the session's frame buffering has to do real work
+  const char* word = "tpunative";
+  std::vector<float> frames;
+  int prev = -1;
+  for (const char* c = word; *c; ++c) {
+    int idx = *c - 'a';
+    if (idx == prev) {
+      std::vector<float> blank(kFeat, 0.0f);
+      blank[0] = static_cast<float>(kBlank);
+      frames.insert(frames.end(), blank.begin(), blank.end());
+    }
+    std::vector<float> f(kFeat, 0.0f);
+    f[0] = static_cast<float>(idx);
+    frames.insert(frames.end(), f.begin(), f.end());
+    prev = idx;
+  }
+  int32_t n_frames = static_cast<int32_t>(frames.size() / kFeat);
+  // uneven chunk sizes: 1, 3, 2, 1, ... exercises pending-buffer carry
+  static const int32_t kChunks[] = {1, 3, 2, 1, 4, 2};
+  int32_t fed = 0, ci = 0;
+  while (fed < n_frames) {
+    int32_t take = kChunks[ci++ % 6];
+    if (fed + take > n_frames) take = n_frames - fed;
+    int rc = feed(stream, frames.data() + fed * kFeat, take);
+    if (rc != 0) {
+      std::fprintf(stderr, "sp_feed rc=%d\n", rc);
+      return 2;
+    }
+    fed += take;
+  }
+  char buf[256];
+  int rc = intermediate(stream, buf, sizeof(buf));
+  if (rc != 0) {
+    std::fprintf(stderr, "sp_intermediate rc=%d\n", rc);
+    return 2;
+  }
+  std::printf("intermediate: %s\n", buf);
+  rc = finish(stream, buf, sizeof(buf));
+  if (rc != 0) {
+    std::fprintf(stderr, "sp_finish rc=%d\n", rc);
+    return 2;
+  }
+  std::printf("final: %s\n", buf);
+  bool ok = std::strcmp(buf, word) == 0;
+  free_stream(stream);
+  free_model(model);
+  dlclose(lib);
+  if (!ok) {
+    std::fprintf(stderr, "decode mismatch: want %s\n", word);
+    return 1;
+  }
+  std::printf("abi ok\n");
+  return 0;
+}
+
+// --------------------------------------------------------------- http mode
+
+int RunHttp(const char* host, const char* port, const char* endpoint,
+            const char* body) {
+  addrinfo hints{}, *res = nullptr;
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  if (getaddrinfo(host, port, &hints, &res) != 0 || !res) {
+    std::fprintf(stderr, "resolve %s:%s failed\n", host, port);
+    return 2;
+  }
+  int fd = socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+  if (fd < 0 || connect(fd, res->ai_addr, res->ai_addrlen) != 0) {
+    std::fprintf(stderr, "connect %s:%s failed\n", host, port);
+    freeaddrinfo(res);
+    return 2;
+  }
+  freeaddrinfo(res);
+  std::string req = std::string("POST /") + endpoint + " HTTP/1.1\r\n" +
+                    "Host: " + host + "\r\n" +
+                    "Content-Type: application/json\r\n" +
+                    "Content-Length: " + std::to_string(std::strlen(body)) +
+                    "\r\nConnection: close\r\n\r\n" + body;
+  size_t off = 0;
+  while (off < req.size()) {
+    ssize_t n = send(fd, req.data() + off, req.size() - off, 0);
+    if (n <= 0) {
+      std::fprintf(stderr, "send failed\n");
+      close(fd);
+      return 2;
+    }
+    off += static_cast<size_t>(n);
+  }
+  std::string resp;
+  char buf[4096];
+  ssize_t n;
+  while ((n = recv(fd, buf, sizeof(buf), 0)) > 0) resp.append(buf, n);
+  close(fd);
+  if (resp.rfind("HTTP/1.1 200", 0) != 0 && resp.rfind("HTTP/1.0 200", 0) != 0) {
+    std::fprintf(stderr, "non-200 response:\n%s\n", resp.c_str());
+    return 1;
+  }
+  size_t body_at = resp.find("\r\n\r\n");
+  std::printf("%s\n", body_at == std::string::npos
+                          ? resp.c_str()
+                          : resp.c_str() + body_at + 4);
+  return 0;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc >= 3 && std::strcmp(argv[1], "abi") == 0) return RunAbi(argv[2]);
+  if (argc >= 6 && std::strcmp(argv[1], "http") == 0)
+    return RunHttp(argv[2], argv[3], argv[4], argv[5]);
+  std::fprintf(stderr,
+               "usage: %s abi <libspeech_api.so>\n"
+               "       %s http <host> <port> <endpoint> <json>\n",
+               argv[0], argv[0]);
+  return 64;
+}
