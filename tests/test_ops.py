@@ -58,3 +58,26 @@ class TestConv:
         spec = ConvSpec("tiny", 1, 8, 8, 4, 8, 3, 3)
         stats, row = conv_bench(spec, n_iter=64, reps=1)
         assert row.config == "conv_sweep" and row.value > 0
+
+
+class TestSpaceToDepthStem:
+    def test_conv1_s2d_exact_parity(self):
+        """The space-to-depth stem (4x4 s1 over folded input) must equal
+        the 7x7 s2 SAME conv exactly — same math, MXU-friendly layout."""
+        from tosem_tpu.ops.conv import (conv2d, space_to_depth_conv1_weights,
+                                        space_to_depth_inputs)
+        kx, kw = jax.random.split(jax.random.PRNGKey(3))
+        x = jax.random.normal(kx, (2, 16, 16, 3))
+        w = jax.random.normal(kw, (7, 7, 3, 8))
+        ref = conv2d(x, w, stride=2, precision="float32")
+        got = conv2d(space_to_depth_inputs(x),
+                     space_to_depth_conv1_weights(w),
+                     stride=1, precision="float32")
+        assert got.shape == ref.shape == (2, 8, 8, 8)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_rejects_non_stem_kernel(self):
+        from tosem_tpu.ops.conv import space_to_depth_conv1_weights
+        with pytest.raises(ValueError):
+            space_to_depth_conv1_weights(jnp.zeros((3, 3, 3, 8)))

@@ -73,7 +73,9 @@ def gemm_bench(spec: GemmSpec, *, n_iter: int = 0, reps: int = 3,
         metric="gflops", value=gf, unit="GFLOPS", device=platform,
         n_devices=1,
         extra={"m": spec.m, "n": spec.n, "k": spec.k, "dtype": spec.dtype,
-               "precision": spec.precision, "mean_ms": stats.mean_ms},
+               "precision": spec.precision, "mean_ms": stats.mean_ms,
+               "bytes": (spec.m * spec.k + spec.k * spec.n
+                         + spec.m * spec.n) * jnp.dtype(spec.dtype).itemsize},
     )
     return stats, row
 
