@@ -159,3 +159,60 @@ def test_bf16_cast():
     p = _params(jax.random.key(9))
     bp = to_bf16(p)
     assert bp["l1"]["w"].dtype == jnp.bfloat16
+
+
+class TestEntropyCalibration:
+    """KL-optimal int8 clipping (TensorRT entropy_calibrator.cc role)."""
+
+    def test_outliers_get_clipped(self):
+        from tosem_tpu.compress.quantization import EntropyCalibrator
+        rng = np.random.default_rng(0)
+        cal = EntropyCalibrator(bins=512)
+        for _ in range(4):
+            x = rng.normal(0, 1.0, 8192).astype(np.float32)
+            x[:4] = 80.0                      # rare extreme outliers
+            cal.observe("act", x)
+        thr = cal.thresholds(n_quant=128)["act"]
+        assert thr < 40.0                     # clipped far below amax=80
+        assert thr > 1.0                      # but keeps the bulk
+
+    def test_kl_scale_beats_minmax_on_bulk(self):
+        """For an outlier-heavy distribution, the entropy scale must give
+        lower quantization MSE on the bulk than the min/max scale."""
+        from tosem_tpu.compress.quantization import EntropyCalibrator
+
+        def mse(x, scale):
+            q = np.clip(np.round(x / scale), -127, 127) * scale
+            return float(np.mean((x - q) ** 2))
+
+        rng = np.random.default_rng(1)
+        x = rng.normal(0, 1.0, 65536).astype(np.float32)
+        x[:8] = 100.0
+        cal = EntropyCalibrator(bins=1024)
+        cal.observe("a", x)
+        kl_scale = cal.scales()["a"]
+        minmax_scale = float(np.abs(x).max() / 127.0)
+        bulk = x[np.abs(x) < 10]
+        assert mse(bulk, kl_scale) < mse(bulk, minmax_scale) / 4
+
+    def test_streaming_range_growth(self):
+        from tosem_tpu.compress.quantization import EntropyCalibrator
+        rng = np.random.default_rng(2)
+        cal = EntropyCalibrator(bins=512)
+        cal.observe("a", rng.normal(0, 0.1, 4096))
+        cal.observe("a", rng.normal(0, 2.0, 4096))   # range grows 20x
+        thr = cal.thresholds()["a"]
+        assert 0.5 < thr < 10.0
+        assert cal._hist["a"].sum() == 8192          # mass preserved
+
+    def test_zero_and_empty_tensors(self):
+        from tosem_tpu.compress.quantization import EntropyCalibrator
+        cal = EntropyCalibrator(bins=512)
+        cal.observe("z", np.zeros(128))
+        cal.observe("e", np.array([]))        # empty observation
+        scales = cal.scales()
+        assert scales["z"] == pytest.approx(1e-12)   # clamp floor exactly
+        assert scales["e"] == pytest.approx(1e-12)
+        # a later real observation on the zero tensor still works
+        cal.observe("z", np.full(256, 0.5))
+        assert scales["z"] < cal.scales()["z"] < 1.0

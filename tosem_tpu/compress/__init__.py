@@ -3,6 +3,7 @@ from tosem_tpu.compress.pruning import (SparsityScheduler, apply_masks,
                                         magnitude_masks,
                                         make_pruned_train_step,
                                         shrink_dense_pair, sparsity_of)
-from tosem_tpu.compress.quantization import (dequantize_params, fake_quant,
-                                             qat_params, quantize_params,
-                                             to_bf16)
+from tosem_tpu.compress.quantization import (EntropyCalibrator,
+                                             dequantize_params, fake_quant,
+                                             kl_threshold, qat_params,
+                                             quantize_params, to_bf16)

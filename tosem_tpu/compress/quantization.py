@@ -112,3 +112,112 @@ def to_bf16(params: Params) -> Params:
     return jax.tree_util.tree_map(
         lambda p: p.astype(jnp.bfloat16) if jnp.issubdtype(
             p.dtype, jnp.floating) else p, params)
+
+
+# -- entropy (KL) calibration ------------------------------------------
+#
+# Min/max PTQ lets one outlier blow up the scale for the whole tensor.
+# The reference's TensorRT calibration (apollo ``modules/perception/
+# inference/tensorrt/entropy_calibrator.cc`` + ``batch_stream.cc``)
+# instead histograms activations over a calibration stream and picks the
+# clipping threshold minimizing the KL divergence between the original
+# distribution and its int8-quantized projection. Same algorithm here in
+# numpy over |x| histograms (symmetric quantization).
+
+import numpy as np
+
+
+def kl_threshold(hist: "np.ndarray", bin_width: float,
+                 n_quant: int = 128) -> float:
+    """TensorRT's entropy-calibration search: for each candidate clip
+    point ``i`` (in bins), fold the tail into the last kept bin, project
+    the kept distribution onto ``n_quant`` levels, expand back, and score
+    KL(P‖Q); return the threshold (in input units) minimizing it."""
+    hist = np.asarray(hist, np.float64)
+    nbins = len(hist)
+    if nbins < n_quant * 2:
+        raise ValueError(f"need >= {2 * n_quant} bins, got {nbins}")
+    best_i, best_kl = nbins, float("inf")
+    for i in range(n_quant, nbins + 1):
+        p = hist[:i].copy()
+        outliers = hist[i:].sum()
+        p[-1] += outliers                 # saturate the tail, don't drop it
+        if p.sum() == 0:
+            continue
+        # project onto n_quant levels: merge i bins into n_quant groups,
+        # then spread each group's mass uniformly over its NONZERO bins
+        # (the TensorRT expansion rule)
+        edges = np.linspace(0, i, n_quant + 1).astype(np.int64)
+        q = np.zeros(i, np.float64)
+        kept = hist[:i]
+        for g in range(n_quant):
+            lo, hi = edges[g], edges[g + 1]
+            mass = p[lo:hi].sum()
+            nz = kept[lo:hi] > 0
+            if nz.any():
+                q[lo:hi][nz] = mass / nz.sum()
+        pn = p / p.sum()
+        qs = q.sum()
+        if qs == 0:
+            continue
+        qn = q / qs
+        mask = pn > 0
+        with np.errstate(divide="ignore"):
+            kl = float(np.sum(pn[mask] * np.log(pn[mask]
+                                                / np.maximum(qn[mask],
+                                                             1e-12))))
+        if kl < best_kl:
+            best_kl, best_i = kl, i
+    return (best_i + 0.5) * bin_width
+
+
+class EntropyCalibrator:
+    """Streaming |activation| histogram per tensor name; ``scales()``
+    yields KL-optimal symmetric int8 scales. The batch-stream side of the
+    reference's calibration pair: feed it a few hundred real batches."""
+
+    def __init__(self, bins: int = 2048):
+        self.bins = bins
+        self._hist: Dict[str, "np.ndarray"] = {}
+        self._amax: Dict[str, float] = {}
+
+    def observe(self, name: str, x) -> None:
+        a = np.abs(np.asarray(x, np.float32)).ravel()
+        amax = float(a.max()) if a.size else 0.0
+        if amax == 0.0 and name not in self._hist:
+            self._hist[name] = np.zeros(self.bins, np.int64)
+            self._amax[name] = 0.0
+            return
+        cur = self._amax.get(name, 0.0)
+        if name not in self._hist:
+            self._amax[name] = amax
+            self._hist[name] = np.histogram(
+                a, bins=self.bins, range=(0, amax))[0]
+            return
+        if amax > cur:
+            # grow the range: re-bin the old histogram into the new range
+            # (mass-preserving, the dynamic-range growth of observers)
+            old = self._hist[name]
+            centers = (np.arange(self.bins) + 0.5) * (cur / self.bins)
+            self._hist[name] = np.histogram(
+                centers, bins=self.bins, range=(0, amax), weights=old
+            )[0].astype(np.int64)
+            self._amax[name] = amax
+            cur = amax
+        self._hist[name] += np.histogram(
+            a, bins=self.bins, range=(0, max(cur, 1e-12)))[0]
+
+    def thresholds(self, n_quant: int = 128) -> Dict[str, float]:
+        out = {}
+        for name, hist in self._hist.items():
+            amax = self._amax[name]
+            if amax == 0.0 or hist.sum() == 0:
+                out[name] = 1e-12
+                continue
+            out[name] = kl_threshold(hist, amax / self.bins, n_quant)
+        return out
+
+    def scales(self, bits: int = 8) -> Dict[str, float]:
+        qmax = 2.0 ** (bits - 1) - 1.0
+        return {n: max(t / qmax, 1e-12)
+                for n, t in self.thresholds(2 ** (bits - 1)).items()}
