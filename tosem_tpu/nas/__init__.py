@@ -6,3 +6,5 @@ from tosem_tpu.nas.mutator import (AddSkip, InsertNode, Mutator, RemoveNode,
 from tosem_tpu.nas.search import (SearchResult, evolution_search,
                                   make_train_evaluator,
                                   parallel_evolution_search, random_search)
+from tosem_tpu.nas.codegen import (emit_module, export_candidate,
+                                   load_emitted, write_module)
