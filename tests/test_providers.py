@@ -1,0 +1,151 @@
+"""Pluggable training services (NNI training_service / trialDispatcher
+seam): same trial protocol, interchangeable placement backends — local
+threads, isolated subprocesses, remote node agents.
+"""
+import os
+import time
+
+import pytest
+
+TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+# importable trial targets (spawned processes / agents import by name)
+def quad_trainable(config):
+    x = config["x"]
+    for i in range(3):
+        yield {"loss": (x - 2.0) ** 2 + 1.0 / (i + 1)}
+
+
+def crashing_trainable(config):
+    yield {"loss": 1.0}
+    raise RuntimeError("boom")
+
+
+def _drive(service, num_samples=4):
+    from tosem_tpu.tune.providers import run_with_service
+    from tosem_tpu.tune.search import RandomSearch
+    return run_with_service(
+        "test_providers:quad_trainable", {"x": ("uniform", 0.0, 4.0)},
+        service=service, metric="loss", mode="min",
+        num_samples=num_samples, max_iterations=5,
+        search_alg=_UniformSearch(), timeout_s=300)
+
+
+class _UniformSearch:
+    """Minimal search alg for the provider loop (space-agnostic)."""
+
+    def set_space(self, space, mode):
+        import numpy as np
+        self._rng = np.random.default_rng(0)
+        self.observed = []
+
+    def suggest(self):
+        return {"x": float(self._rng.uniform(0.0, 4.0))}
+
+    def observe(self, config, score):
+        self.observed.append((config["x"], score))
+
+
+class TestLocalService:
+    def test_runs_trials_and_observes(self):
+        from tosem_tpu.tune.providers import LocalService
+        svc = LocalService(max_concurrent=2)
+        out = _drive(svc)
+        assert len(out["trials"]) == 4
+        assert all(t["status"] == "SUCCEEDED" for t in out["trials"])
+        # final metric = (x-2)^2 + 1/3; best config is the x nearest 2
+        xs = [t["config"]["x"] for t in out["trials"]]
+        nearest = min(xs, key=lambda x: abs(x - 2.0))
+        assert out["best_config"]["x"] == nearest
+
+    def test_failure_is_contained(self):
+        from tosem_tpu.tune.providers import LocalService, run_with_service
+        svc = LocalService()
+        out = run_with_service(
+            "test_providers:crashing_trainable", {},
+            service=svc, metric="loss", mode="min", num_samples=2,
+            max_iterations=5, search_alg=_UniformSearch(), timeout_s=120)
+        assert all(t["status"] == "FAILED" for t in out["trials"])
+        assert out["best_config"] is None
+
+
+@pytest.mark.slow
+class TestSubprocessService:
+    def test_process_isolated_trials(self, tmp_path):
+        from tosem_tpu.tune.providers import SubprocessService
+        env_path = os.environ.get("PYTHONPATH", "")
+        os.environ["PYTHONPATH"] = TESTS_DIR + os.pathsep + env_path
+        try:
+            svc = SubprocessService(max_concurrent=2,
+                                    workdir=str(tmp_path))
+            out = _drive(svc, num_samples=3)
+        finally:
+            os.environ["PYTHONPATH"] = env_path
+        assert all(t["status"] == "SUCCEEDED" for t in out["trials"])
+        assert out["best_score"] is not None
+
+    def test_crash_reports_failed_not_hang(self, tmp_path):
+        from tosem_tpu.tune.providers import (SubprocessService,
+                                              run_with_service)
+        env_path = os.environ.get("PYTHONPATH", "")
+        os.environ["PYTHONPATH"] = TESTS_DIR + os.pathsep + env_path
+        try:
+            svc = SubprocessService(workdir=str(tmp_path))
+            out = run_with_service(
+                "test_providers:crashing_trainable", {},
+                service=svc, metric="loss", mode="min", num_samples=1,
+                max_iterations=5, search_alg=_UniformSearch(),
+                timeout_s=300)
+        finally:
+            os.environ["PYTHONPATH"] = env_path
+        assert out["trials"][0]["status"] == "FAILED"
+        assert "boom" in out["trials"][0]["error"]
+
+
+@pytest.mark.slow
+class TestNodeAgentService:
+    def test_trials_run_on_remote_agents(self):
+        from tosem_tpu.cluster.node import RemoteNode
+        from tosem_tpu.tune.providers import NodeAgentService
+        n1 = RemoteNode.spawn_local(num_workers=2,
+                                    extra_sys_path=[TESTS_DIR])
+        n2 = RemoteNode.spawn_local(num_workers=2,
+                                    extra_sys_path=[TESTS_DIR])
+        try:
+            svc = NodeAgentService([n1, n2], max_concurrent=4)
+            out = _drive(svc, num_samples=4)
+            assert all(t["status"] == "SUCCEEDED" for t in out["trials"])
+            # both agents did work (round-robin placement)
+            assert n1.stats()["tasks_done"] >= 1
+            assert n2.stats()["tasks_done"] >= 1
+        finally:
+            n1.kill()
+            n2.kill()
+
+
+@pytest.mark.slow
+class TestExperimentServiceSeam:
+    def test_experiment_runs_via_subprocess_service(self, tmp_path):
+        from tosem_tpu.tune.experiment import ExperimentManager
+        env_path = os.environ.get("PYTHONPATH", "")
+        os.environ["PYTHONPATH"] = TESTS_DIR + os.pathsep + env_path
+        try:
+            mgr = ExperimentManager(path=str(tmp_path / "exp.db"))
+            name = mgr.create({
+                "name": "svc-exp",
+                "trainable": "test_providers:quad_trainable",
+                "space": {"x": {"type": "uniform", "low": 0.0,
+                                "high": 4.0}},
+                "metric": "loss", "mode": "min",
+                "num_samples": 2, "max_iterations": 3,
+                "max_concurrent": 2,
+                "training_service": "subprocess",
+            })
+            state = mgr.run(name)
+        finally:
+            os.environ["PYTHONPATH"] = env_path
+        assert state["status"] == "done"
+        assert state["training_service"] == "subprocess"
+        assert state["n_trials"] == 2
+        assert state["best_score"] is not None

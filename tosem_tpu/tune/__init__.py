@@ -31,3 +31,6 @@ __all__ = [
     "Domain", "Uniform", "LogUniform", "RandInt", "Choice",
     "ExperimentManager", "space_from_json", "space_to_json",
 ]
+from tosem_tpu.tune.providers import (LocalService, NodeAgentService,
+                                      SubprocessService, TrainingService,
+                                      run_with_service)

@@ -227,6 +227,20 @@ class ExperimentManager:
         self._set_state(name, {"status": "running",
                                "started_at": time.time()})
         try:
+            # pluggable training service (NNI trialDispatcher seam):
+            # spec["training_service"] ∈ {"local", "subprocess"} routes
+            # trials through tosem_tpu.tune.providers instead of the
+            # in-process actor loop — same trainable, different placement
+            if spec.get("training_service"):
+                state = self._run_via_service(name, spec)
+                owns = self._set_state_if_owner(name, my_lock, state)
+                self.kv.delete_if(_NS_LOCK, name, my_lock)
+                if not owns:
+                    import sys
+                    print(f"[experiment] {name!r}: displaced by a forced "
+                          "takeover; results not persisted",
+                          file=sys.stderr)
+                return state
             trainable = _resolve_target(spec["trainable"])
             space = space_from_json(spec["space"])
             sched_kw = dict(spec.get("scheduler_args", {}))
@@ -286,6 +300,37 @@ class ExperimentManager:
             print(f"[experiment] {name!r}: displaced by a forced "
                   "takeover; results not persisted", file=sys.stderr)
         return state
+
+    def _run_via_service(self, name: str,
+                         spec: Dict[str, Any]) -> Dict[str, Any]:
+        from tosem_tpu.tune.providers import SERVICES, run_with_service
+        svc_cls = SERVICES[spec["training_service"]]
+        service = svc_cls(
+            max_concurrent=int(spec.get("max_concurrent", 4)))
+        try:
+            out = run_with_service(
+                spec["trainable"], space_from_json(spec["space"]),
+                service=service, metric=spec["metric"],
+                mode=spec["mode"],
+                num_samples=int(spec.get("num_samples", 10)),
+                max_iterations=int(spec.get("max_iterations", 100)),
+                search_alg=SEARCHERS[spec.get("search", "random")](
+                    **dict(spec.get("search_args", {}))),
+                max_in_flight=int(spec.get("max_concurrent", 4)))
+        finally:
+            service.shutdown()
+        return {
+            "status": "done",
+            "ended_at": time.time(),
+            "training_service": spec["training_service"],
+            "best_config": out["best_config"],
+            "best_score": out["best_score"],
+            "n_trials": len(out["trials"]),
+            "trials": [{
+                "trial_id": t["trial_id"], "config": t["config"],
+                "status": t["status"], "best_score": t["score"],
+            } for t in out["trials"]],
+        }
 
     def _set_state_if_owner(self, name: str, my_lock: bytes,
                             state: Dict[str, Any]) -> bool:

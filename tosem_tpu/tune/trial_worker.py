@@ -1,0 +1,34 @@
+"""Trial worker entry point for :class:`SubprocessService`.
+
+One trial per process (the NNI local training service spawns exactly
+this shape: an interpreter running the user trainable, reporting
+metrics through a side channel — here a JSON file written atomically).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--target", required=True, help="module:attr trainable")
+    ap.add_argument("--config", required=True, help="JSON config dict")
+    ap.add_argument("--max-iterations", type=int, default=100)
+    ap.add_argument("--out", required=True, help="result JSON path")
+    args = ap.parse_args(argv)
+
+    from tosem_tpu.tune.providers import run_trial
+    out = run_trial(args.target, json.loads(args.config),
+                    args.max_iterations)
+    tmp = args.out + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(out, f)
+    os.replace(tmp, args.out)   # atomic: the manager never reads a torn file
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
