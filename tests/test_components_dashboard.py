@@ -170,3 +170,39 @@ class TestDashboard:
             assert "# TYPE" in prom or prom.strip()
         finally:
             srv.shutdown()
+
+
+class TestDashboardCharts:
+    def test_svg_charts_render(self):
+        """Experiments with trial scores and result series get inline SVG
+        charts (the WebUI default-metric plot, server-rendered)."""
+        snap = {
+            "timestamp": 0.0,
+            "runtime": None,
+            "memory": {"rss_bytes": 1e6, "available_bytes": 1e9},
+            "metrics": [],
+            "experiments": [{"name": "exp1", "status": "done",
+                             "best_score": 0.2, "n_trials": 4,
+                             "trial_scores": [0.9, 0.5, 0.3, 0.2]}],
+            "deployments": [],
+            "results": [{"config": "gemm", "bench_id": f"g{i}",
+                         "metric": "gflops", "value": 100.0 + i,
+                         "unit": "GFLOPS", "device": "tpu"}
+                        for i in range(3)],
+        }
+        page = render_html(snap)
+        assert page.count("<svg") == 2          # one per chart family
+        assert "best score per trial" in page
+        assert "gemm/gflops" in page
+
+    def test_no_charts_for_sparse_data(self):
+        snap = {
+            "timestamp": 0.0, "runtime": None,
+            "memory": {"rss_bytes": 1e6, "available_bytes": 1e9},
+            "metrics": [],
+            "experiments": [{"name": "e", "status": "running",
+                             "best_score": None, "n_trials": 1,
+                             "trial_scores": [0.5]}],   # 1 point: no chart
+            "deployments": [], "results": [],
+        }
+        assert "<svg" not in render_html(snap)

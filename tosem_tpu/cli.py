@@ -39,6 +39,13 @@ def make_flags() -> FlagSet:
     fs.define_integer("n_virtual_devices", 8,
                       "virtual device count for --device=cpu")
     fs.define_integer("steps", 20, "training steps for resnet_train")
+    fs.define_integer("converge_steps", 0,
+                      "resnet_train: extra steps for the convergence gate "
+                      "(0 = throughput-only)")
+    fs.define_float("target_acc", 0.6,
+                    "resnet_train convergence gate: required held-out "
+                    "accuracy (release-gate pass/fail)")
+    fs.define_float("lr", 0.1, "resnet_train SGD learning rate")
     fs.define_integer("batch", 0, "global batch (0 = per-config default)")
     fs.define_integer("seq", 0, "sequence length for bert_kernels (0 = auto)")
     fs.define_integer("max_bytes", 0,
@@ -144,7 +151,7 @@ def run_resnet_train(fs: FlagSet) -> List[Any]:
     batch = max(batch // n_dev * n_dev, n_dev)
     steps = max(fs.steps, 1)  # at least one timed step (avoids div-by-0)
     model = resnet50(num_classes=10, small_inputs=True)
-    opt = optax.sgd(0.1, momentum=0.9)
+    opt = optax.sgd(fs.lr, momentum=0.9)
     ts = create_train_state(model, jax.random.PRNGKey(0), opt)
     mesh = default_mesh("dp") if n_dev > 1 else None
     step = make_train_step(model, opt, classification_loss, mesh=mesh)
@@ -184,6 +191,38 @@ def run_resnet_train(fs: FlagSet) -> List[Any]:
                   device=jax.devices()[0].platform, n_devices=n_dev,
                   extra={"batch": batch}),
     ]
+
+    # convergence gate (--converge_steps > 0): keep training, then assert
+    # held-out accuracy — benchmark-as-release-gate, the way the
+    # reference's release logs assert workload SUCCESS, not just rate
+    # (ray release_logs/.../test_many_tasks.txt). The teacher-labelled
+    # synthetic set has real signal; val inputs are disjoint draws.
+    if fs.converge_steps > 0:
+        from tosem_tpu.data.synthetic import SyntheticImageDataset
+        import numpy as np
+        for b in cifar_like_batches(batch, steps=fs.converge_steps):
+            if mesh is not None:
+                b = shard_batch(b, mesh)
+            rng, sub = jax.random.split(rng)
+            ts, metrics = step(ts, b, sub)
+        final_loss = float(jax.device_get(metrics["loss"]))
+        xv, yv = SyntheticImageDataset().materialize_val(256)
+        logits = model.apply({"params": ts["params"],
+                              "state": ts["state"]},
+                             jnp.asarray(xv), train=False)[0]
+        acc = float(jnp.mean(jnp.argmax(logits, -1) == jnp.asarray(yv)))
+        passed = acc >= fs.target_acc and final_loss < 1.0
+        rows.append(ResultRow(
+            project="train", config="resnet_train",
+            bench_id=f"resnet50_convergence_b{batch}", metric="val_acc",
+            value=acc, unit="ratio",
+            device=jax.devices()[0].platform, n_devices=n_dev,
+            extra={"converge_steps": fs.converge_steps,
+                   "final_loss": final_loss,
+                   "target_acc": fs.target_acc, "passed": bool(passed)}))
+        print(f"  convergence gate: val_acc={acc:.3f} "
+              f"loss={final_loss:.3f} -> "
+              f"{'PASS' if passed else 'FAIL'}")
     for r in rows:
         print(f"  {r.bench_id}: {r.value:.2f} {r.unit}")
     return rows

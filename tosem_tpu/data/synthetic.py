@@ -25,16 +25,33 @@ class SyntheticImageDataset:
     classes: int = 10
     seed: int = 0
 
+    def _teacher(self) -> np.ndarray:
+        """The fixed linear teacher, drawn from its own RNG stream so
+        train and val label-generation can never desynchronize."""
+        rng = np.random.default_rng(self.seed + 7777)
+        return rng.standard_normal((self.hw * self.hw * 3, self.classes),
+                                   dtype=np.float32)
+
     def materialize(self):
         rng = np.random.default_rng(self.seed)
         x = rng.standard_normal((self.n, self.hw, self.hw, 3),
                                 dtype=np.float32)
         # learnable labels: class = argmax of 'classes' fixed random
         # projections of the image (a linear teacher)
-        teacher = rng.standard_normal((self.hw * self.hw * 3, self.classes),
-                                      dtype=np.float32)
-        y = np.argmax(x.reshape(self.n, -1) @ teacher, axis=1).astype(np.int32)
+        y = np.argmax(x.reshape(self.n, -1) @ self._teacher(),
+                      axis=1).astype(np.int32)
         return x, y
+
+    def materialize_val(self, n_val: int = 256):
+        """Held-out samples from the SAME linear teacher (fresh inputs,
+        disjoint RNG stream) — validation accuracy on these measures
+        generalization, not memorization."""
+        rngv = np.random.default_rng(self.seed + 9999)
+        xv = rngv.standard_normal((n_val, self.hw, self.hw, 3),
+                                  dtype=np.float32)
+        yv = np.argmax(xv.reshape(n_val, -1) @ self._teacher(),
+                       axis=1).astype(np.int32)
+        return xv, yv
 
 
 def cifar_like_batches(batch_size: int, *, steps: Optional[int] = None,

@@ -50,9 +50,14 @@ def snapshot(*, kv_path: Optional[str] = None,
             from tosem_tpu.tune.experiment import ExperimentManager
             mgr = ExperimentManager(path=kv_path)
         if mgr is not None:
+            # mgr.list() already carries the full state incl. trials —
+            # build the default-metric chart series (best score per
+            # trial, NNI WebUI's headline plot) without re-reading
             snap["experiments"] = [
-                {k: e.get(k) for k in ("name", "status", "best_score",
-                                       "n_trials")}
+                dict({k: e.get(k) for k in ("name", "status",
+                                            "best_score", "n_trials")},
+                     trial_scores=[t.get("best_score")
+                                   for t in (e.get("trials") or [])])
                 for e in mgr.list()]
         else:
             snap["experiments"] = []
@@ -134,6 +139,63 @@ def _table(rows: List[Dict[str, Any]], cols: List[str]) -> str:
     return f"<table><tr>{head}</tr>{body}</table>"
 
 
+def _svg_chart(values: List[float], *, width: int = 360, height: int = 90,
+               label: str = "") -> str:
+    """Inline SVG line chart (no JS, no external assets — the WebUI's
+    default-metric plot rendered server-side)."""
+    pts = [(i, v) for i, v in enumerate(values)
+           if isinstance(v, (int, float))]
+    if len(pts) < 2:
+        return ""
+    xs = [p[0] for p in pts]
+    ys = [p[1] for p in pts]
+    lo, hi = min(ys), max(ys)
+    span = (hi - lo) or 1.0
+    pad = 6
+    W, H = width - 2 * pad, height - 2 * pad
+
+    def sx(x):
+        return pad + W * (x - xs[0]) / max(xs[-1] - xs[0], 1)
+
+    def sy(y):
+        return pad + H * (1.0 - (y - lo) / span)
+
+    poly = " ".join(f"{sx(x):.1f},{sy(y):.1f}" for x, y in pts)
+    dots = "".join(f'<circle cx="{sx(x):.1f}" cy="{sy(y):.1f}" r="2"/>'
+                   for x, y in pts)
+    return (f'<figure><svg width="{width}" height="{height}" '
+            f'viewBox="0 0 {width} {height}">'
+            f'<rect width="{width}" height="{height}" fill="#f6f6f6"/>'
+            f'<polyline points="{poly}" fill="none" stroke="#369" '
+            f'stroke-width="1.5"/>{dots}</svg>'
+            f'<figcaption>{html.escape(label)} '
+            f'(min {lo:.4g}, max {hi:.4g})</figcaption></figure>')
+
+
+def _experiment_charts(experiments: List[Dict[str, Any]]) -> str:
+    parts = []
+    for e in experiments:
+        scores = e.get("trial_scores") or []
+        chart = _svg_chart(scores,
+                           label=f"{e.get('name')}: best score per trial")
+        if chart:
+            parts.append(chart)
+    return "".join(parts)
+
+
+def _results_charts(results: List[Dict[str, Any]]) -> str:
+    series: Dict[str, List[float]] = {}
+    for r in results:
+        key = f"{r.get('config')}/{r.get('metric')}"
+        try:
+            series.setdefault(key, []).append(float(r.get("value")))
+        except (TypeError, ValueError):
+            pass
+    return "".join(_svg_chart(vals, label=key)
+                   for key, vals in sorted(series.items())
+                   if len(vals) >= 2)
+
+
 def render_html(snap: Dict[str, Any]) -> str:
     rtm = snap.get("runtime") or {}
     rt_rows = [{"key": k, "value": v} for k, v in sorted(rtm.items())]
@@ -145,6 +207,8 @@ def render_html(snap: Dict[str, Any]) -> str:
  table {{ border-collapse: collapse; margin: 0.5em 0 1.5em; }}
  th, td {{ border: 1px solid #999; padding: 2px 8px; text-align: left; }}
  h2 {{ margin-bottom: 0.2em; }}
+ figure {{ display: inline-block; margin: 0.4em 1em 0.4em 0; }}
+ figcaption {{ font-size: 11px; color: #555; }}
 </style></head><body>
 <h1>tosem_tpu dashboard</h1>
 <p>{html.escape(time.ctime(snap['timestamp']))} &mdash;
@@ -154,11 +218,13 @@ rss {mem['rss_bytes']/1e6:.1f} MB, available
 <h2>Metrics</h2>{_table(snap['metrics'], ["series", "value"])}
 <h2>Experiments</h2>{_table(snap['experiments'],
                             ["name", "status", "best_score", "n_trials"])}
+{_experiment_charts(snap['experiments'])}
 <h2>Deployments</h2>{_table(snap.get('deployments', []),
                             ["name", "replicas", "load"])}
 <h2>Recent results</h2>{_table(snap['results'],
                                ["config", "bench_id", "metric", "value",
                                 "unit", "device"])}
+{_results_charts(snap['results'])}
 </body></html>"""
 
 

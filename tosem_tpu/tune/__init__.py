@@ -21,6 +21,10 @@ from tosem_tpu.tune.experiment import (ExperimentManager, space_from_json,
                                        space_to_json)
 from tosem_tpu.tune.tune import Analysis, Trainable, Trial, run
 
+from tosem_tpu.tune.providers import (LocalService, NodeAgentService,
+                                      SubprocessService, TrainingService,
+                                      run_with_service)
+
 __all__ = [
     "run", "Analysis", "Trainable", "Trial",
     "TrialScheduler", "FIFOScheduler", "ASHAScheduler", "MedianStoppingRule",
@@ -30,7 +34,6 @@ __all__ = [
     "uniform", "loguniform", "randint", "choice", "grid_search",
     "Domain", "Uniform", "LogUniform", "RandInt", "Choice",
     "ExperimentManager", "space_from_json", "space_to_json",
+    "TrainingService", "LocalService", "SubprocessService",
+    "NodeAgentService", "run_with_service",
 ]
-from tosem_tpu.tune.providers import (LocalService, NodeAgentService,
-                                      SubprocessService, TrainingService,
-                                      run_with_service)

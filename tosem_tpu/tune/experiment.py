@@ -27,7 +27,6 @@ Spec schema (JSON/YAML)::
 """
 from __future__ import annotations
 
-import importlib
 import json
 import time
 from typing import Any, Dict, List, Optional
@@ -110,11 +109,8 @@ def space_to_json(space: Dict[str, Any]) -> Dict[str, Any]:
     return out
 
 
-def _resolve_target(ref: str):
-    mod, _, attr = ref.partition(":")
-    if not attr:
-        raise ValueError(f"trainable must be 'module:attr', got {ref!r}")
-    return getattr(importlib.import_module(mod), attr)
+# one 'module:attr' parsing contract for every trial-launching path
+from tosem_tpu.tune.providers import resolve_target as _resolve_target
 
 
 class ExperimentManager:
@@ -228,60 +224,54 @@ class ExperimentManager:
                                "started_at": time.time()})
         try:
             # pluggable training service (NNI trialDispatcher seam):
-            # spec["training_service"] ∈ {"local", "subprocess"} routes
-            # trials through tosem_tpu.tune.providers instead of the
-            # in-process actor loop — same trainable, different placement
+            # spec["training_service"] routes trials through
+            # tosem_tpu.tune.providers instead of the in-process actor
+            # loop — same trainable, different placement; both paths
+            # share the persist/unlock epilogue below
             if spec.get("training_service"):
                 state = self._run_via_service(name, spec)
-                owns = self._set_state_if_owner(name, my_lock, state)
-                self.kv.delete_if(_NS_LOCK, name, my_lock)
-                if not owns:
-                    import sys
-                    print(f"[experiment] {name!r}: displaced by a forced "
-                          "takeover; results not persisted",
-                          file=sys.stderr)
-                return state
-            trainable = _resolve_target(spec["trainable"])
-            space = space_from_json(spec["space"])
-            sched_kw = dict(spec.get("scheduler_args", {}))
-            search_kw = dict(spec.get("search_args", {}))
-            analysis = tune_run(
-                trainable, space,
-                metric=spec["metric"], mode=spec["mode"],
-                num_samples=int(spec.get("num_samples", 10)),
-                max_iterations=int(spec.get("max_iterations", 100)),
-                scheduler=SCHEDULERS[spec.get("scheduler", "fifo")](
-                    **sched_kw),
-                search_alg=SEARCHERS[spec.get("search", "random")](
-                    **search_kw),
-                max_concurrent=int(spec.get("max_concurrent", 4)),
-                verbose=verbose)
+            else:
+                trainable = _resolve_target(spec["trainable"])
+                space = space_from_json(spec["space"])
+                sched_kw = dict(spec.get("scheduler_args", {}))
+                search_kw = dict(spec.get("search_args", {}))
+                analysis = tune_run(
+                    trainable, space,
+                    metric=spec["metric"], mode=spec["mode"],
+                    num_samples=int(spec.get("num_samples", 10)),
+                    max_iterations=int(spec.get("max_iterations", 100)),
+                    scheduler=SCHEDULERS[spec.get("scheduler", "fifo")](
+                        **sched_kw),
+                    search_alg=SEARCHERS[spec.get("search", "random")](
+                        **search_kw),
+                    max_concurrent=int(spec.get("max_concurrent", 4)),
+                    verbose=verbose)
 
-            # Trial.best_score is sign-internalized (higher is better);
-            # persist the RAW metric value so status/results read
-            # naturally. best_trial raises when every trial errored —
-            # that must land in the 'failed' state too.
-            sign = -1.0 if spec["mode"] == "min" else 1.0
+                # Trial.best_score is sign-internalized (higher is better);
+                # persist the RAW metric value so status/results read
+                # naturally. best_trial raises when every trial errored —
+                # that must land in the 'failed' state too.
+                sign = -1.0 if spec["mode"] == "min" else 1.0
 
-            def raw(s):
-                return (None if s in (None, float("-inf"), float("inf"))
-                        else float(sign * s))
+                def raw(s):
+                    return (None if s in (None, float("-inf"), float("inf"))
+                            else float(sign * s))
 
-            trials = [{
-                "trial_id": t.trial_id,
-                "config": t.config,
-                "status": t.status,
-                "iterations": t.iteration,
-                "best_score": raw(t.best_score),
-            } for t in analysis.trials]
-            state = {
-                "status": "done",
-                "ended_at": time.time(),
-                "best_config": analysis.best_config,
-                "best_score": raw(analysis.best_trial.best_score),
-                "n_trials": len(trials),
-                "trials": trials,
-            }
+                trials = [{
+                    "trial_id": t.trial_id,
+                    "config": t.config,
+                    "status": t.status,
+                    "iterations": t.iteration,
+                    "best_score": raw(t.best_score),
+                } for t in analysis.trials]
+                state = {
+                    "status": "done",
+                    "ended_at": time.time(),
+                    "best_config": analysis.best_config,
+                    "best_score": raw(analysis.best_trial.best_score),
+                    "n_trials": len(trials),
+                    "trials": trials,
+                }
         except BaseException as e:
             self._set_state_if_owner(name, my_lock,
                                      {"status": "failed",
@@ -304,6 +294,13 @@ class ExperimentManager:
     def _run_via_service(self, name: str,
                          spec: Dict[str, Any]) -> Dict[str, Any]:
         from tosem_tpu.tune.providers import SERVICES, run_with_service
+        if spec.get("scheduler", "fifo") != "fifo":
+            # the service loop observes FINAL metrics only; silently
+            # dropping an early-stopping scheduler would be a lie
+            raise ValueError(
+                "training_service runs support scheduler='fifo' only "
+                f"(got {spec['scheduler']!r}); use the in-process path "
+                "for early-stopping schedulers")
         svc_cls = SERVICES[spec["training_service"]]
         service = svc_cls(
             max_concurrent=int(spec.get("max_concurrent", 4)))
@@ -316,7 +313,8 @@ class ExperimentManager:
                 max_iterations=int(spec.get("max_iterations", 100)),
                 search_alg=SEARCHERS[spec.get("search", "random")](
                     **dict(spec.get("search_args", {}))),
-                max_in_flight=int(spec.get("max_concurrent", 4)))
+                max_in_flight=int(spec.get("max_concurrent", 4)),
+                timeout_s=float(spec.get("service_timeout_s", 600.0)))
         finally:
             service.shutdown()
         return {

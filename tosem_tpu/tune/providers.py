@@ -156,6 +156,7 @@ class SubprocessService(TrainingService):
     def __init__(self, max_concurrent: int = 4,
                  workdir: Optional[str] = None):
         self._max = max_concurrent
+        self._own_dir = workdir is None
         self._dir = workdir or tempfile.mkdtemp(prefix="tosem_trials_")
         self._jobs: Dict[str, TrialJob] = {}
         self._procs: Dict[str, subprocess.Popen] = {}
@@ -241,12 +242,16 @@ class SubprocessService(TrainingService):
         for p in procs:
             if p.poll() is None:
                 p.kill()
+        if self._own_dir:        # a dir we made, we clean (no temp litter)
+            import shutil
+            shutil.rmtree(self._dir, ignore_errors=True)
 
 
 class NodeAgentService(TrainingService):
     """Trials on remote node agents (cluster/node.py) — the remote
-    training service. Placement: least-loaded agent; results return over
-    the RPC channel. Gang-safe: pass ``reservation`` (a
+    training service. Placement: round-robin across agents (the agent's
+    own admission gate queues beyond its pool); results return over the
+    RPC channel. Gang-safe: pass ``reservation`` (a
     :class:`~tosem_tpu.cluster.gang.GangReservation`) to run inside a
     placement-group bundle."""
 
