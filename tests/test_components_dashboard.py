@@ -206,3 +206,89 @@ class TestDashboardCharts:
             "deployments": [], "results": [],
         }
         assert "<svg" not in render_html(snap)
+
+
+class TestChannelQos:
+    """QoS tiers on component channels (cyber QosProfile: history depth
+    + reliability; best_effort = KEEP_LAST sensor-stream semantics)."""
+
+    def _rt_and_sink(self, qos=None):
+        from tosem_tpu.dataflow import (ChannelQos, Component,
+                                        ComponentRuntime)
+        rt = ComponentRuntime()
+        got = []
+
+        class Sink(Component):
+            def __init__(self):
+                super().__init__("sink", ["ch"])
+
+            def proc(self, msg):
+                got.append(msg)
+
+        rt.add(Sink())
+        w = rt.writer("ch", qos=qos)
+        return rt, w, got
+
+    def test_reliable_delivers_everything(self):
+        rt, w, got = self._rt_and_sink()
+        for i in range(5):
+            w(i, latency=0.1)
+        rt.run_until(1.0)
+        assert got == [0, 1, 2, 3, 4]
+        assert rt.drop_counts() == {}
+
+    def test_best_effort_keeps_last_depth(self):
+        from tosem_tpu.dataflow import ChannelQos
+        rt, w, got = self._rt_and_sink(
+            ChannelQos(depth=2, reliability="best_effort"))
+        for i in range(5):          # 5 writes before any delivery fires
+            w(i, latency=0.5)
+        rt.run_until(1.0)
+        assert got == [3, 4]        # oldest three superseded
+        assert rt.drop_counts()["ch"] == 3
+
+    def test_history_buffer_depth(self):
+        from tosem_tpu.dataflow import ChannelQos
+        rt, w, got = self._rt_and_sink(ChannelQos(depth=3))
+        for i in range(6):
+            w(i, latency=0.01 * (i + 1))
+        rt.run_until(1.0)
+        assert got == list(range(6))          # reliable: no drops
+        assert rt.history("ch") == [3, 4, 5]  # last depth=3, oldest first
+
+    def test_qos_validation(self):
+        from tosem_tpu.dataflow import ChannelQos
+        import pytest as _pytest
+        with _pytest.raises(ValueError):
+            ChannelQos(depth=0)
+        with _pytest.raises(ValueError):
+            ChannelQos(reliability="sometimes")
+
+    def test_best_effort_messages_keep_own_latency(self):
+        """Regression: each surviving best-effort message arrives at ITS
+        modeled latency — a later short-latency write must not smuggle an
+        earlier message in ahead of its transport time."""
+        from tosem_tpu.dataflow import ChannelQos
+        rt, w, got = self._rt_and_sink(
+            ChannelQos(depth=2, reliability="best_effort"))
+        w("slow", latency=10.0)
+        w("fast", latency=0.1)
+        rt.run_until(1.0)
+        assert got == ["fast"]          # slow hasn't arrived yet
+        rt.run_until(20.0)
+        assert got == ["fast", "slow"]  # and arrives at its own time
+
+    def test_best_effort_depth_shrink_trims_backlog(self):
+        """Regression: re-pinning a smaller depth trims the whole
+        over-depth backlog, not one message per subsequent write."""
+        from tosem_tpu.dataflow import ChannelQos
+        rt, w, got = self._rt_and_sink(
+            ChannelQos(depth=5, reliability="best_effort"))
+        for i in range(5):
+            w(i, latency=1.0)
+        w2 = rt.writer("ch", qos=ChannelQos(depth=1,
+                                            reliability="best_effort"))
+        w2(99, latency=1.0)
+        rt.run_until(2.0)
+        assert got == [99]
+        assert rt.drop_counts()["ch"] == 5

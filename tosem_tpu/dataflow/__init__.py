@@ -8,11 +8,12 @@ Ray Streaming's stage dataflow with credit-based backpressure
 are runtime actors (stateful, restartable) or stateless task fans; the
 driver owns routing, credits, and end-of-stream propagation.
 """
-from tosem_tpu.dataflow.components import (Component, ComponentContext,
+from tosem_tpu.dataflow.components import (ChannelQos, Component,
+                                           ComponentContext,
                                            ComponentRuntime, TimerComponent)
 from tosem_tpu.dataflow.graph import (Stage, StreamGraph, keyed, rebalance,
                                       broadcast)
 
 __all__ = ["StreamGraph", "Stage", "keyed", "rebalance", "broadcast",
            "Component", "TimerComponent", "ComponentRuntime",
-           "ComponentContext"]
+           "ComponentContext", "ChannelQos"]

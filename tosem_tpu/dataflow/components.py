@@ -16,6 +16,7 @@ way Cyber's topology manager exposes them.
 """
 from __future__ import annotations
 
+import collections
 import heapq
 import itertools
 from dataclasses import dataclass
@@ -62,13 +63,38 @@ class TimerComponent:
         raise NotImplementedError
 
 
+@dataclass(frozen=True)
+class ChannelQos:
+    """Per-channel QoS profile (cyber transport's QosProfile: history
+    depth + reliability tier).
+
+    ``reliability="reliable"`` delivers every message; ``"best_effort"``
+    keeps at most ``depth`` undelivered messages per channel (KEEP_LAST:
+    under write pressure the OLDEST pending message is dropped, the
+    sensor-stream semantics — a fresher lidar frame supersedes a stale
+    one). ``depth`` also sizes the reader-side history buffer
+    (:meth:`ComponentRuntime.history`)."""
+    depth: int = 1
+    reliability: str = "reliable"
+
+    def __post_init__(self):
+        if self.depth < 1:
+            raise ValueError("qos depth must be >= 1")
+        if self.reliability not in ("reliable", "best_effort"):
+            raise ValueError(f"unknown reliability {self.reliability!r}")
+
+
+_DEFAULT_QOS = ChannelQos()
+
+
 @dataclass
 class ComponentContext:
     """Handed to components at init: write access + the current clock."""
     runtime: "ComponentRuntime"
 
-    def writer(self, channel: str) -> Callable[[Any], None]:
-        return self.runtime.writer(channel, owner="component")
+    def writer(self, channel: str,
+               qos: Optional[ChannelQos] = None) -> Callable[[Any], None]:
+        return self.runtime.writer(channel, owner="component", qos=qos)
 
     @property
     def now(self) -> float:
@@ -92,20 +118,65 @@ class ComponentRuntime:
         self._latest: Dict[str, Any] = {}          # channel -> last message
         self._subs: Dict[str, List[Component]] = {}
         self._stats: Dict[str, int] = {}
+        self._qos: Dict[str, ChannelQos] = {}
+        self._pending: Dict[str, Any] = {}         # best-effort queues
+        self._history: Dict[str, Any] = {}         # channel -> deque
+        self._drops: Dict[str, int] = {}
 
     # ------------------------------------------------------- channels
 
-    def writer(self, channel: str, owner: str = "external"
-               ) -> Callable[[Any], None]:
+    def set_qos(self, channel: str, qos: ChannelQos) -> None:
+        """Pin a channel's QoS profile (cyber's reader/writer QosProfile;
+        here per-channel, single-controller collapse)."""
+        self._qos[channel] = qos
+
+    def qos(self, channel: str) -> ChannelQos:
+        return self._qos.get(channel, _DEFAULT_QOS)
+
+    def writer(self, channel: str, owner: str = "external",
+               qos: Optional[ChannelQos] = None) -> Callable[[Any], None]:
         """Create a channel writer (``node->CreateWriter`` analog);
         registers the channel for discovery."""
         self.registry.register("channel", channel,
                                {"owner": owner}, unique=False)
+        if qos is not None:
+            self.set_qos(channel, qos)
 
         def write(message: Any, *, latency: float = 0.0) -> None:
-            self._push(self.now + max(latency, 0.0),
-                       lambda: self._deliver(channel, message))
+            q = self.qos(channel)
+            when = self.now + max(latency, 0.0)
+            if q.reliability == "best_effort":
+                # KEEP_LAST by write order, but each surviving message
+                # still delivers at ITS OWN latency: pending is an
+                # insertion-ordered id→message map; a dropped id's event
+                # fires into nothing
+                pend = self._pending.setdefault(channel, {})
+                mid = next(self._seq)
+                pend[mid] = message
+                while len(pend) > q.depth:    # drop the oldest-written
+                    pend.pop(next(iter(pend)))
+                    self._drops[channel] = self._drops.get(channel, 0) + 1
+                self._push(when,
+                           lambda: self._deliver_token(channel, mid))
+            else:
+                self._push(when, lambda: self._deliver(channel, message))
         return write
+
+    _MISSING = object()
+
+    def _deliver_token(self, channel: str, mid: int) -> None:
+        msg = self._pending.get(channel, {}).pop(mid, self._MISSING)
+        if msg is not self._MISSING:   # else: superseded before arrival
+            self._deliver(channel, msg)
+
+    def history(self, channel: str) -> List[Any]:
+        """Last ``qos(channel).depth`` DELIVERED messages, oldest first
+        (the reader-side history buffer of a depth-k subscription)."""
+        return list(self._history.get(channel, ()))
+
+    def drop_counts(self) -> Dict[str, int]:
+        """Messages dropped per best-effort channel (KEEP_LAST policy)."""
+        return dict(self._drops)
 
     def channels(self) -> List[str]:
         return self.registry.list("channel")
@@ -146,6 +217,12 @@ class ComponentRuntime:
 
     def _deliver(self, channel: str, message: Any) -> None:
         self._latest[channel] = message
+        hist = self._history.get(channel)
+        depth = self.qos(channel).depth
+        if hist is None or hist.maxlen != depth:
+            hist = collections.deque(hist or (), maxlen=depth)
+            self._history[channel] = hist
+        hist.append(message)
         for comp in self._subs.get(channel, []):
             fused = [self._latest.get(ch) for ch in comp.channels[1:]]
             comp.proc(message, *fused)
