@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# The TPU legs deferred while the axon tunnel was down (round 4).
+# Run when `tunnel_alive()` is True; each step is independent.
+#
+#   1. full north-star bench (kernel autotune + roofline bounds +
+#      conv1_s2d row) -> results/tpu_full.csv, REPORT.md, BENCH json
+#   2. on-chip C++ PJRT driver execute (the one standing test skip)
+#   3. ResNet convergence release gate (PASS/FAIL row in results/)
+set -euo pipefail
+cd "$(dirname "$0")"
+
+python - <<'EOF'
+from tosem_tpu.utils.net import tunnel_alive
+import sys
+if not tunnel_alive():
+    print("axon tunnel is DOWN - aborting (nothing would run)")
+    sys.exit(1)
+print("tunnel alive")
+EOF
+
+echo "== [1/3] north-star bench"
+python bench.py
+
+echo "== [2/3] on-chip PJRT driver execute"
+python -m pytest tests/test_pjrt_driver.py -q
+
+echo "== [3/3] ResNet convergence gate"
+python -m tosem_tpu.cli --device=tpu --config=resnet_train \
+    --steps=20 --converge_steps=600 --target_acc=0.6 \
+    --results_csv=results/convergence.csv
+
+echo "== TPU follow-up complete; commit results/ + REPORT.md"
