@@ -126,3 +126,57 @@ class TestAttention:
     def test_mha_rejects_bad_heads(self):
         with pytest.raises(ValueError):
             MultiHeadAttention(10, 3)
+
+
+class TestFlashAttnDispatch:
+    def test_bert_with_flash_matches_xla_path(self):
+        """The full BERT encoder with attn_fn=flash_attn_fn() must match
+        the default XLA attention path (mask-free shapes)."""
+        from tosem_tpu.models.bert import Bert, BertConfig
+        from tosem_tpu.nn.attention import flash_attn_fn
+        cfg = BertConfig(vocab_size=64, max_len=128, dim=64, heads=2,
+                         layers=2, mlp_dim=128, dropout=0.0,
+                         dtype="float32")
+        model = Bert(cfg)
+        vs = model.init(jax.random.PRNGKey(0))
+        ids = jax.random.randint(jax.random.PRNGKey(1), (2, 128), 0,
+                                 cfg.vocab_size)
+        ref, _ = model.apply(vs, ids)
+        got, _ = model.apply(vs, ids, attn_fn=flash_attn_fn())
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=2e-4, rtol=2e-4)
+
+    def test_flash_fn_falls_back_on_mask(self):
+        from tosem_tpu.nn.attention import (dot_product_attention,
+                                            flash_attn_fn)
+        q = jax.random.normal(jax.random.PRNGKey(0), (1, 64, 2, 16))
+        mask = jnp.ones((1, 1, 64, 64), bool).at[:, :, :, 32:].set(False)
+        got = flash_attn_fn()(q, q, q, mask)
+        ref = dot_product_attention(q, q, q, mask)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=1e-6)
+
+    def test_flash_fn_fallback_preserves_causality(self):
+        """Regression: causal + padding mask must fold causality into
+        the fallback mask, never silently go bidirectional."""
+        from tosem_tpu.nn.attention import (dot_product_attention,
+                                            flash_attn_fn)
+        q = jax.random.normal(jax.random.PRNGKey(2), (1, 64, 2, 16))
+        pad = jnp.ones((1, 1, 64, 64), bool).at[:, :, :, 48:].set(False)
+        causal = jnp.tril(jnp.ones((64, 64), bool))[None, None]
+        got = flash_attn_fn(causal=True)(q, q, q, pad)
+        ref = dot_product_attention(q, q, q,
+                                    jnp.logical_and(pad, causal))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=1e-6)
+
+    def test_flash_fn_odd_lengths_fall_back(self):
+        """Regression: T=192 (not a 128-block multiple) must take the
+        XLA path instead of raising inside the kernel."""
+        from tosem_tpu.nn.attention import (dot_product_attention,
+                                            flash_attn_fn)
+        q = jax.random.normal(jax.random.PRNGKey(3), (1, 192, 2, 16))
+        got = flash_attn_fn()(q, q, q, None)
+        ref = dot_product_attention(q, q, q)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)

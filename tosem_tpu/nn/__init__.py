@@ -2,5 +2,6 @@ from tosem_tpu.nn.core import Module, Sequential, Lambda, variables
 from tosem_tpu.nn.layers import (Dense, Conv2D, BatchNorm, LayerNorm,
                                  Embedding, Dropout, max_pool,
                                  avg_pool_global, gelu, relu)
-from tosem_tpu.nn.attention import MultiHeadAttention, dot_product_attention
+from tosem_tpu.nn.attention import (MultiHeadAttention,
+                                    dot_product_attention, flash_attn_fn)
 from tosem_tpu.nn.moe import MoELayer, moe_rules, shard_moe_params

@@ -34,6 +34,32 @@ def dot_product_attention(q, k, v, mask: Optional[jax.Array] = None, *,
                       precision=PRECISION[precision])
 
 
+def flash_attn_fn(causal: bool = False, precision: str = "default"):
+    """An ``attn_fn`` for :class:`MultiHeadAttention` that routes
+    eligible shapes through the Pallas flash kernel (bf16-native MXU
+    path) and falls back to the XLA path otherwise — when a padding mask
+    is present (flash supports causal/none masks only) or when the
+    sequence length does not divide into kernel blocks. The fallback
+    preserves causality (folded into the mask) and the requested matmul
+    precision, so swapping ``attn_fn`` never changes semantics, only the
+    kernel. Thread it through a model's
+    ``apply(..., attn_fn=flash_attn_fn())`` — e.g. BERT-base on TPU."""
+    from tosem_tpu.ops.flash_attention import (DEFAULT_BK, DEFAULT_BQ,
+                                               mha_flash_attention)
+
+    def core(q, k, v, mask):
+        Tq, Tk = q.shape[1], k.shape[1]
+        blocks_ok = (Tq % min(DEFAULT_BQ, Tq) == 0
+                     and Tk % min(DEFAULT_BK, Tk) == 0)
+        if mask is None and blocks_ok:
+            return mha_flash_attention(q, k, v, causal=causal)
+        if causal:
+            cm = jnp.tril(jnp.ones((Tq, Tk), bool))[None, None]
+            mask = cm if mask is None else jnp.logical_and(mask, cm)
+        return dot_product_attention(q, k, v, mask, precision=precision)
+    return core
+
+
 class MultiHeadAttention(Module):
     def __init__(self, dim: int, heads: int, *, dropout: float = 0.0,
                  dtype=jnp.float32, precision: str = "default"):
