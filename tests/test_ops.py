@@ -50,9 +50,11 @@ class TestConv:
         assert conv2d(x, w, stride=2).shape == (1, 8, 8, 8)
 
     def test_sweep_table(self):
-        assert len(RESNET50_CONV_SWEEP) == 13
+        # 13 distinct ResNet-50 layer shapes + the conv1_s2d stem variant
+        assert len(RESNET50_CONV_SWEEP) == 14
         ids = [s.bench_id for s in RESNET50_CONV_SWEEP]
         assert len(set(ids)) == len(ids)
+        assert any("conv1_s2d" in i for i in ids)
 
     def test_bench_emits_row(self):
         spec = ConvSpec("tiny", 1, 8, 8, 4, 8, 3, 3)
