@@ -221,3 +221,62 @@ class TestTuneBundles:
                        for w in workers)
         finally:
             rt.shutdown()
+
+
+@pytest.mark.slow
+class TestPlacementStress:
+    def test_randomized_concurrent_gangs_converge_clean(self):
+        """Invariant fuzz (the sanitizer-stress idea at the scheduler
+        level): many threads loop acquiring random-size gangs and
+        running tagged tasks. No deadlock (everything joins), never an
+        over-reservation, and the pool ends fully released."""
+        import random
+
+        rt.init(num_workers=4)
+        try:
+            f = rt.remote(_sleep_ms)
+            errors = []
+
+            def worker(seed):
+                rng = random.Random(seed)
+                try:
+                    for _ in range(6):
+                        n = rng.randint(1, 3)
+                        with rt.placement_group(n, timeout=60) as pg:
+                            rtm = rt.api._runtime
+                            with rtm.lock:    # consistent snapshot
+                                mine = sum(
+                                    1 for w in rtm.task_workers
+                                    if w.reserved_by == pg._pg_id)
+                                total = sum(
+                                    1 for w in rtm.task_workers
+                                    if w.reserved_by is not None)
+                                booked = sum(
+                                    rec["n_slots"] for rec in
+                                    rtm.placement_groups.values())
+                            # this gang holds EXACTLY its slots, and the
+                            # pool-wide reservation count equals the sum
+                            # of all active groups (no double-booking)
+                            assert mine == n, (mine, n)
+                            assert total == booked, (total, booked)
+                            refs = [f.options(placement_group=pg)
+                                    .remote(2) for _ in range(n)]
+                            assert rt.get(refs, timeout=60) == [2] * n
+                except BaseException as e:   # surface, don't swallow
+                    errors.append(e)
+
+            threads = [threading.Thread(target=worker, args=(i,))
+                       for i in range(4)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=240)
+            assert not any(t.is_alive() for t in threads), "deadlock"
+            assert not errors, errors
+            workers = rt.api._runtime.task_workers
+            assert all(w.reserved_by is None and not w.parked
+                       for w in workers)
+            assert rt.api._runtime._pg_queue == []
+            assert rt.api._runtime.placement_groups == {}
+        finally:
+            rt.shutdown()

@@ -141,6 +141,14 @@ def run_microbenchmarks(num_workers: int = 4, trials: int = 3,
     m, s = _timeit("nn_actor_async", nn_actor_async, trials, min_s)
     record("n_n_actor_calls_async", "n:n actor calls async", m, s)
 
+    # --- placement groups -------------------------------------------------
+    def pg_cycle():
+        for _ in range(100):
+            rt.placement_group(1).remove()
+        return 100
+    m, s = _timeit("pg_cycle", pg_cycle, trials, min_s)
+    record("placement_group_cycle", "placement group create/remove", m, s)
+
     if not quiet:
         for ln in lines:
             print(ln)
