@@ -1,0 +1,157 @@
+"""Planning-lite: piecewise-jerk path/speed as batched linear algebra.
+
+Role model: the reference's DP+QP on-road planner
+(``modules/planning/tasks/optimizers/piecewise_jerk_path/``,
+``piecewise_jerk_speed/``, OSQP-backed). Here the QPs run as jitted
+penalty-method solves and the DP pass-side decisions are a vmap batch.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tosem_tpu.models.planning import (EMPTY_OBSTACLE, corridor_candidates,
+                                       plan_path, plan_speed,
+                                       solve_corridor)
+
+
+def _pad(rows, k=3):
+    rows = list(rows)
+    while len(rows) < k:
+        rows.append(EMPTY_OBSTACLE)
+    return jnp.asarray(rows, jnp.float32)
+
+
+class TestPath:
+    def test_free_road_stays_centered(self):
+        l, cost, _ = plan_path(_pad([]), n=64)
+        assert float(jnp.max(jnp.abs(l))) < 0.05
+        assert float(cost) < 1.0
+
+    def test_single_obstacle_is_avoided_smoothly(self):
+        # box blocking the right half of the lane at s in [20, 30]
+        obs = _pad([(20.0, 30.0, -1.75, 0.5)])
+        l, cost, best = plan_path(obs, n=64)
+        s = np.arange(64) * 1.0
+        inside = (s >= 20) & (s <= 30)
+        lane_half = 1.75
+        assert np.all(np.asarray(l)[inside] >= 0.5 - 1e-3)   # passes left
+        assert np.all(np.abs(np.asarray(l)) <= lane_half + 1e-3)
+        # smooth: bounded third difference (comfort, the jerk term)
+        dddl = np.diff(np.asarray(l), 3)
+        assert np.max(np.abs(dddl)) < 0.2
+        # returns toward center after the obstacle
+        assert abs(float(l[-1])) < 0.3
+
+    def test_pass_side_follows_the_gap(self):
+        # obstacle hugging the LEFT edge → the free gap is on the right
+        obs = _pad([(20.0, 30.0, 0.2, 1.75)])
+        l, _, _ = plan_path(obs, n=64)
+        s = np.arange(64) * 1.0
+        inside = (s >= 20) & (s <= 30)
+        assert np.all(np.asarray(l)[inside] <= 0.2 + 1e-3)   # passes right
+
+    def test_two_obstacles_weave(self):
+        obs = _pad([(15.0, 22.0, -1.75, 0.0),      # right half blocked
+                    (35.0, 42.0, 0.0, 1.75)])      # then left half
+        l, cost, _ = plan_path(obs, n=64)
+        s = np.arange(64) * 1.0
+        la = np.asarray(l)
+        assert np.all(la[(s >= 15) & (s <= 22)] >= -1e-3)
+        assert np.all(la[(s >= 35) & (s <= 42)] <= 1e-3)
+        assert float(cost) < 1e6                   # a feasible weave won
+
+    def test_batched_candidates_and_argmin(self):
+        obs = _pad([(20.0, 30.0, -1.75, 0.5)], k=2)
+        lowers, uppers = corridor_candidates(64, 1.0, 1.75, obs)
+        assert lowers.shape == (4, 64)             # 2^K candidates
+        # the blocked-right candidate(s) must cost more than pass-left
+        paths, costs = jax.vmap(
+            lambda lo, hi: solve_corridor(lo, hi, ds=1.0, init=(0.0, 0.0)))(
+                lowers, uppers)
+        assert float(jnp.min(costs)) < float(jnp.max(costs))
+
+    def test_initial_state_anchoring(self):
+        l, _, _ = plan_path(_pad([]), n=64, init=(0.8, -0.1))
+        assert abs(float(l[0]) - 0.8) < 1e-2
+        assert abs(float(l[1] - l[0]) - (-0.1)) < 2e-2   # ds = 1
+
+    def test_fully_blocked_station_reports_infeasible_cost(self):
+        # overlapping obstacles spilling past both lane edges: every
+        # pass-side corridor is empty somewhere → all candidates
+        # infeasible, and the planner says so instead of pretending
+        obs = _pad([(20.0, 30.0, -1.8, 0.1),
+                    (20.0, 30.0, -0.1, 1.8)])
+        _, cost, _ = plan_path(obs, n=64)
+        assert not np.isfinite(float(cost))
+
+
+class TestSpeed:
+    def test_cruise_tracks_reference_speed(self):
+        s, _ = plan_speed(jnp.float32(1e9), n_t=40, dt=0.25,
+                          v_init=8.0, v_ref=8.0)
+        s = np.asarray(s)
+        v = np.diff(s) / 0.25
+        assert abs(v.mean() - 8.0) < 0.3
+        assert np.all(v >= -1e-3)                  # never reverses
+
+    def test_stop_fence_is_respected(self):
+        s, cost = plan_speed(jnp.float32(30.0), n_t=40, dt=0.25,
+                             v_init=8.0, v_ref=8.0)
+        s = np.asarray(s)
+        assert np.isfinite(float(cost))
+        assert s.max() <= 30.0 + 0.1               # stops before the fence
+        v = np.diff(s) / 0.25
+        assert np.all(v >= -1e-2)
+        assert v[-1] < 1.0                         # actually slowing/stopped
+        a = np.diff(v) / 0.25
+        assert np.max(np.abs(a)) < 8.0             # no slam-stop
+
+    def test_profiles_jit_batch(self):
+        """The planner's TPU story: many stop hypotheses in one vmap."""
+        fences = jnp.asarray([15.0, 30.0, 60.0, 1e9], jnp.float32)
+        profs, costs = jax.vmap(
+            lambda f: plan_speed(f, n_t=40, dt=0.25))(fences)
+        assert np.all(np.isfinite(np.asarray(costs)))
+        ends = np.asarray(profs[:, -1])
+        assert ends[0] <= 15.1 and ends[1] <= 30.1
+        assert ends[3] > ends[1] > ends[0]
+
+
+class TestPerceptionHandoff:
+    def test_tracks_to_path(self):
+        """Perception tracks → Frenet obstacles → planned path: the
+        detect→track→plan pipeline end (onboard flow, minimal)."""
+        from tosem_tpu.models.perception import Track
+        from tosem_tpu.models.planning import obstacles_from_tracks
+        tracks = [Track(track_id=1,
+                        box=np.array([22.0, -1.75, 28.0, 0.4]),
+                        score=0.9)]
+        obs = obstacles_from_tracks(tracks, max_k=3)
+        assert obs.shape == (3, 4)
+        l, cost, _ = plan_path(obs, n=48)
+        s = np.arange(48) * 1.0
+        inside = (s >= 22) & (s <= 28)
+        assert np.all(np.asarray(l)[inside] >= 0.4 - 1e-3)
+        assert np.isfinite(float(cost))
+
+
+    def test_impossible_stop_is_flagged_by_cost(self):
+        """A fence inside braking distance cannot be honored; the cost
+        must carry the violation instead of silently pretending."""
+        s_ok, c_ok = plan_speed(jnp.float32(60.0), n_t=40, dt=0.25,
+                                v_init=8.0, v_ref=8.0)
+        s_bad, c_bad = plan_speed(jnp.float32(1.0), n_t=40, dt=0.25,
+                                  v_init=8.0, v_ref=8.0)
+        assert float(c_bad) > 10 * float(c_ok)
+
+    def test_nearest_tracks_kept_under_truncation(self):
+        from tosem_tpu.models.perception import Track
+        from tosem_tpu.models.planning import obstacles_from_tracks
+        far = [Track(track_id=i, box=np.array([40.0 + i, -1.0,
+                                               45.0 + i, 1.0]), score=0.5)
+               for i in range(3)]
+        near = Track(track_id=9, box=np.array([10.0, -1.0, 15.0, 1.0]),
+                     score=0.9)
+        obs = obstacles_from_tracks(far + [near], max_k=3)
+        assert float(obs[:, 0].min()) == 10.0   # the near box survived

@@ -6,3 +6,6 @@ from tosem_tpu.models.bert_pipeline import (make_bert_pipeline_fn,
 from tosem_tpu.models.pointpillars import (PillarFeatureNet, PillarGrid,
                                            PointPillarsDetector, device_nms,
                                            voxelize)
+from tosem_tpu.models.planning import (plan_path, plan_speed,
+                                       obstacles_from_tracks,
+                                       solve_corridor)

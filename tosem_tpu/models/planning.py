@@ -1,0 +1,238 @@
+"""Planning-lite — piecewise-jerk path & speed optimization, TPU-first.
+
+The reference's on-road planner optimizes a lateral path l(s) and a
+speed profile s(t) as QPs over discretized stations
+(``modules/planning/tasks/optimizers/piecewise_jerk_path/
+piecewise_jerk_path_optimizer.cc``, ``piecewise_jerk_speed/``, backed by
+``modules/planning/math/piecewise_jerk/`` + OSQP). TPU redesign with the
+same state formulation — decision variables are the SECOND derivative
+sequence, the profile is its double integration from the anchored
+initial state (so no stiff anchor penalties and a well-conditioned
+float32 system) — solved by a fixed-iteration **penalty method**: each
+iteration is one dense symmetric solve, so the whole planner is
+jittable with static shapes, and candidate corridors (pass-left/
+pass-right per obstacle, the DP part of the reference's DP+QP split)
+are evaluated **in one batch via vmap** and argmin-selected. Planning
+as batched linear algebra on the MXU instead of a host QP solver in a
+loop.
+
+Everything is Frenet-frame: stations ``s`` along the reference line,
+lateral offset ``l`` (left positive). Obstacles are static corridor
+constraints ``(s0, s1, l0, l1)``; pad with ``EMPTY_OBSTACLE`` rows to
+keep shapes static.
+"""
+from __future__ import annotations
+
+import functools
+import itertools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+EMPTY_OBSTACLE = (-1.0, -2.0, 0.0, 0.0)   # s0 > s1 → overlaps nothing
+
+
+def _integration_maps(n: int, h: float):
+    """x = X0 + A a  with decision vars a = x'' at the first n-2 knots.
+
+    Trapezoid-free simple scheme: x'_{k+1} = x'_k + a_k h,
+    x_{k+1} = x_k + x'_k h + a_k h²/2. Returns (A [n, n-2], v_map
+    [n-1, n-2]) mapping a to positions (minus the init-state affine
+    part) and to knot velocities x'_1..x'_{n-1}."""
+    m = n - 2
+    # velocity after k steps: x'_k = x'_0 + h * sum_{j<k} a_j  (k=1..n-1)
+    vmap_ = np.tril(np.ones((n - 1, n - 1)))[:, :m] * h
+    # position: x_k = x_0 + k h x'_0 + sum_{j<k} (h x'_j dt part)
+    a_map = np.zeros((n, m))
+    for k in range(1, n):
+        for j in range(min(k, m)):
+            # a_j contributes h²/2 at its own step plus h² per later step
+            a_map[k, j] = (h * h / 2.0) + (k - 1 - j) * h * h
+    return (jnp.asarray(a_map, jnp.float32),
+            jnp.asarray(vmap_, jnp.float32))
+
+
+@functools.partial(jax.jit, static_argnames=("ds", "n_iter"))
+def solve_corridor(lower: jax.Array, upper: jax.Array, *, ds: float,
+                   init: Tuple[float, float],
+                   w_ref: float = 0.2, w_d1: float = 0.5,
+                   w_d2: float = 4.0, w_d3: float = 10.0,
+                   n_iter: int = 8) -> Tuple[jax.Array, jax.Array]:
+    """Smoothest profile inside [lower, upper] with anchored start.
+
+    Returns (profile, cost). Decision vars: the curvature sequence
+    a = l'' (the piecewise-jerk state form); l is its double
+    integration from ``init`` — the start constraints are exact by
+    construction. Penalty iterations activate quadratic walls on the
+    bounds the previous iterate violated. ``cost`` adds a large
+    violation term so an infeasible corridor (lower > upper anywhere)
+    loses any argmin over candidates.
+    """
+    n = lower.shape[0]
+    m = n - 2
+    A, V = _integration_maps(n, ds)
+    l0, dl0 = init
+    base = l0 + dl0 * ds * jnp.arange(n)          # affine init part
+    mid = 0.5 * (lower + upper)
+    d1a = jnp.asarray(np.eye(m), jnp.float32)     # a itself = l''
+    d3 = (jnp.asarray(np.diff(np.eye(m), axis=0), jnp.float32)
+          / ds)                                   # jerk = diff(a)/ds
+    # objective: w_ref ||base + A a - mid||² + w_d1 ||dl0 + V a||²
+    #          + w_d2 ||a||² + w_d3 ||D a||²
+    h_base = (w_ref * A.T @ A + w_d1 * V.T @ V + w_d2 * d1a
+              + w_d3 * d3.T @ d3 + 1e-6 * jnp.eye(m))
+    b_base = w_ref * A.T @ (mid - base) - w_d1 * V.T @ jnp.full(
+        (n - 1,), dl0)
+
+    w_pen = 1e4
+
+    def profile(a):
+        return base + A @ a
+
+    def body(_, a):
+        x = profile(a)
+        viol_lo = (x < lower).astype(x.dtype)
+        viol_hi = (x > upper).astype(x.dtype)
+        W = viol_lo + viol_hi
+        target = viol_lo * lower + viol_hi * upper
+        h = h_base + w_pen * A.T @ (W[:, None] * A)
+        b = b_base + w_pen * A.T @ (W * (target - base))
+        return jnp.linalg.solve(h, b)
+
+    a0 = jnp.linalg.solve(h_base, b_base)
+    a = jax.lax.fori_loop(0, n_iter, body, a0)
+    x = profile(a)
+
+    viol = jnp.maximum(lower - x, 0.0) + jnp.maximum(x - upper, 0.0)
+    infeasible = jnp.any(lower > upper)
+    cost = (w_ref * jnp.sum((x - mid) ** 2)
+            + w_d1 * jnp.sum((dl0 + V @ a) ** 2)
+            + w_d2 * jnp.sum(a ** 2)
+            + w_d3 * jnp.sum((d3 @ a) ** 2)
+            + 1e4 * jnp.sum(viol ** 2)
+            + jnp.where(infeasible, jnp.inf, 0.0))
+    return x, cost
+
+
+def corridor_candidates(n: int, ds: float, lane_half: float,
+                        obstacles: jax.Array
+                        ) -> Tuple[jax.Array, jax.Array]:
+    """All pass-side assignments → batched (lower, upper) corridors.
+
+    ``obstacles``: [K, 4] rows (s0, s1, l0, l1); EMPTY_OBSTACLE rows are
+    inert. 2^K candidates (the DP decision per obstacle: pass left of it
+    or right of it), shapes static — this is the branch enumeration the
+    reference does with dynamic programming over a road graph
+    (``tasks/optimizers/road_graph/``), recast as one batched tensor op.
+    """
+    K = obstacles.shape[0]
+    s = jnp.arange(n) * ds
+    sides = jnp.asarray(list(itertools.product((0, 1), repeat=K)),
+                        jnp.float32)                    # [2^K, K] 1=left
+    s0, s1, l0, l1 = (obstacles[:, i] for i in range(4))
+    overlap = ((s[None, :] >= s0[:, None])
+               & (s[None, :] <= s1[:, None]))           # [K, n]
+
+    def bounds(side):                                   # side: [K]
+        # pass left of an obstacle → stay above its top edge l1;
+        # pass right → stay below its bottom edge l0
+        lo = jnp.where(overlap & (side[:, None] > 0.5),
+                       l1[:, None], -lane_half)
+        hi = jnp.where(overlap & (side[:, None] < 0.5),
+                       l0[:, None], lane_half)
+        return jnp.max(lo, axis=0), jnp.min(hi, axis=0)
+
+    lowers, uppers = jax.vmap(bounds)(sides)            # [2^K, n]
+    return lowers, uppers
+
+
+@functools.partial(jax.jit, static_argnames=("n", "ds", "lane_half"))
+def plan_path(obstacles: jax.Array, *, n: int = 64, ds: float = 1.0,
+              lane_half: float = 1.75,
+              init: Tuple[float, float] = (0.0, 0.0)):
+    """Best smooth lateral path around static obstacles.
+
+    Returns (l_profile [n], cost, candidate_index). All 2^K pass-side
+    corridors are solved IN ONE BATCH (vmap over :func:`solve_corridor`)
+    and the cheapest feasible one wins — the planner's hot loop is a
+    single batched dense solve on the MXU.
+    """
+    lowers, uppers = corridor_candidates(n, ds, lane_half, obstacles)
+    paths, costs = jax.vmap(
+        lambda lo, hi: solve_corridor(lo, hi, ds=ds, init=init))(
+        lowers, uppers)
+    best = jnp.argmin(costs)
+    return paths[best], costs[best], best
+
+
+@functools.partial(jax.jit, static_argnames=("n_t", "dt"))
+def plan_speed(stop_s: jax.Array, *, n_t: int = 40, dt: float = 0.25,
+               v_init: float = 8.0, v_ref: float = 8.0,
+               w_v: float = 1.0, w_a: float = 4.0, w_j: float = 4.0,
+               n_iter: int = 10) -> Tuple[jax.Array, jax.Array]:
+    """Speed profile s(t): track ``v_ref`` but stop before ``stop_s``.
+
+    Returns (s_profile, cost); cost carries a large fence/reverse
+    violation term, so a physically impossible stop (fence inside
+    braking distance) is detectable by the caller instead of silently
+    violated — symmetric with :func:`solve_corridor`.
+
+    The piecewise-jerk-speed QP in acceleration-state form: decision
+    vars a_k, s and v by integration from (0, v_init). Cost = velocity
+    tracking + accel + jerk; penalties keep s under the stop fence (the
+    ST-graph upper envelope) and v non-negative.
+    """
+    n = n_t
+    A, V = _integration_maps(n, dt)
+    m = n - 2
+    base = v_init * dt * jnp.arange(n)            # s from init state
+    d3 = jnp.asarray(np.diff(np.eye(m), axis=0), jnp.float32) / dt
+    h_base = (w_v * V.T @ V + w_a * jnp.eye(m) + w_j * d3.T @ d3
+              + 1e-6 * jnp.eye(m))
+    b_base = w_v * V.T @ jnp.full((n - 1,), v_ref - v_init)
+    upper = jnp.full((n,), stop_s)
+    w_pen = 1e4
+
+    def body(_, a):
+        s = base + A @ a
+        v = v_init + V @ a
+        viol_hi = (s > upper).astype(s.dtype)
+        viol_v = (v < 0.0).astype(v.dtype)
+        h = (h_base + w_pen * A.T @ (viol_hi[:, None] * A)
+             + w_pen * V.T @ (viol_v[:, None] * V))
+        b = (b_base + w_pen * A.T @ (viol_hi * (upper - base))
+             + w_pen * V.T @ (viol_v * (-v_init)))
+        return jnp.linalg.solve(h, b)
+
+    a0 = jnp.linalg.solve(h_base, b_base)
+    a = jax.lax.fori_loop(0, n_iter, body, a0)
+    sprof = base + A @ a
+    v = v_init + V @ a
+    viol = (jnp.maximum(sprof - upper, 0.0).sum()
+            + jnp.maximum(-v, 0.0).sum())
+    cost = (w_v * jnp.sum((v - v_ref) ** 2) + w_a * jnp.sum(a ** 2)
+            + w_j * jnp.sum((d3 @ a) ** 2) + 1e4 * viol ** 2)
+    return sprof, cost
+
+
+def obstacles_from_tracks(tracks, *, lane_half: float = 1.75,
+                          max_k: int = 3) -> jax.Array:
+    """Frenet obstacle rows from perception tracks (x→s, y→l of the box
+    centers/extents), padded with EMPTY_OBSTACLE to a static K — the
+    perception→planning handoff (``modules/planning/common/obstacle.cc``
+    role, minimal)."""
+    # nearest obstacles matter most: keep the max_k with the smallest
+    # s_start, never the first K in tracker-insertion order (a new box
+    # dead ahead must not be silently dropped)
+    rows = []
+    for t in sorted(tracks, key=lambda t: float(min(t.box[0], t.box[2])
+                                                ))[:max_k]:
+        x0, y0, x1, y1 = (float(v) for v in t.box[:4])
+        rows.append((min(x0, x1), max(x0, x1),
+                     max(min(y0, y1), -lane_half),
+                     min(max(y0, y1), lane_half)))
+    while len(rows) < max_k:
+        rows.append(EMPTY_OBSTACLE)
+    return jnp.asarray(rows, jnp.float32)
