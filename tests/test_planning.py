@@ -155,3 +155,19 @@ class TestPerceptionHandoff:
                      score=0.9)
         obs = obstacles_from_tracks(far + [near], max_k=3)
         assert float(obs[:, 0].min()) == 10.0   # the near box survived
+
+    def test_behind_ego_tracks_do_not_evict_ahead(self):
+        """Regression: behind-ego boxes (s < 0) must not consume the
+        max_k slots and let the planner drive through a box ahead."""
+        from tosem_tpu.models.perception import Track
+        from tosem_tpu.models.planning import obstacles_from_tracks
+        behind = [Track(track_id=i, box=np.array([-33.0 - i, -1.0,
+                                                  -25.0 - i, 1.0]),
+                        score=0.5) for i in range(3)]
+        ahead = Track(track_id=9, box=np.array([20.0, -1.75, 25.0, 0.4]),
+                      score=0.9)
+        obs = obstacles_from_tracks(behind + [ahead], max_k=3)
+        l, cost, _ = plan_path(obs, n=48)
+        s = np.arange(48) * 1.0
+        inside = (s >= 20) & (s <= 25)
+        assert np.all(np.asarray(l)[inside] >= 0.4 - 1e-3)

@@ -10,10 +10,12 @@ driver owns routing, credits, and end-of-stream propagation.
 """
 from tosem_tpu.dataflow.components import (ChannelQos, Component,
                                            ComponentContext,
-                                           ComponentRuntime, TimerComponent)
+                                           ComponentRuntime,
+                                           CoroutineComponent,
+                                           TimerComponent)
 from tosem_tpu.dataflow.graph import (Stage, StreamGraph, keyed, rebalance,
                                       broadcast)
 
 __all__ = ["StreamGraph", "Stage", "keyed", "rebalance", "broadcast",
            "Component", "TimerComponent", "ComponentRuntime",
-           "ComponentContext", "ChannelQos"]
+           "ComponentContext", "ChannelQos", "CoroutineComponent"]

@@ -47,6 +47,35 @@ class Component:
         raise NotImplementedError
 
 
+class CoroutineComponent:
+    """Cooperative multi-step task — the croutine role, deterministic.
+
+    The reference schedules userspace coroutines that yield at blocking
+    points (``cyber/croutine/croutine.h``: ``data_wait`` parks the
+    routine until its reader has data, the scheduler resumes it). TPU
+    collapse: :meth:`run` is a **generator**; every ``yield "channel"``
+    parks the routine until the next message on that channel arrives
+    (delivered as the value of the yield), and ``yield ("sleep", dt)``
+    parks it for virtual time. Cooperative scheduling on the same
+    deterministic (time, seq) event loop — no OS threads, fully
+    replayable, which is what croutines buy Apollo minus the context-
+    switch machinery XLA's async dispatch already makes unnecessary.
+
+    Subclass and override :meth:`run`; it is started at ``add()`` time
+    and retired when the generator returns.
+    """
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def on_init(self, ctx: "ComponentContext") -> None:
+        pass
+
+    def run(self, ctx: "ComponentContext"):
+        raise NotImplementedError
+        yield  # pragma: no cover  (marks this as a generator template)
+
+
 class TimerComponent:
     """Periodic component (``timer_component.h`` analog)."""
 
@@ -122,6 +151,7 @@ class ComponentRuntime:
         self._pending: Dict[str, Any] = {}         # best-effort queues
         self._history: Dict[str, Any] = {}         # channel -> deque
         self._drops: Dict[str, int] = {}
+        self._waiters: Dict[str, List[Any]] = {}   # croutine data_wait
 
     # ------------------------------------------------------- channels
 
@@ -184,7 +214,15 @@ class ComponentRuntime:
     # ----------------------------------------------------- components
 
     def add(self, comp: Any) -> None:
-        if isinstance(comp, TimerComponent):
+        if isinstance(comp, CoroutineComponent):
+            self._components.append(comp)
+            comp.on_init(ComponentContext(self))
+            gen = comp.run(ComponentContext(self))
+            # first advance runs as a scheduled event so startup order
+            # is (time, seq)-deterministic like everything else
+            self._push(self.now,
+                       lambda: self._advance_coroutine(comp, gen, None))
+        elif isinstance(comp, TimerComponent):
             self._components.append(comp)
             comp.on_init(ComponentContext(self))
             self._schedule_timer(comp, self.now + comp.interval)
@@ -215,6 +253,62 @@ class ComponentRuntime:
             self._stats[comp.name] = self._stats.get(comp.name, 0) + 1
         self._push(t, fire)
 
+    def _park(self, comp: "CoroutineComponent", gen, req,
+              mail=None) -> None:
+        """Park a routine per its yield request (channel / sleep)."""
+        if isinstance(req, str):        # data_wait: park with a mailbox
+            rec = {"comp": comp, "gen": gen,
+                   "mail": mail if mail is not None else
+                   collections.deque(), "scheduled": False}
+            self._waiters.setdefault(req, []).append(rec)
+            if rec["mail"]:             # leftovers: drain immediately
+                rec["scheduled"] = True
+                self._push(self.now,
+                           lambda: self._drain_waiter(req, rec))
+        elif (isinstance(req, tuple) and len(req) == 2
+                and req[0] == "sleep"):
+            self._push(self.now + max(float(req[1]), 0.0),
+                       lambda: self._advance_coroutine(comp, gen, None))
+        else:
+            raise TypeError(
+                f"coroutine {comp.name!r} yielded {req!r}; expected a "
+                "channel name or ('sleep', seconds)")
+
+    def _advance_coroutine(self, comp: "CoroutineComponent", gen,
+                           value: Any) -> None:
+        """Resume a parked routine; park it again at its next yield."""
+        try:
+            req = gen.send(value)
+        except StopIteration:
+            return                      # routine finished: retire
+        self._stats[comp.name] = self._stats.get(comp.name, 0) + 1
+        self._park(comp, gen, req)
+
+    def _drain_waiter(self, channel: str, rec) -> None:
+        """Feed a parked routine one buffered message. The mailbox makes
+        same-timestamp (or resume-in-flight) deliveries lossless: every
+        message lands in the waiter's queue at _deliver time and is
+        consumed one-per-yield here; leftovers follow the routine if it
+        parks on the same channel again, so bursts are never dropped."""
+        rec["scheduled"] = False
+        if not rec["mail"]:
+            return
+        msg = rec["mail"].popleft()
+        lst = self._waiters.get(channel, [])
+        lst.remove(rec)
+        if not lst:
+            self._waiters.pop(channel, None)
+        comp, gen = rec["comp"], rec["gen"]
+        try:
+            req = gen.send(msg)
+        except StopIteration:
+            return
+        self._stats[comp.name] = self._stats.get(comp.name, 0) + 1
+        if req == channel:
+            self._park(comp, gen, req, mail=rec["mail"])
+        else:
+            self._park(comp, gen, req)
+
     def _deliver(self, channel: str, message: Any) -> None:
         self._latest[channel] = message
         hist = self._history.get(channel)
@@ -223,6 +317,15 @@ class ComponentRuntime:
             hist = collections.deque(hist or (), maxlen=depth)
             self._history[channel] = hist
         hist.append(message)
+        # wake parked routines (data_wait satisfied): the message goes
+        # into each waiter's mailbox and the drain runs as a scheduled
+        # event, so ordering stays (time, seq) and bursts are lossless
+        for rec in list(self._waiters.get(channel, [])):
+            rec["mail"].append(message)
+            if not rec["scheduled"]:
+                rec["scheduled"] = True
+                self._push(self.now,
+                           lambda r=rec: self._drain_waiter(channel, r))
         for comp in self._subs.get(channel, []):
             fused = [self._latest.get(ch) for ch in comp.channels[1:]]
             comp.proc(message, *fused)

@@ -34,6 +34,19 @@ import numpy as np
 EMPTY_OBSTACLE = (-1.0, -2.0, 0.0, 0.0)   # s0 > s1 → overlaps nothing
 
 
+def _penalty_solve(h_base: jax.Array, b_base: jax.Array, penalty_fn,
+                   n_iter: int) -> jax.Array:
+    """Fixed-iteration penalty method: each step solves the base QP plus
+    the quadratic walls ``penalty_fn`` activates for the previous
+    iterate. The one solver behind both the path and speed optimizers
+    (the OSQP role, recast as n_iter dense solves under jit)."""
+    def body(_, a):
+        dh, db = penalty_fn(a)
+        return jnp.linalg.solve(h_base + dh, b_base + db)
+    a0 = jnp.linalg.solve(h_base, b_base)
+    return jax.lax.fori_loop(0, n_iter, body, a0)
+
+
 def _integration_maps(n: int, h: float):
     """x = X0 + A a  with decision vars a = x'' at the first n-2 knots.
 
@@ -88,22 +101,17 @@ def solve_corridor(lower: jax.Array, upper: jax.Array, *, ds: float,
 
     w_pen = 1e4
 
-    def profile(a):
-        return base + A @ a
-
-    def body(_, a):
-        x = profile(a)
+    def penalty(a):
+        x = base + A @ a
         viol_lo = (x < lower).astype(x.dtype)
         viol_hi = (x > upper).astype(x.dtype)
         W = viol_lo + viol_hi
         target = viol_lo * lower + viol_hi * upper
-        h = h_base + w_pen * A.T @ (W[:, None] * A)
-        b = b_base + w_pen * A.T @ (W * (target - base))
-        return jnp.linalg.solve(h, b)
+        return (w_pen * A.T @ (W[:, None] * A),
+                w_pen * A.T @ (W * (target - base)))
 
-    a0 = jnp.linalg.solve(h_base, b_base)
-    a = jax.lax.fori_loop(0, n_iter, body, a0)
-    x = profile(a)
+    a = _penalty_solve(h_base, b_base, penalty, n_iter)
+    x = base + A @ a
 
     viol = jnp.maximum(lower - x, 0.0) + jnp.maximum(x - upper, 0.0)
     infeasible = jnp.any(lower > upper)
@@ -195,25 +203,23 @@ def plan_speed(stop_s: jax.Array, *, n_t: int = 40, dt: float = 0.25,
     upper = jnp.full((n,), stop_s)
     w_pen = 1e4
 
-    def body(_, a):
-        s = base + A @ a
+    def penalty(a):
+        sprof = base + A @ a
         v = v_init + V @ a
-        viol_hi = (s > upper).astype(s.dtype)
+        viol_hi = (sprof > upper).astype(sprof.dtype)
         viol_v = (v < 0.0).astype(v.dtype)
-        h = (h_base + w_pen * A.T @ (viol_hi[:, None] * A)
-             + w_pen * V.T @ (viol_v[:, None] * V))
-        b = (b_base + w_pen * A.T @ (viol_hi * (upper - base))
-             + w_pen * V.T @ (viol_v * (-v_init)))
-        return jnp.linalg.solve(h, b)
+        return (w_pen * A.T @ (viol_hi[:, None] * A)
+                + w_pen * V.T @ (viol_v[:, None] * V),
+                w_pen * A.T @ (viol_hi * (upper - base))
+                + w_pen * V.T @ (viol_v * (-v_init)))
 
-    a0 = jnp.linalg.solve(h_base, b_base)
-    a = jax.lax.fori_loop(0, n_iter, body, a0)
+    a = _penalty_solve(h_base, b_base, penalty, n_iter)
     sprof = base + A @ a
     v = v_init + V @ a
-    viol = (jnp.maximum(sprof - upper, 0.0).sum()
-            + jnp.maximum(-v, 0.0).sum())
     cost = (w_v * jnp.sum((v - v_ref) ** 2) + w_a * jnp.sum(a ** 2)
-            + w_j * jnp.sum((d3 @ a) ** 2) + 1e4 * viol ** 2)
+            + w_j * jnp.sum((d3 @ a) ** 2)
+            + 1e4 * jnp.sum(jnp.maximum(sprof - upper, 0.0) ** 2)
+            + 1e4 * jnp.sum(jnp.maximum(-v, 0.0) ** 2))
     return sprof, cost
 
 
@@ -223,12 +229,14 @@ def obstacles_from_tracks(tracks, *, lane_half: float = 1.75,
     centers/extents), padded with EMPTY_OBSTACLE to a static K — the
     perception→planning handoff (``modules/planning/common/obstacle.cc``
     role, minimal)."""
-    # nearest obstacles matter most: keep the max_k with the smallest
-    # s_start, never the first K in tracker-insertion order (a new box
-    # dead ahead must not be silently dropped)
+    # keep the max_k AHEAD-of-ego obstacles nearest in s: behind-ego
+    # boxes never constrain the s>=0 grid and must not evict a box dead
+    # ahead; nor may tracker-insertion order decide survival
+    ahead = [t for t in tracks
+             if float(max(t.box[0], t.box[2])) >= 0.0]
     rows = []
-    for t in sorted(tracks, key=lambda t: float(min(t.box[0], t.box[2])
-                                                ))[:max_k]:
+    for t in sorted(ahead, key=lambda t: float(min(t.box[0], t.box[2])
+                                               ))[:max_k]:
         x0, y0, x1, y1 = (float(v) for v in t.box[:4])
         rows.append((min(x0, x1), max(x0, x1),
                      max(min(y0, y1), -lane_half),
