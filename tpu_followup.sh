@@ -24,9 +24,10 @@ python bench.py
 echo "== [2/3] on-chip PJRT driver execute"
 python -m pytest tests/test_pjrt_driver.py -q
 
-echo "== [3/3] ResNet convergence gate"
+echo "== [3/3] ResNet convergence gate (standalone rerun of the gate"
+echo "   bench.py already ran — same lr so the evidence cannot disagree)"
 python -m tosem_tpu.cli --device=tpu --config=resnet_train \
-    --steps=20 --converge_steps=600 --target_acc=0.6 \
+    --steps=20 --converge_steps=600 --target_acc=0.6 --lr=0.05 \
     --results_csv=results/convergence.csv
 
 echo "== TPU follow-up complete; commit results/ + REPORT.md"
