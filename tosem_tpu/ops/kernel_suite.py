@@ -86,6 +86,38 @@ def bert_kernel_suite(*, batch: int = 8, seq: int = 512, heads: int = 12,
                       "shape": [B, H, T, D], "dtype": dtype,
                       "blocks": [bq, bk]}))
 
+    # XLA-path attention at the same shape: the direct flash-vs-XLA
+    # comparison rows (quantifies what the Pallas kernel buys — or
+    # costs — on this chip, honest either way)
+    from tosem_tpu.nn.attention import dot_product_attention
+
+    def _xla_attn(a, b, c):
+        tr = lambda x: x.transpose(0, 2, 1, 3)      # [B,H,T,D]→[B,T,H,D]
+        return tr(dot_product_attention(tr(a), tr(b), tr(c)))
+
+    sec = DeviceLoopBench(op=jax.jit(_xla_attn), args=(q, k, v),
+                          perturb=0).time(reps=reps)
+    fl = attention_flops(B, H, T, D, bwd=False)
+    rows.append(_row(f"attention_fwd_xla_b{B}_t{T}_{dtype}", "gflops",
+                     fl / sec / 1e9, "GFLOPS",
+                     {"flop_model": "4BHT^2D", "time_us": sec * 1e6,
+                      "shape": [B, H, T, D], "dtype": dtype,
+                      "path": "xla"}))
+    xla_grad = jax.jit(jax.grad(
+        lambda a, b, c: jnp.sum(_xla_attn(a, b, c)
+                                .astype(jnp.float32) ** 2), (0, 1, 2)))
+    sec = DeviceLoopBench(op=_all_grads(xla_grad), args=(q, k, v),
+                          perturb=0).time(reps=reps)
+    # XLA keeps activations (no recompute): its hardware work is
+    # 4 fwd + 8 bwd = 12BHT^2D; compare paths by time_us, not GFLOPS
+    fl = 12.0 * B * H * T * T * D
+    rows.append(_row(f"attention_fwdbwd_xla_b{B}_t{T}_{dtype}", "gflops",
+                     fl / sec / 1e9, "GFLOPS",
+                     {"flop_model": "12BHT^2D (no recompute)",
+                      "time_us": sec * 1e6,
+                      "shape": [B, H, T, D], "dtype": dtype,
+                      "path": "xla"}))
+
     # layernorm fwd / fwd+bwd over [B*T, hidden]
     x = jax.random.normal(ks[3], (B * T, hidden), jnp.float32).astype(dt)
     g = jnp.ones((hidden,), dt)
