@@ -158,3 +158,82 @@ class TestRepoAnalysisEndToEnd:
                      "tests_strategy.csv", "properties.csv",
                      "bench_summary.csv", "bench_correlate.csv"):
             assert (tmp_path / "out" / name).exists(), name
+
+
+class TestReferenceReplication:
+    """The replication leg: classifier over external subject trees
+    (``analysis/replicate.py``), pinned on a vendored synthetic suite and
+    — when the study mount is present — scored against the published
+    ``RQs/RQ3/tests_strategy_rq3.csv`` numbers."""
+
+    def _write_subject(self, tmp_path):
+        root = tmp_path / "subject"
+        (root / "tests" / "unit").mkdir(parents=True)
+        (root / "tests" / "integration").mkdir(parents=True)
+        (root / "tests" / "unit" / "core_test.py").write_text(textwrap.dedent('''
+            import unittest
+
+            class CoreTest(unittest.TestCase):
+                def test_equalities(self):
+                    self.assertEqual(1, 1)
+                    self.assertAlmostEqual(0.1 + 0.2, 0.3, places=6)
+
+                def test_membership_and_types(self):
+                    self.assertIn("a", ["a", "b"])
+                    self.assertIsInstance([], list)
+                    self.assertIsNotNone(object())
+
+                def test_bad_input(self):
+                    self.assertRaises(ValueError, int, "nope")
+
+                def test_status_flag(self):
+                    ok = True
+                    self.assertTrue(ok)
+        '''))
+        (root / "tests" / "integration" / "pipe_tests.py").write_text(
+            textwrap.dedent('''
+            from nose.tools import assert_raises, assert_in
+
+            def test_pipeline_rejects():
+                assert_raises(TypeError, len, 3)
+                assert_in(1, [1, 2])
+        '''))
+        (root / "tests" / "unit" / "helpers.py").write_text(
+            "def test_not_a_test_file(): pass\n")
+        return str(root)
+
+    def test_classify_tree_vendored_suite(self, tmp_path):
+        from tosem_tpu.analysis.study import classify_tree
+        cases = classify_tree(self._write_subject(tmp_path), project="subj")
+        assert len(cases) == 5
+        assert {c.project for c in cases} == {"subj"}
+        by_name = {c.name: c for c in cases}
+        # path-derived method: integration dir wins over unit default
+        assert by_name["test_pipeline_rejects"].method == "integration"
+        assert by_name["test_equalities"].method == "unit_test"
+        # unittest + nose idioms land in the study's strategy vocabulary
+        assert "basic_comparizon" in by_name["test_equalities"].strategies
+        assert "rounding_tolence" in by_name["test_equalities"].strategies
+        assert "sub_set_checks" in by_name["test_membership_and_types"].strategies
+        assert "instance_check" in by_name["test_membership_and_types"].strategies
+        assert "Null_pointer" in by_name["test_membership_and_types"].strategies
+        assert "negative_test" in by_name["test_bad_input"].strategies
+        assert "value_error" in by_name["test_bad_input"].strategies
+        assert "status_analysis" in by_name["test_status_flag"].strategies
+        assert "type_error" in by_name["test_pipeline_rejects"].strategies
+
+    def test_reference_agreement(self, tmp_path):
+        """Against the real study mount: our automatic per-repo strategy
+        distribution must rank-correlate with the hand-labeled one."""
+        import pytest
+        from tosem_tpu.analysis.replicate import run_replication
+        if not os.path.isdir("/root/reference/src/tpot/v0.11.7"):
+            pytest.skip("study reference mount not present")
+        summary = run_replication("/root/reference", str(tmp_path / "out"),
+                                  subjects=["tpot", "auto-sklearn"])
+        agree = {r["project"]: r for r in summary["strategy_agreement"]}
+        assert agree["tpot"]["spearman"] > 0.5
+        assert agree["auto-sklearn"]["spearman"] > 0.5
+        assert agree["auto-sklearn"]["top_overlap"] >= 3
+        assert (tmp_path / "out" / "reference_strategy.csv").exists()
+        assert (tmp_path / "out" / "reference_agreement.json").exists()

@@ -24,13 +24,13 @@ from typing import List, Optional, Tuple
 class KVStore:
     """Namespaced persistent KV with compare-and-swap."""
 
+    # pop()'s single-statement lease needs UPDATE..RETURNING (SQLite
+    # >= 3.35); older engines fall back to SELECT+UPDATE under the
+    # in-process lock — same semantics within one process, but NOT
+    # atomic across processes sharing the db file
+    _HAS_RETURNING = sqlite3.sqlite_version_info >= (3, 35)
+
     def __init__(self, path: str = ":memory:"):
-        if sqlite3.sqlite_version_info < (3, 35):
-            # pop()'s atomic UPDATE..RETURNING lease needs 3.35+; fail
-            # loudly at construction, not deep inside the work loop
-            raise RuntimeError(
-                f"KVStore requires SQLite >= 3.35 (RETURNING); found "
-                f"{sqlite3.sqlite_version}")
         self.path = path
         if path != ":memory:":
             os.makedirs(os.path.dirname(os.path.abspath(path)),
@@ -147,14 +147,24 @@ class KVStore:
         reap() returns expired leases to ready — the work-queue pattern
         the study's MySQL queue implements)."""
         with self._lock:
-            # single statement (RETURNING, SQLite >=3.35) so the lease is
-            # atomic across *processes* sharing the db file — a SELECT then
-            # UPDATE pair lets two processes lease the same item
-            row = self._db.execute(
-                "UPDATE q SET state='leased', leased=? WHERE id=("
-                "SELECT id FROM q WHERE qname=? AND state='ready' "
-                "ORDER BY id LIMIT 1) RETURNING id, payload",
-                (time.time(), qname)).fetchone()
+            if self._HAS_RETURNING:
+                # single statement so the lease is atomic across
+                # *processes* sharing the db file — a SELECT then UPDATE
+                # pair lets two processes lease the same item
+                row = self._db.execute(
+                    "UPDATE q SET state='leased', leased=? WHERE id=("
+                    "SELECT id FROM q WHERE qname=? AND state='ready' "
+                    "ORDER BY id LIMIT 1) RETURNING id, payload",
+                    (time.time(), qname)).fetchone()
+            else:
+                row = self._db.execute(
+                    "SELECT id, payload FROM q WHERE qname=? AND "
+                    "state='ready' ORDER BY id LIMIT 1",
+                    (qname,)).fetchone()
+                if row is not None:
+                    self._db.execute(
+                        "UPDATE q SET state='leased', leased=? WHERE id=?",
+                        (time.time(), row[0]))
             self._db.commit()
             if row is None:
                 return None

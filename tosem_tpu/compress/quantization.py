@@ -152,7 +152,10 @@ def kl_threshold(hist: "np.ndarray", bin_width: float,
         kept = hist[:i]
         for g in range(n_quant):
             lo, hi = edges[g], edges[g + 1]
-            mass = p[lo:hi].sum()
+            # Q's group mass comes from the UNFOLDED histogram (the
+            # outlier fold belongs to P only); folding it in here would
+            # inflate the last group and bias the threshold
+            mass = kept[lo:hi].sum()
             nz = kept[lo:hi] > 0
             if nz.any():
                 q[lo:hi][nz] = mass / nz.sum()

@@ -49,8 +49,12 @@ def flash_attn_fn(causal: bool = False, precision: str = "default"):
 
     def core(q, k, v, mask):
         Tq, Tk = q.shape[1], k.shape[1]
+        # block divisibility alone is trivially true for T <= block; the
+        # Mosaic kernel additionally needs (sublane, lane) tile-aligned
+        # sequence lengths, so short ragged T falls back to XLA
         blocks_ok = (Tq % min(DEFAULT_BQ, Tq) == 0
-                     and Tk % min(DEFAULT_BK, Tk) == 0)
+                     and Tk % min(DEFAULT_BK, Tk) == 0
+                     and Tq % 8 == 0 and Tk % 128 == 0)
         if mask is None and blocks_ok:
             return mha_flash_attention(q, k, v, causal=causal)
         if causal:

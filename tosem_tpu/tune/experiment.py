@@ -301,7 +301,14 @@ class ExperimentManager:
                 "training_service runs support scheduler='fifo' only "
                 f"(got {spec['scheduler']!r}); use the in-process path "
                 "for early-stopping schedulers")
-        svc_cls = SERVICES[spec["training_service"]]
+        svc_name = spec["training_service"]
+        if svc_name not in SERVICES:
+            raise ValueError(
+                f"unknown training_service {svc_name!r}; supported: "
+                f"{sorted(SERVICES)} (NodeAgentService needs live agent "
+                "endpoints — construct it directly and call "
+                "run_with_service)")
+        svc_cls = SERVICES[svc_name]
         service = svc_cls(
             max_concurrent=int(spec.get("max_concurrent", 4)))
         try:

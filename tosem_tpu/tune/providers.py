@@ -358,7 +358,9 @@ def run_with_service(trainable_ref: str, space: Dict[str, Any], *,
                 continue
             if tid not in observed and job.status == SUCCEEDED \
                     and job.metrics:
-                alg.observe(configs[tid], float(job.metrics[-1][metric]))
+                val = job.metrics[-1].get(metric)
+                if val is not None:
+                    alg.observe(configs[tid], float(val))
                 observed.add(tid)
         if done:
             break
@@ -371,11 +373,18 @@ def run_with_service(trainable_ref: str, space: Dict[str, Any], *,
     rows = []
     for tid, cfg in configs.items():
         job = jobs[tid]
-        score = (float(job.metrics[-1][metric])
+        score = (job.metrics[-1].get(metric)
                  if job.status == SUCCEEDED and job.metrics else None)
+        score = None if score is None else float(score)
+        status, error = job.status, job.error
+        if status == SUCCEEDED and score is None:
+            # completed without ever reporting the configured metric —
+            # that's the trial's bug, not the experiment's; fail it alone
+            status, error = FAILED, (
+                f"trial finished without reporting metric {metric!r}")
         rows.append({"trial_id": tid, "config": cfg,
-                     "status": job.status, "score": score,
-                     "error": job.error})
+                     "status": status, "score": score,
+                     "error": error})
         if score is not None and sign * score > best:
             best, best_tid = sign * score, tid
     return {
