@@ -1,0 +1,186 @@
+"""Prediction-lite + control-lite: the loop-closing AD modules.
+
+Role models: the reference's free-move constant-velocity predictor
+(``modules/prediction/predictor/free_move/free_move_predictor.cc``), the
+LQR lateral controller over the dynamic-bicycle error state
+(``modules/control/controller/lat_controller.cc`` +
+``modules/common/math/linear_quadratic_regulator.cc``) and the cascaded
+PID longitudinal controller (``lon_controller.cc``). The pipeline test
+closes perception → prediction → planning → control on the deterministic
+component runtime — the reference's cyber DAG for the driving stack.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tosem_tpu.dataflow.components import Component, ComponentRuntime
+from tosem_tpu.models.control import (ControlComponent, PlanningComponent,
+                                      VehicleParams, bicycle_matrices,
+                                      discretize, lateral_gain, lqr_gain,
+                                      track_candidates, track_trajectory)
+from tosem_tpu.models.perception import TrackerComponent
+from tosem_tpu.models.prediction import (PredictionComponent,
+                                         TrackVelocityEstimator,
+                                         predict_rollout, swept_obstacles)
+
+
+class TestPrediction:
+    def test_constant_velocity_rollout(self):
+        boxes = np.array([[0.0, 0.0, 2.0, 1.0]])
+        vels = np.array([[2.0, 0.0]])
+        roll = predict_rollout(boxes, vels, horizon=2.0, dt=1.0)
+        assert roll.shape == (1, 2, 4)
+        np.testing.assert_allclose(roll[0, 0], [2.0, 0.0, 4.0, 1.0])
+        np.testing.assert_allclose(roll[0, 1], [4.0, 0.0, 6.0, 1.0])
+
+    def test_swept_corridor_covers_motion(self):
+        boxes = np.array([[10.0, -0.5, 12.0, 0.5]])
+        vels = np.array([[4.0, 0.0]])       # moving ahead at 4 m/s
+        obs = swept_obstacles(boxes, vels, horizon=5.0, dt=1.0, max_k=3)
+        assert obs.shape == (3, 4)
+        s0, s1, l0, l1 = obs[0]
+        assert s0 == pytest.approx(10.0)
+        assert s1 == pytest.approx(12.0 + 4.0 * 5.0)
+        assert l0 == pytest.approx(-0.5) and l1 == pytest.approx(0.5)
+        # remaining rows are inert padding (s0 > s1)
+        assert (obs[1:, 0] > obs[1:, 1]).all()
+
+    def test_behind_and_offlane_obstacles_dropped(self):
+        boxes = np.array([[-20.0, 0.0, -10.0, 1.0],    # behind ego
+                          [5.0, 8.0, 7.0, 9.0]])       # far off-lane
+        vels = np.zeros((2, 2))
+        obs = swept_obstacles(boxes, vels, horizon=1.0, dt=1.0,
+                              lane_half=1.75, max_k=2)
+        assert (obs[:, 0] > obs[:, 1]).all()   # all padding
+
+    def test_velocity_estimator_finite_difference(self):
+        est = TrackVelocityEstimator(dt=0.5)
+        t0 = [{"track_id": 1, "box": [0.0, 0.0, 2.0, 1.0]}]
+        t1 = [{"track_id": 1, "box": [1.0, 0.0, 3.0, 1.0]},
+              {"track_id": 2, "box": [5.0, 5.0, 6.0, 6.0]}]
+        v0 = est.update(t0)
+        np.testing.assert_allclose(v0, [[0.0, 0.0]])   # first sight
+        v1 = est.update(t1)
+        np.testing.assert_allclose(v1[0], [2.0, 0.0])  # 1m / 0.5s
+        np.testing.assert_allclose(v1[1], [0.0, 0.0])  # new track
+
+
+class TestLqr:
+    def test_closed_loop_stable_open_loop_not(self):
+        """The synthesized gain must place every closed-loop eigenvalue
+        inside the unit circle (the property the reference's Riccati
+        iteration converges to)."""
+        p = VehicleParams()
+        a, b = bicycle_matrices(p, jnp.float32(10.0))
+        ad, bd = discretize(a, b, 0.1)
+        k = lateral_gain(p, jnp.float32(10.0), dt=0.1)
+        acl = np.asarray(ad - bd @ k)
+        assert np.abs(np.linalg.eigvals(acl)).max() < 1.0
+
+    def test_riccati_fixed_point(self):
+        """K is the fixed point of the Riccati recursion: re-running the
+        synthesis with more iterations must not move the gain."""
+        p = VehicleParams()
+        a, b = bicycle_matrices(p, jnp.float32(15.0))
+        ad, bd = discretize(a, b, 0.1)
+        q = jnp.diag(jnp.asarray([1.0, 0.0, 1.0, 0.0], jnp.float32))
+        r = jnp.asarray([[10.0]], jnp.float32)
+        k100 = lqr_gain(ad, bd, q, r, n_iter=100)
+        k300 = lqr_gain(ad, bd, q, r, n_iter=300)
+        np.testing.assert_allclose(np.asarray(k100), np.asarray(k300),
+                                   atol=1e-4)
+
+    def test_offset_start_converges(self):
+        n, dt, nt = 64, 0.25, 40
+        path = jnp.zeros(n)
+        sprof = jnp.arange(nt, dtype=jnp.float32) * 8.0 * dt
+        roll = track_trajectory(path, sprof, ds=1.0, dt=dt, n_steps=nt,
+                                init=(0.0, 1.0, 0.0, 8.0))
+        e = np.asarray(roll["e_lat"])
+        assert abs(e[0]) > 0.9          # starts a meter off the path
+        assert abs(e[-1]) < 0.15        # LQR pulls it back
+        assert float(roll["max_e_station"]) < 2.0
+
+    def test_candidate_batch_scores_match_single(self):
+        """vmap-batched controller-in-the-loop scoring equals the
+        per-candidate rollout — batching never changes semantics."""
+        n, dt, nt = 32, 0.25, 20
+        sprof = jnp.arange(nt, dtype=jnp.float32) * 8.0 * dt
+        paths = jnp.stack([jnp.zeros(n), jnp.full((n,), 0.5)])
+        batch = track_candidates(paths, sprof, ds=1.0, dt=dt, n_steps=nt)
+        single = track_trajectory(paths[1], sprof, ds=1.0, dt=dt,
+                                  n_steps=nt)
+        np.testing.assert_allclose(np.asarray(batch["e_lat"][1]),
+                                   np.asarray(single["e_lat"]), atol=1e-5)
+
+
+class TestStopFence:
+    def test_full_lane_blocker_forces_stop(self):
+        """An obstacle spanning the whole lane band cannot be passed on
+        either side — the speed planner must stop the ego short of it
+        (the reference's stop-decision in the speed-bounds decider)."""
+        comp = PlanningComponent(n=64, ds=1.0, v_init=8.0)
+        blocker = np.array([[25.0, 30.0, -1.75, 1.75],
+                            [-1.0, -2.0, 0.0, 0.0],
+                            [-1.0, -2.0, 0.0, 0.0]], np.float32)
+        assert comp._stop_fence(blocker) == pytest.approx(24.0)
+        out = {}
+        comp._write = out.update
+        comp.proc({"obstacles": blocker})
+        assert out["stop_fence"] == pytest.approx(24.0)
+        sprof = out["s_profile"]
+        assert sprof.max() <= 24.0 + 0.5      # stops at the fence
+        # a passable obstacle leaves the fence at the horizon end
+        passable = np.array([[25.0, 30.0, -1.75, 0.5]], np.float32)
+        assert comp._stop_fence(passable) == pytest.approx(63.0)
+
+
+class TestDrivingPipeline:
+    def test_perception_to_control_loop(self):
+        """detections → tracker → prediction → planning → control on the
+        deterministic runtime: the planned path dodges the predicted
+        corridor and the controller tracks it within bounds."""
+        rtc = ComponentRuntime()
+        rtc.add(TrackerComponent(iou_threshold=0.1))
+        rtc.add(PredictionComponent(frame_dt=1.0, horizon=2.0, dt=0.5,
+                                    max_k=2))
+        rtc.add(PlanningComponent(n=64, ds=1.0, v_init=8.0))
+        rtc.add(ControlComponent(n_steps=40))
+        out: list = []
+
+        class Sink(Component):
+            def __init__(self):
+                super().__init__("sink", ["control", "trajectory",
+                                          "predicted_obstacles"])
+
+            def proc(self, ctl, traj, pred):
+                out.append((ctl, traj, pred))
+
+        rtc.add(Sink())
+        det_w = rtc.writer("detections")
+        # a static box dead ahead in-lane, drifting slowly left
+        for i, cy in enumerate((-0.6, -0.5, -0.4)):
+            det_w({"boxes": np.array([[20.0, cy, 24.0, cy + 1.0]]),
+                   "scores": np.array([0.9])})
+            rtc.run_until(float(i + 1))
+
+        assert len(out) == 3
+        ctl, traj, pred = out[-1]
+        obstacles = np.asarray(pred["obstacles"])
+        # the swept corridor covers the box (and its leftward drift)
+        assert obstacles[0, 0] <= 20.0 and obstacles[0, 1] >= 24.0
+        # planned path is finite and actually dodges: at the obstacle
+        # stations the path leaves the blocked lateral band
+        path = np.asarray(traj["path_l"])
+        assert np.isfinite(path).all()
+        s_hit = slice(int(obstacles[0, 0]), int(np.ceil(obstacles[0, 1])))
+        blocked_lo, blocked_hi = obstacles[0, 2], obstacles[0, 3]
+        inside = ((path[s_hit] > blocked_lo)
+                  & (path[s_hit] < blocked_hi))
+        assert not inside.any(), (path[s_hit], obstacles[0])
+        # controller tracks the dodging path: bounded transient during
+        # the swerve, settled by the end of the horizon
+        assert ctl["max_e_lat"] < 0.9
+        assert ctl["max_e_station"] < 3.0
+        assert np.isfinite(ctl["steer"]).all()

@@ -165,7 +165,10 @@ def run_replication(reference: str, out_dir: str,
         rel, _col = SUBJECTS[name]
         root = _subject_root(reference, rel)
         if root is None:
-            continue
+            raise FileNotFoundError(
+                f"subject tree for {name!r} not found under "
+                f"{os.path.join(reference, 'src', rel)!r} — wrong "
+                "--reference path or unmounted study checkout")
         cases = classify_tree(root, project=name, max_files=max_files)
         per_subject[name] = len(cases)
         all_cases.extend(cases)

@@ -157,14 +157,26 @@ class KVStore:
                     "ORDER BY id LIMIT 1) RETURNING id, payload",
                     (time.time(), qname)).fetchone()
             else:
-                row = self._db.execute(
-                    "SELECT id, payload FROM q WHERE qname=? AND "
-                    "state='ready' ORDER BY id LIMIT 1",
-                    (qname,)).fetchone()
-                if row is not None:
-                    self._db.execute(
-                        "UPDATE q SET state='leased', leased=? WHERE id=?",
-                        (time.time(), row[0]))
+                # SELECT + guarded UPDATE: the AND state='ready' guard +
+                # rowcount check narrows (not closes) the cross-process
+                # race — if another process won the lease, retry instead
+                # of double-leasing
+                row = None
+                for _ in range(8):
+                    cand = self._db.execute(
+                        "SELECT id, payload FROM q WHERE qname=? AND "
+                        "state='ready' ORDER BY id LIMIT 1",
+                        (qname,)).fetchone()
+                    if cand is None:
+                        break
+                    cur = self._db.execute(
+                        "UPDATE q SET state='leased', leased=? WHERE "
+                        "id=? AND state='ready'",
+                        (time.time(), cand[0]))
+                    if cur.rowcount == 1:
+                        row = cand
+                        break
+                    self._db.commit()   # lost the race; observe fresh state
             self._db.commit()
             if row is None:
                 return None

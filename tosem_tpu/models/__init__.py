@@ -9,3 +9,10 @@ from tosem_tpu.models.pointpillars import (PillarFeatureNet, PillarGrid,
 from tosem_tpu.models.planning import (plan_path, plan_speed,
                                        obstacles_from_tracks,
                                        solve_corridor)
+from tosem_tpu.models.prediction import (predict_rollout, swept_obstacles,
+                                         TrackVelocityEstimator,
+                                         PredictionComponent)
+from tosem_tpu.models.control import (VehicleParams, PidGains, lqr_gain,
+                                      lateral_gain, track_trajectory,
+                                      track_candidates, PlanningComponent,
+                                      ControlComponent)

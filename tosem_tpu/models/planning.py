@@ -223,24 +223,34 @@ def plan_speed(stop_s: jax.Array, *, n_t: int = 40, dt: float = 0.25,
     return sprof, cost
 
 
+def pad_obstacle_rows(rows, *, lane_half: float = 1.75,
+                      max_k: int = 3) -> jax.Array:
+    """Candidate Frenet rows ``(s0, s1, l0, l1)`` → static ``[max_k, 4]``
+    planner input: drop behind-ego (s1 < 0) and fully off-lane rows,
+    keep the ``max_k`` nearest in s (tracker-insertion order must not
+    decide survival), clip l to the lane band, pad with
+    ``EMPTY_OBSTACLE``. The one select/clip/pad step shared by the
+    perception handoff and the prediction sweep."""
+    kept = []
+    for s0, s1, l0, l1 in rows:
+        s0, s1 = float(min(s0, s1)), float(max(s0, s1))
+        l0, l1 = float(min(l0, l1)), float(max(l0, l1))
+        if s1 < 0.0 or l0 > lane_half or l1 < -lane_half:
+            continue
+        kept.append((s0, s1, max(l0, -lane_half), min(l1, lane_half)))
+    kept = sorted(kept)[:max_k]
+    while len(kept) < max_k:
+        kept.append(EMPTY_OBSTACLE)
+    return jnp.asarray(kept, jnp.float32)
+
+
 def obstacles_from_tracks(tracks, *, lane_half: float = 1.75,
                           max_k: int = 3) -> jax.Array:
     """Frenet obstacle rows from perception tracks (x→s, y→l of the box
     centers/extents), padded with EMPTY_OBSTACLE to a static K — the
     perception→planning handoff (``modules/planning/common/obstacle.cc``
     role, minimal)."""
-    # keep the max_k AHEAD-of-ego obstacles nearest in s: behind-ego
-    # boxes never constrain the s>=0 grid and must not evict a box dead
-    # ahead; nor may tracker-insertion order decide survival
-    ahead = [t for t in tracks
-             if float(max(t.box[0], t.box[2])) >= 0.0]
-    rows = []
-    for t in sorted(ahead, key=lambda t: float(min(t.box[0], t.box[2])
-                                               ))[:max_k]:
-        x0, y0, x1, y1 = (float(v) for v in t.box[:4])
-        rows.append((min(x0, x1), max(x0, x1),
-                     max(min(y0, y1), -lane_half),
-                     min(max(y0, y1), lane_half)))
-    while len(rows) < max_k:
-        rows.append(EMPTY_OBSTACLE)
-    return jnp.asarray(rows, jnp.float32)
+    rows = [(min(float(t.box[0]), float(t.box[2])),
+             max(float(t.box[0]), float(t.box[2])),
+             float(t.box[1]), float(t.box[3])) for t in tracks]
+    return pad_obstacle_rows(rows, lane_half=lane_half, max_k=max_k)

@@ -316,6 +316,16 @@ SERVICES = {
 }
 
 
+def _last_metric(metrics, key):
+    """Most recent report carrying ``key`` — the final entry may be a
+    heterogeneous record (checkpoint stats etc.) without the configured
+    metric, which must not discard the trial."""
+    for m in reversed(metrics):
+        if m.get(key) is not None:
+            return m[key]
+    return None
+
+
 def run_with_service(trainable_ref: str, space: Dict[str, Any], *,
                      service: TrainingService, metric: str, mode: str,
                      num_samples: int, max_iterations: int = 100,
@@ -358,7 +368,7 @@ def run_with_service(trainable_ref: str, space: Dict[str, Any], *,
                 continue
             if tid not in observed and job.status == SUCCEEDED \
                     and job.metrics:
-                val = job.metrics[-1].get(metric)
+                val = _last_metric(job.metrics, metric)
                 if val is not None:
                     alg.observe(configs[tid], float(val))
                 observed.add(tid)
@@ -373,7 +383,7 @@ def run_with_service(trainable_ref: str, space: Dict[str, Any], *,
     rows = []
     for tid, cfg in configs.items():
         job = jobs[tid]
-        score = (job.metrics[-1].get(metric)
+        score = (_last_metric(job.metrics, metric)
                  if job.status == SUCCEEDED and job.metrics else None)
         score = None if score is None else float(score)
         status, error = job.status, job.error
