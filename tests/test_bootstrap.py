@@ -1,0 +1,142 @@
+"""Remote-machine bootstrap (cluster/bootstrap.py): the SSH-shaped
+training-service leg — the manager STARTS its agents over a shell
+transport, runs trials through them, and tears them down
+(``remoteMachineTrainingService.ts`` + ``shellExecutor.ts`` roles).
+"""
+import os
+import subprocess
+import time
+
+import pytest
+
+from tosem_tpu.cluster.bootstrap import (BootstrapService, CommandRunner,
+                                         LocalRunner, SshRunner,
+                                         bootstrap_agent)
+
+TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+class RecordingRunner(CommandRunner):
+    """Mock transport: records the command, delegates to bash locally —
+    proves the seam is the shell string, nothing else."""
+
+    def __init__(self):
+        self.commands = []
+
+    def popen(self, command):
+        self.commands.append(command)
+        return subprocess.Popen(["bash", "-c", command],
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.DEVNULL)
+
+
+class TestBootstrapAgent:
+    def test_agent_boots_and_serves_through_local_shell(self):
+        agent = bootstrap_agent(LocalRunner(), num_workers=1)
+        try:
+            assert agent.node.health()["ok"]
+            assert agent.node.submit(max, 3, 7) == 7
+        finally:
+            agent.teardown()
+
+    def test_transport_seam_is_one_shell_command(self):
+        runner = RecordingRunner()
+        agent = bootstrap_agent(runner, num_workers=1,
+                                extra_sys_path=[TESTS_DIR])
+        try:
+            assert len(runner.commands) == 1
+            cmd = runner.commands[0]
+            # env rides inside the command (ssh forwards no env) and the
+            # repo is the environment — no upload step
+            assert "PYTHONPATH=" in cmd and "--num-workers 1" in cmd
+            assert "--path" in cmd and TESTS_DIR in cmd
+            assert agent.node.health()["ok"]
+        finally:
+            agent.teardown()
+
+    def test_wedged_remote_does_not_hang_manager(self):
+        class WedgedRunner(CommandRunner):
+            def popen(self, command):
+                return subprocess.Popen(["bash", "-c", "sleep 300"],
+                                        stdout=subprocess.PIPE,
+                                        stderr=subprocess.DEVNULL)
+
+        t0 = time.monotonic()
+        with pytest.raises(RuntimeError, match="failed to announce"):
+            bootstrap_agent(WedgedRunner(), startup_timeout=2.0)
+        assert time.monotonic() - t0 < 30.0
+
+    def test_dead_remote_raises_not_hangs(self):
+        class DeadRunner(CommandRunner):
+            def popen(self, command):
+                return subprocess.Popen(["bash", "-c", "exit 7"],
+                                        stdout=subprocess.PIPE,
+                                        stderr=subprocess.DEVNULL)
+
+        with pytest.raises(RuntimeError, match="failed to announce"):
+            bootstrap_agent(DeadRunner(), startup_timeout=10.0)
+
+    def test_ssh_runner_command_shape(self):
+        """The ssh command line itself (no live ssh in CI): BatchMode
+        so a password prompt can never wedge the manager."""
+        r = SshRunner("worker1", user="ci", ssh_options=["-p", "2222"])
+        assert r.host == "worker1"
+
+        class Probe(SshRunner):
+            def popen(self, command):
+                self.argv = ["ssh", "-o", "BatchMode=yes", "-p", "2222",
+                             "ci@worker1", command]
+                return None
+
+        p = Probe("worker1", user="ci", ssh_options=["-p", "2222"])
+        p.popen("echo hi")
+        assert p.argv[:3] == ["ssh", "-o", "BatchMode=yes"]
+        assert "ci@worker1" in p.argv
+
+
+class TestBootstrapService:
+    def test_end_to_end_trial_through_self_bootstrapped_agent(self):
+        """The acceptance: a whole HPO loop whose agents exist only
+        because the service bootstrapped them."""
+        from test_providers import _UniformSearch
+
+        from tosem_tpu.tune.providers import run_with_service
+
+        svc = BootstrapService([LocalRunner()], num_workers=2,
+                               extra_sys_path=[TESTS_DIR])
+        try:
+            out = run_with_service(
+                "test_providers:quad_trainable",
+                {"x": ("uniform", 0.0, 4.0)},
+                service=svc, metric="loss", mode="min", num_samples=3,
+                max_iterations=3,
+                search_alg=_UniformSearch(), poll_s=0.1, timeout_s=180)
+        finally:
+            svc.shutdown()
+        assert len(out["trials"]) == 3
+        assert all(t["status"] == "SUCCEEDED" for t in out["trials"])
+        assert out["best_score"] is not None
+
+    def test_shutdown_reaps_agents(self):
+        svc = BootstrapService([LocalRunner()], num_workers=1)
+        node = svc._agents[0].node
+        proc = svc._agents[0]._proc
+        assert node.alive()
+        svc.shutdown()
+        # bounded reap: terminate, then kill
+        deadline = time.monotonic() + 15
+        while proc.poll() is None and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert proc.poll() is not None
+
+    def test_failed_bootstrap_leaks_nothing(self):
+        class DeadRunner(CommandRunner):
+            def popen(self, command):
+                return subprocess.Popen(["bash", "-c", "exit 1"],
+                                        stdout=subprocess.PIPE,
+                                        stderr=subprocess.DEVNULL)
+
+        ok = LocalRunner()
+        with pytest.raises(RuntimeError):
+            BootstrapService([ok, DeadRunner()], num_workers=1,
+                             startup_timeout=10.0)

@@ -73,3 +73,19 @@ def test_manifest_drives_run(tmp_path):
         f"results_csv: {out}\nsteps: 2\n")
     assert main([f"--manifest={mpath}"]) == 0
     assert read_results(str(out))[0]["bench_id"].startswith("gemm_")
+
+
+def test_bert_train_ab_loss_parity():
+    """bert_train config: flash vs XLA train step on identical params —
+    same loss (semantics), both timed, speedup row emitted."""
+    from tosem_tpu.cli import make_flags, run_bert_train
+    fs = make_flags()
+    fs.set("device", "cpu")
+    fs.set("steps", 1)
+    rows = run_bert_train(fs)
+    losses = {r.extra["attn"]: r.extra["final_loss"]
+              for r in rows if r.metric == "step_time_ms"}
+    assert set(losses) == {"xla", "flash"}
+    assert abs(losses["xla"] - losses["flash"]) < 1e-4
+    assert sum(r.metric == "train_gflops" for r in rows) == 2
+    assert any(r.metric == "speedup" for r in rows)

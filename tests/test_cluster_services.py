@@ -259,3 +259,81 @@ class TestRecordReplay:
         assert out == [1, 2, 3, 4, 5]
         rec.close()
         assert [m for _, _, m in replay(path, "stage1")] == [0, 1, 2, 3, 4]
+
+
+class TestParameterServer:
+    """cluster/param.py — the Cyber parameter-server role
+    (cyber/parameter/parameter_server.cc) over the shared KV table."""
+
+    def test_set_get_list_delete(self):
+        from tosem_tpu.cluster.param import ParameterServer
+        ps = ParameterServer()
+        v1 = ps.set("max_speed", 12.5)
+        v2 = ps.set("planner", {"lane_half": 1.75})
+        assert v2 == v1 + 1                      # monotonic versions
+        assert ps.get("max_speed") == 12.5
+        assert ps.get("missing", default="d") == "d"
+        assert ps.list() == {"max_speed": 12.5,
+                             "planner": {"lane_half": 1.75}}
+        assert ps.delete("max_speed")
+        assert ps.get("max_speed") is None
+
+    def test_local_watch_fires_on_set(self):
+        from tosem_tpu.cluster.param import ParameterServer
+        ps = ParameterServer()
+        seen = []
+        ps.watch(lambda n, v, ver: seen.append((n, v, ver)))
+        ps.set("a", 1)
+        ps.set("b", 2)
+        assert seen == [("a", 1, 1), ("b", 2, 2)]
+        ps.unwatch(ps._watchers[0])
+        ps.set("c", 3)
+        assert len(seen) == 2
+
+    def test_cross_process_view_and_poller(self, tmp_path):
+        """Two server instances over one db file: writes by one become
+        poll-driven callbacks in the other (the cross-node subscribe)."""
+        import time as _t
+        from tosem_tpu.cluster.kv import KVStore
+        from tosem_tpu.cluster.param import ParameterPoller, ParameterServer
+        path = str(tmp_path / "params.db")
+        writer = ParameterServer(KVStore(path))
+        reader = ParameterServer(KVStore(path))
+        seen = []
+        poller = ParameterPoller(reader, lambda n, v, ver:
+                                 seen.append((n, v)), poll_s=0.02)
+        try:
+            writer.set("obstacle_horizon", 5.0)
+            writer.set("obstacle_horizon", 6.0)
+            deadline = _t.monotonic() + 10
+            while len(seen) < 2 and _t.monotonic() < deadline:
+                _t.sleep(0.02)
+        finally:
+            poller.close()
+        # versioned rows: the poller saw at least the LATEST value and
+        # cursors past it (a same-key overwrite may legally coalesce)
+        assert seen and seen[-1] == ("obstacle_horizon", 6.0)
+        assert reader.get("obstacle_horizon") == 6.0
+
+    def test_component_visible_updates(self):
+        """bind_runtime: a parameter change arrives at a dataflow
+        component as a channel message."""
+        from tosem_tpu.cluster.param import ParameterServer
+        from tosem_tpu.dataflow.components import Component, ComponentRuntime
+
+        rtc = ComponentRuntime()
+        got = []
+
+        class Tuned(Component):
+            def __init__(self):
+                super().__init__("tuned", ["param_events"])
+
+            def proc(self, msg, *fused):
+                got.append((msg["name"], msg["value"]))
+
+        rtc.add(Tuned())
+        ps = ParameterServer()
+        ps.bind_runtime(rtc)
+        ps.set("nms_threshold", 0.45)
+        rtc.run_until(1.0)
+        assert got == [("nms_threshold", 0.45)]

@@ -1,0 +1,40 @@
+"""Quickstart examples EXECUTE, not just byte-compile.
+
+The reference smoke-runs its user-facing entry points in CI
+(DeepSpeech's taskcluster ``bin/run-tc-*`` scripts run training and
+inference end-to-end on tiny data); ``ci.sh``'s compileall gate alone
+would let these rot silently. Each example is hermetic (CPU-forced via
+``examples/_bootstrap.py``), so running it as a subprocess with a
+timeout IS the smoke test.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLES = sorted(
+    f for f in os.listdir(os.path.join(REPO, "examples"))
+    if f.startswith("quickstart_") and f.endswith(".py"))
+
+
+def test_inventory_pinned():
+    """New examples must join the smoke matrix, not dodge it."""
+    assert EXAMPLES == ["quickstart_gang.py", "quickstart_hpo.py",
+                       "quickstart_serve.py", "quickstart_train.py"]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", EXAMPLES)
+def test_example_runs(name):
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", name)],
+        cwd=os.path.join(REPO, "examples"),
+        env=env, capture_output=True, timeout=600)
+    assert proc.returncode == 0, (
+        f"{name} failed rc={proc.returncode}\n"
+        f"--- stdout ---\n{proc.stdout[-1500:].decode(errors='replace')}\n"
+        f"--- stderr ---\n{proc.stderr[-1500:].decode(errors='replace')}")

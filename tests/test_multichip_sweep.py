@@ -29,6 +29,13 @@ def test_factoring_plan_shapes():
     f6 = ge._trainer_factorings(6, 12, 32)
     assert (6, 1, 1) in f6 and (1, 2, 3) not in f6
     assert all(12 % dp == 0 and 32 % sp == 0 for dp, _, sp in f6)
+    # every n keeps a balanced MIDDLE factoring — n=32 used to lose it
+    # to the tp<=heads filter exactly where sharding is riskiest
+    assert (4, 2, 4) in ge._trainer_factorings(32, 64, 32)
+    assert (8, 2, 4) in ge._trainer_factorings(64, 128, 32)
+    for n, B in ((6, 12), (8, 16), (16, 32), (32, 64)):
+        fs = ge._trainer_factorings(n, B, 32)
+        assert ge._balanced_factoring(n, B, 32) in fs
 
 
 @pytest.mark.slow

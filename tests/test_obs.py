@@ -179,3 +179,59 @@ class TestDetectionAP:
             [{"boxes": np.asarray([[0, 0, 5, 5]], np.float32),
               "classes": np.asarray([2])}])
         assert ap["AP"] == pytest.approx(0.0)   # GT exists, nothing found
+
+
+class TestSysMo:
+    """obs/sysmo.py — the cyber/sysmo checker role: periodic process/
+    scheduler health snapshots with pluggable subsystem sources."""
+
+    def test_sample_fields_and_history_bound(self):
+        from tosem_tpu.obs.sysmo import SysMo
+        sm = SysMo(interval_s=0.01, history=5)
+        for _ in range(8):
+            snap = sm.sample()
+        assert snap["rss_bytes"] > 0
+        assert snap["n_threads"] >= 1
+        assert any(t["name"] == "MainThread" for t in snap["threads"])
+        assert len(sm.snapshots) == 5          # bounded history
+
+    def test_checker_thread_and_sources(self):
+        import time as _t
+        from tosem_tpu.obs.sysmo import SysMo
+        sm = SysMo(interval_s=0.01)
+        sm.add_source("queue", lambda: {"depth": 3})
+        sm.add_source("sick", lambda: 1 / 0)
+        sm.start()
+        deadline = _t.monotonic() + 10
+        while len(sm.snapshots) < 3 and _t.monotonic() < deadline:
+            _t.sleep(0.01)
+        sm.stop()
+        assert len(sm.snapshots) >= 3
+        snap = sm.snapshots[-1]
+        assert snap["queue"] == {"depth": 3}
+        assert "ZeroDivisionError" in snap["sick"]["error"]
+        assert "sysmo @" in sm.dump() and "queue" in sm.dump()
+
+    def test_gauges_feed_registry(self):
+        from tosem_tpu.obs.metrics import Registry
+        from tosem_tpu.obs.sysmo import SysMo
+        reg = Registry()
+        sm = SysMo(registry=reg)
+        sm.sample()
+        text = "\n".join(l for m in reg._metrics.values()
+                         for l in m.collect())
+        assert "sysmo_rss_bytes" in text and "sysmo_threads" in text
+
+    def test_node_agent_stats_as_source(self):
+        """The scheduler-hook analog: a node agent's stats RPC joins the
+        sysmo report."""
+        from tosem_tpu.cluster.node import RemoteNode
+        from tosem_tpu.obs.sysmo import SysMo
+        node = RemoteNode.spawn_local(num_workers=1)
+        try:
+            sm = SysMo()
+            sm.add_source("agent", node.stats)
+            snap = sm.sample()
+            assert snap["agent"]["num_workers"] == 1
+        finally:
+            node.kill()

@@ -1,0 +1,133 @@
+"""Cross-language call surface (cluster/xlang.py + native/xlang_client):
+named functions invoked ACROSS the language boundary over the JSON wire
+— the Ray cross-language contract (java/api calling registered Python
+functions by name, args narrowed to a neutral serialization).
+"""
+import json
+import subprocess
+
+import pytest
+
+from tosem_tpu.cluster.xlang import XLangGateway, xlang_call
+
+
+@pytest.fixture(scope="module")
+def xlang_bin():
+    from tosem_tpu.native import build_binary
+    return build_binary("xlang_client")
+
+
+def _split(address):
+    host, _, port = address.rpartition(":")
+    return host, port
+
+
+class TestGateway:
+    def test_python_reference_client(self):
+        gw = XLangGateway()
+        try:
+            gw.register("add", lambda a, b: a + b)
+            assert xlang_call(gw.address, "ping") == "pong"
+            assert xlang_call(gw.address, "add", 2, 3) == 5
+            assert "add" in xlang_call(gw.address, "list_methods")
+        finally:
+            gw.close()
+
+    def test_remote_errors_surface_not_crash(self):
+        gw = XLangGateway()
+        try:
+            gw.register("boom", lambda: 1 / 0)
+            with pytest.raises(RuntimeError, match="ZeroDivisionError"):
+                xlang_call(gw.address, "boom")
+            with pytest.raises(RuntimeError, match="unknown method"):
+                xlang_call(gw.address, "nope")
+            # the connection/server survives the errors
+            assert xlang_call(gw.address, "ping") == "pong"
+        finally:
+            gw.close()
+
+    def test_non_json_result_is_a_remote_error(self):
+        gw = XLangGateway()
+        try:
+            gw.register("bad", lambda: object())
+            with pytest.raises(RuntimeError, match="TypeError"):
+                xlang_call(gw.address, "bad")
+        finally:
+            gw.close()
+
+
+class TestCppClient:
+    def test_cpp_calls_registered_python_function(self, xlang_bin):
+        """The acceptance: C++ invokes a Python function BY NAME and
+        consumes its JSON result — a cross-language task call, not an
+        FFI link."""
+        gw = XLangGateway()
+        try:
+            gw.register("plan_fence",
+                        lambda horizon, blocked: (blocked - 1.0
+                                                  if blocked < horizon
+                                                  else horizon))
+            host, port = _split(gw.address)
+            req = json.dumps({"method": "plan_fence",
+                              "args": [63.0, 25.0]})
+            proc = subprocess.run([xlang_bin, host, port, req],
+                                  capture_output=True, timeout=60)
+            assert proc.returncode == 0, proc.stderr
+            resp = json.loads(proc.stdout)
+            assert resp["ok"] is True and resp["result"] == 24.0
+        finally:
+            gw.close()
+
+    def test_cpp_ping_and_error_exit_codes(self, xlang_bin):
+        gw = XLangGateway()
+        try:
+            host, port = _split(gw.address)
+            ok = subprocess.run([xlang_bin, host, port, "--ping"],
+                                capture_output=True, timeout=60)
+            assert ok.returncode == 0
+            bad = subprocess.run(
+                [xlang_bin, host, port,
+                 json.dumps({"method": "missing"})],
+                capture_output=True, timeout=60)
+            assert bad.returncode == 1        # gateway said ok: false
+            assert b"unknown method" in bad.stdout
+        finally:
+            gw.close()
+
+    def test_cpp_drives_node_trial_plane(self, xlang_bin):
+        """End to end: C++ → gateway → node agent trial plane — the
+        remote training service driven from a second language."""
+        import os
+        import time
+        from tosem_tpu.cluster.node import RemoteNode
+        TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+        node = RemoteNode.spawn_local(num_workers=1,
+                                      extra_sys_path=[TESTS_DIR])
+        gw = XLangGateway()
+        try:
+            gw.bridge_node(node)
+            host, port = _split(gw.address)
+            req = json.dumps({
+                "method": "node.submit_trial",
+                "args": ["tx0", "test_providers:quad_trainable",
+                         {"x": 2.0}, 3]})
+            proc = subprocess.run([xlang_bin, host, port, req],
+                                  capture_output=True, timeout=60)
+            assert proc.returncode == 0, proc.stderr
+            deadline = time.monotonic() + 60
+            status = None
+            while time.monotonic() < deadline:
+                out = subprocess.run(
+                    [xlang_bin, host, port,
+                     json.dumps({"method": "node.trial_status",
+                                 "args": ["tx0"]})],
+                    capture_output=True, timeout=60)
+                status = json.loads(out.stdout)["result"]
+                if status["status"] in ("SUCCEEDED", "FAILED"):
+                    break
+                time.sleep(0.2)
+            assert status["status"] == "SUCCEEDED", status
+            assert len(status["metrics"]) == 3
+        finally:
+            gw.close()
+            node.kill()

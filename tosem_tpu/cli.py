@@ -25,8 +25,8 @@ from typing import Any, Dict, List
 from tosem_tpu.utils.flags import FlagSet
 
 CONFIGS = ("gemm", "conv_sweep", "allreduce", "resnet_train",
-           "bert_kernels", "detection_train", "detection_infer",
-           "speech_train", "analysis")
+           "bert_kernels", "bert_train", "detection_train",
+           "detection_infer", "speech_train", "analysis")
 
 
 def make_flags() -> FlagSet:
@@ -237,6 +237,119 @@ def run_bert_kernels(fs: FlagSet) -> List[Any]:
         rows = bert_kernel_suite(batch=8, seq=fs.seq or 512)
     for r in rows:
         print(f"  {r.bench_id}: {r.value:.1f} {r.unit}")
+    return rows
+
+
+def run_bert_train(fs: FlagSet) -> List[Any]:
+    """BERT full MLM train step, flash vs XLA attention A/B.
+
+    The kernel suite (``bert_kernels``) measures pieces; the north star
+    is the model: one jitted train step on IDENTICAL params/batch with
+    the only difference being ``attn_fn`` — the flash kernel must win at
+    the model level, not just in isolation. Reference anchor: the
+    towers-to-pjit training-graph story
+    (``src/DeepSpeech/v0.9.3/training/deepspeech_training/train.py:292``)
+    and the EfficientDet train loop (``det_model_fn.py:309-322``).
+    Emits step-time + MFU rows per variant plus the flash/XLA speedup.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from tosem_tpu.models.bert import Bert, BertConfig
+    from tosem_tpu.nn.attention import flash_attn_fn
+    from tosem_tpu.train.trainer import (create_train_state,
+                                         cross_entropy_loss, variables)
+    from tosem_tpu.utils.results import ResultRow
+
+    on_tpu = fs.device == "tpu"
+    cfg = BertConfig.base() if on_tpu else BertConfig.tiny()
+    B = fs.batch or (8 if on_tpu else 2)
+    T = fs.seq or (512 if on_tpu else 64)
+    T = min(T, cfg.max_len)
+    steps = max(fs.steps, 1)
+    model = Bert(cfg)
+    opt = optax.adamw(1e-4)
+    ts0 = create_train_state(model, jax.random.PRNGKey(0), opt)
+    kb = jax.random.PRNGKey(1)
+    ids = jax.random.randint(kb, (B, T), 0, cfg.vocab_size)
+    masked = (jax.random.uniform(jax.random.fold_in(kb, 1),
+                                 (B, T)) < 0.15)
+    batch = {"ids": ids, "labels": ids, "masked": masked}
+
+    # train FLOPs/step: 6·N·B·T matmul term + the T² attention term
+    # (fwd 2NBT + attn, bwd ≈ 2× fwd) — the PaLM-appendix accounting
+    n_params = sum(int(np.prod(p.shape))
+                   for p in jax.tree_util.tree_leaves(ts0["params"]))
+    attn_flops = 12 * cfg.layers * B * T * T * cfg.dim
+    flops_per_step = 6 * n_params * B * T + 3 * attn_flops
+
+    def make_step(attn_fn):
+        def loss_fn(params, state, rng):
+            enc, new_state = model.apply(
+                {"params": params, "state": state}, batch["ids"],
+                train=True, rng=rng, attn_fn=attn_fn)
+            logits = model.mlm_logits(variables(params, state), enc)
+            loss = cross_entropy_loss(logits, batch["labels"],
+                                      batch["masked"])
+            return loss, new_state
+
+        @jax.jit
+        def step(ts, rng):
+            (loss, new_state), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(ts["params"], ts["state"], rng)
+            updates, opt_state = opt.update(grads, ts["opt_state"],
+                                            ts["params"])
+            return {"step": ts["step"] + 1,
+                    "params": optax.apply_updates(ts["params"], updates),
+                    "state": new_state, "opt_state": opt_state}, loss
+        return step
+
+    rows, times = [], {}
+    for name, afn in (("xla", None), ("flash", flash_attn_fn())):
+        step = make_step(afn)
+        ts, rng = ts0, jax.random.PRNGKey(2)
+        loss = None
+        for _ in range(2):                       # compile + settle
+            rng, sub = jax.random.split(rng)
+            ts, loss = step(ts, sub)
+        float(jax.device_get(loss))              # warmup sync
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            rng, sub = jax.random.split(rng)
+            ts, loss = step(ts, sub)
+        loss = float(jax.device_get(loss))       # end-of-block sync
+        step_s = (time.perf_counter() - t0) / steps
+        times[name] = step_s
+        rows.append(ResultRow(
+            project="train", config="bert_train",
+            bench_id=f"bert_{'base' if on_tpu else 'tiny'}"
+                     f"_b{B}_t{T}_{name}",
+            metric="step_time_ms", value=step_s * 1e3, unit="ms",
+            device=jax.devices()[0].platform, n_devices=1,
+            extra={"batch": B, "seq": T, "attn": name,
+                   "final_loss": loss, "params": n_params,
+                   "dtype": cfg.dtype}))
+        rows.append(ResultRow(
+            project="train", config="bert_train",
+            bench_id=f"bert_{'base' if on_tpu else 'tiny'}"
+                     f"_b{B}_t{T}_{name}",
+            metric="train_gflops", value=flops_per_step / step_s / 1e9,
+            unit="GFLOPS",
+            device=jax.devices()[0].platform, n_devices=1,
+            extra={"batch": B, "seq": T, "attn": name,
+                   "dtype": cfg.dtype,
+                   "flops_per_step": flops_per_step}))
+    if "flash" in times and "xla" in times:
+        rows.append(ResultRow(
+            project="train", config="bert_train",
+            bench_id=f"bert_b{B}_t{T}_flash_vs_xla",
+            metric="speedup", value=times["xla"] / times["flash"],
+            unit="x", device=jax.devices()[0].platform, n_devices=1,
+            extra={"xla_ms": times["xla"] * 1e3,
+                   "flash_ms": times["flash"] * 1e3}))
+    for r in rows:
+        print(f"  {r.bench_id} {r.metric}: {r.value:.2f} {r.unit}")
     return rows
 
 
@@ -561,6 +674,7 @@ RUNNERS = {
     "allreduce": run_allreduce,
     "resnet_train": run_resnet_train,
     "bert_kernels": run_bert_kernels,
+    "bert_train": run_bert_train,
     "detection_train": run_detection_train,
     "detection_infer": run_detection_infer,
     "speech_train": run_speech_train,

@@ -1,0 +1,199 @@
+"""Remote-machine bootstrap — the SSH-shaped training-service leg.
+
+The reference's remote training service does three things our
+:class:`~tosem_tpu.tune.providers.NodeAgentService` assumed away: it
+STARTS the remote environment itself over a shell transport, waits for
+it to come up, and tears it down afterwards
+(``ts/nni_manager/training_service/remote_machine/
+remoteMachineTrainingService.ts`` driving ``shellExecutor.ts``). This
+module supplies that leg:
+
+- :class:`CommandRunner` — the ``shellExecutor`` seam: run one shell
+  command, hand back the process. :class:`LocalRunner` executes on this
+  host (the ``ssh localhost`` stand-in CI uses); :class:`SshRunner`
+  wraps the command in ``ssh -o BatchMode=yes host``. Tests inject a
+  recording fake — the transport is fully mockable.
+- :func:`bootstrap_agent` — launch a node agent THROUGH a runner, read
+  its announced ``host:port`` off the transport's stdout (bounded), and
+  connect a :class:`~tosem_tpu.cluster.node.RemoteNode` to it. No code
+  upload step: the repo is the environment (the reference rsyncs a
+  codeDir; our agents import by PYTHONPATH).
+- :class:`BootstrapService` — a
+  :class:`~tosem_tpu.tune.providers.TrainingService` that bootstraps its
+  agents on construction and tears them down in ``shutdown()``, with
+  trials delegated to the agent trial plane (killable mid-flight).
+
+Cross-host reach note: agents bind loopback by design (`cluster/rpc.py`
+refuses public binds — the control plane is unauthenticated pickle), so
+a real multi-host deployment runs ``SshRunner`` with an ``ssh -L`` port
+forward per agent, exactly like the reference tunnels its gRPC channel.
+"""
+from __future__ import annotations
+
+import os
+import select
+import shlex
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+from tosem_tpu.cluster.node import RemoteNode
+
+__all__ = ["CommandRunner", "LocalRunner", "SshRunner",
+           "bootstrap_agent", "BootstrappedAgent", "BootstrapService"]
+
+
+class CommandRunner:
+    """The shellExecutor seam: run one shell command, return the Popen.
+    ``host`` is where the command's sockets are reachable."""
+
+    host = "127.0.0.1"
+
+    def popen(self, command: str) -> subprocess.Popen:
+        raise NotImplementedError
+
+
+class LocalRunner(CommandRunner):
+    """Execute on this host — CI's ``ssh localhost`` stand-in."""
+
+    def popen(self, command: str) -> subprocess.Popen:
+        return subprocess.Popen(["bash", "-c", command],
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.DEVNULL)
+
+
+class SshRunner(CommandRunner):
+    """Execute over ssh (BatchMode: key auth only, never an interactive
+    prompt wedging the manager — the reference's non-interactive
+    contract)."""
+
+    def __init__(self, host: str, user: Optional[str] = None,
+                 ssh_options: Sequence[str] = ()):
+        self.host = host
+        self._dest = f"{user}@{host}" if user else host
+        self._opts = list(ssh_options)
+
+    def popen(self, command: str) -> subprocess.Popen:
+        return subprocess.Popen(
+            ["ssh", "-o", "BatchMode=yes", *self._opts, self._dest,
+             command],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL)
+
+
+class BootstrappedAgent:
+    """A node agent this manager started and therefore owns."""
+
+    def __init__(self, node: RemoteNode, proc: subprocess.Popen):
+        self.node = node
+        self._proc = proc
+
+    def teardown(self) -> None:
+        self.node.close()
+        if self._proc.poll() is None:
+            self._proc.terminate()
+            try:
+                self._proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self._proc.kill()
+
+
+def _agent_command(num_workers: int, extra_sys_path: Sequence[str],
+                   python: str) -> str:
+    """One shell line that boots a node agent announcing on stdout.
+    PYTHONPATH rides inside the command — ssh does not forward env."""
+    repo_root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    path = os.pathsep.join([repo_root, *extra_sys_path])
+    args = " ".join(
+        ["--num-workers", str(num_workers)]
+        + [a for p in extra_sys_path for a in ("--path", shlex.quote(p))])
+    return (f"PYTHONPATH={shlex.quote(path)} exec {shlex.quote(python)} "
+            f"-c 'from tosem_tpu.cluster.node import main; main()' "
+            f"{args}")
+
+
+def bootstrap_agent(runner: CommandRunner, *, num_workers: int = 2,
+                    extra_sys_path: Sequence[str] = (),
+                    python: str = sys.executable,
+                    startup_timeout: float = 60.0) -> BootstrappedAgent:
+    """Start a node agent through ``runner`` and connect to it.
+
+    Reads the agent's ``host:port`` announcement from the transport's
+    stdout with a bounded wait (a wedged remote python must not hang the
+    manager), then rewrites the host to the runner's reachable address.
+    """
+    proc = runner.popen(_agent_command(num_workers, extra_sys_path,
+                                       python))
+    fd = proc.stdout.fileno()
+    line = b""
+    deadline = time.monotonic() + startup_timeout
+    while not line.endswith(b"\n"):
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            break
+        ready, _, _ = select.select([fd], [], [], remaining)
+        if not ready:
+            break
+        chunk = os.read(fd, 256)
+        if not chunk:
+            break                        # EOF: remote died pre-announce
+        line += chunk
+    if not line.endswith(b"\n"):
+        proc.kill()
+        raise RuntimeError(
+            f"agent failed to announce via {type(runner).__name__} "
+            f"within {startup_timeout}s")
+    _, _, port = line.decode().strip().rpartition(":")
+    node = RemoteNode(f"{runner.host}:{port}")
+    return BootstrappedAgent(node, proc)
+
+
+class BootstrapService:
+    """TrainingService that owns its agents' lifecycle: bootstrap over
+    shell transports at construction, run trials on the agents' killable
+    trial plane, tear everything down in ``shutdown()`` — the
+    remoteMachineTrainingService contract end to end."""
+
+    def __init__(self, runners: Sequence[CommandRunner], *,
+                 num_workers: int = 2,
+                 extra_sys_path: Sequence[str] = (),
+                 max_concurrent: int = 4,
+                 startup_timeout: float = 60.0):
+        from tosem_tpu.tune.providers import NodeAgentService
+        self._agents: List[BootstrappedAgent] = []
+        try:
+            for r in runners:
+                self._agents.append(bootstrap_agent(
+                    r, num_workers=num_workers,
+                    extra_sys_path=extra_sys_path,
+                    startup_timeout=startup_timeout))
+        except Exception:
+            self.shutdown()              # no half-bootstrapped leak
+            raise
+        self._inner = NodeAgentService(
+            [a.node for a in self._agents], max_concurrent=max_concurrent)
+
+    # -- TrainingService delegation ------------------------------------
+
+    def submit(self, trainable_ref: str, config: Dict[str, Any],
+               trial_id: str, max_iterations: int) -> None:
+        self._inner.submit(trainable_ref, config, trial_id,
+                           max_iterations)
+
+    def poll(self):
+        return self._inner.poll()
+
+    def cancel(self, trial_id: str) -> None:
+        self._inner.cancel(trial_id)
+
+    def shutdown(self) -> None:
+        inner = getattr(self, "_inner", None)
+        if inner is not None:
+            inner.shutdown()
+        for a in self._agents:
+            try:
+                a.teardown()
+            except Exception:
+                pass
+        self._agents = []

@@ -34,6 +34,7 @@ class _AgentHandlers:
 
     def __init__(self, num_workers: int):
         import multiprocessing as mp
+        import tempfile
         import threading
         self._pool = ProcessPoolExecutor(
             max_workers=num_workers, mp_context=mp.get_context("spawn"))
@@ -49,6 +50,12 @@ class _AgentHandlers:
         self._reserved: Dict[str, int] = {}
         self._active_general = 0
         self._active_pg: Dict[str, int] = {}
+        # trial plane: subprocess-backed so a RUNNING trial is actually
+        # killable (a pool future is not) — the remote training
+        # service's cancelTrialJob contract
+        self._trials: Dict[str, Dict[str, Any]] = {}
+        self._trials_lock = threading.Lock()
+        self._trial_dir = tempfile.mkdtemp(prefix="agent_trials_")
 
     def health(self) -> Dict[str, Any]:
         return {"ok": True, "pid": os.getpid(),
@@ -139,8 +146,125 @@ class _AgentHandlers:
             self._tasks_done += len(outs)
         return outs
 
+    # -- trial plane (remote training service) -------------------------
+
+    def start_trial(self, task_id: str, trainable_ref: str,
+                    config_json: str, max_iterations: int,
+                    pg: Optional[str] = None) -> None:
+        """Launch a trial as a dedicated killable subprocess. Returns
+        immediately; admission (the agent's slot gate) happens on a
+        background thread, so a full node queues the trial rather than
+        blocking the RPC."""
+        import threading
+        with self._trials_lock:
+            if task_id in self._trials:
+                raise ValueError(f"trial {task_id!r} already exists")
+            t = {"status": "WAITING", "proc": None, "error": "",
+                 "killed": False}
+            self._trials[task_id] = t
+
+        out = os.path.join(self._trial_dir, f"{task_id}.json")
+        progress = os.path.join(self._trial_dir, f"{task_id}.progress")
+        errp = os.path.join(self._trial_dir, f"{task_id}.err")
+
+        def work():
+            from tosem_tpu.tune.trial_worker import worker_argv
+            self._admit(pg)
+            try:
+                with self._trials_lock:
+                    if t["killed"]:
+                        t["status"] = "CANCELED"
+                        return
+                    env = dict(os.environ)
+                    env.setdefault("JAX_PLATFORMS", "cpu")
+                    # the agent's sys.path (repo root + --path extras)
+                    # must reach the child, or the trainable is not
+                    # importable there
+                    env["PYTHONPATH"] = os.pathsep.join(
+                        [p for p in sys.path if p])
+                    errf = open(errp, "wb")
+                    t["proc"] = subprocess.Popen(
+                        worker_argv(trainable_ref, config_json,
+                                    max_iterations, out, progress),
+                        env=env, stdout=subprocess.DEVNULL, stderr=errf)
+                    errf.close()
+                    t["status"] = "RUNNING"
+                rc = t["proc"].wait()
+                with self._trials_lock:
+                    if t["killed"]:
+                        t["status"] = "CANCELED"
+                    elif rc == 0 and os.path.exists(out):
+                        t["status"] = "SUCCEEDED"
+                    else:
+                        err = b""
+                        if os.path.exists(errp):
+                            with open(errp, "rb") as f:
+                                err = f.read()
+                        t["error"] = (f"rc={rc}: "
+                                      f"{err[-500:].decode(errors='replace')}")
+                        t["status"] = "FAILED"
+            except BaseException as e:
+                # a spawn failure (errfile open, fork, ENOMEM) must not
+                # strand the trial in WAITING with no diagnostic
+                with self._trials_lock:
+                    t["error"] = repr(e)
+                    t["status"] = "FAILED"
+            finally:
+                self._leave(pg)
+                with self._done_lock:
+                    self._tasks_done += 1
+
+        threading.Thread(target=work, daemon=True,
+                         name=f"trial-{task_id}").start()
+
+    def trial_status(self, task_id: str) -> Dict[str, Any]:
+        """Status + metrics-so-far (final result file when done, else
+        the progress stream — the intermediate-result side channel)."""
+        with self._trials_lock:
+            t = self._trials.get(task_id)
+            if t is None:
+                raise KeyError(f"unknown trial {task_id!r}")
+            status, error = t["status"], t["error"]
+        from tosem_tpu.tune.trial_worker import read_progress
+        metrics: List[Dict[str, Any]] = []
+        out = os.path.join(self._trial_dir, f"{task_id}.json")
+        if status == "SUCCEEDED" and os.path.exists(out):
+            import json
+            with open(out) as f:
+                metrics = json.load(f)["metrics"]
+        else:
+            metrics = read_progress(
+                os.path.join(self._trial_dir, f"{task_id}.progress"))
+        return {"status": status, "metrics": metrics, "error": error}
+
+    def kill_trial(self, task_id: str) -> bool:
+        """Cancel a trial in ANY live state: a WAITING one never starts,
+        a RUNNING one's subprocess is killed (partial metrics survive in
+        the progress file)."""
+        with self._trials_lock:
+            t = self._trials.get(task_id)
+            if t is None:
+                raise KeyError(f"unknown trial {task_id!r}")
+            if t["status"] in ("SUCCEEDED", "FAILED", "CANCELED"):
+                return False
+            t["killed"] = True
+            proc = t["proc"]
+            if t["status"] == "WAITING":
+                t["status"] = "CANCELED"
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+        return True
+
     def close(self) -> None:
         self._pool.shutdown(wait=False, cancel_futures=True)
+        with self._trials_lock:
+            procs = [t["proc"] for t in self._trials.values()
+                     if t["proc"] is not None]
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        import shutil
+        shutil.rmtree(self._trial_dir, ignore_errors=True)
 
 
 def serve(port: int = 0, num_workers: int = 2,
@@ -221,6 +345,21 @@ class RemoteNode:
         blobs = [pickle.dumps((fn, (it,), {})) for it in items]
         return [pickle.loads(b)
                 for b in self._client.call("run_batch", blobs)]
+
+    # -- trial plane ---------------------------------------------------
+
+    def start_trial(self, task_id: str, trainable_ref: str,
+                    config: Dict[str, Any], max_iterations: int,
+                    pg: Optional[str] = None) -> None:
+        import json
+        self._client.call("start_trial", task_id, trainable_ref,
+                          json.dumps(config), max_iterations, pg)
+
+    def trial_status(self, task_id: str) -> Dict[str, Any]:
+        return self._client.call("trial_status", task_id)
+
+    def kill_trial(self, task_id: str) -> bool:
+        return bool(self._client.call("kill_trial", task_id))
 
     # -- lifecycle -----------------------------------------------------
 

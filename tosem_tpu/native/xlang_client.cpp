@@ -1,0 +1,118 @@
+// Cross-language client: drive the framework's named-function surface
+// from C++ over the JSON wire (cluster/xlang.py).
+//
+// Role model: the reference's second-language APIs make CALLS into the
+// task plane, not just link a C ABI — Ray's Java worker invokes
+// registered Python functions by name across the language boundary
+// (src/ray/ray-1.1.0/java/api/, python/ray/cross_language.py). This
+// client is that boundary from C++: 4-byte big-endian length + UTF-8
+// JSON request {"method": m, "args": [...]}, same frame back.
+//
+// Usage:
+//   xlang_client <host> <port> <request-json>
+//     sends one request, prints the raw JSON response to stdout,
+//     exit 0 iff the response contains "ok": true.
+//   xlang_client <host> <port> --ping
+//     liveness convenience: {"method": "ping"}.
+//
+// JSON is composed by the CALLER (argv) and parsed only for the "ok"
+// flag — the client owns the wire, not a JSON library; that keeps the
+// cross-language contract visibly small (a screenful in any language).
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+int dial(const char* host, const char* port) {
+  addrinfo hints{};
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  addrinfo* res = nullptr;
+  if (getaddrinfo(host, port, &hints, &res) != 0 || res == nullptr) {
+    std::fprintf(stderr, "xlang_client: cannot resolve %s:%s\n", host, port);
+    return -1;
+  }
+  int fd = socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+  if (fd < 0 || connect(fd, res->ai_addr, res->ai_addrlen) != 0) {
+    std::fprintf(stderr, "xlang_client: connect failed\n");
+    if (fd >= 0) close(fd);
+    freeaddrinfo(res);
+    return -1;
+  }
+  freeaddrinfo(res);
+  return fd;
+}
+
+bool send_all(int fd, const char* buf, size_t n) {
+  while (n > 0) {
+    ssize_t w = write(fd, buf, n);
+    if (w <= 0) return false;
+    buf += w;
+    n -= static_cast<size_t>(w);
+  }
+  return true;
+}
+
+bool recv_all(int fd, char* buf, size_t n) {
+  while (n > 0) {
+    ssize_t r = read(fd, buf, n);
+    if (r <= 0) return false;
+    buf += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool send_frame(int fd, const std::string& payload) {
+  uint32_t len = htonl(static_cast<uint32_t>(payload.size()));
+  return send_all(fd, reinterpret_cast<const char*>(&len), 4) &&
+         send_all(fd, payload.data(), payload.size());
+}
+
+bool recv_frame(int fd, std::string* out) {
+  uint32_t len_be = 0;
+  if (!recv_all(fd, reinterpret_cast<char*>(&len_be), 4)) return false;
+  uint32_t len = ntohl(len_be);
+  if (len > (64u << 20)) return false;
+  out->resize(len);
+  return recv_all(fd, &(*out)[0], len);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 4) {
+    std::fprintf(stderr,
+                 "usage: %s <host> <port> <request-json>\n"
+                 "       %s <host> <port> --ping\n",
+                 argv[0], argv[0]);
+    return 2;
+  }
+  std::string request = argv[3];
+  if (request == "--ping") request = "{\"method\": \"ping\"}";
+
+  int fd = dial(argv[1], argv[2]);
+  if (fd < 0) return 1;
+  std::string response;
+  bool ok = send_frame(fd, request) && recv_frame(fd, &response);
+  close(fd);
+  if (!ok) {
+    std::fprintf(stderr, "xlang_client: wire error\n");
+    return 1;
+  }
+  std::printf("%s\n", response.c_str());
+  // success iff the gateway said so — tolerate whitespace variants
+  return (response.find("\"ok\": true") != std::string::npos ||
+          response.find("\"ok\":true") != std::string::npos)
+             ? 0
+             : 1;
+}

@@ -10,6 +10,7 @@ from tosem_tpu.obs.dashboard import (DashboardServer, render_html,
                                      render_text, snapshot)
 from tosem_tpu.obs.log_monitor import LogMonitor
 from tosem_tpu.obs.memory_monitor import MemoryMonitor
+from tosem_tpu.obs.sysmo import SysMo
 from tosem_tpu.obs.metrics import (Counter, Gauge, Histogram, MetricsServer,
                                    Registry, counter, gauge, histogram,
                                    prometheus_text)
@@ -18,5 +19,5 @@ __all__ = [
     "metrics", "Counter", "Gauge", "Histogram", "Registry", "MetricsServer",
     "counter", "gauge", "histogram", "prometheus_text", "MemoryMonitor",
     "LogMonitor", "DashboardServer", "snapshot", "render_text",
-    "render_html",
+    "render_html", "SysMo",
 ]

@@ -294,13 +294,23 @@ class ExperimentManager:
     def _run_via_service(self, name: str,
                          spec: Dict[str, Any]) -> Dict[str, Any]:
         from tosem_tpu.tune.providers import SERVICES, run_with_service
-        if spec.get("scheduler", "fifo") != "fifo":
-            # the service loop observes FINAL metrics only; silently
-            # dropping an early-stopping scheduler would be a lie
+        sched_name = spec.get("scheduler", "fifo")
+        if sched_name not in SCHEDULERS:
+            raise ValueError(f"unknown scheduler {sched_name!r}")
+        if sched_name == "pbt":
+            # the service loop honors STOP verdicts only; PBT needs the
+            # exploit/perturb directive path (save/restore through the
+            # in-process Trainable contract) — running it here would be
+            # a silent no-op degrading to random search
             raise ValueError(
-                "training_service runs support scheduler='fifo' only "
-                f"(got {spec['scheduler']!r}); use the in-process path "
-                "for early-stopping schedulers")
+                "training_service runs support stop-only schedulers "
+                "(asha/median/hyperband/curvefit); use the in-process "
+                "path for pbt")
+        # the service loop streams intermediate metrics, so
+        # early-stopping schedulers cancel RUNNING trials mid-flight
+        scheduler = (None if sched_name == "fifo" else
+                     SCHEDULERS[sched_name](
+                         **dict(spec.get("scheduler_args", {}))))
         svc_name = spec["training_service"]
         if svc_name not in SERVICES:
             raise ValueError(
@@ -320,6 +330,7 @@ class ExperimentManager:
                 max_iterations=int(spec.get("max_iterations", 100)),
                 search_alg=SEARCHERS[spec.get("search", "random")](
                     **dict(spec.get("search_args", {}))),
+                scheduler=scheduler,
                 max_in_flight=int(spec.get("max_concurrent", 4)),
                 timeout_s=float(spec.get("service_timeout_s", 600.0)))
         finally:

@@ -46,31 +46,46 @@ def resolve_target(ref: str):
 
 
 def run_trial(trainable_ref: str, config: Dict[str, Any],
-              max_iterations: int) -> Dict[str, Any]:
-    """Execute one trial to completion; returns {metrics: [...]}.
-    Shared by every service so placement never changes semantics."""
+              max_iterations: int, *, metrics_cb=None,
+              should_stop=None) -> Dict[str, Any]:
+    """Execute one trial; returns {metrics: [...]}. Shared by every
+    service so placement never changes semantics.
+
+    ``metrics_cb(m)`` streams each report as it lands (the NNI
+    ``nni.report_intermediate_result`` side channel) and
+    ``should_stop()`` is checked between iterations — the cooperative
+    cancellation point that lets a manager early-stop a RUNNING trial
+    (``cancelTrialJob`` on a live job, ``nnimanager.ts:633``)."""
     import inspect
 
     target = resolve_target(trainable_ref)
     metrics: List[Dict[str, Any]] = []
+
+    def record(m: Dict[str, Any], i: int) -> None:
+        m["training_iteration"] = i + 1
+        metrics.append(m)
+        if metrics_cb is not None:
+            metrics_cb(m)
+
     if inspect.isclass(target):
         t = target(config)
         for i in range(max_iterations):
+            if should_stop is not None and should_stop():
+                break
             try:
                 m = dict(t.step())
             except StopIteration:
                 break
-            m["training_iteration"] = i + 1
-            metrics.append(m)
+            record(m, i)
     else:
         gen = target(config)
         if not inspect.isgenerator(gen):
             raise TypeError("function trainables must be generators")
         for i, m in enumerate(gen):
-            m = dict(m)
-            m["training_iteration"] = i + 1
-            metrics.append(m)
+            record(dict(m), i)
             if i + 1 >= max_iterations:
+                break
+            if should_stop is not None and should_stop():
                 break
     return {"metrics": metrics}
 
@@ -103,17 +118,27 @@ class TrainingService(ABC):
 
 
 class LocalService(TrainingService):
-    """Trials on daemon threads in this process."""
+    """Trials on daemon threads in this process. A RUNNING trial is
+    cancelable cooperatively: ``cancel`` raises a stop flag checked
+    between iterations (threads cannot be killed; the iteration
+    boundary is exactly where ASHA/median-stop act anyway)."""
 
     def __init__(self, max_concurrent: int = 4):
         self._sem = threading.Semaphore(max_concurrent)
         self._jobs: Dict[str, TrialJob] = {}
+        self._stops: Dict[str, threading.Event] = {}
         self._lock = threading.Lock()
 
     def submit(self, trainable_ref, config, trial_id, max_iterations):
         job = TrialJob(trial_id, dict(config))
+        stop = threading.Event()
         with self._lock:
             self._jobs[trial_id] = job
+            self._stops[trial_id] = stop
+
+        def on_metric(m):
+            with self._lock:
+                job.metrics.append(m)
 
         def work():
             with self._sem:
@@ -122,10 +147,12 @@ class LocalService(TrainingService):
                         return
                     job.status = RUNNING
                 try:
-                    out = run_trial(trainable_ref, config, max_iterations)
+                    run_trial(trainable_ref, config, max_iterations,
+                              metrics_cb=on_metric,
+                              should_stop=stop.is_set)
                     with self._lock:
-                        job.metrics = out["metrics"]
-                        job.status = SUCCEEDED
+                        job.status = (CANCELED if stop.is_set()
+                                      else SUCCEEDED)
                 except BaseException as e:
                     with self._lock:
                         job.error = repr(e)
@@ -141,8 +168,14 @@ class LocalService(TrainingService):
     def cancel(self, trial_id):
         with self._lock:
             job = self._jobs.get(trial_id)
-            if job and job.status == WAITING:
+            if job is None:
+                return
+            if job.status == WAITING:
                 job.status = CANCELED
+            stop = self._stops.get(trial_id)
+        if stop is not None:
+            stop.set()          # a RUNNING trial stops at the next
+                                # iteration boundary and keeps partials
 
     def shutdown(self):
         pass
@@ -186,17 +219,22 @@ class SubprocessService(TrainingService):
                 env.setdefault("JAX_PLATFORMS", "cpu")
                 # stderr to a FILE, never a pipe: a chatty trial filling
                 # an undrained pipe buffer would block and hang forever
+                from tosem_tpu.tune.trial_worker import worker_argv
                 errf = open(os.path.join(self._dir, f"{tid}.err"), "wb")
                 proc = subprocess.Popen(
-                    [sys.executable, "-m", "tosem_tpu.tune.trial_worker",
-                     "--target", ref, "--config", json.dumps(config),
-                     "--max-iterations", str(iters),
-                     "--out", self._out_path(tid)],
+                    worker_argv(ref, json.dumps(config), iters,
+                                self._out_path(tid),
+                                os.path.join(self._dir,
+                                             f"{tid}.progress")),
                     env=env, stdout=subprocess.DEVNULL, stderr=errf)
                 errf.close()
                 self._procs[tid] = proc
                 job.status = RUNNING
                 running += 1
+
+    def _progress(self, tid: str) -> List[Dict[str, Any]]:
+        from tosem_tpu.tune.trial_worker import read_progress
+        return read_progress(os.path.join(self._dir, f"{tid}.progress"))
 
     def poll(self):
         with self._lock:
@@ -204,6 +242,9 @@ class SubprocessService(TrainingService):
         for tid, proc in items:
             rc = proc.poll()
             if rc is None:
+                # stream intermediate reports so schedulers can act on
+                # a trial that is still RUNNING
+                self._jobs[tid].metrics = self._progress(tid)
                 continue
             job = self._jobs[tid]
             if job.status not in (SUCCEEDED, FAILED, CANCELED):
@@ -234,6 +275,7 @@ class SubprocessService(TrainingService):
             proc = self._procs.get(trial_id)
         if proc is not None and proc.poll() is None:
             proc.kill()
+            job.metrics = self._progress(trial_id)   # keep partials
             job.status = CANCELED
 
     def shutdown(self):
@@ -249,9 +291,14 @@ class SubprocessService(TrainingService):
 
 class NodeAgentService(TrainingService):
     """Trials on remote node agents (cluster/node.py) — the remote
-    training service. Placement: round-robin across agents (the agent's
-    own admission gate queues beyond its pool); results return over the
-    RPC channel. Gang-safe: pass ``reservation`` (a
+    training service. Each trial runs as a dedicated killable
+    subprocess on its agent (the agent's trial plane): ``submit`` is a
+    non-blocking ``start_trial`` RPC, ``poll`` pulls status + the
+    intermediate-metric stream, and ``cancel`` kills a RUNNING trial
+    mid-flight (``cancelTrialJob``,
+    ``remoteMachineTrainingService.ts``). Placement: round-robin across
+    agents; the agent's own admission gate queues beyond its pool.
+    Gang-safe: pass ``reservation`` (a
     :class:`~tosem_tpu.cluster.gang.GangReservation`) to run inside a
     placement-group bundle."""
 
@@ -259,55 +306,93 @@ class NodeAgentService(TrainingService):
         self._nodes = list(nodes)
         if not self._nodes:
             raise ValueError("need at least one node agent")
-        self._sem = threading.Semaphore(max_concurrent)
+        self._max = max_concurrent
         self._jobs: Dict[str, TrialJob] = {}
+        self._node_of: Dict[str, Any] = {}
+        self._pending: List[tuple] = []
         self._lock = threading.Lock()
         self._rr = 0
         self._resv = reservation
 
     def submit(self, trainable_ref, config, trial_id, max_iterations):
-        job = TrialJob(trial_id, dict(config))
         with self._lock:
-            self._jobs[trial_id] = job
-            node = self._nodes[self._rr % len(self._nodes)]
-            self._rr += 1
+            self._jobs[trial_id] = TrialJob(trial_id, dict(config))
+            self._pending.append((trainable_ref, config, trial_id,
+                                  max_iterations))
+        self._pump()
 
-        def work():
-            with self._sem:
+    def _pump(self):
+        """Dispatch queued trials up to the manager-side cap (the
+        remote load bound the constructor advertises; the per-agent
+        admission gate bounds each node separately)."""
+        while True:
+            with self._lock:
+                live = sum(1 for tid, j in self._jobs.items()
+                           if j.status == RUNNING
+                           or (j.status == WAITING
+                               and tid in self._node_of))
+                if not self._pending or live >= self._max:
+                    return
+                ref, config, tid, iters = self._pending.pop(0)
+                job = self._jobs[tid]
+                if job.status == CANCELED:
+                    continue
+                node = self._nodes[self._rr % len(self._nodes)]
+                self._rr += 1
+                self._node_of[tid] = node
+            pg = None
+            if self._resv is not None \
+                    and node.address in self._resv.counts:
+                pg = self._resv.pg_id
+            try:
+                node.start_trial(tid, ref, config, iters, pg=pg)
+            except Exception as e:
                 with self._lock:
-                    if job.status == CANCELED:
-                        return
-                    job.status = RUNNING
-                try:
-                    kw = {}
-                    if self._resv is not None and \
-                            node.address in self._resv.counts:
-                        kw["_pg"] = self._resv.pg_id
-                    out = node.submit(run_trial, trainable_ref, config,
-                                      max_iterations, **kw)
-                    with self._lock:
-                        job.metrics = out["metrics"]
-                        job.status = SUCCEEDED
-                except BaseException as e:
-                    with self._lock:
-                        job.error = repr(e)
-                        job.status = FAILED
-
-        threading.Thread(target=work, daemon=True,
-                         name=f"trial-{trial_id}").start()
+                    job.error = repr(e)
+                    job.status = FAILED
 
     def poll(self):
+        self._pump()
+        with self._lock:
+            items = [(tid, job, self._node_of.get(tid))
+                     for tid, job in self._jobs.items()]
+        for tid, job, node in items:
+            if node is None or job.status in (SUCCEEDED, FAILED,
+                                              CANCELED):
+                continue
+            try:
+                st = node.trial_status(tid)
+            except Exception as e:
+                with self._lock:
+                    job.error = repr(e)
+                    job.status = FAILED
+                continue
+            with self._lock:
+                job.metrics = st["metrics"]
+                job.error = st["error"]
+                job.status = st["status"]
         with self._lock:
             return list(self._jobs.values())
 
     def cancel(self, trial_id):
         with self._lock:
             job = self._jobs.get(trial_id)
-            if job and job.status == WAITING:
-                job.status = CANCELED
+            node = self._node_of.get(trial_id)
+            if job is not None and node is None:
+                job.status = CANCELED    # still queued manager-side
+        if job is None or node is None:
+            return
+        try:
+            node.kill_trial(trial_id)
+        except Exception:
+            pass
 
     def shutdown(self):
-        pass
+        with self._lock:
+            items = list(self._jobs.items())
+        for tid, job in items:
+            if job.status in (WAITING, RUNNING):
+                self.cancel(tid)
 
 
 SERVICES = {
@@ -329,25 +414,58 @@ def _last_metric(metrics, key):
 def run_with_service(trainable_ref: str, space: Dict[str, Any], *,
                      service: TrainingService, metric: str, mode: str,
                      num_samples: int, max_iterations: int = 100,
-                     search_alg=None, poll_s: float = 0.2,
+                     search_alg=None, scheduler=None, poll_s: float = 0.2,
                      timeout_s: float = 600.0,
                      max_in_flight: int = 4) -> Dict[str, Any]:
     """Provider-agnostic manager loop: suggest → submit → poll → observe
     (the nni_manager core loop). Final metric feeds the search algorithm;
-    returns {trials, best_config, best_score}."""
+    returns {trials, best_config, best_score}.
+
+    ``scheduler`` (a :class:`~tosem_tpu.tune.schedulers.TrialScheduler`)
+    consumes the intermediate-metric stream every poll round and a STOP
+    verdict cancels the trial MID-FLIGHT through the service
+    (``cancelTrialJob`` on a running job) — ASHA/median-stop work
+    against remote agents, not just the in-process path."""
+    from tosem_tpu.tune.schedulers import CONTINUE as CONTINUE_TRIAL
+    from tosem_tpu.tune.schedulers import STOP as STOP_TRIAL
     from tosem_tpu.tune.search import RandomSearch
 
     if mode not in ("min", "max"):
         raise ValueError("mode must be min|max")
     alg = search_alg or RandomSearch()
     alg.set_space(space, mode)
+    if scheduler is not None:
+        scheduler.set_mode(metric, mode)
     sign = -1.0 if mode == "min" else 1.0
     configs: Dict[str, Dict[str, Any]] = {}
     submitted = 0
     observed: set = set()
+    fed: Dict[str, int] = {}          # intermediate reports already fed
+    stopped: set = set()              # trials the scheduler canceled
     deadline = time.monotonic() + timeout_s
     while True:
         jobs = {j.trial_id: j for j in service.poll()}
+        if scheduler is not None:
+            for tid, job in jobs.items():
+                new = job.metrics[fed.get(tid, 0):]
+                fed[tid] = fed.get(tid, 0) + len(new)
+                verdict = CONTINUE_TRIAL
+                for m in new:
+                    if m.get(metric) is None:
+                        continue
+                    verdict = scheduler.on_result(
+                        tid, int(m.get("training_iteration", fed[tid])),
+                        m)
+                    if verdict == STOP_TRIAL:
+                        break
+                if (verdict == STOP_TRIAL and tid not in stopped
+                        and job.status in (WAITING, RUNNING)):
+                    service.cancel(tid)
+                    stopped.add(tid)
+                if job.status not in (WAITING, RUNNING) \
+                        and tid not in stopped:
+                    scheduler.on_complete(tid)
+                    stopped.add(tid)  # terminal: no more feeding needed
         # stagger submissions so adaptive searchers (TPE/BOHB/evolution)
         # see earlier results before proposing later configs — submitting
         # everything up-front would silently degrade them to random
@@ -366,8 +484,10 @@ def run_with_service(trainable_ref: str, space: Dict[str, Any], *,
             if job is None or job.status in (WAITING, RUNNING):
                 done = False
                 continue
-            if tid not in observed and job.status == SUCCEEDED \
-                    and job.metrics:
+            if tid not in observed and job.metrics \
+                    and job.status in (SUCCEEDED, CANCELED):
+                # an early-stopped (CANCELED) trial's partial result
+                # still informs the searcher — Tune/ASHA semantics
                 val = _last_metric(job.metrics, metric)
                 if val is not None:
                     alg.observe(configs[tid], float(val))
@@ -384,7 +504,8 @@ def run_with_service(trainable_ref: str, space: Dict[str, Any], *,
     for tid, cfg in configs.items():
         job = jobs[tid]
         score = (_last_metric(job.metrics, metric)
-                 if job.status == SUCCEEDED and job.metrics else None)
+                 if job.status in (SUCCEEDED, CANCELED) and job.metrics
+                 else None)
         score = None if score is None else float(score)
         status, error = job.status, job.error
         if status == SUCCEEDED and score is None:

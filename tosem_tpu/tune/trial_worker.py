@@ -11,6 +11,33 @@ import json
 import os
 import sys
 
+# -- the launch/parse contract shared by every subprocess-backed trial
+# host (SubprocessService and the node agent's trial plane): one
+# definition so the worker flags and the progress format cannot diverge
+
+
+def worker_argv(target: str, config_json: str, max_iterations: int,
+                out_path: str, progress_path: str) -> list:
+    """Command line for one trial-worker process."""
+    return [sys.executable, "-m", "tosem_tpu.tune.trial_worker",
+            "--target", target, "--config", config_json,
+            "--max-iterations", str(max_iterations),
+            "--out", out_path, "--progress", progress_path]
+
+
+def read_progress(path: str) -> list:
+    """Parse the progress JSONL side channel; a torn tail line (the
+    worker mid-write) ends the read instead of erroring."""
+    out = []
+    if os.path.exists(path):
+        with open(path) as f:
+            for line in f:
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    break
+    return out
+
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
@@ -18,11 +45,22 @@ def main(argv=None) -> int:
     ap.add_argument("--config", required=True, help="JSON config dict")
     ap.add_argument("--max-iterations", type=int, default=100)
     ap.add_argument("--out", required=True, help="result JSON path")
+    ap.add_argument("--progress", default=None,
+                    help="JSONL path streaming one metric line per "
+                    "report (the intermediate-result side channel a "
+                    "manager polls to early-stop a RUNNING trial)")
     args = ap.parse_args(argv)
 
     from tosem_tpu.tune.providers import run_trial
+    metrics_cb = None
+    if args.progress:
+        pf = open(args.progress, "a", buffering=1)
+
+        def metrics_cb(m):
+            pf.write(json.dumps(m) + "\n")
+
     out = run_trial(args.target, json.loads(args.config),
-                    args.max_iterations)
+                    args.max_iterations, metrics_cb=metrics_cb)
     tmp = args.out + ".tmp"
     with open(tmp, "w") as f:
         json.dump(out, f)
