@@ -379,3 +379,47 @@ class TestCoroutineComponent:
         rt.run_until(2.0)
         assert got == [1, 2]
         assert rt._waiters.get("sensor", []) == []   # retired, not stuck
+
+
+class TestInteractiveDashboard:
+    """The interactive layer: live-poll script served with the page,
+    per-experiment trial drill-down endpoint, table ids for in-place
+    re-render (the NNI WebUI role beyond static SVG)."""
+
+    def test_page_carries_live_script_and_table_ids(self, tmp_path):
+        import urllib.request
+        from tosem_tpu.obs import DashboardServer
+        srv = DashboardServer(kv_path=str(tmp_path / "kv.db"))
+        try:
+            page = urllib.request.urlopen(srv.url, timeout=30).read().decode()
+            assert 'id="t-results"' in page and 'id="t-exp"' in page
+            assert 'fetch("/api")' in page          # live polling
+            assert 'id="pause"' in page             # pause control
+            assert "/api/experiment/" in page       # drill-down wiring
+        finally:
+            srv.shutdown()
+
+    def test_experiment_drilldown_endpoint(self, tmp_path):
+        import json as _json
+        import urllib.request
+        from tosem_tpu.tune.experiment import ExperimentManager
+        from tosem_tpu.obs import DashboardServer
+        db = str(tmp_path / "kv.db")
+        mgr = ExperimentManager(path=db)
+        mgr.create({"name": "exp1", "trainable": "x:y",
+                    "space": {}, "metric": "m", "mode": "max"})
+        mgr._set_state("exp1", {
+            "status": "done",
+            "trials": [{"trial_id": "t0", "status": "SUCCEEDED",
+                        "score": 0.9, "config": {"x": 1}}]})
+        srv = DashboardServer(kv_path=db)
+        try:
+            out = _json.loads(urllib.request.urlopen(
+                srv.url + "/api/experiment/exp1", timeout=30).read())
+            assert out["name"] == "exp1"
+            assert out["trials"][0]["trial_id"] == "t0"
+            missing = _json.loads(urllib.request.urlopen(
+                srv.url + "/api/experiment/nope", timeout=30).read())
+            assert missing["trials"] == []          # unknown -> empty
+        finally:
+            srv.shutdown()

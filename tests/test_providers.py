@@ -264,3 +264,20 @@ class TestCancelRunning:
         assert good["status"] == "SUCCEEDED"
         assert bad["status"] == "CANCELED"      # stopped while RUNNING
         assert out["best_config"]["lvl"] == 1.0
+
+
+class TestProgressIncremental:
+    def test_incr_read_consumes_only_complete_lines(self, tmp_path):
+        from tosem_tpu.tune.trial_worker import (read_progress,
+                                                 read_progress_incr)
+        p = str(tmp_path / "x.progress")
+        with open(p, "w") as f:
+            f.write('{"a": 1}\n{"a": 2}\n{"a": 3')   # torn tail
+        got, off = read_progress_incr(p, 0)
+        assert [m["a"] for m in got] == [1, 2]
+        # the torn line is NOT consumed; completing it resumes there
+        with open(p, "a") as f:
+            f.write('}\n{"a": 4}\n')
+        got2, off2 = read_progress_incr(p, off)
+        assert [m["a"] for m in got2] == [3, 4] and off2 > off
+        assert [m["a"] for m in read_progress(p)] == [1, 2, 3, 4]

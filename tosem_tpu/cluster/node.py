@@ -169,8 +169,13 @@ class _AgentHandlers:
 
         def work():
             from tosem_tpu.tune.trial_worker import worker_argv
-            self._admit(pg)
+            admitted = False
             try:
+                # inside the guard: an admission failure (e.g. the gang
+                # reservation was released while this trial queued) must
+                # fail the trial, not strand it in WAITING
+                self._admit(pg)
+                admitted = True
                 with self._trials_lock:
                     if t["killed"]:
                         t["status"] = "CANCELED"
@@ -204,38 +209,50 @@ class _AgentHandlers:
                                       f"{err[-500:].decode(errors='replace')}")
                         t["status"] = "FAILED"
             except BaseException as e:
-                # a spawn failure (errfile open, fork, ENOMEM) must not
-                # strand the trial in WAITING with no diagnostic
+                # a spawn/admission failure must not strand the trial in
+                # WAITING with no diagnostic
                 with self._trials_lock:
                     t["error"] = repr(e)
                     t["status"] = "FAILED"
             finally:
-                self._leave(pg)
+                if admitted:
+                    self._leave(pg)
                 with self._done_lock:
                     self._tasks_done += 1
 
         threading.Thread(target=work, daemon=True,
                          name=f"trial-{task_id}").start()
 
-    def trial_status(self, task_id: str) -> Dict[str, Any]:
-        """Status + metrics-so-far (final result file when done, else
-        the progress stream — the intermediate-result side channel)."""
+    def trial_status(self, task_id: str,
+                     since: int = 0) -> Dict[str, Any]:
+        """Status + metrics (final result file when done, else the
+        progress stream — the intermediate-result side channel).
+        ``since`` slices the returned metrics (the caller's count of
+        already-received reports) so a poll loop ships only the new
+        suffix; the agent itself reads the progress file incrementally
+        via a cached byte offset — O(new lines) on both sides."""
         with self._trials_lock:
             t = self._trials.get(task_id)
             if t is None:
                 raise KeyError(f"unknown trial {task_id!r}")
             status, error = t["status"], t["error"]
-        from tosem_tpu.tune.trial_worker import read_progress
-        metrics: List[Dict[str, Any]] = []
+        from tosem_tpu.tune.trial_worker import read_progress_incr
         out = os.path.join(self._trial_dir, f"{task_id}.json")
         if status == "SUCCEEDED" and os.path.exists(out):
             import json
             with open(out) as f:
                 metrics = json.load(f)["metrics"]
         else:
-            metrics = read_progress(
-                os.path.join(self._trial_dir, f"{task_id}.progress"))
-        return {"status": status, "metrics": metrics, "error": error}
+            with self._trials_lock:
+                new, off = read_progress_incr(
+                    os.path.join(self._trial_dir,
+                                 f"{task_id}.progress"),
+                    t.get("prog_off", 0))
+                t["prog_off"] = off
+                t.setdefault("prog_cache", []).extend(new)
+                metrics = list(t["prog_cache"])
+        return {"status": status, "metrics": metrics[since:],
+                "n_total": len(metrics), "error": error}
 
     def kill_trial(self, task_id: str) -> bool:
         """Cancel a trial in ANY live state: a WAITING one never starts,
@@ -355,8 +372,9 @@ class RemoteNode:
         self._client.call("start_trial", task_id, trainable_ref,
                           json.dumps(config), max_iterations, pg)
 
-    def trial_status(self, task_id: str) -> Dict[str, Any]:
-        return self._client.call("trial_status", task_id)
+    def trial_status(self, task_id: str,
+                     since: int = 0) -> Dict[str, Any]:
+        return self._client.call("trial_status", task_id, since)
 
     def kill_trial(self, task_id: str) -> bool:
         return bool(self._client.call("kill_trial", task_id))

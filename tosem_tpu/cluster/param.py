@@ -105,6 +105,20 @@ class ParameterServer:
         cur = self._kv.get(self._ns, _VERSION_KEY)
         return int(cur) if cur else 0
 
+    def rows(self) -> Dict[str, Tuple[Any, int]]:
+        """Full table view ``{name: (value, version)}`` — the scan the
+        per-key poller consumes."""
+        out: Dict[str, Tuple[Any, int]] = {}
+        for k in self._kv.keys(self._ns):
+            if k == _VERSION_KEY:
+                continue
+            raw = self._kv.get(self._ns, k)
+            if raw is None:
+                continue
+            row = json.loads(raw)
+            out[k] = (row["v"], row["version"])
+        return out
+
     def updates_since(self, version: int
                       ) -> List[Tuple[str, Any, int]]:
         """Changes with version > cursor, oldest first — the pull side
@@ -132,9 +146,16 @@ class ParameterServer:
 
 
 class ParameterPoller:
-    """Background version-cursor poller: turns cross-process parameter
+    """Background per-key version poller: turns cross-process parameter
     writes into callbacks (the subscriber half for processes that do not
-    share the writing :class:`ParameterServer` instance)."""
+    share the writing :class:`ParameterServer` instance).
+
+    Tracks the last-delivered version PER KEY (seeded from the table at
+    construction), not one global cursor: with a global cursor, a slow
+    writer whose allocated version lands AFTER a faster writer's higher
+    version has been observed would slip below the cursor and never be
+    delivered. Per-key comparison delivers any row whose version moved,
+    regardless of cross-key allocation order."""
 
     def __init__(self, server: ParameterServer,
                  callback: Callable[[str, Any, int], None],
@@ -142,7 +163,8 @@ class ParameterPoller:
         self._server = server
         self._callback = callback
         self._poll_s = poll_s
-        self._cursor = server.version()
+        self._seen: Dict[str, int] = {
+            k: ver for k, (_v, ver) in server.rows().items()}
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="param-poller")
@@ -150,10 +172,20 @@ class ParameterPoller:
 
     def _run(self) -> None:
         while not self._stop.is_set():
-            for name, value, version in self._server.updates_since(
-                    self._cursor):
-                self._callback(name, value, version)
-                self._cursor = version
+            try:
+                rows = self._server.rows()
+            except Exception:
+                rows = {}               # a flaky store: retry next tick
+            changed = [(k, v, ver) for k, (v, ver) in rows.items()
+                       if ver != self._seen.get(k)]
+            for k, v, ver in sorted(changed, key=lambda r: r[2]):
+                self._seen[k] = ver
+                try:
+                    self._callback(k, v, ver)
+                except Exception:
+                    # one sick subscriber callback must not kill the
+                    # poller and silently drop all future updates
+                    pass
             self._stop.wait(self._poll_s)
 
     def close(self) -> None:

@@ -128,15 +128,18 @@ def render_text(snap: Dict[str, Any]) -> str:
     return "\n".join(lines)
 
 
-def _table(rows: List[Dict[str, Any]], cols: List[str]) -> str:
+def _table(rows: List[Dict[str, Any]], cols: List[str],
+           table_id: str = "") -> str:
+    ident = f' id="{table_id}"' if table_id else ""
     if not rows:
-        return "<p><em>none</em></p>"
+        return (f"<table{ident}><tr></tr></table><p><em>none</em></p>"
+                if table_id else "<p><em>none</em></p>")
     head = "".join(f"<th>{html.escape(c)}</th>" for c in cols)
     body = "".join(
         "<tr>" + "".join(f"<td>{html.escape(str(r.get(c, '')))}</td>"
                          for c in cols) + "</tr>"
         for r in rows)
-    return f"<table><tr>{head}</tr>{body}</table>"
+    return f"<table{ident}><tr>{head}</tr>{body}</table>"
 
 
 def _svg_chart(values: List[float], *, width: int = 360, height: int = 90,
@@ -214,17 +217,121 @@ def render_html(snap: Dict[str, Any]) -> str:
 <p>{html.escape(time.ctime(snap['timestamp']))} &mdash;
 rss {mem['rss_bytes']/1e6:.1f} MB, available
 {mem['available_bytes']/1e9:.2f} GB</p>
+<p><button id="pause">pause</button> refresh every
+<select id="ival"><option>2</option><option>5</option><option>10</option>
+</select>s &mdash; <span id="stamp"></span></p>
 <h2>Runtime</h2>{_table(rt_rows, ["key", "value"])}
-<h2>Metrics</h2>{_table(snap['metrics'], ["series", "value"])}
-<h2>Experiments</h2>{_table(snap['experiments'],
-                            ["name", "status", "best_score", "n_trials"])}
+<h2>Metrics</h2>{_table(snap['metrics'], ["series", "value"],
+                        table_id="t-metrics")}
+<h2>Experiments <small>(click a row for trials)</small></h2>
+{_table(snap['experiments'],
+        ["name", "status", "best_score", "n_trials"],
+        table_id="t-exp")}
+<div id="exp-detail"></div>
 {_experiment_charts(snap['experiments'])}
 <h2>Deployments</h2>{_table(snap.get('deployments', []),
                             ["name", "replicas", "load"])}
-<h2>Recent results</h2>{_table(snap['results'],
-                               ["config", "bench_id", "metric", "value",
-                                "unit", "device"])}
+<h2>Recent results <small>(click a header to sort)</small></h2>
+{_table(snap['results'],
+        ["config", "bench_id", "metric", "value", "unit", "device"],
+        table_id="t-results")}
 {_results_charts(snap['results'])}
+<script>
+// live dashboard: poll /api and re-render in place — the interactive
+// layer (auto-refresh, pause, sortable results, per-experiment trial
+// drill-down) the server-side SVG charts alone did not give
+const COLS = {{
+  "t-metrics": ["series", "value"],
+  "t-exp": ["name", "status", "best_score", "n_trials"],
+  "t-results": ["config", "bench_id", "metric", "value", "unit",
+                "device"],
+}};
+let paused = false, sortCol = null, sortDir = -1, lastSnap = null;
+// all values land in innerHTML: escape EVERYTHING user-supplied
+// (experiment names, bench ids, configs) or the live re-render undoes
+// the server-side html.escape
+function esc(v) {{
+  return String(v ?? "").replace(/[&<>"']/g, (ch) => ({{
+    "&": "&amp;", "<": "&lt;", ">": "&gt;",
+    '"': "&quot;", "'": "&#39;"}})[ch]);
+}}
+function fill(id, rows) {{
+  const t = document.getElementById(id);
+  if (!t || !rows) return;
+  const cols = COLS[id];
+  let h = "<tr>" + cols.map(c => `<th data-c="${{esc(c)}}">${{esc(c)}}</th>`)
+                       .join("") + "</tr>";
+  for (const r of rows)
+    h += "<tr>" + cols.map(c => `<td>${{esc(r[c])}}</td>`)
+                      .join("") + "</tr>";
+  t.innerHTML = h;
+}}
+function renderResults() {{
+  let rows = (lastSnap && lastSnap.results) || [];
+  if (sortCol !== null) {{
+    rows = [...rows].sort((a, b) => {{
+      const x = a[sortCol], y = b[sortCol];
+      return (typeof x === "number" && typeof y === "number"
+              ? x - y : String(x).localeCompare(String(y))) * sortDir;
+    }});
+  }}
+  fill("t-results", rows);
+}}
+async function tick() {{
+  if (paused) return;
+  try {{
+    lastSnap = await (await fetch("/api")).json();
+    fill("t-metrics", lastSnap.metrics);
+    fill("t-exp", lastSnap.experiments);
+    renderResults();
+    document.getElementById("stamp").textContent =
+      "live @ " + new Date(lastSnap.timestamp * 1000)
+                    .toLocaleTimeString();
+  }} catch (e) {{
+    document.getElementById("stamp").textContent = "poll failed: " + e;
+  }}
+}}
+document.getElementById("pause").onclick = (e) => {{
+  paused = !paused;
+  e.target.textContent = paused ? "resume" : "pause";
+}};
+let timer = setInterval(tick, 2000);
+document.getElementById("ival").onchange = (e) => {{
+  clearInterval(timer);
+  timer = setInterval(tick, Number(e.target.value) * 1000);
+}};
+document.addEventListener("click", async (ev) => {{
+  const th = ev.target.closest("#t-results th");
+  if (th) {{
+    const c = th.dataset.c;
+    // before the first poll the server-rendered <th> has no data-c and
+    // lastSnap is null — sorting then would blank the table
+    if (!c || !lastSnap) return;
+    sortDir = (sortCol === c) ? -sortDir : -1;
+    sortCol = c;
+    renderResults();
+    return;
+  }}
+  const row = ev.target.closest("#t-exp tr");
+  if (row && row.rowIndex > 0) {{
+    const name = row.cells[0].textContent;
+    const d = await (await fetch(
+      "/api/experiment/" + encodeURIComponent(name))).json();
+    const div = document.getElementById("exp-detail");
+    if (d.error) {{
+      div.innerHTML = `<p><em>${{esc(d.error)}}</em></p>`;
+      return;
+    }}
+    let h = `<h3>trials of ${{esc(name)}}</h3><table><tr><th>trial</th>` +
+            `<th>status</th><th>score</th><th>config</th></tr>`;
+    for (const t of d.trials)
+      h += `<tr><td>${{esc(t.trial_id)}}</td><td>${{esc(t.status)}}` +
+           `</td><td>${{esc(t.score)}}</td>` +
+           `<td>${{esc(JSON.stringify(t.config))}}</td></tr>`;
+    div.innerHTML = h + "</table>";
+  }}
+}});
+</script>
 </body></html>"""
 
 
@@ -254,6 +361,18 @@ class DashboardServer:
             if path.startswith("/metrics"):
                 return (200, "text/plain; version=0.0.4",
                         _metrics.prometheus_text().encode())
+            if path.startswith("/api/experiment/"):
+                # trial drill-down for the interactive layer
+                from urllib.parse import unquote
+                name = unquote(path[len("/api/experiment/"):].split("?")[0])
+                if mgr is None:
+                    body = {"error": "no experiment store attached"}
+                else:
+                    try:
+                        body = {"name": name, "trials": mgr.results(name)}
+                    except Exception as e:
+                        body = {"error": repr(e)}
+                return (200, "application/json", json.dumps(body).encode())
             if path.startswith("/api"):
                 return (200, "application/json",
                         json.dumps(snapshot(**kw)).encode())

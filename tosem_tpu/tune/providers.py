@@ -194,6 +194,8 @@ class SubprocessService(TrainingService):
         self._jobs: Dict[str, TrialJob] = {}
         self._procs: Dict[str, subprocess.Popen] = {}
         self._queue: List[tuple] = []
+        self._prog_off: Dict[str, int] = {}
+        self._prog_cache: Dict[str, List[Dict[str, Any]]] = {}
         self._lock = threading.Lock()
 
     def submit(self, trainable_ref, config, trial_id, max_iterations):
@@ -233,8 +235,15 @@ class SubprocessService(TrainingService):
                 running += 1
 
     def _progress(self, tid: str) -> List[Dict[str, Any]]:
-        from tosem_tpu.tune.trial_worker import read_progress
-        return read_progress(os.path.join(self._dir, f"{tid}.progress"))
+        # incremental: keep a byte offset per trial so a poll loop over
+        # a long trial's stream stays O(new lines)
+        from tosem_tpu.tune.trial_worker import read_progress_incr
+        new, off = read_progress_incr(
+            os.path.join(self._dir, f"{tid}.progress"),
+            self._prog_off.get(tid, 0))
+        self._prog_off[tid] = off
+        self._prog_cache.setdefault(tid, []).extend(new)
+        return self._prog_cache[tid]
 
     def poll(self):
         with self._lock:
@@ -309,6 +318,7 @@ class NodeAgentService(TrainingService):
         self._max = max_concurrent
         self._jobs: Dict[str, TrialJob] = {}
         self._node_of: Dict[str, Any] = {}
+        self._poll_errs: Dict[str, int] = {}
         self._pending: List[tuple] = []
         self._lock = threading.Lock()
         self._rr = 0
@@ -361,14 +371,33 @@ class NodeAgentService(TrainingService):
                                               CANCELED):
                 continue
             try:
-                st = node.trial_status(tid)
+                st = node.trial_status(tid, since=len(job.metrics))
             except Exception as e:
-                with self._lock:
-                    job.error = repr(e)
-                    job.status = FAILED
+                # one transient RPC hiccup (timeout on a loaded agent)
+                # must not permanently fail a healthy trial; after
+                # repeated failures, give up AND kill the remote side so
+                # it does not run on holding an agent slot
+                n = self._poll_errs.get(tid, 0) + 1
+                self._poll_errs[tid] = n
+                if n >= 3:
+                    with self._lock:
+                        job.error = repr(e)
+                        job.status = FAILED
+                    try:
+                        node.kill_trial(tid)
+                    except Exception:
+                        pass
                 continue
+            self._poll_errs.pop(tid, None)
+            prefix = max(0, st["n_total"] - len(st["metrics"]))
+            if prefix > len(job.metrics):
+                # agent knows more history than our slice assumed
+                # (should not happen; refetch whole rather than corrupt)
+                st = node.trial_status(tid)
+                prefix = 0
             with self._lock:
-                job.metrics = st["metrics"]
+                # the agent sliced at our count: extend, don't replace
+                job.metrics = job.metrics[:prefix] + st["metrics"]
                 job.error = st["error"]
                 job.status = st["status"]
         with self._lock:

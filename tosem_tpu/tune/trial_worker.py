@@ -25,18 +25,35 @@ def worker_argv(target: str, config_json: str, max_iterations: int,
             "--out", out_path, "--progress", progress_path]
 
 
-def read_progress(path: str) -> list:
-    """Parse the progress JSONL side channel; a torn tail line (the
-    worker mid-write) ends the read instead of erroring."""
+def read_progress_incr(path: str, offset: int = 0) -> tuple:
+    """Incremental progress read from a byte ``offset``: returns
+    ``(new_entries, new_offset)``. Only COMPLETE lines are consumed —
+    a torn tail (the worker mid-write) stays un-consumed so the next
+    read retries it. Pollers keep the offset per trial, making a
+    lifetime of polling O(total lines), not O(n²)."""
+    if not os.path.exists(path):
+        return [], offset
+    with open(path, "rb") as f:
+        f.seek(offset)
+        blob = f.read()
     out = []
-    if os.path.exists(path):
-        with open(path) as f:
-            for line in f:
-                try:
-                    out.append(json.loads(line))
-                except json.JSONDecodeError:
-                    break
-    return out
+    consumed = 0
+    for line in blob.split(b"\n"):
+        # the final split element is either b"" (trailing newline —
+        # nothing torn) or a partial line to leave for next time
+        if consumed + len(line) + 1 > len(blob):
+            break
+        try:
+            out.append(json.loads(line))
+        except json.JSONDecodeError:
+            break
+        consumed += len(line) + 1
+    return out, offset + consumed
+
+
+def read_progress(path: str) -> list:
+    """Whole-file convenience wrapper over :func:`read_progress_incr`."""
+    return read_progress_incr(path, 0)[0]
 
 
 def main(argv=None) -> int:
