@@ -25,7 +25,8 @@ if [[ "$QUICK" == "1" ]]; then
     tests/test_sharding.py tests/test_serial.py tests/test_utils.py \
     tests/test_analysis.py tests/test_image_ops.py tests/test_htm.py \
     tests/test_compress.py tests/test_scorer.py tests/test_ring.py \
-    tests/test_moe.py tests/test_pipeline.py
+    tests/test_moe.py tests/test_pipeline.py tests/test_routing.py \
+    tests/test_control_prediction.py tests/test_planning.py
   echo "== quick CI green"
   exit 0
 fi

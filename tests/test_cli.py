@@ -89,3 +89,34 @@ def test_bert_train_ab_loss_parity():
     assert abs(losses["xla"] - losses["flash"]) < 1e-4
     assert sum(r.metric == "train_gflops" for r in rows) == 2
     assert any(r.metric == "speedup" for r in rows)
+
+
+def test_analysis_config_runs_and_skips_absent_reference(tmp_path):
+    """--config=analysis: RQ tables over our suite; the replication leg
+    engages only when the study mount exists."""
+    from tosem_tpu.cli import make_flags, run_analysis
+    fs = make_flags()
+    fs.set("device", "cpu")
+    fs.set("analysis_out", str(tmp_path / "out"))
+    fs.set("reference_dir", str(tmp_path / "nope"))   # absent -> skip
+    rows = run_analysis(fs)
+    ids = [r.bench_id for r in rows]
+    assert "tests_with_strategy" in ids
+    assert not any(i.startswith("replication_") for i in ids)
+
+
+@pytest.mark.slow
+def test_analysis_config_replication_rows(tmp_path):
+    from tosem_tpu.analysis.replicate import SUBJECTS, _subject_root
+    if not all(_subject_root("/root/reference", rel)
+               for rel, _ in SUBJECTS.values()):
+        pytest.skip("study reference mount absent or partial")
+    from tosem_tpu.cli import make_flags, run_analysis
+    fs = make_flags()
+    fs.set("device", "cpu")
+    fs.set("analysis_out", str(tmp_path / "out"))
+    rows = run_analysis(fs)
+    rep = {r.bench_id: r for r in rows
+           if r.bench_id.startswith("replication_")}
+    assert len(rep) == 4
+    assert all(r.value > 0.5 for r in rep.values())   # rank agreement

@@ -337,3 +337,40 @@ class TestParameterServer:
         ps.set("nms_threshold", 0.45)
         rtc.run_until(1.0)
         assert got == [("nms_threshold", 0.45)]
+
+    def test_poller_delivers_late_lower_version_write(self, tmp_path):
+        """Cross-key race regression: a write whose allocated version is
+        LOWER than one the poller already observed (slow writer landing
+        late) must still be delivered — per-key version tracking, not a
+        global cursor."""
+        import json as _json
+        import time as _t
+        from tosem_tpu.cluster.kv import KVStore
+        from tosem_tpu.cluster.param import (_NS, ParameterPoller,
+                                             ParameterServer)
+        path = str(tmp_path / "p.db")
+        writer = ParameterServer(KVStore(path))
+        writer.set("seed", 0)                      # v1, pre-poller
+        reader = ParameterServer(KVStore(path))
+        seen = []
+        poller = ParameterPoller(reader, lambda n, v, ver:
+                                 seen.append((n, v, ver)), poll_s=0.02)
+        try:
+            writer.set("fast", "B")                # v2: observed first
+            deadline = _t.monotonic() + 10
+            while not any(n == "fast" for n, _, _ in seen) \
+                    and _t.monotonic() < deadline:
+                _t.sleep(0.02)
+            # simulate the slow writer: its row (allocated BEFORE v2,
+            # landing AFTER) appears with a version below the max seen
+            writer._kv.put(_NS, "slow",
+                           _json.dumps({"v": "A", "version": 1}).encode())
+            deadline = _t.monotonic() + 10
+            while not any(n == "slow" for n, _, _ in seen) \
+                    and _t.monotonic() < deadline:
+                _t.sleep(0.02)
+        finally:
+            poller.close()
+        assert ("slow", "A", 1) in seen            # not lost below cursor
+        assert any(n == "fast" for n, _, _ in seen)
+        assert not any(n == "seed" for n, _, _ in seen)  # pre-existing

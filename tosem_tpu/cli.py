@@ -60,6 +60,9 @@ def make_flags() -> FlagSet:
                      "test-suite directory for the analysis config")
     fs.define_string("analysis_out", "results/analysis",
                      "output directory for the analysis config's RQ tables")
+    fs.define_string("reference_dir", "/root/reference",
+                     "study checkout for the replication leg (skipped "
+                     "when absent)")
     return fs
 
 
@@ -662,6 +665,26 @@ def run_analysis(fs: FlagSet) -> List[Any]:
                "n_strategies": summary["n_strategies"],
                "n_projects": summary["n_projects"],
                "bench_correlations": summary["bench_correlations"]}))
+    # replication leg: when the study checkout is mounted, also score
+    # our classifier against the published per-repo strategy tables
+    if os.path.isdir(os.path.join(fs.reference_dir, "src")):
+        from tosem_tpu.analysis.replicate import run_replication
+        try:
+            rep = run_replication(fs.reference_dir, out_dir)
+        except FileNotFoundError as e:
+            # a PARTIAL study mount: drop the replication leg only,
+            # never the RQ3/RQ4 rows computed above
+            print(f"  replication leg skipped: {e}")
+            rep = {}
+        for a in rep.get("strategy_agreement", []):
+            rows.append(ResultRow(
+                project="analysis", config="analysis",
+                bench_id=f"replication_{a['project']}",
+                metric="spearman", value=float(a["spearman"]),
+                unit="rank-corr", device="host",
+                extra={"top_overlap": a["top_overlap"],
+                       "top_k": a["top_k"],
+                       "n_shared": a["n_shared_strategies"]}))
     for r in rows:
         print(f"  {r.bench_id}: {r.value:g} {r.unit}")
     print(f"  tables -> {out_dir}/")
