@@ -63,6 +63,10 @@ def make_flags() -> FlagSet:
     fs.define_string("reference_dir", "/root/reference",
                      "study checkout for the replication leg (skipped "
                      "when absent)")
+    fs.define_string("remat", "none",
+                     "bert_train activation remat: none|full|dots "
+                     "(recompute layer activations in backward — "
+                     "FLOPs for HBM)")
     return fs
 
 
@@ -265,8 +269,15 @@ def run_bert_train(fs: FlagSet) -> List[Any]:
                                          cross_entropy_loss, variables)
     from tosem_tpu.utils.results import ResultRow
 
+    from dataclasses import replace as _replace
+
     on_tpu = fs.device == "tpu"
     cfg = BertConfig.base() if on_tpu else BertConfig.tiny()
+    if fs.remat not in ("none", "full", "dots"):
+        raise ValueError(f"--remat must be none|full|dots, "
+                         f"got {fs.remat!r}")
+    if fs.remat != "none":
+        cfg = _replace(cfg, remat=fs.remat)
     B = fs.batch or (8 if on_tpu else 2)
     T = fs.seq or (512 if on_tpu else 64)
     T = min(T, cfg.max_len)
@@ -308,6 +319,9 @@ def run_bert_train(fs: FlagSet) -> List[Any]:
                     "state": new_state, "opt_state": opt_state}, loss
         return step
 
+    # remat runs carry their own bench_id suffix so downstream
+    # aggregation keyed on bench_id never mixes remat and baseline rows
+    tag = "" if cfg.remat == "none" else f"_remat-{cfg.remat}"
     rows, times = [], {}
     for name, afn in (("xla", None), ("flash", flash_attn_fn())):
         step = make_step(afn)
@@ -327,26 +341,26 @@ def run_bert_train(fs: FlagSet) -> List[Any]:
         rows.append(ResultRow(
             project="train", config="bert_train",
             bench_id=f"bert_{'base' if on_tpu else 'tiny'}"
-                     f"_b{B}_t{T}_{name}",
+                     f"_b{B}_t{T}_{name}{tag}",
             metric="step_time_ms", value=step_s * 1e3, unit="ms",
             device=jax.devices()[0].platform, n_devices=1,
             extra={"batch": B, "seq": T, "attn": name,
                    "final_loss": loss, "params": n_params,
-                   "dtype": cfg.dtype}))
+                   "dtype": cfg.dtype, "remat": cfg.remat}))
         rows.append(ResultRow(
             project="train", config="bert_train",
             bench_id=f"bert_{'base' if on_tpu else 'tiny'}"
-                     f"_b{B}_t{T}_{name}",
+                     f"_b{B}_t{T}_{name}{tag}",
             metric="train_gflops", value=flops_per_step / step_s / 1e9,
             unit="GFLOPS",
             device=jax.devices()[0].platform, n_devices=1,
             extra={"batch": B, "seq": T, "attn": name,
-                   "dtype": cfg.dtype,
+                   "dtype": cfg.dtype, "remat": cfg.remat,
                    "flops_per_step": flops_per_step}))
     if "flash" in times and "xla" in times:
         rows.append(ResultRow(
             project="train", config="bert_train",
-            bench_id=f"bert_b{B}_t{T}_flash_vs_xla",
+            bench_id=f"bert_b{B}_t{T}_flash_vs_xla{tag}",
             metric="speedup", value=times["xla"] / times["flash"],
             unit="x", device=jax.devices()[0].platform, n_devices=1,
             extra={"xla_ms": times["xla"] * 1e3,

@@ -30,6 +30,13 @@ class BertConfig:
     dropout: float = 0.1
     dtype: str = "bfloat16"
     precision: str = "default"
+    # activation rematerialization: recompute each encoder layer's
+    # activations in the backward pass instead of keeping them in HBM —
+    # the FLOPs-for-memory trade that makes long-context / large-batch
+    # training fit (jax.checkpoint around the per-layer apply;
+    # "dots_with_no_batch_dims_saveable" keeps the MXU matmul outputs
+    # and recomputes only the cheap elementwise chain)
+    remat: str = "none"          # none | full | dots
     # MoE variant (0 experts = dense FFN everywhere): every
     # ``moe_every``-th layer swaps its MLP for a routed expert layer
     moe_experts: int = 0
@@ -188,10 +195,29 @@ class Bert(Module):
         rngs = split_key(rng, len(self.layers) + 1)
         h, _ = self.drop.apply(variables({}), h, train=train, rng=rngs[0])
         moe_aux = jnp.float32(0.0)
+        remat_wrap = None
+        if self.cfg.remat not in ("none", "full", "dots"):
+            raise ValueError(
+                f"unknown remat mode {self.cfg.remat!r}; "
+                "expected none|full|dots")
+        if self.cfg.remat != "none":
+            policy = (jax.checkpoint_policies
+                      .dots_with_no_batch_dims_saveable
+                      if self.cfg.remat == "dots" else None)
+
+            def remat_wrap(layer):
+                def run(lp, x, rng):
+                    return layer.apply(variables(lp), x, mask=attn_mask,
+                                       train=train, rng=rng,
+                                       attn_fn=attn_fn)
+                return jax.checkpoint(run, policy=policy)
         for i, l in enumerate(self.layers):
-            h, lstate = l.apply(variables(p[f"layer{i}"]), h,
-                                mask=attn_mask, train=train,
-                                rng=rngs[i + 1], attn_fn=attn_fn)
+            if remat_wrap is not None:
+                h, lstate = remat_wrap(l)(p[f"layer{i}"], h, rngs[i + 1])
+            else:
+                h, lstate = l.apply(variables(p[f"layer{i}"]), h,
+                                    mask=attn_mask, train=train,
+                                    rng=rngs[i + 1], attn_fn=attn_fn)
             if isinstance(lstate, dict) and "moe_aux" in lstate:
                 moe_aux = moe_aux + lstate["moe_aux"]
         h, _ = self.ln_out.apply(variables(p["ln_out"]), h)
