@@ -1,0 +1,117 @@
+"""Scenario-lite (models/scenario.py): per-cycle scenario selection
+with asymmetric hysteresis parameterizing the planning tasks — the
+``scenario_manager.cc`` contract minus the config plumbing.
+"""
+import numpy as np
+import pytest
+
+from tosem_tpu.dataflow.components import Component, ComponentRuntime
+from tosem_tpu.models.control import PlanningComponent
+from tosem_tpu.models.prediction import PredictionComponent
+from tosem_tpu.models.scenario import (EMERGENCY_STOP, LANE_FOLLOW,
+                                       OBSTACLE_AVOID, ScenarioComponent,
+                                       ScenarioManager)
+
+PAD = [-1.0, -2.0, 0.0, 0.0]
+
+
+class TestManagerRules:
+    def test_clear_road_is_lane_follow(self):
+        m = ScenarioManager()
+        assert m.select([PAD, PAD], ego_v=8.0) == LANE_FOLLOW
+        assert m.params().v_ref == m.cruise_v
+
+    def test_passable_obstacle_is_avoid(self):
+        m = ScenarioManager()
+        # obstacle leaves the whole left half-lane free
+        assert m.select([[20.0, 24.0, -1.75, 0.0], PAD], 8.0) \
+            == OBSTACLE_AVOID
+        assert m.params().v_ref == m.avoid_v
+
+    def test_full_lane_blocker_inside_braking_distance_is_emergency(self):
+        m = ScenarioManager(a_brake=3.0, margin_m=5.0)
+        blocker = [[12.0, 16.0, -1.75, 1.75], PAD]
+        # 8 m/s: brake distance 64/6 + 5 ≈ 15.7 > s0=12 → emergency
+        assert m.select(blocker, ego_v=8.0) == EMERGENCY_STOP
+        p = m.params()
+        assert p.v_ref == 0.0 and p.hard_fence
+
+    def test_far_blocker_is_avoid_not_emergency(self):
+        m = ScenarioManager()
+        assert m.select([[60.0, 64.0, -1.75, 1.75], PAD], 8.0) \
+            == OBSTACLE_AVOID
+
+    def test_escalation_immediate_deescalation_dwells(self):
+        m = ScenarioManager(min_dwell=3)
+        blocker = [[10.0, 14.0, -1.75, 1.75], PAD]
+        assert m.select([PAD], 8.0) == LANE_FOLLOW
+        # escalate instantly
+        assert m.select(blocker, 8.0) == EMERGENCY_STOP
+        # road clears: stays emergency for min_dwell cycles
+        assert m.select([PAD], 8.0) == EMERGENCY_STOP
+        assert m.select([PAD], 8.0) == EMERGENCY_STOP
+        assert m.select([PAD], 8.0) == LANE_FOLLOW   # 3rd calm cycle
+        # an interrupted dwell resets
+        assert m.select(blocker, 8.0) == EMERGENCY_STOP
+        assert m.select([PAD], 8.0) == EMERGENCY_STOP
+        assert m.select(blocker, 8.0) == EMERGENCY_STOP
+        assert m.select([PAD], 8.0) == EMERGENCY_STOP
+
+
+class TestScenarioInPipeline:
+    def test_emergency_stops_the_speed_profile(self):
+        """prediction → scenario → planning: a close full-lane blocker
+        flips the scenario and the planned profile stops short of it."""
+        rtc = ComponentRuntime()
+        rtc.add(PredictionComponent(frame_dt=1.0, horizon=1.0, dt=0.5,
+                                    max_k=2))
+        rtc.add(ScenarioComponent())
+        rtc.add(PlanningComponent(in_channel="planning_request",
+                                  n=64, ds=1.0, v_init=8.0))
+        out = []
+
+        class Sink(Component):
+            def __init__(self):
+                super().__init__("sink", ["trajectory"])
+
+            def proc(self, traj, *f):
+                out.append(traj)
+
+        rtc.add(Sink())
+        ego_w = rtc.writer("ego")
+        tracks_w = rtc.writer("tracks")
+        ego_w({"v": 8.0})
+        # static wall dead ahead spanning the lane, 14 m out
+        tracks_w([{"track_id": 1, "box": [14.0, -1.75, 18.0, 1.75]}])
+        rtc.run_until(1.0)
+        assert len(out) == 1
+        traj = out[0]
+        assert traj["scenario"] == EMERGENCY_STOP
+        assert traj["v_ref"] == 0.0
+        assert traj["stop_fence"] <= 13.0
+        assert traj["s_profile"].max() <= traj["stop_fence"] + 0.5
+
+    def test_clear_road_cruises(self):
+        rtc = ComponentRuntime()
+        rtc.add(PredictionComponent(frame_dt=1.0, max_k=2))
+        rtc.add(ScenarioComponent())
+        rtc.add(PlanningComponent(in_channel="planning_request",
+                                  n=64, ds=1.0, v_init=8.0))
+        out = []
+
+        class Sink(Component):
+            def __init__(self):
+                super().__init__("sink", ["trajectory"])
+
+            def proc(self, traj, *f):
+                out.append(traj)
+
+        rtc.add(Sink())
+        rtc.writer("ego")({"v": 8.0})
+        rtc.writer("tracks")([])
+        rtc.run_until(1.0)
+        traj = out[0]
+        assert traj["scenario"] == LANE_FOLLOW
+        assert traj["v_ref"] == pytest.approx(8.0)
+        # profile actually advances at cruise speed
+        assert traj["s_profile"].max() > 40.0

@@ -15,6 +15,9 @@ from tosem_tpu.models.routing import (Lane, LaneGraph, RoutingComponent,
 from tosem_tpu.models.prediction import (predict_rollout, swept_obstacles,
                                          TrackVelocityEstimator,
                                          PredictionComponent)
+from tosem_tpu.models.scenario import (ScenarioManager, ScenarioComponent,
+                                       LANE_FOLLOW, OBSTACLE_AVOID,
+                                       EMERGENCY_STOP)
 from tosem_tpu.models.control import (VehicleParams, PidGains, lqr_gain,
                                       lateral_gain, track_trajectory,
                                       track_candidates, PlanningComponent,

@@ -235,14 +235,13 @@ class PlanningComponent(Component):
         l1 nor below l0 inside the lane band) → stop short of it; else
         the end of the planning horizon. The ST-boundary 'stop decision'
         of the reference's speed-bounds decider, reduced to statics."""
+        from tosem_tpu.models.planning import (blocks_lane,
+                                               live_obstacle_rows)
         fence = (self.n - 1) * self.ds
-        for s0, s1, l0, l1 in np.asarray(obstacles, np.float32):
-            if s0 > s1 or s1 < 0.0:
-                continue            # padding / behind ego
-            room_right = l0 - (-self.lane_half)
-            room_left = self.lane_half - l1
-            if max(room_right, room_left) < self.MIN_PASS_GAP:
-                fence = min(fence, max(float(s0) - 1.0, 0.0))
+        for row in live_obstacle_rows(obstacles):
+            if blocks_lane(row, lane_half=self.lane_half,
+                           min_pass_gap=self.MIN_PASS_GAP):
+                fence = min(fence, max(row[0] - 1.0, 0.0))
         return fence
 
     def proc(self, pred, *fused):
@@ -250,13 +249,27 @@ class PlanningComponent(Component):
         obstacles = jnp.asarray(pred["obstacles"], jnp.float32)
         path, cost, idx = plan_path(obstacles, n=self.n, ds=self.ds,
                                     lane_half=self.lane_half)
-        fence = jnp.float32(self._stop_fence(pred["obstacles"]))
-        sprof, scost = plan_speed(fence, n_t=self.n_t, dt=self.dt,
-                                  v_init=self.v_init, v_ref=self.v_init)
+        # a scenario layer may parameterize the same optimizers: target
+        # speed and a hard (brake-now) fence ride in the request
+        v_ref = float(pred.get("v_ref", self.v_init))
+        fence = self._stop_fence(pred["obstacles"])
+        if pred.get("hard_fence"):
+            # emergency scenario: stop short of the NEAREST live
+            # obstacle even if the pass-gap rule would allow dodging
+            from tosem_tpu.models.planning import live_obstacle_rows
+            live = live_obstacle_rows(pred["obstacles"])
+            if live:
+                fence = min(fence,
+                            max(min(r[0] for r in live) - 1.0, 0.0))
+        sprof, scost = plan_speed(jnp.float32(fence), n_t=self.n_t,
+                                  dt=self.dt, v_init=self.v_init,
+                                  v_ref=v_ref)
         self._write({"path_l": np.asarray(path),
                      "s_profile": np.asarray(sprof),
                      "cost": float(cost), "candidate": int(idx),
-                     "stop_fence": float(fence)})
+                     "stop_fence": float(fence),
+                     "scenario": pred.get("scenario"),
+                     "v_ref": v_ref})
 
 
 class ControlComponent(Component):

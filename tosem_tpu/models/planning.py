@@ -223,6 +223,26 @@ def plan_speed(stop_s: jax.Array, *, n_t: int = 40, dt: float = 0.25,
     return sprof, cost
 
 
+def live_obstacle_rows(obstacles):
+    """Non-padding, not-behind-ego rows of a ``[K, 4]`` obstacle array —
+    the one liveness filter shared by the scenario rules, the planner's
+    stop fence, and the emergency hard-fence path."""
+    return [(float(s0), float(s1), float(l0), float(l1))
+            for s0, s1, l0, l1 in np.asarray(obstacles, np.float32)
+            if s0 <= s1 and s1 >= 0.0]
+
+
+def blocks_lane(row, *, lane_half: float = 1.75,
+                min_pass_gap: float = 0.4) -> bool:
+    """True when a Frenet row leaves less than ``min_pass_gap`` of
+    lateral room on BOTH sides of the lane band — the full-lane-blocker
+    predicate (shared so scenario and planner can never disagree about
+    which obstacles block)."""
+    _s0, _s1, l0, l1 = row
+    room = max(l0 - (-lane_half), lane_half - l1)
+    return room < min_pass_gap
+
+
 def pad_obstacle_rows(rows, *, lane_half: float = 1.75,
                       max_k: int = 3) -> jax.Array:
     """Candidate Frenet rows ``(s0, s1, l0, l1)`` → static ``[max_k, 4]``
