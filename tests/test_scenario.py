@@ -91,6 +91,35 @@ class TestScenarioInPipeline:
         assert traj["stop_fence"] <= 13.0
         assert traj["s_profile"].max() <= traj["stop_fence"] + 0.5
 
+    def test_factory_shares_one_geometry(self):
+        """build_driving_pipeline: one lane_half / pass-gap reaches the
+        scenario rules AND the planner fence, and prediction fields
+        (velocities) survive the scenario pass-through."""
+        from tosem_tpu.models.control import build_driving_pipeline
+
+        rtc = ComponentRuntime()
+        pred, scen, plan, ctl = build_driving_pipeline(
+            rtc, lane_half=2.5, min_pass_gap=0.6, frame_dt=1.0,
+            horizon=1.0)
+        assert scen.manager.lane_half == plan.lane_half == 2.5
+        assert scen.manager.min_pass_gap == plan.MIN_PASS_GAP == 0.6
+        got = []
+
+        class Sink(Component):
+            def __init__(self):
+                super().__init__("sink", ["planning_request"])
+
+            def proc(self, req, *f):
+                got.append(req)
+
+        rtc.add(Sink())
+        rtc.writer("ego")({"v": 8.0})
+        rtc.writer("tracks")(
+            [{"track_id": 1, "box": [30.0, -0.5, 34.0, 0.5]}])
+        rtc.run_until(1.0)
+        assert "velocities" in got[0]        # pass-through preserved
+        assert got[0]["scenario"] == OBSTACLE_AVOID
+
     def test_clear_road_cruises(self):
         rtc = ComponentRuntime()
         rtc.add(PredictionComponent(frame_dt=1.0, max_k=2))

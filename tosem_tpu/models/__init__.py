@@ -21,4 +21,5 @@ from tosem_tpu.models.scenario import (ScenarioManager, ScenarioComponent,
 from tosem_tpu.models.control import (VehicleParams, PidGains, lqr_gain,
                                       lateral_gain, track_trajectory,
                                       track_candidates, PlanningComponent,
-                                      ControlComponent)
+                                      ControlComponent,
+                                      build_driving_pipeline)

@@ -230,17 +230,20 @@ class PlanningComponent(Component):
     #: full-lane blocker and forces a stop fence
     MIN_PASS_GAP = 0.4
 
-    def _stop_fence(self, obstacles: np.ndarray) -> float:
+    def _stop_fence(self, obstacles: np.ndarray,
+                    hard: bool = False) -> float:
         """Nearest obstacle that blocks both pass sides (no room above
         l1 nor below l0 inside the lane band) → stop short of it; else
         the end of the planning horizon. The ST-boundary 'stop decision'
-        of the reference's speed-bounds decider, reduced to statics."""
+        of the reference's speed-bounds decider, reduced to statics.
+        ``hard`` (the emergency scenario) fences the nearest LIVE
+        obstacle even when the pass-gap rule would allow dodging."""
         from tosem_tpu.models.planning import (blocks_lane,
                                                live_obstacle_rows)
         fence = (self.n - 1) * self.ds
         for row in live_obstacle_rows(obstacles):
-            if blocks_lane(row, lane_half=self.lane_half,
-                           min_pass_gap=self.MIN_PASS_GAP):
+            if hard or blocks_lane(row, lane_half=self.lane_half,
+                                   min_pass_gap=self.MIN_PASS_GAP):
                 fence = min(fence, max(row[0] - 1.0, 0.0))
         return fence
 
@@ -252,15 +255,8 @@ class PlanningComponent(Component):
         # a scenario layer may parameterize the same optimizers: target
         # speed and a hard (brake-now) fence ride in the request
         v_ref = float(pred.get("v_ref", self.v_init))
-        fence = self._stop_fence(pred["obstacles"])
-        if pred.get("hard_fence"):
-            # emergency scenario: stop short of the NEAREST live
-            # obstacle even if the pass-gap rule would allow dodging
-            from tosem_tpu.models.planning import live_obstacle_rows
-            live = live_obstacle_rows(pred["obstacles"])
-            if live:
-                fence = min(fence,
-                            max(min(r[0] for r in live) - 1.0, 0.0))
+        fence = self._stop_fence(pred["obstacles"],
+                                 hard=bool(pred.get("hard_fence")))
         sprof, scost = plan_speed(jnp.float32(fence), n_t=self.n_t,
                                   dt=self.dt, v_init=self.v_init,
                                   v_ref=v_ref)
@@ -270,6 +266,35 @@ class PlanningComponent(Component):
                      "stop_fence": float(fence),
                      "scenario": pred.get("scenario"),
                      "v_ref": v_ref})
+
+
+def build_driving_pipeline(runtime, *, lane_half: float = 1.75,
+                           min_pass_gap: float = 0.4,
+                           cruise_v: float = 8.0, avoid_v: float = 5.0,
+                           n: int = 64, ds: float = 1.0,
+                           frame_dt: float = 0.1, horizon: float = 5.0,
+                           max_k: int = 3,
+                           params: VehicleParams = VehicleParams()):
+    """Wire prediction → scenario → planning → control with ONE shared
+    geometry (lane_half / pass gap / speeds) so the scenario rules and
+    the planner's fence can never disagree about which obstacles block
+    — the wiring-level guarantee the shared predicates alone cannot
+    give. Returns the four components after adding them to ``runtime``.
+    """
+    from tosem_tpu.models.prediction import PredictionComponent
+    from tosem_tpu.models.scenario import ScenarioComponent, ScenarioManager
+    pred = PredictionComponent(frame_dt=frame_dt, horizon=horizon,
+                               lane_half=lane_half, max_k=max_k)
+    scen = ScenarioComponent(ScenarioManager(
+        cruise_v=cruise_v, avoid_v=avoid_v, lane_half=lane_half,
+        min_pass_gap=min_pass_gap))
+    plan = PlanningComponent(in_channel="planning_request", n=n, ds=ds,
+                             lane_half=lane_half, v_init=cruise_v)
+    plan.MIN_PASS_GAP = min_pass_gap
+    ctl = ControlComponent(params=params, ds=ds)
+    for c in (pred, scen, plan, ctl):
+        runtime.add(c)
+    return pred, scen, plan, ctl
 
 
 class ControlComponent(Component):

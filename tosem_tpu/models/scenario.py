@@ -133,7 +133,7 @@ class ScenarioComponent(Component):
         ego_v = float(ego["v"]) if ego else self.manager.cruise_v
         scenario = self.manager.select(pred["obstacles"], ego_v)
         p = self.manager.params(scenario)
-        self._write({"obstacles": pred["obstacles"],
-                     "scenario": scenario,
-                     "v_ref": p.v_ref,
+        # pass the prediction message THROUGH (velocities etc. stay
+        # available downstream); the scenario layer only adds fields
+        self._write({**pred, "scenario": scenario, "v_ref": p.v_ref,
                      "hard_fence": p.hard_fence})
