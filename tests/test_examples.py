@@ -21,8 +21,9 @@ EXAMPLES = sorted(
 
 def test_inventory_pinned():
     """New examples must join the smoke matrix, not dodge it."""
-    assert EXAMPLES == ["quickstart_gang.py", "quickstart_hpo.py",
-                       "quickstart_serve.py", "quickstart_train.py"]
+    assert EXAMPLES == ["quickstart_driving.py", "quickstart_gang.py",
+                       "quickstart_hpo.py", "quickstart_serve.py",
+                       "quickstart_train.py"]
 
 
 @pytest.mark.slow

@@ -9,6 +9,9 @@ from tosem_tpu.models.pointpillars import (PillarFeatureNet, PillarGrid,
 from tosem_tpu.models.planning import (plan_path, plan_speed,
                                        obstacles_from_tracks,
                                        solve_corridor)
+from tosem_tpu.models.perception import (DetectionComponent,
+                                         TrackerComponent,
+                                         GreedyIouTracker)
 from tosem_tpu.models.routing import (Lane, LaneGraph, RoutingComponent,
                                       a_star, batched_sssp,
                                       route_reference)

@@ -37,7 +37,8 @@ from tosem_tpu.dataflow.components import Component
 
 __all__ = ["VehicleParams", "PidGains", "bicycle_matrices", "discretize",
            "lqr_gain", "lateral_gain", "track_trajectory",
-           "track_candidates", "PlanningComponent", "ControlComponent"]
+           "track_candidates", "PlanningComponent", "ControlComponent",
+           "build_driving_pipeline"]
 
 
 @dataclass(frozen=True)
@@ -216,19 +217,19 @@ class PlanningComponent(Component):
     def __init__(self, *, in_channel: str = "predicted_obstacles",
                  out_channel: str = "trajectory", n: int = 64,
                  ds: float = 1.0, lane_half: float = 1.75,
-                 n_t: int = 40, dt: float = 0.25, v_init: float = 8.0):
+                 n_t: int = 40, dt: float = 0.25, v_init: float = 8.0,
+                 min_pass_gap: float = 0.4):
         super().__init__("planning", [in_channel])
         self.out_channel = out_channel
         self.n, self.ds, self.lane_half = n, ds, lane_half
         self.n_t, self.dt, self.v_init = n_t, dt, v_init
+        # lateral clearance needed to squeeze past an obstacle on
+        # either side; a corridor leaving less than this on BOTH sides
+        # is a full-lane blocker and forces a stop fence
+        self.MIN_PASS_GAP = min_pass_gap
 
     def on_init(self, ctx):
         self._write = ctx.writer(self.out_channel)
-
-    #: lateral clearance needed to squeeze past an obstacle on either
-    #: side; a corridor leaving less than this on BOTH sides is a
-    #: full-lane blocker and forces a stop fence
-    MIN_PASS_GAP = 0.4
 
     def _stop_fence(self, obstacles: np.ndarray,
                     hard: bool = False) -> float:
@@ -289,8 +290,8 @@ def build_driving_pipeline(runtime, *, lane_half: float = 1.75,
         cruise_v=cruise_v, avoid_v=avoid_v, lane_half=lane_half,
         min_pass_gap=min_pass_gap))
     plan = PlanningComponent(in_channel="planning_request", n=n, ds=ds,
-                             lane_half=lane_half, v_init=cruise_v)
-    plan.MIN_PASS_GAP = min_pass_gap
+                             lane_half=lane_half, v_init=cruise_v,
+                             min_pass_gap=min_pass_gap)
     ctl = ControlComponent(params=params, ds=ds)
     for c in (pred, scen, plan, ctl):
         runtime.add(c)
