@@ -73,7 +73,9 @@ class TestPlacementGroupLocal:
         done = []
 
         def gang(tag):
-            pg = rt.placement_group(3, timeout=30)
+            # generous acquisition timeout: under a loaded CI box the
+            # other gang's 3 tasks can take tens of seconds to drain
+            pg = rt.placement_group(3, timeout=120)
             try:
                 refs = [f.options(placement_group=pg).remote(5)
                         for _ in range(3)]
