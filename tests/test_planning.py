@@ -171,3 +171,25 @@ class TestPerceptionHandoff:
         s = np.arange(48) * 1.0
         inside = (s >= 20) & (s <= 25)
         assert np.all(np.asarray(l)[inside] >= 0.4 - 1e-3)
+
+
+class TestPlannerFuzz:
+    def test_random_obstacle_sets_never_nan(self):
+        """Property sweep: any random (possibly degenerate) obstacle set
+        must yield a finite path inside the lane band; cost may be inf
+        only when every corridor is infeasible."""
+        import numpy as np
+        from tosem_tpu.models.planning import pad_obstacle_rows, plan_path
+
+        rng = np.random.default_rng(7)
+        for trial in range(25):
+            k = int(rng.integers(0, 4))
+            raw = rng.uniform(-10.0, 70.0, (k, 4))
+            # random degeneracies: swapped corners, behind-ego, off-lane
+            rows = [(r[0], r[1], r[2] / 20.0, r[3] / 20.0) for r in raw]
+            obstacles = pad_obstacle_rows(rows, max_k=3)
+            path, cost, idx = plan_path(obstacles, n=32, ds=1.0)
+            path = np.asarray(path)
+            assert np.isfinite(path).all(), (trial, rows)
+            assert (np.abs(path) <= 1.75 + 0.75).all(), (trial, path)
+            assert np.isfinite(float(cost)) or float(cost) == np.inf
