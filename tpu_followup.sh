@@ -30,4 +30,10 @@ python -m tosem_tpu.cli --device=tpu --config=resnet_train \
     --steps=20 --converge_steps=600 --target_acc=0.6 --lr=0.05 \
     --results_csv=results/convergence.csv
 
+echo "== [4/4] bert_train remat A/B (HBM-for-FLOPs trade, on-chip)"
+python -m tosem_tpu.cli --device=tpu --config=bert_train --steps=20 \
+    --remat=dots --results_csv=results/tpu_full.csv
+python -m tosem_tpu.cli --device=tpu --config=bert_train --steps=20 \
+    --remat=full --results_csv=results/tpu_full.csv
+
 echo "== TPU follow-up complete; commit results/ + REPORT.md"
