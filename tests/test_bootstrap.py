@@ -140,3 +140,102 @@ class TestBootstrapService:
         with pytest.raises(RuntimeError):
             BootstrapService([ok, DeadRunner()], num_workers=1,
                              startup_timeout=10.0)
+
+
+class TestElasticAgentPool:
+    """Node-level elasticity: one Autoscaler policy drives WHOLE-AGENT
+    launches/teardowns over the shell transport (the reference
+    autoscaler's node-launcher + idle-terminate contract)."""
+
+    def test_burst_scales_up_drain_scales_down(self):
+        from tosem_tpu.cluster.autoscaler import Autoscaler, AutoscalerConfig
+        from tosem_tpu.cluster.bootstrap import ElasticAgentPool
+        from tosem_tpu.tune.providers import NodeAgentService
+
+        svc_ref = {}
+
+        def demand():
+            svc = svc_ref.get("svc")
+            if svc is None:
+                return 0
+            return sum(1 for j in svc.poll() if j.status == "WAITING")
+
+        pool = ElasticAgentPool(LocalRunner, num_workers=1,
+                                min_agents=1, max_agents=3,
+                                extra_sys_path=[TESTS_DIR],
+                                demand_fn=demand)
+        try:
+            # manager cap near per-agent capacity: queued trials stay
+            # manager-side, so agents that join MID-RUN pick them up
+            svc = NodeAgentService(pool.nodes, max_concurrent=2)
+            svc_ref["svc"] = svc
+            scaler = Autoscaler(
+                AutoscalerConfig(min_workers=1, max_workers=3,
+                                 backlog_per_worker=1.0,
+                                 idle_ticks_before_downscale=2,
+                                 max_scale_up_per_tick=1),
+                stats_fn=pool.stats, add_fn=pool.scale_up,
+                remove_fn=pool.scale_down)
+
+            # burst: 6 slow-ish trials onto a single 1-slot agent
+            for i in range(6):
+                svc.submit("test_providers:slow_scored_trainable",
+                           {"lvl": 1.0, "sleep": 0.05}, f"t{i}", 4)
+            d1 = scaler.tick()
+            assert d1["added"] == 1 and len(pool.agents) == 2
+            scaler.tick()
+            assert len(pool.agents) == 3          # capped at max_agents
+            scaler.tick()
+            assert len(pool.agents) == 3
+
+            # drain, then idle ticks terminate the extra agents
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                jobs = svc.poll()
+                if all(j.status in ("SUCCEEDED", "FAILED", "CANCELED")
+                       for j in jobs):
+                    break
+                time.sleep(0.2)
+            assert all(j.status == "SUCCEEDED" for j in svc.poll())
+            # live nodes list: the agents launched MID-RUN actually
+            # served trials (service picked them up without a rebuild)
+            served = [n.stats()["tasks_done"] for n in pool.nodes]
+            assert len(served) == 3 and all(s >= 1 for s in served), served
+            removed = 0
+            for _ in range(10):
+                removed += scaler.tick()["removed"]
+                if len(pool.agents) == 1:
+                    break
+            assert len(pool.agents) == 1          # back to min_agents
+            assert removed >= 2
+        finally:
+            svc_ref.clear()
+            pool.shutdown()
+
+    def test_scale_down_spares_busy_agents(self):
+        from tosem_tpu.cluster.bootstrap import ElasticAgentPool
+        from tosem_tpu.tune.providers import NodeAgentService
+
+        pool = ElasticAgentPool(LocalRunner, num_workers=1,
+                                min_agents=1, max_agents=2,
+                                extra_sys_path=[TESTS_DIR])
+        try:
+            pool.scale_up()
+            assert len(pool.agents) == 2
+            svc = NodeAgentService(pool.nodes)
+            # busy the NEWEST agent (round-robin: second submit)
+            svc.submit("test_providers:slow_scored_trainable",
+                       {"lvl": 1.0, "sleep": 0.3}, "tb0", 50)
+            svc.submit("test_providers:slow_scored_trainable",
+                       {"lvl": 1.0, "sleep": 0.3}, "tb1", 50)
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                if all(j.status == "RUNNING" for j in svc.poll()):
+                    break
+                time.sleep(0.1)
+            # both agents have a live trial: idle-terminate must refuse
+            assert pool.scale_down() is False
+            assert len(pool.agents) == 2
+            svc.cancel("tb0"); svc.cancel("tb1")
+        finally:
+            pool.shutdown()

@@ -1,8 +1,9 @@
 from tosem_tpu.cluster.autoscaler import Autoscaler, AutoscalerConfig
 from tosem_tpu.cluster.discovery import (Registry, deregister_actor,
                                          get_actor, register_actor)
-from tosem_tpu.cluster.bootstrap import (BootstrapService, LocalRunner,
-                                         SshRunner, bootstrap_agent)
+from tosem_tpu.cluster.bootstrap import (BootstrapService, ElasticAgentPool,
+                                         LocalRunner, SshRunner,
+                                         bootstrap_agent)
 from tosem_tpu.cluster.kv import KVStore
 from tosem_tpu.cluster.node import RemoteNode
 from tosem_tpu.cluster.param import ParameterPoller, ParameterServer

@@ -35,13 +35,15 @@ import select
 import shlex
 import subprocess
 import sys
+import threading
 import time
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from tosem_tpu.cluster.node import RemoteNode
 
 __all__ = ["CommandRunner", "LocalRunner", "SshRunner",
-           "bootstrap_agent", "BootstrappedAgent", "BootstrapService"]
+           "bootstrap_agent", "BootstrappedAgent", "BootstrapService",
+           "ElasticAgentPool"]
 
 
 class CommandRunner:
@@ -147,6 +149,130 @@ def bootstrap_agent(runner: CommandRunner, *, num_workers: int = 2,
     _, _, port = line.decode().strip().rpartition(":")
     node = RemoteNode(f"{runner.host}:{port}")
     return BootstrappedAgent(node, proc)
+
+
+class ElasticAgentPool:
+    """Node-level elasticity over the shell transport — the reference
+    autoscaler's node-launcher half (``python/ray/autoscaler/``:
+    demand converts into NODE launches, idle nodes terminate). Here a
+    "node launch" is :func:`bootstrap_agent` through a
+    :class:`CommandRunner` factory, and the pool's hooks plug straight
+    into :class:`~tosem_tpu.cluster.autoscaler.Autoscaler`
+    (``stats_fn``/``add_fn``/``remove_fn``), so ONE scaling policy
+    drives in-process workers and whole agents alike.
+
+    ``nodes`` is a LIVE list (mutated in place): hand it to a
+    :class:`~tosem_tpu.tune.providers.NodeAgentService` and newly
+    launched agents join the round-robin immediately.
+    """
+
+    def __init__(self, runner_factory: Callable[[], CommandRunner], *,
+                 num_workers: int = 1, min_agents: int = 1,
+                 max_agents: int = 4,
+                 extra_sys_path: Sequence[str] = (),
+                 demand_fn: Optional[Callable[[], int]] = None,
+                 startup_timeout: float = 60.0):
+        self._factory = runner_factory
+        self._num_workers = num_workers
+        self.min_agents, self.max_agents = min_agents, max_agents
+        self._extra = list(extra_sys_path)
+        self._timeout = startup_timeout
+        self._demand = demand_fn or (lambda: 0)
+        # protects agents/nodes against the Autoscaler.run() monitor
+        # thread racing the owner's shutdown()/stats(). NOTE: the
+        # scale_down idle check remains check-then-act against a
+        # concurrently dispatching service — downscale with the service
+        # quiesced, or accept the (bounded) chance of killing a trial
+        # admitted in that window.
+        self._lock = threading.Lock()
+        self._closed = False
+        self.agents: List[BootstrappedAgent] = []
+        self.nodes: List[RemoteNode] = []     # live view for services
+        try:
+            for _ in range(min_agents):
+                self.scale_up()
+        except Exception:
+            self.shutdown()              # no half-bootstrapped leak
+            raise
+
+    # -- autoscaler hooks ----------------------------------------------
+
+    def stats(self) -> Dict[str, int]:
+        """Demand view in the Autoscaler's vocabulary: each agent slot
+        is a 'worker', backlog is the caller-supplied trial demand,
+        inflight is the agents' active trials."""
+        with self._lock:
+            nodes = list(self.nodes)
+            n_agents = len(self.agents)
+        inflight = 0
+        for node in nodes:
+            try:
+                inflight += int(node.stats().get("active_trials", 0))
+            except Exception:
+                pass                        # a dying agent reads as idle
+        # report TRUE capacity — a phantom worker at zero agents would
+        # make `backlog > per_worker * workers` unreachable and starve
+        # scale-up from empty
+        return {"num_workers": n_agents,
+                "pending": int(self._demand()),
+                "inflight": inflight}
+
+    def scale_up(self) -> int:
+        with self._lock:
+            if self._closed or len(self.agents) >= self.max_agents:
+                return len(self.agents)
+        agent = bootstrap_agent(self._factory(),
+                                num_workers=self._num_workers,
+                                extra_sys_path=self._extra,
+                                startup_timeout=self._timeout)
+        with self._lock:
+            if self._closed:             # lost the race with shutdown
+                agent.teardown()
+                return 0
+            self.agents.append(agent)
+            self.nodes.append(agent.node)
+            return len(self.agents)
+
+    def scale_down(self) -> bool:
+        """Tear down ONE idle agent (newest first), never below
+        ``min_agents`` and never one with live trials — the idle-node
+        terminate rule."""
+        with self._lock:
+            if len(self.agents) <= self.min_agents:
+                return False
+            candidates = list(enumerate(self.agents))
+        victim = None
+        for i, agent in reversed(candidates):
+            try:
+                if int(agent.node.stats().get("active_trials", 0)):
+                    continue
+            except Exception:
+                pass                        # unreachable: reap it
+            victim = (i, agent)
+            break
+        if victim is None:
+            return False
+        i, agent = victim
+        with self._lock:
+            if i < len(self.agents) and self.agents[i] is agent:
+                del self.agents[i]
+                del self.nodes[i]
+            else:
+                return False             # list changed under us
+        agent.teardown()
+        return True
+
+    def shutdown(self) -> None:
+        with self._lock:
+            self._closed = True
+            agents = list(self.agents)
+            self.agents = []
+            del self.nodes[:]
+        for a in agents:
+            try:
+                a.teardown()
+            except Exception:
+                pass
 
 
 class BootstrapService:

@@ -64,10 +64,15 @@ class _AgentHandlers:
     def stats(self) -> Dict[str, Any]:
         with self._adm:
             reserved = sum(self._reserved.values())
+        with self._trials_lock:
+            active_trials = sum(
+                1 for t in self._trials.values()
+                if t["status"] in ("WAITING", "RUNNING"))
         return {"num_workers": self._num_workers,
                 "tasks_done": self._tasks_done,
                 "reserved_slots": reserved,
-                "free_slots": self._num_workers - reserved}
+                "free_slots": self._num_workers - reserved,
+                "active_trials": active_trials}
 
     # -- gang slots ----------------------------------------------------
 

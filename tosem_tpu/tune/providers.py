@@ -312,7 +312,10 @@ class NodeAgentService(TrainingService):
     placement-group bundle."""
 
     def __init__(self, nodes, max_concurrent: int = 4, reservation=None):
-        self._nodes = list(nodes)
+        # keep a LIST by reference: an ElasticAgentPool hands over its
+        # live ``nodes`` list so scaled-up agents join the round-robin
+        # and torn-down agents leave it; other iterables are snapshotted
+        self._nodes = nodes if isinstance(nodes, list) else list(nodes)
         if not self._nodes:
             raise ValueError("need at least one node agent")
         self._max = max_concurrent
@@ -347,6 +350,9 @@ class NodeAgentService(TrainingService):
                 job = self._jobs[tid]
                 if job.status == CANCELED:
                     continue
+                if not self._nodes:      # elastic pool scaled to zero
+                    self._pending.insert(0, (ref, config, tid, iters))
+                    return
                 node = self._nodes[self._rr % len(self._nodes)]
                 self._rr += 1
                 self._node_of[tid] = node
