@@ -7,7 +7,7 @@
 # Tiers:
 #   ./ci.sh          full release gate (tests + native + sanitizers +
 #                    C++ client + multichip dryrun) — slow (~40 min)
-#   ./ci.sh --quick  iteration tier (< 5 min): syntax gate + the pure
+#   ./ci.sh --quick  iteration tier (~5-6 min): syntax gate + the pure
 #                    numerics/unit files, no process-spawning suites
 set -euo pipefail
 cd "$(dirname "$0")"
