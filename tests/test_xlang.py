@@ -131,3 +131,52 @@ class TestCppClient:
         finally:
             gw.close()
             node.kill()
+
+
+class TestExperimentBridge:
+    def test_hpo_driven_from_cpp(self, xlang_bin, tmp_path):
+        """nnictl-from-another-language: create, start, poll to done,
+        and read results — entirely over the JSON wire via the C++
+        client."""
+        import time
+        from tosem_tpu.tune.experiment import ExperimentManager
+
+        gw = XLangGateway()
+        mgr = ExperimentManager(path=str(tmp_path / "kv.db"))
+        try:
+            gw.bridge_experiments(mgr)
+            host, port = _split(gw.address)
+
+            def cpp(method, *args):
+                out = subprocess.run(
+                    [xlang_bin, host, port,
+                     json.dumps({"method": method, "args": list(args)})],
+                    capture_output=True, timeout=60)
+                assert out.returncode == 0, out.stdout + out.stderr
+                return json.loads(out.stdout)["result"]
+
+            spec = {"name": "xq",
+                    "trainable": "tosem_tpu.tune.examples:quadratic",
+                    "space": {"x": {"type": "uniform",
+                                    "low": -5, "high": 5},
+                              "lr": {"type": "loguniform",
+                                     "low": 1e-2, "high": 1.0}},
+                    "metric": "loss", "mode": "min",
+                    "num_samples": 3, "max_iterations": 4}
+            assert cpp("experiment.create", spec) == "xq"
+            assert cpp("experiment.start", "xq") == "started"
+            deadline = time.monotonic() + 120
+            status = None
+            while time.monotonic() < deadline:
+                status = cpp("experiment.status", "xq")
+                if status.get("status") in ("done", "failed"):
+                    break
+                time.sleep(0.3)
+            assert status and status["status"] == "done", status
+            results = cpp("experiment.results", "xq")
+            assert len(results) == 3
+            assert any(r.get("best_score") is not None for r in results)
+            names = [e["name"] for e in cpp("experiment.list")]
+            assert "xq" in names
+        finally:
+            gw.close()

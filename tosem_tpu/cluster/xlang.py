@@ -102,6 +102,35 @@ class XLangGateway:
         self.register(prefix + "kill_trial", node.kill_trial)
         self.register(prefix + "health", node.health)
 
+    def bridge_experiments(self, manager,
+                           prefix: str = "experiment.") -> None:
+        """Front the durable experiment manager (the nnictl surface) for
+        non-Python clients: create/start/status/results from any
+        language. ``start`` runs the (blocking) experiment on a daemon
+        thread and returns immediately — the client polls ``status``."""
+        def start(name: str) -> str:
+            manager.spec(name)           # unknown name fails THE CALL,
+                                         # not a detached thread
+
+            def run():
+                try:
+                    manager.run(name)
+                except Exception:
+                    # pre-lock failures (e.g. already running) must not
+                    # die as a silent daemon-thread traceback; run()
+                    # itself records post-lock failures as 'failed'
+                    traceback.print_exc()
+
+            threading.Thread(target=run, daemon=True,
+                             name=f"xlang-exp-{name}").start()
+            return "started"
+
+        self.register(prefix + "create", manager.create)
+        self.register(prefix + "start", start)
+        self.register(prefix + "status", manager.status)
+        self.register(prefix + "results", manager.results)
+        self.register(prefix + "list", manager.list)
+
     # -- serving -------------------------------------------------------
 
     def _accept_loop(self) -> None:
