@@ -423,3 +423,24 @@ class TestInteractiveDashboard:
             assert missing["trials"] == []          # unknown -> empty
         finally:
             srv.shutdown()
+
+    def test_sysmo_gauges_reach_the_metrics_panel(self, tmp_path):
+        """DashboardServer(sysmo=True): the health checker's gauges ride
+        the same /metrics endpoint and metrics table as everything else."""
+        import time as _t
+        import urllib.request
+        from tosem_tpu.obs import DashboardServer
+        srv = DashboardServer(kv_path=str(tmp_path / "kv.db"), sysmo=True)
+        try:
+            deadline = _t.monotonic() + 20
+            text = ""
+            while _t.monotonic() < deadline:
+                text = urllib.request.urlopen(
+                    srv.url + "/metrics", timeout=30).read().decode()
+                if "sysmo_rss_bytes" in text:
+                    break
+                _t.sleep(0.2)
+            assert "sysmo_rss_bytes" in text
+            assert "sysmo_threads" in text
+        finally:
+            srv.shutdown()

@@ -341,8 +341,16 @@ class DashboardServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0, *,
                  kv_path: Optional[str] = None,
                  results_csv: Optional[str] = None,
-                 serve: Any = None):
+                 serve: Any = None, sysmo: bool = False):
         from tosem_tpu.obs.httpd import RouteServer
+        self._sysmo = None
+        if sysmo:
+            # the checker's gauges land in the global registry, so they
+            # appear on the same /metrics + metrics panel as everything
+            # else (cpu/rss/threads refreshed each checker tick)
+            from tosem_tpu.obs.sysmo import SysMo
+            self._sysmo = SysMo(interval_s=1.0,
+                                registry=_metrics.DEFAULT).start()
         mgr = None
         if kv_path is not None:
             # one manager (one sqlite connection) for the server's life,
@@ -387,4 +395,6 @@ class DashboardServer:
         return self._server.url
 
     def shutdown(self) -> None:
+        if self._sysmo is not None:
+            self._sysmo.stop()
         self._server.shutdown()
