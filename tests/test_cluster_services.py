@@ -374,3 +374,16 @@ class TestParameterServer:
         assert ("slow", "A", 1) in seen            # not lost below cursor
         assert any(n == "fast" for n, _, _ in seen)
         assert not any(n == "seed" for n, _, _ in seen)  # pre-existing
+
+
+@pytest.mark.slow
+def test_control_plane_microbenchmarks_run():
+    """The ray_perf-style harness over OUR transports produces sane
+    positive rates for every plane (rpc, channel, xlang, params)."""
+    from tosem_tpu.runtime.bench_runtime import run_control_plane_benchmarks
+    rows = run_control_plane_benchmarks(trials=1, min_s=0.1, quiet=True)
+    by_id = {r.bench_id: r for r in rows}
+    assert set(by_id) == {"rpc_round_trip", "channel_publish",
+                          "channel_pub_take", "xlang_call", "param_set"}
+    for r in rows:
+        assert r.value > 10.0, (r.bench_id, r.value)

@@ -36,6 +36,20 @@ def _timeit(name: str, fn: Callable[[], int], trials: int = 3,
     return mean, sd
 
 
+def _record(rows: List[ResultRow], lines: List[str], bench_id: str,
+            name: str, mean: float, sd: float,
+            unit: str = "ops/s") -> None:
+    """Shared row/release-line emitter for every microbench runner —
+    one place for the schema (project/config/metric/stddev) so the two
+    harnesses cannot diverge."""
+    lines.append(_release_line(name, mean, sd))
+    rows.append(ResultRow(project="runtime", config="microbenchmark",
+                          bench_id=bench_id,
+                          metric=name.replace(" ", "_"),
+                          value=mean, unit=unit, device="cpu",
+                          n_devices=1, extra={"stddev": sd}))
+
+
 def _release_line(name: str, mean: float, sd: float) -> str:
     return f"{name} per second {mean:.2f} +- {sd:.2f}"
 
@@ -49,13 +63,8 @@ def run_microbenchmarks(num_workers: int = 4, trials: int = 3,
     rows: List[ResultRow] = []
     lines: List[str] = []
 
-    def record(bench_id: str, name: str, mean: float, sd: float,
-               unit: str = "ops/s"):
-        lines.append(_release_line(name, mean, sd))
-        rows.append(ResultRow(project="runtime", config="microbenchmark",
-                              bench_id=bench_id, metric=name.replace(" ", "_"),
-                              value=mean, unit=unit, device="cpu",
-                              n_devices=1, extra={"stddev": sd}))
+    def record(bench_id, name, mean, sd, unit="ops/s"):
+        _record(rows, lines, bench_id, name, mean, sd, unit)
 
     # --- object plane (ray_perf.py "single client get/put") ---------------
     obj = rt.put(b"x" * 1024)
@@ -154,4 +163,102 @@ def run_microbenchmarks(num_workers: int = 4, trials: int = 3,
             print(ln)
     if own_runtime:
         rt.shutdown()
+    return rows
+
+
+def run_control_plane_benchmarks(trials: int = 3, min_s: float = 0.5,
+                                 quiet: bool = False) -> List[ResultRow]:
+    """Control-plane microbenchmarks over the cross-process planes this
+    framework adds around the compute path: raw RPC round trips, pub/sub
+    channel publish + take, the cross-language JSON wire, and parameter
+    server writes — the ray_perf-style numbers for OUR transports, so
+    regressions in the runtime shell are as visible as kernel ones."""
+    rows: List[ResultRow] = []
+    lines: List[str] = []
+
+    def record(bench_id, name, mean, sd, unit="ops/s"):
+        _record(rows, lines, bench_id, name, mean, sd, unit)
+
+    # --- raw RPC round trip -----------------------------------------------
+    from tosem_tpu.cluster.rpc import RpcClient, RpcServer
+    srv = RpcServer({"echo": lambda x: x})
+    cli = None
+    try:
+        cli = RpcClient(srv.address)
+
+        def rpc_rt():
+            for _ in range(200):
+                cli.call("echo", b"x")
+            return 200
+        m, s = _timeit("rpc", rpc_rt, trials, min_s)
+        record("rpc_round_trip", "rpc round trips", m, s)
+    finally:
+        if cli is not None:
+            cli.close()
+        srv.shutdown()
+
+    # --- pub/sub channel ----------------------------------------------------
+    from tosem_tpu.cluster.channel import (ChannelBroker, ChannelPublisher,
+                                           ChannelSubscriber)
+    from tosem_tpu.dataflow.components import ChannelQos
+    broker = ChannelBroker()
+    pub = sub = None
+    try:
+        pub = ChannelPublisher(broker.address, "bench")
+        sub = ChannelSubscriber(broker.address, "bench",
+                                qos=ChannelQos(depth=64,
+                                               reliability="best_effort"))
+
+        def publish():
+            for _ in range(200):
+                pub.publish(b"frame")
+            return 200
+        m, s = _timeit("chan_pub", publish, trials, min_s)
+        record("channel_publish", "channel publishes", m, s)
+
+        def pub_take():
+            for _ in range(50):
+                pub.publish(b"frame")
+                sub.take(max_n=64)
+            return 50
+        m, s = _timeit("chan_rt", pub_take, trials, min_s)
+        record("channel_pub_take", "channel publish+take round trips",
+               m, s)
+    finally:
+        for closer in (sub and sub.close, pub and pub.close,
+                       broker.shutdown):
+            if closer:
+                try:
+                    closer()
+                except Exception:
+                    pass
+
+    # --- cross-language JSON wire -------------------------------------------
+    from tosem_tpu.cluster.xlang import XLangGateway, xlang_call
+    gw = XLangGateway()
+    gw.register("echo", lambda x: x)
+    try:
+        def xl():
+            for _ in range(100):
+                xlang_call(gw.address, "echo", 1)
+            return 100
+        m, s = _timeit("xlang", xl, trials, min_s)
+        record("xlang_call", "xlang calls", m, s)
+    finally:
+        gw.close()
+
+    # --- parameter server ---------------------------------------------------
+    from tosem_tpu.cluster.param import ParameterServer
+    ps = ParameterServer()
+
+    def param_set():
+        for i in range(200):
+            ps.set("p", i)
+        return 200
+    m, s = _timeit("param_set", param_set, trials, min_s)
+    record("param_set", "parameter sets", m, s)
+
+    if not quiet:
+        for line in lines:
+            print(line)
     return rows
