@@ -1,0 +1,149 @@
+"""Roofline annotation + CSV round-trip (the bench/report contract).
+
+The reference's benchmark drivers hardcode device peaks next to each
+kernel call (``modules/perception/inference/utils/gemm.cu:107-121``);
+here the accounting is one shared module consumed by ``bench.py``, the
+CLI, and the tunnel-flap capture harness — these tests pin that the
+three agree: annotation is deterministic, rows survive a CSV round
+trip, and "newest row wins" supersedes stale measurements.
+"""
+import os
+
+from tosem_tpu.utils.results import ResultRow, ResultWriter
+from tosem_tpu.utils.roofline import (PEAK_BF16_GFLOPS, PEAK_FP32_GFLOPS,
+                                      annotate_roofline, latest_rows,
+                                      read_rows)
+
+
+def _row(value=10000.0, dtype="bfloat16", ts=0.0, bench_id="g1",
+         metric="gflops", unit="GFLOPS", **extra):
+    extra = dict(dtype=dtype, **extra)
+    return ResultRow(project="ops", config="gemm", bench_id=bench_id,
+                     metric=metric, value=value, unit=unit,
+                     device="tpu", n_devices=1, extra=extra,
+                     timestamp=ts)
+
+
+class TestAnnotate:
+    def test_bf16_mfu_against_bf16_peak(self):
+        r = _row(value=PEAK_BF16_GFLOPS / 2)
+        annotate_roofline(r)
+        assert r.extra["mfu"] == 0.5
+        assert r.extra["bound"] == "compute"
+
+    def test_fp32_uses_emulated_peak(self):
+        r = _row(value=PEAK_FP32_GFLOPS, dtype="float32")
+        annotate_roofline(r)
+        assert r.extra["mfu"] == 1.0
+
+    def test_memory_bound_small_gemm(self):
+        # tiny flops, huge bytes, per-call time present -> memory bound
+        r = _row(value=100.0, bytes=1 << 30, mean_ms=10.0)
+        annotate_roofline(r)
+        assert r.extra["bound"] == "memory"
+        assert "mbu" in r.extra
+
+    def test_bandwidth_rows_get_mbu(self):
+        r = _row(value=400.0, unit="GB/s", metric="bus_bw")
+        annotate_roofline(r)
+        assert r.extra["bound"] == "memory"
+        assert 0 < r.extra["mbu"] < 1
+
+
+class TestCsvRoundTrip:
+    def test_write_read_latest(self, tmp_path):
+        path = os.path.join(tmp_path, "r.csv")
+        old = _row(value=1.0, ts=100.0)
+        new = _row(value=2.0, ts=200.0)
+        other = _row(value=3.0, ts=150.0, bench_id="g2")
+        with ResultWriter(path) as w:
+            w.add_many([old, new, other])
+        rows = read_rows(path)
+        assert len(rows) == 3
+        assert rows[0].extra["dtype"] == "bfloat16"
+
+        fresh = latest_rows(rows)
+        by_id = {r.bench_id: r for r in fresh}
+        assert len(fresh) == 2
+        assert by_id["g1"].value == 2.0  # newest g1 wins
+        assert by_id["g2"].value == 3.0
+
+    def test_min_timestamp_filters_stale(self, tmp_path):
+        path = os.path.join(tmp_path, "r.csv")
+        with ResultWriter(path) as w:
+            w.add_many([_row(ts=100.0), _row(ts=200.0, bench_id="g2")])
+        assert [r.bench_id for r in read_rows(path, min_timestamp=150.0)] \
+            == ["g2"]
+
+    def test_torn_last_line_is_skipped(self, tmp_path):
+        # a subprocess killed mid-flush truncates the file arbitrarily;
+        # the reader must yield every intact row and never raise
+        path = os.path.join(tmp_path, "r.csv")
+        with ResultWriter(path) as w:
+            w.add_many([_row(ts=100.0), _row(ts=200.0, bench_id="g2")])
+        text = open(path).read()
+        torn = text[:text.rfind("g2") + 2]  # g2 line dies after bench_id
+        open(path, "w").write(torn)
+        rows = read_rows(path)  # must not raise
+        assert [r.bench_id for r in rows] == ["g1"]
+
+
+class TestBenchRebuild:
+    def test_rebuild_from_csv_headline_and_report(self, tmp_path,
+                                                  monkeypatch):
+        import time
+
+        import bench
+        monkeypatch.chdir(tmp_path)
+        path = os.path.join(tmp_path, "tpu.csv")
+        ts = time.time()
+        rows = [
+            _row(value=26000.0, dtype="float32", ts=ts,
+                 bench_id="gemm_1024x1024x1024_float32_float32"),
+            # internal runner config names must file under their
+            # north-star config (bert_kernel_suite -> bert_kernels)
+            ResultRow(project="ops", config="bert_kernel_suite",
+                      bench_id="attention_fwdbwd_b8_t512_bfloat16",
+                      metric="gflops", value=98500.0, unit="GFLOPS",
+                      device="tpu", n_devices=1,
+                      extra={"dtype": "bfloat16"}, timestamp=ts),
+            ResultRow(project="train", config="resnet_train",
+                      bench_id="resnet_gate", metric="val_acc",
+                      value=0.7, unit="", device="tpu", n_devices=1,
+                      extra={"passed": True}, timestamp=ts),
+            ResultRow(project="models", config="speech_train",
+                      bench_id="speech_b8", metric="step_time_ms",
+                      value=12.0, unit="ms", device="tpu", n_devices=1,
+                      extra={}, timestamp=ts),
+        ]
+        for r in rows:
+            annotate_roofline(r)
+        with ResultWriter(path) as w:
+            w.add_many(rows)
+        out = bench.rebuild_from_csv(path, errors={"allreduce": "boom"})
+        assert out["value"] == 26000.0
+        assert out["vs_baseline"] == round(26000.0 / 13000.0, 4)
+        assert out["convergence"] == {"val_acc": 0.7, "passed": True}
+        # aliased flash row found under bert_kernels, MFU vs bf16 peak
+        assert out["flash_attn_fwdbwd_mfu"] == 0.5
+        # ok/err partition north-star configs; model sweep counted apart
+        # ok: gemm + bert_kernels + resnet_train; err: allreduce
+        assert out["configs_ok"] == 3 and out["configs_err"] == 1
+        assert out["configs_extra"] == 1
+        report = open("REPORT.md").read()
+        assert "gemm_1024x1024x1024_float32_float32" in report
+        assert "PASS" in report
+        # non-north-star configs land in the model-sweep section
+        assert "speech_b8" in report
+        assert "boom" in report  # failed config surfaces as an ERROR row
+
+    def test_rebuild_ignores_pre_session_rows(self, tmp_path,
+                                              monkeypatch):
+        import bench
+        monkeypatch.chdir(tmp_path)
+        path = os.path.join(tmp_path, "tpu.csv")
+        with ResultWriter(path) as w:
+            w.add(_row(value=9999.0, dtype="float32", ts=100.0,
+                       bench_id="gemm_1024x1024x1024_float32_float32"))
+        out = bench.rebuild_from_csv(path)
+        assert out["value"] == -1.0  # r2-era row must not masquerade

@@ -752,11 +752,17 @@ def main(argv: List[str] = None) -> int:
     print(f"device={fs.device} jax_devices={len(jax.devices())} "
           f"platform={jax.devices()[0].platform}")
 
+    from tosem_tpu.utils.roofline import annotate_roofline
     with ResultWriter(fs.results_csv) as w:
         for c in configs:
             print(f"[{c}]")
             t0 = time.perf_counter()
             rows = RUNNERS[c](fs)
+            if fs.device == "tpu":
+                # same roofline accounting as bench.py, so rows captured
+                # leg-by-leg (tunnel-flap harness) match full-bench rows
+                for r in rows:
+                    annotate_roofline(r)
             w.add_many(rows)
             print(f"[{c}] {len(rows)} rows in "
                   f"{time.perf_counter() - t0:.1f}s")

@@ -1,0 +1,115 @@
+"""Roofline annotation + result-CSV round-tripping, shared by bench entry
+points.
+
+The reference ships its utilization accounting inside each benchmark driver
+(e.g. ``modules/perception/inference/utils/gemm.cu:107-121`` hardcodes the
+device peak next to the cuBLAS call); here the peaks and the roofline
+classification live in ONE module so ``bench.py``, the CLI runners, and the
+opportunistic TPU-capture harness all agree on what "MFU" means.
+
+Peak assumptions (documented in BASELINE.md "TPU peak assumptions"):
+v5e MXU peak 197 TFLOPS bf16; fp32 executes as 6-pass bf16 emulation at
+HIGHEST precision -> 197/6 ~= 32.8 TFLOPS effective; HBM ~819 GB/s.
+
+``read_rows`` parses a results CSV (``tosem_tpu.utils.results.SCHEMA``)
+back into :class:`ResultRow` objects so reports can be rebuilt from disk —
+a capture interrupted by a tunnel flap loses a process, not the report.
+"""
+from __future__ import annotations
+
+import csv
+import json
+from typing import Iterable, List, Optional
+
+from tosem_tpu.utils.results import ResultRow, SCHEMA
+
+PEAK_BF16_GFLOPS = 197_000.0             # v5e MXU peak, bf16
+PEAK_FP32_GFLOPS = PEAK_BF16_GFLOPS / 6  # 6-pass bf16 emulation (HIGHEST)
+PEAK_HBM_GBPS = 819.0                    # v5e HBM bandwidth
+
+
+def annotate_roofline(row: ResultRow) -> None:
+    """Attach roofline utilization to a result row in place.
+
+    Every row gets ``bound`` in {compute, memory} — which roofline term
+    dominates its ideal time — plus the MATCHING utilization (MFU against
+    the MXU peak, or MBU against HBM). Reporting MFU on a memory-bound
+    1x1 conv makes a correct kernel look broken; reporting MBU on a
+    compute-bound GEMM hides a slow one. Rows that report GFLOPS also
+    carry ``bytes`` so both terms are computable.
+    """
+    unit = row.unit.lower()
+    dtype = str(row.extra.get("dtype", ""))
+    if unit == "gflops":
+        peak = PEAK_FP32_GFLOPS if "float32" in dtype else PEAK_BF16_GFLOPS
+        row.extra["mfu"] = round(row.value / peak, 4)
+        nbytes = row.extra.get("bytes")
+        if nbytes and row.value > 0:
+            flops = row.value * 1e9  # per second
+            sec_per_call = None
+            if row.extra.get("mean_ms"):
+                sec_per_call = row.extra["mean_ms"] / 1e3
+            elif row.extra.get("time_us"):
+                sec_per_call = row.extra["time_us"] / 1e6
+            if sec_per_call:
+                eff_gbps = nbytes / sec_per_call / 1e9
+                row.extra["mbu"] = round(eff_gbps / PEAK_HBM_GBPS, 4)
+                # which term dominates the ROOFLINE (ideal) time —
+                # computable only with a per-call time (per-call flops vs
+                # per-call bytes; mixing rates and totals would classify
+                # arbitrarily)
+                total_flops = flops * sec_per_call
+                t_compute = total_flops / (peak * 1e9)
+                t_memory = nbytes / (PEAK_HBM_GBPS * 1e9)
+                row.extra["bound"] = ("memory" if t_memory > t_compute
+                                      else "compute")
+        else:
+            row.extra["bound"] = "compute"
+    elif unit == "gb/s":
+        row.extra["mbu"] = round(row.value / PEAK_HBM_GBPS, 4)
+        row.extra["bound"] = "memory"
+
+
+def read_rows(path: str,
+              min_timestamp: float = 0.0) -> List[ResultRow]:
+    """Parse a results CSV back into rows (newest-last, file order)."""
+    rows: List[ResultRow] = []
+    with open(path, newline="") as f:
+        for rec in csv.DictReader(f):
+            # a subprocess killed mid-flush leaves a torn last line:
+            # skip any record that doesn't parse, never poison the file
+            try:
+                ts = float(rec["timestamp"])
+                if ts < min_timestamp:
+                    continue
+                try:
+                    extra = json.loads(rec.get("extra") or "{}")
+                except json.JSONDecodeError:
+                    extra = {}
+                rows.append(ResultRow(
+                    project=rec["project"] or "", config=rec["config"] or "",
+                    bench_id=rec["bench_id"] or "",
+                    metric=rec["metric"] or "",
+                    value=float(rec["value"]), unit=rec["unit"] or "",
+                    device=rec["device"] or "",
+                    n_devices=int(float(rec["n_devices"] or 1)),
+                    extra=extra if isinstance(extra, dict) else {},
+                    timestamp=ts))
+            except (TypeError, ValueError, KeyError):
+                continue
+    return rows
+
+
+def latest_rows(rows: Iterable[ResultRow]) -> List[ResultRow]:
+    """Keep only the newest row per (config, bench_id, metric) key.
+
+    Captures append; reruns of a leg supersede their earlier rows so a
+    report built from the file reflects the freshest measurement of each
+    quantity without losing file history.
+    """
+    best = {}
+    for r in rows:
+        key = (r.config, r.bench_id, r.metric)
+        if key not in best or r.timestamp >= best[key].timestamp:
+            best[key] = r
+    return list(best.values())

@@ -1,0 +1,161 @@
+#!/usr/bin/env python
+"""Opportunistic on-chip capture across tunnel flaps.
+
+The axon TPU relay comes and goes (rounds 3-4 never saw it up; round 5
+watched it drop mid-``conv_sweep``). A monolithic ``bench.py`` run loses
+everything after the flap, because a dead tunnel wedges the in-process
+backend in its redial loop. This harness makes capture incremental:
+
+- every leg is its OWN subprocess (``tosem_tpu.cli`` with one config, or
+  a pytest file) with a hard timeout — a flap costs one leg, not the run;
+- legs only launch while ``tunnel_alive()``; between attempts the harness
+  waits for the next liveness window;
+- failed/timed-out legs requeue (bounded attempts), so a leg interrupted
+  at 04:10 retries when the tunnel returns at 05:00;
+- after every successful leg the report + summary JSON are rebuilt from
+  ``results/tpu_full.csv`` (newest row per (config, bench_id, metric)),
+  so partial progress is always commit-ready.
+
+Run: ``python tpu_capture.py`` (add ``--budget-h 8`` to bound the wait).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+CSV = "results/tpu_full.csv"
+LOG_DIR = "results/capture_logs"
+SUMMARY = "results/r5_capture.json"
+
+CLI = [sys.executable, "-m", "tosem_tpu.cli", "--device=tpu",
+       f"--results_csv={CSV}"]
+
+
+def _north_star_leg(cfg):
+    """Build a leg from bench.py's own flags/timeouts so the two entry
+    points can never measure the same config under different parameters
+    (e.g. diverging convergence-gate thresholds)."""
+    from bench import CONFIG_FLAGS, CONFIG_TIMEOUT_S
+
+    return (cfg, CLI + [f"--config={cfg}"] + CONFIG_FLAGS.get(cfg, []),
+            CONFIG_TIMEOUT_S.get(cfg, 1800))
+
+
+# (name, argv, timeout_s) — priority order: the two rows the verdict
+# gates on (flash-attention MFU, convergence PASS) go first so a short
+# liveness window captures the highest-value evidence.
+LEGS = [
+    _north_star_leg("bert_kernels"),
+    _north_star_leg("resnet_train"),
+    _north_star_leg("bert_train"),
+    _north_star_leg("conv_sweep"),
+    _north_star_leg("allreduce"),
+    ("bert_train_remat_dots", CLI + ["--config=bert_train", "--remat=dots"],
+     1500),
+    ("bert_train_remat_full", CLI + ["--config=bert_train", "--remat=full"],
+     1500),
+    ("pjrt_execute", [sys.executable, "-m", "pytest",
+                      "tests/test_pjrt_driver.py", "-q"], 900),
+    ("detection_infer", CLI + ["--config=detection_infer"], 1800),
+    ("speech_train", CLI + ["--config=speech_train", "--steps=10"], 2400),
+    ("detection_train", CLI + ["--config=detection_train", "--steps=10"],
+     2400),
+    ("gemm_refresh", CLI + ["--config=gemm"], 1200),
+]
+
+MAX_ATTEMPTS = 3
+
+
+def tunnel_alive() -> bool:
+    from tosem_tpu.utils.net import tunnel_alive as probe
+    return probe()
+
+
+def wait_for_tunnel(deadline: float, poll_s: float = 20.0) -> bool:
+    while time.time() < deadline:
+        if tunnel_alive():
+            return True
+        time.sleep(poll_s)
+    return False
+
+
+def rebuild_report() -> dict:
+    """REPORT.md + summary JSON from the CSV's freshest session rows
+    (same builder the driver-run bench uses, so artifacts agree)."""
+    from bench import rebuild_from_csv
+
+    summary = rebuild_from_csv(CSV)
+    summary["captured_at"] = time.time()
+    return summary
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--budget-h", type=float, default=9.0,
+                    help="overall wall budget incl. tunnel-down waits")
+    ap.add_argument("--legs", default="",
+                    help="comma-separated subset of leg names")
+    args = ap.parse_args()
+    deadline = time.time() + args.budget_h * 3600
+
+    os.chdir(HERE)
+    os.makedirs(LOG_DIR, exist_ok=True)
+    if args.legs:
+        wanted = [s for s in args.legs.split(",") if s]
+        known = {l[0] for l in LEGS}
+        unknown = [w for w in wanted if w not in known]
+        if unknown:
+            print(f"unknown legs {unknown}; choose from {sorted(known)}",
+                  file=sys.stderr)
+            return 2
+        picked = [l for l in LEGS if l[0] in wanted]
+    else:
+        picked = list(LEGS)
+    queue = [(n, a, t, 1) for n, a, t in picked]
+    status = {n: "pending" for n, _, _, _ in queue}
+
+    while queue and time.time() < deadline:
+        name, argv, timeout, attempt = queue.pop(0)
+        if not wait_for_tunnel(deadline):
+            status[name] = "tunnel-never-up"
+            break
+        print(f"[capture] {name} (attempt {attempt}) ...", flush=True)
+        log_path = os.path.join(LOG_DIR, f"{name}.log")
+        t0 = time.time()
+        try:
+            with open(log_path, "w") as log:
+                rc = subprocess.run(argv, stdout=log, stderr=log,
+                                    timeout=timeout).returncode
+        except subprocess.TimeoutExpired:
+            rc = -1
+        dt = time.time() - t0
+        if rc == 0:
+            status[name] = f"ok ({dt:.0f}s)"
+            print(f"[capture] {name}: OK in {dt:.0f}s", flush=True)
+            try:
+                summary = rebuild_report()
+                summary["legs"] = dict(status)
+                with open(SUMMARY, "w") as f:
+                    json.dump(summary, f, indent=1)
+            except Exception as e:
+                print(f"[capture] report rebuild failed: {e}", flush=True)
+        else:
+            why = "timeout" if rc == -1 else f"rc={rc}"
+            print(f"[capture] {name}: {why} after {dt:.0f}s "
+                  f"(attempt {attempt})", flush=True)
+            if attempt < MAX_ATTEMPTS:
+                queue.append((name, argv, timeout, attempt + 1))
+                status[name] = f"retry ({why})"
+            else:
+                status[name] = f"failed ({why})"
+    print("[capture] done:", json.dumps(status, indent=1), flush=True)
+    return 0 if all(v.startswith("ok") for v in status.values()) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
