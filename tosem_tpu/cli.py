@@ -101,6 +101,68 @@ def run_gemm(fs: FlagSet) -> List[Any]:
     return rows
 
 
+def run_timing_check(fs: FlagSet) -> List[Any]:
+    """Cross-validate the two timing harnesses against each other.
+
+    ``DeviceLoopBench`` (on-device chained ``fori_loop``, one dispatch)
+    and ``time_fn`` (differential batch: N separate dispatches, one sync,
+    ``(t_N - t_1)/(N-1)``) share NO mechanism — the loop-chain runs one
+    compiled program, the batch method relies on the device executing
+    queued programs in order. If a reading is a timing artifact (e.g. a
+    GEMM above the v5e nominal 197 TFLOPS peak), the two disagree; if the
+    silicon really sustains that rate, they agree. The reference leans on
+    nvprof for the same arbitration role over CUDA events
+    (``modules/perception/inference/utils/gemm.cu`` under nvprof).
+    Emits one row per (shape, method) plus an agreement-ratio row.
+    """
+    import jax
+    import jax.numpy as jnp
+    from tosem_tpu.ops.gemm import GemmSpec, gemm, gemm_bench
+    from tosem_tpu.utils.results import ResultRow
+    from tosem_tpu.utils.timing import time_fn
+    shapes = ([GemmSpec(8192, 8192, 8192, "bfloat16", "default"),
+               GemmSpec(1024, 1024, 1024, "bfloat16", "default"),
+               GemmSpec(1024, 1024, 1024, "float32", "float32")]
+              if fs.device == "tpu" else
+              [GemmSpec(256, 256, 256, "float32", "float32")])
+    platform = jax.devices()[0].platform
+    rows = []
+    for spec in shapes:
+        _, loop_row = gemm_bench(spec)
+        loop_row = ResultRow(project="ops", config="timing_check",
+                             bench_id=f"{spec.bench_id}_deviceloop",
+                             metric="gflops", value=loop_row.value,
+                             unit="GFLOPS", device=platform, n_devices=1,
+                             extra=dict(loop_row.extra))
+        key_a, key_b = jax.random.split(jax.random.PRNGKey(0))
+        dt = jnp.dtype(spec.dtype)
+        a = jax.device_put(jax.random.normal(
+            key_a, (spec.m, spec.k), dtype=jnp.float32).astype(dt))
+        b = jax.device_put(jax.random.normal(
+            key_b, (spec.k, spec.n), dtype=jnp.float32).astype(dt))
+        prec = spec.precision
+        stats = time_fn(lambda: gemm(a, b, prec), iters=8, name="batch")
+        batch_gf = spec.flops / stats.min_s / 1e9
+        rows.append(loop_row)
+        rows.append(ResultRow(
+            project="ops", config="timing_check",
+            bench_id=f"{spec.bench_id}_batch", metric="gflops",
+            value=batch_gf, unit="GFLOPS", device=platform, n_devices=1,
+            extra={"m": spec.m, "n": spec.n, "k": spec.k,
+                   "dtype": spec.dtype, "precision": spec.precision,
+                   "mean_ms": stats.min_s * 1e3}))
+        rows.append(ResultRow(
+            project="ops", config="timing_check",
+            bench_id=f"{spec.bench_id}_agreement", metric="ratio",
+            value=loop_row.value / batch_gf if batch_gf else -1.0,
+            unit="x", device=platform, n_devices=1,
+            extra={"loop_gflops": round(loop_row.value, 1),
+                   "batch_gflops": round(batch_gf, 1)}))
+    for r in rows:
+        print(f"  {r.bench_id}: {r.value:.1f} {r.unit}")
+    return rows
+
+
 def run_conv_sweep(fs: FlagSet) -> List[Any]:
     from tosem_tpu.ops.conv import (RESNET50_CONV_SWEEP,
                                     RESNET50_CONV_SWEEP_BF16, ConvSpec,
@@ -707,6 +769,7 @@ def run_analysis(fs: FlagSet) -> List[Any]:
 
 RUNNERS = {
     "gemm": run_gemm,
+    "timing_check": run_timing_check,
     "conv_sweep": run_conv_sweep,
     "allreduce": run_allreduce,
     "resnet_train": run_resnet_train,

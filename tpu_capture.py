@@ -50,6 +50,9 @@ def _north_star_leg(cfg):
 # gates on (flash-attention MFU, convergence PASS) go first so a short
 # liveness window captures the highest-value evidence.
 LEGS = [
+    # the meter first: if the two timing harnesses disagree, every other
+    # number this session needs the arbitration context
+    ("timing_check", CLI + ["--config=timing_check"], 1200),
     _north_star_leg("bert_kernels"),
     _north_star_leg("resnet_train"),
     _north_star_leg("bert_train"),
