@@ -66,7 +66,14 @@ def test_pattern_fill_matches_driver_contract():
                     reason="axon PJRT plugin/tunnel unavailable")
 def test_native_gemm_matches_python(tmp_path):
     paths = export_gemm(str(tmp_path), n=128)
-    res = run_driver(paths, reps=2, timeout=280)
+    try:
+        res = run_driver(paths, reps=2, timeout=280)
+    except Exception:
+        # the relay flaps: if it died between the skipif probe and the
+        # driver's execute, that's environment loss, not a driver bug
+        if not tunnel_alive():
+            pytest.skip("axon tunnel dropped mid-test")
+        raise
     a = pattern_fill((128, 128))
     want = float(np.mean(a @ a))
     assert res["out0"] == pytest.approx(want, abs=1e-4, rel=1e-3)

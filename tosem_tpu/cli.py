@@ -116,10 +116,10 @@ def run_timing_check(fs: FlagSet) -> List[Any]:
     Emits one row per (shape, method) plus an agreement-ratio row.
     """
     import jax
-    import jax.numpy as jnp
-    from tosem_tpu.ops.gemm import GemmSpec, gemm, gemm_bench
+    from tosem_tpu.ops.gemm import (GemmSpec, gemm, gemm_bench,
+                                    gemm_operands)
     from tosem_tpu.utils.results import ResultRow
-    from tosem_tpu.utils.timing import time_fn
+    from tosem_tpu.utils.timing import gflops, time_fn
     shapes = ([GemmSpec(8192, 8192, 8192, "bfloat16", "default"),
                GemmSpec(1024, 1024, 1024, "bfloat16", "default"),
                GemmSpec(1024, 1024, 1024, "float32", "float32")]
@@ -134,15 +134,12 @@ def run_timing_check(fs: FlagSet) -> List[Any]:
                              metric="gflops", value=loop_row.value,
                              unit="GFLOPS", device=platform, n_devices=1,
                              extra=dict(loop_row.extra))
-        key_a, key_b = jax.random.split(jax.random.PRNGKey(0))
-        dt = jnp.dtype(spec.dtype)
-        a = jax.device_put(jax.random.normal(
-            key_a, (spec.m, spec.k), dtype=jnp.float32).astype(dt))
-        b = jax.device_put(jax.random.normal(
-            key_b, (spec.k, spec.n), dtype=jnp.float32).astype(dt))
+        a, b = gemm_operands(spec)
         prec = spec.precision
         stats = time_fn(lambda: gemm(a, b, prec), iters=8, name="batch")
-        batch_gf = spec.flops / stats.min_s / 1e9
+        # value is min-based (time_fn's noise-free estimator), mean_ms
+        # is the honest sample mean — same convention as gemm_bench
+        batch_gf = gflops(spec.flops, stats.min_s)
         rows.append(loop_row)
         rows.append(ResultRow(
             project="ops", config="timing_check",
@@ -150,7 +147,8 @@ def run_timing_check(fs: FlagSet) -> List[Any]:
             value=batch_gf, unit="GFLOPS", device=platform, n_devices=1,
             extra={"m": spec.m, "n": spec.n, "k": spec.k,
                    "dtype": spec.dtype, "precision": spec.precision,
-                   "mean_ms": stats.min_s * 1e3}))
+                   "mean_ms": stats.mean_ms,
+                   "min_ms": stats.min_s * 1e3}))
         rows.append(ResultRow(
             project="ops", config="timing_check",
             bench_id=f"{spec.bench_id}_agreement", metric="ratio",

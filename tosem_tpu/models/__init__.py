@@ -26,3 +26,6 @@ from tosem_tpu.models.control import (VehicleParams, PidGains, lqr_gain,
                                       track_candidates, PlanningComponent,
                                       ControlComponent,
                                       build_driving_pipeline)
+from tosem_tpu.models.localization import (EkfParams, ekf_localize,
+                                           dead_reckon, rtk_interpolate,
+                                           LocalizationComponent)

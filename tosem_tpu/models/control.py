@@ -275,12 +275,18 @@ def build_driving_pipeline(runtime, *, lane_half: float = 1.75,
                            n: int = 64, ds: float = 1.0,
                            frame_dt: float = 0.1, horizon: float = 5.0,
                            max_k: int = 3,
-                           params: VehicleParams = VehicleParams()):
+                           params: VehicleParams = VehicleParams(),
+                           localize: bool = False):
     """Wire prediction → scenario → planning → control with ONE shared
     geometry (lane_half / pass gap / speeds) so the scenario rules and
     the planner's fence can never disagree about which obstacles block
     — the wiring-level guarantee the shared predicates alone cannot
     give. Returns the four components after adding them to ``runtime``.
+
+    ``localize=True`` also mounts the EKF localization branch
+    (imu + gnss → pose; ``models/localization.py``) — the pose stream
+    the reference's driving DAG feeds every module from
+    (``rtk_localization_component.cc``); it is returned appended.
     """
     from tosem_tpu.models.prediction import PredictionComponent
     from tosem_tpu.models.scenario import ScenarioComponent, ScenarioManager
@@ -293,9 +299,16 @@ def build_driving_pipeline(runtime, *, lane_half: float = 1.75,
                              lane_half=lane_half, v_init=cruise_v,
                              min_pass_gap=min_pass_gap)
     ctl = ControlComponent(params=params, ds=ds)
-    for c in (pred, scen, plan, ctl):
+    comps = [pred, scen, plan, ctl]
+    if localize:
+        from tosem_tpu.models.localization import (EkfParams,
+                                                   LocalizationComponent)
+        comps.append(LocalizationComponent(
+            x0=(0.0, 0.0, 0.0, cruise_v),
+            params=EkfParams(dt=frame_dt)))
+    for c in comps:
         runtime.add(c)
-    return pred, scen, plan, ctl
+    return tuple(comps)
 
 
 class ControlComponent(Component):

@@ -47,6 +47,18 @@ def gemm(a: jax.Array, b: jax.Array, precision: str = "float32") -> jax.Array:
     return jnp.dot(a, b, precision=PRECISION[precision])
 
 
+def gemm_operands(spec: GemmSpec, seed: int = 0):
+    """Device-resident operands for a spec (shared by every harness so
+    cross-validating timers measure the SAME program)."""
+    key_a, key_b = jax.random.split(jax.random.PRNGKey(seed))
+    dt = jnp.dtype(spec.dtype)
+    a = jax.random.normal(key_a, (spec.m, spec.k),
+                          dtype=jnp.float32).astype(dt)
+    b = jax.random.normal(key_b, (spec.k, spec.n),
+                          dtype=jnp.float32).astype(dt)
+    return jax.device_put(a), jax.device_put(b)
+
+
 def gemm_bench(spec: GemmSpec, *, n_iter: int = 0, reps: int = 3,
                seed: int = 0) -> Tuple[BenchStats, ResultRow]:
     """Time one GEMM shape; returns stats + a schema row for the results CSV.
@@ -55,11 +67,7 @@ def gemm_bench(spec: GemmSpec, *, n_iter: int = 0, reps: int = 3,
     number is pure kernel time — the analog of nvprof's kernel duration for
     ``cublasSgemm``, not launch+sync wall time.
     """
-    key_a, key_b = jax.random.split(jax.random.PRNGKey(seed))
-    dt = jnp.dtype(spec.dtype)
-    a = jax.random.normal(key_a, (spec.m, spec.k), dtype=jnp.float32).astype(dt)
-    b = jax.random.normal(key_b, (spec.k, spec.n), dtype=jnp.float32).astype(dt)
-    a, b = jax.device_put(a), jax.device_put(b)
+    a, b = gemm_operands(spec, seed)
     prec = spec.precision
     bench = DeviceLoopBench(
         op=lambda x, y: gemm(x, y, prec), args=(a, b), perturb=0)
