@@ -27,3 +27,13 @@ def setup(virtual_devices: int = 8) -> None:
     os.environ["JAX_PLATFORMS"] = "cpu"     # force, not setdefault
     import jax
     jax.config.update("jax_platforms", "cpu")
+
+
+def artifact_path(name: str) -> str:
+    """Where an example drops a rendered artifact (kept out of the
+    package tree; TOSEM_EXAMPLE_OUT overrides for CI temp dirs)."""
+    base = os.environ.get("TOSEM_EXAMPLE_OUT") or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "results", "examples")
+    os.makedirs(base, exist_ok=True)
+    return os.path.join(base, name)

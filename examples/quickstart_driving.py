@@ -32,7 +32,14 @@ graph = LaneGraph([
 rtc = ComponentRuntime()
 rtc.add(RoutingComponent(graph))
 rtc.add(TrackerComponent(iou_threshold=0.1))
-build_driving_pipeline(rtc, lane_half=1.6, frame_dt=1.0, horizon=2.0)
+build_driving_pipeline(rtc, lane_half=1.6, frame_dt=1.0, horizon=2.0,
+                       localize=True)
+
+# the dreamview role: record frames for the dashboard's /drive panel
+from tosem_tpu.obs.driveview import DriveViewRecorder  # noqa: E402
+
+view = DriveViewRecorder(lane_half=1.6)
+rtc.add(view)
 
 frames = []
 
@@ -66,8 +73,13 @@ scenes = ([[]] * 2
           + [[[38.0, 1.4 - 0.4 * i, 42.0, 2.4 - 0.4 * i]]
              for i in range(3)]
           + [[[12.0, -1.6, 16.0, 1.6]]] * 2)
-for boxes in scenes:
+imu_w = rtc.writer("imu")
+gnss_w = rtc.writer("gnss")
+for i, boxes in enumerate(scenes):
     ego_w({"v": 8.0})
+    # feed the localization branch so the drive view carries ego pose
+    gnss_w({"pos": [8.0 * i, 0.0]})
+    imu_w({"yaw_rate": 0.0, "accel": 0.0})
     det_w({"boxes": np.asarray(boxes, np.float32).reshape(-1, 4),
            "scores": np.ones((len(boxes),), np.float32)})
     t += 1.0
@@ -82,3 +94,13 @@ assert frames[-1][0]["stop_fence"] <= 11.0      # stops short of the wall
 print(f"== drove {len(frames)} frames over "
       f"{route['length_m']:.0f} m of route; "
       f"scenario trace: {' -> '.join(dict.fromkeys(scenarios))}")
+
+# render the final frame the way GET /drive would (server-side SVG)
+from tosem_tpu.obs.driveview import render_scene_svg  # noqa: E402
+
+svg = render_scene_svg(view.scene())
+out = _bootstrap.artifact_path("driveview.html")
+with open(out, "w") as f:
+    f.write(f"<!doctype html><html><body>{svg}</body></html>")
+assert "<svg" in svg and "polyline" in svg
+print(f"== drive view rendered -> {out}")

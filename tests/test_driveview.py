@@ -1,0 +1,103 @@
+"""Drive view (the dreamview role): scene recording + SVG + HTTP.
+
+Role model: ``modules/dreamview/`` — Apollo's web HMI republishing
+cyber channels into a rendered driving world. Here the recorder is a
+plain fused-reader component on the deterministic runtime and the
+dashboard renders the scene server-side; the tests drive the REAL
+pipeline (prediction → scenario → planning → control) and assert the
+rendered artifact reflects what the planner saw.
+"""
+import json
+import urllib.request
+
+import numpy as np
+
+from tosem_tpu.dataflow.components import ComponentRuntime
+from tosem_tpu.models.control import build_driving_pipeline
+from tosem_tpu.models.perception import TrackerComponent
+from tosem_tpu.obs.dashboard import DashboardServer
+from tosem_tpu.obs.driveview import DriveViewRecorder, render_scene_svg
+
+
+def _drive_frames(rec=None, frames=3):
+    rtc = ComponentRuntime()
+    rtc.add(TrackerComponent(iou_threshold=0.1))
+    build_driving_pipeline(rtc, frame_dt=1.0, horizon=2.0, localize=True)
+    if rec is not None:
+        rtc.add(rec)
+    det_w = rtc.writer("detections")
+    imu_w = rtc.writer("imu")
+    gnss_w = rtc.writer("gnss")
+    for i in range(frames):
+        det_w({"boxes": np.array([[18.0, -0.6, 22.0, 0.5]]),
+               "scores": np.array([0.9])})
+        gnss_w({"pos": [1.0 * i, 0.0]})
+        imu_w({"yaw_rate": 0.0, "accel": 0.0})
+        rtc.run_until(float(i + 1))
+    return rtc
+
+
+class TestRecorder:
+    def test_scene_fuses_all_channels(self):
+        rec = DriveViewRecorder(lane_half=1.75)
+        _drive_frames(rec)
+        scene = rec.scene()
+        assert scene is not None
+        assert len(scene["path_l"]) >= 2
+        obs = np.asarray(scene["obstacles"])
+        live = obs[obs[:, 1] > obs[:, 0]]
+        assert len(live) >= 1 and live[0, 0] <= 18.0
+        assert "steer0" in scene and "accel0" in scene
+        assert scene["ego"]["v"] > 0
+        assert len(scene["speed_history"]) >= 1
+
+    def test_empty_scene_before_any_frame(self):
+        assert DriveViewRecorder().scene() is None
+
+
+class TestRender:
+    def test_svg_contains_scene_elements(self):
+        rec = DriveViewRecorder()
+        _drive_frames(rec)
+        svg = render_scene_svg(rec.scene())
+        assert "<svg" in svg and "polyline" in svg      # planned path
+        assert "polygon" in svg                          # ego marker
+        assert svg.count("<rect") >= 3                   # bg+lane+obstacle
+        assert "figcaption" in svg
+
+    def test_render_handles_missing_fields(self):
+        assert "no driving frames" in render_scene_svg({})
+        minimal = {"path_l": [0.0, 0.1], "s_profile": [0.0, 1.0]}
+        out = render_scene_svg(minimal)
+        assert "<svg" in out
+
+    def test_caption_escapes_hostile_scenario_name(self):
+        scene = {"path_l": [0.0, 0.1], "s_profile": [0.0, 1.0],
+                 "scenario": "<script>alert(1)</script>"}
+        out = render_scene_svg(scene)
+        assert "<script>" not in out
+
+
+class TestHttp:
+    def test_drive_routes(self):
+        rec = DriveViewRecorder()
+        _drive_frames(rec)
+        srv = DashboardServer(driveview=rec)
+        try:
+            page = urllib.request.urlopen(
+                srv.url + "/drive", timeout=10).read().decode()
+            assert "<svg" in page and "drive view" in page
+            api = json.loads(urllib.request.urlopen(
+                srv.url + "/api/drive", timeout=10).read().decode())
+            assert api["path_l"] == rec.scene()["path_l"]
+        finally:
+            srv.shutdown()
+
+    def test_drive_route_without_recorder(self):
+        srv = DashboardServer()
+        try:
+            page = urllib.request.urlopen(
+                srv.url + "/drive", timeout=10).read().decode()
+            assert "no driveview recorder" in page
+        finally:
+            srv.shutdown()

@@ -8,6 +8,7 @@ single-controller topology (SURVEY §5.5).
 from tosem_tpu.obs import metrics
 from tosem_tpu.obs.dashboard import (DashboardServer, render_html,
                                      render_text, snapshot)
+from tosem_tpu.obs.driveview import DriveViewRecorder, render_scene_svg
 from tosem_tpu.obs.log_monitor import LogMonitor
 from tosem_tpu.obs.memory_monitor import MemoryMonitor
 from tosem_tpu.obs.sysmo import SysMo
@@ -19,5 +20,5 @@ __all__ = [
     "metrics", "Counter", "Gauge", "Histogram", "Registry", "MetricsServer",
     "counter", "gauge", "histogram", "prometheus_text", "MemoryMonitor",
     "LogMonitor", "DashboardServer", "snapshot", "render_text",
-    "render_html", "SysMo",
+    "render_html", "SysMo", "DriveViewRecorder", "render_scene_svg",
 ]

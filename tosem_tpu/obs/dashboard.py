@@ -341,7 +341,8 @@ class DashboardServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0, *,
                  kv_path: Optional[str] = None,
                  results_csv: Optional[str] = None,
-                 serve: Any = None, sysmo: bool = False):
+                 serve: Any = None, sysmo: bool = False,
+                 driveview: Any = None):
         from tosem_tpu.obs.httpd import RouteServer
         self._sysmo = None
         if sysmo:
@@ -369,6 +370,25 @@ class DashboardServer:
             if path.startswith("/metrics"):
                 return (200, "text/plain; version=0.0.4",
                         _metrics.prometheus_text().encode())
+            if path.startswith("/api/drive"):
+                # dreamview-backend role: the latest scene as JSON
+                scene = driveview.scene() if driveview is not None else None
+                return (200, "application/json",
+                        json.dumps(scene or {}).encode())
+            if path.startswith("/drive"):
+                from tosem_tpu.obs.driveview import render_scene_svg
+                scene = driveview.scene() if driveview is not None else None
+                body = ("<!doctype html><html><head>"
+                        "<title>drive view</title>"
+                        "<meta http-equiv='refresh' content='1'>"
+                        "</head><body style='font-family:monospace'>"
+                        "<h2>drive view</h2>"
+                        + (render_scene_svg(scene) if scene else
+                           "<p>(no driveview recorder attached)</p>"
+                           if driveview is None else
+                           "<p>(no driving frames yet)</p>")
+                        + "</body></html>")
+                return (200, "text/html", body.encode())
             if path.startswith("/api/experiment/"):
                 # trial drill-down for the interactive layer
                 from urllib.parse import unquote
