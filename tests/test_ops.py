@@ -25,6 +25,24 @@ class TestGemm:
     def test_flops(self):
         assert GemmSpec(1024, 1024, 1024).flops == 2 * 1024 ** 3
 
+    def test_int8_accumulates_int32_bit_exact(self):
+        # the PTQ deployment path: int8 operands, int32 accumulation —
+        # must be EXACT integer arithmetic, not a float round trip
+        from tosem_tpu.ops.gemm import gemm_operands
+        spec = GemmSpec(64, 64, 64, "int8", "default")
+        a, b = gemm_operands(spec)
+        out = gemm(a, b)
+        assert out.dtype == jnp.int32
+        np.testing.assert_array_equal(
+            np.asarray(out),
+            np.asarray(a, np.int32) @ np.asarray(b, np.int32))
+
+    def test_int8_operands_span_the_range(self):
+        from tosem_tpu.ops.gemm import gemm_operands
+        a, _ = gemm_operands(GemmSpec(128, 128, 128, "int8", "default"))
+        vals = np.asarray(a)
+        assert vals.min() < -100 and vals.max() > 100
+
 
 class TestConv:
     def test_numerics_vs_reference(self):

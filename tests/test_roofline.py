@@ -36,6 +36,12 @@ class TestAnnotate:
         annotate_roofline(r)
         assert r.extra["mfu"] == 1.0
 
+    def test_int8_uses_integer_peak(self):
+        from tosem_tpu.utils.roofline import PEAK_INT8_GOPS
+        r = _row(value=PEAK_INT8_GOPS / 2, dtype="int8")
+        annotate_roofline(r)
+        assert r.extra["mfu"] == 0.5
+
     def test_memory_bound_small_gemm(self):
         # tiny flops, huge bytes, per-call time present -> memory bound
         r = _row(value=100.0, bytes=1 << 30, mean_ms=10.0)

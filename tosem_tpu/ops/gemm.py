@@ -44,6 +44,11 @@ class GemmSpec:
 
 @functools.partial(jax.jit, static_argnames=("precision",))
 def gemm(a: jax.Array, b: jax.Array, precision: str = "float32") -> jax.Array:
+    if jnp.issubdtype(a.dtype, jnp.integer):
+        # int8 rides the MXU's double-rate integer path (v5e: 394 TOPS);
+        # accumulate in int32 — the deployment dtype the PTQ stack
+        # (compress/quantization.py) produces
+        return jnp.dot(a, b, preferred_element_type=jnp.int32)
     return jnp.dot(a, b, precision=PRECISION[precision])
 
 
@@ -52,10 +57,16 @@ def gemm_operands(spec: GemmSpec, seed: int = 0):
     cross-validating timers measure the SAME program)."""
     key_a, key_b = jax.random.split(jax.random.PRNGKey(seed))
     dt = jnp.dtype(spec.dtype)
-    a = jax.random.normal(key_a, (spec.m, spec.k),
-                          dtype=jnp.float32).astype(dt)
-    b = jax.random.normal(key_b, (spec.k, spec.n),
-                          dtype=jnp.float32).astype(dt)
+    if jnp.issubdtype(dt, jnp.integer):
+        a = jax.random.randint(key_a, (spec.m, spec.k), -127, 128,
+                               dtype=jnp.int32).astype(dt)
+        b = jax.random.randint(key_b, (spec.k, spec.n), -127, 128,
+                               dtype=jnp.int32).astype(dt)
+    else:
+        a = jax.random.normal(key_a, (spec.m, spec.k),
+                              dtype=jnp.float32).astype(dt)
+        b = jax.random.normal(key_b, (spec.k, spec.n),
+                              dtype=jnp.float32).astype(dt)
     return jax.device_put(a), jax.device_put(b)
 
 
@@ -95,4 +106,8 @@ DEFAULT_GEMM_SWEEP = [
     GemmSpec(2048, 2048, 2048, "float32", "float32"),
     GemmSpec(4096, 4096, 4096, "bfloat16", "default"),
     GemmSpec(8192, 8192, 8192, "bfloat16", "default"),
+    # the int8 serving path (what the PTQ stack deploys): MXU integer
+    # rate is 2x bf16 on v5e, the beyond-cuBLAS axis
+    GemmSpec(4096, 4096, 4096, "int8", "default"),
+    GemmSpec(8192, 8192, 8192, "int8", "default"),
 ]

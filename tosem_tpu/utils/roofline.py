@@ -25,6 +25,7 @@ from tosem_tpu.utils.results import ResultRow, SCHEMA
 
 PEAK_BF16_GFLOPS = 197_000.0             # v5e MXU peak, bf16
 PEAK_FP32_GFLOPS = PEAK_BF16_GFLOPS / 6  # 6-pass bf16 emulation (HIGHEST)
+PEAK_INT8_GOPS = 394_000.0               # v5e MXU integer path (2x bf16)
 PEAK_HBM_GBPS = 819.0                    # v5e HBM bandwidth
 
 
@@ -41,7 +42,12 @@ def annotate_roofline(row: ResultRow) -> None:
     unit = row.unit.lower()
     dtype = str(row.extra.get("dtype", ""))
     if unit == "gflops":
-        peak = PEAK_FP32_GFLOPS if "float32" in dtype else PEAK_BF16_GFLOPS
+        if "float32" in dtype:
+            peak = PEAK_FP32_GFLOPS
+        elif "int8" in dtype:
+            peak = PEAK_INT8_GOPS
+        else:
+            peak = PEAK_BF16_GFLOPS
         row.extra["mfu"] = round(row.value / peak, 4)
         nbytes = row.extra.get("bytes")
         if nbytes and row.value > 0:
