@@ -58,6 +58,12 @@ LEGS = [
     _north_star_leg("bert_train"),
     _north_star_leg("conv_sweep"),
     _north_star_leg("allreduce"),
+    # long-context kernel evidence: the same suite at 4x/8x the
+    # north-star sequence (T^2 attention term dominates here)
+    ("bert_kernels_t2048", CLI + ["--config=bert_kernels", "--seq=2048"],
+     2400),
+    ("bert_kernels_t4096", CLI + ["--config=bert_kernels", "--seq=4096"],
+     2400),
     ("bert_train_remat_dots", CLI + ["--config=bert_train", "--remat=dots"],
      1500),
     ("bert_train_remat_full", CLI + ["--config=bert_train", "--remat=full"],
