@@ -88,35 +88,40 @@ def bert_kernel_suite(*, batch: int = 8, seq: int = 512, heads: int = 12,
 
     # XLA-path attention at the same shape: the direct flash-vs-XLA
     # comparison rows (quantifies what the Pallas kernel buys — or
-    # costs — on this chip, honest either way)
-    from tosem_tpu.nn.attention import dot_product_attention
+    # costs — on this chip, honest either way). XLA materializes the
+    # [B,H,T,T] score tensor; past ~1 GB that's exactly the
+    # memory-wall flash exists to avoid, so the comparison is skipped
+    # (long-context configs) rather than OOMing the whole suite.
+    scores_bytes = B * H * T * T * dt.itemsize
+    if scores_bytes <= 1 << 30:
+        from tosem_tpu.nn.attention import dot_product_attention
 
-    def _xla_attn(a, b, c):
-        tr = lambda x: x.transpose(0, 2, 1, 3)      # [B,H,T,D]→[B,T,H,D]
-        return tr(dot_product_attention(tr(a), tr(b), tr(c)))
+        def _xla_attn(a, b, c):
+            tr = lambda x: x.transpose(0, 2, 1, 3)  # [B,H,T,D]→[B,T,H,D]
+            return tr(dot_product_attention(tr(a), tr(b), tr(c)))
 
-    sec = DeviceLoopBench(op=jax.jit(_xla_attn), args=(q, k, v),
-                          perturb=0).time(reps=reps)
-    fl = attention_flops(B, H, T, D, bwd=False)
-    rows.append(_row(f"attention_fwd_xla_b{B}_t{T}_{dtype}", "gflops",
-                     fl / sec / 1e9, "GFLOPS",
-                     {"flop_model": "4BHT^2D", "time_us": sec * 1e6,
-                      "shape": [B, H, T, D], "dtype": dtype,
-                      "path": "xla"}))
-    xla_grad = jax.jit(jax.grad(
-        lambda a, b, c: jnp.sum(_xla_attn(a, b, c)
-                                .astype(jnp.float32) ** 2), (0, 1, 2)))
-    sec = DeviceLoopBench(op=_all_grads(xla_grad), args=(q, k, v),
-                          perturb=0).time(reps=reps)
-    # XLA keeps activations (no recompute): its hardware work is
-    # 4 fwd + 8 bwd = 12BHT^2D; compare paths by time_us, not GFLOPS
-    fl = 12.0 * B * H * T * T * D
-    rows.append(_row(f"attention_fwdbwd_xla_b{B}_t{T}_{dtype}", "gflops",
-                     fl / sec / 1e9, "GFLOPS",
-                     {"flop_model": "12BHT^2D (no recompute)",
-                      "time_us": sec * 1e6,
-                      "shape": [B, H, T, D], "dtype": dtype,
-                      "path": "xla"}))
+        sec = DeviceLoopBench(op=jax.jit(_xla_attn), args=(q, k, v),
+                              perturb=0).time(reps=reps)
+        fl = attention_flops(B, H, T, D, bwd=False)
+        rows.append(_row(f"attention_fwd_xla_b{B}_t{T}_{dtype}", "gflops",
+                         fl / sec / 1e9, "GFLOPS",
+                         {"flop_model": "4BHT^2D", "time_us": sec * 1e6,
+                          "shape": [B, H, T, D], "dtype": dtype,
+                          "path": "xla"}))
+        xla_grad = jax.jit(jax.grad(
+            lambda a, b, c: jnp.sum(_xla_attn(a, b, c)
+                                    .astype(jnp.float32) ** 2), (0, 1, 2)))
+        sec = DeviceLoopBench(op=_all_grads(xla_grad), args=(q, k, v),
+                              perturb=0).time(reps=reps)
+        # XLA keeps activations (no recompute): its hardware work is
+        # 4 fwd + 8 bwd = 12BHT^2D; compare paths by time_us, not GFLOPS
+        fl = 12.0 * B * H * T * T * D
+        rows.append(_row(f"attention_fwdbwd_xla_b{B}_t{T}_{dtype}",
+                         "gflops", fl / sec / 1e9, "GFLOPS",
+                         {"flop_model": "12BHT^2D (no recompute)",
+                          "time_us": sec * 1e6,
+                          "shape": [B, H, T, D], "dtype": dtype,
+                          "path": "xla"}))
 
     # layernorm fwd / fwd+bwd over [B*T, hidden]
     x = jax.random.normal(ks[3], (B * T, hidden), jnp.float32).astype(dt)
@@ -139,18 +144,22 @@ def bert_kernel_suite(*, batch: int = 8, seq: int = 512, heads: int = 12,
                      {"bytes": 4 * x.nbytes, "time_us": sec * 1e6,
                       "dtype": dtype}))
 
-    # softmax fwd / fwd+bwd over attention-logit shape [B*H*T, T]
-    s = jax.random.normal(ks[3], (B * H * T, T), jnp.float32).astype(dt)
+    # softmax fwd / fwd+bwd over attention-logit shape [B*H*T, T] —
+    # row count capped so the buffer stays ≤256 MB at long T (the
+    # bandwidth number is row-count invariant; the bench_id carries the
+    # actual shape)
+    sm_rows = min(B * H * T, max(256, (256 << 20) // (T * dt.itemsize)))
+    s = jax.random.normal(ks[3], (sm_rows, T), jnp.float32).astype(dt)
     sm = jax.jit(fused_softmax)
     sec = DeviceLoopBench(op=sm, args=(s,), perturb=0).time(reps=reps)
-    rows.append(_row(f"softmax_fwd_{B * H * T}x{T}_{dtype}", "gbps",
+    rows.append(_row(f"softmax_fwd_{sm_rows}x{T}_{dtype}", "gbps",
                      2 * s.nbytes / sec / 1e9, "GB/s",
                      {"bytes": 2 * s.nbytes, "time_us": sec * 1e6,
                       "dtype": dtype}))
     sm_grad = jax.jit(jax.grad(
         lambda x: jnp.sum(fused_softmax(x).astype(jnp.float32) ** 2)))
     sec = DeviceLoopBench(op=sm_grad, args=(s,), perturb=0).time(reps=reps)
-    rows.append(_row(f"softmax_fwdbwd_{B * H * T}x{T}_{dtype}", "gbps",
+    rows.append(_row(f"softmax_fwdbwd_{sm_rows}x{T}_{dtype}", "gbps",
                      4 * s.nbytes / sec / 1e9, "GB/s",
                      {"bytes": 4 * s.nbytes, "time_us": sec * 1e6,
                       "dtype": dtype}))
