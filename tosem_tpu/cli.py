@@ -588,6 +588,66 @@ def run_detection_infer(fs: FlagSet) -> List[Any]:
     return rows
 
 
+def run_pointpillars_infer(fs: FlagSet) -> List[Any]:
+    """PointPillars end-to-end inference latency (Apollo's lidar path).
+
+    The reference benchmarks its TensorRT PointPillars engine as
+    points→boxes latency (``modules/perception/lidar/.../point_pillars``
+    under trt profiling); the analog here is the jitted
+    voxelize→PFN→canvas→head→NMS program on realistic KITTI-scale
+    density (~16k lidar points), timed on-device.
+    """
+    import jax
+    import jax.numpy as jnp
+    from tosem_tpu.models.pointpillars import (PillarGrid,
+                                               PointPillarsDetector)
+    from tosem_tpu.utils.results import ResultRow
+    from tosem_tpu.utils.timing import DeviceLoopBench
+
+    on_tpu = fs.device == "tpu"
+    # ~70m x 70m field at 0.5m pillars, KITTI-like point budget
+    grid = (PillarGrid(0.0, 70.4, -35.2, 35.2, 141, 141, 32)
+            if on_tpu else PillarGrid(0, 8, 0, 8, 4, 4, 8))
+    n_pts = 16384 if on_tpu else 256
+    det = PointPillarsDetector(grid)
+    key = jax.random.PRNGKey(0)
+    params = det.init(key)
+    pts = jax.random.uniform(
+        jax.random.fold_in(key, 1), (n_pts, 4),
+        minval=jnp.array([grid.x_min, grid.y_min, -2.0, 0.0]),
+        maxval=jnp.array([grid.x_max, grid.y_max, 2.0, 1.0]))
+
+    platform = jax.devices()[0].platform
+    rows = []
+    apply_fn = jax.jit(lambda p: det.apply(params, p)[0])
+    sec = DeviceLoopBench(op=apply_fn, args=(pts,), perturb=0).time()
+    rows.append(ResultRow(
+        project="models", config="pointpillars_infer",
+        bench_id=f"pointpillars_apply_n{n_pts}_{grid.nx}x{grid.ny}",
+        metric="latency_ms", value=sec * 1e3, unit="ms",
+        device=platform, n_devices=1,
+        extra={"points": n_pts, "grid": [grid.nx, grid.ny],
+               "clouds_per_sec": round(1.0 / sec, 1)}))
+
+    def detect_fn(p):
+        boxes, scores, keep = det.detect(params, p)
+        return boxes * keep[:, None].astype(boxes.dtype) + scores[:, None]
+
+    sec = DeviceLoopBench(op=jax.jit(detect_fn), args=(pts,),
+                          perturb=0).time()
+    rows.append(ResultRow(
+        project="models", config="pointpillars_infer",
+        bench_id=f"pointpillars_detect_n{n_pts}_{grid.nx}x{grid.ny}",
+        metric="latency_ms", value=sec * 1e3, unit="ms",
+        device=platform, n_devices=1,
+        extra={"points": n_pts, "grid": [grid.nx, grid.ny],
+               "includes": "device NMS",
+               "clouds_per_sec": round(1.0 / sec, 1)}))
+    for r in rows:
+        print(f"  {r.bench_id}: {r.value:.2f} {r.unit}")
+    return rows
+
+
 def run_speech_train(fs: FlagSet) -> List[Any]:
     """DeepSpeech-family end-to-end: synthetic corpus import → bucketed
     batches → CTC training → WER eval with greedy, beam, and LM-scored
@@ -775,6 +835,7 @@ RUNNERS = {
     "bert_train": run_bert_train,
     "detection_train": run_detection_train,
     "detection_infer": run_detection_infer,
+    "pointpillars_infer": run_pointpillars_infer,
     "speech_train": run_speech_train,
     "analysis": run_analysis,
 }

@@ -71,6 +71,7 @@ LEGS = [
     ("pjrt_execute", [sys.executable, "-m", "pytest",
                       "tests/test_pjrt_driver.py", "-q"], 900),
     ("detection_infer", CLI + ["--config=detection_infer"], 1800),
+    ("pointpillars_infer", CLI + ["--config=pointpillars_infer"], 1500),
     ("speech_train", CLI + ["--config=speech_train", "--steps=10"], 2400),
     ("detection_train", CLI + ["--config=detection_train", "--steps=10"],
      2400),
