@@ -24,9 +24,10 @@ from typing import Any, Dict, List
 
 from tosem_tpu.utils.flags import FlagSet
 
-CONFIGS = ("gemm", "conv_sweep", "allreduce", "resnet_train",
-           "bert_kernels", "bert_train", "detection_train",
-           "detection_infer", "speech_train", "analysis")
+CONFIGS = ("gemm", "timing_check", "conv_sweep", "allreduce",
+           "resnet_train", "bert_kernels", "bert_train",
+           "detection_train", "detection_infer", "pointpillars_infer",
+           "speech_train", "analysis")
 
 
 def make_flags() -> FlagSet:
