@@ -64,6 +64,9 @@ def make_flags() -> FlagSet:
     fs.define_string("reference_dir", "/root/reference",
                      "study checkout for the replication leg (skipped "
                      "when absent)")
+    fs.define_bool("profile", False,
+                   "bert_train: capture an xplane trace of a few steps "
+                   "and write the nvprof-style kernel summary CSV")
     fs.define_string("remat", "none",
                      "bert_train activation remat: none|full|dots "
                      "(recompute layer activations in backward — "
@@ -426,6 +429,26 @@ def run_bert_train(fs: FlagSet) -> List[Any]:
             unit="x", device=jax.devices()[0].platform, n_devices=1,
             extra={"xla_ms": times["xla"] * 1e3,
                    "flash_ms": times["flash"] * 1e3}))
+
+    if fs.profile:
+        # nvprof-style evidence for the flagship step (SURVEY §5.1):
+        # trace a few flash-path steps, emit the kernel-summary CSV
+        from tosem_tpu.profiler.trace import (capture_trace,
+                                              kernel_summary_csv)
+        prof_dir = os.path.join(
+            os.path.dirname(fs.results_csv) or ".", "profile",
+            f"bert_train_{'tpu' if on_tpu else 'cpu'}{tag}")
+        step = make_step(flash_attn_fn())
+        ts, rng = ts0, jax.random.PRNGKey(3)
+        ts, loss = step(ts, rng)                  # compile outside trace
+        with capture_trace(prof_dir):
+            for _ in range(3):
+                rng, sub = jax.random.split(rng)
+                ts, loss = step(ts, sub)
+            float(jax.device_get(loss))
+        csv_path = os.path.join(prof_dir, "kernel_summary.csv")
+        stats = kernel_summary_csv(prof_dir, csv_path)
+        print(f"  profile: {len(stats)} kernels -> {csv_path}")
     for r in rows:
         print(f"  {r.bench_id} {r.metric}: {r.value:.2f} {r.unit}")
     return rows

@@ -70,6 +70,9 @@ LEGS = [
      1500),
     ("pjrt_execute", [sys.executable, "-m", "pytest",
                       "tests/test_pjrt_driver.py", "-q"], 900),
+    # nvprof-style kernel summary of the flagship step, on-chip
+    ("bert_train_profile", CLI + ["--config=bert_train", "--steps=3",
+                                  "--profile"], 1500),
     ("detection_infer", CLI + ["--config=detection_infer"], 1800),
     ("pointpillars_infer", CLI + ["--config=pointpillars_infer"], 1500),
     ("speech_train", CLI + ["--config=speech_train", "--steps=10"], 2400),
