@@ -26,7 +26,8 @@ if [[ "$QUICK" == "1" ]]; then
     tests/test_analysis.py tests/test_image_ops.py tests/test_htm.py \
     tests/test_compress.py tests/test_scorer.py tests/test_ring.py \
     tests/test_moe.py tests/test_pipeline.py tests/test_routing.py \
-    tests/test_control_prediction.py tests/test_planning.py
+    tests/test_control_prediction.py tests/test_planning.py \
+    tests/test_localization.py tests/test_roofline.py
   echo "== quick CI green"
   exit 0
 fi
