@@ -47,8 +47,11 @@ def bert_kernel_suite(*, batch: int = 8, seq: int = 512, heads: int = 12,
     # attention forward — autotune the block sizes on the device (the
     # TensorRT-plugin practice of tactic selection): sweep fwd, reuse the
     # winning blocks for fwd+bwd so the bwd pass compiles only once
+    # candidates above 512 only help (and only tile) at long T; scores
+    # block stays ≤2 MB f32 so VMEM holds q/k/v blocks + stats alongside
     sweep = {(min(bq, T), min(bk, T))
-             for bq, bk in ((128, 128), (256, 256), (256, 512), (512, 512))
+             for bq, bk in ((128, 128), (256, 256), (256, 512),
+                            (512, 512), (512, 1024), (1024, 512))
              if T % min(bq, T) == 0 and T % min(bk, T) == 0}
     fl = attention_flops(B, H, T, D, bwd=False)
     best = None
