@@ -159,21 +159,27 @@ class TestDrivingSoak:
                                                LANE_FOLLOW,
                                                OBSTACLE_AVOID)
 
+        from tosem_tpu.obs.driveview import DriveViewRecorder
+
         rng = np.random.default_rng(3)
         rtc = ComponentRuntime()
         build_driving_pipeline(rtc, frame_dt=1.0, horizon=2.0,
-                               n=32, max_k=2)
+                               n=32, max_k=2, localize=True)
+        view = DriveViewRecorder()
+        rtc.add(view)
         frames = []
 
         class Sink(Component):
             def __init__(self):
-                super().__init__("sink", ["control", "trajectory"])
+                super().__init__("sink", ["control", "trajectory",
+                                          "pose"])
 
-            def proc(self, ctl, traj):
-                frames.append((ctl, traj))
+            def proc(self, ctl, traj, pose):
+                frames.append((ctl, traj, pose))
 
         rtc.add(Sink())
         ego_w, det_w = rtc.writer("ego"), rtc.writer("tracks")
+        imu_w, gnss_w = rtc.writer("imu"), rtc.writer("gnss")
         t = 0.0
         for i in range(100):
             k = int(rng.integers(0, 3))
@@ -186,12 +192,18 @@ class TestDrivingSoak:
                                        y0 + rng.uniform(0.3, 1.2)]})
             ego_w({"v": float(rng.uniform(2.0, 12.0))})
             det_w(tracks)
+            # noisy localization inputs alongside the traffic
+            if i % 4 == 0:
+                gnss_w({"pos": [5.0 * i + rng.normal(0, 0.5),
+                                rng.normal(0, 0.5)]})
+            imu_w({"yaw_rate": float(rng.normal(0, 0.05)),
+                   "accel": float(rng.normal(0, 0.3))})
             t += 1.0
             rtc.run_until(t)
 
         assert len(frames) == 100
         seen = set()
-        for ctl, traj in frames:
+        for ctl, traj, pose in frames:
             seen.add(traj["scenario"])
             assert traj["scenario"] in (LANE_FOLLOW, OBSTACLE_AVOID,
                                         EMERGENCY_STOP)
@@ -199,5 +211,12 @@ class TestDrivingSoak:
             assert np.isfinite(traj["s_profile"]).all()
             assert np.isfinite(ctl["steer"]).all()
             assert np.isfinite(ctl["accel"]).all()
+            # the EKF pose never goes non-finite under noisy inputs
+            assert np.isfinite(pose["pos"]).all()
+            assert np.isfinite(pose["cov"]).all()
         # randomized traffic must actually exercise multiple scenarios
         assert len(seen) >= 2, seen
+        # the dreamview recorder kept pace with the loop and its last
+        # scene renders (the long-running-HMI property)
+        from tosem_tpu.obs.driveview import render_scene_svg
+        assert "<svg" in render_scene_svg(view.scene())
