@@ -21,8 +21,8 @@ from jax import lax
 
 from tosem_tpu.ops.common import PRECISION
 from tosem_tpu.utils.results import ResultRow
-from tosem_tpu.utils.timing import (BenchStats, DeviceLoopBench, gflops,
-                                    matmul_flops)
+from tosem_tpu.utils.timing import (BenchStats, DeviceLoopBench,
+                                    chain_overhead, gflops, matmul_flops)
 
 
 @dataclass(frozen=True)
@@ -87,14 +87,23 @@ def gemm_bench(spec: GemmSpec, *, n_iter: int = 0, reps: int = 3,
                        min_s=sec, p50_s=sec)
     gf = gflops(spec.flops, stats.min_s)
     platform = jax.devices()[0].platform
+    extra = {"m": spec.m, "n": spec.n, "k": spec.k, "dtype": spec.dtype,
+             "precision": spec.precision, "mean_ms": stats.mean_ms,
+             "bytes": (spec.m * spec.k + spec.k * spec.n
+                       + spec.m * spec.n) * jnp.dtype(spec.dtype).itemsize}
+    if spec.m * spec.n * spec.k <= 2048 ** 3:
+        # small shapes: the loop chain's O(n^2) bookkeeping is no longer
+        # negligible next to the O(n^3) op — attach the overhead bracket
+        # (see utils.timing.chain_overhead) so readers can correct
+        ovh = chain_overhead((a, b), 0, reps=reps)
+        if 0.0 < ovh < sec:
+            extra["chain_overhead_us"] = round(ovh * 1e6, 3)
+            extra["gflops_nooverhead"] = round(
+                gflops(spec.flops, sec - ovh), 1)
     row = ResultRow(
         project="ops", config="gemm", bench_id=spec.bench_id,
         metric="gflops", value=gf, unit="GFLOPS", device=platform,
-        n_devices=1,
-        extra={"m": spec.m, "n": spec.n, "k": spec.k, "dtype": spec.dtype,
-               "precision": spec.precision, "mean_ms": stats.mean_ms,
-               "bytes": (spec.m * spec.k + spec.k * spec.n
-                         + spec.m * spec.n) * jnp.dtype(spec.dtype).itemsize},
+        n_devices=1, extra=extra,
     )
     return stats, row
 

@@ -188,6 +188,27 @@ class DeviceLoopBench:
             n_iter = min(n_iter * 4, max_iter)
 
 
+def chain_overhead(args: tuple, perturb: int = 0, *,
+                   reps: int = 3) -> float:
+    """Seconds/iter of the loop-chain bookkeeping alone (upper bound).
+
+    The :class:`DeviceLoopBench` body adds ``eps*s`` to one operand and
+    mean-reduces the output — O(elements) memory work per iteration
+    that is negligible next to an O(n^3) matmul but not next to a small
+    op. This times an *identity-op* loop (same perturb + reduce, no
+    op), giving an upper bound on that overhead: in the real loop XLA
+    may fuse the add into the op's operand read and the mean into its
+    output, making the true overhead smaller. Consumers can report
+    ``[t_raw - overhead, t_raw]`` as the honest bracket for small ops.
+    """
+    bench = DeviceLoopBench(op=lambda *xs: xs[perturb], args=args,
+                            perturb=perturb)
+    try:
+        return bench.time(reps=reps)
+    except MeasurementBelowNoiseFloor:
+        return 0.0
+
+
 def gflops(flop_count: float, seconds: float) -> float:
     return flop_count / seconds / 1e9 if seconds > 0 else float("inf")
 
