@@ -1,0 +1,47 @@
+"""Capture-harness plumbing (no TPU needed): leg construction + CLI.
+
+The opportunistic capture (`tpu_capture.py`) is round-5's answer to the
+flapping axon relay; these tests pin the host-side logic that must not
+rot: north-star legs share bench.py's exact flags/timeouts (so the two
+entry points can never measure the same config under different
+parameters), and a typo'd --legs selection is an error, not a silent
+successful no-op.
+"""
+import subprocess
+import sys
+
+import tpu_capture
+from bench import CONFIG_FLAGS, CONFIG_TIMEOUT_S, CONFIG_ORDER
+
+
+class TestLegs:
+    def test_north_star_legs_share_bench_flags(self):
+        legs = {name: (argv, t) for name, argv, t in tpu_capture.LEGS}
+        for cfg in CONFIG_ORDER:
+            if cfg not in legs:
+                continue
+            argv, timeout = legs[cfg]
+            assert f"--config={cfg}" in argv
+            for flag in CONFIG_FLAGS.get(cfg, []):
+                assert flag in argv, (cfg, flag)
+            if cfg in CONFIG_TIMEOUT_S:
+                assert timeout == CONFIG_TIMEOUT_S[cfg]
+
+    def test_all_legs_write_the_shared_csv(self):
+        for name, argv, _ in tpu_capture.LEGS:
+            if "pytest" in " ".join(argv):
+                continue
+            assert f"--results_csv={tpu_capture.CSV}" in argv, name
+
+    def test_leg_names_unique(self):
+        names = [l[0] for l in tpu_capture.LEGS]
+        assert len(names) == len(set(names))
+
+
+class TestCli:
+    def test_unknown_leg_is_an_error(self):
+        proc = subprocess.run(
+            [sys.executable, "tpu_capture.py", "--legs", "bert_kernel"],
+            capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 2
+        assert "unknown legs" in proc.stderr
