@@ -7,8 +7,13 @@ entry points can never measure the same config under different
 parameters), and a typo'd --legs selection is an error, not a silent
 successful no-op.
 """
+import os
 import subprocess
 import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
 
 import tpu_capture
 from bench import CONFIG_FLAGS, CONFIG_TIMEOUT_S, CONFIG_ORDER
@@ -41,7 +46,8 @@ class TestLegs:
 class TestCli:
     def test_unknown_leg_is_an_error(self):
         proc = subprocess.run(
-            [sys.executable, "tpu_capture.py", "--legs", "bert_kernel"],
-            capture_output=True, text=True, timeout=60)
+            [sys.executable, os.path.join(REPO, "tpu_capture.py"),
+             "--legs", "bert_kernel"],
+            capture_output=True, text=True, timeout=60, cwd=REPO)
         assert proc.returncode == 2
         assert "unknown legs" in proc.stderr

@@ -9,3 +9,6 @@ from tosem_tpu.cluster.node import RemoteNode
 from tosem_tpu.cluster.param import ParameterPoller, ParameterServer
 from tosem_tpu.cluster.replay import Recorder, replay, replay_source
 from tosem_tpu.cluster.rpc import RpcClient, RpcError, RpcServer
+from tosem_tpu.cluster.stubgen import (describe, describe_remote,
+                                       write_stubs)
+from tosem_tpu.cluster.xlang import XLangGateway, xlang_call

@@ -69,6 +69,7 @@ class XLangGateway:
         self._fns: Dict[str, Callable] = {
             "ping": lambda: "pong",
             "list_methods": self._list_methods,
+            "list_signatures": self._list_signatures,
         }
         self._lock = threading.Lock()
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -84,6 +85,14 @@ class XLangGateway:
     def _list_methods(self) -> List[str]:
         with self._lock:
             return sorted(self._fns)
+
+    def _list_signatures(self) -> List[Dict[str, Any]]:
+        """Wire-level introspection for the stub generator
+        (:mod:`tosem_tpu.cluster.stubgen`): name + positional parameter
+        names + first doc line per registered function."""
+        from tosem_tpu.cluster.stubgen import describe
+        return [{"name": s.name, "params": list(s.params), "doc": s.doc}
+                for s in describe(self)]
 
     def register(self, name: str, fn: Callable) -> None:
         """Expose ``fn`` to non-Python callers under ``name`` — the
