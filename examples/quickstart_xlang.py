@@ -1,0 +1,99 @@
+"""Quickstart: cross-language surface + generated client stubs.
+
+Boot the JSON-wire gateway, bridge the durable experiment manager onto
+it (the nnictl surface), then do what a non-Python team would do:
+
+1. introspect the LIVE gateway over the wire,
+2. generate client stubs for C++ / Java / Node (the SWIG role,
+   ``tosem_tpu.cluster.stubgen``),
+3. compile the generated C++ stub with g++ and drive a whole HPO
+   experiment through it — create, start, poll, results — without a
+   line of Python on the client side.
+
+    python examples/quickstart_xlang.py
+"""
+import _bootstrap
+
+_bootstrap.setup()
+
+import json                                                   # noqa: E402
+import os                                                     # noqa: E402
+import subprocess                                             # noqa: E402
+import tempfile                                               # noqa: E402
+import time                                                   # noqa: E402
+
+
+def trial(config):
+    x = config["x"]
+    for i in range(3):
+        yield {"loss": (x - 2.0) ** 2 + 1.0 / (i + 1)}
+
+
+def main():
+    from tosem_tpu.cluster.stubgen import describe_remote, write_stubs
+    from tosem_tpu.cluster.xlang import XLangGateway
+    from tosem_tpu.tune.experiment import ExperimentManager
+
+    workdir = tempfile.mkdtemp(prefix="xlang_quickstart_")
+    mgr = ExperimentManager(path=os.path.join(workdir, "experiments.db"))
+    gw = XLangGateway()
+    gw.bridge_experiments(mgr)
+    print(f"gateway at {gw.address} with methods:")
+
+    # 1-2: wire introspection -> stub families
+    methods = describe_remote(gw.address)
+    for m in methods:
+        print(f"  {m.name}({', '.join(m.params)})")
+    stub_dir = _bootstrap.artifact_path("stubs")
+    paths = write_stubs(methods, stub_dir)
+    for lang, p in sorted(paths.items()):
+        print(f"generated {lang}: {p}")
+
+    # 3: compile the C++ stub and run the whole experiment through it
+    host, port = gw.address.split(":")
+    main_cpp = os.path.join(workdir, "drive.cpp")
+    with open(main_cpp, "w") as f:
+        f.write(f'''
+#include "TosemXlangClient.hpp"
+#include <unistd.h>
+#include <cstdio>
+#include <string>
+int main() {{
+  TosemXlangClient c("{host}", "{port}");
+  std::string spec = R"({{"name": "demo",
+    "trainable": "quickstart_xlang:trial",
+    "space": {{"x": {{"type": "uniform", "low": -4.0, "high": 6.0}}}},
+    "metric": "loss", "mode": "min", "num_samples": 4,
+    "max_iterations": 3}})";
+  if (!TosemXlangClient::ok(c.experiment_create(spec))) return 1;
+  if (!TosemXlangClient::ok(c.experiment_start("\\"demo\\""))) return 2;
+  for (int i = 0; i < 600; ++i) {{
+    std::string st = c.experiment_status("\\"demo\\"");
+    if (st.find("\\"done\\"") != std::string::npos ||
+        st.find("\\"failed\\"") != std::string::npos) break;
+    usleep(200 * 1000);
+  }}
+  std::string res = c.experiment_results("\\"demo\\"");
+  std::printf("%s\\n", res.c_str());
+  return TosemXlangClient::ok(res) ? 0 : 3;
+}}
+''')
+    binary = os.path.join(workdir, "drive")
+    subprocess.run(["g++", "-std=c++17", "-O1", main_cpp, "-o", binary,
+                    f"-I{stub_dir}"], check=True, timeout=240)
+    t0 = time.time()
+    proc = subprocess.run([binary], capture_output=True, text=True,
+                          timeout=240)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    trials = payload["result"]
+    best = min((t["best_score"] for t in trials
+                if t.get("best_score") is not None), default=None)
+    assert best is not None and best < 36.0
+    print(f"C++ stub drove a {len(trials)}-trial experiment end-to-end "
+          f"in {time.time() - t0:.1f}s; best loss {best:.3f}")
+    gw.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -23,7 +23,7 @@ def test_inventory_pinned():
     """New examples must join the smoke matrix, not dodge it."""
     assert EXAMPLES == ["quickstart_driving.py", "quickstart_gang.py",
                        "quickstart_hpo.py", "quickstart_serve.py",
-                       "quickstart_train.py"]
+                       "quickstart_train.py", "quickstart_xlang.py"]
 
 
 @pytest.mark.slow
