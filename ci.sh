@@ -27,7 +27,8 @@ if [[ "$QUICK" == "1" ]]; then
     tests/test_compress.py tests/test_scorer.py tests/test_ring.py \
     tests/test_moe.py tests/test_pipeline.py tests/test_routing.py \
     tests/test_control_prediction.py tests/test_planning.py \
-    tests/test_localization.py tests/test_roofline.py
+    tests/test_localization.py tests/test_roofline.py \
+    tests/test_stubgen.py tests/test_tpu_capture.py
   echo "== quick CI green"
   exit 0
 fi
