@@ -82,6 +82,29 @@ class TestGeneratedSources:
         assert "writeUInt32BE" in src and "readUInt32BE" in src
         assert "module.exports" in src
 
+    def test_csharp_swaps_endianness(self, gateway):
+        from tosem_tpu.cluster.stubgen import generate_csharp
+        src = generate_csharp(describe(gateway))
+        # BinaryWriter is little-endian; the wire is big-endian
+        assert "HostToNetworkOrder" in src
+        assert "NetworkToHostOrder" in src
+        assert "public class TosemXlangClient" in src
+
+    def test_swift_uses_big_endian_length(self, gateway):
+        from tosem_tpu.cluster.stubgen import generate_swift
+        src = generate_swift(describe(gateway))
+        assert ".bigEndian" in src and "UInt32(bigEndian:" in src
+        assert "func call(" in src
+
+    def test_write_stubs_emits_all_five_families(self, gateway,
+                                                 tmp_path):
+        from tosem_tpu.cluster.stubgen import write_stubs
+        paths = write_stubs(describe(gateway), str(tmp_path))
+        assert sorted(paths) == ["cpp", "csharp", "java", "node",
+                                 "swift"]
+        for p in paths.values():
+            assert os.path.getsize(p) > 500
+
 
 @pytest.mark.slow
 class TestCompiledCpp:

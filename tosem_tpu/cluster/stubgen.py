@@ -37,7 +37,8 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
 __all__ = ["MethodSpec", "describe", "describe_remote", "generate_cpp",
-           "generate_java", "generate_node", "write_stubs"]
+           "generate_java", "generate_node", "generate_csharp",
+           "generate_swift", "write_stubs"]
 
 
 @dataclass(frozen=True)
@@ -347,14 +348,169 @@ module.exports = {{ {class_name} }};
 """
 
 
+def _csharp_method(m: MethodSpec) -> str:
+    args = ", ".join(f"string {p}Json" for p in m.params)
+    arg_list = ", ".join(f"{p}Json" for p in m.params)
+    doc = f"  /// <summary>{m.doc}</summary>\n" if m.doc else ""
+    arr = f"new string[]{{{arg_list}}}" if m.params else "new string[0]"
+    return (f"{doc}  public string {m.ident.title().replace('_', '')}"
+            f"({args}) {{\n    return Call(\"{m.name}\", {arr});\n  }}\n")
+
+
+def generate_csharp(methods: List[MethodSpec],
+                    class_name: str = "TosemXlangClient") -> str:
+    """C# stub (the reference's .NET family, ``native_client/dotnet/``).
+
+    .NET's ``BinaryReader``/``Writer`` are little-endian, so the 4-byte
+    frame length goes through ``IPAddress.HostToNetworkOrder``.
+    """
+    _check_idents(methods)
+    methods_src = "".join(_csharp_method(m) for m in methods)
+    return f"""// GENERATED by tosem_tpu.cluster.stubgen — do not edit.
+// C# client stub for the cross-language JSON wire (cluster/xlang.py).
+using System;
+using System.IO;
+using System.Net;
+using System.Net.Sockets;
+using System.Text;
+
+public class {class_name} {{
+  private readonly string host;
+  private readonly int port;
+
+  public {class_name}(string host, int port) {{
+    this.host = host;
+    this.port = port;
+  }}
+
+  public string Call(string method, string[] jsonArgs) {{
+    var req = new StringBuilder();
+    req.Append("{{\\"method\\": \\"").Append(method)
+       .Append("\\", \\"args\\": [");
+    for (int i = 0; i < jsonArgs.Length; i++) {{
+      if (i > 0) req.Append(", ");
+      req.Append(jsonArgs[i]);
+    }}
+    req.Append("]}}");
+    byte[] payload = Encoding.UTF8.GetBytes(req.ToString());
+    using (var client = new TcpClient(host, port)) {{
+      var stream = client.GetStream();
+      var writer = new BinaryWriter(stream);
+      // BinaryWriter is little-endian; the wire is big-endian
+      writer.Write(IPAddress.HostToNetworkOrder(payload.Length));
+      writer.Write(payload);
+      writer.Flush();
+      var reader = new BinaryReader(stream);
+      int len = IPAddress.NetworkToHostOrder(reader.ReadInt32());
+      if (len < 0 || len > (64 << 20))
+        throw new IOException("huge frame");
+      byte[] resp = reader.ReadBytes(len);
+      return Encoding.UTF8.GetString(resp);
+    }}
+  }}
+
+  public static bool Ok(string response) {{
+    return response.Contains("\\"ok\\": true");
+  }}
+
+{methods_src}}}
+"""
+
+
+def _swift_method(m: MethodSpec) -> str:
+    args = ", ".join(f"_ {p}Json: String" for p in m.params)
+    arg_list = ", ".join(f"{p}Json" for p in m.params)
+    doc = f"  /// {m.doc}\n" if m.doc else ""
+    return (f"{doc}  func {m.ident}({args}) throws -> String {{\n"
+            f"    return try call(\"{m.name}\", [{arg_list}])\n  }}\n")
+
+
+def generate_swift(methods: List[MethodSpec],
+                   class_name: str = "TosemXlangClient") -> str:
+    """Swift stub (the reference's ``native_client/swift/`` family) —
+    Foundation ``Stream`` I/O, explicit big-endian length bytes."""
+    _check_idents(methods)
+    methods_src = "".join(_swift_method(m) for m in methods)
+    return f"""// GENERATED by tosem_tpu.cluster.stubgen — do not edit.
+// Swift client stub for the cross-language JSON wire (cluster/xlang.py).
+import Foundation
+
+enum XlangError: Error {{ case transport(String) }}
+
+final class {class_name} {{
+  let host: String
+  let port: UInt32
+
+  init(host: String, port: UInt32) {{
+    self.host = host
+    self.port = port
+  }}
+
+  func call(_ method: String, _ jsonArgs: [String]) throws -> String {{
+    let req = "{{\\"method\\": \\"\\(method)\\", \\"args\\": " +
+        "[\\(jsonArgs.joined(separator: ", "))]}}"
+    let payload = Array(req.utf8)
+    var frame = [UInt8]()
+    let n = UInt32(payload.count).bigEndian   // wire is big-endian
+    withUnsafeBytes(of: n) {{ frame.append(contentsOf: $0) }}
+    frame.append(contentsOf: payload)
+
+    var input: InputStream?
+    var output: OutputStream?
+    Stream.getStreamsToHost(withName: host, port: Int(port),
+                            inputStream: &input, outputStream: &output)
+    guard let inp = input, let out = output else {{
+      throw XlangError.transport("connect failed")
+    }}
+    inp.open(); out.open()
+    defer {{ inp.close(); out.close() }}
+    var sent = 0
+    while sent < frame.count {{
+      let w = frame[sent...].withUnsafeBufferPointer {{
+        out.write($0.baseAddress!, maxLength: frame.count - sent)
+      }}
+      if w <= 0 {{ throw XlangError.transport("short write") }}
+      sent += w
+    }}
+    func readExact(_ n: Int) throws -> [UInt8] {{
+      var buf = [UInt8](repeating: 0, count: n)
+      var got = 0
+      while got < n {{
+        let r = buf[got...].withUnsafeMutableBufferPointer {{
+          inp.read($0.baseAddress!, maxLength: n - got)
+        }}
+        if r <= 0 {{ throw XlangError.transport("short read") }}
+        got += r
+      }}
+      return buf
+    }}
+    let lenBytes = try readExact(4)
+    let len = lenBytes.withUnsafeBytes {{
+      UInt32(bigEndian: $0.load(as: UInt32.self))
+    }}
+    if len > (64 << 20) {{ throw XlangError.transport("huge frame") }}
+    let body = try readExact(Int(len))
+    return String(decoding: body, as: UTF8.self)
+  }}
+
+  static func ok(_ response: String) -> Bool {{
+    return response.contains("\\"ok\\": true")
+  }}
+
+{methods_src}}}
+"""
+
+
 def write_stubs(methods: List[MethodSpec], out_dir: str,
                 class_name: str = "TosemXlangClient") -> Dict[str, str]:
-    """Emit all three stub families; returns {language: path}."""
+    """Emit all five stub families; returns {language: path}."""
     os.makedirs(out_dir, exist_ok=True)
     paths = {
         "cpp": os.path.join(out_dir, f"{class_name}.hpp"),
         "java": os.path.join(out_dir, f"{class_name}.java"),
         "node": os.path.join(out_dir, f"{class_name.lower()}.js"),
+        "csharp": os.path.join(out_dir, f"{class_name}.cs"),
+        "swift": os.path.join(out_dir, f"{class_name}.swift"),
     }
     with open(paths["cpp"], "w") as f:
         f.write(generate_cpp(methods, class_name))
@@ -362,6 +518,10 @@ def write_stubs(methods: List[MethodSpec], out_dir: str,
         f.write(generate_java(methods, class_name))
     with open(paths["node"], "w") as f:
         f.write(generate_node(methods, class_name))
+    with open(paths["csharp"], "w") as f:
+        f.write(generate_csharp(methods, class_name))
+    with open(paths["swift"], "w") as f:
+        f.write(generate_swift(methods, class_name))
     return paths
 
 
