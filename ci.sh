@@ -28,7 +28,8 @@ if [[ "$QUICK" == "1" ]]; then
     tests/test_moe.py tests/test_pipeline.py tests/test_routing.py \
     tests/test_control_prediction.py tests/test_planning.py \
     tests/test_localization.py tests/test_roofline.py \
-    tests/test_stubgen.py tests/test_tpu_capture.py
+    tests/test_stubgen.py tests/test_tpu_capture.py \
+    tests/test_driving_replay.py
   echo "== quick CI green"
   exit 0
 fi
