@@ -7,8 +7,10 @@
 # Tiers:
 #   ./ci.sh          full release gate (tests + native + sanitizers +
 #                    C++ client + multichip dryrun) — slow (~40 min)
-#   ./ci.sh --quick  iteration tier (~5-6 min): syntax gate + the pure
-#                    numerics/unit files, no process-spawning suites
+#   ./ci.sh --quick  iteration tier (~6-7 min): syntax gate + the pure
+#                    numerics/unit files (no process-spawning suites)
+#                    + the 3-plan chaos smoke (the one deliberate
+#                    process-spawning step, so fault paths gate every PR)
 set -euo pipefail
 cd "$(dirname "$0")"
 
@@ -18,8 +20,18 @@ QUICK=0
 echo "== byte-compile (syntax gate)"
 python -m compileall -q tosem_tpu tests examples bench.py __graft_entry__.py
 
+chaos_smoke() {
+  # fast chaos smoke: 3 canned fault plans, fixed seeds (<60s) — the
+  # runtime/serve/tune failure paths run on every PR, not just when a
+  # chaos test file is touched (see tosem_tpu/chaos/)
+  echo "== chaos smoke (3 canned fault plans, fixed seeds)"
+  for plan in worker-carnage serve-flap trial-crash; do
+    JAX_PLATFORMS=cpu python -m tosem_tpu.cli chaos --plan "$plan"
+  done
+}
+
 if [[ "$QUICK" == "1" ]]; then
-  echo "== quick tier: numerics + unit tests (no process spawns)"
+  echo "== quick tier: numerics + unit tests + chaos smoke"
   python -m pytest -q -m "not slow" \
     tests/test_ops.py tests/test_pallas_kernels.py tests/test_nn.py \
     tests/test_sharding.py tests/test_serial.py tests/test_utils.py \
@@ -30,6 +42,7 @@ if [[ "$QUICK" == "1" ]]; then
     tests/test_localization.py tests/test_roofline.py \
     tests/test_stubgen.py tests/test_tpu_capture.py \
     tests/test_driving_replay.py
+  chaos_smoke
   echo "== quick CI green"
   exit 0
 fi
@@ -57,6 +70,8 @@ for suite, san in (("objstore", "asan"), ("decoder", "asan"),
     assert rc == 0, f"{suite}/{san} failed:\n{out[-2000:]}"
     print(f"{suite}/{san}: clean")
 EOF
+
+chaos_smoke
 
 echo "== multichip dryrun (8 virtual devices: factoring sweep + pp + ep)"
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

@@ -24,6 +24,22 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compilation cache: recompiling identical programs
+# dominates suite wall-clock on CPU CI, and repeated runs (local
+# iteration, CI retries, the tiered gates) hit the same programs. The
+# cache dir survives across runs; harmless when cold.
+try:
+    import tempfile
+    _default_cache = os.path.join(
+        tempfile.gettempdir(),
+        f"tosem_jax_cache_{os.getuid() if hasattr(os, 'getuid') else 'u'}")
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.environ.get("TOSEM_JAX_CACHE_DIR", _default_cache))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+except Exception:   # unknown config on some jax versions: run uncached
+    pass
+
 import pytest  # noqa: E402
 
 

@@ -18,3 +18,28 @@ harnesses this framework re-expresses TPU-first:
 """
 
 __version__ = "0.1.0"
+
+# Robustness surface, exported lazily (PEP 562) so `import tosem_tpu`
+# stays light — none of these pull jax or spawn anything until touched.
+_LAZY_EXPORTS = {
+    "DeadlineExceeded": ("tosem_tpu.runtime.common", "DeadlineExceeded"),
+    "CircuitOpen": ("tosem_tpu.serve.breaker", "CircuitOpen"),
+    "CircuitBreaker": ("tosem_tpu.serve.breaker", "CircuitBreaker"),
+    "FaultPlan": ("tosem_tpu.chaos.plan", "FaultPlan"),
+    "Fault": ("tosem_tpu.chaos.plan", "Fault"),
+    "ChaosController": ("tosem_tpu.chaos.injector", "ChaosController"),
+}
+
+__all__ = sorted(_LAZY_EXPORTS)
+
+
+def __getattr__(name: str):
+    try:
+        mod_name, attr = _LAZY_EXPORTS[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}") from None
+    import importlib
+    value = getattr(importlib.import_module(mod_name), attr)
+    globals()[name] = value          # cache: __getattr__ runs once per name
+    return value

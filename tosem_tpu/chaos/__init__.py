@@ -1,0 +1,33 @@
+"""Deterministic chaos layer: seeded fault injection for the runtime,
+serve, tune, and cluster layers.
+
+The TOSEM-2021 study found failure-handling paths chronically
+under-tested in distributed ML stacks (Ray, NNI, DeepSpeech). This
+package turns those paths into first-class tested surface: a
+:class:`FaultPlan` is a seed plus a schedule of typed faults, a
+:class:`ChaosController` installed via :func:`install` makes the
+framework's injection sites fire them, and every decision is a pure
+function of ``(seed, plan, event counts)`` — so a chaos run replays
+exactly and chaos tests are ordinary deterministic pytest cases.
+
+    from tosem_tpu.chaos import FaultPlan, Fault, ChaosController, install
+
+    plan = FaultPlan(seed=7, faults=[
+        Fault(site="runtime.dispatch", action="kill_worker", at=3),
+        Fault(site="runtime.result", action="drop_result", at=5),
+    ])
+    with ChaosController(plan) as chaos:
+        ...  # run the workload; chaos.log records every injection
+
+Canned plans live in :data:`CANNED_PLANS`; ``python -m tosem_tpu.cli
+chaos --plan <name>`` runs one against an in-process workload and
+prints a survival report.
+"""
+from tosem_tpu.chaos.hooks import fire, get_controller, install, uninstall
+from tosem_tpu.chaos.injector import ChaosController
+from tosem_tpu.chaos.plan import CANNED_PLANS, Fault, FaultPlan
+
+__all__ = [
+    "Fault", "FaultPlan", "CANNED_PLANS", "ChaosController",
+    "install", "uninstall", "get_controller", "fire",
+]

@@ -1,0 +1,69 @@
+"""``tosem_tpu chaos`` — run a named fault plan and print the survival
+report.
+
+    python -m tosem_tpu.cli chaos --list
+    python -m tosem_tpu.cli chaos --plan worker-carnage
+    python -m tosem_tpu.cli chaos --plan split-survival --seed 42 --json
+    python -m tosem_tpu.cli chaos --plan-file my_plan.json --scenario serve-flap
+
+Exit code 0 = the workload survived every injected fault; 1 = it did
+not (the ci.sh chaos smoke step gates on this).
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import sys
+from typing import List, Optional
+
+from tosem_tpu.chaos.plan import CANNED_PLANS, FaultPlan
+from tosem_tpu.chaos.runner import SCENARIOS, run_plan
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tosem_tpu chaos",
+        description="deterministic fault injection: run a plan, report "
+                    "survival")
+    ap.add_argument("--plan", default=None,
+                    help=f"canned plan name, one of {sorted(CANNED_PLANS)}")
+    ap.add_argument("--plan-file", default=None,
+                    help="JSON FaultPlan file (pair with --scenario)")
+    ap.add_argument("--scenario", default="",
+                    help="workload to run the plan against "
+                    f"({sorted(SCENARIOS)}; defaults to the plan name)")
+    ap.add_argument("--seed", type=int, default=None,
+                    help="override the plan's seed (replay knob)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the survival report as JSON")
+    ap.add_argument("--list", action="store_true",
+                    help="list canned plans and exit")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for name in sorted(CANNED_PLANS):
+            p = CANNED_PLANS[name]
+            faults = ", ".join(f"{f.site}:{f.action}@{f.at}"
+                               for f in p.faults)
+            print(f"{name:16s} seed={p.seed:<4d} {faults}")
+        return 0
+
+    if bool(args.plan) == bool(args.plan_file):
+        ap.error("exactly one of --plan / --plan-file is required")
+    if args.plan is not None:
+        if args.plan not in CANNED_PLANS:
+            ap.error(f"unknown plan {args.plan!r}; see --list")
+        plan = CANNED_PLANS[args.plan]
+    else:
+        with open(args.plan_file) as f:
+            plan = FaultPlan.from_json(f.read())
+    if args.seed is not None:
+        plan = dataclasses.replace(plan, seed=args.seed)
+
+    report = run_plan(plan, scenario=args.scenario)
+    print(report.to_json() if args.json else report.render())
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,59 @@
+"""Injection seam between the framework and the chaos controller.
+
+Import-light on purpose: :mod:`tosem_tpu.runtime.runtime`,
+:mod:`tosem_tpu.serve.core`, and :mod:`tosem_tpu.tune.tune` call
+:func:`fire` at their injection sites, so this module must not import
+any of them (and costs one attribute load + None check when no
+controller is installed — the production fast path).
+
+Sites and the actions they honor:
+
+==================  =====================================  =============
+site                fired                                   actions
+==================  =====================================  =============
+runtime.dispatch    task/actor-call written to a worker     kill_worker
+runtime.result      "done" message drained from a worker    drop_result,
+                                                            delay_result
+runtime.store       large result sealed into the store      evict_object
+serve.dispatch      request routed to a replica             crash_replica,
+                                                            slow_replica
+tune.step           trial step result processed             crash_trial
+==================  =====================================  =============
+
+The cluster layer's node agent runs in a separate process, so its
+faults ride environment variables instead (``TOSEM_CHAOS_NODE_
+UNHEALTHY_AFTER``, ``TOSEM_CHAOS_SLOW_HEALTH_S``; see
+:mod:`tosem_tpu.cluster.node`) and the trial worker honors
+``TOSEM_CHAOS_TRIAL_CRASH_AT`` (:mod:`tosem_tpu.tune.trial_worker`).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+_controller: Optional[Any] = None
+
+
+def install(controller: Any) -> Any:
+    """Install ``controller`` as the process-wide chaos controller.
+    Returns it (convenience for ``chaos = install(ChaosController(p))``)."""
+    global _controller
+    _controller = controller
+    return controller
+
+
+def uninstall() -> None:
+    global _controller
+    _controller = None
+
+
+def get_controller() -> Optional[Any]:
+    return _controller
+
+
+def fire(site: str, **ctx: Any) -> Optional[Dict[str, Any]]:
+    """Report one event at ``site``; returns the action dict the
+    installed controller wants applied there, or None (no chaos)."""
+    c = _controller
+    if c is None:
+        return None
+    return c.on(site, **ctx)

@@ -1,0 +1,149 @@
+"""Fault plans: a seed plus a schedule of typed faults.
+
+A :class:`Fault` names an injection site, an action, and a *trigger
+window* over that site's event sequence: the fault fires on matching
+events number ``at`` through ``at + times - 1`` (1-based, counted per
+site). Because triggers are event ordinals — never wall-clock — a run
+of the same workload under the same ``(seed, plan)`` injects the same
+faults at the same points, which is what makes chaos tests ordinary
+deterministic pytest cases (the property CuPBoP/COX-style ports get
+from replayable stress harnesses).
+
+Plans serialize to/from plain JSON dicts so they can live in test
+fixtures, CI scripts, and the ``tosem_tpu chaos`` CLI.
+"""
+from __future__ import annotations
+
+import json
+from dataclasses import asdict, dataclass, field
+from typing import Any, Dict, List, Optional
+
+# NOTE: cluster-layer faults are NOT plan sites — node agents and trial
+# workers run in their own processes, so those faults ride env vars
+# (TOSEM_CHAOS_NODE_UNHEALTHY_AFTER, TOSEM_CHAOS_SLOW_HEALTH_S,
+# TOSEM_CHAOS_TRIAL_CRASH_AT; see tosem_tpu/cluster/node.py and
+# tosem_tpu/tune/trial_worker.py). Listing a site here that nothing
+# fires would validate and then silently never inject.
+VALID_SITES = (
+    "runtime.dispatch", "runtime.result", "runtime.store",
+    "serve.dispatch", "tune.step",
+)
+
+VALID_ACTIONS = {
+    "runtime.dispatch": ("kill_worker",),
+    "runtime.result": ("drop_result", "delay_result"),
+    "runtime.store": ("evict_object",),
+    "serve.dispatch": ("crash_replica", "slow_replica"),
+    "tune.step": ("crash_trial",),
+}
+
+
+@dataclass(frozen=True)
+class Fault:
+    """One typed fault: fire ``action`` at ``site`` on matching events
+    ``at .. at + times - 1`` (1-based ordinals of events whose target
+    matches ``target``; ``target=None`` matches every event)."""
+
+    site: str
+    action: str
+    at: int = 1
+    times: int = 1
+    target: Optional[str] = None   # deployment name / trial id / None=any
+    delay_s: float = 0.0           # for delay_result / slow_replica
+
+    def __post_init__(self) -> None:
+        if self.site not in VALID_SITES:
+            raise ValueError(f"unknown chaos site {self.site!r}; "
+                             f"choose from {VALID_SITES}")
+        if self.action not in VALID_ACTIONS[self.site]:
+            raise ValueError(
+                f"action {self.action!r} not valid at {self.site!r}; "
+                f"choose from {VALID_ACTIONS[self.site]}")
+        if self.at < 1 or self.times < 1:
+            raise ValueError("at and times must be >= 1 (1-based ordinals)")
+        if self.delay_s < 0:
+            raise ValueError("delay_s must be >= 0")
+
+    def window(self) -> range:
+        return range(self.at, self.at + self.times)
+
+
+@dataclass(frozen=True)
+class FaultPlan:
+    """Seed + fault schedule. The seed drives every random choice a
+    controller makes (there are none in the canned plans — they pin
+    their triggers — but custom plans may rely on it), so ``(seed,
+    plan)`` fully determines the injection sequence for a given
+    workload."""
+
+    seed: int
+    faults: List[Fault] = field(default_factory=list)
+    name: str = ""
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"seed": self.seed, "name": self.name,
+                "faults": [asdict(f) for f in self.faults]}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "FaultPlan":
+        return cls(seed=int(d["seed"]), name=d.get("name", ""),
+                   faults=[Fault(**f) for f in d.get("faults", [])])
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), sort_keys=True)
+
+    @classmethod
+    def from_json(cls, blob: str) -> "FaultPlan":
+        return cls.from_dict(json.loads(blob))
+
+
+# --------------------------------------------------------------- canned plans
+#
+# Each canned plan pairs with a workload scenario of the same name in
+# :mod:`tosem_tpu.chaos.runner` (and the ci.sh chaos smoke step runs a
+# fixed-seed subset on every PR).
+
+def _canned() -> Dict[str, FaultPlan]:
+    return {
+        # kill 2 of the 4 pool workers mid-task and drop one result
+        # message — the runtime must replay every affected task.
+        # target="task" scopes the faults to stateless task workers
+        # (runtime.dispatch/result events carry target "task" | "actor")
+        "worker-carnage": FaultPlan(seed=7, name="worker-carnage", faults=[
+            Fault(site="runtime.dispatch", action="kill_worker", at=3,
+                  target="task"),
+            Fault(site="runtime.dispatch", action="kill_worker", at=9,
+                  target="task"),
+            Fault(site="runtime.result", action="drop_result", at=5,
+                  target="task"),
+        ]),
+        # crash one serve replica process and slow another request —
+        # the router must retry onto survivors / the restarted replica
+        "serve-flap": FaultPlan(seed=11, name="serve-flap", faults=[
+            Fault(site="serve.dispatch", action="crash_replica", at=2),
+            Fault(site="serve.dispatch", action="slow_replica", at=6,
+                  delay_s=0.05),
+        ]),
+        # crash a tune trial between checkpoints — the trial must
+        # resume from its last checkpoint, not restart from iteration 0
+        "trial-crash": FaultPlan(seed=13, name="trial-crash", faults=[
+            Fault(site="tune.step", action="crash_trial", at=5),
+        ]),
+        # the acceptance-criteria plan: 2 worker kills + 1 dropped
+        # result + 1 trial crash, all surviving in one run. The
+        # runtime faults are scoped to target="task" so the trial's
+        # actor worker sees exactly ONE fault (the scheduled crash) —
+        # that keeps `trial_failures == 1` a deterministic assertion
+        "split-survival": FaultPlan(seed=42, name="split-survival", faults=[
+            Fault(site="runtime.dispatch", action="kill_worker", at=4,
+                  target="task"),
+            Fault(site="runtime.dispatch", action="kill_worker", at=11,
+                  target="task"),
+            Fault(site="runtime.result", action="drop_result", at=7,
+                  target="task"),
+            Fault(site="tune.step", action="crash_trial", at=5),
+        ]),
+    }
+
+
+CANNED_PLANS: Dict[str, FaultPlan] = _canned()

@@ -1,0 +1,205 @@
+"""Chaos scenarios: canned workloads that run a FaultPlan to survival.
+
+Each canned plan in :data:`tosem_tpu.chaos.plan.CANNED_PLANS` pairs with
+a workload here of the same name. A scenario builds the workload, runs
+it under an installed :class:`ChaosController`, and returns a
+:class:`SurvivalReport` — did every task/request/trial finish correctly
+*despite* the injected faults? The report is what the ``tosem_tpu
+chaos`` CLI prints and what the ci.sh chaos smoke step gates on.
+
+Determinism contract: the plan's injection decisions replay exactly
+from ``(seed, plan)`` (event-ordinal triggers); the asserted outcomes
+(all results correct, trial resumed from checkpoint) are
+timing-invariant, so the same scenario is also run as a pytest case.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List
+
+from tosem_tpu.chaos.injector import ChaosController
+from tosem_tpu.chaos.plan import CANNED_PLANS, FaultPlan
+
+
+@dataclass
+class SurvivalReport:
+    plan: str
+    seed: int
+    ok: bool
+    counts: Dict[str, int] = field(default_factory=dict)
+    injections: List[Dict[str, Any]] = field(default_factory=list)
+    notes: List[str] = field(default_factory=list)
+    elapsed_s: float = 0.0
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), sort_keys=True)
+
+    def render(self) -> str:
+        verdict = "SURVIVED" if self.ok else "FAILED"
+        lines = [f"chaos plan {self.plan!r} (seed={self.seed}): {verdict} "
+                 f"in {self.elapsed_s:.1f}s"]
+        for k in sorted(self.counts):
+            lines.append(f"  {k}: {self.counts[k]}")
+        lines.append(f"  faults injected: {len(self.injections)}")
+        for inj in self.injections:
+            lines.append(f"    #{inj['seq']} {inj['site']} -> "
+                         f"{inj['action']}"
+                         + (f" (target={inj['target']})"
+                            if inj.get("target") else ""))
+        lines.extend(f"  note: {n}" for n in self.notes)
+        return "\n".join(lines)
+
+
+# ------------------------------------------------------------- workloads
+# module-level so cloudpickle ships them to workers by reference
+
+def _square_after(x: int, delay_s: float = 0.05) -> int:
+    time.sleep(delay_s)
+    return x * x
+
+
+class _EchoBackend:
+    def call(self, request):
+        return {"echo": request}
+
+
+def _counting_trainable():
+    """The resumable step-counting trainable (state = iteration count):
+    shared with the cluster trial plane's crash-resume tests so every
+    resume path exercises the same save_state/load_state contract."""
+    from tosem_tpu.tune.examples import counting
+    return counting
+
+
+# ------------------------------------------------------------- scenarios
+
+def _scenario_runtime(chaos: ChaosController,
+                      rep: SurvivalReport) -> None:
+    """24 tasks on a 4-worker pool; kills/drops must all be survived by
+    the retry/replay machinery, with every result still correct."""
+    import tosem_tpu.runtime as rt
+    rt.init(num_workers=4, memory_monitor=False)
+    try:
+        f = rt.remote(_square_after)
+        refs = [f.remote(i) for i in range(24)]
+        results = rt.get(refs, timeout=120.0)
+        bad = [i for i, v in enumerate(results) if v != i * i]
+        rep.counts["tasks_submitted"] = 24
+        rep.counts["tasks_correct"] = 24 - len(bad)
+        rep.ok = not bad
+        if bad:
+            rep.notes.append(f"wrong results for tasks {bad}")
+    finally:
+        rt.shutdown()
+
+
+def _scenario_serve(chaos: ChaosController,
+                    rep: SurvivalReport) -> None:
+    """12 requests against a 2-replica deployment with a breaker; the
+    router's retry+backoff must absorb a replica crash and a slow hit."""
+    import tosem_tpu.runtime as rt
+    from tosem_tpu.serve.core import Serve
+    rt.init(num_workers=2, memory_monitor=False)
+    try:
+        serve = Serve()
+        serve.deploy("echo", _EchoBackend, num_replicas=2,
+                     circuit_breaker=True)
+        h = serve.get_handle("echo")
+        ok = 0
+        for i in range(12):
+            if h.call({"i": i}, timeout=60.0) == {"echo": {"i": i}}:
+                ok += 1
+        rep.counts["requests"] = 12
+        rep.counts["requests_ok"] = ok
+        rep.ok = ok == 12
+    finally:
+        rt.shutdown()
+
+
+def _scenario_tune(chaos: ChaosController,
+                   rep: SurvivalReport) -> None:
+    """2 trials × 8 iterations, checkpoint every 2: the injected crash
+    must resume its trial from the last checkpoint, not restart it."""
+    import tosem_tpu.runtime as rt
+    from tosem_tpu.tune import tune as tt
+    rt.init(num_workers=2, memory_monitor=False)
+    try:
+        analysis = tt.run(_counting_trainable(), {"x": 1.0},
+                          metric="loss", mode="min", num_samples=2,
+                          max_iterations=8, checkpoint_freq=2,
+                          max_concurrent=2)
+        done = [t for t in analysis.trials if t.status == tt.TERMINATED]
+        crashed = [t for t in analysis.trials if t.failures > 0]
+        rep.counts["trials"] = len(analysis.trials)
+        rep.counts["trials_finished"] = len(done)
+        rep.counts["trials_crashed_and_resumed"] = len(
+            [t for t in crashed if t.status == tt.TERMINATED])
+        full = all(t.iteration >= 8 for t in done)
+        rep.ok = (len(done) == len(analysis.trials) and full)
+        if not full:
+            rep.notes.append("a trial finished short of max_iterations "
+                             "(restarted instead of resumed?)")
+    finally:
+        rt.shutdown()
+
+
+def _scenario_split(chaos: ChaosController,
+                    rep: SurvivalReport) -> None:
+    """The acceptance-criteria run: 16 tasks on 4 workers (2 killed, one
+    result dropped) plus a tune trial crashed between checkpoints — one
+    runtime, everything finishes correctly."""
+    import tosem_tpu.runtime as rt
+    from tosem_tpu.tune import tune as tt
+    rt.init(num_workers=4, memory_monitor=False)
+    try:
+        f = rt.remote(_square_after)
+        refs = [f.remote(i) for i in range(16)]
+        analysis = tt.run(_counting_trainable(), {"x": 1.0},
+                          metric="loss", mode="min", num_samples=1,
+                          max_iterations=8, checkpoint_freq=2,
+                          max_concurrent=1)
+        results = rt.get(refs, timeout=120.0)
+        bad = [i for i, v in enumerate(results) if v != i * i]
+        trial = analysis.trials[0]
+        rep.counts["tasks_submitted"] = 16
+        rep.counts["tasks_correct"] = 16 - len(bad)
+        rep.counts["trial_iterations"] = trial.iteration
+        rep.counts["trial_failures"] = trial.failures
+        resumed = trial.status == tt.TERMINATED and trial.iteration >= 8
+        rep.ok = not bad and resumed
+        if bad:
+            rep.notes.append(f"wrong results for tasks {bad}")
+        if not resumed:
+            rep.notes.append(f"trial ended {trial.status} at iteration "
+                             f"{trial.iteration}")
+    finally:
+        rt.shutdown()
+
+
+SCENARIOS: Dict[str, Callable[[ChaosController, SurvivalReport], None]] = {
+    "worker-carnage": _scenario_runtime,
+    "serve-flap": _scenario_serve,
+    "trial-crash": _scenario_tune,
+    "split-survival": _scenario_split,
+}
+
+
+def run_plan(plan: FaultPlan, scenario: str = "") -> SurvivalReport:
+    """Run ``plan`` against its scenario (by plan name unless
+    ``scenario`` overrides) and return the survival report."""
+    name = scenario or plan.name
+    if name not in SCENARIOS:
+        raise ValueError(f"no chaos scenario {name!r}; choose from "
+                         f"{sorted(SCENARIOS)}")
+    rep = SurvivalReport(plan=plan.name or name, seed=plan.seed, ok=False)
+    t0 = time.monotonic()
+    with ChaosController(plan) as chaos:
+        try:
+            SCENARIOS[name](chaos, rep)
+        finally:
+            rep.injections = chaos.injections()
+            rep.elapsed_s = time.monotonic() - t0
+    return rep

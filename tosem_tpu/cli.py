@@ -866,9 +866,16 @@ RUNNERS = {
 
 
 def main(argv: List[str] = None) -> int:
+    args = sys.argv[1:] if argv is None else list(argv)
+    if args and args[0] == "chaos":
+        # subcommand dispatch: `python -m tosem_tpu.cli chaos --plan …`
+        # runs a fault plan against the in-process runtime and prints a
+        # survival report (see tosem_tpu/chaos/)
+        from tosem_tpu.chaos.cli import main as chaos_main
+        return chaos_main(args[1:])
     fs = make_flags()
     fs.apply_env()
-    leftover = fs.parse_args(sys.argv[1:] if argv is None else list(argv))
+    leftover = fs.parse_args(args)
     if leftover:
         print(f"unexpected positional args: {leftover}", file=sys.stderr)
         print(fs.usage(), file=sys.stderr)

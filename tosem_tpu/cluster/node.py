@@ -21,7 +21,14 @@ import time
 from concurrent.futures import ProcessPoolExecutor
 from typing import Any, Callable, Dict, List, Optional
 
-from tosem_tpu.cluster.rpc import RpcClient, RpcServer
+from tosem_tpu.cluster.rpc import RpcClient, RpcError, RpcServer
+
+
+class NodeDrainingError(RuntimeError):
+    """The node agent is draining (unhealthy or told to drain): it
+    rejects new tasks/trials immediately instead of hanging callers.
+    In-flight work is allowed to finish — graceful degradation, the
+    raylet's drain-before-termination contract."""
 
 
 def _run_blob(blob: bytes) -> bytes:
@@ -56,10 +63,37 @@ class _AgentHandlers:
         self._trials: Dict[str, Dict[str, Any]] = {}
         self._trials_lock = threading.Lock()
         self._trial_dir = tempfile.mkdtemp(prefix="agent_trials_")
+        # drain state: an unhealthy node stops taking new work but lets
+        # in-flight work finish, so callers fail fast instead of hanging
+        self._draining = False
+        self._health_calls = 0
+        # chaos seam (the agent is its own process, so faults ride env
+        # vars): become unhealthy after N health() calls / answer
+        # health() slowly — the two cluster-layer fault shapes
+        self._chaos_unhealthy_after = int(
+            os.environ.get("TOSEM_CHAOS_NODE_UNHEALTHY_AFTER", "0") or "0")
+        self._chaos_slow_health_s = float(
+            os.environ.get("TOSEM_CHAOS_SLOW_HEALTH_S", "0") or "0")
 
     def health(self) -> Dict[str, Any]:
-        return {"ok": True, "pid": os.getpid(),
+        with self._adm:
+            self._health_calls += 1
+            if (self._chaos_unhealthy_after
+                    and self._health_calls > self._chaos_unhealthy_after):
+                self._draining = True
+        if self._chaos_slow_health_s:
+            time.sleep(self._chaos_slow_health_s)
+        return {"ok": not self._draining, "draining": self._draining,
+                "pid": os.getpid(),
                 "uptime_s": time.time() - self._started}
+
+    def drain(self) -> bool:
+        """Stop admitting new work (idempotent). Health flips to
+        ``ok=False`` so pool managers route around this node."""
+        with self._adm:
+            self._draining = True
+            self._adm.notify_all()
+        return True
 
     def stats(self) -> Dict[str, Any]:
         with self._adm:
@@ -99,6 +133,11 @@ class _AgentHandlers:
     def _admit(self, pg: Optional[str]) -> None:
         with self._adm:
             while True:
+                if self._draining:
+                    # fail fast, never hang: a draining node's callers
+                    # get a typed rejection they can route around
+                    raise NodeDrainingError(
+                        "node agent is draining; rejecting new work")
                 if pg is None:
                     free = self._num_workers - sum(self._reserved.values())
                     if self._active_general < free:
@@ -155,17 +194,26 @@ class _AgentHandlers:
 
     def start_trial(self, task_id: str, trainable_ref: str,
                     config_json: str, max_iterations: int,
-                    pg: Optional[str] = None) -> None:
+                    pg: Optional[str] = None,
+                    checkpoint_freq: int = 5) -> None:
         """Launch a trial as a dedicated killable subprocess. Returns
         immediately; admission (the agent's slot gate) happens on a
         background thread, so a full node queues the trial rather than
         blocking the RPC."""
         import threading
         with self._trials_lock:
-            if task_id in self._trials:
+            prior = self._trials.get(task_id)
+            if prior is not None and prior["status"] not in ("FAILED",
+                                                             "CANCELED"):
                 raise ValueError(f"trial {task_id!r} already exists")
+            # resubmitting a FAILED/CANCELED id relaunches it against
+            # the same checkpoint file — crash-resume, not restart
+            # (class trainables pick up at their last checkpoint)
             t = {"status": "WAITING", "proc": None, "error": "",
                  "killed": False}
+            if prior is not None:
+                t["prog_off"] = prior.get("prog_off", 0)
+                t["prog_cache"] = prior.get("prog_cache", [])
             self._trials[task_id] = t
 
         out = os.path.join(self._trial_dir, f"{task_id}.json")
@@ -193,9 +241,12 @@ class _AgentHandlers:
                     env["PYTHONPATH"] = os.pathsep.join(
                         [p for p in sys.path if p])
                     errf = open(errp, "wb")
+                    ckpt = os.path.join(self._trial_dir, f"{task_id}.ckpt")
                     t["proc"] = subprocess.Popen(
                         worker_argv(trainable_ref, config_json,
-                                    max_iterations, out, progress),
+                                    max_iterations, out, progress,
+                                    checkpoint_path=ckpt,
+                                    checkpoint_freq=checkpoint_freq),
                         env=env, stdout=subprocess.DEVNULL, stderr=errf)
                     errf.close()
                     t["status"] = "RUNNING"
@@ -334,6 +385,22 @@ class RemoteNode:
     def stats(self) -> Dict[str, Any]:
         return self._client.call("stats")
 
+    def drain(self) -> bool:
+        """Tell the agent to stop admitting new work (idempotent)."""
+        return bool(self._client.call("drain"))
+
+    @staticmethod
+    def _translate(e: RpcError) -> BaseException:
+        """Re-type a remote drain rejection so callers can catch it
+        without string-matching RpcError themselves. The RPC layer
+        ships ``repr(exc)`` of the handler's exception, so a real
+        drain rejection is exactly ``NodeDrainingError(...)`` at the
+        START of the message — a substring match would misclassify an
+        application error that merely *mentions* the name."""
+        if str(e).startswith("NodeDrainingError("):
+            return NodeDrainingError(str(e))
+        return e
+
     def alive(self, timeout: float = 5.0) -> bool:
         # a bounded, independent probe connection: a long task holding
         # the main client's lock (or a wedged agent) must not make the
@@ -359,23 +426,31 @@ class RemoteNode:
     def submit(self, fn: Callable, *args, **kwargs) -> Any:
         pg = kwargs.pop("_pg", None)
         blob = pickle.dumps((fn, args, kwargs))
-        if pg is not None:
-            return pickle.loads(self._client.call("run_task", blob, pg))
-        return pickle.loads(self._client.call("run_task", blob))
+        try:
+            if pg is not None:
+                return pickle.loads(self._client.call("run_task", blob, pg))
+            return pickle.loads(self._client.call("run_task", blob))
+        except RpcError as e:
+            raise self._translate(e) from None
 
     def map(self, fn: Callable, items) -> List[Any]:
         blobs = [pickle.dumps((fn, (it,), {})) for it in items]
-        return [pickle.loads(b)
-                for b in self._client.call("run_batch", blobs)]
+        try:
+            return [pickle.loads(b)
+                    for b in self._client.call("run_batch", blobs)]
+        except RpcError as e:
+            raise self._translate(e) from None
 
     # -- trial plane ---------------------------------------------------
 
     def start_trial(self, task_id: str, trainable_ref: str,
                     config: Dict[str, Any], max_iterations: int,
-                    pg: Optional[str] = None) -> None:
+                    pg: Optional[str] = None,
+                    checkpoint_freq: int = 5) -> None:
         import json
         self._client.call("start_trial", task_id, trainable_ref,
-                          json.dumps(config), max_iterations, pg)
+                          json.dumps(config), max_iterations, pg,
+                          checkpoint_freq)
 
     def trial_status(self, task_id: str,
                      since: int = 0) -> Dict[str, Any]:

@@ -50,7 +50,10 @@ from tosem_tpu.ops.common import interpret_default as _interpret
 
 # every grid cell is independent in all three kernels (the K/V loop is a
 # fori_loop *inside* the cell), so Mosaic may overlap/reorder cells freely
-_PARALLEL = pltpu.CompilerParams(dimension_semantics=("parallel", "parallel"))
+# jax >= 0.6 renamed TPUCompilerParams → CompilerParams; accept either
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or \
+    pltpu.TPUCompilerParams
+_PARALLEL = _CompilerParams(dimension_semantics=("parallel", "parallel"))
 
 
 def _causal_mask(bq: int, bk: int, qi: int, kj: int):

@@ -34,6 +34,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from tosem_tpu.parallel.compat import shard_map
 from tosem_tpu.utils.results import ResultRow
 from tosem_tpu.utils.timing import DeviceLoopBench
 
@@ -45,7 +46,7 @@ from tosem_tpu.utils.timing import DeviceLoopBench
 def all_reduce(mesh: Mesh, axis: str) -> Callable[[jax.Array], jax.Array]:
     """x sharded on ``axis`` (leading dim = per-device buffers) → summed,
     replicated buffer. Semantics of ``ncclAllReduce``."""
-    @functools.partial(jax.shard_map, mesh=mesh,
+    @functools.partial(shard_map, mesh=mesh,
                        in_specs=P(axis), out_specs=P())
     def f(x):
         return lax.psum(x, axis)
@@ -55,7 +56,7 @@ def all_reduce(mesh: Mesh, axis: str) -> Callable[[jax.Array], jax.Array]:
 def all_gather_op(mesh: Mesh, axis: str) -> Callable[[jax.Array], jax.Array]:
     """shards on ``axis`` → full array replicated (``ncclAllGather``)."""
     # check_vma off: vma inference can't prove all_gather output replicated
-    @functools.partial(jax.shard_map, mesh=mesh,
+    @functools.partial(shard_map, mesh=mesh,
                        in_specs=P(axis), out_specs=P(), check_vma=False)
     def f(x):
         return lax.all_gather(x, axis, tiled=True)
@@ -65,7 +66,7 @@ def all_gather_op(mesh: Mesh, axis: str) -> Callable[[jax.Array], jax.Array]:
 def reduce_scatter_op(mesh: Mesh, axis: str) -> Callable[[jax.Array], jax.Array]:
     """replicated-sized input sharded on ``axis`` → per-device reduced shard
     (``ncclReduceScatter``)."""
-    @functools.partial(jax.shard_map, mesh=mesh,
+    @functools.partial(shard_map, mesh=mesh,
                        in_specs=P(axis), out_specs=P(axis))
     def f(x):
         return lax.psum_scatter(x, axis, tiled=True)
@@ -78,7 +79,7 @@ def ring_permute(mesh: Mesh, axis: str) -> Callable[[jax.Array], jax.Array]:
     n = mesh.shape[axis]
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    @functools.partial(jax.shard_map, mesh=mesh,
+    @functools.partial(shard_map, mesh=mesh,
                        in_specs=P(axis), out_specs=P(axis))
     def f(x):
         return lax.ppermute(x, axis, perm)
@@ -88,7 +89,7 @@ def ring_permute(mesh: Mesh, axis: str) -> Callable[[jax.Array], jax.Array]:
 def all_to_all_op(mesh: Mesh, axis: str) -> Callable[[jax.Array], jax.Array]:
     """Transpose shard dimension across devices (``ncclAllToAll`` /
     the Ulysses sequence-parallel primitive)."""
-    @functools.partial(jax.shard_map, mesh=mesh,
+    @functools.partial(shard_map, mesh=mesh,
                        in_specs=P(axis), out_specs=P(axis))
     def f(x):
         # block rows split into n chunks; chunk j → device j; received

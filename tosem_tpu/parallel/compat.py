@@ -1,0 +1,40 @@
+"""JAX version compatibility for the parallel layer.
+
+The codebase targets the current ``jax.shard_map`` surface (top-level
+export, ``check_vma=`` kwarg). Older releases (0.4.x/0.5.x, including
+the CI image's 0.4.37) ship it as ``jax.experimental.shard_map`` with
+the kwarg named ``check_rep`` — one seam here instead of per-module
+try/except blocks.
+"""
+from __future__ import annotations
+
+import inspect
+
+try:                                    # jax >= 0.6: top-level export
+    from jax import shard_map as _shard_map
+except ImportError:                     # jax 0.4.x/0.5.x
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_HAS_CHECK_VMA = "check_vma" in inspect.signature(_shard_map).parameters
+
+try:                                    # jax >= 0.5: lax.axis_size
+    from jax.lax import axis_size
+except ImportError:
+    def axis_size(axis_name):
+        """Size of a mapped mesh axis. ``psum(1, axis)`` is folded to a
+        concrete int at trace time on old jax, so the result is usable
+        in Python control flow exactly like the real ``axis_size``."""
+        from jax import lax
+        return lax.psum(1, axis_name)
+
+
+def shard_map(f=None, **kwargs):
+    """``jax.shard_map`` accepting ``check_vma=`` on every jax version
+    (translated to the old ``check_rep=`` spelling when needed). Usable
+    directly or via ``functools.partial`` like the real one."""
+    if not _HAS_CHECK_VMA and "check_vma" in kwargs:
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    if f is None:
+        import functools
+        return functools.partial(shard_map, **kwargs)
+    return _shard_map(f, **kwargs)

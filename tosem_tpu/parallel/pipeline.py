@@ -31,8 +31,10 @@ from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
+
+from tosem_tpu.parallel.compat import axis_size, shard_map
 
 
 def stack_stage_params(per_stage_params) -> Any:
@@ -51,7 +53,7 @@ def _pipeline_body(stage_fn: Callable, n_micro: int, axis: str,
             f"built with n_micro={n_micro} — a mismatch would silently "
             "drop or duplicate microbatches")
     stage = lax.axis_index(axis)
-    n_stages = lax.axis_size(axis)
+    n_stages = axis_size(axis)
     local = jax.tree_util.tree_map(lambda p: p[0], params)
     M = n_micro
     mb_shape = x.shape[1:]

@@ -29,7 +29,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+
+from tosem_tpu.parallel.compat import axis_size, shard_map
 
 _NEG_INF = -1e30
 
@@ -57,7 +58,7 @@ def ring_attention(q, k, v, *, axis: str, causal: bool = False,
     Call inside ``shard_map``/``pjit`` context where ``axis`` is a mesh
     axis and q/k/v are the *local* sequence shards [B, Tl, H, D].
     """
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     my = lax.axis_index(axis)
     B, Tl, H, D = q.shape
     scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(D)
@@ -121,7 +122,7 @@ def ulysses_attention(q, k, v, *, axis: str, causal: bool = False,
     Local shards [B, Tl, H, D] → all_to_all → [B, T, H/n, D] full-sequence
     per head group → full attention → all_to_all back. Requires H % n == 0.
     """
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     B, Tl, H, D = q.shape
     if H % n:
         raise ValueError(f"heads {H} must divide by axis size {n}")

@@ -73,16 +73,120 @@ def latest_xplane(log_dir: str) -> str:
 
 
 def parse_xplane(path_or_dir: str) -> Iterator[Tuple[str, str, str, float]]:
-    """Yield (plane, line, event_name, duration_us) for every trace event."""
-    from jax.profiler import ProfileData
+    """Yield (plane, line, event_name, duration_us) for every trace event.
+
+    Uses ``jax.profiler.ProfileData`` when this jax provides it; older
+    releases (< 0.5) fall back to :func:`_parse_xplane_wire`, a
+    dependency-free protobuf wire-format reader of the same ``XSpace``
+    message — identical tuples either way."""
     path = (latest_xplane(path_or_dir) if os.path.isdir(path_or_dir)
             else path_or_dir)
+    try:
+        from jax.profiler import ProfileData
+    except ImportError:
+        with open(path, "rb") as f:
+            yield from _parse_xplane_wire(f.read())
+        return
     pd = ProfileData.from_file(path)
     for plane in pd.planes:
         for line in plane.lines:
             for ev in line.events:
                 dur_ns = ev.duration_ns or 0.0
                 yield plane.name, line.name, ev.name, dur_ns / 1e3
+
+
+# --- raw-proto fallback ----------------------------------------------------
+# XSpace schema (tensorflow/core/profiler/protobuf/xplane.proto), fields we
+# read: XSpace.planes=1; XPlane.name=2 .lines=3 .event_metadata=4 (map:
+# key=1, value=2); XLine.name=2 .events=4 .display_name=11;
+# XEvent.metadata_id=1 .duration_ps=3; XEventMetadata.id=1 .name=2.
+
+def _varint(buf: bytes, i: int) -> Tuple[int, int]:
+    """Decode one varint at offset ``i`` → (value, next_offset)."""
+    val = 0
+    shift = 0
+    try:
+        while True:
+            b = buf[i]; i += 1
+            val |= (b & 0x7F) << shift
+            if not b & 0x80:
+                return val, i
+            shift += 7
+    except IndexError:
+        raise ValueError("truncated xplane proto (varint runs off the "
+                         "end of the buffer)") from None
+
+
+def _wire_fields(buf: bytes) -> Iterator[Tuple[int, int, object]]:
+    """Minimal protobuf wire decoder: yields (field_number, wire_type,
+    value) with varints decoded and length-delimited fields as bytes."""
+    i, n = 0, len(buf)
+    while i < n:
+        tag, i = _varint(buf, i)
+        fnum, wt = tag >> 3, tag & 7
+        if wt == 0:                       # varint
+            val, i = _varint(buf, i)
+        elif wt == 1:                     # 64-bit
+            val = buf[i:i + 8]; i += 8
+        elif wt == 2:                     # length-delimited
+            ln, i = _varint(buf, i)
+            val = buf[i:i + ln]; i += ln
+        elif wt == 5:                     # 32-bit
+            val = buf[i:i + 4]; i += 4
+        else:
+            raise ValueError(f"unsupported protobuf wire type {wt}")
+        if i > n:
+            # a declared length running past the buffer must fail loud,
+            # not yield a silently-truncated slice as valid data
+            raise ValueError("truncated xplane proto (field overruns "
+                             "the buffer)")
+        yield fnum, wt, val
+
+
+def _parse_xplane_wire(space: bytes) -> Iterator[Tuple[str, str, str, float]]:
+    for fnum, wt, plane_buf in _wire_fields(space):
+        if fnum != 1 or wt != 2:
+            continue
+        plane_name = ""
+        lines: List[bytes] = []
+        ev_names: Dict[int, str] = {}
+        for pf, pw, pv in _wire_fields(plane_buf):
+            if pf == 2 and pw == 2:
+                plane_name = pv.decode("utf-8", "replace")
+            elif pf == 3 and pw == 2:
+                lines.append(pv)
+            elif pf == 4 and pw == 2:     # event_metadata map entry
+                key, meta_name = 0, ""
+                for mf, mw, mv in _wire_fields(pv):
+                    if mf == 1 and mw == 0:
+                        key = mv
+                    elif mf == 2 and mw == 2:
+                        for ef, ew, ev_ in _wire_fields(mv):
+                            if ef == 1 and ew == 0:
+                                key = ev_
+                            elif ef == 2 and ew == 2:
+                                meta_name = ev_.decode("utf-8", "replace")
+                ev_names[key] = meta_name
+        for line_buf in lines:
+            line_name = ""
+            events: List[bytes] = []
+            for lf, lw, lv in _wire_fields(line_buf):
+                if lf == 2 and lw == 2 and not line_name:
+                    line_name = lv.decode("utf-8", "replace")
+                elif lf == 11 and lw == 2 and lv:
+                    line_name = lv.decode("utf-8", "replace")
+                elif lf == 4 and lw == 2:
+                    events.append(lv)
+            for ev_buf in events:
+                meta_id, dur_ps = 0, 0
+                for ef, ew, ev_ in _wire_fields(ev_buf):
+                    if ef == 1 and ew == 0:
+                        meta_id = ev_
+                    elif ef == 3 and ew == 0:
+                        dur_ps = ev_
+                yield (plane_name, line_name,
+                       ev_names.get(meta_id, f"event:{meta_id}"),
+                       dur_ps / 1e6)
 
 
 def kernel_summary(path_or_dir: str, *, device_only: bool = True,

@@ -6,13 +6,13 @@ native C++ shared-memory object store for large payloads. The raylet/GCS/
 Redis daemons collapse into the driver (JAX is single-controller already);
 what remains native is the data plane (:mod:`tosem_tpu.native` objstore).
 """
-from tosem_tpu.runtime.api import (ActorDiedError, ObjectRef,
-                                   PlacementGroup, PlacementTimeout,
-                                   TaskCancelledError, TaskError,
-                                   WorkerCrashedError, add_worker, cancel,
-                                   get, init, is_initialized, kill,
-                                   placement_group, put, remote,
-                                   remove_idle_worker,
+from tosem_tpu.runtime.api import (ActorDiedError, DeadlineExceeded,
+                                   ObjectRef, PlacementGroup,
+                                   PlacementTimeout, TaskCancelledError,
+                                   TaskError, WorkerCrashedError,
+                                   add_worker, cancel, get, init,
+                                   is_initialized, kill, placement_group,
+                                   put, remote, remove_idle_worker,
                                    remove_placement_group, shutdown,
                                    stats, wait)
 from tosem_tpu.runtime.object_store import ObjectID, ObjectStore
@@ -23,4 +23,5 @@ __all__ = [
     "placement_group", "remove_placement_group", "PlacementGroup",
     "PlacementTimeout", "ObjectRef", "ObjectID", "ObjectStore", "TaskError",
     "WorkerCrashedError", "ActorDiedError", "TaskCancelledError",
+    "DeadlineExceeded",
 ]

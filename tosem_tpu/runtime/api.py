@@ -31,16 +31,18 @@ import threading
 from typing import Any, List, Optional, Sequence, Tuple, Union
 
 from tosem_tpu.runtime import common
-from tosem_tpu.runtime.common import (ActorDiedError, ObjectRef,
-                                      PlacementTimeout, TaskCancelledError,
-                                      TaskError, WorkerCrashedError)
+from tosem_tpu.runtime.common import (ActorDiedError, DeadlineExceeded,
+                                      ObjectRef, PlacementTimeout,
+                                      TaskCancelledError, TaskError,
+                                      WorkerCrashedError)
 from tosem_tpu.runtime.runtime import Runtime
 
 __all__ = [
     "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
     "kill", "cancel", "ObjectRef", "TaskError", "WorkerCrashedError",
-    "ActorDiedError", "TaskCancelledError", "PlacementGroup",
-    "PlacementTimeout", "placement_group", "remove_placement_group",
+    "ActorDiedError", "TaskCancelledError", "DeadlineExceeded",
+    "PlacementGroup", "PlacementTimeout", "placement_group",
+    "remove_placement_group",
 ]
 
 _runtime: Optional[Runtime] = None
@@ -124,10 +126,12 @@ def remove_placement_group(pg: PlacementGroup) -> None:
 
 class RemoteFunction:
     def __init__(self, fn, max_retries: Optional[int] = None,
-                 placement_group: Optional[PlacementGroup] = None):
+                 placement_group: Optional[PlacementGroup] = None,
+                 deadline_s: Optional[float] = None):
         self._fn = fn
         self._max_retries = max_retries
         self._pg = placement_group
+        self._deadline_s = deadline_s
         self._fn_id = None
         self.__name__ = getattr(fn, "__name__", "remote_fn")
 
@@ -137,13 +141,15 @@ class RemoteFunction:
             self._fn_id = rt.register_fn(common.dumps(self._fn))
         return rt.submit_task(
             self._fn_id, args, kwargs, max_retries=self._max_retries,
-            pg=self._pg._pg_id if self._pg is not None else None)
+            pg=self._pg._pg_id if self._pg is not None else None,
+            deadline_s=self._deadline_s)
 
     def options(self, max_retries: Optional[int] = None,
-                placement_group: Optional[PlacementGroup] = None
-                ) -> "RemoteFunction":
+                placement_group: Optional[PlacementGroup] = None,
+                deadline_s: Optional[float] = None) -> "RemoteFunction":
         rf = RemoteFunction(self._fn, max_retries=max_retries,
-                            placement_group=placement_group)
+                            placement_group=placement_group,
+                            deadline_s=deadline_s)
         rf._fn_id = self._fn_id
         return rf
 
@@ -153,34 +159,46 @@ class RemoteFunction:
 
 
 class ActorMethod:
-    def __init__(self, actor_id: bytes, name: str):
+    def __init__(self, actor_id: bytes, name: str,
+                 deadline_s: Optional[float] = None):
         self._actor_id = actor_id
         self._name = name
+        self._deadline_s = deadline_s
+
+    def options(self, deadline_s: Optional[float] = None) -> "ActorMethod":
+        """Per-call deadline: ``actor.m.options(deadline_s=1.0).remote()``
+        resolves to :class:`DeadlineExceeded` if not finished in time."""
+        return ActorMethod(self._actor_id, self._name, deadline_s=deadline_s)
 
     def remote(self, *args, **kwargs) -> ObjectRef:
         return _rt().submit_actor_call(self._actor_id, self._name, args,
-                                       kwargs)
+                                       kwargs, deadline_s=self._deadline_s)
 
 
 class ActorHandle:
-    def __init__(self, actor_id: bytes, method_names: Sequence[str]):
+    def __init__(self, actor_id: bytes, method_names: Sequence[str],
+                 deadline_s: Optional[float] = None):
         self._actor_id = actor_id
         self._method_names = set(method_names)
+        self._deadline_s = deadline_s    # default for every method call
 
     def __getattr__(self, name: str) -> ActorMethod:
         if name.startswith("_"):
             raise AttributeError(name)
         if name not in self._method_names:
             raise AttributeError(f"actor has no public method {name!r}")
-        return ActorMethod(self._actor_id, name)
+        return ActorMethod(self._actor_id, name,
+                           deadline_s=self._deadline_s)
 
 
 class ActorClass:
     def __init__(self, cls, max_restarts: int = 0,
-                 placement_group: Optional[PlacementGroup] = None):
+                 placement_group: Optional[PlacementGroup] = None,
+                 deadline_s: Optional[float] = None):
         self._cls = cls
         self._max_restarts = max_restarts
         self._pg = placement_group
+        self._deadline_s = deadline_s
         self.__name__ = getattr(cls, "__name__", "Actor")
 
     def remote(self, *args, **kwargs) -> ActorHandle:
@@ -191,15 +209,18 @@ class ActorClass:
             pg=self._pg._pg_id if self._pg is not None else None)
         methods = [n for n, _ in inspect.getmembers(
             self._cls, predicate=callable) if not n.startswith("_")]
-        return ActorHandle(actor_id, methods)
+        return ActorHandle(actor_id, methods,
+                           deadline_s=self._deadline_s)
 
     def options(self, max_restarts: Optional[int] = None,
-                placement_group: Optional[PlacementGroup] = None
-                ) -> "ActorClass":
+                placement_group: Optional[PlacementGroup] = None,
+                deadline_s: Optional[float] = None) -> "ActorClass":
         return ActorClass(self._cls,
                           self._max_restarts if max_restarts is None
                           else max_restarts,
-                          placement_group=placement_group)
+                          placement_group=placement_group,
+                          deadline_s=(self._deadline_s if deadline_s is None
+                                      else deadline_s))
 
     def __call__(self, *a, **k):
         raise TypeError(f"actor class {self.__name__!r} must be instantiated "
@@ -207,13 +228,18 @@ class ActorClass:
 
 
 def remote(*args, **options):
-    """Decorator: ``@remote`` or ``@remote(max_retries=…, max_restarts=…)``."""
+    """Decorator: ``@remote`` or ``@remote(max_retries=…, max_restarts=…,
+    deadline_s=…)``. ``deadline_s`` on an actor class becomes the
+    default deadline for every method call (override per call with
+    ``actor.m.options(deadline_s=…)``)."""
     def wrap(target):
         if inspect.isclass(target):
             return ActorClass(target,
-                              max_restarts=options.get("max_restarts", 0))
+                              max_restarts=options.get("max_restarts", 0),
+                              deadline_s=options.get("deadline_s"))
         return RemoteFunction(target,
-                              max_retries=options.get("max_retries"))
+                              max_retries=options.get("max_retries"),
+                              deadline_s=options.get("deadline_s"))
     if len(args) == 1 and callable(args[0]) and not options:
         return wrap(args[0])
     return wrap

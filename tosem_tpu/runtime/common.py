@@ -58,6 +58,15 @@ class TaskCancelledError(RuntimeError_):
     """The task was cancelled via ``rt.cancel`` (``ray.cancel`` semantics)."""
 
 
+class DeadlineExceeded(RuntimeError_):
+    """The task's per-task deadline elapsed before it produced a result.
+
+    Fail-fast semantics: the result ref resolves to this error as soon
+    as the scheduler notices the deadline (within one heartbeat tick);
+    the worker is NOT killed — a late completion is discarded, so a
+    deadline bounds the *caller's* wait, not the worker's CPU time."""
+
+
 class ObjectRef:
     """Future for a task result or put object (the ``ray.ObjectRef`` shape)."""
 
@@ -213,3 +222,6 @@ class TaskSpec:
     retries_left: int
     deps: set                   # unresolved ObjectRefs
     pg: Optional[bytes] = None  # placement group id (gang scheduling)
+    # absolute time.monotonic() deadline; None = unbounded. Checked by
+    # the scheduler sweep → DeadlineExceeded (fail-fast, worker survives)
+    deadline: Optional[float] = None

@@ -34,9 +34,10 @@ import time
 import weakref
 from typing import Any, Dict, List, Optional, Set, Tuple
 
+from tosem_tpu.chaos import hooks as _chaos
 from tosem_tpu.runtime import common
-from tosem_tpu.runtime.common import (ActorDiedError, ObjectRef,
-                                      PlacementTimeout, StoreRef,
+from tosem_tpu.runtime.common import (ActorDiedError, DeadlineExceeded,
+                                      ObjectRef, PlacementTimeout, StoreRef,
                                       TaskCancelledError, TaskError, TaskSpec,
                                       WorkerCrashedError)
 from tosem_tpu.obs import metrics as _metrics
@@ -165,6 +166,12 @@ class Runtime:
         self.specs: Dict[bytes, TaskSpec] = {}
         self.pending: List[TaskSpec] = []        # FIFO, deps may be unresolved
         self.fn_blobs: Dict[bytes, bytes] = {}
+        # task_ids carrying a deadline — keeps the per-tick expiry sweep
+        # O(deadlined tasks), i.e. free for workloads that use none
+        self.deadlined: Set[bytes] = set()
+        # chaos delay_result parking lot: (deliver_at, worker, done-msg)
+        # tuples matured by the scheduler tick
+        self._delayed_results: List[Tuple[float, _Worker, tuple]] = []
         # workers
         self.task_workers: List[_Worker] = []
         self.actors: Dict[bytes, _ActorRecord] = {}
@@ -218,16 +225,21 @@ class Runtime:
 
     def submit_task(self, fn_id: bytes, args: tuple, kwargs: dict,
                     max_retries: Optional[int] = None,
-                    pg: Optional[bytes] = None) -> ObjectRef:
+                    pg: Optional[bytes] = None,
+                    deadline_s: Optional[float] = None) -> ObjectRef:
         ref = self._new_ref()
         spec = TaskSpec(task_id=os.urandom(16), fn_id=fn_id, method=None,
                         actor_id=None, args=args, kwargs=kwargs,
                         result_ref=ref,
                         retries_left=(self.max_task_retries
                                       if max_retries is None else max_retries),
-                        deps=self._unresolved_deps(args, kwargs), pg=pg)
+                        deps=self._unresolved_deps(args, kwargs), pg=pg,
+                        deadline=(None if deadline_s is None
+                                  else time.monotonic() + deadline_s))
         M_TASKS_SUBMITTED.inc()
         with self.lock:
+            if spec.deadline is not None:
+                self.deadlined.add(spec.task_id)
             if pg is not None and pg not in self.placement_groups:
                 self.errors[ref.oid.binary] = ValueError(
                     "unknown or removed placement group")
@@ -378,18 +390,23 @@ class Runtime:
                 if w.reserved_by == spec_pg and not w.parked]
 
     def submit_actor_call(self, actor_id: bytes, method: str, args: tuple,
-                          kwargs: dict) -> ObjectRef:
+                          kwargs: dict,
+                          deadline_s: Optional[float] = None) -> ObjectRef:
         ref = self._new_ref()
         spec = TaskSpec(task_id=os.urandom(16), fn_id=None, method=method,
                         actor_id=actor_id, args=args, kwargs=kwargs,
                         result_ref=ref, retries_left=0,
-                        deps=self._unresolved_deps(args, kwargs))
+                        deps=self._unresolved_deps(args, kwargs),
+                        deadline=(None if deadline_s is None
+                                  else time.monotonic() + deadline_s))
         with self.lock:
             rec = self.actors.get(actor_id)
             if rec is None or rec.dead:
                 self.errors[ref.oid.binary] = ActorDiedError("actor is dead")
                 self.cv.notify_all()
                 return ref
+            if spec.deadline is not None:
+                self.deadlined.add(spec.task_id)
             self.specs[spec.task_id] = spec
             if not spec.deps:
                 # fast path: the actor's pipe IS its ordered queue
@@ -763,6 +780,13 @@ class Runtime:
             # head task starts now — an idle worker isn't "stalled"
             w.last_progress = time.monotonic()
         w.inflight.append(spec.task_id)
+        act = _chaos.fire("runtime.dispatch",
+                          target="actor" if spec.actor_id is not None
+                          else "task", worker=w.wid)
+        if act is not None and act["action"] == "kill_worker":
+            # chaos: the worker dies mid-task; the sentinel/heartbeat
+            # path replays its in-flight work (charging a retry)
+            w.kill()
 
     def _fail_task_locked(self, spec: TaskSpec, err: BaseException) -> None:
         self.errors[spec.result_ref.oid.binary] = err
@@ -781,6 +805,15 @@ class Runtime:
             self.inline[spec.result_ref.oid.binary] = payload
         elif kind == "store":
             self.in_store.add(spec.result_ref.oid.binary)
+            act = _chaos.fire("runtime.store")
+            if act is not None and act["action"] == "evict_object":
+                # chaos: memory-pressure eviction of a sealed result —
+                # a later get() fails fast with the typed
+                # WorkerCrashedError("lost from store") path
+                try:
+                    self.store.delete(ObjectID(spec.result_ref.oid.binary))
+                except Exception:
+                    pass
         M_TASKS_FINISHED.inc(labels=["ok"])
         self.cv.notify_all()
         if self.pending:
@@ -813,7 +846,57 @@ class Runtime:
                 for w in workers:
                     if not w.alive() and (w.inflight or w.actor_id):
                         self._handle_death_locked(w)
+                self._deliver_delayed_locked()
+                self._expire_deadlines_locked()
                 self._steal_from_stalled_locked()
+
+    def _deliver_delayed_locked(self) -> None:
+        """Deliver chaos-delayed result messages whose time has come."""
+        if not self._delayed_results:
+            return
+        now = time.monotonic()
+        mature = [e for e in self._delayed_results if e[0] <= now]
+        if not mature:
+            return
+        self._delayed_results = [e for e in self._delayed_results
+                                 if e[0] > now]
+        for _, w, (tid, rkind, payload) in mature:
+            w.last_progress = time.monotonic()
+            self._complete_locked(w, tid, rkind, payload)
+
+    def _expire_deadlines_locked(self) -> None:
+        """Fail every task past its deadline with DeadlineExceeded.
+
+        Fail-fast only: the executing worker is left alone (its late
+        completion is discarded because the spec is gone), so deadlines
+        bound caller latency without wasting a worker respawn."""
+        if not self.deadlined:
+            return
+        now = time.monotonic()
+        expired = []
+        for tid in list(self.deadlined):
+            spec = self.specs.get(tid)
+            if spec is None:                 # finished/failed since
+                self.deadlined.discard(tid)
+            elif now > spec.deadline:
+                self.deadlined.discard(tid)
+                expired.append(spec)
+        if not expired:
+            return
+        for spec in expired:
+            self.specs.pop(spec.task_id, None)
+            self.errors[spec.result_ref.oid.binary] = DeadlineExceeded(
+                "task exceeded its deadline before completing")
+            M_TASKS_FINISHED.inc(labels=["DeadlineExceeded"])
+            # NOTE: the task_id stays in its worker's inflight list — the
+            # worker really is still grinding it, and lying about that
+            # would route fresh tasks onto a busy/hung worker. The entry
+            # clears when the late done/err arrives (spec already gone →
+            # discarded), and a never-finishing task keeps the worker
+            # marked stalled so the steal path works around it.
+        self.pending = [s for s in self.pending if s.task_id in self.specs]
+        self.cv.notify_all()
+        self._dispatch_locked()
 
     def _steal_from_stalled_locked(self) -> None:
         """Reclaim unstarted tasks queued behind a long-running one.
@@ -849,6 +932,26 @@ class Runtime:
                     self._dispatch_locked()
                 elif kind == "done":
                     _, tid, rkind, payload = msg
+                    act = _chaos.fire("runtime.result",
+                                      target="actor" if w.actor_id
+                                      else "task", worker=w.wid)
+                    if act is not None and act["action"] == "drop_result":
+                        # chaos: the completion message is lost in
+                        # transit AND the worker dies — the death
+                        # handler replays the task (at-least-once,
+                        # like the reference's retry semantics)
+                        w.kill()
+                        return
+                    if act is not None and act["action"] == "delay_result":
+                        # chaos: the message is in-flight for delay_s —
+                        # parked for later delivery, NOT slept on (this
+                        # code runs under the runtime lock; sleeping here
+                        # would freeze the whole scheduler, which is a
+                        # different fault than "one result delayed")
+                        self._delayed_results.append(
+                            (time.monotonic() + act["delay_s"], w,
+                             (tid, rkind, payload)))
+                        continue
                     w.last_progress = time.monotonic()
                     self._complete_locked(w, tid, rkind, payload)
                 elif kind == "err":

@@ -6,6 +6,7 @@ streaming surface (``deepspeech.h:107-358``) as a real C ABI
 (``native/speech_api.cpp``) fed by JAX callbacks.
 """
 from tosem_tpu.serve.autoscale import ServeAutoscaler, ServeScaleConfig
+from tosem_tpu.serve.breaker import CircuitBreaker, CircuitOpen
 from tosem_tpu.serve.core import Deployment, Handle, Serve, ServeFuture
 from tosem_tpu.serve.http import HttpIngress
 from tosem_tpu.serve.speech import (CStreamingModel, SpeechStreamBackend,
@@ -13,6 +14,7 @@ from tosem_tpu.serve.speech import (CStreamingModel, SpeechStreamBackend,
 
 __all__ = [
     "Serve", "Deployment", "Handle", "ServeFuture", "HttpIngress",
+    "CircuitBreaker", "CircuitOpen",
     "CStreamingModel", "SpeechStreamBackend", "StreamingClient",
     "greedy_ctc_text",
 ]

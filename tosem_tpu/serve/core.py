@@ -23,39 +23,106 @@ from __future__ import annotations
 import itertools
 import threading
 import time
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
 
 import tosem_tpu.runtime as rt
+from tosem_tpu.chaos import hooks as _chaos
 from tosem_tpu.runtime.common import (ActorDiedError, TaskCancelledError,
-                                      WorkerCrashedError)
+                                      TaskError, WorkerCrashedError)
+from tosem_tpu.serve.breaker import CircuitBreaker, CircuitOpen
 
 RETRYABLE = (ActorDiedError, WorkerCrashedError)
 
 
 class ServeFuture:
-    """A routed request: retries on replica death, like the reference's
-    router re-submitting to another worker replica."""
+    """A routed request: retries on replica death with exponential
+    backoff, like the reference's router re-submitting to another worker
+    replica — but with a bounded retry budget so a dead deployment fails
+    in bounded time instead of spinning."""
 
     def __init__(self, deployment: "Deployment", request: Any,
                  max_retries: int, pin: Optional[int] = None):
         self._dep = deployment
         self._request = request
         self._retries_left = max_retries
+        self._attempt = 0
         self._pin = pin
-        self._ref = deployment._dispatch(request, pin=pin)
+        # breaker admission happens per attempt, per request, so probe
+        # ownership is this future's alone — a stale request finishing
+        # late can never free or fail another request's probe
+        self._probe = False
+        self._ref = self._dispatch_attempt()
+
+    def _dispatch_attempt(self):
+        """Admit through the breaker, then dispatch — releasing an
+        acquired probe slot if the dispatch itself fails (a deleted
+        deployment raising here must not wedge the breaker in
+        'probe in flight' forever)."""
+        breaker = self._dep.breaker
+        self._probe = breaker.allow() if breaker is not None else False
+        try:
+            return self._dep._dispatch(self._request, pin=self._pin)
+        except BaseException:
+            if breaker is not None and self._probe:
+                breaker.release_probe()
+                self._probe = False
+            raise
 
     def result(self, timeout: Optional[float] = None) -> Any:
         deadline = None if timeout is None else time.monotonic() + timeout
+        breaker = self._dep.breaker
         while True:
             remaining = (None if deadline is None
                          else max(deadline - time.monotonic(), 0.001))
             try:
-                return rt.get(self._ref, timeout=remaining)
+                value = rt.get(self._ref, timeout=remaining)
             except RETRYABLE:
+                if breaker is not None:
+                    breaker.record_failure(probe=self._probe)
+                    self._probe = False
                 if self._retries_left <= 0:
                     raise
+                # deterministic exponential backoff: replica restarts /
+                # re-deploys get breathing room before the re-dispatch —
+                # clipped to the caller's own deadline (never sleep past
+                # the time budget of a result(timeout=...) call)
+                delay = min(self._dep.backoff_base_s * (2 ** self._attempt),
+                            self._dep.backoff_cap_s)
+                if deadline is not None:
+                    budget = deadline - time.monotonic()
+                    if budget <= 0:
+                        raise          # out of time: surface the failure
+                    # at most half the remaining budget goes to backing
+                    # off — sleeping the WHOLE budget would guarantee
+                    # the retried attempt times out unwaited
+                    delay = min(delay, budget / 2)
                 self._retries_left -= 1
-                self._ref = self._dep._dispatch(self._request, pin=self._pin)
+                time.sleep(delay)
+                self._attempt += 1
+                self._ref = self._dispatch_attempt()  # may raise CircuitOpen
+            except TaskError:
+                # application error: counts against the breaker (the
+                # backend is failing requests) but is never retried —
+                # the caller sees its own exception
+                if breaker is not None:
+                    breaker.record_failure(probe=self._probe)
+                    self._probe = False
+                raise
+            except BaseException:
+                # anything without a clear verdict — the caller's wait
+                # timed out (the request may still land later),
+                # cancellation, a result that fails to unpickle,
+                # KeyboardInterrupt: free our probe slot rather than
+                # wedging the breaker in 'probe in flight' forever
+                if breaker is not None and self._probe:
+                    breaker.release_probe()
+                    self._probe = False
+                raise
+            else:
+                if breaker is not None:
+                    breaker.record_success(probe=self._probe)
+                    self._probe = False
+                return value
 
 
 class Deployment:
@@ -63,10 +130,16 @@ class Deployment:
 
     def __init__(self, name: str, backend_cls, num_replicas: int,
                  init_args: Tuple, init_kwargs: Dict,
-                 max_restarts: int, max_retries: int):
+                 max_restarts: int, max_retries: int,
+                 breaker: Optional[CircuitBreaker] = None,
+                 backoff_base_s: float = 0.05,
+                 backoff_cap_s: float = 2.0):
         self.name = name
         self.backend_cls = backend_cls
         self.max_retries = max_retries
+        self.breaker = breaker
+        self.backoff_base_s = backoff_base_s
+        self.backoff_cap_s = backoff_cap_s
         self._init_args = init_args
         self._init_kwargs = init_kwargs
         self._actor_cls = rt.remote(max_restarts=max_restarts)(backend_cls)
@@ -102,6 +175,8 @@ class Deployment:
             self.load()
 
     def _dispatch(self, request: Any, pin: Optional[int] = None):
+        # breaker admission is the caller's job (ServeFuture): it owns
+        # the per-request probe flag the breaker hands out
         self._prune_amortized()
         with self._lock:
             replicas = list(self._replicas)
@@ -126,6 +201,17 @@ class Deployment:
             else:
                 i = pin % len(replicas)
             replica = replicas[i]
+        act = _chaos.fire("serve.dispatch", target=self.name, replica=i)
+        if act is not None:
+            if act["action"] == "crash_replica":
+                # chaos: SIGKILL the replica's process just before the
+                # request lands — the call fails with ActorDiedError,
+                # the restart policy replays the replica's init, and
+                # the router's retry path re-dispatches
+                from tosem_tpu.chaos.injector import crash_actor_process
+                crash_actor_process(replica._actor_id)
+            elif act["action"] == "slow_replica":
+                time.sleep(act["delay_s"])
         ref = replica.call.remote(request)
         with self._lock:
             self._outstanding.append((ref, replica))
@@ -228,12 +314,27 @@ class Serve:
 
     def deploy(self, name: str, backend_cls, *, num_replicas: int = 1,
                init_args: Tuple = (), init_kwargs: Optional[Dict] = None,
-               max_restarts: int = 2, max_retries: int = 3) -> Deployment:
+               max_restarts: int = 2, max_retries: int = 3,
+               circuit_breaker: Union[bool, CircuitBreaker, None] = None,
+               backoff_base_s: float = 0.05,
+               backoff_cap_s: float = 2.0) -> Deployment:
+        """``circuit_breaker``: True for a default breaker (5 consecutive
+        failures open it for 5s), or a configured
+        :class:`~tosem_tpu.serve.breaker.CircuitBreaker`; None disables
+        (the pre-breaker behavior)."""
+        if circuit_breaker is True:
+            breaker: Optional[CircuitBreaker] = CircuitBreaker()
+        elif isinstance(circuit_breaker, CircuitBreaker):
+            breaker = circuit_breaker
+        else:
+            breaker = None
         with self._lock:
             if name in self._deployments:
                 raise ValueError(f"deployment {name!r} already exists")
             dep = Deployment(name, backend_cls, num_replicas, init_args,
-                             init_kwargs or {}, max_restarts, max_retries)
+                             init_kwargs or {}, max_restarts, max_retries,
+                             breaker=breaker, backoff_base_s=backoff_base_s,
+                             backoff_cap_s=backoff_cap_s)
             self._deployments[name] = dep
             return dep
 

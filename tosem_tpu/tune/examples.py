@@ -21,6 +21,38 @@ def always_crashes(config):
     yield  # pragma: no cover — makes this a generator function
 
 
+class counting(object):
+    """Class trainable whose state is its step counter (the minimal
+    ``save_state``/``load_state`` contract) — the crash-resume target:
+    a resumed run continues its iteration count, a restarted one
+    starts over, so the reported ``training_iteration`` sequence tells
+    the two apart. Duck-typed to the :class:`tosem_tpu.tune.tune
+    .Trainable` surface without importing the runtime stack (this
+    module must stay importable in bare trial-worker subprocesses)."""
+
+    def __init__(self, config):
+        self.config = dict(config)
+        self.n = 0
+        self.x = float(self.config.get("x", 1.0))
+
+    def step(self):
+        import os
+        self.n += 1
+        # pid makes resume observable from the metric history alone: a
+        # resumed trial's entries span two processes, a restarted one's
+        # only the latest (crash-resume tests key on this)
+        return {"loss": self.x / self.n, "n": self.n, "pid": os.getpid()}
+
+    def save_state(self):
+        return self.n
+
+    def load_state(self, state):
+        self.n = int(state)
+
+    def reset_config(self, config):
+        self.config = dict(config)
+
+
 def noisy_branin(config):
     """2-D Branin-like surface for searcher comparisons."""
     import math

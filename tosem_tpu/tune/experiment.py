@@ -245,6 +245,11 @@ class ExperimentManager:
                     search_alg=SEARCHERS[spec.get("search", "random")](
                         **search_kw),
                     max_concurrent=int(spec.get("max_concurrent", 4)),
+                    # crash-resume knobs: how often trials snapshot
+                    # (the resume point after an injected/real crash)
+                    # and how many crashes a trial survives
+                    checkpoint_freq=int(spec.get("checkpoint_freq", 5)),
+                    max_failures=int(spec.get("max_failures", 2)),
                     verbose=verbose)
 
                 # Trial.best_score is sign-internalized (higher is better);
@@ -319,8 +324,12 @@ class ExperimentManager:
                 "endpoints — construct it directly and call "
                 "run_with_service)")
         svc_cls = SERVICES[svc_name]
-        service = svc_cls(
-            max_concurrent=int(spec.get("max_concurrent", 4)))
+        svc_kw = {"max_concurrent": int(spec.get("max_concurrent", 4))}
+        if svc_name == "subprocess":
+            # the subprocess plane checkpoints trials for crash-resume;
+            # the in-process LocalService has no crash boundary
+            svc_kw["checkpoint_freq"] = int(spec.get("checkpoint_freq", 5))
+        service = svc_cls(**svc_kw)
         try:
             out = run_with_service(
                 spec["trainable"], space_from_json(spec["space"]),

@@ -47,7 +47,8 @@ def resolve_target(ref: str):
 
 def run_trial(trainable_ref: str, config: Dict[str, Any],
               max_iterations: int, *, metrics_cb=None,
-              should_stop=None) -> Dict[str, Any]:
+              should_stop=None, checkpoint_path: Optional[str] = None,
+              checkpoint_freq: int = 5) -> Dict[str, Any]:
     """Execute one trial; returns {metrics: [...]}. Shared by every
     service so placement never changes semantics.
 
@@ -55,8 +56,19 @@ def run_trial(trainable_ref: str, config: Dict[str, Any],
     ``nni.report_intermediate_result`` side channel) and
     ``should_stop()`` is checked between iterations — the cooperative
     cancellation point that lets a manager early-stop a RUNNING trial
-    (``cancelTrialJob`` on a live job, ``nnimanager.ts:633``)."""
+    (``cancelTrialJob`` on a live job, ``nnimanager.ts:633``).
+
+    ``checkpoint_path`` enables crash-resume for **class** trainables
+    (the ``save_state``/``load_state`` contract): every
+    ``checkpoint_freq`` iterations the (iteration, state, metrics)
+    triple is written atomically; a relaunched trial pointed at the
+    same path resumes from the last checkpoint instead of restarting,
+    with the pre-crash metric history restored into the final result
+    (restored entries are NOT re-streamed through ``metrics_cb`` — they
+    already went out before the crash). Generator trainables have no
+    state contract, so they always restart."""
     import inspect
+    import pickle as _pickle
 
     target = resolve_target(trainable_ref)
     metrics: List[Dict[str, Any]] = []
@@ -69,7 +81,16 @@ def run_trial(trainable_ref: str, config: Dict[str, Any],
 
     if inspect.isclass(target):
         t = target(config)
-        for i in range(max_iterations):
+        start = 0
+        if checkpoint_path and os.path.exists(checkpoint_path):
+            with open(checkpoint_path, "rb") as f:
+                start, state, prior = _pickle.load(f)
+            t.load_state(state)
+            # keep the pre-crash history: without it, a crash after the
+            # LAST checkpoint would resume into zero remaining
+            # iterations and report an empty (silently discarded) trial
+            metrics.extend(prior)
+        for i in range(start, max_iterations):
             if should_stop is not None and should_stop():
                 break
             try:
@@ -77,6 +98,12 @@ def run_trial(trainable_ref: str, config: Dict[str, Any],
             except StopIteration:
                 break
             record(m, i)
+            if (checkpoint_path and checkpoint_freq
+                    and (i + 1) % checkpoint_freq == 0):
+                tmp = checkpoint_path + ".tmp"
+                with open(tmp, "wb") as f:
+                    _pickle.dump((i + 1, t.save_state(), list(metrics)), f)
+                os.replace(tmp, checkpoint_path)   # atomic: never torn
     else:
         gen = target(config)
         if not inspect.isgenerator(gen):
@@ -187,8 +214,10 @@ class SubprocessService(TrainingService):
     or OOM in a trial cannot touch the manager)."""
 
     def __init__(self, max_concurrent: int = 4,
-                 workdir: Optional[str] = None):
+                 workdir: Optional[str] = None,
+                 checkpoint_freq: int = 5):
         self._max = max_concurrent
+        self._ckpt_freq = checkpoint_freq
         self._own_dir = workdir is None
         self._dir = workdir or tempfile.mkdtemp(prefix="tosem_trials_")
         self._jobs: Dict[str, TrialJob] = {}
@@ -227,7 +256,10 @@ class SubprocessService(TrainingService):
                     worker_argv(ref, json.dumps(config), iters,
                                 self._out_path(tid),
                                 os.path.join(self._dir,
-                                             f"{tid}.progress")),
+                                             f"{tid}.progress"),
+                                checkpoint_path=os.path.join(
+                                    self._dir, f"{tid}.ckpt"),
+                                checkpoint_freq=self._ckpt_freq),
                     env=env, stdout=subprocess.DEVNULL, stderr=errf)
                 errf.close()
                 self._procs[tid] = proc
@@ -311,7 +343,9 @@ class NodeAgentService(TrainingService):
     :class:`~tosem_tpu.cluster.gang.GangReservation`) to run inside a
     placement-group bundle."""
 
-    def __init__(self, nodes, max_concurrent: int = 4, reservation=None):
+    def __init__(self, nodes, max_concurrent: int = 4, reservation=None,
+                 checkpoint_freq: int = 5):
+        self._ckpt_freq = checkpoint_freq
         # keep a LIST by reference: an ElasticAgentPool hands over its
         # live ``nodes`` list so scaled-up agents join the round-robin
         # and torn-down agents leave it; other iterables are snapshotted
@@ -361,7 +395,8 @@ class NodeAgentService(TrainingService):
                     and node.address in self._resv.counts:
                 pg = self._resv.pg_id
             try:
-                node.start_trial(tid, ref, config, iters, pg=pg)
+                node.start_trial(tid, ref, config, iters, pg=pg,
+                                 checkpoint_freq=self._ckpt_freq)
             except Exception as e:
                 with self._lock:
                     job.error = repr(e)

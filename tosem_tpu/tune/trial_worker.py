@@ -17,12 +17,20 @@ import sys
 
 
 def worker_argv(target: str, config_json: str, max_iterations: int,
-                out_path: str, progress_path: str) -> list:
-    """Command line for one trial-worker process."""
-    return [sys.executable, "-m", "tosem_tpu.tune.trial_worker",
+                out_path: str, progress_path: str,
+                checkpoint_path: "str | None" = None,
+                checkpoint_freq: int = 5) -> list:
+    """Command line for one trial-worker process. When
+    ``checkpoint_path`` is given, a relaunch with the same path resumes
+    a class trainable from its last checkpoint (crash-resume)."""
+    argv = [sys.executable, "-m", "tosem_tpu.tune.trial_worker",
             "--target", target, "--config", config_json,
             "--max-iterations", str(max_iterations),
             "--out", out_path, "--progress", progress_path]
+    if checkpoint_path:
+        argv += ["--checkpoint", checkpoint_path,
+                 "--checkpoint-freq", str(checkpoint_freq)]
+    return argv
 
 
 def read_progress_incr(path: str, offset: int = 0) -> tuple:
@@ -66,18 +74,37 @@ def main(argv=None) -> int:
                     help="JSONL path streaming one metric line per "
                     "report (the intermediate-result side channel a "
                     "manager polls to early-stop a RUNNING trial)")
+    ap.add_argument("--checkpoint", default=None,
+                    help="checkpoint file for crash-resume: written "
+                    "atomically every --checkpoint-freq iterations; if "
+                    "it already exists the trial resumes from it")
+    ap.add_argument("--checkpoint-freq", type=int, default=5)
     args = ap.parse_args(argv)
 
     from tosem_tpu.tune.providers import run_trial
-    metrics_cb = None
-    if args.progress:
-        pf = open(args.progress, "a", buffering=1)
+    pf = open(args.progress, "a", buffering=1) if args.progress else None
 
-        def metrics_cb(m):
+    # chaos seam (cluster trial plane runs in its own process, so the
+    # fault rides an env var): hard-exit once at iteration N, exactly
+    # the way an OOM-killed / preempted trial dies. The marker file
+    # makes the crash one-shot so the resumed process survives the same
+    # iteration — deterministic for tests.
+    crash_at = int(os.environ.get("TOSEM_CHAOS_TRIAL_CRASH_AT", "0") or "0")
+    crash_marker = (args.checkpoint or args.out) + ".chaos-crashed"
+
+    def metrics_cb(m):
+        if pf is not None:
             pf.write(json.dumps(m) + "\n")
+        if (crash_at and m.get("training_iteration", 0) >= crash_at
+                and not os.path.exists(crash_marker)):
+            with open(crash_marker, "w"):
+                pass
+            os._exit(1)
 
     out = run_trial(args.target, json.loads(args.config),
-                    args.max_iterations, metrics_cb=metrics_cb)
+                    args.max_iterations, metrics_cb=metrics_cb,
+                    checkpoint_path=args.checkpoint,
+                    checkpoint_freq=args.checkpoint_freq)
     tmp = args.out + ".tmp"
     with open(tmp, "w") as f:
         json.dump(out, f)

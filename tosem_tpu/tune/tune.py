@@ -16,6 +16,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
 import tosem_tpu.runtime as rt
+from tosem_tpu.chaos import hooks as _chaos
 from tosem_tpu.tune.schedulers import (CONTINUE, STOP, FIFOScheduler,
                                        PBTScheduler, TrialScheduler)
 from tosem_tpu.tune.search import (GridSearch, GridValues, RandomSearch,
@@ -285,6 +286,15 @@ def run(trainable, config_space: Dict[str, Any], *, metric: str, mode: str,
                 continue
             t.iteration = result["training_iteration"]
             t.last_result = result
+            act = _chaos.fire("tune.step", target=t.trial_id,
+                              iteration=t.iteration)
+            if act is not None and act["action"] == "crash_trial":
+                # chaos: SIGKILL the trial's actor process between
+                # checkpoints; the next step errors with ActorDiedError
+                # and the recovery path below relaunches the trial from
+                # its last snapshot (resume, not restart)
+                from tosem_tpu.chaos.injector import crash_actor_process
+                crash_actor_process(t.handle._actor_id)
             score = sign * float(result[metric])
             t.best_score = max(t.best_score, score)
             if t.iteration <= t.reported_iter:
