@@ -21,11 +21,15 @@ echo "== byte-compile (syntax gate)"
 python -m compileall -q tosem_tpu tests examples bench.py __graft_entry__.py
 
 chaos_smoke() {
-  # fast chaos smoke: 3 canned fault plans, fixed seeds (<60s) — the
-  # runtime/serve/tune failure paths run on every PR, not just when a
-  # chaos test file is touched (see tosem_tpu/chaos/)
-  echo "== chaos smoke (3 canned fault plans, fixed seeds)"
-  for plan in worker-carnage serve-flap trial-crash; do
+  # fast chaos smoke: 5 canned fault plans, fixed seeds (<90s) — the
+  # runtime/serve/tune failure paths AND the recovery layer (lineage
+  # reconstruction of an evicted object, node-kill resubmission) run
+  # on every PR, not just when a chaos test file is touched
+  # (see tosem_tpu/chaos/); the recovery plans gate on zero surfaced
+  # errors — the workload must HEAL, not merely fail loudly
+  echo "== chaos smoke (5 canned fault plans, fixed seeds)"
+  for plan in worker-carnage serve-flap trial-crash \
+              evict-heal node-kill-heal; do
     JAX_PLATFORMS=cpu python -m tosem_tpu.cli chaos --plan "$plan"
   done
 }

@@ -1,0 +1,130 @@
+"""Object store lifecycle: two-phase writes (reserve/seal/abort),
+orphan reclamation after a creator crash, and the disk spill tier
+(spill → transparent restore-on-get)."""
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from tosem_tpu.runtime.object_store import (ID_LEN, ObjectID, ObjectStore,
+                                            ObjectStoreError)
+
+
+@pytest.fixture
+def store():
+    s = ObjectStore(f"/tosem_test_{os.getpid()}_{time.monotonic_ns() % 10**9}",
+                    capacity=4 << 20)
+    yield s
+    s.close()
+
+
+class TestSealAbortLifecycle:
+    def test_reserve_seal_readable(self, store):
+        oid = ObjectID.random()
+        view = store.reserve(oid, 5)
+        view[:] = b"hello"
+        assert store.is_sealed(oid) is False      # mid-write, unreadable
+        assert not store.contains(oid)
+        store.seal(oid)
+        assert store.is_sealed(oid) is True
+        assert store.get(oid) == b"hello"
+
+    def test_reserve_abort_slot_gone(self, store):
+        oid = ObjectID.random()
+        store.reserve(oid, 8)
+        store.abort(oid)
+        assert store.is_sealed(oid) is None       # absent
+        assert store.get(oid) is None
+        # the id is reusable after an abort
+        store.put(oid, b"take2")
+        assert store.get(oid) == b"take2"
+
+    def test_double_seal_and_seal_absent(self, store):
+        oid = ObjectID.random()
+        store.reserve(oid, 3)
+        store.seal(oid)
+        with pytest.raises(ObjectStoreError):
+            store.seal(oid)                       # already sealed
+        with pytest.raises(ObjectStoreError):
+            store.seal(ObjectID.random())         # never reserved
+
+    def test_oversized_put_leaves_no_slot(self, store):
+        oid = ObjectID.random()
+        with pytest.raises(ObjectStoreError):
+            store.put_parts(oid, [b"x" * (8 << 20)])   # > 4MB capacity
+        # the failed write must not leave a stuck mid-write slot
+        assert store.is_sealed(oid) is None
+
+
+class TestReclaimOrphan:
+    def test_reclaim_requires_dead_creator(self, store):
+        oid = ObjectID.random()
+        store.reserve(oid, 4)
+        # creator (this process) is alive: refuse to reclaim
+        assert store.reclaim_orphan(oid) is False
+        store.abort(oid)
+
+    def test_reclaim_not_midwrite(self, store):
+        oid = ObjectID.random()
+        store.put(oid, b"sealed")
+        assert store.reclaim_orphan(oid) is False   # sealed, not orphaned
+        assert store.reclaim_orphan(ObjectID.random()) is False  # absent
+
+    def test_reclaim_after_creator_death(self, store):
+        """A child process reserves a slot and dies mid-write; the
+        parent reclaims the orphaned slot and can rewrite the id."""
+        oid = ObjectID.random()
+        code = (
+            "import sys\n"
+            "sys.path.insert(0, %r)\n"
+            "from tosem_tpu.runtime.object_store import ObjectID, ObjectStore\n"
+            "s = ObjectStore(%r, create=False)\n"
+            "s.reserve(ObjectID(bytes.fromhex(%r)), 16)\n"
+            "import os; os._exit(9)\n"   # die WITHOUT abort/seal
+        ) % (os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+             store.name, oid.hex())
+        subprocess.run([sys.executable, "-c", code], check=False, timeout=60)
+        assert store.is_sealed(oid) is False        # orphaned mid-write
+        assert store.reclaim_orphan(oid) is True
+        store.put(oid, b"rewritten")
+        assert store.get(oid) == b"rewritten"
+
+
+class TestSpillTier:
+    def test_spill_and_transparent_restore(self, store):
+        oid = ObjectID.random()
+        store.put(oid, b"z" * 10_000)
+        assert store.spill(oid) is True
+        assert not store.contains_shm(oid)
+        assert store.has_spilled(oid)
+        assert store.contains(oid)                # spilled counts as present
+        # get transparently restores (and promotes back into shm)
+        assert store.get(oid) == b"z" * 10_000
+        assert store.contains_shm(oid)
+        assert not store.has_spilled(oid)         # promoted, file cleaned
+
+    def test_spill_absent_is_false(self, store):
+        assert store.spill(ObjectID.random()) is False
+
+    def test_spill_idempotent(self, store):
+        oid = ObjectID.random()
+        store.put(oid, b"q" * 100)
+        assert store.spill(oid)
+        assert store.spill(oid) is True           # already spilled = success
+
+    def test_delete_removes_spill_file_too(self, store):
+        oid = ObjectID.random()
+        store.put(oid, b"gone" * 50)
+        store.spill(oid)
+        store.delete(oid)
+        assert not store.has_spilled(oid)
+        assert store.get(oid) is None             # truly gone
+
+    def test_spilled_ids_listing(self, store):
+        oid = ObjectID.random()
+        store.put(oid, b"listme" * 10)
+        store.spill(oid)
+        assert oid.hex() in store.spilled_ids()
+        assert all(len(h) == 2 * ID_LEN for h in store.spilled_ids())

@@ -1,0 +1,186 @@
+"""Self-healing state plane: lineage-based object reconstruction,
+worker-side dependency recovery, actor snapshot+replay state restore,
+and the runtime spill tier. Deterministic: losses are injected by
+deleting objects/killing processes at known points, and the assertions
+are timing-invariant (results correct, state continuous)."""
+import time
+
+import pytest
+
+import tosem_tpu.runtime as rt
+from tosem_tpu.runtime.object_store import ObjectID
+
+
+@pytest.fixture(scope="module")
+def runtime():
+    r = rt.init(num_workers=2, memory_monitor=False)
+    yield r
+    rt.shutdown()
+
+
+def _payload(i, size=200_000):
+    return bytes([i % 251]) * size
+
+
+def _make(i, size=200_000):
+    return bytes([i % 251]) * size
+
+
+def _concat(b, extra):
+    return b + extra
+
+
+class TestLineageReconstruction:
+    def test_evict_then_get_reconstructs(self, runtime):
+        f = rt.remote(_make)
+        ref = f.remote(1)
+        assert rt.get(ref, timeout=60.0) == _payload(1)
+        # evict from under the ref (native LRU / memory pressure analog)
+        runtime.store.delete(ObjectID(ref.oid.binary))
+        assert rt.get(ref, timeout=60.0) == _payload(1)
+
+    def test_ancestor_chain_reconstructs(self, runtime):
+        f = rt.remote(_make)
+        g = rt.remote(_concat)
+        a = f.remote(2)
+        b = g.remote(a, b"tail")
+        assert rt.get(b, timeout=60.0) == _payload(2) + b"tail"
+        # lose BOTH the object and its ancestor: reconstruction must
+        # chase the lineage DAG, re-deriving the ancestor first
+        runtime.store.delete(ObjectID(a.oid.binary))
+        runtime.store.delete(ObjectID(b.oid.binary))
+        assert rt.get(b, timeout=60.0) == _payload(2) + b"tail"
+
+    def test_worker_reported_missing_dep_recovers(self, runtime):
+        """The dep vanishes between dispatch bookkeeping and worker
+        resolution: the worker ships DependencyLostError, the driver
+        rebuilds the dep from lineage and requeues the task — no
+        user-visible TaskError."""
+        f = rt.remote(_make)
+        g = rt.remote(_concat)
+        a = f.remote(3)
+        assert rt.get(a, timeout=60.0) == _payload(3)
+        runtime.store.delete(ObjectID(a.oid.binary))
+        # the driver still believes `a` is in the store, so this
+        # dispatches a StoreRef the worker cannot resolve
+        assert rt.get(g.remote(a, b"!"), timeout=60.0) == _payload(3) + b"!"
+
+    def test_put_object_loss_is_typed(self, runtime):
+        """Puts have no producing task: loss surfaces as ObjectLostError
+        (still a WorkerCrashedError subclass for older callers)."""
+        ref = rt.put(_payload(4))
+        runtime.store.delete(ObjectID(ref.oid.binary))
+        with pytest.raises(rt.ObjectLostError, match="no\\s+lineage"):
+            rt.get(ref, timeout=10.0)
+        assert issubclass(rt.ObjectLostError, rt.WorkerCrashedError)
+
+    def test_spill_is_not_loss(self, runtime):
+        """A spilled object restores transparently on get — eviction to
+        disk is a slow path, not data loss, and needs no re-execution."""
+        ref = rt.put(_payload(5))
+        assert runtime.store.spill(ObjectID(ref.oid.binary))
+        assert rt.get(ref, timeout=10.0) == _payload(5)
+
+    def test_spill_under_pressure_frees_shm(self, runtime):
+        refs = [rt.put(_payload(i, 150_000)) for i in range(3)]
+        spilled = runtime.spill_under_pressure(target_fraction=0.0)
+        assert spilled >= 1
+        for i, ref in enumerate(refs):
+            assert rt.get(ref, timeout=10.0) == _payload(i, 150_000)
+
+
+class TestReconstructionDisabled:
+    def test_typed_error_and_no_waiter_leak(self):
+        r = rt.runtime.Runtime(num_workers=1, memory_monitor=False,
+                               reconstruction=False)
+        try:
+            fn_id = r.register_fn(rt.runtime.common.dumps(_make))
+            ref = r.submit_task(fn_id, (6,), {})
+            assert r.get(ref, timeout=60.0) == _payload(6)
+            r.store.delete(ObjectID(ref.oid.binary))
+            # every get fails typed — the first failure must not park
+            # the ref in a permanently-"in flight" state
+            for _ in range(2):
+                with pytest.raises(rt.ObjectLostError,
+                                   match="reconstruction is disabled"):
+                    r.get(ref, timeout=10.0)
+        finally:
+            r.shutdown()
+
+
+class TestActorStateRestore:
+    def test_snapshot_and_replay_restore_counter(self, runtime):
+        @rt.remote(max_restarts=1, restore_state=True, snapshot_every=2)
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def inc(self):
+                self.n += 1
+                return self.n
+
+        c = Counter.remote()
+        for i in range(3):
+            assert rt.get(c.inc.remote(), timeout=30.0) == i + 1
+        from tosem_tpu.chaos.injector import crash_actor_process
+        assert crash_actor_process(c._actor_id)
+        # the restart restores snapshot(2) + replays the log: the next
+        # successful inc continues from >= 4 (a fresh __init__ would
+        # give 1). >= because a call racing the corpse may fail with
+        # ActorDiedError yet still be replayed (at-least-once).
+        deadline = time.monotonic() + 30.0
+        v = None
+        while time.monotonic() < deadline:
+            try:
+                v = rt.get(c.inc.remote(), timeout=10.0)
+                break
+            except rt.ActorDiedError:
+                time.sleep(0.1)
+        assert v is not None and v >= 4, f"state lost across restart: {v}"
+        # and the restored state keeps evolving consistently
+        assert rt.get(c.inc.remote(), timeout=10.0) == v + 1
+
+    def test_unpicklable_state_falls_back_to_replay(self, runtime):
+        @rt.remote(max_restarts=1, restore_state=True, snapshot_every=1)
+        class Unpicklable:
+            def __init__(self):
+                import threading
+                self.lock = threading.Lock()   # defeats the snapshot
+                self.n = 0
+
+            def inc(self):
+                self.n += 1
+                return self.n
+
+        u = Unpicklable.remote()
+        for i in range(3):
+            assert rt.get(u.inc.remote(), timeout=30.0) == i + 1
+        from tosem_tpu.chaos.injector import crash_actor_process
+        assert crash_actor_process(u._actor_id)
+        deadline = time.monotonic() + 30.0
+        v = None
+        while time.monotonic() < deadline:
+            try:
+                v = rt.get(u.inc.remote(), timeout=10.0)
+                break
+            except rt.ActorDiedError:
+                time.sleep(0.1)
+        # snapshots are impossible, but the full replay log still
+        # restores the count
+        assert v is not None and v >= 4, f"replay fallback lost state: {v}"
+
+
+class TestKillWorkerReconstructs:
+    def test_chaos_kill_mid_task_all_results_correct(self, runtime):
+        """A worker killed mid-task (chaos) must not lose any result:
+        in-flight tasks replay, store results stay derivable."""
+        from tosem_tpu.chaos import ChaosController, Fault, FaultPlan
+        plan = FaultPlan(seed=3, faults=[
+            Fault(site="runtime.dispatch", action="kill_worker", at=2,
+                  target="task")])
+        with ChaosController(plan) as chaos:
+            f = rt.remote(_make)
+            refs = [f.remote(i) for i in range(4)]
+            out = rt.get(refs, timeout=120.0)
+            assert out == [_payload(i) for i in range(4)]
+            assert chaos.injections("runtime.dispatch")

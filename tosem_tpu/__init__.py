@@ -23,11 +23,18 @@ __version__ = "0.1.0"
 # stays light — none of these pull jax or spawn anything until touched.
 _LAZY_EXPORTS = {
     "DeadlineExceeded": ("tosem_tpu.runtime.common", "DeadlineExceeded"),
+    "ObjectLostError": ("tosem_tpu.runtime.common", "ObjectLostError"),
     "CircuitOpen": ("tosem_tpu.serve.breaker", "CircuitOpen"),
     "CircuitBreaker": ("tosem_tpu.serve.breaker", "CircuitBreaker"),
     "FaultPlan": ("tosem_tpu.chaos.plan", "FaultPlan"),
     "Fault": ("tosem_tpu.chaos.plan", "Fault"),
     "ChaosController": ("tosem_tpu.chaos.injector", "ChaosController"),
+    "NodePool": ("tosem_tpu.cluster.supervisor", "NodePool"),
+    "FailureDetector": ("tosem_tpu.cluster.supervisor", "FailureDetector"),
+    "HeadJournal": ("tosem_tpu.cluster.supervisor", "HeadJournal"),
+    "TrainingPreempted": ("tosem_tpu.train.trainer", "TrainingPreempted"),
+    "CheckpointCorruptError": ("tosem_tpu.train.checkpoint",
+                               "CheckpointCorruptError"),
 }
 
 __all__ = sorted(_LAZY_EXPORTS)

@@ -18,6 +18,8 @@ runtime.store       large result sealed into the store      evict_object
 serve.dispatch      request routed to a replica             crash_replica,
                                                             slow_replica
 tune.step           trial step result processed             crash_trial
+cluster.submit      NodePool routes work to a node agent    kill_node
+train.step          trainer fit() finished one step         preempt
 ==================  =====================================  =============
 
 The cluster layer's node agent runs in a separate process, so its

@@ -18,15 +18,18 @@ import json
 from dataclasses import asdict, dataclass, field
 from typing import Any, Dict, List, Optional
 
-# NOTE: cluster-layer faults are NOT plan sites — node agents and trial
-# workers run in their own processes, so those faults ride env vars
-# (TOSEM_CHAOS_NODE_UNHEALTHY_AFTER, TOSEM_CHAOS_SLOW_HEALTH_S,
+# NOTE: agent-internal cluster faults are NOT plan sites — node agents
+# and trial workers run in their own processes, so those faults ride
+# env vars (TOSEM_CHAOS_NODE_UNHEALTHY_AFTER, TOSEM_CHAOS_SLOW_HEALTH_S,
 # TOSEM_CHAOS_TRIAL_CRASH_AT; see tosem_tpu/cluster/node.py and
-# tosem_tpu/tune/trial_worker.py). Listing a site here that nothing
-# fires would validate and then silently never inject.
+# tosem_tpu/tune/trial_worker.py). cluster.submit IS a plan site: the
+# NodePool router runs in the driver process (the kill lands on the
+# agent subprocess, but the decision point is in-process). Listing a
+# site here that nothing fires would validate and then silently never
+# inject.
 VALID_SITES = (
     "runtime.dispatch", "runtime.result", "runtime.store",
-    "serve.dispatch", "tune.step",
+    "serve.dispatch", "tune.step", "cluster.submit", "train.step",
 )
 
 VALID_ACTIONS = {
@@ -35,6 +38,8 @@ VALID_ACTIONS = {
     "runtime.store": ("evict_object",),
     "serve.dispatch": ("crash_replica", "slow_replica"),
     "tune.step": ("crash_trial",),
+    "cluster.submit": ("kill_node",),
+    "train.step": ("preempt",),
 }
 
 
@@ -143,6 +148,36 @@ def _canned() -> Dict[str, FaultPlan]:
                   target="task"),
             Fault(site="tune.step", action="crash_trial", at=5),
         ]),
+        # evict two sealed results out of the store — every later get()
+        # must transparently re-derive them through lineage
+        # reconstruction (zero user-visible errors, results correct)
+        "evict-heal": FaultPlan(seed=17, name="evict-heal", faults=[
+            Fault(site="runtime.store", action="evict_object", at=2,
+                  times=2),
+        ]),
+        # hard-kill a node agent the instant work is routed to it — the
+        # pool's failure detector + resubmit path must finish the whole
+        # workload on the survivors
+        "node-kill-heal": FaultPlan(seed=23, name="node-kill-heal", faults=[
+            Fault(site="cluster.submit", action="kill_node", at=3),
+        ]),
+        # preempt training between checkpoints — the rerun must resume
+        # from the latest atomic checkpoint and produce a bit-exact
+        # metric history (not re-diverge, not restart from step 0)
+        "train-preempt": FaultPlan(seed=29, name="train-preempt", faults=[
+            Fault(site="train.step", action="preempt", at=5),
+        ]),
+        # the self-healing acceptance plan: a live object evicted, a
+        # worker killed mid-task, AND a node agent killed — one run,
+        # zero user-visible errors (the survival report shows
+        # recoveries, not failures)
+        "state-plane-survival": FaultPlan(
+            seed=31, name="state-plane-survival", faults=[
+                Fault(site="runtime.store", action="evict_object", at=1),
+                Fault(site="runtime.dispatch", action="kill_worker", at=2,
+                      target="task"),
+                Fault(site="cluster.submit", action="kill_node", at=2),
+            ]),
     }
 
 

@@ -74,6 +74,16 @@ def _counting_trainable():
     return counting
 
 
+def _big_payload(i: int) -> bytes:
+    """Deterministic over-INLINE_THRESHOLD result: forces the store
+    path, so eviction faults have something to evict."""
+    return bytes([i % 251]) * 200_000
+
+
+def _pool_square(x: int) -> int:
+    return x * x
+
+
 # ------------------------------------------------------------- scenarios
 
 def _scenario_runtime(chaos: ChaosController,
@@ -179,11 +189,172 @@ def _scenario_split(chaos: ChaosController,
         rt.shutdown()
 
 
+def _scenario_evict_heal(chaos: ChaosController,
+                         rep: SurvivalReport) -> None:
+    """4 store-sized results with 2 evicted from under their refs; every
+    get() must transparently re-derive the lost objects from lineage —
+    recovery, not the old typed ObjectLostError. One worker per task:
+    with no queued tasks the steal path never duplicates an execution,
+    so the evicted objects can ONLY come back through reconstruction."""
+    import tosem_tpu.runtime as rt
+    runtime = rt.init(num_workers=4, memory_monitor=False)
+    try:
+        f = rt.remote(_big_payload)
+        refs = [f.remote(i) for i in range(4)]
+        results = rt.get(refs, timeout=120.0)
+        bad = [i for i, v in enumerate(results) if v != _big_payload(i)]
+        rep.counts["tasks_submitted"] = 4
+        rep.counts["tasks_correct"] = 4 - len(bad)
+        rep.counts["objects_evicted"] = len(
+            chaos.injections("runtime.store"))
+        rep.counts["objects_reconstructed"] = sum(
+            runtime._recon_attempts.values())
+        rep.ok = (not bad and rep.counts["objects_evicted"] > 0
+                  and rep.counts["objects_reconstructed"] > 0)
+        if bad:
+            rep.notes.append(f"wrong results for tasks {bad}")
+    finally:
+        rt.shutdown()
+
+
+def _scenario_node_kill(chaos: ChaosController,
+                        rep: SurvivalReport) -> None:
+    """8 tasks routed over a 2-agent pool; one agent is hard-killed the
+    moment work lands on it. The failure detector + resubmit path must
+    finish the whole workload on the survivor with zero errors."""
+    from tosem_tpu.cluster.node import RemoteNode
+    from tosem_tpu.cluster.supervisor import NodePool
+    pool = NodePool(miss_threshold=1, probe_timeout=3.0)
+    nodes = []
+    try:
+        for i in range(2):
+            n = RemoteNode.spawn_local(num_workers=1)
+            nodes.append(n)
+            pool.add_node(n, name=f"n{i}")
+        outs = [pool.submit(_pool_square, i) for i in range(8)]
+        bad = [i for i, v in enumerate(outs) if v != i * i]
+        rep.counts["tasks_submitted"] = 8
+        rep.counts["tasks_correct"] = 8 - len(bad)
+        rep.counts["nodes_killed"] = len(
+            chaos.injections("cluster.submit"))
+        rep.counts["nodes_surviving"] = len(pool.live_nodes())
+        rep.ok = (not bad and rep.counts["nodes_killed"] > 0
+                  and rep.counts["nodes_surviving"] >= 1)
+        if bad:
+            rep.notes.append(f"wrong results for tasks {bad}")
+    finally:
+        pool.close(close_nodes=True)
+
+
+def _scenario_train_preempt(chaos: ChaosController,
+                            rep: SurvivalReport) -> None:
+    """Training preempted between checkpoints; the resumed run must
+    replay to completion with a metric history BIT-EXACT against an
+    uninterrupted reference run (same seeds, same batches)."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from tosem_tpu.train.trainer import TrainingPreempted, fit
+
+    def step_fn_py(state, batch, rng):
+        x, y = batch
+        def loss(w):
+            return jnp.mean((x @ w - y) ** 2)
+        l, g = jax.value_and_grad(loss)(state["w"])
+        return ({"step": state["step"] + 1, "w": state["w"] - 0.1 * g},
+                {"loss": l})
+    step_fn = jax.jit(step_fn_py)
+
+    def batch_fn(step):
+        k = jax.random.fold_in(jax.random.PRNGKey(0), step)
+        x = jax.random.normal(k, (8, 3))
+        return x, x @ jnp.array([1.0, -2.0, 0.5])
+
+    def init():
+        return {"step": jnp.zeros((), jnp.int32), "w": jnp.zeros(3)}
+
+    rng = jax.random.PRNGKey(7)
+    ckpt_dir = tempfile.mkdtemp(prefix="chaos_train_ck_")
+    preempted_at = 0
+    try:
+        try:
+            fit(init(), step_fn, batch_fn, 10, rng=rng,
+                ckpt_dir=ckpt_dir, checkpoint_every=2)
+            rep.notes.append("chaos never preempted the run")
+        except TrainingPreempted:
+            preempted_at = len(chaos.injections("train.step"))
+        # resume (fresh init state, same ckpt dir) — then an
+        # uninterrupted reference run; both run after the plan's fault
+        # window is spent
+        _, resumed = fit(init(), step_fn, batch_fn, 10, rng=rng,
+                         ckpt_dir=ckpt_dir, checkpoint_every=2)
+        _, reference = fit(init(), step_fn, batch_fn, 10, rng=rng)
+    finally:
+        import shutil
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+    rep.counts["steps_total"] = len(resumed)
+    rep.counts["preempted"] = 1 if preempted_at else 0
+    rep.ok = (preempted_at > 0 and len(resumed) == 10
+              and resumed == reference)
+    if resumed != reference:
+        rep.notes.append("resumed metric history diverged from the "
+                         "uninterrupted reference run")
+
+
+def _scenario_state_plane(chaos: ChaosController,
+                          rep: SurvivalReport) -> None:
+    """The acceptance run for the self-healing state plane: one live
+    object evicted, one worker killed mid-task, one node agent killed —
+    every result still arrives correct, zero user-visible errors."""
+    import tosem_tpu.runtime as rt
+    from tosem_tpu.cluster.node import RemoteNode
+    from tosem_tpu.cluster.supervisor import NodePool
+    rt.init(num_workers=6, memory_monitor=False)
+    pool = NodePool(miss_threshold=1, probe_timeout=3.0)
+    nodes = []
+    try:
+        for i in range(2):
+            n = RemoteNode.spawn_local(num_workers=1)
+            nodes.append(n)
+            pool.add_node(n, name=f"n{i}")
+        f = rt.remote(_big_payload)
+        refs = [f.remote(i) for i in range(6)]
+        pool_outs = [pool.submit(_pool_square, i) for i in range(6)]
+        results = rt.get(refs, timeout=120.0)
+        bad = [i for i, v in enumerate(results) if v != _big_payload(i)]
+        bad_pool = [i for i, v in enumerate(pool_outs) if v != i * i]
+        rep.counts["runtime_tasks_correct"] = 6 - len(bad)
+        rep.counts["pool_tasks_correct"] = 6 - len(bad_pool)
+        rep.counts["objects_evicted"] = len(
+            chaos.injections("runtime.store"))
+        rep.counts["workers_killed"] = len(
+            chaos.injections("runtime.dispatch"))
+        rep.counts["nodes_killed"] = len(
+            chaos.injections("cluster.submit"))
+        rep.ok = (not bad and not bad_pool
+                  and rep.counts["objects_evicted"] > 0
+                  and rep.counts["workers_killed"] > 0
+                  and rep.counts["nodes_killed"] > 0)
+        if bad:
+            rep.notes.append(f"wrong runtime results: {bad}")
+        if bad_pool:
+            rep.notes.append(f"wrong pool results: {bad_pool}")
+    finally:
+        pool.close(close_nodes=True)
+        rt.shutdown()
+
+
 SCENARIOS: Dict[str, Callable[[ChaosController, SurvivalReport], None]] = {
     "worker-carnage": _scenario_runtime,
     "serve-flap": _scenario_serve,
     "trial-crash": _scenario_tune,
     "split-survival": _scenario_split,
+    "evict-heal": _scenario_evict_heal,
+    "node-kill-heal": _scenario_node_kill,
+    "train-preempt": _scenario_train_preempt,
+    "state-plane-survival": _scenario_state_plane,
 }
 
 

@@ -7,6 +7,8 @@ from tosem_tpu.cluster.bootstrap import (BootstrapService, ElasticAgentPool,
 from tosem_tpu.cluster.kv import KVStore
 from tosem_tpu.cluster.node import RemoteNode
 from tosem_tpu.cluster.param import ParameterPoller, ParameterServer
+from tosem_tpu.cluster.supervisor import (FailureDetector, HeadJournal,
+                                          NodeLostError, NodePool)
 from tosem_tpu.cluster.replay import Recorder, replay, replay_source
 from tosem_tpu.cluster.rpc import RpcClient, RpcError, RpcServer
 from tosem_tpu.cluster.stubgen import (describe, describe_remote,

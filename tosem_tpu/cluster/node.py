@@ -195,11 +195,15 @@ class _AgentHandlers:
     def start_trial(self, task_id: str, trainable_ref: str,
                     config_json: str, max_iterations: int,
                     pg: Optional[str] = None,
-                    checkpoint_freq: int = 5) -> None:
+                    checkpoint_freq: int = 5,
+                    checkpoint_dir: Optional[str] = None) -> None:
         """Launch a trial as a dedicated killable subprocess. Returns
         immediately; admission (the agent's slot gate) happens on a
         background thread, so a full node queues the trial rather than
-        blocking the RPC."""
+        blocking the RPC. ``checkpoint_dir`` overrides the agent-local
+        trial dir for the checkpoint file — point it at shared storage
+        and a trial resubmitted on ANOTHER node resumes from the same
+        checkpoint (cross-node crash-resume)."""
         import threading
         with self._trials_lock:
             prior = self._trials.get(task_id)
@@ -241,7 +245,13 @@ class _AgentHandlers:
                     env["PYTHONPATH"] = os.pathsep.join(
                         [p for p in sys.path if p])
                     errf = open(errp, "wb")
-                    ckpt = os.path.join(self._trial_dir, f"{task_id}.ckpt")
+                    if checkpoint_dir:
+                        os.makedirs(checkpoint_dir, exist_ok=True)
+                        ckpt = os.path.join(checkpoint_dir,
+                                            f"{task_id}.ckpt")
+                    else:
+                        ckpt = os.path.join(self._trial_dir,
+                                            f"{task_id}.ckpt")
                     t["proc"] = subprocess.Popen(
                         worker_argv(trainable_ref, config_json,
                                     max_iterations, out, progress,
@@ -446,11 +456,12 @@ class RemoteNode:
     def start_trial(self, task_id: str, trainable_ref: str,
                     config: Dict[str, Any], max_iterations: int,
                     pg: Optional[str] = None,
-                    checkpoint_freq: int = 5) -> None:
+                    checkpoint_freq: int = 5,
+                    checkpoint_dir: Optional[str] = None) -> None:
         import json
         self._client.call("start_trial", task_id, trainable_ref,
                           json.dumps(config), max_iterations, pg,
-                          checkpoint_freq)
+                          checkpoint_freq, checkpoint_dir)
 
     def trial_status(self, task_id: str,
                      since: int = 0) -> Dict[str, Any]:

@@ -1,0 +1,475 @@
+"""Head-node supervision: heartbeat failure detection, journaled state,
+and automatic resubmission of work stranded on dead nodes.
+
+The reference's GCS owns exactly this triple: node liveness via
+heartbeats (``gcs_node_manager.cc`` / ``gcs_heartbeat_manager.cc``),
+control-plane state persisted to Redis so the GCS can crash-restart
+(``gcs_table_storage.cc``), and lease/actor reconstruction onto
+surviving raylets. Here the head is the driver process:
+
+- :class:`FailureDetector` probes each registered
+  :class:`~tosem_tpu.cluster.node.RemoteNode` on a cadence and declares
+  it dead after ``miss_threshold`` consecutive failed probes.
+- :class:`HeadJournal` is an append-only JSONL journal of control
+  events (nodes added/removed, work submitted/finished) with a
+  ``reconcile`` replay, so a crashed head restarts, replays the
+  journal, re-probes the nodes it knew, and learns which work items
+  never finished.
+- :class:`NodePool` ties both together: ``submit``/``map`` route tasks
+  to live nodes and transparently retry on surviving nodes when a node
+  dies mid-call; trials started through the pool are resubmitted under
+  the SAME trial id to a surviving node, so a shared ``checkpoint_dir``
+  resumes them from their last checkpoint instead of restarting.
+
+Chaos seam: ``cluster.submit`` fires per routed task (action
+``kill_node`` hard-kills the chosen node first, simulating node loss at
+the worst moment); deterministic by event ordinal like every other
+site.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+from tosem_tpu.chaos import hooks as _chaos
+from tosem_tpu.cluster.node import NodeDrainingError, RemoteNode
+
+
+class NodeLostError(RuntimeError):
+    """Every candidate node failed (or none are alive) for this call."""
+
+
+# --------------------------------------------------------------- journal
+
+
+class HeadJournal:
+    """Append-only JSONL journal of head-node control state.
+
+    Each :meth:`record` is one fsync'd line, so the journal survives a
+    head crash mid-write (a torn final line is skipped on load — same
+    contract as the trial progress files).
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self._lock = threading.Lock()
+        self._f = open(path, "ab")
+
+    def record(self, event: str, **fields: Any) -> None:
+        line = json.dumps({"event": event, **fields},
+                          sort_keys=True).encode() + b"\n"
+        with self._lock:
+            self._f.write(line)
+            self._f.flush()
+            os.fsync(self._f.fileno())
+
+    def close(self) -> None:
+        with self._lock:
+            try:
+                self._f.close()
+            except OSError:
+                pass
+
+    @staticmethod
+    def load(path: str) -> List[Dict[str, Any]]:
+        events: List[Dict[str, Any]] = []
+        try:
+            with open(path, "rb") as f:
+                for line in f.read().split(b"\n"):
+                    if not line:
+                        continue
+                    try:
+                        events.append(json.loads(line))
+                    except json.JSONDecodeError:
+                        break       # torn tail from a mid-write crash
+        except OSError:
+            pass
+        return events
+
+    @staticmethod
+    def reconcile(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+        """Replay the journal into the head's last known state:
+        registered node addresses, work submitted-but-not-finished, and
+        trials started-but-not-finished (with their last known node)."""
+        nodes: Dict[str, str] = {}           # name -> address
+        work: Dict[str, Dict[str, Any]] = {}
+        trials: Dict[str, Dict[str, Any]] = {}
+        for e in events:
+            ev = e.get("event")
+            if ev == "node_added":
+                nodes[e["name"]] = e["address"]
+            elif ev == "node_removed":
+                nodes.pop(e["name"], None)
+            elif ev == "work_submitted":
+                work[e["work_id"]] = e
+            elif ev in ("work_done", "work_failed"):
+                work.pop(e["work_id"], None)
+            elif ev == "trial_started":
+                trials[e["trial_id"]] = e
+            elif ev in ("trial_done", "trial_failed", "trial_canceled"):
+                trials.pop(e["trial_id"], None)
+        return {"nodes": nodes, "outstanding_work": work,
+                "outstanding_trials": trials}
+
+
+# ------------------------------------------------------ failure detector
+
+
+class FailureDetector:
+    """Heartbeat-based liveness: a node missing ``miss_threshold``
+    consecutive probes is declared dead exactly once (``on_dead``
+    callback), after which it is no longer probed. Run the background
+    thread via :meth:`start`, or call :meth:`check_once` from a test
+    for a deterministic sweep."""
+
+    def __init__(self, interval_s: float = 0.5, miss_threshold: int = 3,
+                 probe_timeout: float = 2.0,
+                 on_dead: Optional[Callable[[str, RemoteNode], None]] = None):
+        self.interval_s = interval_s
+        self.miss_threshold = max(1, miss_threshold)
+        self.probe_timeout = probe_timeout
+        self.on_dead = on_dead
+        self._lock = threading.Lock()
+        self._nodes: Dict[str, RemoteNode] = {}
+        self._misses: Dict[str, int] = {}
+        self._dead: Dict[str, RemoteNode] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def add(self, name: str, node: RemoteNode) -> None:
+        with self._lock:
+            self._nodes[name] = node
+            self._misses[name] = 0
+            self._dead.pop(name, None)
+
+    def remove(self, name: str) -> None:
+        with self._lock:
+            self._nodes.pop(name, None)
+            self._misses.pop(name, None)
+            self._dead.pop(name, None)
+
+    def live_names(self) -> List[str]:
+        with self._lock:
+            return list(self._nodes)
+
+    def is_dead(self, name: str) -> bool:
+        with self._lock:
+            return name in self._dead
+
+    def declare_dead(self, name: str) -> None:
+        """Out-of-band death report (e.g. a submit hit a closed socket):
+        skip the remaining probe budget — the caller KNOWS."""
+        with self._lock:
+            node = self._nodes.pop(name, None)
+            self._misses.pop(name, None)
+            if node is None:
+                return
+            self._dead[name] = node
+        if self.on_dead is not None:
+            self.on_dead(name, node)
+
+    def check_once(self) -> List[str]:
+        """One probe sweep; returns names declared dead BY this sweep."""
+        with self._lock:
+            targets = list(self._nodes.items())
+        died: List[str] = []
+        for name, node in targets:
+            ok = node.alive(timeout=self.probe_timeout)
+            with self._lock:
+                if name not in self._nodes:
+                    continue        # removed/declared dead concurrently
+                if ok:
+                    self._misses[name] = 0
+                    continue
+                self._misses[name] = self._misses.get(name, 0) + 1
+                if self._misses[name] < self.miss_threshold:
+                    continue
+            self.declare_dead(name)
+            died.append(name)
+        return died
+
+    def start(self) -> "FailureDetector":
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._loop, daemon=True,
+                                            name="tosem-failure-detector")
+            self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.check_once()
+            except Exception:
+                pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+
+# --------------------------------------------------------------- pool
+
+
+class NodePool:
+    """Head-side router over node agents with self-healing semantics.
+
+    ``submit``/``map`` retry on surviving nodes when a node dies
+    mid-call; trials are tracked and resubmitted (same id) to a
+    survivor when their node is declared dead — give trials a shared
+    ``checkpoint_dir`` so the resubmission RESUMES from the last
+    checkpoint. All control transitions are journaled when a
+    ``journal_path`` is given, so :meth:`recover` can rebuild a crashed
+    head's state.
+    """
+
+    def __init__(self, journal_path: Optional[str] = None,
+                 heartbeat_interval_s: float = 0.5,
+                 miss_threshold: int = 2,
+                 probe_timeout: float = 2.0,
+                 start_detector: bool = False):
+        self._lock = threading.RLock()
+        self._nodes: Dict[str, RemoteNode] = {}
+        self._rr = 0
+        self._journal = HeadJournal(journal_path) if journal_path else None
+        self._trials: Dict[str, Dict[str, Any]] = {}
+        self.detector = FailureDetector(
+            interval_s=heartbeat_interval_s, miss_threshold=miss_threshold,
+            probe_timeout=probe_timeout, on_dead=self._on_node_dead)
+        if start_detector:
+            self.detector.start()
+
+    # -- membership ----------------------------------------------------
+
+    def add_node(self, node: RemoteNode, name: Optional[str] = None) -> str:
+        name = name or node.address
+        with self._lock:
+            self._nodes[name] = node
+        self.detector.add(name, node)
+        self._record("node_added", name=name, address=node.address)
+        return name
+
+    def remove_node(self, name: str) -> None:
+        with self._lock:
+            node = self._nodes.pop(name, None)
+        self.detector.remove(name)
+        if node is not None:
+            self._record("node_removed", name=name)
+
+    def live_nodes(self) -> Dict[str, RemoteNode]:
+        with self._lock:
+            return {n: v for n, v in self._nodes.items()
+                    if not self.detector.is_dead(n)}
+
+    def _record(self, event: str, **fields: Any) -> None:
+        if self._journal is not None:
+            self._journal.record(event, **fields)
+
+    def _on_node_dead(self, name: str, node: RemoteNode) -> None:
+        """Detector callback: drop the corpse and resubmit its trials
+        to survivors (same trial id ⇒ checkpoint resume)."""
+        with self._lock:
+            self._nodes.pop(name, None)
+            stranded = [tid for tid, t in self._trials.items()
+                        if t["node"] == name and not t.get("terminal")]
+        self._record("node_removed", name=name, reason="heartbeat")
+        for tid in stranded:
+            try:
+                self._resubmit_trial(tid)
+            except Exception as e:
+                self._record("trial_failed", trial_id=tid, error=repr(e))
+                with self._lock:
+                    self._trials[tid]["terminal"] = True
+                    self._trials[tid]["error"] = repr(e)
+
+    # -- task plane ----------------------------------------------------
+
+    def _pick_locked(self, exclude: set) -> Optional[str]:
+        names = [n for n in self._nodes
+                 if n not in exclude and not self.detector.is_dead(n)]
+        if not names:
+            return None
+        self._rr += 1
+        return names[self._rr % len(names)]
+
+    def submit(self, fn: Callable, *args: Any, **kwargs: Any) -> Any:
+        """Run ``fn`` on some live node; on node loss mid-call the task
+        is resubmitted to a survivor (at-least-once — like the
+        runtime's task retries, side effects may run twice)."""
+        work_id = uuid.uuid4().hex[:12]
+        self._record("work_submitted", work_id=work_id,
+                     fn=getattr(fn, "__name__", str(fn)))
+        tried: set = set()
+        last_err: Optional[BaseException] = None
+        while True:
+            with self._lock:
+                name = self._pick_locked(tried)
+                node = self._nodes.get(name) if name else None
+            if node is None:
+                self._record("work_failed", work_id=work_id,
+                             error=repr(last_err))
+                raise NodeLostError(
+                    f"no live node could run work {work_id}"
+                    + (f" (last error: {last_err!r})" if last_err else ""))
+            act = _chaos.fire("cluster.submit", target=name)
+            if act is not None and act["action"] == "kill_node":
+                # chaos: the node dies the instant work is routed to it
+                node.kill()
+            try:
+                out = node.submit(fn, *args, **kwargs)
+            except NodeDrainingError as e:
+                # draining is deliberate, not death: route around it
+                tried.add(name)
+                last_err = e
+                continue
+            except (ConnectionError, TimeoutError, OSError) as e:
+                tried.add(name)
+                last_err = e
+                self.detector.declare_dead(name)
+                continue
+            self._record("work_done", work_id=work_id)
+            return out
+
+    def map(self, fn: Callable, items) -> List[Any]:
+        return [self.submit(fn, it) for it in items]
+
+    # -- trial plane ---------------------------------------------------
+
+    def start_trial(self, trial_id: str, trainable_ref: str,
+                    config: Dict[str, Any], max_iterations: int,
+                    checkpoint_dir: Optional[str] = None,
+                    checkpoint_freq: int = 5) -> str:
+        """Route a trial to a live node and track it for resubmission.
+        With a shared ``checkpoint_dir``, a node death mid-trial resumes
+        the trial on a survivor from its last checkpoint (same-id
+        resubmit); without one, the resubmitted trial restarts."""
+        with self._lock:
+            self._trials[trial_id] = {
+                "trainable_ref": trainable_ref, "config": dict(config),
+                "max_iterations": max_iterations,
+                "checkpoint_dir": checkpoint_dir,
+                "checkpoint_freq": checkpoint_freq,
+                "node": None, "resubmits": 0, "terminal": False,
+            }
+        return self._resubmit_trial(trial_id)
+
+    def _resubmit_trial(self, trial_id: str) -> str:
+        with self._lock:
+            t = self._trials[trial_id]
+            tried: set = {t["node"]} if t["node"] else set()
+        last_err: Optional[BaseException] = None
+        while True:
+            with self._lock:
+                name = self._pick_locked(tried)
+                node = self._nodes.get(name) if name else None
+            if node is None:
+                raise NodeLostError(
+                    f"no live node for trial {trial_id!r}"
+                    + (f" (last error: {last_err!r})" if last_err else ""))
+            try:
+                node.start_trial(trial_id, t["trainable_ref"], t["config"],
+                                 t["max_iterations"],
+                                 checkpoint_freq=t["checkpoint_freq"],
+                                 checkpoint_dir=t["checkpoint_dir"])
+            except (ConnectionError, TimeoutError, OSError) as e:
+                tried.add(name)
+                last_err = e
+                self.detector.declare_dead(name)
+                continue
+            with self._lock:
+                t["node"] = name
+                t["resubmits"] += 1
+            self._record("trial_started", trial_id=trial_id, node=name,
+                         attempt=t["resubmits"])
+            return name
+
+    def trial_status(self, trial_id: str) -> Dict[str, Any]:
+        with self._lock:
+            t = self._trials[trial_id]
+            # a trial whose resubmission already failed terminally (no
+            # surviving nodes) must report that, not RESUBMITTING forever
+            if t.get("terminal") and t.get("error"):
+                return {"status": "FAILED", "metrics": [], "n_total": 0,
+                        "error": t["error"]}
+            name = t["node"]
+            node = self._nodes.get(name)
+        if node is None:
+            return {"status": "RESUBMITTING", "metrics": [],
+                    "n_total": 0, "error": ""}
+        try:
+            st = node.trial_status(trial_id)
+        except (ConnectionError, TimeoutError, OSError):
+            # declare the node we actually probed — by now the detector
+            # may have re-homed the trial onto a healthy replacement
+            self.detector.declare_dead(name)
+            return {"status": "RESUBMITTING", "metrics": [],
+                    "n_total": 0, "error": ""}
+        if st["status"] in ("SUCCEEDED", "FAILED", "CANCELED"):
+            with self._lock:
+                if not t.get("terminal"):
+                    t["terminal"] = True
+                    self._record(
+                        "trial_done" if st["status"] == "SUCCEEDED"
+                        else "trial_failed", trial_id=trial_id,
+                        status=st["status"])
+        return st
+
+    def wait_trial(self, trial_id: str, timeout: float = 120.0,
+                   poll_s: float = 0.2) -> Dict[str, Any]:
+        """Poll until the trial reaches a terminal state, driving the
+        failure detector between polls so node death is noticed even
+        without the background thread running."""
+        deadline = time.monotonic() + timeout
+        st: Dict[str, Any] = {"status": "UNKNOWN"}
+        while time.monotonic() < deadline:
+            self.detector.check_once()
+            st = self.trial_status(trial_id)
+            if st["status"] in ("SUCCEEDED", "FAILED", "CANCELED"):
+                return st
+            time.sleep(poll_s)
+        raise TimeoutError(f"trial {trial_id!r} not terminal after "
+                           f"{timeout}s (last: {st['status']})")
+
+    # -- head crash-restart --------------------------------------------
+
+    @classmethod
+    def recover(cls, journal_path: str,
+                probe_timeout: float = 2.0, **kwargs: Any
+                ) -> "NodePool":
+        """Rebuild a head from its journal: re-register every node that
+        still answers health probes, journal the ones that don't as
+        removed, and leave ``outstanding_work``/``outstanding_trials``
+        on the instance for the caller to resubmit."""
+        state = HeadJournal.reconcile(HeadJournal.load(journal_path))
+        pool = cls(journal_path=journal_path, probe_timeout=probe_timeout,
+                   **kwargs)
+        for name, address in state["nodes"].items():
+            node = RemoteNode(address)
+            if node.alive(timeout=probe_timeout):
+                pool.add_node(node, name=name)
+            else:
+                node.close()
+                pool._record("node_removed", name=name,
+                             reason="dead at recovery")
+        pool.outstanding_work = state["outstanding_work"]
+        pool.outstanding_trials = state["outstanding_trials"]
+        return pool
+
+    def close(self, close_nodes: bool = False) -> None:
+        self.detector.stop()
+        if close_nodes:
+            with self._lock:
+                nodes = list(self._nodes.values())
+            for n in nodes:
+                try:
+                    n.close()
+                except Exception:
+                    pass
+        if self._journal is not None:
+            self._journal.close()

@@ -7,6 +7,7 @@ Redis daemons collapse into the driver (JAX is single-controller already);
 what remains native is the data plane (:mod:`tosem_tpu.native` objstore).
 """
 from tosem_tpu.runtime.api import (ActorDiedError, DeadlineExceeded,
+                                   ObjectLostError,
                                    ObjectRef, PlacementGroup,
                                    PlacementTimeout, TaskCancelledError,
                                    TaskError, WorkerCrashedError,
@@ -22,6 +23,7 @@ __all__ = [
     "kill", "cancel", "stats", "add_worker", "remove_idle_worker",
     "placement_group", "remove_placement_group", "PlacementGroup",
     "PlacementTimeout", "ObjectRef", "ObjectID", "ObjectStore", "TaskError",
-    "WorkerCrashedError", "ActorDiedError", "TaskCancelledError",
+    "WorkerCrashedError", "ObjectLostError", "ActorDiedError",
+    "TaskCancelledError",
     "DeadlineExceeded",
 ]

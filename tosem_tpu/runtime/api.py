@@ -32,17 +32,17 @@ from typing import Any, List, Optional, Sequence, Tuple, Union
 
 from tosem_tpu.runtime import common
 from tosem_tpu.runtime.common import (ActorDiedError, DeadlineExceeded,
-                                      ObjectRef, PlacementTimeout,
-                                      TaskCancelledError, TaskError,
-                                      WorkerCrashedError)
+                                      ObjectLostError, ObjectRef,
+                                      PlacementTimeout, TaskCancelledError,
+                                      TaskError, WorkerCrashedError)
 from tosem_tpu.runtime.runtime import Runtime
 
 __all__ = [
     "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
     "kill", "cancel", "ObjectRef", "TaskError", "WorkerCrashedError",
-    "ActorDiedError", "TaskCancelledError", "DeadlineExceeded",
-    "PlacementGroup", "PlacementTimeout", "placement_group",
-    "remove_placement_group",
+    "ObjectLostError", "ActorDiedError", "TaskCancelledError",
+    "DeadlineExceeded", "PlacementGroup", "PlacementTimeout",
+    "placement_group", "remove_placement_group",
 ]
 
 _runtime: Optional[Runtime] = None
@@ -52,10 +52,13 @@ _lock = threading.Lock()
 def init(num_workers: int = 4, store_capacity: int = 256 << 20,
          max_task_retries: int = common.DEFAULT_MAX_TASK_RETRIES,
          start_method: Optional[str] = None,
-         memory_monitor: bool = True) -> Runtime:
+         memory_monitor: bool = True,
+         reconstruction: bool = True) -> Runtime:
     """start_method: None (env/fork default) | "spawn" — use spawn when
     remote tasks import jax (forked XLA clients hang).
-    memory_monitor: run the RSS/object-store watchdog thread."""
+    memory_monitor: run the RSS/object-store watchdog thread.
+    reconstruction: heal lost store objects by re-executing their
+    producing task from lineage (False = typed ObjectLostError)."""
     global _runtime
     with _lock:
         if _runtime is None:
@@ -63,7 +66,8 @@ def init(num_workers: int = 4, store_capacity: int = 256 << 20,
                                store_capacity=store_capacity,
                                max_task_retries=max_task_retries,
                                start_method=start_method,
-                               memory_monitor=memory_monitor)
+                               memory_monitor=memory_monitor,
+                               reconstruction=reconstruction)
         return _runtime
 
 
@@ -194,11 +198,15 @@ class ActorHandle:
 class ActorClass:
     def __init__(self, cls, max_restarts: int = 0,
                  placement_group: Optional[PlacementGroup] = None,
-                 deadline_s: Optional[float] = None):
+                 deadline_s: Optional[float] = None,
+                 restore_state: bool = False,
+                 snapshot_every: int = common.ACTOR_SNAPSHOT_EVERY):
         self._cls = cls
         self._max_restarts = max_restarts
         self._pg = placement_group
         self._deadline_s = deadline_s
+        self._restore_state = restore_state
+        self._snapshot_every = snapshot_every
         self.__name__ = getattr(cls, "__name__", "Actor")
 
     def remote(self, *args, **kwargs) -> ActorHandle:
@@ -206,7 +214,9 @@ class ActorClass:
         blob = common.dumps((self._cls, args, kwargs))
         actor_id = rt.create_actor(
             blob, self._max_restarts,
-            pg=self._pg._pg_id if self._pg is not None else None)
+            pg=self._pg._pg_id if self._pg is not None else None,
+            restore_state=self._restore_state,
+            snapshot_every=self._snapshot_every)
         methods = [n for n, _ in inspect.getmembers(
             self._cls, predicate=callable) if not n.startswith("_")]
         return ActorHandle(actor_id, methods,
@@ -214,13 +224,21 @@ class ActorClass:
 
     def options(self, max_restarts: Optional[int] = None,
                 placement_group: Optional[PlacementGroup] = None,
-                deadline_s: Optional[float] = None) -> "ActorClass":
+                deadline_s: Optional[float] = None,
+                restore_state: Optional[bool] = None,
+                snapshot_every: Optional[int] = None) -> "ActorClass":
         return ActorClass(self._cls,
                           self._max_restarts if max_restarts is None
                           else max_restarts,
                           placement_group=placement_group,
                           deadline_s=(self._deadline_s if deadline_s is None
-                                      else deadline_s))
+                                      else deadline_s),
+                          restore_state=(self._restore_state
+                                         if restore_state is None
+                                         else restore_state),
+                          snapshot_every=(self._snapshot_every
+                                          if snapshot_every is None
+                                          else snapshot_every))
 
     def __call__(self, *a, **k):
         raise TypeError(f"actor class {self.__name__!r} must be instantiated "
@@ -229,14 +247,21 @@ class ActorClass:
 
 def remote(*args, **options):
     """Decorator: ``@remote`` or ``@remote(max_retries=…, max_restarts=…,
-    deadline_s=…)``. ``deadline_s`` on an actor class becomes the
-    default deadline for every method call (override per call with
-    ``actor.m.options(deadline_s=…)``)."""
+    deadline_s=…, restore_state=…)``. ``deadline_s`` on an actor class
+    becomes the default deadline for every method call (override per
+    call with ``actor.m.options(deadline_s=…)``). ``restore_state=True``
+    makes restarts restore the actor's STATE (snapshot + method replay),
+    not just re-run ``__init__``."""
     def wrap(target):
         if inspect.isclass(target):
             return ActorClass(target,
                               max_restarts=options.get("max_restarts", 0),
-                              deadline_s=options.get("deadline_s"))
+                              deadline_s=options.get("deadline_s"),
+                              restore_state=options.get("restore_state",
+                                                        False),
+                              snapshot_every=options.get(
+                                  "snapshot_every",
+                                  common.ACTOR_SNAPSHOT_EVERY))
         return RemoteFunction(target,
                               max_retries=options.get("max_retries"),
                               deadline_s=options.get("deadline_s"))

@@ -27,6 +27,19 @@ MAX_INFLIGHT_PER_WORKER = 16  # pipeline depth per stateless worker
 STEAL_AFTER_S = 1.0  # reclaim queued tasks from a worker stalled this long
 DEFAULT_MAX_TASK_RETRIES = 3  # reference: ray default task max_retries
 
+# lineage-based reconstruction bounds (the reconstruction_policy knobs):
+# a lost object may be re-derived by re-executing its producing task up
+# to MAX_RECONSTRUCTION_ATTEMPTS times, chasing missing ancestors up to
+# MAX_RECONSTRUCTION_DEPTH levels; the driver remembers at most
+# MAX_LINEAGE_ENTRIES completed task specs (oldest evicted first — an
+# evicted entry's object is no longer reconstructible)
+MAX_RECONSTRUCTION_ATTEMPTS = 3
+MAX_RECONSTRUCTION_DEPTH = 8
+MAX_LINEAGE_ENTRIES = 4096
+# actor state recovery: snapshot the actor every N calls; between
+# snapshots at most N method calls are kept for replay-on-restart
+ACTOR_SNAPSHOT_EVERY = 8
+
 
 class RuntimeError_(Exception):
     pass
@@ -44,6 +57,27 @@ class TaskError(RuntimeError_):
 
 class WorkerCrashedError(RuntimeError_):
     """The worker executing the task died (after exhausting retries)."""
+
+
+class ObjectLostError(WorkerCrashedError):
+    """An object was lost from the store and could NOT be reconstructed
+    (no lineage — e.g. a ``put`` or actor-call result — or the
+    reconstruction attempt/depth budget was exhausted). Subclasses
+    :class:`WorkerCrashedError` so pre-recovery callers keep working."""
+
+
+class DependencyLostError(RuntimeError_):
+    """A worker found a task dependency missing from the object store.
+
+    Raised worker-side and shipped to the driver, which — when
+    reconstruction is enabled and the dependency has lineage —
+    re-derives the dependency and requeues the task (free of retry
+    charge) instead of surfacing a :class:`TaskError`.
+    """
+
+    def __init__(self, key_hex: str):
+        super().__init__(f"dependency {key_hex[:12]} missing from store")
+        self.key_hex = key_hex
 
 
 class ActorDiedError(RuntimeError_):

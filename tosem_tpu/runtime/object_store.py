@@ -4,14 +4,21 @@ Plasma-client analog (reference: ``src/ray/object_manager/plasma/client.cc``):
 immutable objects keyed by 20-byte ids, zero-copy reads out of the mmap'd
 segment, per-object refcounts, LRU eviction under memory pressure. The store
 itself is C++ (:mod:`tosem_tpu.native` ``objstore.cpp``); this wrapper adds
-object-id generation and memoryview-based zero-copy gets.
+object-id generation, memoryview-based zero-copy gets, and a spill tier:
+an object can be demoted to a disk file (``spill``) and is transparently
+restored on the next ``get``/``get_view`` — eviction under memory pressure
+becomes a slow path instead of data loss (the reference's
+``object_manager/spilled_object_reader.cc`` role). The spill directory is
+derived from the segment name, so every process attached to the segment
+sees the same spill tier.
 """
 from __future__ import annotations
 
 import ctypes
 import os
+import tempfile
 import uuid
-from typing import Optional, Tuple
+from typing import List, Optional, Tuple
 
 from tosem_tpu.native import load_library
 
@@ -99,13 +106,21 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     return lib
 
 
+def default_spill_dir(name: str) -> str:
+    """Spill directory shared by every attacher of segment ``name``."""
+    return os.path.join(tempfile.gettempdir(),
+                        "tosem_spill_" + name.strip("/").replace("/", "_"))
+
+
 class ObjectStore:
     """One shared-memory segment, created by the driver, attached by workers."""
 
     def __init__(self, name: str, capacity: int = 256 << 20,
-                 create: bool = True):
+                 create: bool = True, spill_dir: Optional[str] = None):
         self._lib = _bind(load_library("objstore"))
         self.name = name
+        self._created = create
+        self.spill_dir = spill_dir or default_spill_dir(name)
         if create:
             self._h = self._lib.objstore_create(name.encode(), capacity)
         else:
@@ -129,7 +144,31 @@ class ObjectStore:
             self.release(oid)
 
     def get_view(self, oid: ObjectID) -> Optional[memoryview]:
-        """Zero-copy view into the segment; caller must :meth:`release`."""
+        """Zero-copy view into the segment; caller must :meth:`release`.
+
+        A spilled object is transparently restored: promoted back into
+        the segment when it fits (future reads are zero-copy again), or
+        served from a heap copy of the file when the segment is full —
+        either way the caller cannot tell it was ever spilled.
+        """
+        view = self._get_view_shm(oid)
+        if view is not None:
+            return view
+        data = self._read_spilled(oid)
+        if data is None:
+            return None
+        try:
+            self.put(oid, data)
+        except ObjectStoreError as e:
+            if e.code == -1:             # raced restore: already back
+                pass
+            else:                        # segment full: serve the copy
+                return memoryview(data)
+        else:
+            self._unlink_spilled(oid)
+        return self._get_view_shm(oid) or memoryview(data)
+
+    def _get_view_shm(self, oid: ObjectID) -> Optional[memoryview]:
         ptr = ctypes.POINTER(ctypes.c_uint8)()
         size = ctypes.c_uint64()
         rc = self._lib.objstore_get(self._h, oid.binary,
@@ -195,10 +234,75 @@ class ObjectStore:
         self._lib.objstore_release(self._h, oid.binary)
 
     def contains(self, oid: ObjectID) -> bool:
+        """True when the object is readable — in shm OR in the spill
+        tier (a spilled object is present, just slow)."""
+        if self._lib.objstore_contains(self._h, oid.binary):
+            return True
+        return self.has_spilled(oid)
+
+    def contains_shm(self, oid: ObjectID) -> bool:
         return bool(self._lib.objstore_contains(self._h, oid.binary))
 
     def delete(self, oid: ObjectID) -> None:
+        """Remove the object everywhere: shm segment AND spill tier
+        (a deleted object is *gone*, not demoted)."""
         self._lib.objstore_delete(self._h, oid.binary)
+        self._unlink_spilled(oid)
+
+    # -- spill tier ------------------------------------------------------
+
+    def _spill_path(self, oid: ObjectID) -> str:
+        return os.path.join(self.spill_dir, oid.hex())
+
+    def has_spilled(self, oid: ObjectID) -> bool:
+        return os.path.exists(self._spill_path(oid))
+
+    def spill(self, oid: ObjectID) -> bool:
+        """Demote a sealed object to disk and free its shm slot.
+
+        Atomic (write-temp + ``os.replace``): a crash mid-spill leaves
+        either the shm copy or a complete file, never a torn object.
+        Returns False when the object is absent from shm (already
+        spilled objects count as success).
+        """
+        view = self._get_view_shm(oid)
+        if view is None:
+            return self.has_spilled(oid)
+        try:
+            data = bytes(view)
+        finally:
+            self.release(oid)
+        path = self._spill_path(oid)
+        os.makedirs(self.spill_dir, exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        self._lib.objstore_delete(self._h, oid.binary)
+        return True
+
+    def _read_spilled(self, oid: ObjectID) -> Optional[bytes]:
+        try:
+            with open(self._spill_path(oid), "rb") as f:
+                return f.read()
+        except OSError:
+            return None
+
+    def _unlink_spilled(self, oid: ObjectID) -> None:
+        try:
+            os.unlink(self._spill_path(oid))
+        except OSError:
+            pass
+
+    def spilled_ids(self) -> List[str]:
+        """Hex ids currently resident in the spill tier."""
+        try:
+            return [n for n in os.listdir(self.spill_dir)
+                    if len(n) == 2 * ID_LEN]
+        except OSError:
+            return []
 
     def stats(self) -> Tuple[int, int, int]:
         """(used_bytes, num_objects, capacity)."""
@@ -213,6 +317,10 @@ class ObjectStore:
         if self._h:
             self._lib.objstore_close(self._h)
             self._h = None
+            if self._created:
+                # the segment's creator owns the spill tier's lifetime
+                import shutil
+                shutil.rmtree(self.spill_dir, ignore_errors=True)
 
     def __enter__(self):
         return self

@@ -34,14 +34,18 @@ import time
 import weakref
 from typing import Any, Dict, List, Optional, Set, Tuple
 
+from collections import OrderedDict
+
 from tosem_tpu.chaos import hooks as _chaos
 from tosem_tpu.runtime import common
 from tosem_tpu.runtime.common import (ActorDiedError, DeadlineExceeded,
+                                      DependencyLostError, ObjectLostError,
                                       ObjectRef, PlacementTimeout, StoreRef,
                                       TaskCancelledError, TaskError, TaskSpec,
                                       WorkerCrashedError)
 from tosem_tpu.obs import metrics as _metrics
-from tosem_tpu.runtime.object_store import ObjectID, ObjectStore
+from tosem_tpu.runtime.object_store import (ObjectID, ObjectStore,
+                                            ObjectStoreError)
 
 # runtime metric definitions (the src/ray/stats/metric_defs.h role)
 M_TASKS_SUBMITTED = _metrics.counter(
@@ -55,6 +59,12 @@ M_MEM_PRESSURE = _metrics.counter(
     "high-watermark firings of the runtime memory watchdog")
 M_WORKERS_ALIVE = _metrics.gauge(
     "rt_workers_alive", "stateless worker processes in the pool")
+M_RECONSTRUCTIONS = _metrics.counter(
+    "rt_object_reconstructions_total",
+    "lost objects re-derived by re-executing their producing task")
+M_SPILLS = _metrics.counter(
+    "rt_objects_spilled_total",
+    "store objects demoted to the disk spill tier under pressure")
 
 
 def _default_start_method() -> str:
@@ -127,12 +137,47 @@ class _Worker:
 
 
 class _ActorRecord:
-    def __init__(self, worker: _Worker, init_blob: bytes, max_restarts: int):
+    # unpicklable actors fall back to full method replay; this bounds
+    # that log (oldest dropped — restart state becomes best-effort)
+    REPLAY_LOG_CAP = 1024
+
+    def __init__(self, worker: _Worker, init_blob: bytes, max_restarts: int,
+                 restore_state: bool = False,
+                 snapshot_every: int = common.ACTOR_SNAPSHOT_EVERY):
         self.worker = worker
         self.init_blob = init_blob      # replayed on restart
         self.max_restarts = max_restarts
         self.restarts = 0
         self.dead = False
+        # state recovery (restore_state=True): the driver snapshots the
+        # actor every `snapshot_every` calls and keeps the method calls
+        # sent since, so a restart replays init -> snapshot -> log
+        # instead of just init (reference: actor checkpointing +
+        # task-replay reconstruction, gcs_actor_manager.cc)
+        self.restore_state = restore_state
+        self.snapshot_every = max(1, snapshot_every)
+        self.snapshot_blob: Optional[bytes] = None
+        self.snapshot_unavailable = False   # actor state unpicklable
+        self.replay_log: List[Tuple[int, str, bytes]] = []
+        self.call_seq = 0                   # send ordinal (FIFO pipe)
+        self.snapshot_cutoff: Optional[int] = None  # in-flight request
+
+
+class _Lineage:
+    """How to re-derive one store object: its producing stateless task.
+
+    Deliberately does NOT hold the result ObjectRef (that would pin the
+    driver-table entry forever); args/kwargs DO hold dep ObjectRefs —
+    lineage pinning, so a reconstructible object's ancestors stay
+    reconstructible too.
+    """
+
+    __slots__ = ("fn_id", "args", "kwargs")
+
+    def __init__(self, fn_id: bytes, args: tuple, kwargs: dict):
+        self.fn_id = fn_id
+        self.args = args
+        self.kwargs = kwargs
 
 
 class Runtime:
@@ -142,7 +187,8 @@ class Runtime:
                  store_capacity: int = 256 << 20,
                  max_task_retries: int = common.DEFAULT_MAX_TASK_RETRIES,
                  start_method: Optional[str] = None,
-                 memory_monitor: bool = True):
+                 memory_monitor: bool = True,
+                 reconstruction: bool = True):
         # a pinned method (arg or env) is honored forever; otherwise the
         # context is re-picked at every worker spawn — a Runtime created
         # before jax was imported must still switch to spawn for workers
@@ -162,6 +208,13 @@ class Runtime:
         self.inline: Dict[bytes, Tuple[int, List[bytes]]] = {}
         self.in_store: Set[bytes] = set()
         self.errors: Dict[bytes, BaseException] = {}
+        # lineage (reconstruction_policy role): result-oid -> producing
+        # task, kept AFTER completion so a lost object can be re-derived
+        # by re-executing it; bounded FIFO, entries die with their ref
+        self.reconstruction = reconstruction
+        self.lineage: "OrderedDict[bytes, _Lineage]" = OrderedDict()
+        self._recon_attempts: Dict[bytes, int] = {}
+        self._reconstructing: Set[bytes] = set()
         # task state
         self.specs: Dict[bytes, TaskSpec] = {}
         self.pending: List[TaskSpec] = []        # FIFO, deps may be unresolved
@@ -203,9 +256,11 @@ class Runtime:
 
             def _on_pressure(snap):
                 M_MEM_PRESSURE.inc()
+                spilled = self.spill_under_pressure()
                 print(f"[tosem_tpu] memory pressure: "
                       f"rss={snap['rss_bytes']/1e9:.2f}GB "
-                      f"available={snap['available_bytes']/1e9:.2f}GB",
+                      f"available={snap['available_bytes']/1e9:.2f}GB "
+                      f"spilled={spilled} store objects to disk",
                       file=sys.stderr)
             self._memmon = MemoryMonitor(
                 threshold=0.92, interval_s=5.0, store=self.store,
@@ -262,7 +317,10 @@ class Runtime:
         return ref
 
     def create_actor(self, cls_blob_args: bytes, max_restarts: int,
-                     pg: Optional[bytes] = None) -> bytes:
+                     pg: Optional[bytes] = None,
+                     restore_state: bool = False,
+                     snapshot_every: int = common.ACTOR_SNAPSHOT_EVERY
+                     ) -> bytes:
         actor_id = os.urandom(16)
         M_ACTORS.inc(labels=["created"])
         # ONE lock hold for slot consumption + actor registration: a gap
@@ -293,8 +351,9 @@ class Runtime:
                     victim.parked = False
                     rec["actors"].discard(actor_id)
                 raise
-            self.actors[actor_id] = _ActorRecord(w, cls_blob_args,
-                                                 max_restarts)
+            self.actors[actor_id] = _ActorRecord(
+                w, cls_blob_args, max_restarts,
+                restore_state=restore_state, snapshot_every=snapshot_every)
             self._send(w, ("actor_init", cls_blob_args))
             self.cv.notify_all()
         return actor_id
@@ -528,7 +587,15 @@ class Runtime:
         kind, parts = common.dumps_parts(value)
         ref = self._new_ref()
         if common.parts_nbytes(parts) > common.INLINE_THRESHOLD:
-            common.store_put_parts(self.store, ref.oid, kind, parts)
+            try:
+                common.store_put_parts(self.store, ref.oid, kind, parts)
+            except ObjectStoreError as e:
+                if e.code != -3:
+                    raise
+                # store full: demote cold objects to the disk spill tier
+                # and retry once — pressure becomes slow, not fatal
+                self.spill_under_pressure(target_fraction=0.25)
+                common.store_put_parts(self.store, ref.oid, kind, parts)
             with self.lock:
                 self.in_store.add(ref.oid.binary)
         else:
@@ -540,22 +607,31 @@ class Runtime:
     def get(self, ref: ObjectRef, timeout: Optional[float] = None) -> Any:
         key = ref.oid.binary
         deadline = None if timeout is None else time.monotonic() + timeout
-        with self.cv:
-            while not self._ready_locked(key):
-                remaining = (None if deadline is None
-                             else deadline - time.monotonic())
-                if remaining is not None and remaining <= 0:
-                    raise TimeoutError(f"get({ref!r}) timed out")
-                self.cv.wait(remaining)
-            if key in self.errors:
-                raise self.errors[key]
-            if key in self.inline:
-                return common.loads_parts(*self.inline[key])
-        found, value = common.store_get_value(self.store, ref.oid)
-        if not found:
-            raise WorkerCrashedError(f"object {ref!r} lost from store "
-                                     f"(evicted under memory pressure?)")
-        return value
+        while True:
+            with self.cv:
+                while not self._ready_locked(key):
+                    remaining = (None if deadline is None
+                                 else deadline - time.monotonic())
+                    if remaining is not None and remaining <= 0:
+                        # a timed-out waiter holds nothing: any
+                        # reconstruction it triggered keeps running and
+                        # re-publishes the object, so a later get()
+                        # succeeds (no permanently-in-flight ref)
+                        raise TimeoutError(f"get({ref!r}) timed out")
+                    self.cv.wait(remaining)
+                if key in self.errors:
+                    raise self.errors[key]
+                if key in self.inline:
+                    return common.loads_parts(*self.inline[key])
+            found, value = common.store_get_value(self.store, ref.oid)
+            if found:
+                return value
+            # lost from the store (evicted / producing worker died
+            # before the driver learned): heal through lineage, then
+            # loop back and wait for the re-derived object
+            err = self._begin_reconstruction(key)
+            if err is not None:
+                raise err
 
     def wait(self, refs: List[ObjectRef], num_returns: int,
              timeout: Optional[float]
@@ -679,11 +755,162 @@ class Runtime:
             with self.lock:
                 self.inline.pop(key, None)
                 self.errors.pop(key, None)
+                self.lineage.pop(key, None)
+                self._recon_attempts.pop(key, None)
+                self._reconstructing.discard(key)
                 if key in self.in_store:
                     self.in_store.discard(key)
                     self.store.delete(ObjectID(key))
         except Exception:
             pass  # interpreter teardown / store already closed
+
+    # ------------------------------------------- recovery: spill + lineage
+
+    def spill_under_pressure(self, target_fraction: float = 0.5) -> int:
+        """Demote store-resident objects to disk until usage is under
+        ``target_fraction`` of capacity. Spilled objects stay "ready"
+        (the store restores them transparently on get), so this turns
+        memory pressure into a slow path instead of evicting data."""
+        with self.lock:
+            keys = list(self.in_store)
+        spilled = 0
+        try:
+            used, _, cap = self.store.stats()
+            for key in keys:
+                if cap == 0 or used <= cap * target_fraction:
+                    break
+                oid = ObjectID(key)
+                if self.store.contains_shm(oid) and self.store.spill(oid):
+                    spilled += 1
+                    M_SPILLS.inc()
+                    used, _, cap = self.store.stats()
+        except Exception:
+            pass  # pressure relief is best-effort, never fatal
+        return spilled
+
+    def _begin_reconstruction(self, key: bytes) -> Optional[BaseException]:
+        """Kick off lineage reconstruction of ``key`` if needed.
+
+        Returns None when the caller should keep waiting (reconstruction
+        started or already in flight, or the object turned out to be
+        readable after all), or the typed error to raise."""
+        with self.lock:
+            if key in self.errors or key in self.inline:
+                return None          # resolved meanwhile; caller re-checks
+            if key in self._reconstructing:
+                return None          # someone else is already healing it
+            if self.store.contains(ObjectID(key)):
+                return None          # restored meanwhile (spill tier)
+            if not self.reconstruction:
+                return ObjectLostError(
+                    f"object {key.hex()[:12]} lost from store (evicted "
+                    "under memory pressure?); reconstruction is disabled")
+            err = self._start_reconstruction_locked(key)
+            if err is None:
+                self.cv.notify_all()
+            return err
+
+    def _start_reconstruction_locked(self, key: bytes
+                                     ) -> Optional[BaseException]:
+        """Plan + apply reconstruction of ``key`` (lock held).
+
+        Two-phase so a non-reconstructible ancestor is discovered BEFORE
+        any bookkeeping is mutated — a failed plan leaves no
+        partially-resolved state behind.
+        """
+        try:
+            planned = self._plan_reconstruction_locked(key, depth=0,
+                                                       planned=[])
+        except ObjectLostError as e:
+            return e
+        # apply: retract the stale in_store markers first, so dep
+        # resolution below sees missing ancestors as pending deps
+        for k in planned:
+            self._reconstructing.add(k)
+            self.in_store.discard(k)
+            self._recon_attempts[k] = self._recon_attempts.get(k, 0) + 1
+        for k in planned:
+            lin = self.lineage[k]
+            spec = TaskSpec(
+                task_id=os.urandom(16), fn_id=lin.fn_id, method=None,
+                actor_id=None, args=lin.args, kwargs=lin.kwargs,
+                # driver-internal ref: deliberately NO finalizer (the
+                # user's original ObjectRef owns this entry's lifetime)
+                result_ref=ObjectRef(ObjectID(k)),
+                retries_left=self.max_task_retries,
+                deps=self._unresolved_deps(lin.args, lin.kwargs))
+            self.specs[spec.task_id] = spec
+            self.pending.insert(0, spec)
+            M_RECONSTRUCTIONS.inc()
+        self._dispatch_locked()
+        return None
+
+    def _plan_reconstruction_locked(self, key: bytes, depth: int,
+                                    planned: List[bytes]) -> List[bytes]:
+        """DFS over missing ancestors; raises ObjectLostError when any
+        required object has no lineage or a budget is exhausted."""
+        if depth > common.MAX_RECONSTRUCTION_DEPTH:
+            raise ObjectLostError(
+                f"object {key.hex()[:12]} lost from store: reconstruction "
+                f"needs more than {common.MAX_RECONSTRUCTION_DEPTH} "
+                "lineage levels")
+        if key in planned or key in self._reconstructing:
+            return planned
+        lin = self.lineage.get(key)
+        if lin is None:
+            raise ObjectLostError(
+                f"object {key.hex()[:12]} lost from store and has no "
+                "lineage (puts and actor-call results are not "
+                "reconstructible)")
+        if self._recon_attempts.get(key, 0) >= \
+                common.MAX_RECONSTRUCTION_ATTEMPTS:
+            raise ObjectLostError(
+                f"object {key.hex()[:12]} lost from store: "
+                f"{common.MAX_RECONSTRUCTION_ATTEMPTS} reconstruction "
+                "attempts exhausted")
+        planned.append(key)
+        for v in list(lin.args) + list(lin.kwargs.values()):
+            if not isinstance(v, ObjectRef):
+                continue
+            dkey = v.oid.binary
+            if dkey in self.inline or dkey in self.errors:
+                continue             # dispatch-time materialization handles it
+            if dkey in self.in_store and \
+                    not self.store.contains(ObjectID(dkey)):
+                self._plan_reconstruction_locked(dkey, depth + 1, planned)
+            elif dkey not in self.in_store and \
+                    dkey not in self._reconstructing and \
+                    not any(s.result_ref.oid.binary == dkey
+                            for s in self.specs.values()):
+                # the ancestor's driver entry is gone entirely (released)
+                raise ObjectLostError(
+                    f"object {key.hex()[:12]} lost from store: ancestor "
+                    f"{dkey.hex()[:12]} was released and cannot be "
+                    "re-derived")
+        return planned
+
+    def _recover_lost_dep_locked(self, spec: TaskSpec,
+                                 cause: DependencyLostError) -> bool:
+        """A worker reported a task dep missing from the store: rebuild
+        the dep through lineage and requeue the task (no retry charge —
+        the task is a victim, not a crash). False = not recoverable."""
+        if not self.reconstruction:
+            return False
+        try:
+            dkey = bytes.fromhex(cause.key_hex)
+        except ValueError:
+            return False
+        if dkey in self.errors:
+            return False
+        if dkey not in self._reconstructing and \
+                not self.store.contains(ObjectID(dkey)):
+            if self._start_reconstruction_locked(dkey) is not None:
+                return False
+        spec.deps = {ObjectRef(ObjectID(dkey))}
+        self.pending.insert(0, spec)
+        self.cv.notify_all()
+        self._dispatch_locked()
+        return True
 
     def _send(self, w: _Worker, msg: tuple) -> None:
         """Queue a pipe write for the sender thread (never blocks)."""
@@ -769,6 +996,19 @@ class Runtime:
         if spec.actor_id is not None:
             self._send(w, ("actor_call", spec.task_id, spec.method,
                            spec.result_ref.oid.binary, blob))
+            rec = self.actors.get(spec.actor_id)
+            if rec is not None and rec.restore_state and rec.worker is w:
+                # record the call for replay-on-restart; the pipe is
+                # FIFO, so a snapshot requested now covers exactly the
+                # calls sent so far (cutoff = current send ordinal)
+                rec.call_seq += 1
+                rec.replay_log.append((rec.call_seq, spec.method, blob))
+                if rec.snapshot_unavailable:
+                    del rec.replay_log[:-rec.REPLAY_LOG_CAP]
+                elif (rec.snapshot_cutoff is None
+                        and len(rec.replay_log) >= rec.snapshot_every):
+                    rec.snapshot_cutoff = rec.call_seq
+                    self._send(w, ("actor_snapshot",))
         else:
             if spec.fn_id not in w.known_fns:
                 self._send(w, ("reg_fn", spec.fn_id,
@@ -790,6 +1030,7 @@ class Runtime:
 
     def _fail_task_locked(self, spec: TaskSpec, err: BaseException) -> None:
         self.errors[spec.result_ref.oid.binary] = err
+        self._reconstructing.discard(spec.result_ref.oid.binary)
         self.specs.pop(spec.task_id, None)
         M_TASKS_FINISHED.inc(labels=[type(err).__name__])
         self.cv.notify_all()
@@ -801,19 +1042,31 @@ class Runtime:
         spec = self.specs.pop(tid, None)
         if spec is None:
             return
+        rkey = spec.result_ref.oid.binary
         if kind == "inline":
-            self.inline[spec.result_ref.oid.binary] = payload
+            self.inline[rkey] = payload
         elif kind == "store":
-            self.in_store.add(spec.result_ref.oid.binary)
+            self.in_store.add(rkey)
+            if spec.fn_id is not None:
+                # remember how to re-derive this object (lineage);
+                # bounded FIFO — an evicted entry's object can no longer
+                # be reconstructed, only re-read while it survives
+                self.lineage[rkey] = _Lineage(spec.fn_id, spec.args,
+                                              spec.kwargs)
+                self.lineage.move_to_end(rkey)
+                while len(self.lineage) > common.MAX_LINEAGE_ENTRIES:
+                    self.lineage.popitem(last=False)
             act = _chaos.fire("runtime.store")
             if act is not None and act["action"] == "evict_object":
                 # chaos: memory-pressure eviction of a sealed result —
-                # a later get() fails fast with the typed
-                # WorkerCrashedError("lost from store") path
+                # a later get() transparently re-executes the producing
+                # task (lineage reconstruction), or raises the typed
+                # ObjectLostError when reconstruction is off/exhausted
                 try:
-                    self.store.delete(ObjectID(spec.result_ref.oid.binary))
+                    self.store.delete(ObjectID(rkey))
                 except Exception:
                     pass
+        self._reconstructing.discard(rkey)
         M_TASKS_FINISHED.inc(labels=["ok"])
         self.cv.notify_all()
         if self.pending:
@@ -959,16 +1212,40 @@ class Runtime:
                     w.last_progress = time.monotonic()
                     if tid in w.inflight:
                         w.inflight.remove(tid)
-                    spec = self.specs.pop(tid, None)
+                    spec = self.specs.get(tid)
                     if spec is not None:
                         try:
                             cause = common.loads(blob)
                         except Exception as e:  # undeserializable exception
                             cause = RuntimeError(f"(unpicklable) {e}")
+                        if (isinstance(cause, DependencyLostError)
+                                and spec.actor_id is None
+                                and self._recover_lost_dep_locked(spec,
+                                                                  cause)):
+                            continue   # dep rebuilt, task requeued
+                        self.specs.pop(tid, None)
                         self.errors[spec.result_ref.oid.binary] = \
                             TaskError(cause, tb)
+                        self._reconstructing.discard(
+                            spec.result_ref.oid.binary)
                         self.cv.notify_all()
                     self._dispatch_locked()
+                elif kind == "snapshot":
+                    _, blob = msg
+                    rec = self.actors.get(w.actor_id)
+                    if rec is not None and rec.worker is w:
+                        rec.snapshot_blob = blob
+                        cutoff = rec.snapshot_cutoff or 0
+                        rec.snapshot_cutoff = None
+                        rec.replay_log = [e for e in rec.replay_log
+                                          if e[0] > cutoff]
+                elif kind == "snapshot_err":
+                    rec = self.actors.get(w.actor_id)
+                    if rec is not None and rec.worker is w:
+                        # unpicklable actor state: fall back to (bounded)
+                        # full method replay — restart becomes best-effort
+                        rec.snapshot_cutoff = None
+                        rec.snapshot_unavailable = True
                 elif kind == "actor_ready":
                     pass
                 elif kind == "actor_err":
@@ -1016,6 +1293,21 @@ class Runtime:
                 rec.worker = _Worker(self._make_ctx(), self.store_name,
                                      actor_id=w.actor_id)
                 self._send(rec.worker, ("actor_init", rec.init_blob))
+                if rec.restore_state:
+                    # restore state, not just the process: latest
+                    # snapshot, then replay the calls sent since (FIFO
+                    # pipe ⇒ applied before any new call). Calls that
+                    # were in flight at the crash ARE replayed even
+                    # though their callers saw ActorDiedError —
+                    # at-least-once, like task retries
+                    rec.snapshot_cutoff = None  # request died with worker
+                    if rec.snapshot_blob is not None:
+                        self._send(rec.worker,
+                                   ("actor_restore", rec.snapshot_blob))
+                    for _, method, blob in rec.replay_log:
+                        self._send(rec.worker,
+                                   ("actor_replay", method, blob))
+                    M_ACTORS.inc(labels=["state_restored"])
                 self._dispatch_locked()
             else:
                 rec.dead = True

@@ -9,10 +9,12 @@ exactly one actor instance (Ray dedicates workers to actors the same way,
 
 Messages in:  ("reg_fn", fn_id, blob) | ("task", tid, fn_id, blob)
               | ("actor_init", blob) | ("actor_call", tid, method, blob)
-              | ("exit",)
+              | ("actor_snapshot",) | ("actor_restore", blob)
+              | ("actor_replay", method, blob) | ("exit",)
 Messages out: ("ready",) | ("done", tid, kind, payload)
               | ("err", tid, blob, tb) | ("actor_ready",) |
-              ("actor_err", blob, tb)
+              ("actor_err", blob, tb) | ("snapshot", blob) |
+              ("snapshot_err", reason)
 """
 from __future__ import annotations
 
@@ -35,8 +37,9 @@ def _resolve(store_name: str, store_box: list, obj: Any) -> Any:
         store = _attach(store_name, store_box)
         found, value = common.store_get_value(store, ObjectID(obj.binary))
         if not found:
-            raise common.RuntimeError_(
-                f"dependency {obj.binary.hex()[:12]} missing from store")
+            # typed so the driver can reconstruct the dep and requeue
+            # this task instead of surfacing a TaskError
+            raise common.DependencyLostError(obj.binary.hex())
         return value
     return obj
 
@@ -119,6 +122,36 @@ def worker_main(conn, store_name: str) -> None:
             except BaseException as e:  # noqa: BLE001
                 conn.send(("actor_err", _dump_exc(e),
                            traceback.format_exc()))
+        elif kind == "actor_snapshot":
+            # pipe is FIFO: this snapshot reflects exactly the calls the
+            # driver sent before requesting it — the driver's replay-log
+            # cutoff accounting relies on that ordering
+            try:
+                blob = common.dumps(actor)
+                conn.send(("snapshot", blob))
+            except BaseException as e:  # unpicklable actor state
+                conn.send(("snapshot_err", repr(e)))
+        elif kind == "actor_restore":
+            # replace the freshly-init'd instance with the snapshot
+            _, blob = msg
+            try:
+                actor = common.loads(blob)
+            except BaseException as e:  # noqa: BLE001
+                conn.send(("actor_err", _dump_exc(e),
+                           traceback.format_exc()))
+        elif kind == "actor_replay":
+            # best-effort state replay on restart: results are not
+            # re-reported (the original callers already got them or an
+            # ActorDiedError); a replay failure must not kill the actor
+            _, method, blob = msg
+            try:
+                args, kwargs = common.loads(blob)
+                args = tuple(_resolve(store_name, store_box, a) for a in args)
+                kwargs = {k: _resolve(store_name, store_box, v)
+                          for k, v in kwargs.items()}
+                getattr(actor, method)(*args, **kwargs)
+            except BaseException:  # noqa: BLE001
+                pass
         elif kind == "actor_call":
             _, tid, method, result_binary, blob = msg
             try:

@@ -11,17 +11,25 @@ no tower loop, no process group, no parameter server.
 from __future__ import annotations
 
 import functools
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tosem_tpu.chaos import hooks as _chaos
 from tosem_tpu.nn.core import Module, variables
 from tosem_tpu.parallel.sharding import Rules, shard_tree, tree_shardings
 
 TrainState = Dict[str, Any]   # {"step", "params", "state", "opt_state"}
+
+
+class TrainingPreempted(RuntimeError):
+    """The training process was preempted mid-run (chaos ``train.step``
+    ``preempt`` action, or raised by user code on a SIGTERM notice).
+    A :func:`fit` with the same ``ckpt_dir`` resumes from the latest
+    atomic checkpoint with a bit-exact metric history."""
 
 
 def create_train_state(model: Module, key: jax.Array,
@@ -200,6 +208,59 @@ def shard_train_state(ts: TrainState, mesh: Mesh, rules: Rules) -> TrainState:
 
 def shard_batch_by_rules(batch: Any, mesh: Mesh, batch_rules: Rules) -> Any:
     return shard_tree(batch, mesh, batch_rules)
+
+
+def fit(state: TrainState, step_fn: Callable, batch_fn: Callable[[int], Any],
+        num_steps: int, *, rng: jax.Array,
+        ckpt_dir: Optional[str] = None, checkpoint_every: int = 0,
+        keep: int = 3, resume: bool = True,
+        on_step: Optional[Callable[[int, Dict[str, float]], None]] = None
+        ) -> Tuple[TrainState, List[Dict[str, float]]]:
+    """Preemption-safe training loop: checkpoint + auto-resume.
+
+    ``step_fn(state, batch, rng) -> (state, metrics)`` is any step built
+    by :func:`make_train_step`/:func:`make_partitioned_train_step`;
+    ``batch_fn(step) -> batch`` must be deterministic in ``step`` (an
+    indexable dataset, a seeded generator) — that, plus the per-step
+    ``jax.random.fold_in(rng, step)``, is what makes a resumed run
+    produce a BIT-EXACT continuation of the metric history.
+
+    With ``ckpt_dir``, every ``checkpoint_every`` steps the train state
+    and metric history are written atomically with checksums
+    (:func:`tosem_tpu.train.checkpoint.save_versioned`, last-``keep``
+    retained); ``resume=True`` restores the newest valid checkpoint
+    before stepping, skipping any version a preemption tore mid-write.
+
+    Chaos site ``train.step`` fires after each step's bookkeeping
+    (action ``preempt`` raises :class:`TrainingPreempted` — the
+    deterministic analog of a mid-training SIGKILL for tests).
+    """
+    from tosem_tpu.train import checkpoint as _ckpt
+    history: List[Dict[str, float]] = []
+    start = int(state["step"]) if "step" in state else 0
+    if ckpt_dir and resume:
+        found = _ckpt.restore_latest(ckpt_dir, state)
+        if found is not None:
+            start, state, extra = found
+            history = list((extra or {}).get("history", []))
+    for step in range(start, num_steps):
+        batch = batch_fn(step)
+        step_rng = jax.random.fold_in(rng, step)
+        state, metrics = step_fn(state, batch, step_rng)
+        metrics = {k: float(v) for k, v in metrics.items()}
+        history.append(metrics)
+        if on_step is not None:
+            on_step(step + 1, metrics)
+        done = step + 1
+        if ckpt_dir and checkpoint_every and \
+                (done % checkpoint_every == 0 or done == num_steps):
+            _ckpt.save_versioned(ckpt_dir, done, state,
+                                 extra={"history": history}, keep=keep)
+        act = _chaos.fire("train.step", step=done)
+        if act is not None and act["action"] == "preempt":
+            raise TrainingPreempted(
+                f"training preempted after step {done}")
+    return state, history
 
 
 def classification_loss(model: Module, params, state, batch, rng):
