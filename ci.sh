@@ -11,11 +11,17 @@
 #                    numerics/unit files (no process-spawning suites)
 #                    + the 3-plan chaos smoke (the one deliberate
 #                    process-spawning step, so fault paths gate every PR)
+#   ./ci.sh --perf   perf_smoke tier (~2 min): syntax gate + the runtime
+#                    microbenchmarks gated against the recorded baseline
+#                    (results/bench_runtime_post.json) — fails on >30%
+#                    throughput regression on any gated bench
 set -euo pipefail
 cd "$(dirname "$0")"
 
 QUICK=0
+PERF=0
 [[ "${1:-}" == "--quick" ]] && QUICK=1
+[[ "${1:-}" == "--perf" ]] && PERF=1
 
 echo "== byte-compile (syntax gate)"
 python -m compileall -q tosem_tpu tests examples bench.py __graft_entry__.py
@@ -33,6 +39,27 @@ chaos_smoke() {
     JAX_PLATFORMS=cpu python -m tosem_tpu.cli chaos --plan "$plan"
   done
 }
+
+perf_smoke() {
+  # microbench regression gate: the task/object-plane fast path must not
+  # quietly rot. Baseline values are the conservative minimum of several
+  # recorded rounds; one retry absorbs ambient machine-phase noise on
+  # shared CI hosts (a REAL regression fails twice in a row).
+  echo "== perf smoke (runtime microbench vs results/bench_runtime_post.json)"
+  local cmd=(python -m tosem_tpu.cli microbench --workers 4 --trials 2
+             --min-s 0.4 --quiet --only gated
+             --check results/bench_runtime_post.json --threshold 0.30)
+  if ! JAX_PLATFORMS=cpu "${cmd[@]}"; then
+    echo "== perf smoke: regression reported; one retry (noisy host?)"
+    JAX_PLATFORMS=cpu "${cmd[@]}"
+  fi
+}
+
+if [[ "$PERF" == "1" ]]; then
+  perf_smoke
+  echo "== perf CI green"
+  exit 0
+fi
 
 if [[ "$QUICK" == "1" ]]; then
   echo "== quick tier: numerics + unit tests + chaos smoke"
@@ -76,6 +103,7 @@ for suite, san in (("objstore", "asan"), ("decoder", "asan"),
 EOF
 
 chaos_smoke
+perf_smoke
 
 echo "== multichip dryrun (8 virtual devices: factoring sweep + pp + ep)"
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

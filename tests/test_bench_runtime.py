@@ -1,0 +1,209 @@
+"""Runtime microbenchmark harness + fast-path correctness.
+
+Two halves, matching the fast-path PR's guarantees:
+
+1. The ``ray microbenchmark`` analog harness runs, emits release-log
+   format lines and schema-valid :class:`ResultRow`\\ s, and its
+   baseline-JSON save/check pair (the ci.sh ``perf_smoke`` gate)
+   detects regressions and round-trips cleanly.
+2. The fast path itself is safe: inline results are bit-identical to
+   store-path results, survive the chaos ``evict``/``kill worker``
+   plans, and zero-copy arg forwarding never aliases mutable driver
+   state.
+"""
+import re
+
+import pytest
+
+import tosem_tpu.runtime as rt
+from tosem_tpu.chaos import ChaosController, Fault, FaultPlan
+from tosem_tpu.runtime import common
+from tosem_tpu.runtime.bench_runtime import (GATED_BENCHES,
+                                             check_against_baseline,
+                                             _release_line,
+                                             run_microbenchmarks,
+                                             save_baseline)
+from tosem_tpu.utils.results import SCHEMA, ResultRow
+
+RELEASE_LINE_RE = re.compile(
+    r"^.+ per second \d+\.\d\d \+- \d+\.\d\d$")
+
+
+# ------------------------------------------------------------ harness
+
+class TestHarnessSmoke:
+    SMOKE = {"single_client_get", "single_client_put", "tasks_sync",
+             "wait_fanout"}
+
+    def test_emits_release_lines_and_schema_valid_rows(self, capsys):
+        rows = run_microbenchmarks(num_workers=2, trials=1, min_s=0.02,
+                                   only=self.SMOKE)
+        out = capsys.readouterr().out
+        lines = [ln for ln in out.splitlines() if "per second" in ln]
+        assert len(lines) == len(self.SMOKE)
+        for ln in lines:
+            assert RELEASE_LINE_RE.match(ln), ln
+        assert {r.bench_id for r in rows} == self.SMOKE
+        for r in rows:
+            assert isinstance(r, ResultRow)
+            assert r.project == "runtime"
+            assert r.config == "microbenchmark"
+            assert r.value > 0
+            assert r.unit == "ops/s"
+            assert "stddev" in r.extra
+            # the CSV writer's schema accepts the row as-is
+            assert set(r.to_csv_dict()) == set(SCHEMA)
+
+    def test_release_line_format_matches_reference_logs(self):
+        assert (_release_line("tasks synchronous", 1045.658, 22.919)
+                == "tasks synchronous per second 1045.66 +- 22.92")
+
+    def test_subset_filter_skips_everything_else(self):
+        rows = run_microbenchmarks(num_workers=2, trials=1, min_s=0.02,
+                                   only={"single_client_put"}, quiet=True)
+        assert [r.bench_id for r in rows] == ["single_client_put"]
+
+
+class TestBaselineGate:
+    def _rows(self, value):
+        return [ResultRow(project="runtime", config="microbenchmark",
+                          bench_id=b, metric=b, value=value, unit="ops/s",
+                          device="cpu") for b in GATED_BENCHES]
+
+    def test_save_then_check_round_trips_green(self, tmp_path):
+        path = str(tmp_path / "base.json")
+        save_baseline(self._rows(1000.0), path, num_workers=4)
+        ok, report = check_against_baseline(self._rows(1000.0), path)
+        assert ok and len(report) == len(GATED_BENCHES)
+
+    def test_regression_beyond_threshold_fails(self, tmp_path):
+        path = str(tmp_path / "base.json")
+        save_baseline(self._rows(1000.0), path, num_workers=4)
+        ok, report = check_against_baseline(self._rows(500.0), path,
+                                            threshold=0.30)
+        assert not ok
+        assert all("REGRESSION" in ln for ln in report)
+        # within threshold: green
+        ok, _ = check_against_baseline(self._rows(750.0), path,
+                                       threshold=0.30)
+        assert ok
+
+    def test_missing_bench_reported_but_not_fatal(self, tmp_path):
+        path = str(tmp_path / "base.json")
+        save_baseline(self._rows(1000.0), path, num_workers=4)
+        ok, report = check_against_baseline(self._rows(1000.0)[:-1], path)
+        assert ok
+        assert any("MISSING" in ln for ln in report)
+
+
+# --------------------------------------------------- fast-path safety
+
+@pytest.fixture
+def runtime():
+    r = rt.init(num_workers=2, memory_monitor=False)
+    yield r
+    rt.shutdown()
+
+
+def _payload(n):
+    return bytes(range(256)) * (n // 256)
+
+
+def _make_bytes(n):
+    return _payload(n)
+
+
+class TestInlineResults:
+    def test_inline_bit_identical_to_store_path(self, runtime):
+        """The same producer under and over INLINE_THRESHOLD: the
+        inline leg (result rides the pipe) must be byte-for-byte what
+        the store leg (shm round trip) produces."""
+        f = rt.remote(_make_bytes)
+        small_n = common.INLINE_THRESHOLD - 4096     # inline leg
+        large_n = common.INLINE_THRESHOLD * 4        # store leg
+        small = rt.get(f.remote(small_n), timeout=60)
+        large = rt.get(f.remote(large_n), timeout=60)
+        assert small == _payload(small_n)
+        assert large == _payload(large_n)
+        # and the inline value re-reads identically (driver table copy)
+        ref = f.remote(small_n)
+        assert rt.get(ref, timeout=60) == rt.get(ref, timeout=60) \
+            == _payload(small_n)
+
+    def test_inline_results_survive_worker_kill_chaos(self):
+        """Chaos kill_worker on dispatch: in-flight tasks are replayed
+        and every (inline) result still arrives correct — the fast path
+        must not weaken the PR 1/2 recovery guarantees."""
+        plan = FaultPlan(seed=11, faults=[
+            Fault(site="runtime.dispatch", action="kill_worker", at=3),
+            Fault(site="runtime.result", action="drop_result", at=5),
+        ])
+        rt.init(num_workers=2, memory_monitor=False)
+        try:
+            with ChaosController(plan):
+                f = rt.remote(_make_bytes)
+                refs = [f.remote(8192) for _ in range(12)]
+                vals = rt.get(refs, timeout=120)
+            assert all(v == _payload(8192) for v in vals)
+        finally:
+            rt.shutdown()
+
+    def test_store_results_survive_evict_chaos(self):
+        """Chaos evict_object on sealed store results: lineage
+        reconstruction (PR 2) re-derives them transparently."""
+        plan = FaultPlan(seed=7, faults=[
+            Fault(site="runtime.store", action="evict_object", at=2),
+        ])
+        rt.init(num_workers=2, memory_monitor=False)
+        try:
+            with ChaosController(plan):
+                f = rt.remote(_make_bytes)
+                n = common.INLINE_THRESHOLD * 2
+                refs = [f.remote(n) for _ in range(4)]
+                vals = rt.get(refs, timeout=120)
+            assert all(v == _payload(n) for v in vals)
+        finally:
+            rt.shutdown()
+
+
+def _mutate_and_return(buf):
+    # bytearray arrives mutable; scribble over it and hand it back
+    buf[:8] = b"XXXXXXXX"
+    return bytes(buf)
+
+
+class TestZeroCopyForwarding:
+    def test_forwarded_inline_arg_never_aliases_driver_state(self,
+                                                             runtime):
+        """A worker mutating its (deserialized) copy of an inline arg
+        must not corrupt the driver's inline table: later consumers of
+        the same ref see the original bytes."""
+        src = bytearray(_payload(8192))
+        ref = rt.put(src)
+        f = rt.remote(_mutate_and_return)
+        mutated = rt.get(f.remote(ref), timeout=60)
+        assert mutated[:8] == b"XXXXXXXX"
+        # the driver-held object is untouched by the worker's mutation
+        again = rt.get(ref)
+        assert bytes(again) == _payload(8192)
+        # and a second dispatch still forwards the original
+        mutated2 = rt.get(f.remote(ref), timeout=60)
+        assert mutated2 == mutated
+
+    def test_driver_side_gets_do_not_alias_each_other(self, runtime):
+        ref = rt.put(bytearray(_payload(4096)))
+        a = rt.get(ref)
+        b = rt.get(ref)
+        a[:4] = b"ZZZZ"
+        assert bytes(b) == _payload(4096)
+
+    def test_user_mutation_after_put_does_not_leak_in(self, runtime):
+        """put() snapshots: mutating the source buffer afterwards must
+        not change what dependants receive (the zero-copy send path may
+        hold views, never the user's live buffer)."""
+        src = bytearray(_payload(4096))
+        ref = rt.put(src)
+        src[:4] = b"!!!!"
+        f = rt.remote(lambda buf: bytes(buf))
+        assert rt.get(f.remote(ref), timeout=60) == _payload(4096)
+        assert bytes(rt.get(ref)) == _payload(4096)

@@ -10,6 +10,7 @@ every registered method present, correct big-endian framing calls.
 """
 import json
 import os
+import shutil
 import subprocess
 import sys
 
@@ -56,6 +57,18 @@ class TestDescribe:
                    MethodSpec("node_kill_trial")]
         with pytest.raises(ValueError, match="collision"):
             generate_cpp(methods)
+
+    def test_csharp_collision_on_emitted_pascal_case(self):
+        # distinct raw idents that COLLAPSE under C#'s PascalCase
+        # transform (fooBar/foobar -> Foobar): the generated class would
+        # contain a duplicate method and fail to compile — generation
+        # must fail instead, while languages emitting the raw ident
+        # still accept the pair
+        from tosem_tpu.cluster.stubgen import generate_csharp
+        methods = [MethodSpec("fooBar"), MethodSpec("foobar")]
+        with pytest.raises(ValueError, match="collision"):
+            generate_csharp(methods)
+        assert "fooBar" in generate_cpp(methods)   # raw idents distinct
 
     def test_node_stub_rejects_on_midframe_close(self, gateway):
         src = generate_node(describe(gateway))
@@ -108,6 +121,10 @@ class TestGeneratedSources:
 
 @pytest.mark.slow
 class TestCompiledCpp:
+    @pytest.mark.skipif(shutil.which("g++") is None,
+                        reason="no C++ toolchain on this image; the "
+                               "structural stub checks above still "
+                               "cover generation")
     def test_cpp_stub_compiles_and_calls_live_gateway(self, gateway,
                                                       tmp_path):
         paths = write_stubs(describe(gateway), str(tmp_path))

@@ -873,6 +873,12 @@ def main(argv: List[str] = None) -> int:
         # survival report (see tosem_tpu/chaos/)
         from tosem_tpu.chaos.cli import main as chaos_main
         return chaos_main(args[1:])
+    if args and args[0] == "microbench":
+        # `python -m tosem_tpu.cli microbench [--save/--check …]` — the
+        # ray-microbenchmark analog over the task/object planes, plus
+        # the ci.sh perf_smoke regression gate (--check)
+        from tosem_tpu.runtime.bench_runtime import main as micro_main
+        return micro_main(args[1:])
     fs = make_flags()
     fs.apply_env()
     leftover = fs.parse_args(args)

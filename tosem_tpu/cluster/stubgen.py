@@ -89,18 +89,26 @@ def describe_remote(address: str) -> List[MethodSpec]:
         return [MethodSpec(name=n) for n in names]
 
 
-def _check_idents(methods: List[MethodSpec]) -> None:
+def _check_idents(methods: List[MethodSpec],
+                  emit=lambda m: m.ident) -> None:
     """Distinct wire names must not collapse to the same identifier
     (``node.kill_trial`` vs ``node_kill_trial``) — the generated class
     would silently shadow one of them (Node) or fail to compile
-    (C++/Java). Fail generation instead."""
+    (C++/Java). Fail generation instead.
+
+    ``emit`` maps a spec to the name the target language actually
+    emits: languages that transform identifiers (C#'s PascalCase) can
+    collapse names that are distinct as raw idents (``fooBar`` vs
+    ``foobar`` → ``Foobar``), so the check must run on the emitted
+    form, not the shared sanitized form."""
     seen: Dict[str, str] = {}
     for m in methods:
-        if m.ident in seen and seen[m.ident] != m.name:
+        emitted = emit(m)
+        if emitted in seen and seen[emitted] != m.name:
             raise ValueError(
-                f"method identifier collision: {seen[m.ident]!r} and "
-                f"{m.name!r} both generate {m.ident!r}; rename one")
-        seen[m.ident] = m.name
+                f"method identifier collision: {seen[emitted]!r} and "
+                f"{m.name!r} both generate {emitted!r}; rename one")
+        seen[emitted] = m.name
 
 
 def _cpp_method(m: MethodSpec) -> str:
@@ -348,12 +356,17 @@ module.exports = {{ {class_name} }};
 """
 
 
+def _csharp_name(m: MethodSpec) -> str:
+    """The PascalCase method name the C# stub emits for a spec."""
+    return m.ident.title().replace("_", "")
+
+
 def _csharp_method(m: MethodSpec) -> str:
     args = ", ".join(f"string {p}Json" for p in m.params)
     arg_list = ", ".join(f"{p}Json" for p in m.params)
     doc = f"  /// <summary>{m.doc}</summary>\n" if m.doc else ""
     arr = f"new string[]{{{arg_list}}}" if m.params else "new string[0]"
-    return (f"{doc}  public string {m.ident.title().replace('_', '')}"
+    return (f"{doc}  public string {_csharp_name(m)}"
             f"({args}) {{\n    return Call(\"{m.name}\", {arr});\n  }}\n")
 
 
@@ -364,7 +377,10 @@ def generate_csharp(methods: List[MethodSpec],
     .NET's ``BinaryReader``/``Writer`` are little-endian, so the 4-byte
     frame length goes through ``IPAddress.HostToNetworkOrder``.
     """
-    _check_idents(methods)
+    # collision check on the PascalCase EMITTED names: ``fooBar`` and
+    # ``foobar`` have distinct idents but both emit ``Foobar``, which
+    # would fail to compile as a duplicate method
+    _check_idents(methods, emit=_csharp_name)
     methods_src = "".join(_csharp_method(m) for m in methods)
     return f"""// GENERATED by tosem_tpu.cluster.stubgen — do not edit.
 // C# client stub for the cross-language JSON wire (cluster/xlang.py).

@@ -9,12 +9,23 @@ Also funnels rows through the study-schema CSV writer.
 """
 from __future__ import annotations
 
+import json
+import os
 import statistics
 import time
-from typing import Callable, List, Tuple
+from typing import Callable, List, Optional, Tuple
 
 import tosem_tpu.runtime as rt
 from tosem_tpu.utils.results import ResultRow
+
+# Benches the ci.sh perf_smoke tier gates on (the latency-critical task
+# hot path). Throughput-style rows only — every one is higher-is-better,
+# so "regression" is simply current < baseline * (1 - threshold).
+GATED_BENCHES = (
+    "single_client_get", "single_client_put", "tasks_sync", "tasks_async",
+    "small_result_async", "large_object_roundtrip", "wait_fanout",
+    "actor_calls_sync", "actor_calls_async",
+)
 
 
 def _timeit(name: str, fn: Callable[[], int], trials: int = 3,
@@ -55,13 +66,19 @@ def _release_line(name: str, mean: float, sd: float) -> str:
 
 
 def run_microbenchmarks(num_workers: int = 4, trials: int = 3,
-                        min_s: float = 0.5, quiet: bool = False
-                        ) -> List[ResultRow]:
+                        min_s: float = 0.5, quiet: bool = False,
+                        only: Optional[set] = None) -> List[ResultRow]:
+    """Run the task/object-plane microbenchmarks; ``only`` restricts to
+    a subset of bench_ids (test smokes run a cheap slice, CI and the
+    baseline recorder run everything)."""
     own_runtime = not rt.is_initialized()
     if own_runtime:
         rt.init(num_workers=num_workers)
     rows: List[ResultRow] = []
     lines: List[str] = []
+
+    def want(bench_id):
+        return only is None or bench_id in only
 
     def record(bench_id, name, mean, sd, unit="ops/s"):
         _record(rows, lines, bench_id, name, mean, sd, unit)
@@ -70,93 +87,154 @@ def run_microbenchmarks(num_workers: int = 4, trials: int = 3,
     obj = rt.put(b"x" * 1024)
     BATCH = 1000
 
-    def do_gets():
-        for _ in range(BATCH):
-            rt.get(obj)
-        return BATCH
-    m, s = _timeit("get", do_gets, trials, min_s)
-    record("single_client_get", "single client get calls", m, s)
+    if want("single_client_get"):
+        def do_gets():
+            for _ in range(BATCH):
+                rt.get(obj)
+            return BATCH
+        m, s = _timeit("get", do_gets, trials, min_s)
+        record("single_client_get", "single client get calls", m, s)
 
-    payload = b"x" * 1024
+    if want("single_client_put"):
+        payload = b"x" * 1024
 
-    def do_puts():
-        for _ in range(BATCH):
-            rt.put(payload)
-        return BATCH
-    m, s = _timeit("put", do_puts, trials, min_s)
-    record("single_client_put", "single client put calls", m, s)
+        def do_puts():
+            for _ in range(BATCH):
+                rt.put(payload)
+            return BATCH
+        m, s = _timeit("put", do_puts, trials, min_s)
+        record("single_client_put", "single client put calls", m, s)
 
     # --- put bandwidth (ray_perf "single client put gigabytes") -----------
-    mb = b"x" * (1 << 20)
+    if want("single_client_put_gbps"):
+        mb = b"x" * (1 << 20)
 
-    def do_put_gb():
-        for _ in range(16):
-            rt.put(mb)
-        return 16
-    m, s = _timeit("put_gb", do_put_gb, trials, min_s)
-    record("single_client_put_gbps", "single client put gigabytes",
-           m / 1024.0, s / 1024.0, unit="GB/s")
+        def do_put_gb():
+            for _ in range(16):
+                rt.put(mb)
+            return 16
+        m, s = _timeit("put_gb", do_put_gb, trials, min_s)
+        record("single_client_put_gbps", "single client put gigabytes",
+               m / 1024.0, s / 1024.0, unit="GB/s")
 
     # --- tasks ------------------------------------------------------------
     @rt.remote
     def tiny():
         return b"ok"
 
-    def tasks_sync():
-        for _ in range(100):
-            rt.get(tiny.remote())
-        return 100
-    m, s = _timeit("tasks_sync", tasks_sync, trials, min_s)
-    record("tasks_sync", "tasks synchronous", m, s)
+    if want("tasks_sync"):
+        def tasks_sync():
+            for _ in range(100):
+                rt.get(tiny.remote())
+            return 100
+        m, s = _timeit("tasks_sync", tasks_sync, trials, min_s)
+        record("tasks_sync", "tasks synchronous", m, s)
 
-    def tasks_async():
-        rt.get([tiny.remote() for _ in range(1000)])
-        return 1000
-    m, s = _timeit("tasks_async", tasks_async, trials, min_s)
-    record("tasks_async", "tasks async", m, s)
+    if want("tasks_async"):
+        def tasks_async():
+            rt.get([tiny.remote() for _ in range(1000)])
+            return 1000
+        m, s = _timeit("tasks_async", tasks_async, trials, min_s)
+        record("tasks_async", "tasks async", m, s)
+
+    # --- fast-path specific benches ----------------------------------------
+    # small results ride the result pipe inline (no store round trip)
+    if want("small_result_async"):
+        small = b"y" * 8192
+
+        @rt.remote
+        def small_result():
+            return small
+
+        def small_results():
+            rt.get([small_result.remote() for _ in range(500)])
+            return 500
+        m, s = _timeit("small_result_async", small_results, trials, min_s)
+        record("small_result_async", "small result (8KB) tasks async",
+               m, s)
+
+    # large objects go driver→store→worker as StoreRef (zero-copy arg
+    # forwarding) and back as a store result — the >INLINE_THRESHOLD leg
+    if want("large_object_roundtrip"):
+        big = b"z" * (4 << 20)
+
+        @rt.remote
+        def consume(buf):
+            return len(buf)
+
+        def large_roundtrip():
+            ref = rt.put(big)
+            assert rt.get(consume.remote(ref)) == len(big)
+            return 1
+        m, s = _timeit("large_object", large_roundtrip, trials, min_s)
+        record("large_object_roundtrip", "large object (4MB) put+task",
+               m, s)
+
+    # wait() fan-out: N outstanding tasks collected through rt.wait
+    if want("wait_fanout"):
+        def wait_fanout():
+            refs = [tiny.remote() for _ in range(200)]
+            while refs:
+                done, refs = rt.wait(refs,
+                                     num_returns=min(10, len(refs)),
+                                     timeout=30.0)
+                assert done
+            return 200
+        m, s = _timeit("wait_fanout", wait_fanout, trials, min_s)
+        record("wait_fanout", "wait fanout tasks", m, s)
 
     # --- actors -----------------------------------------------------------
-    @rt.remote
-    class Echo:
-        def ping(self):
-            return b"ok"
+    actor_ids = {"actor_calls_sync", "actor_calls_async",
+                 "n_n_actor_calls_async"}
+    if only is None or actor_ids & only:
+        @rt.remote
+        class Echo:
+            def ping(self):
+                return b"ok"
 
-    a = Echo.remote()
-    rt.get(a.ping.remote())  # actor warm
+        a = Echo.remote()
+        rt.get(a.ping.remote())  # actor warm
 
-    def actor_sync():
-        for _ in range(100):
-            rt.get(a.ping.remote())
-        return 100
-    m, s = _timeit("actor_sync", actor_sync, trials, min_s)
-    record("actor_calls_sync", "1:1 actor calls sync", m, s)
+        if want("actor_calls_sync"):
+            def actor_sync():
+                for _ in range(100):
+                    rt.get(a.ping.remote())
+                return 100
+            m, s = _timeit("actor_sync", actor_sync, trials, min_s)
+            record("actor_calls_sync", "1:1 actor calls sync", m, s)
 
-    def actor_async():
-        rt.get([a.ping.remote() for _ in range(1000)])
-        return 1000
-    m, s = _timeit("actor_async", actor_async, trials, min_s)
-    record("actor_calls_async", "1:1 actor calls async", m, s)
+        if want("actor_calls_async"):
+            def actor_async():
+                rt.get([a.ping.remote() for _ in range(1000)])
+                return 1000
+            m, s = _timeit("actor_async", actor_async, trials, min_s)
+            record("actor_calls_async", "1:1 actor calls async", m, s)
 
-    n = max(2, num_workers)
-    actors = [Echo.remote() for _ in range(n)]
-    rt.get([b.ping.remote() for b in actors])
+        if want("n_n_actor_calls_async"):
+            n = max(2, num_workers)
+            actors = [Echo.remote() for _ in range(n)]
+            rt.get([b.ping.remote() for b in actors])
 
-    def nn_actor_async():
-        refs = []
-        for b in actors:
-            refs.extend(b.ping.remote() for _ in range(250))
-        rt.get(refs)
-        return len(refs)
-    m, s = _timeit("nn_actor_async", nn_actor_async, trials, min_s)
-    record("n_n_actor_calls_async", "n:n actor calls async", m, s)
+            def nn_actor_async():
+                refs = []
+                for b in actors:
+                    refs.extend(b.ping.remote() for _ in range(250))
+                rt.get(refs)
+                return len(refs)
+            m, s = _timeit("nn_actor_async", nn_actor_async, trials,
+                           min_s)
+            record("n_n_actor_calls_async", "n:n actor calls async",
+                   m, s)
 
     # --- placement groups -------------------------------------------------
-    def pg_cycle():
-        for _ in range(100):
-            rt.placement_group(1).remove()
-        return 100
-    m, s = _timeit("pg_cycle", pg_cycle, trials, min_s)
-    record("placement_group_cycle", "placement group create/remove", m, s)
+    if want("placement_group_cycle"):
+        def pg_cycle():
+            for _ in range(100):
+                rt.placement_group(1).remove()
+            return 100
+        m, s = _timeit("pg_cycle", pg_cycle, trials, min_s)
+        record("placement_group_cycle", "placement group create/remove",
+               m, s)
 
     if not quiet:
         for ln in lines:
@@ -164,6 +242,122 @@ def run_microbenchmarks(num_workers: int = 4, trials: int = 3,
     if own_runtime:
         rt.shutdown()
     return rows
+
+
+def save_baseline(rows: List[ResultRow], path: str,
+                  num_workers: int) -> None:
+    """Record a microbench run as the regression-gate baseline JSON."""
+    benches = {r.bench_id: {"metric": r.metric, "value": r.value,
+                            "unit": r.unit,
+                            "stddev": r.extra.get("stddev", 0.0)}
+               for r in rows}
+    doc = {"schema": "bench_runtime/v1",
+           "captured_unix": time.time(),
+           "num_workers": num_workers,
+           "benches": benches}
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def check_against_baseline(rows: List[ResultRow], baseline_path: str,
+                           threshold: float = 0.30
+                           ) -> Tuple[bool, List[str]]:
+    """Compare a run against a recorded baseline (higher-is-better rows).
+
+    Returns (ok, report_lines). A gated bench regressing by more than
+    ``threshold`` (fractional) fails the gate; benches present in only
+    one of the two sets are reported but do not fail (so adding a bench
+    does not break CI until a new baseline is recorded).
+    """
+    try:
+        with open(baseline_path) as f:
+            doc = json.load(f)
+    except FileNotFoundError:
+        raise SystemExit(
+            f"perf baseline {baseline_path!r} not found — record one "
+            "first: python -m tosem_tpu.cli microbench --save "
+            f"{baseline_path}")
+    base = doc.get("benches", {})
+    current = {r.bench_id: r for r in rows}
+    ok = True
+    report: List[str] = []
+    for bid in GATED_BENCHES:
+        if bid not in base:
+            continue
+        if bid not in current:
+            report.append(f"  {bid}: MISSING from current run (skipped)")
+            continue
+        b, c = base[bid]["value"], current[bid].value
+        ratio = c / b if b else float("inf")
+        floor = b * (1.0 - threshold)
+        if c < floor:
+            ok = False
+            report.append(f"  {bid}: REGRESSION {c:,.1f} vs baseline "
+                          f"{b:,.1f} ({ratio:.2f}x < {1 - threshold:.2f}x "
+                          "floor)")
+        else:
+            report.append(f"  {bid}: ok {c:,.1f} vs baseline {b:,.1f} "
+                          f"({ratio:.2f}x)")
+    return ok, report
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """``python -m tosem_tpu.cli microbench`` entry point.
+
+    --save records the run as a baseline JSON; --check gates the run
+    against a recorded baseline (exit 1 on >threshold regression) — the
+    ci.sh perf_smoke tier.
+    """
+    import argparse
+    p = argparse.ArgumentParser(prog="tosem_tpu.cli microbench",
+                                description="runtime microbenchmarks")
+    p.add_argument("--workers", type=int, default=4)
+    p.add_argument("--trials", type=int, default=3)
+    p.add_argument("--min-s", type=float, default=0.5)
+    p.add_argument("--save", default=None,
+                   help="write the run as a baseline JSON")
+    p.add_argument("--check", default=None,
+                   help="baseline JSON to gate against")
+    p.add_argument("--threshold", type=float, default=0.30,
+                   help="allowed fractional regression vs baseline")
+    p.add_argument("--control-plane", action="store_true",
+                   help="also run the RPC/channel/xlang/param benches")
+    p.add_argument("--only", default=None,
+                   help="comma-separated bench_id subset, or 'gated' for "
+                        "exactly the perf_smoke-gated benches")
+    p.add_argument("--quiet", action="store_true")
+    args = p.parse_args(argv)
+
+    only = None
+    if args.only:
+        only = (set(GATED_BENCHES) if args.only == "gated"
+                else set(args.only.split(",")))
+    rows = run_microbenchmarks(num_workers=args.workers, trials=args.trials,
+                               min_s=args.min_s, quiet=args.quiet,
+                               only=only)
+    if args.control_plane:
+        rows += run_control_plane_benchmarks(trials=args.trials,
+                                             min_s=args.min_s,
+                                             quiet=args.quiet)
+    if args.save:
+        save_baseline(rows, args.save, num_workers=args.workers)
+        print(f"baseline -> {args.save}")
+    if args.check:
+        ok, report = check_against_baseline(rows, args.check,
+                                            threshold=args.threshold)
+        print(f"perf gate vs {args.check} (threshold "
+              f"{args.threshold:.0%}):")
+        for line in report:
+            print(line)
+        if not ok:
+            print("perf gate: FAIL")
+            return 1
+        print("perf gate: PASS")
+    return 0
 
 
 def run_control_plane_benchmarks(trials: int = 3, min_s: float = 0.5,

@@ -16,7 +16,9 @@ from typing import Any, Optional
 
 import cloudpickle
 
-from tosem_tpu.runtime.object_store import ObjectID
+from tosem_tpu.runtime.object_store import ObjectID, fast_token  # noqa: F401
+# fast_token is re-exported: the runtime mints task/actor/fn/pg ids from it
+# (os.urandom per id was the single biggest per-call tax on some kernels)
 
 # Objects larger than this go to the shared-memory store instead of riding
 # the control pipe (reference: core_worker.cc:849 plasma threshold).
@@ -128,9 +130,42 @@ class StoreRef:
     binary: bytes
 
 
+@dataclass
+class InlineParts:
+    """Marker inside serialized args: an inline object forwarded in its
+    already-serialized ``(kind, parts)`` form (see :func:`dumps_parts`).
+
+    Zero-copy arg forwarding: the driver ships the parts it already holds
+    in its inline table instead of ``loads_parts`` + re-``dumps`` per
+    dispatch; the worker runs ``loads_parts`` once, which copies — so the
+    reconstructed value never aliases driver state."""
+    kind: int
+    parts: list
+
+
 def dumps(value: Any) -> bytes:
     """Serialize a value (cloudpickle: closures, lambdas, local classes)."""
     return cloudpickle.dumps(value, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def dumps_args(value: Any) -> bytes:
+    """Serialize an (args, kwargs) payload on the dispatch hot path.
+
+    Stdlib pickle is C-speed; cloudpickle pays Python-level dispatch per
+    call. Args are data in the overwhelmingly common case, so try pickle
+    first and fall back to cloudpickle for closures/lambdas. A stdlib
+    success that references ``__main__`` globals is ALSO demoted to
+    cloudpickle: stdlib pickles those by reference, which a spawn-mode
+    worker (fresh ``__main__``) could not resolve — cloudpickle pickles
+    them by value, preserving the old behavior.
+    """
+    try:
+        blob = pickle.dumps(value, protocol=pickle.HIGHEST_PROTOCOL)
+    except Exception:
+        return cloudpickle.dumps(value, protocol=pickle.HIGHEST_PROTOCOL)
+    if b"__main__" in blob:
+        return cloudpickle.dumps(value, protocol=pickle.HIGHEST_PROTOCOL)
+    return blob
 
 
 def loads(blob: bytes) -> Any:

@@ -16,13 +16,42 @@ from __future__ import annotations
 
 import ctypes
 import os
+import random
 import tempfile
-import uuid
+import threading
 from typing import List, Optional, Tuple
 
 from tosem_tpu.native import load_library
 
 ID_LEN = 20
+
+# --- fast unique tokens ----------------------------------------------------
+# ``os.urandom`` is a syscall per call and can be pathologically slow under
+# sandboxed kernels (hundreds of µs — it dominated the whole put/submit hot
+# path). Ids only need uniqueness within the driver process that mints them,
+# so one urandom seed feeding a process-local PRNG stream is equivalent and
+# ~100× cheaper. The stream is invalidated in fork children via
+# ``os.register_at_fork`` (not a getpid() check per call — that is a
+# syscall too) so a child never replays the parent's stream.
+_token_lock = threading.Lock()
+_token_rng: Optional[random.Random] = None
+
+
+def _reset_token_rng() -> None:
+    global _token_rng
+    _token_rng = None
+
+
+if hasattr(os, "register_at_fork"):
+    os.register_at_fork(after_in_child=_reset_token_rng)
+
+
+def fast_token(n: int) -> bytes:
+    global _token_rng
+    with _token_lock:
+        if _token_rng is None:
+            _token_rng = random.Random(os.urandom(32))
+        return _token_rng.randbytes(n)
 
 _ERRORS = {
     -1: "object already exists (objects are immutable)",
@@ -51,7 +80,7 @@ class ObjectID:
 
     @classmethod
     def random(cls) -> "ObjectID":
-        return cls(uuid.uuid4().bytes + os.urandom(4))
+        return cls(fast_token(ID_LEN))
 
     def hex(self) -> str:
         return self.binary.hex()

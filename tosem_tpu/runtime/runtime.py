@@ -34,7 +34,7 @@ import time
 import weakref
 from typing import Any, Dict, List, Optional, Set, Tuple
 
-from collections import OrderedDict
+from collections import OrderedDict, deque
 
 from tosem_tpu.chaos import hooks as _chaos
 from tosem_tpu.runtime import common
@@ -117,6 +117,23 @@ class _Worker:
         # placed in the group and runs nothing until the actor dies
         self.reserved_by: Optional[bytes] = None
         self.parked = False
+        # O(1) scheduling bookkeeping: membership in the runtime's
+        # per-pool idle deque (in_idle + which pool's deque), and a
+        # tombstone set when the worker leaves the pool so stale deque
+        # entries can be dropped lazily at pop time
+        self.in_idle = False
+        self.idle_key: Optional[bytes] = None
+        self.retired = False
+        # direct-send fast path: submitters may write this pipe
+        # themselves (outside the runtime lock) when nothing for the
+        # worker is queued on the sender thread. send_lock serializes
+        # pipe writers; nqueued (guarded by nq_lock, never held during a
+        # send) counts messages still owed by the sender thread — a
+        # direct write is allowed only at nqueued == 0, preserving
+        # per-worker FIFO between the two paths.
+        self.send_lock = threading.Lock()
+        self.nq_lock = threading.Lock()
+        self.nqueued = 0
 
     def load_key(self):
         """Dispatch preference: non-stalled first, then least loaded. A
@@ -215,9 +232,25 @@ class Runtime:
         self.lineage: "OrderedDict[bytes, _Lineage]" = OrderedDict()
         self._recon_attempts: Dict[bytes, int] = {}
         self._reconstructing: Set[bytes] = set()
-        # task state
+        # task state. Scheduling is indexed, not scanned (the fast path):
+        #  - pending: every undispatched spec, keyed by task_id
+        #  - _ready_q: per-placement-pool FIFO of dep-free stateless task
+        #    ids (key = spec.pg; None = the default pool)
+        #  - _waiters: dep object key → task_ids blocked on it; resolved
+        #    objects wake exactly their dependants (no pending scan)
+        #  - _idle: per-pool deque of workers with spare pipeline slots,
+        #    validated lazily at pop (stale entries are just dropped)
+        # so _dispatch_locked is O(ready tasks), not O(tasks × workers).
         self.specs: Dict[bytes, TaskSpec] = {}
-        self.pending: List[TaskSpec] = []        # FIFO, deps may be unresolved
+        self.pending: Dict[bytes, TaskSpec] = {}
+        self._ready_q: Dict[Optional[bytes], "deque[bytes]"] = {}
+        self._waiters: Dict[bytes, List[bytes]] = {}
+        self._idle: Dict[Optional[bytes], "deque[_Worker]"] = {}
+        self._enqueued_during_dispatch = False
+        # getters draining worker pipes themselves (see get()): while
+        # any are active the scheduler waits on process sentinels only,
+        # so every result doesn't wake two threads racing for the lock
+        self._active_getters = 0
         self.fn_blobs: Dict[bytes, bytes] = {}
         # task_ids carrying a deadline — keeps the per-tick expiry sweep
         # O(deadlined tasks), i.e. free for workloads that use none
@@ -235,8 +268,18 @@ class Runtime:
         self._pg_queue: List[Any] = []
         self._shutdown = False
         for _ in range(num_workers):
-            self.task_workers.append(_Worker(self.ctx, self.store_name))
+            w = _Worker(self.ctx, self.store_name)
+            self.task_workers.append(w)
+            self._push_idle_locked(w)
         M_WORKERS_ALIVE.set(len(self.task_workers))
+
+        # completion wake pipe (self-pipe trick): getters block on the
+        # worker pipes themselves, so a completion applied by ANOTHER
+        # thread (scheduler drain, deadline sweep, cancel) must still
+        # wake them — one nonblocking byte per completion event
+        self._wake_r, self._wake_w = os.pipe()
+        os.set_blocking(self._wake_r, False)
+        os.set_blocking(self._wake_w, False)
 
         self._sendq: "queue.SimpleQueue[Optional[Tuple[_Worker, tuple]]]" = \
             queue.SimpleQueue()
@@ -273,7 +316,7 @@ class Runtime:
     # ------------------------------------------------------------------ API
 
     def register_fn(self, blob: bytes) -> bytes:
-        fn_id = os.urandom(16)
+        fn_id = common.fast_token(16)
         with self.lock:
             self.fn_blobs[fn_id] = blob
         return fn_id
@@ -283,12 +326,12 @@ class Runtime:
                     pg: Optional[bytes] = None,
                     deadline_s: Optional[float] = None) -> ObjectRef:
         ref = self._new_ref()
-        spec = TaskSpec(task_id=os.urandom(16), fn_id=fn_id, method=None,
-                        actor_id=None, args=args, kwargs=kwargs,
+        spec = TaskSpec(task_id=common.fast_token(16), fn_id=fn_id,
+                        method=None, actor_id=None, args=args, kwargs=kwargs,
                         result_ref=ref,
                         retries_left=(self.max_task_retries
                                       if max_retries is None else max_retries),
-                        deps=self._unresolved_deps(args, kwargs), pg=pg,
+                        deps=set(), pg=pg,
                         deadline=(None if deadline_s is None
                                   else time.monotonic() + deadline_s))
         M_TASKS_SUBMITTED.inc()
@@ -301,19 +344,30 @@ class Runtime:
                 self.cv.notify_all()
                 return ref
             self.specs[spec.task_id] = spec
+            spec.deps = self._unresolved_deps_locked(args, kwargs)
+            direct = None
             if not spec.deps:
-                # fast path: straight onto the least-loaded eligible pipeline
-                w = min(self._eligible_locked(pg), key=_Worker.load_key,
-                        default=None)
-                if (w is not None and w.load_key()[0] == 0 and
-                        len(w.inflight) < common.MAX_INFLIGHT_PER_WORKER):
+                # fast path: straight onto an idle worker's pipeline.
+                # Re-index the worker UNCONDITIONALLY: on a send failure
+                # (e.g. an errored dependency raising at materialize)
+                # nothing was booked inflight, so no completion would
+                # ever re-index it — skipping the push would leak the
+                # worker out of the O(1) scheduler for good
+                w = self._pop_worker_locked(pg)
+                if w is not None:
                     try:
-                        self._send_task_locked(w, spec)
+                        direct = self._send_task_locked(w, spec,
+                                                        allow_direct=True)
                     except BaseException as e:
                         self._fail_task_locked(spec, e)
-                    return ref
-            self.pending.append(spec)
-            self._dispatch_locked()
+                    finally:
+                        self._push_idle_locked(w)
+                else:
+                    self._enqueue_ready_locked(spec)
+            else:
+                self._index_deps_locked(spec)
+        if direct is not None:
+            self._direct_send(w, direct)
         return ref
 
     def create_actor(self, cls_blob_args: bytes, max_restarts: int,
@@ -321,7 +375,7 @@ class Runtime:
                      restore_state: bool = False,
                      snapshot_every: int = common.ACTOR_SNAPSHOT_EVERY
                      ) -> bytes:
-        actor_id = os.urandom(16)
+        actor_id = common.fast_token(16)
         M_ACTORS.inc(labels=["created"])
         # ONE lock hold for slot consumption + actor registration: a gap
         # between them would let a concurrent remove_placement_group miss
@@ -398,9 +452,10 @@ class Runtime:
                         free = [w for w in self.task_workers
                                 if w.reserved_by is None]
                         if len(free) >= n_slots:
-                            pg_id = os.urandom(16)
+                            pg_id = common.fast_token(16)
                             for w in free[:n_slots]:
                                 w.reserved_by = pg_id
+                                self._reindex_idle_locked(w)
                             self.placement_groups[pg_id] = {
                                 "n_slots": n_slots, "strategy": strategy,
                                 "actors": set()}
@@ -420,42 +475,171 @@ class Runtime:
 
     def remove_placement_group(self, pg_id: bytes) -> None:
         """Release the gang's workers. Actors placed in the group are
-        killed (the reference's remove_placement_group semantics)."""
-        with self.lock:
+        killed (the reference's remove_placement_group semantics).
+
+        One critical section for record removal + worker release, so a
+        concurrent reader can never observe reserved workers whose group
+        record is already gone (the reservation accounting invariant
+        ``sum(reserved) == sum(booked slots)`` holds at every instant).
+        """
+        with self.cv:
             rec = self.placement_groups.pop(pg_id, None)
             if rec is None:
                 return
-            actors = list(rec["actors"])
-        for aid in actors:
-            self.kill_actor(aid)
-        with self.cv:
+            for aid in list(rec["actors"]):
+                self.kill_actor(aid)     # re-entrant (RLock)
             for w in self.task_workers:
                 if w.reserved_by == pg_id:
                     w.reserved_by = None
                     w.parked = False
+                    self._reindex_idle_locked(w)
             # pending tasks tagged with the dead group can never run
-            for spec in [s for s in self.pending if s.pg == pg_id]:
+            # (blocked or ready alike — _fail pops them from pending;
+            # their ready-queue ids go stale and the queue is dropped)
+            self._ready_q.pop(pg_id, None)
+            self._idle.pop(pg_id, None)
+            for spec in [s for s in self.pending.values()
+                         if s.pg == pg_id]:
                 self._fail_task_locked(spec, ValueError(
                     "placement group was removed"))
-            self.pending = [s for s in self.pending if s.pg != pg_id]
             self.cv.notify_all()
             self._dispatch_locked()
 
-    def _eligible_locked(self, spec_pg: Optional[bytes]) -> List[_Worker]:
-        """Workers a task tagged ``spec_pg`` may run on."""
-        if spec_pg is None:
-            return [w for w in self.task_workers if w.reserved_by is None]
-        return [w for w in self.task_workers
-                if w.reserved_by == spec_pg and not w.parked]
+    # ------------------------------------------- O(1) scheduling indexes
+
+    def _push_idle_locked(self, w: _Worker) -> None:
+        """Index ``w`` as dispatchable in its pool (idempotent).
+
+        Hot-stack order: a fully idle worker goes to the pop end (its
+        process is hot — reusing it keeps sync round-trip latency low,
+        matching the old least-loaded pick), a worker that still has
+        tasks in flight goes to the far end (so bursts spread across
+        idle workers before pipelining onto busy ones)."""
+        if (w.in_idle or w.retired or w.parked or w.actor_id is not None
+                or len(w.inflight) >= common.MAX_INFLIGHT_PER_WORKER):
+            return
+        w.in_idle = True
+        w.idle_key = w.reserved_by
+        q = self._idle.setdefault(w.reserved_by, deque())
+        if w.inflight:
+            q.appendleft(w)
+        else:
+            q.append(w)
+
+    def _pop_worker_locked(self, key: Optional[bytes]) -> Optional[_Worker]:
+        """Next dispatchable worker of pool ``key`` (idle-hot first),
+        or None. Stale entries (retired / re-reserved / parked / full
+        pipeline) are dropped; a stalled worker is skipped and re-indexed
+        when it next makes progress (completion pushes it back)."""
+        q = self._idle.get(key)
+        if not q:
+            return None
+        for _ in range(len(q)):
+            w = q.pop()
+            w.in_idle = False
+            if (w.retired or w.parked or w.reserved_by != key
+                    or len(w.inflight) >= common.MAX_INFLIGHT_PER_WORKER):
+                continue
+            if w.load_key()[0] != 0:
+                continue  # stalled: steal path works around it
+            return w
+        return None
+
+    def _reindex_idle_locked(self, w: _Worker) -> None:
+        """Move ``w`` to the idle deque matching its (possibly changed)
+        reservation. Rare event (placement-group create/remove)."""
+        if w.in_idle and w.idle_key != w.reserved_by:
+            try:
+                self._idle[w.idle_key].remove(w)
+            except (KeyError, ValueError):
+                pass
+            w.in_idle = False
+        self._push_idle_locked(w)
+
+    def _enqueue_ready_locked(self, spec: TaskSpec, front: bool = False)\
+            -> None:
+        """Queue a dep-free stateless spec for dispatch (FIFO per pool;
+        ``front=True`` for requeues — stolen/replayed/reconstruction
+        work runs before fresh submissions, as the old list did with
+        ``insert(0)``)."""
+        self.pending[spec.task_id] = spec
+        self._enqueued_during_dispatch = True
+        q = self._ready_q.setdefault(spec.pg, deque())
+        if front:
+            q.appendleft(spec.task_id)
+        else:
+            q.append(spec.task_id)
+
+    def _index_deps_locked(self, spec: TaskSpec) -> None:
+        """Register an undispatched spec with unresolved deps: each dep
+        key wakes exactly this spec when it resolves."""
+        self.pending[spec.task_id] = spec
+        for d in spec.deps:
+            self._waiters.setdefault(d.oid.binary, []).append(spec.task_id)
+
+    def _dispatch_unblocked_locked(self, spec: TaskSpec,
+                                   front: bool = False,
+                                   ready_stack: Optional[List[bytes]]
+                                   = None) -> None:
+        """Route a dep-free undispatched spec: actor specs go straight
+        to their (ordered) pipe or fail if the actor is gone, stateless
+        specs join their pool's ready queue. Shared by re-admission and
+        the publish wake path so the two cannot diverge."""
+        if spec.actor_id is not None:
+            rec = self.actors.get(spec.actor_id)
+            if rec is None or rec.dead:
+                self._fail_task_locked(spec, ActorDiedError("actor died"),
+                                       ready_stack=ready_stack)
+                return
+            try:
+                self._send_task_locked(rec.worker, spec)
+            except BaseException as e:
+                self._fail_task_locked(spec, e, ready_stack=ready_stack)
+            return
+        self._enqueue_ready_locked(spec, front=front)
+
+    def _admit_spec_locked(self, spec: TaskSpec, front: bool = False)\
+            -> None:
+        """(Re-)admit an undispatched spec: waiter-index unresolved deps
+        or queue it ready. Requeue paths (steal, death replay, lost-dep
+        recovery, reconstruction) land here."""
+        spec.deps = {d for d in spec.deps
+                     if not self._ready_locked(d.oid.binary)}
+        if spec.deps:
+            self._index_deps_locked(spec)
+            return
+        self._dispatch_unblocked_locked(spec, front=front)
+
+    def _publish_ready_locked(self, key: bytes) -> None:
+        """An object (result or error) for ``key`` is now available:
+        wake exactly its waiting dependants. Iterative — failing a
+        dependant publishes ITS result error onto the same worklist, so
+        a deep error cascade cannot overflow the stack."""
+        if key not in self._waiters:
+            return
+        stack = [key]
+        while stack:
+            for tid in self._waiters.pop(stack.pop(), ()):
+                spec = self.pending.pop(tid, None)
+                if spec is None or tid not in self.specs:
+                    continue
+                spec.deps = {d for d in spec.deps
+                             if not self._ready_locked(d.oid.binary)}
+                if spec.deps:
+                    # still blocked: keep waiting (its remaining deps
+                    # are already waiter-indexed from admission)
+                    self.pending[tid] = spec
+                    continue
+                self._dispatch_unblocked_locked(spec, ready_stack=stack)
 
     def submit_actor_call(self, actor_id: bytes, method: str, args: tuple,
                           kwargs: dict,
                           deadline_s: Optional[float] = None) -> ObjectRef:
         ref = self._new_ref()
-        spec = TaskSpec(task_id=os.urandom(16), fn_id=None, method=method,
+        spec = TaskSpec(task_id=common.fast_token(16),
+                        fn_id=None, method=method,
                         actor_id=actor_id, args=args, kwargs=kwargs,
-                        result_ref=ref, retries_left=0,
-                        deps=self._unresolved_deps(args, kwargs),
+                        result_ref=ref, retries_left=0, deps=set(),
                         deadline=(None if deadline_s is None
                                   else time.monotonic() + deadline_s))
         with self.lock:
@@ -467,15 +651,20 @@ class Runtime:
             if spec.deadline is not None:
                 self.deadlined.add(spec.task_id)
             self.specs[spec.task_id] = spec
+            spec.deps = self._unresolved_deps_locked(args, kwargs)
+            direct = None
+            w = rec.worker
             if not spec.deps:
                 # fast path: the actor's pipe IS its ordered queue
                 try:
-                    self._send_task_locked(rec.worker, spec)
+                    direct = self._send_task_locked(w, spec,
+                                                    allow_direct=True)
                 except BaseException as e:
                     self._fail_task_locked(spec, e)
-                return ref
-            self.pending.append(spec)
-            self._dispatch_locked()
+            else:
+                self._index_deps_locked(spec)
+        if direct is not None:
+            self._direct_send(w, direct)
         return ref
 
     def _unpark_for_actor_locked(self, actor_id: bytes) -> None:
@@ -486,6 +675,7 @@ class Runtime:
                 for w in self.task_workers:
                     if w.reserved_by == pg_id and w.parked:
                         w.parked = False
+                        self._push_idle_locked(w)
                         break
                 self.cv.notify_all()
                 return
@@ -500,14 +690,15 @@ class Runtime:
             # fail everything in flight or queued NOW — once dead the
             # scheduler stops watching this worker, so nothing else will
             for tid in list(rec.worker.inflight):
-                spec = self.specs.pop(tid, None)
-                if spec:
-                    self.errors[spec.result_ref.oid.binary] = ActorDiedError(
-                        "actor was killed")
+                spec = self.specs.get(tid)
+                if spec is not None:
+                    self._fail_task_locked(spec,
+                                           ActorDiedError("actor was killed"))
             rec.worker.inflight.clear()
             self._fail_actor_tasks_locked(actor_id,
                                           ActorDiedError("actor was killed"))
             rec.worker.kill()
+            self._dispatch_locked()
 
     def cancel(self, ref: ObjectRef) -> None:
         """Cancel the task producing ``ref`` (``ray.cancel(force=True)``).
@@ -545,9 +736,10 @@ class Runtime:
             if self._ready_locked(key) or spec.task_id not in self.specs:
                 return  # completed during the drain
             self.specs.pop(spec.task_id, None)
-            self.pending = [s for s in self.pending
-                            if s.task_id != spec.task_id]
+            self.pending.pop(spec.task_id, None)
+            self.deadlined.discard(spec.task_id)
             self.errors[key] = TaskCancelledError("task was cancelled")
+            self._publish_ready_locked(key)
             self.cv.notify_all()
             # re-locate the task: the drain may have re-homed it (worker
             # died mid-drain → death handler re-queued and re-dispatched
@@ -571,15 +763,17 @@ class Runtime:
             # in-flight tasks free of charge
             if target in self.task_workers:
                 self.task_workers.remove(target)
+                target.retired = True
                 for tid in reversed(target.inflight):
                     s = self.specs.get(tid)
                     if s is not None:
-                        self.pending.insert(0, s)
+                        self._admit_spec_locked(s, front=True)
                 target.inflight.clear()
                 target.kill()
                 if not self._shutdown:
-                    self.task_workers.append(
-                        _Worker(self._make_ctx(), self.store_name))
+                    repl = _Worker(self._make_ctx(), self.store_name)
+                    self.task_workers.append(repl)
+                    self._push_idle_locked(repl)
                 M_WORKERS_ALIVE.set(len(self.task_workers))
                 self._dispatch_locked()
 
@@ -606,32 +800,81 @@ class Runtime:
 
     def get(self, ref: ObjectRef, timeout: Optional[float] = None) -> Any:
         key = ref.oid.binary
+        # fast path: one lock hold, one dict probe — the overwhelmingly
+        # common case of getting an already-resolved inline object (the
+        # RAW-bytes case is unpacked here: parts[0] is already the
+        # immutable value, no loads_parts frame needed)
+        with self.lock:
+            entry = self.inline.get(key)
+        if entry is not None:
+            kind, parts = entry
+            if kind == common._RAW:
+                return bytes(parts[0])
+            return common.loads_parts(kind, parts)
         deadline = None if timeout is None else time.monotonic() + timeout
         while True:
-            with self.cv:
-                while not self._ready_locked(key):
-                    remaining = (None if deadline is None
-                                 else deadline - time.monotonic())
-                    if remaining is not None and remaining <= 0:
-                        # a timed-out waiter holds nothing: any
-                        # reconstruction it triggered keeps running and
-                        # re-publishes the object, so a later get()
-                        # succeeds (no permanently-in-flight ref)
-                        raise TimeoutError(f"get({ref!r}) timed out")
-                    self.cv.wait(remaining)
+            with self.lock:
                 if key in self.errors:
                     raise self.errors[key]
-                if key in self.inline:
-                    return common.loads_parts(*self.inline[key])
-            found, value = common.store_get_value(self.store, ref.oid)
-            if found:
-                return value
-            # lost from the store (evicted / producing worker died
-            # before the driver learned): heal through lineage, then
-            # loop back and wait for the re-derived object
-            err = self._begin_reconstruction(key)
-            if err is not None:
-                raise err
+                entry = self.inline.get(key)
+                if entry is not None:
+                    return common.loads_parts(*entry)
+                stored = key in self.in_store
+                if not stored:
+                    watch = list(self.task_workers)
+                    watch += [r.worker for r in self.actors.values()
+                              if not r.dead]
+            if stored:
+                found, value = common.store_get_value(self.store, ref.oid)
+                if found:
+                    return value
+                # lost from the store (evicted / producing worker died
+                # before the driver learned): heal through lineage, then
+                # loop back and wait for the re-derived object
+                err = self._begin_reconstruction(key)
+                if err is not None:
+                    raise err
+                continue
+            remaining = (None if deadline is None
+                         else deadline - time.monotonic())
+            if remaining is not None and remaining <= 0:
+                # a timed-out waiter holds nothing: any
+                # reconstruction it triggered keeps running and
+                # re-publishes the object, so a later get()
+                # succeeds (no permanently-in-flight ref)
+                raise TimeoutError(f"get({ref!r}) timed out")
+            # Block on the worker pipes themselves (+ the completion
+            # wake pipe): an arriving result wakes THIS thread directly,
+            # skipping the scheduler→condvar→getter double hop that
+            # dominated sync round-trip latency. The short cap bounds
+            # staleness of the pipe snapshot (worker churn) and covers
+            # completion paths with no pipe traffic.
+            step = 0.05 if remaining is None else min(remaining, 0.05)
+            with self.lock:
+                self._active_getters += 1
+            try:
+                ready = mpc.wait([w.conn for w in watch] + [self._wake_r],
+                                 timeout=step)
+            except (OSError, ValueError):
+                time.sleep(0.01)   # a watched pipe died mid-wait
+                continue
+            finally:
+                with self.lock:
+                    self._active_getters -= 1
+            if not ready:
+                continue
+            if self._wake_r in ready:
+                try:
+                    while os.read(self._wake_r, 4096):
+                        pass
+                except (BlockingIOError, OSError):
+                    pass
+            by_conn = {w.conn: w for w in watch}
+            with self.lock:
+                for obj in ready:
+                    w = by_conn.get(obj)
+                    if w is not None:
+                        self._drain_conn_locked(w)
 
     def wait(self, refs: List[ObjectRef], num_returns: int,
              timeout: Optional[float]
@@ -663,10 +906,11 @@ class Runtime:
         with self.lock:
             # only dep-resolved stateless tasks can drain onto new task
             # workers — dep-blocked or actor-bound work must not drive
-            # up-scaling (it wouldn't dispatch to the added workers)
-            ready = sum(1 for s in self.pending
-                        if s.actor_id is None
-                        and not self._unresolved_deps(s.args, s.kwargs))
+            # up-scaling (it wouldn't dispatch to the added workers);
+            # the ready queues hold exactly those (skipping stale ids)
+            ready = sum(1 for q in self._ready_q.values()
+                        for tid in q
+                        if tid in self.pending and tid in self.specs)
             return {
                 "num_workers": len(self.task_workers),
                 "pending": len(self.pending),
@@ -685,8 +929,10 @@ class Runtime:
             # since (fork → spawn re-pick, see __init__)
             w = _Worker(self._make_ctx(), self.store_name)
             self.task_workers.append(w)
+            self._push_idle_locked(w)
             M_WORKERS_ALIVE.set(len(self.task_workers))
             self.cv.notify_all()
+            self._dispatch_locked()
             return w.wid
 
     def remove_idle_worker(self) -> bool:
@@ -699,6 +945,7 @@ class Runtime:
             for i, w in enumerate(self.task_workers):
                 if not w.inflight and w.reserved_by is None:
                     self.task_workers.pop(i)
+                    w.retired = True     # idle-deque entries go stale
                     M_WORKERS_ALIVE.set(len(self.task_workers))
                     victim = w
                     break
@@ -737,6 +984,11 @@ class Runtime:
             w.proc.join(timeout=1.0)
             if w.proc.is_alive():
                 w.kill()
+        for fd in (self._wake_r, self._wake_w):
+            try:
+                os.close(fd)
+            except OSError:
+                pass
         self.store.close()
 
     # ------------------------------------------------------------ internals
@@ -832,15 +1084,15 @@ class Runtime:
         for k in planned:
             lin = self.lineage[k]
             spec = TaskSpec(
-                task_id=os.urandom(16), fn_id=lin.fn_id, method=None,
+                task_id=common.fast_token(16), fn_id=lin.fn_id, method=None,
                 actor_id=None, args=lin.args, kwargs=lin.kwargs,
                 # driver-internal ref: deliberately NO finalizer (the
                 # user's original ObjectRef owns this entry's lifetime)
                 result_ref=ObjectRef(ObjectID(k)),
                 retries_left=self.max_task_retries,
-                deps=self._unresolved_deps(lin.args, lin.kwargs))
+                deps=self._unresolved_deps_locked(lin.args, lin.kwargs))
             self.specs[spec.task_id] = spec
-            self.pending.insert(0, spec)
+            self._admit_spec_locked(spec, front=True)
             M_RECONSTRUCTIONS.inc()
         self._dispatch_locked()
         return None
@@ -907,33 +1159,108 @@ class Runtime:
             if self._start_reconstruction_locked(dkey) is not None:
                 return False
         spec.deps = {ObjectRef(ObjectID(dkey))}
-        self.pending.insert(0, spec)
+        self._admit_spec_locked(spec, front=True)
         self.cv.notify_all()
         self._dispatch_locked()
         return True
 
+    def _wake_getters(self) -> None:
+        """One nonblocking byte on the completion wake pipe: unblocks
+        any getter waiting in ``mpc.wait`` on pipes with no traffic
+        (the completion was applied by a different thread). A full pipe
+        just means wakeups are already pending — dropped safely.
+
+        Skipped when no getter is blocked (callers hold the lock, so
+        ``_active_getters`` is exact): in the common case the completer
+        IS the getter — it already left its wait before draining, and a
+        stray byte would cost it a spurious wake/read cycle on its very
+        next get."""
+        if not self._active_getters:
+            return
+        try:
+            os.write(self._wake_w, b"\0")
+        except (BlockingIOError, OSError):
+            pass
+
     def _send(self, w: _Worker, msg: tuple) -> None:
         """Queue a pipe write for the sender thread (never blocks)."""
+        with w.nq_lock:
+            w.nqueued += 1
         self._sendq.put((w, msg))
 
+    def _direct_send(self, w: _Worker, msg: tuple) -> None:
+        """Write ``msg`` to ``w``'s pipe from the calling thread — the
+        sync-latency fast path: no sender-thread hop (one fewer GIL
+        handoff per dispatch). MUST be called WITHOUT the runtime lock
+        (a blocking pipe write under the lock could deadlock against the
+        draining scheduler). Falls back to the queue whenever the worker
+        has queued messages or its pipe is busy (FIFO preserved)."""
+        if w.send_lock.acquire(blocking=False):
+            try:
+                with w.nq_lock:
+                    clear = w.nqueued == 0
+                if clear:
+                    try:
+                        w.conn.send(msg)
+                    except Exception:
+                        pass  # dead worker: sentinel handling replays
+                    return
+            finally:
+                w.send_lock.release()
+        self._send(w, msg)
+
     def _sender_loop(self) -> None:
+        """Drain the send queue and coalesce per-worker runs into one
+        ``("batch", [msgs])`` pipe write — batched pipe I/O: a burst of
+        N task submissions costs one syscall per worker, not N. Per-
+        worker FIFO order is preserved (groups are built in scan order);
+        cross-worker order was never guaranteed."""
         while True:
             item = self._sendq.get()
-            if item is None:
+            stop = False
+            groups: "OrderedDict[_Worker, list]" = OrderedDict()
+            while True:
+                if item is None:
+                    stop = True
+                    break
+                w, msg = item
+                groups.setdefault(w, []).append(msg)
+                try:
+                    item = self._sendq.get_nowait()
+                except queue.Empty:
+                    break
+            for w, msgs in groups.items():
+                # send under the worker's pipe lock (serializes with
+                # direct senders), then retire the owed-message count —
+                # decrementing only after the write keeps the direct
+                # path closed until the pipe really is caught up
+                with w.send_lock:
+                    try:
+                        if len(msgs) == 1:
+                            w.conn.send(msgs[0])
+                        else:
+                            w.conn.send(("batch", msgs))
+                    except Exception:
+                        pass  # dead worker: sentinel handling replays
+                with w.nq_lock:
+                    w.nqueued -= len(msgs)
+            if stop:
                 return
-            w, msg = item
-            try:
-                w.conn.send(msg)
-            except Exception:
-                pass  # dead worker: sentinel handling replays its tasks
 
     def _unresolved_deps(self, args, kwargs) -> Set[ObjectRef]:
-        deps = set()
         with self.lock:
-            for v in list(args) + list(kwargs.values()):
-                if isinstance(v, ObjectRef) and \
-                        not self._ready_locked(v.oid.binary):
-                    deps.add(v)
+            return self._unresolved_deps_locked(args, kwargs)
+
+    def _unresolved_deps_locked(self, args, kwargs) -> Set[ObjectRef]:
+        deps = set()
+        for v in args:
+            if isinstance(v, ObjectRef) and \
+                    not self._ready_locked(v.oid.binary):
+                deps.add(v)
+        for v in kwargs.values():
+            if isinstance(v, ObjectRef) and \
+                    not self._ready_locked(v.oid.binary):
+                deps.add(v)
         return deps
 
     def _ready_locked(self, key: bytes) -> bool:
@@ -944,63 +1271,84 @@ class Runtime:
 
         Like the reference, only *top-level* args are resolved
         (``direct_task_transport.cc`` dependency resolver behaviour).
+        Zero-copy: an inline object is forwarded in its already-
+        serialized ``(kind, parts)`` form — no ``loads_parts`` +
+        re-``dumps`` per dispatch; the worker deserializes once (which
+        copies, so the value never aliases driver state).
         """
         if not isinstance(v, ObjectRef):
             return v
         key = v.oid.binary
         if key in self.errors:
             raise self.errors[key]
-        if key in self.inline:
-            return common.loads_parts(*self.inline[key])
+        entry = self.inline.get(key)
+        if entry is not None:
+            return common.InlineParts(entry[0], entry[1])
         return StoreRef(key)
 
     def _dispatch_locked(self) -> None:
-        """Push ready pending tasks to idle workers (FIFO)."""
+        """Push ready tasks to idle workers: O(dispatched + stale ids),
+        with no scan of blocked tasks or the worker list. The outer loop
+        re-snapshots because failing a task mid-dispatch can publish its
+        error and wake dependants into a queue already visited."""
         if self._shutdown:
             return
-        still_pending: List[TaskSpec] = []
-        for spec in self.pending:
-            if spec.task_id not in self.specs:
-                continue  # completed elsewhere (e.g. stolen copy finished)
-            spec.deps = {d for d in spec.deps
-                         if not self._ready_locked(d.oid.binary)}
-            target: Optional[_Worker] = None
-            if spec.deps:
-                still_pending.append(spec)
-                continue
-            if spec.actor_id is not None:
-                rec = self.actors.get(spec.actor_id)
-                if rec is None or rec.dead:
-                    self._fail_task_locked(spec, ActorDiedError("actor died"))
-                    continue
-                target = rec.worker     # actor calls are ordered on its pipe
-            else:
-                w = min(self._eligible_locked(spec.pg),
-                        key=_Worker.load_key, default=None)
-                target = (w if w is not None and w.load_key()[0] == 0 and
-                          len(w.inflight) < common.MAX_INFLIGHT_PER_WORKER
-                          else None)
-            if target is None:
-                still_pending.append(spec)
-                continue
-            try:
-                self._send_task_locked(target, spec)
-            except BaseException as e:  # a dep errored → propagate to result
-                self._fail_task_locked(spec, e)
-        self.pending = still_pending
+        while True:
+            self._enqueued_during_dispatch = False
+            for key in list(self._ready_q):
+                q = self._ready_q.get(key)
+                while q:
+                    spec = self.pending.get(q[0])
+                    if spec is None or spec.task_id not in self.specs:
+                        tid = q.popleft()   # stale: cancelled/expired/failed
+                        self.pending.pop(tid, None)
+                        continue
+                    w = self._pop_worker_locked(key)
+                    if w is None:
+                        break               # pool saturated; next pool
+                    q.popleft()
+                    self.pending.pop(spec.task_id, None)
+                    try:
+                        self._send_task_locked(w, spec)
+                    except BaseException as e:  # a dep errored → propagate
+                        self._fail_task_locked(spec, e)
+                    self._push_idle_locked(w)
+                if not q:
+                    self._ready_q.pop(key, None)
+            if not self._enqueued_during_dispatch:
+                return
 
-    def _send_task_locked(self, w: _Worker, spec: TaskSpec) -> None:
+    def _send_task_locked(self, w: _Worker, spec: TaskSpec,
+                          allow_direct: bool = False) -> Optional[tuple]:
+        """Book ``spec`` onto ``w`` and ship (or hand back) its message.
+
+        With ``allow_direct=True`` and no companion control message
+        (fn registration, snapshot request), the task message is
+        RETURNED instead of queued — the caller sends it via
+        :meth:`_direct_send` after releasing the runtime lock (the
+        sync-latency fast path). All bookkeeping happens here either
+        way, so the two paths cannot diverge.
+        """
         args = tuple(self._materialize_arg(a) for a in spec.args)
         kwargs = {k: self._materialize_arg(v) for k, v in spec.kwargs.items()}
-        blob = common.dumps((args, kwargs))
+        blob = common.dumps_args((args, kwargs))
+        # direct pipe writes only pay off for a latency-sensitive single
+        # dispatch onto an idle worker; under a burst (worker already has
+        # work in flight) the coalescing sender thread wins by an order
+        # of magnitude — one pipe write per batch, not per task
+        allow_direct = allow_direct and not w.inflight
+        direct: Optional[tuple] = None
         if spec.actor_id is not None:
-            self._send(w, ("actor_call", spec.task_id, spec.method,
-                           spec.result_ref.oid.binary, blob))
+            msg = ("actor_call", spec.task_id, spec.method,
+                   spec.result_ref.oid.binary, blob)
             rec = self.actors.get(spec.actor_id)
             if rec is not None and rec.restore_state and rec.worker is w:
                 # record the call for replay-on-restart; the pipe is
                 # FIFO, so a snapshot requested now covers exactly the
-                # calls sent so far (cutoff = current send ordinal)
+                # calls sent so far (cutoff = current send ordinal).
+                # restore_state actors always ride the queue: the
+                # snapshot request MUST follow this call on the pipe
+                self._send(w, msg)
                 rec.call_seq += 1
                 rec.replay_log.append((rec.call_seq, spec.method, blob))
                 if rec.snapshot_unavailable:
@@ -1009,13 +1357,24 @@ class Runtime:
                         and len(rec.replay_log) >= rec.snapshot_every):
                     rec.snapshot_cutoff = rec.call_seq
                     self._send(w, ("actor_snapshot",))
+            elif allow_direct:
+                direct = msg
+            else:
+                self._send(w, msg)
         else:
+            msg = ("task", spec.task_id, spec.fn_id,
+                   spec.result_ref.oid.binary, blob)
             if spec.fn_id not in w.known_fns:
+                # registration must precede the task on the pipe, so
+                # both ride the (FIFO) sender queue together
                 self._send(w, ("reg_fn", spec.fn_id,
                                self.fn_blobs[spec.fn_id]))
                 w.known_fns.add(spec.fn_id)
-            self._send(w, ("task", spec.task_id, spec.fn_id,
-                           spec.result_ref.oid.binary, blob))
+                self._send(w, msg)
+            elif allow_direct:
+                direct = msg
+            else:
+                self._send(w, msg)
         if not w.inflight:
             # head task starts now — an idle worker isn't "stalled"
             w.last_progress = time.monotonic()
@@ -1027,35 +1386,57 @@ class Runtime:
             # chaos: the worker dies mid-task; the sentinel/heartbeat
             # path replays its in-flight work (charging a retry)
             w.kill()
+        return direct
 
-    def _fail_task_locked(self, spec: TaskSpec, err: BaseException) -> None:
-        self.errors[spec.result_ref.oid.binary] = err
-        self._reconstructing.discard(spec.result_ref.oid.binary)
+    def _fail_task_locked(self, spec: TaskSpec, err: BaseException,
+                          ready_stack: Optional[List[bytes]] = None) -> None:
+        rkey = spec.result_ref.oid.binary
+        self.errors[rkey] = err
+        self._reconstructing.discard(rkey)
         self.specs.pop(spec.task_id, None)
+        self.pending.pop(spec.task_id, None)
+        self.deadlined.discard(spec.task_id)
         M_TASKS_FINISHED.inc(labels=[type(err).__name__])
+        # the error IS this ref's result: wake dependants (either onto
+        # the caller's in-progress publish worklist, or directly)
+        if ready_stack is not None:
+            ready_stack.append(rkey)
+        else:
+            self._publish_ready_locked(rkey)
+        self._wake_getters()
         self.cv.notify_all()
 
     def _complete_locked(self, w: _Worker, tid: bytes, kind: str,
-                         payload) -> None:
+                         payload, defer: bool = False) -> None:
+        """Apply one task completion. ``defer=True`` (batch drain) skips
+        the per-result notify/dispatch — the caller does both once per
+        drained batch."""
         if tid in w.inflight:
             w.inflight.remove(tid)
+            self._push_idle_locked(w)
         spec = self.specs.pop(tid, None)
         if spec is None:
             return
+        self.deadlined.discard(tid)
         rkey = spec.result_ref.oid.binary
         if kind == "inline":
             self.inline[rkey] = payload
-        elif kind == "store":
-            self.in_store.add(rkey)
+        if kind == "inline" or kind == "store":
+            if kind == "store":
+                self.in_store.add(rkey)
             if spec.fn_id is not None:
                 # remember how to re-derive this object (lineage);
                 # bounded FIFO — an evicted entry's object can no longer
-                # be reconstructed, only re-read while it survives
+                # be reconstructed, only re-read while it survives.
+                # Inline results get lineage too: they cannot be lost
+                # from the driver table, but recording the producer keeps
+                # the healing bookkeeping uniform (PR 2 guarantees)
                 self.lineage[rkey] = _Lineage(spec.fn_id, spec.args,
                                               spec.kwargs)
                 self.lineage.move_to_end(rkey)
                 while len(self.lineage) > common.MAX_LINEAGE_ENTRIES:
                     self.lineage.popitem(last=False)
+        if kind == "store":
             act = _chaos.fire("runtime.store")
             if act is not None and act["action"] == "evict_object":
                 # chaos: memory-pressure eviction of a sealed result —
@@ -1068,8 +1449,10 @@ class Runtime:
                     pass
         self._reconstructing.discard(rkey)
         M_TASKS_FINISHED.inc(labels=["ok"])
-        self.cv.notify_all()
-        if self.pending:
+        self._publish_ready_locked(rkey)
+        self._wake_getters()
+        if not defer:
+            self.cv.notify_all()
             self._dispatch_locked()
 
     def _scheduler_loop(self) -> None:
@@ -1081,8 +1464,13 @@ class Runtime:
                     r.worker for r in self.actors.values() if not r.dead]
                 conn_by_fd = {w.conn: w for w in workers}
                 sent_by_fd = {w.proc.sentinel: w for w in workers}
+                # active getters drain the pipes themselves: watch only
+                # the sentinels then, so one result doesn't wake two
+                # threads racing for the same lock and messages
+                wait_conns = ([] if self._active_getters
+                              else list(conn_by_fd))
             try:
-                ready = mpc.wait(list(conn_by_fd) + list(sent_by_fd),
+                ready = mpc.wait(wait_conns + list(sent_by_fd),
                                  timeout=common.HEARTBEAT_INTERVAL_S)
             except OSError:
                 ready = []
@@ -1137,17 +1525,14 @@ class Runtime:
         if not expired:
             return
         for spec in expired:
-            self.specs.pop(spec.task_id, None)
-            self.errors[spec.result_ref.oid.binary] = DeadlineExceeded(
-                "task exceeded its deadline before completing")
-            M_TASKS_FINISHED.inc(labels=["DeadlineExceeded"])
             # NOTE: the task_id stays in its worker's inflight list — the
             # worker really is still grinding it, and lying about that
             # would route fresh tasks onto a busy/hung worker. The entry
             # clears when the late done/err arrives (spec already gone →
             # discarded), and a never-finishing task keeps the worker
             # marked stalled so the steal path works around it.
-        self.pending = [s for s in self.pending if s.task_id in self.specs]
+            self._fail_task_locked(spec, DeadlineExceeded(
+                "task exceeded its deadline before completing"))
         self.cv.notify_all()
         self._dispatch_locked()
 
@@ -1170,105 +1555,123 @@ class Runtime:
                 for tid in reversed(stolen):
                     spec = self.specs.get(tid)
                     if spec is not None:
-                        self.pending.insert(0, spec)
+                        self._admit_spec_locked(spec, front=True)
                         stole = True
         if stole:
             self._dispatch_locked()
 
     def _drain_conn_locked(self, w: _Worker) -> None:
+        """Drain EVERY pending message from one worker pipe under the
+        single already-held lock acquisition — batched pipe I/O's receive
+        half: one ``cv.notify_all`` and one dispatch per drained batch,
+        not per result. Workers may coalesce results into a
+        ``("batch", [msgs])`` envelope; it is unpacked here in order."""
+        dirty = False
         try:
             while w.conn.poll():
                 msg = w.conn.recv()
-                kind = msg[0]
-                if kind == "ready":
-                    w.ready = True
-                    self._dispatch_locked()
-                elif kind == "done":
-                    _, tid, rkind, payload = msg
-                    act = _chaos.fire("runtime.result",
-                                      target="actor" if w.actor_id
-                                      else "task", worker=w.wid)
-                    if act is not None and act["action"] == "drop_result":
-                        # chaos: the completion message is lost in
-                        # transit AND the worker dies — the death
-                        # handler replays the task (at-least-once,
-                        # like the reference's retry semantics)
-                        w.kill()
-                        return
-                    if act is not None and act["action"] == "delay_result":
-                        # chaos: the message is in-flight for delay_s —
-                        # parked for later delivery, NOT slept on (this
-                        # code runs under the runtime lock; sleeping here
-                        # would freeze the whole scheduler, which is a
-                        # different fault than "one result delayed")
-                        self._delayed_results.append(
-                            (time.monotonic() + act["delay_s"], w,
-                             (tid, rkind, payload)))
-                        continue
-                    w.last_progress = time.monotonic()
-                    self._complete_locked(w, tid, rkind, payload)
-                elif kind == "err":
-                    _, tid, blob, tb = msg
-                    w.last_progress = time.monotonic()
-                    if tid in w.inflight:
-                        w.inflight.remove(tid)
-                    spec = self.specs.get(tid)
-                    if spec is not None:
-                        try:
-                            cause = common.loads(blob)
-                        except Exception as e:  # undeserializable exception
-                            cause = RuntimeError(f"(unpicklable) {e}")
-                        if (isinstance(cause, DependencyLostError)
-                                and spec.actor_id is None
-                                and self._recover_lost_dep_locked(spec,
-                                                                  cause)):
-                            continue   # dep rebuilt, task requeued
-                        self.specs.pop(tid, None)
-                        self.errors[spec.result_ref.oid.binary] = \
-                            TaskError(cause, tb)
-                        self._reconstructing.discard(
-                            spec.result_ref.oid.binary)
-                        self.cv.notify_all()
-                    self._dispatch_locked()
-                elif kind == "snapshot":
-                    _, blob = msg
-                    rec = self.actors.get(w.actor_id)
-                    if rec is not None and rec.worker is w:
-                        rec.snapshot_blob = blob
-                        cutoff = rec.snapshot_cutoff or 0
-                        rec.snapshot_cutoff = None
-                        rec.replay_log = [e for e in rec.replay_log
-                                          if e[0] > cutoff]
-                elif kind == "snapshot_err":
-                    rec = self.actors.get(w.actor_id)
-                    if rec is not None and rec.worker is w:
-                        # unpicklable actor state: fall back to (bounded)
-                        # full method replay — restart becomes best-effort
-                        rec.snapshot_cutoff = None
-                        rec.snapshot_unavailable = True
-                elif kind == "actor_ready":
-                    pass
-                elif kind == "actor_err":
-                    _, blob, tb = msg
-                    rec = self.actors.get(w.actor_id)
-                    if rec is not None:
-                        rec.dead = True
-                        try:
-                            cause = common.loads(blob)
-                        except Exception:
-                            cause = RuntimeError("actor init failed")
-                        err = TaskError(cause, tb)
-                        self._fail_actor_tasks_locked(w.actor_id, err)
+                msgs = msg[1] if msg[0] == "batch" else (msg,)
+                for m in msgs:
+                    applied = self._handle_msg_locked(w, m)
+                    if applied is None:
+                        return          # chaos killed the worker mid-batch
+                    dirty = dirty or applied
         except (EOFError, OSError):
             self._handle_death_locked(w)
+        finally:
+            if dirty:
+                self.cv.notify_all()
+                self._dispatch_locked()
+
+    def _handle_msg_locked(self, w: _Worker, msg: tuple) -> Optional[bool]:
+        """Apply one worker→driver message. Returns True when it changed
+        completion state (caller notifies/dispatches once per batch),
+        False when it did not, None when the worker was chaos-killed and
+        the rest of its batch must be discarded."""
+        kind = msg[0]
+        if kind == "ready":
+            w.ready = True
+            return True
+        elif kind == "done":
+            _, tid, rkind, payload = msg
+            act = _chaos.fire("runtime.result",
+                              target="actor" if w.actor_id
+                              else "task", worker=w.wid)
+            if act is not None and act["action"] == "drop_result":
+                # chaos: the completion message is lost in
+                # transit AND the worker dies — the death
+                # handler replays the task (at-least-once,
+                # like the reference's retry semantics)
+                w.kill()
+                return None
+            if act is not None and act["action"] == "delay_result":
+                # chaos: the message is in-flight for delay_s —
+                # parked for later delivery, NOT slept on (this
+                # code runs under the runtime lock; sleeping here
+                # would freeze the whole scheduler, which is a
+                # different fault than "one result delayed")
+                self._delayed_results.append(
+                    (time.monotonic() + act["delay_s"], w,
+                     (tid, rkind, payload)))
+                return False
+            w.last_progress = time.monotonic()
+            self._complete_locked(w, tid, rkind, payload, defer=True)
+            return True
+        elif kind == "err":
+            _, tid, blob, tb = msg
+            w.last_progress = time.monotonic()
+            if tid in w.inflight:
+                w.inflight.remove(tid)
+                self._push_idle_locked(w)
+            spec = self.specs.get(tid)
+            if spec is not None:
+                try:
+                    cause = common.loads(blob)
+                except Exception as e:  # undeserializable exception
+                    cause = RuntimeError(f"(unpicklable) {e}")
+                if (isinstance(cause, DependencyLostError)
+                        and spec.actor_id is None
+                        and self._recover_lost_dep_locked(spec, cause)):
+                    return True   # dep rebuilt, task requeued
+                self._fail_task_locked(spec, TaskError(cause, tb))
+            return True
+        elif kind == "snapshot":
+            _, blob = msg
+            rec = self.actors.get(w.actor_id)
+            if rec is not None and rec.worker is w:
+                rec.snapshot_blob = blob
+                cutoff = rec.snapshot_cutoff or 0
+                rec.snapshot_cutoff = None
+                rec.replay_log = [e for e in rec.replay_log
+                                  if e[0] > cutoff]
+        elif kind == "snapshot_err":
+            rec = self.actors.get(w.actor_id)
+            if rec is not None and rec.worker is w:
+                # unpicklable actor state: fall back to (bounded)
+                # full method replay — restart becomes best-effort
+                rec.snapshot_cutoff = None
+                rec.snapshot_unavailable = True
+        elif kind == "actor_ready":
+            pass
+        elif kind == "actor_err":
+            _, blob, tb = msg
+            rec = self.actors.get(w.actor_id)
+            if rec is not None:
+                rec.dead = True
+                try:
+                    cause = common.loads(blob)
+                except Exception:
+                    cause = RuntimeError("actor init failed")
+                err = TaskError(cause, tb)
+                self._fail_actor_tasks_locked(w.actor_id, err)
+                return True
+        return False
 
     def _fail_actor_tasks_locked(self, actor_id: bytes,
                                  err: BaseException) -> None:
-        for tid, spec in list(self.specs.items()):
-            if spec.actor_id == actor_id:
-                self.specs.pop(tid)
-                self.errors[spec.result_ref.oid.binary] = err
-        self.pending = [s for s in self.pending if s.actor_id != actor_id]
+        for spec in [s for s in self.specs.values()
+                     if s.actor_id == actor_id]:
+            self._fail_task_locked(spec, err)
         self.cv.notify_all()
 
     def _handle_death_locked(self, w: _Worker) -> None:
@@ -1278,10 +1681,10 @@ class Runtime:
                 return
             # in-flight calls on the dead process fail (ray semantics)
             for tid in list(w.inflight):
-                spec = self.specs.pop(tid, None)
-                if spec:
-                    self.errors[spec.result_ref.oid.binary] = ActorDiedError(
-                        "actor process died mid-call")
+                spec = self.specs.get(tid)
+                if spec is not None:
+                    self._fail_task_locked(spec, ActorDiedError(
+                        "actor process died mid-call"))
             w.inflight.clear()
             self.cv.notify_all()
             if rec.dead:
@@ -1319,18 +1722,17 @@ class Runtime:
         # stateless task worker: replay or fail its in-flight tasks, respawn
         if w in self.task_workers:
             self.task_workers.remove(w)
-            for tid in list(w.inflight):
+            w.retired = True
+            for tid in reversed(list(w.inflight)):
                 spec = self.specs.get(tid)
                 if spec is None:
                     continue
                 if spec.retries_left > 0:
                     spec.retries_left -= 1
-                    self.pending.insert(0, spec)
+                    self._admit_spec_locked(spec, front=True)
                 else:
-                    self.specs.pop(tid)
-                    self.errors[spec.result_ref.oid.binary] = \
-                        WorkerCrashedError(
-                            "worker died executing task; retries exhausted")
+                    self._fail_task_locked(spec, WorkerCrashedError(
+                        "worker died executing task; retries exhausted"))
             w.inflight.clear()
             if not self._shutdown:
                 repl = _Worker(self._make_ctx(), self.store_name)
@@ -1338,6 +1740,7 @@ class Runtime:
                 repl.reserved_by = w.reserved_by
                 repl.parked = w.parked
                 self.task_workers.append(repl)
+                self._push_idle_locked(repl)
             M_WORKERS_ALIVE.set(len(self.task_workers))
             self.cv.notify_all()
             self._dispatch_locked()
