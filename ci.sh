@@ -63,8 +63,12 @@ fi
 
 if [[ "$QUICK" == "1" ]]; then
   echo "== quick tier: numerics + unit tests + chaos smoke"
+  # test_pallas_kernels = the interpret-mode flash parity gate (streamed
+  # kernels vs XLA on causal/none/padding/segment masks, fp32 + bf16);
+  # test_flash_blocks = the block-selector + VMEM-budget-fallback smoke
   python -m pytest -q -m "not slow" \
     tests/test_ops.py tests/test_pallas_kernels.py tests/test_nn.py \
+    tests/test_flash_blocks.py \
     tests/test_sharding.py tests/test_serial.py tests/test_utils.py \
     tests/test_analysis.py tests/test_image_ops.py tests/test_htm.py \
     tests/test_compress.py tests/test_scorer.py tests/test_ring.py \

@@ -1,0 +1,119 @@
+"""Unit tests for flash-attention block-size selection
+(:mod:`tosem_tpu.ops.flash_blocks`): table pins, VMEM-budget fallback,
+divisibility alignment, and the autotune JSON cache."""
+import json
+
+import pytest
+
+from tosem_tpu.ops.flash_blocks import (BlockSizes, DEFAULT_VMEM_BUDGET,
+                                        reset_cache, save_cache,
+                                        select_block_sizes,
+                                        vmem_bytes_estimate)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    reset_cache()
+    yield
+    reset_cache()
+
+
+class TestSelectionTable:
+    def test_north_star_pin(self):
+        """The b8_t512 d64 bf16 shape must resolve from the table, not
+        heuristics — it is the shape the MFU gate is scored on."""
+        blocks = select_block_sizes(512, 64, "bfloat16", cache_path=None)
+        assert blocks == BlockSizes(512, 512, 512, 512)
+        assert select_block_sizes.last_source == "table"
+
+    def test_long_context_streams(self):
+        for T in (2048, 4096, 8192):
+            b = select_block_sizes(T, 64, "bfloat16", cache_path=None)
+            assert b.bq < T and b.bk < T, (T, b)
+            assert T % b.bq == 0 and T % b.bk == 0
+
+    def test_unknown_shape_gets_default_clamped(self):
+        b = select_block_sizes(256, 32, "float32", cache_path=None)
+        assert b.bq <= 256 and b.bk <= 256
+        assert 256 % b.bq == 0 and 256 % b.bk == 0
+
+    def test_alignment_shrinks_to_divisor(self):
+        # T=384 does not hold a 512 block; selection must shrink to a
+        # divisor rather than raise in the kernel
+        b = select_block_sizes(384, 64, "bfloat16", cache_path=None)
+        assert 384 % b.bq == 0 and 384 % b.bk == 0
+        assert 384 % b.bq_bwd == 0 and 384 % b.bk_bwd == 0
+
+
+class TestVmemBudget:
+    def test_estimate_monotonic_in_blocks(self):
+        small = vmem_bytes_estimate(BlockSizes(128, 128, 128, 128), 64, 2)
+        big = vmem_bytes_estimate(BlockSizes(1024, 1024, 1024, 1024), 64, 2)
+        assert big > small > 0
+
+    def test_budget_fallback_shrinks_blocks(self):
+        """Acceptance: the VMEM-budget fallback is exercised — a tight
+        budget must yield smaller blocks that fit it."""
+        full = select_block_sizes(4096, 64, "bfloat16", cache_path=None)
+        tight = 256 << 10
+        b = select_block_sizes(4096, 64, "bfloat16", cache_path=None,
+                               vmem_budget=tight)
+        assert vmem_bytes_estimate(b, 64, 2) <= tight
+        assert (b.bq, b.bk) < (full.bq, full.bk)
+        assert select_block_sizes.last_source == "vmem"
+        assert 4096 % b.bq == 0 and 4096 % b.bk == 0
+
+    def test_budget_floors_never_zero(self):
+        b = select_block_sizes(4096, 64, "bfloat16", cache_path=None,
+                               vmem_budget=1)
+        assert b.bq >= 8 and b.bk >= 128     # Mosaic tiling floors
+
+    def test_default_budget_accepts_table_entries(self):
+        for key_t in (512, 2048, 4096):
+            b = select_block_sizes(key_t, 64, "bfloat16", cache_path=None)
+            assert vmem_bytes_estimate(b, 64, 2) <= DEFAULT_VMEM_BUDGET
+
+
+class TestAutotuneCache:
+    def test_cache_overrides_table(self, tmp_path):
+        path = str(tmp_path / "flash_blocks.json")
+        save_cache({"t512_d64_bfloat16": [256, 256, 128, 256]}, path)
+        reset_cache()
+        b = select_block_sizes(512, 64, "bfloat16", cache_path=path)
+        assert b == BlockSizes(256, 256, 128, 256)
+        assert select_block_sizes.last_source == "cache"
+
+    def test_cache_merge_keeps_other_entries(self, tmp_path):
+        path = str(tmp_path / "flash_blocks.json")
+        save_cache({"t512_d64_bfloat16": [256, 256, 256, 256]}, path)
+        save_cache({"t2048_d64_bfloat16": [512, 1024, 512, 512]}, path)
+        data = json.load(open(path))["blocks"]
+        assert set(data) == {"t512_d64_bfloat16", "t2048_d64_bfloat16"}
+
+    def test_corrupt_cache_falls_back_to_table(self, tmp_path):
+        path = str(tmp_path / "flash_blocks.json")
+        with open(path, "w") as f:
+            f.write("{not json")
+        reset_cache()
+        b = select_block_sizes(512, 64, "bfloat16", cache_path=path)
+        assert b == BlockSizes(512, 512, 512, 512)
+        assert select_block_sizes.last_source == "table"
+
+    def test_missing_cache_file_is_fine(self, tmp_path):
+        b = select_block_sizes(512, 64, "bfloat16",
+                               cache_path=str(tmp_path / "absent.json"))
+        assert b == BlockSizes(512, 512, 512, 512)
+
+    def test_autotune_writes_cache_and_picks_best(self, tmp_path):
+        """End-to-end autotune on a tiny interpret-mode shape."""
+        from tosem_tpu.ops.flash_blocks import autotune
+        path = str(tmp_path / "flash_blocks.json")
+        recs = autotune([(1, 1, 128, 16, "float32")], reps=1,
+                        cache_path=path)
+        assert recs and any(r["best"] for r in recs)
+        data = json.load(open(path))["blocks"]
+        assert "t128_d16_float32" in data
+        reset_cache()
+        b = select_block_sizes(128, 16, "float32", cache_path=path)
+        assert b.as_list() == data["t128_d16_float32"]
+        assert select_block_sizes.last_source == "cache"

@@ -451,3 +451,102 @@ class TestMicrobench:
         assert by_id["single_client_get"] > 1000
         assert by_id["tasks_async"] > 100
         assert all(v > 0 for v in by_id.values())
+
+
+class TestDuplicateDoneIdempotent:
+    """Steal-path at-least-once: a second "done" for an already-completed
+    task id must be dropped — never re-put into the store, never
+    re-recorded in lineage — so a stolen-then-finished task cannot
+    resurrect an evicted object and skew recovery determinism."""
+
+    def _completed_store_task(self, runtime):
+        from tosem_tpu.runtime import api
+
+        @rt.remote
+        def big(n):
+            return b"d" * n
+
+        ref = big.remote(256 << 10)            # > INLINE_THRESHOLD → store
+        assert rt.get(ref) == b"d" * (256 << 10)
+        r = api._runtime
+        with r.lock:
+            tid, (kind, rkey) = next(reversed(r._completed.items()))
+        assert kind == "store" and rkey == ref.oid.binary
+        return r, ref, tid, rkey
+
+    def test_duplicate_done_after_evict_does_not_resurrect(self, runtime):
+        r, ref, tid, rkey = self._completed_store_task(runtime)
+        with r.lock:
+            lineage_before = r.lineage.get(rkey)
+            # driver-side eviction (what chaos evict_object does)
+            r.store.delete(ObjectID(rkey))
+            r._evicted.add(rkey)
+        assert not r.store.contains(ObjectID(rkey))
+        # the stolen copy finishes later: its worker re-puts the result,
+        # then its "done" reaches the driver
+        r.store.put(ObjectID(rkey), b"resurrected")
+        with r.lock:
+            w = r.task_workers[0]
+            applied = r._handle_msg_locked(w, ("done", tid, "store", rkey))
+        assert applied is True
+        # the duplicate neither resurrected the object nor touched lineage
+        assert not r.store.contains(ObjectID(rkey))
+        with r.lock:
+            assert r.lineage.get(rkey) is lineage_before
+        # determinism: get() heals via lineage reconstruction, exactly as
+        # if the duplicate had never arrived
+        assert rt.get(ref) == b"d" * (256 << 10)
+
+    def test_duplicate_done_keeps_live_object(self, runtime):
+        r, ref, tid, rkey = self._completed_store_task(runtime)
+        with r.lock:
+            w = r.task_workers[0]
+            applied = r._handle_msg_locked(w, ("done", tid, "store", rkey))
+        assert applied is True
+        assert r.store.contains(ObjectID(rkey))   # live object untouched
+        assert rt.get(ref) == b"d" * (256 << 10)
+
+    def test_duplicate_inline_done_not_rerecorded(self, runtime):
+        from tosem_tpu.runtime import api
+
+        @rt.remote
+        def small():
+            return 7
+
+        ref = small.remote()
+        assert rt.get(ref) == 7
+        r = api._runtime
+        with r.lock:
+            tid, (kind, rkey) = next(reversed(r._completed.items()))
+            assert kind == "inline"
+            inline_before = r.inline.get(rkey)
+            w = r.task_workers[0]
+            applied = r._handle_msg_locked(
+                w, ("done", tid, "inline", (0, [b"bogus"])))
+        assert applied is True
+        with r.lock:
+            # the duplicate payload must NOT replace the recorded result
+            assert r.inline.get(rkey) is inline_before
+        assert rt.get(ref) == 7
+
+    def test_duplicate_done_spares_inflight_reconstruction(self, runtime):
+        """A duplicate arriving WHILE the evicted object is being healed
+        must not delete the reconstruction's freshly re-put result."""
+        r, ref, tid, rkey = self._completed_store_task(runtime)
+        with r.lock:
+            r.store.delete(ObjectID(rkey))
+            r._evicted.add(rkey)
+            r._reconstructing.add(rkey)        # heal in flight
+        try:
+            # the healing task has just re-put the object...
+            r.store.put(ObjectID(rkey), b"healed")
+            with r.lock:
+                w = r.task_workers[0]
+                # ...when the stolen copy's late duplicate lands
+                r._handle_msg_locked(w, ("done", tid, "store", rkey))
+            assert r.store.contains(ObjectID(rkey))  # heal survives
+        finally:
+            with r.lock:
+                r._reconstructing.discard(rkey)
+            r.store.delete(ObjectID(rkey))
+            r._evicted.add(rkey)

@@ -35,6 +35,14 @@ _LAZY_EXPORTS = {
     "TrainingPreempted": ("tosem_tpu.train.trainer", "TrainingPreempted"),
     "CheckpointCorruptError": ("tosem_tpu.train.checkpoint",
                                "CheckpointCorruptError"),
+    # flash-attention kernel surface (round 6): segment-masked streamed
+    # kernels + block-size selection + the shard_map wrapper
+    "SegmentIds": ("tosem_tpu.ops.flash_attention", "SegmentIds"),
+    "BlockSizes": ("tosem_tpu.ops.flash_blocks", "BlockSizes"),
+    "select_block_sizes": ("tosem_tpu.ops.flash_blocks",
+                           "select_block_sizes"),
+    "sharded_flash_attention": ("tosem_tpu.parallel.flash",
+                                "sharded_flash_attention"),
 }
 
 __all__ = sorted(_LAZY_EXPORTS)

@@ -26,8 +26,8 @@ from tosem_tpu.utils.flags import FlagSet
 
 CONFIGS = ("gemm", "timing_check", "conv_sweep", "allreduce",
            "resnet_train", "bert_kernels", "bert_train",
-           "detection_train", "detection_infer", "pointpillars_infer",
-           "speech_train", "analysis")
+           "flash_autotune", "detection_train", "detection_infer",
+           "pointpillars_infer", "speech_train", "analysis")
 
 
 def make_flags() -> FlagSet:
@@ -302,10 +302,10 @@ def run_resnet_train(fs: FlagSet) -> List[Any]:
 def run_bert_kernels(fs: FlagSet) -> List[Any]:
     from tosem_tpu.ops.kernel_suite import bert_kernel_suite
     if fs.device == "cpu":  # interpret-mode Pallas: keep it tiny
-        rows = bert_kernel_suite(batch=1, seq=fs.seq or 128, heads=2,
-                                 head_dim=32, hidden=64)
+        rows = bert_kernel_suite(batch=fs.batch or 1, seq=fs.seq or 128,
+                                 heads=2, head_dim=32, hidden=64)
     else:
-        rows = bert_kernel_suite(batch=8, seq=fs.seq or 512)
+        rows = bert_kernel_suite(batch=fs.batch or 8, seq=fs.seq or 512)
     for r in rows:
         print(f"  {r.bench_id}: {r.value:.1f} {r.unit}")
     return rows
@@ -451,6 +451,54 @@ def run_bert_train(fs: FlagSet) -> List[Any]:
         print(f"  profile: {len(stats)} kernels -> {csv_path}")
     for r in rows:
         print(f"  {r.bench_id} {r.metric}: {r.value:.2f} {r.unit}")
+    return rows
+
+
+def run_flash_autotune(fs: FlagSet) -> List[Any]:
+    """On-chip flash-attention block-size sweep (the TensorRT
+    tactic-selection role): measures candidate (bq, bk) chunkings per
+    shape, emits one row per candidate (the block-size-sweep evidence),
+    and caches winners to ``results/flash_blocks.json`` where
+    ``select_block_sizes`` — and therefore ``bert_kernels``,
+    ``bert_train`` and the BERT flash path — picks them up. Run this
+    leg BEFORE ``bert_kernels`` in a capture window so the MFU rows use
+    tuned blocks."""
+    import jax
+    from tosem_tpu.ops.flash_blocks import DEFAULT_CACHE_PATH, autotune
+    from tosem_tpu.utils.results import ResultRow
+
+    if fs.device == "cpu":   # interpret-mode smoke: one tiny shape
+        shapes = [(1, 2, fs.seq or 128, 32, "float32")]
+    elif fs.seq:
+        B = max(1, (8 * 512) // fs.seq)
+        shapes = [(B, 12, fs.seq, 64, fs.dtype or "bfloat16")]
+    else:
+        # north-star shape first (highest-value evidence if the tunnel
+        # flaps mid-leg), then the long-context legs (b2 at t8192
+        # matches the capture harness's bert_kernels_t8192 leg, so that
+        # leg reads a tuned cache entry instead of the static table)
+        shapes = [(8, 12, 512, 64, "bfloat16"),
+                  (2, 12, 2048, 64, "bfloat16"),
+                  (1, 12, 4096, 64, "bfloat16"),
+                  (2, 12, 8192, 64, "bfloat16")]
+    records = autotune(shapes, reps=3)
+    platform = jax.devices()[0].platform
+    rows = []
+    for r in records:
+        B, H, T, D, dtype = r["shape"]
+        bq, bk = r["blocks"][0], r["blocks"][1]
+        rows.append(ResultRow(
+            project="ops", config="flash_autotune",
+            bench_id=f"flash_blocks_b{B}_t{T}_{dtype}_bq{bq}_bk{bk}",
+            metric="time_us", value=r["time_us"], unit="us",
+            device=platform, n_devices=1,
+            extra={"shape": [B, H, T, D], "dtype": dtype,
+                   "blocks": r["blocks"], "best": r["best"],
+                   "cache": DEFAULT_CACHE_PATH}))
+    for r in rows:
+        star = " *" if r.extra["best"] else ""
+        print(f"  {r.bench_id}: {r.value:.1f} {r.unit}{star}")
+    print(f"  winners -> {DEFAULT_CACHE_PATH}")
     return rows
 
 
@@ -857,6 +905,7 @@ RUNNERS = {
     "resnet_train": run_resnet_train,
     "bert_kernels": run_bert_kernels,
     "bert_train": run_bert_train,
+    "flash_autotune": run_flash_autotune,
     "detection_train": run_detection_train,
     "detection_infer": run_detection_infer,
     "pointpillars_infer": run_pointpillars_infer,

@@ -8,6 +8,7 @@ kernel suite) and as the carrier for sequence parallelism.
 """
 from __future__ import annotations
 
+import collections
 from typing import Optional
 
 import jax
@@ -34,29 +35,60 @@ def dot_product_attention(q, k, v, mask: Optional[jax.Array] = None, *,
                       precision=PRECISION[precision])
 
 
+# trace-time dispatch tally: which attention core ran per traced call.
+# The padded-batch A/B test asserts the flash path actually fired (a
+# silent XLA fallback is exactly the regression this guards against).
+# A Counter so callers may clear() it between measurements.
+FLASH_DISPATCH_COUNTS = collections.Counter({"flash": 0, "xla": 0})
+
+
+def _as_key_padding(mask, B: int, Tk: int) -> Optional[jax.Array]:
+    """[B, Tk] key-padding vector from a broadcastable attention mask,
+    or None when the mask is not a pure key mask (query- or
+    head-dependent masks take the XLA path)."""
+    if mask is None or mask.ndim != 4:
+        return None
+    mb, mh, mq, mk = mask.shape
+    if (mh, mq) != (1, 1) or mk != Tk or mb not in (1, B):
+        return None
+    kv = mask[:, 0, 0, :]
+    if mb == 1:
+        kv = jnp.broadcast_to(kv, (B, Tk))
+    return kv
+
+
 def flash_attn_fn(causal: bool = False, precision: str = "default"):
     """An ``attn_fn`` for :class:`MultiHeadAttention` that routes
     eligible shapes through the Pallas flash kernel (bf16-native MXU
-    path) and falls back to the XLA path otherwise — when a padding mask
-    is present (flash supports causal/none masks only) or when the
-    sequence length does not divide into kernel blocks. The fallback
-    preserves causality (folded into the mask) and the requested matmul
-    precision, so swapping ``attn_fn`` never changes semantics, only the
-    kernel. Thread it through a model's
+    path) and falls back to the XLA path otherwise. Key-padding masks
+    (the [B, 1, 1, Tk] masks BERT builds from ``mask[:, None, None, :]``)
+    stay on the flash path as kernel-level segment ids — q ids all 1, kv
+    ids the mask — which reproduces the XLA key-mask semantics exactly
+    (every query attends exactly the real keys). Only query-/
+    head-dependent dense masks, or sequence lengths that don't tile,
+    fall back; the fallback preserves causality (folded into the mask)
+    and the requested matmul precision, so swapping ``attn_fn`` never
+    changes semantics, only the kernel. Thread it through a model's
     ``apply(..., attn_fn=flash_attn_fn())`` — e.g. BERT-base on TPU."""
-    from tosem_tpu.ops.flash_attention import (DEFAULT_BK, DEFAULT_BQ,
+    from tosem_tpu.ops.flash_attention import (SegmentIds,
                                                mha_flash_attention)
 
     def core(q, k, v, mask):
-        Tq, Tk = q.shape[1], k.shape[1]
-        # block divisibility alone is trivially true for T <= block; the
-        # Mosaic kernel additionally needs (sublane, lane) tile-aligned
-        # sequence lengths, so short ragged T falls back to XLA
-        blocks_ok = (Tq % min(DEFAULT_BQ, Tq) == 0
-                     and Tk % min(DEFAULT_BK, Tk) == 0
-                     and Tq % 8 == 0 and Tk % 128 == 0)
-        if mask is None and blocks_ok:
-            return mha_flash_attention(q, k, v, causal=causal)
+        B, Tq = q.shape[0], q.shape[1]
+        Tk = k.shape[1]
+        # the Mosaic kernel needs (sublane, lane) tile-aligned sequence
+        # lengths, so short ragged T falls back to XLA
+        blocks_ok = Tq % 8 == 0 and Tk % 128 == 0
+        kv_mask = _as_key_padding(mask, B, Tk)
+        if blocks_ok and (mask is None or kv_mask is not None):
+            seg = None
+            if kv_mask is not None:
+                seg = SegmentIds(q=jnp.ones((B, Tq), jnp.int32),
+                                 kv=kv_mask.astype(jnp.int32))
+            FLASH_DISPATCH_COUNTS["flash"] += 1
+            return mha_flash_attention(q, k, v, causal=causal,
+                                       segment_ids=seg)
+        FLASH_DISPATCH_COUNTS["xla"] += 1
         if causal:
             cm = jnp.tril(jnp.ones((Tq, Tk), bool))[None, None]
             mask = cm if mask is None else jnp.logical_and(mask, cm)

@@ -1,5 +1,8 @@
 from tosem_tpu.ops.gemm import gemm, gemm_bench, GemmSpec
 from tosem_tpu.ops.conv import conv2d, conv_bench, ConvSpec, RESNET50_CONV_SWEEP
-from tosem_tpu.ops.flash_attention import flash_attention, mha_flash_attention
+from tosem_tpu.ops.flash_attention import (flash_attention,
+                                           mha_flash_attention, SegmentIds)
+from tosem_tpu.ops.flash_blocks import (BlockSizes, autotune,
+                                        select_block_sizes)
 from tosem_tpu.ops.fused_norms import fused_layernorm, fused_softmax
 from tosem_tpu.ops.kernel_suite import bert_kernel_suite

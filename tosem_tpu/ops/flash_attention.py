@@ -1,33 +1,58 @@
-"""Fused (flash) attention — Pallas TPU kernel, fwd + bwd.
+"""Fused (flash) attention — Pallas TPU kernels, fwd + bwd, grid-streamed.
 
 North-star config 5 is the BERT-base fwd/bwd kernel suite: attention,
 layernorm, softmax. The reference has no fused attention (its subject
 systems predate it; closest are the hand-fused CUDA kernels like the
 PointPillars pipeline, SURVEY §2.2) — this is the TPU-native equivalent of
 that "hand-fuse the hot path" practice: online-softmax tiling keeps the
-T×T score matrix out of HBM entirely, trading it for O(T·d) VMEM blocks.
+T×T score matrix out of HBM entirely.
 
-Layout: [B, H, T, D]. Grid (B·H, Tq/bq); K/V stream through VMEM in bk
-chunks inside a fori_loop. All statistics in fp32. Backward uses the
-standard recompute-from-logsumexp scheme (two kernels: dKV and dQ).
+Streaming grid (the round-6 restructure): every kernel is a 4-D grid
+``(B, H, tiles, stream)`` whose LAST dimension walks the streamed operand
+in chunks under ``"arbitrary"`` dimension semantics — the forward and dQ
+kernels stream K/V past a resident Q tile, the dKV kernel streams Q/dO
+past a resident K/V tile. The online-softmax state (m, l, acc — dk/dv in
+the dKV kernel) lives in fp32 VMEM *scratch accumulators* that persist
+across the stream sweep; outputs are written once, on the final chunk.
+Because the chunk index is a grid dimension (not an in-cell ``fori_loop``),
+Mosaic double-buffers the HBM→VMEM chunk copies against MXU compute, and
+VMEM residency is O(block·d) per operand instead of O(T·d) — long-context
+legs (t4096+) run at full block sizes.
+
+Causal block skipping happens at the grid level: chunks strictly above the
+diagonal are masked off with ``pl.when`` (no MXU work) AND their BlockSpec
+index maps are clamped to the last needed chunk (no HBM copy) — skipped
+cells cost nothing, halving causal FLOPs, and only diagonal-straddling
+blocks pay the ``jnp.where`` (via ``lax.cond``; interior blocks skip it).
+
+Padding/segment masks are kernel-level: ``SegmentIds`` (q, kv) int32
+arrays gate attention to equal ids — a key-padding mask is q=1 everywhere,
+kv=the mask — so padded BERT batches stay on the flash path. Per-row
+statistics (m, l, lse, delta) travel broadcast across a 128-lane minor dim
+(the official TPU flash kernel's MIN_BLOCK_SIZE trick); kv segment ids
+travel broadcast across 8 sublanes.
+
+Layouts: the kernels slice one (rows, d) head tile per grid cell via
+``None``-squeezed BlockSpecs, so the SAME kernel body serves the
+``[B, H, T, D]`` layout (``flash_attention``) and the native
+``[B, T, H, D]`` layout of the nn layer (``mha_flash_attention``) — the
+BERT path never materializes a transposed copy of q/k/v/o.
 
 Dtype discipline (the MXU contract): matmul *operands* stay in the input
 dtype — bf16 inputs hit the MXU at the native single-pass rate with fp32
 accumulation via ``preferred_element_type``; fp32 inputs keep full fp32
-operands. Softmax statistics (max/sum/lse/delta) are always fp32; the
-probability matrix is cast back to the operand dtype only for the PV-style
-matmuls. The softmax scale is applied to the fp32 scores, never to the
-operands. (Upcasting bf16 operands to fp32 before the dots — the round-3
-kernel — forces every matmul onto the 6-pass fp32-emulation path, ~6×
-slower than native bf16.)
+operands. Softmax statistics are always fp32; the probability matrix is
+cast back to the operand dtype only for the PV-style matmuls. The softmax
+scale is applied to the fp32 scores, never to the operands.
 
-The XLA reference implementation for parity tests lives in
-``tosem_tpu.nn.attention.dot_product_attention``.
+Block sizes come from :mod:`tosem_tpu.ops.flash_blocks` (selection table
++ VMEM-budget fallback + on-chip autotune cache). The XLA reference for
+parity tests is ``tosem_tpu.nn.attention.dot_product_attention``.
 """
 from __future__ import annotations
 
 import functools
-from typing import Optional
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -36,284 +61,508 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from tosem_tpu.ops.common import interpret_default as _interpret
+from tosem_tpu.ops.flash_blocks import BlockSizes, select_block_sizes
+
 DEFAULT_BQ = 128
 DEFAULT_BK = 128
 _NEG_INF = -1e30
 # Mosaic requires the last two dims of every block to be (8k, 128k) or the
 # full array dim, so per-row statistics (LSE, delta) are carried broadcast
-# across a 128-lane minor dim (the official TPU flash kernel's MIN_BLOCK_SIZE
-# trick) instead of as rank-2 (rows,) vectors.
+# across a 128-lane minor dim and kv segment ids across an 8-sublane major
+# dim (the official TPU flash kernel's layout tricks) instead of as rank-2
+# (rows,) vectors.
 _LANES = 128
+_SUBLANES = 8
 
-
-from tosem_tpu.ops.common import interpret_default as _interpret
-
-# every grid cell is independent in all three kernels (the K/V loop is a
-# fori_loop *inside* the cell), so Mosaic may overlap/reorder cells freely
 # jax >= 0.6 renamed TPUCompilerParams → CompilerParams; accept either
 _CompilerParams = getattr(pltpu, "CompilerParams", None) or \
     pltpu.TPUCompilerParams
-_PARALLEL = _CompilerParams(dimension_semantics=("parallel", "parallel"))
+# (B, H, tile) cells are independent; the trailing stream dim carries the
+# scratch accumulators between cells and must run in order
+_STREAMED = _CompilerParams(
+    dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"))
 
 
-def _causal_mask(bq: int, bk: int, qi: int, kj: int):
+class SegmentIds(NamedTuple):
+    """Per-token segment ids gating attention to equal ids.
+
+    ``q``: [B, Tq] int32, ``kv``: [B, Tk] int32. A key-padding mask is
+    ``SegmentIds(q=ones, kv=mask)`` — every query attends exactly the
+    real keys (XLA key-mask semantics). Rows whose segment id appears
+    nowhere in ``kv`` produce unnormalized garbage (finite, never NaN)
+    and garbage grads; standard segment packing never creates such rows.
+    """
+    q: jax.Array
+    kv: jax.Array
+
+
+def _causal_mask(bq: int, bk: int, qi, kj):
     rows = lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + qi
     cols = lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + kj
     return rows >= cols
+
+
+def _apply_masks(s, *, causal, qi, kj, bq, bk, qseg_ref, kseg_ref):
+    """Mask fp32 scores in place of the score matrix.
+
+    Causal: skipped entirely for interior (fully-unmasked) blocks — the
+    grid never schedules fully-masked blocks, so only diagonal-straddling
+    chunks pay the ``jnp.where`` (``lax.cond`` keeps it off the interior
+    blocks' critical path)."""
+    if causal:
+        s = lax.cond(
+            qi < kj + bk - 1,       # block straddles the diagonal
+            lambda x: jnp.where(_causal_mask(bq, bk, qi, kj), x, _NEG_INF),
+            lambda x: x,
+            s)
+    if qseg_ref is not None:
+        qseg = qseg_ref[:, 0:1]                      # (bq, 1), lanes equal
+        kseg = kseg_ref[0:1, :]                      # (1, bk), sublanes eq.
+        s = jnp.where(qseg == kseg, s, _NEG_INF)
+    return s
+
+
+def _read_stat(ref):
+    """(rows, LANES) lanes-broadcast statistic → (rows, 1) fp32."""
+    return jnp.max(ref[...], axis=-1, keepdims=True)
+
+
+def _tile_spec(layout: str, rows: int, d: int, row_idx):
+    """BlockSpec slicing one (rows, d) single-head tile.
+
+    ``row_idx(t, s)`` maps the (tile, stream) grid ids to the T-axis
+    block index; B and H grid dims index their array dims directly. The
+    ``None`` entries squeeze the B/H axes so the kernel sees a plain
+    (rows, d) ref in BOTH layouts — no transposed copies anywhere."""
+    if layout == "bhtd":
+        return pl.BlockSpec((None, None, rows, d),
+                            lambda b, h, t, s: (b, h, row_idx(t, s), 0))
+    if layout == "bthd":
+        return pl.BlockSpec((None, rows, None, d),
+                            lambda b, h, t, s: (b, row_idx(t, s), h, 0))
+    raise ValueError(f"unknown layout {layout!r}")
+
+
+def _lanes_spec(rows: int, row_idx):
+    """BlockSpec for a [B, H, T, LANES] lanes-broadcast statistic."""
+    return pl.BlockSpec((None, None, rows, _LANES),
+                        lambda b, h, t, s: (b, h, row_idx(t, s), 0))
+
+
+def _qseg_spec(rows: int, row_idx):
+    return pl.BlockSpec((None, rows, _LANES),
+                        lambda b, h, t, s: (b, row_idx(t, s), 0))
+
+
+def _kseg_spec(cols: int, col_idx):
+    return pl.BlockSpec((None, _SUBLANES, cols),
+                        lambda b, h, t, s: (b, 0, col_idx(t, s)))
+
+
+def _seg_operands(segment_ids, B, Tq, Tk):
+    """Broadcast segment ids into Mosaic-tileable layouts."""
+    qseg = jnp.broadcast_to(
+        segment_ids.q.astype(jnp.int32)[:, :, None], (B, Tq, _LANES))
+    kseg = jnp.broadcast_to(
+        segment_ids.kv.astype(jnp.int32)[:, None, :], (B, _SUBLANES, Tk))
+    return qseg, kseg
+
+
+def _shapes(layout, x):
+    """(B, T, H, d) of an operand in the given layout."""
+    if layout == "bhtd":
+        B, H, T, d = x.shape
+    else:
+        B, T, H, d = x.shape
+    return B, T, H, d
+
+
+def _check_blocks(Tq, Tk, bq, bk):
+    if Tq % bq or Tk % bk:
+        raise ValueError(f"sequence lengths ({Tq},{Tk}) must divide into "
+                         f"blocks ({bq},{bk})")
 
 
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bk, sm_scale, causal):
-    q = q_ref[0]                                         # (bq, d), native dtype
-    bq, d = q.shape
-    cdt = q.dtype                                        # MXU operand dtype
-    Tk = k_ref.shape[1]
-    qi = pl.program_id(1) * bq
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest, sm_scale, causal, segmented,
+                bq, bk, n_k):
+    if segmented:
+        qseg_ref, kseg_ref, o_ref, lse_ref, m_sc, l_sc, acc_sc = rest
+    else:
+        o_ref, lse_ref, m_sc, l_sc, acc_sc = rest
+        qseg_ref = kseg_ref = None
+    i = pl.program_id(2)                             # q tile
+    j = pl.program_id(3)                             # streamed k/v chunk
+    qi = i * bq
+    kj = j * bk
 
-    def body(j, carry):
-        m, l, acc = carry
-        kj = j * bk
-        k = k_ref[0, pl.ds(kj, bk), :]                   # (bk, d)
-        v = v_ref[0, pl.ds(kj, bk), :]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * sm_scale
-        if causal:
-            s = jnp.where(_causal_mask(bq, bk, qi, kj), s, _NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, -1, keepdims=True))
+    @pl.when(j == 0)
+    def _init():
+        m_sc[...] = jnp.full(m_sc.shape, _NEG_INF, jnp.float32)
+        l_sc[...] = jnp.zeros(l_sc.shape, jnp.float32)
+        acc_sc[...] = jnp.zeros(acc_sc.shape, jnp.float32)
+
+    # causal: the last K chunk this Q tile attends (clamped to the K
+    # buffer so Tq > Tk never reads past the end); chunks beyond it are
+    # never computed and (via the clamped index maps) never copied
+    j_last = jnp.minimum((qi + bq - 1) // bk, n_k - 1) if causal \
+        else n_k - 1
+
+    def _step():
+        q = q_ref[...]                               # (bq, d), native dtype
+        k = k_ref[...]                               # (bk, d)
+        v = v_ref[...]
+        cdt = q.dtype                                # MXU operand dtype
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * sm_scale
+        s = _apply_masks(s, causal=causal, qi=qi, kj=kj, bq=bq, bk=bk,
+                         qseg_ref=qseg_ref, kseg_ref=kseg_ref)
+        m_prev = _read_stat(m_sc)
+        l_prev = _read_stat(l_sc)
+        m_new = jnp.maximum(m_prev, jnp.max(s, -1, keepdims=True))
         p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m - m_new)
-        l = l * alpha + jnp.sum(p, -1, keepdims=True)
-        acc = acc * alpha + jax.lax.dot_general(
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, -1, keepdims=True)
+        acc_sc[...] = acc_sc[...] * alpha + lax.dot_general(
             p.astype(cdt), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return m_new, l, acc
+        m_sc[...] = jnp.broadcast_to(m_new, m_sc.shape)
+        l_sc[...] = jnp.broadcast_to(l_new, l_sc.shape)
 
-    m0 = jnp.full((bq, 1), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((bq, 1), jnp.float32)
-    a0 = jnp.zeros((bq, d), jnp.float32)
-    n_k = Tk // bk
     if causal:
-        # only blocks with kj <= qi+bq-1 contribute; clamp to the buffer so
-        # Tq > Tk never reads K/V blocks past the end
-        n_k_eff = jnp.minimum(lax.div(qi + bq - 1, bk) + 1, n_k)
-        m, l, acc = lax.fori_loop(0, n_k_eff, body, (m0, l0, a0))
+        @pl.when(j <= j_last)
+        def _run():
+            _step()
     else:
-        m, l, acc = lax.fori_loop(0, n_k, body, (m0, l0, a0))
-    o_ref[0] = (acc / l).astype(o_ref.dtype)
-    lse_ref[0] = jnp.broadcast_to(m + jnp.log(l), (bq, _LANES))
+        _step()
+
+    @pl.when(j == j_last)
+    def _epilogue():
+        m = _read_stat(m_sc)
+        l = _read_stat(l_sc)
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[...] = (acc_sc[...] / l_safe).astype(o_ref.dtype)
+        lse_ref[...] = jnp.broadcast_to(m + jnp.log(l_safe), lse_ref.shape)
 
 
-def _flash_fwd(q, k, v, sm_scale, causal, bq, bk):
-    B, H, Tq, d = q.shape
-    Tk = k.shape[2]
-    bq = min(bq, Tq)
-    bk = min(bk, Tk)
-    if Tq % bq or Tk % bk:
-        raise ValueError(f"sequence lengths ({Tq},{Tk}) must divide into "
-                         f"blocks ({bq},{bk})")
-    qr = q.reshape(B * H, Tq, d)
-    kr = k.reshape(B * H, Tk, d)
-    vr = v.reshape(B * H, Tk, d)
-    grid = (B * H, Tq // bq)
+def _flash_fwd(q, k, v, segment_ids, sm_scale, causal, blocks, layout):
+    B, Tq, H, d = _shapes(layout, q)
+    _, Tk, _, _ = _shapes(layout, k)
+    blocks = blocks.clamp(Tq, Tk)
+    bq, bk = blocks.bq, blocks.bk
+    _check_blocks(Tq, Tk, bq, bk)
+    n_k = Tk // bk
+
+    def kv_idx(t, s):
+        # clamp skipped (fully-masked) chunks to the last needed one so
+        # the revisited index suppresses their HBM→VMEM copy entirely
+        return jnp.minimum(s, (t * bq + bq - 1) // bk) if causal else s
+
+    in_specs = [_tile_spec(layout, bq, d, lambda t, s: t),
+                _tile_spec(layout, bk, d, kv_idx),
+                _tile_spec(layout, bk, d, kv_idx)]
+    args = [q, k, v]
+    segmented = segment_ids is not None
+    if segmented:
+        qseg, kseg = _seg_operands(segment_ids, B, Tq, Tk)
+        in_specs += [_qseg_spec(bq, lambda t, s: t),
+                     _kseg_spec(bk, kv_idx)]
+        args += [qseg, kseg]
+    o_shape = ((B, H, Tq, d) if layout == "bhtd" else (B, Tq, H, d))
     out, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, bk=bk, sm_scale=sm_scale,
-                          causal=causal),
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, Tk, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, Tk, d), lambda b, i: (b, 0, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, bq, _LANES), lambda b, i: (b, i, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((B * H, Tq, d), q.dtype),
-            jax.ShapeDtypeStruct((B * H, Tq, _LANES), jnp.float32),
-        ],
-        compiler_params=_PARALLEL,
+        functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
+                          segmented=segmented, bq=bq, bk=bk, n_k=n_k),
+        grid=(B, H, Tq // bq, n_k),
+        in_specs=in_specs,
+        out_specs=[_tile_spec(layout, bq, d, lambda t, s: t),
+                   _lanes_spec(bq, lambda t, s: t)],
+        out_shape=[jax.ShapeDtypeStruct(o_shape, q.dtype),
+                   jax.ShapeDtypeStruct((B, H, Tq, _LANES), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((bq, _LANES), jnp.float32),
+                        pltpu.VMEM((bq, _LANES), jnp.float32),
+                        pltpu.VMEM((bq, d), jnp.float32)],
+        compiler_params=_STREAMED,
         interpret=_interpret(),
-    )(qr, kr, vr)
-    return out.reshape(B, H, Tq, d), lse  # lse stays in lanes layout
+    )(*args)
+    return out, lse                                  # lse in lanes layout
 
 
 # ---------------------------------------------------------------------------
 # backward
 # ---------------------------------------------------------------------------
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *, bq, sm_scale, causal):
-    k = k_ref[0]                                         # (bk, d), native
-    v = v_ref[0]
-    cdt = k.dtype
-    bk, d = k.shape
-    Tq = q_ref.shape[1]
-    kj = pl.program_id(1) * bk
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+                    sm_scale, causal, segmented, bq, bk, n_q):
+    if segmented:
+        qseg_ref, kseg_ref, dk_ref, dv_ref, dk_sc, dv_sc = rest
+    else:
+        dk_ref, dv_ref, dk_sc, dv_sc = rest
+        qseg_ref = kseg_ref = None
+    j = pl.program_id(2)                             # resident k/v tile
+    i = pl.program_id(3)                             # streamed q/do chunk
+    kj = j * bk
+    qi = i * bq
 
-    def body(i, carry):
-        dk, dv = carry
-        qi = i * bq
-        q = q_ref[0, pl.ds(qi, bq), :]                   # native, unscaled
-        do = do_ref[0, pl.ds(qi, bq), :]
-        lse = lse_ref[0, pl.ds(qi, bq), 0:1]     # lanes layout: col 0
-        delta = delta_ref[0, pl.ds(qi, bq), 0:1]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * sm_scale
-        if causal:
-            s = jnp.where(_causal_mask(bq, bk, qi, kj), s, _NEG_INF)
-        p = jnp.exp(s - lse)                              # (bq, bk) fp32
-        dv = dv + jax.lax.dot_general(p.astype(cdt), do,
-                                      (((0,), (0,)), ((), ())),
-                                      preferred_element_type=jnp.float32)
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
+    @pl.when(i == 0)
+    def _init():
+        dk_sc[...] = jnp.zeros(dk_sc.shape, jnp.float32)
+        dv_sc[...] = jnp.zeros(dv_sc.shape, jnp.float32)
+
+    def _step():
+        k = k_ref[...]                               # (bk, d), native
+        v = v_ref[...]
+        q = q_ref[...]                               # (bq, d), unscaled
+        do = do_ref[...]
+        cdt = k.dtype
+        lse = _read_stat(lse_ref)                    # (bq, 1) fp32
+        delta = _read_stat(delta_ref)
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * sm_scale
+        s = _apply_masks(s, causal=causal, qi=qi, kj=kj, bq=bq, bk=bk,
+                         qseg_ref=qseg_ref, kseg_ref=kseg_ref)
+        p = jnp.exp(s - lse)                         # (bq, bk) fp32
+        dv_sc[...] = dv_sc[...] + lax.dot_general(
+            p.astype(cdt), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
         # ds carries the softmax scale (q is loaded unscaled)
-        ds = p * (dp - delta) * sm_scale                  # (bq, bk)
-        dk = dk + jax.lax.dot_general(ds.astype(cdt), q,
-                                      (((0,), (0,)), ((), ())),
-                                      preferred_element_type=jnp.float32)
-        return dk, dv
+        ds = p * (dp - delta) * sm_scale             # (bq, bk)
+        dk_sc[...] = dk_sc[...] + lax.dot_general(
+            ds.astype(cdt), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
-    dk0 = jnp.zeros((bk, d), jnp.float32)
-    dv0 = jnp.zeros((bk, d), jnp.float32)
     if causal:
-        start = lax.div(kj, bq)
-        dk, dv = lax.fori_loop(start, Tq // bq, body, (dk0, dv0))
+        # chunks whose every row precedes this K tile are fully masked:
+        # first contributing chunk is kj // bq (same bound the r5 in-cell
+        # loop used), earlier ones are never computed nor copied
+        @pl.when(i >= kj // bq)
+        def _run():
+            _step()
     else:
-        dk, dv = lax.fori_loop(0, Tq // bq, body, (dk0, dv0))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+        _step()
+
+    @pl.when(i == n_q - 1)
+    def _epilogue():
+        dk_ref[...] = dk_sc[...].astype(dk_ref.dtype)
+        dv_ref[...] = dv_sc[...].astype(dv_ref.dtype)
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, *, bk, sm_scale, causal):
-    q = q_ref[0]                                         # native, unscaled
-    do = do_ref[0]
-    cdt = q.dtype
-    lse = lse_ref[0, :, 0:1]                     # lanes layout: col 0
-    delta = delta_ref[0, :, 0:1]
-    bq, d = q.shape
-    Tk = k_ref.shape[1]
-    qi = pl.program_id(1) * bq
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+                   sm_scale, causal, segmented, bq, bk, n_k):
+    if segmented:
+        qseg_ref, kseg_ref, dq_ref, dq_sc = rest
+    else:
+        dq_ref, dq_sc = rest
+        qseg_ref = kseg_ref = None
+    i = pl.program_id(2)                             # resident q tile
+    j = pl.program_id(3)                             # streamed k/v chunk
+    qi = i * bq
+    kj = j * bk
 
-    def body(j, dq):
-        kj = j * bk
-        k = k_ref[0, pl.ds(kj, bk), :]
-        v = v_ref[0, pl.ds(kj, bk), :]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * sm_scale
-        if causal:
-            s = jnp.where(_causal_mask(bq, bk, qi, kj), s, _NEG_INF)
+    @pl.when(j == 0)
+    def _init():
+        dq_sc[...] = jnp.zeros(dq_sc.shape, jnp.float32)
+
+    j_last = jnp.minimum((qi + bq - 1) // bk, n_k - 1) if causal \
+        else n_k - 1
+
+    def _step():
+        q = q_ref[...]                               # native, unscaled
+        do = do_ref[...]
+        k = k_ref[...]
+        v = v_ref[...]
+        cdt = q.dtype
+        lse = _read_stat(lse_ref)
+        delta = _read_stat(delta_ref)
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * sm_scale
+        s = _apply_masks(s, causal=causal, qi=qi, kj=kj, bq=bq, bk=bk,
+                         qseg_ref=qseg_ref, kseg_ref=kseg_ref)
         p = jnp.exp(s - lse)
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
+        dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
-        return dq + jax.lax.dot_general(ds.astype(cdt), k,
-                                        (((1,), (0,)), ((), ())),
-                                        preferred_element_type=jnp.float32)
+        dq_sc[...] = dq_sc[...] + lax.dot_general(
+            ds.astype(cdt), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
-    dq0 = jnp.zeros((bq, d), jnp.float32)
     if causal:
-        n_k_eff = jnp.minimum(lax.div(qi + bq - 1, bk) + 1, Tk // bk)
-        dq = lax.fori_loop(0, n_k_eff, body, dq0)
+        @pl.when(j <= j_last)
+        def _run():
+            _step()
     else:
-        dq = lax.fori_loop(0, Tk // bk, body, dq0)
-    dq_ref[0] = (dq * sm_scale).astype(dq_ref.dtype)
+        _step()
+
+    @pl.when(j == j_last)
+    def _epilogue():
+        dq_ref[...] = (dq_sc[...] * sm_scale).astype(dq_ref.dtype)
 
 
-def _flash_bwd(sm_scale, causal, bq, bk, res, g):
-    q, k, v, out, lse = res
-    do, _ = g
-    B, H, Tq, d = q.shape
-    Tk = k.shape[2]
-    bq = min(bq, Tq)
-    bk = min(bk, Tk)
+def _flash_bwd(sm_scale, causal, blocks, layout, res, g):
+    q, k, v, out, lse, segment_ids = res
+    do = g
+    B, Tq, H, d = _shapes(layout, q)
+    _, Tk, _, _ = _shapes(layout, k)
+    blocks = blocks.clamp(Tq, Tk)
+    bq, bk = blocks.bq_bwd, blocks.bk_bwd
+    _check_blocks(Tq, Tk, bq, bk)
+    n_q, n_k = Tq // bq, Tk // bk
+    # delta = rowsum(do * out), fp32, in the lanes-broadcast layout —
+    # [B, H, Tq, LANES] regardless of operand layout (d is reduced away,
+    # so the bthd transpose here moves stats only, never a d-sized tensor)
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), -1)
-    # per-row statistics travel in the (rows, 128)-lane layout (see _LANES)
-    delta_lanes = jnp.broadcast_to(
-        delta.reshape(B * H, Tq)[:, :, None], (B * H, Tq, _LANES))
-    args = [q.reshape(B * H, Tq, d), k.reshape(B * H, Tk, d),
-            v.reshape(B * H, Tk, d), do.reshape(B * H, Tq, d),
-            lse, delta_lanes]
-    qspec_full = pl.BlockSpec((1, Tq, d), lambda b, j: (b, 0, 0))
-    vec_full = pl.BlockSpec((1, Tq, _LANES), lambda b, j: (b, 0, 0))
+    if layout == "bthd":
+        delta = delta.transpose(0, 2, 1)             # [B, Tq, H] → [B,H,Tq]
+    delta_lanes = jnp.broadcast_to(delta[..., None], (B, H, Tq, _LANES))
+
+    segmented = segment_ids is not None
+    seg_args = []
+    if segmented:
+        qseg, kseg = _seg_operands(segment_ids, B, Tq, Tk)
+        seg_args = [qseg, kseg]
+
+    # dKV: resident K/V tile (grid dim 2), streamed Q/dO (grid dim 3)
+    def q_idx(t, s):
+        # skipped leading chunks (fully above the diagonal) clamp to the
+        # first contributing one, suppressing their copies
+        return jnp.minimum(jnp.maximum(s, (t * bk) // bq), n_q - 1) \
+            if causal else s
+
+    dkv_in = [_tile_spec(layout, bq, d, q_idx),              # q
+              _tile_spec(layout, bk, d, lambda t, s: t),     # k
+              _tile_spec(layout, bk, d, lambda t, s: t),     # v
+              _tile_spec(layout, bq, d, q_idx),              # do
+              _lanes_spec(bq, q_idx),                        # lse
+              _lanes_spec(bq, q_idx)]                        # delta
+    if segmented:
+        dkv_in += [_qseg_spec(bq, q_idx),
+                   _kseg_spec(bk, lambda t, s: t)]
+    kv_shape = ((B, H, Tk, d) if layout == "bhtd" else (B, Tk, H, d))
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, bq=bq, sm_scale=sm_scale,
-                          causal=causal),
-        grid=(B * H, Tk // bk),
-        in_specs=[qspec_full,
-                  pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
-                  pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
-                  qspec_full, vec_full, vec_full],
-        out_specs=[pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
-                   pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0))],
-        out_shape=[jax.ShapeDtypeStruct((B * H, Tk, d), k.dtype),
-                   jax.ShapeDtypeStruct((B * H, Tk, d), v.dtype)],
-        compiler_params=_PARALLEL,
+        functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
+                          segmented=segmented, bq=bq, bk=bk, n_q=n_q),
+        grid=(B, H, n_k, n_q),
+        in_specs=dkv_in,
+        out_specs=[_tile_spec(layout, bk, d, lambda t, s: t),
+                   _tile_spec(layout, bk, d, lambda t, s: t)],
+        out_shape=[jax.ShapeDtypeStruct(kv_shape, k.dtype),
+                   jax.ShapeDtypeStruct(kv_shape, v.dtype)],
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
+        compiler_params=_STREAMED,
         interpret=_interpret(),
-    )(*args)
-    kv_full = pl.BlockSpec((1, Tk, d), lambda b, i: (b, 0, 0))
+    )(q, k, v, do, lse, delta_lanes, *seg_args)
+
+    # dQ: resident Q tile (grid dim 2), streamed K/V (grid dim 3)
+    def kv_idx(t, s):
+        return jnp.minimum(s, (t * bq + bq - 1) // bk) if causal else s
+
+    dq_in = [_tile_spec(layout, bq, d, lambda t, s: t),      # q
+             _tile_spec(layout, bk, d, kv_idx),              # k
+             _tile_spec(layout, bk, d, kv_idx),              # v
+             _tile_spec(layout, bq, d, lambda t, s: t),      # do
+             _lanes_spec(bq, lambda t, s: t),                # lse
+             _lanes_spec(bq, lambda t, s: t)]                # delta
+    if segmented:
+        dq_in += [_qseg_spec(bq, lambda t, s: t),
+                  _kseg_spec(bk, kv_idx)]
+    q_shape = ((B, H, Tq, d) if layout == "bhtd" else (B, Tq, H, d))
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, bk=bk, sm_scale=sm_scale,
-                          causal=causal),
-        grid=(B * H, Tq // bq),
-        in_specs=[pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
-                  kv_full, kv_full,
-                  pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
-                  pl.BlockSpec((1, bq, _LANES), lambda b, i: (b, i, 0)),
-                  pl.BlockSpec((1, bq, _LANES), lambda b, i: (b, i, 0))],
-        out_specs=pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, Tq, d), q.dtype),
-        compiler_params=_PARALLEL,
+        functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+                          segmented=segmented, bq=bq, bk=bk, n_k=n_k),
+        grid=(B, H, n_q, n_k),
+        in_specs=dq_in,
+        out_specs=_tile_spec(layout, bq, d, lambda t, s: t),
+        out_shape=jax.ShapeDtypeStruct(q_shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        compiler_params=_STREAMED,
         interpret=_interpret(),
-    )(*args)
-    return (dq.reshape(B, H, Tq, d), dk.reshape(B, H, Tk, d),
-            dv.reshape(B, H, Tk, d))
+    )(q, k, v, do, lse, delta_lanes, *seg_args)
+    return dq, dk, dv
 
 
 # ---------------------------------------------------------------------------
 # public op
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def flash_attention(q, k, v, sm_scale: Optional[float] = None,
-                    causal: bool = False, bq: int = DEFAULT_BQ,
-                    bk: int = DEFAULT_BK):
-    """q,k,v: [B, H, T, D] → [B, H, T, D]."""
-    (out, _lse), _ = _fwd_rule(q, k, v, sm_scale, causal, bq, bk)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash_attention(q, k, v, segment_ids, sm_scale, causal, blocks,
+                     layout):
+    out, _ = _flash_fwd(q, k, v, segment_ids, sm_scale, causal, blocks,
+                        layout)
     return out
 
 
-def _fwd_rule(q, k, v, sm_scale, causal, bq, bk):
-    scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(q.shape[-1])
-    out, lse = _flash_fwd(q, k, v, scale, causal, bq, bk)
-    return (out, lse), (q, k, v, out, lse)
+def _vjp_fwd(q, k, v, segment_ids, sm_scale, causal, blocks, layout):
+    out, lse = _flash_fwd(q, k, v, segment_ids, sm_scale, causal, blocks,
+                          layout)
+    return out, (q, k, v, out, lse, segment_ids)
 
 
-def _vjp_fwd(q, k, v, sm_scale, causal, bq, bk):
-    (out, lse), res = _fwd_rule(q, k, v, sm_scale, causal, bq, bk)
-    return out, res
+def _float0_zeros(x):
+    return np.zeros(x.shape, jax.dtypes.float0)
 
 
-def _vjp_bwd(sm_scale, causal, bq, bk, res, g):
-    scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(
-        res[0].shape[-1])
-    return _flash_bwd(scale, causal, bq, bk, res, (g, None))
+def _vjp_bwd(sm_scale, causal, blocks, layout, res, g):
+    dq, dk, dv = _flash_bwd(sm_scale, causal, blocks, layout, res, g)
+    segment_ids = res[5]
+    dseg = None if segment_ids is None else SegmentIds(
+        _float0_zeros(segment_ids.q), _float0_zeros(segment_ids.kv))
+    return dq, dk, dv, dseg
 
 
-flash_attention.defvjp(_vjp_fwd, _vjp_bwd)
+_flash_attention.defvjp(_vjp_fwd, _vjp_bwd)
 
 
-def mha_flash_attention(q, k, v, mask=None, *, causal: bool = False):
-    """Adapter with the [B, T, H, D] layout of
-    :func:`tosem_tpu.nn.attention.dot_product_attention`. ``mask`` must be
-    None (padding masks take the XLA path)."""
+def _resolve(q, k, v, sm_scale, bq, bk, block_sizes, layout):
+    _, Tq, _, d = _shapes(layout, q)
+    _, Tk, _, _ = _shapes(layout, k)
+    scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(d)
+    if block_sizes is None:
+        if bq is None and bk is None:
+            block_sizes = select_block_sizes(Tq, d, str(q.dtype), Tk)
+        else:
+            bq = DEFAULT_BQ if bq is None else bq
+            bk = DEFAULT_BK if bk is None else bk
+            block_sizes = BlockSizes(bq=bq, bk=bk, bq_bwd=bq, bk_bwd=bk)
+    return scale, block_sizes.clamp(Tq, Tk)
+
+
+def flash_attention(q, k, v, sm_scale: Optional[float] = None,
+                    causal: bool = False, bq: Optional[int] = None,
+                    bk: Optional[int] = None, *,
+                    block_sizes: Optional[BlockSizes] = None,
+                    segment_ids: Optional[SegmentIds] = None,
+                    layout: str = "bhtd"):
+    """q,k,v: [B, H, T, D] (``layout="bhtd"``, default) or [B, T, H, D]
+    (``layout="bthd"``) → same layout out. With neither bq/bk nor
+    ``block_sizes`` given, blocks come from the selection table /
+    autotune cache (:func:`select_block_sizes`); ``block_sizes``
+    overrides the positional bq/bk with independent fwd/bwd chunks;
+    ``segment_ids`` enables kernel-level padding/segment masking."""
+    scale, blocks = _resolve(q, k, v, sm_scale, bq, bk, block_sizes, layout)
+    return _flash_attention(q, k, v, segment_ids, scale, causal, blocks,
+                            layout)
+
+
+def mha_flash_attention(q, k, v, mask=None, *, causal: bool = False,
+                        segment_ids: Optional[SegmentIds] = None,
+                        block_sizes: Optional[BlockSizes] = None):
+    """Flash attention in the native [B, T, H, D] layout of
+    :func:`tosem_tpu.nn.attention.dot_product_attention` — the kernels
+    index heads via BlockSpecs, so no transposed copy of q/k/v/o is ever
+    materialized. ``mask`` must be None: express padding as
+    ``segment_ids`` (``flash_attn_fn`` converts key-padding masks
+    automatically; arbitrary dense masks take the XLA path)."""
     if mask is not None:
-        raise ValueError("flash path supports causal/none masks only")
-    out = flash_attention(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
-                          v.transpose(0, 2, 1, 3), None, causal)
-    return out.transpose(0, 2, 1, 3)
+        raise ValueError("flash path takes causal/segment masks only; "
+                         "pass padding as segment_ids (flash_attn_fn "
+                         "does this) or use the XLA path")
+    return flash_attention(q, k, v, None, causal,
+                           block_sizes=block_sizes,
+                           segment_ids=segment_ids, layout="bthd")

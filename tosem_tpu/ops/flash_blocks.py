@@ -1,0 +1,312 @@
+"""Flash-attention block-size selection: table, VMEM budget, autotune.
+
+The streamed kernels in :mod:`tosem_tpu.ops.flash_attention` tile the
+sequence into (bq, bk) chunks; the chunk sizes trade MXU efficiency
+(bigger scores blocks amortize the online-softmax epilogue) against VMEM
+residency (q/k/v chunks + fp32 accumulators must fit on-chip, double
+buffered). This module owns that choice, TensorRT-tactic-selection
+style:
+
+- a static per-(T, d, dtype) **selection table** with the north-star
+  b8_t512 d64 bf16 entry pinned to the round-5 sweep winner;
+- a **VMEM-budget fallback** that halves blocks until the estimated
+  residency fits (so t4096/t8192 legs run instead of OOMing Mosaic);
+- an on-chip **autotune()** sweep that measures candidate blocks with
+  the device-loop harness and caches winners to
+  ``results/flash_blocks.json`` — the table answers instantly, the
+  cache (when present) wins over the table.
+"""
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Tuple
+
+_LANES = 128
+_SUBLANES = 8
+
+# Usable VMEM for one kernel instance. v5e has ~16 MiB/core; leave
+# headroom for Mosaic's own spills, semaphores and the double-buffered
+# output windows the estimate below does not model exactly.
+DEFAULT_VMEM_BUDGET = 12 << 20
+
+DEFAULT_CACHE_PATH = os.path.join("results", "flash_blocks.json")
+
+
+@dataclass(frozen=True)
+class BlockSizes:
+    """Kernel chunk sizes. ``bq``/``bk`` drive the forward kernel;
+    ``bq_bwd``/``bk_bwd`` drive both backward kernels (dKV streams Q in
+    ``bq_bwd`` chunks around resident ``bk_bwd`` K/V tiles; dQ streams
+    K/V in ``bk_bwd`` chunks around a resident ``bq_bwd`` Q tile)."""
+    bq: int = 128
+    bk: int = 128
+    bq_bwd: int = 128
+    bk_bwd: int = 128
+
+    def clamp(self, Tq: int, Tk: int) -> "BlockSizes":
+        return BlockSizes(bq=min(self.bq, Tq), bk=min(self.bk, Tk),
+                          bq_bwd=min(self.bq_bwd, Tq),
+                          bk_bwd=min(self.bk_bwd, Tk))
+
+    def as_list(self) -> List[int]:
+        return [self.bq, self.bk, self.bq_bwd, self.bk_bwd]
+
+
+# (T, d, dtype) -> BlockSizes. T is the KV sequence length the kernels
+# stream over. The b8_t512 d64 bfloat16 entry is the north-star shape:
+# full-T tiles — at T=512 one K/V tile is 64 KiB, streaming buys nothing
+# and the single-chunk grid keeps the epilogue out of the inner loop
+# (the round-4/5 on-chip sweeps picked (512, 512) over (128..256) too).
+_TABLE: Dict[Tuple[int, int, str], BlockSizes] = {
+    (512, 64, "bfloat16"): BlockSizes(512, 512, 512, 512),
+    (512, 64, "float32"): BlockSizes(256, 512, 256, 512),
+    (1024, 64, "bfloat16"): BlockSizes(512, 512, 512, 512),
+    # long context: the T^2 scores block is the VMEM hog — keep bq at
+    # 512 (2 MiB fp32 scores at bk=1024) and stream K/V in 1024-chunks
+    (2048, 64, "bfloat16"): BlockSizes(512, 1024, 512, 512),
+    (4096, 64, "bfloat16"): BlockSizes(512, 1024, 512, 512),
+    (8192, 64, "bfloat16"): BlockSizes(512, 1024, 512, 512),
+}
+
+_DEFAULT = BlockSizes(512, 512, 512, 512)
+
+
+def vmem_bytes_estimate(blocks: BlockSizes, d: int, itemsize: int) -> int:
+    """Rough per-core VMEM residency of the streamed kernels.
+
+    Streamed operands count twice (Mosaic double-buffers the HBM copy
+    of the next chunk against compute on the current one); resident
+    tiles and fp32 scratch accumulators count once; the fp32 scores
+    block lives in registers/VMEM during the cell. Returns the max over
+    the three kernels — the budget must hold for fwd AND bwd since one
+    train step runs all of them.
+    """
+    lane_stats = _LANES * 4                       # one (rows, 128) fp32 row
+    fwd = (2 * blocks.bq * d * itemsize            # q tile (dbl-buffered)
+           + 2 * 2 * blocks.bk * d * itemsize      # k, v streamed
+           + blocks.bq * blocks.bk * 4             # fp32 scores
+           + blocks.bq * d * 4                     # fp32 acc scratch
+           + 2 * blocks.bq * lane_stats            # m, l scratch
+           + 2 * blocks.bq * (d * itemsize + lane_stats))  # o + lse out
+    bq, bk = blocks.bq_bwd, blocks.bk_bwd
+    dkv = (2 * 2 * bq * d * itemsize               # q, do streamed
+           + 2 * 2 * bq * lane_stats               # lse, delta streamed
+           + 2 * 2 * bk * d * itemsize             # k, v resident tiles
+           + bq * bk * 4                           # fp32 scores
+           + 2 * bk * d * 4                        # dk, dv scratch
+           + 2 * 2 * bk * d * itemsize)            # dk, dv out windows
+    dq = (2 * 2 * bk * d * itemsize                # k, v streamed
+          + 2 * bq * d * itemsize                  # q, do resident
+          + 2 * bq * lane_stats                    # lse, delta resident
+          + bq * bk * 4                            # fp32 scores
+          + bq * d * 4                             # dq scratch
+          + 2 * bq * d * itemsize)                 # dq out window
+    return max(fwd, dkv, dq)
+
+
+def _fit_to_budget(blocks: BlockSizes, Tq: int, Tk: int, d: int,
+                   itemsize: int, budget: int) -> BlockSizes:
+    """Halve block sizes (largest first, K/V before Q) until the
+    residency estimate fits ``budget``. Floors: 128 on the K axis (it is
+    the lane dim of the scores block) and 8 sublanes on the Q axis —
+    below those Mosaic can't tile the blocks anyway."""
+    bq, bk, bqb, bkb = blocks.bq, blocks.bk, blocks.bq_bwd, blocks.bk_bwd
+    k_floor = min(_LANES, Tk)
+    q_floor = min(_SUBLANES, Tq)
+    for _ in range(64):
+        cur = BlockSizes(bq, bk, bqb, bkb)
+        if vmem_bytes_estimate(cur, d, itemsize) <= budget:
+            return cur
+        shrunk = False
+        for name in ("bk", "bk_bwd", "bq", "bq_bwd"):
+            val = {"bq": bq, "bk": bk, "bq_bwd": bqb, "bk_bwd": bkb}[name]
+            floor = k_floor if name.startswith("bk") else q_floor
+            if val // 2 >= floor:
+                if name == "bq":
+                    bq //= 2
+                elif name == "bk":
+                    bk //= 2
+                elif name == "bq_bwd":
+                    bqb //= 2
+                else:
+                    bkb //= 2
+                shrunk = True
+                break
+        if not shrunk:
+            return BlockSizes(bq, bk, bqb, bkb)   # at floors: best effort
+    return BlockSizes(bq, bk, bqb, bkb)
+
+
+def _align_to_seq(blocks: BlockSizes, Tq: int, Tk: int) -> BlockSizes:
+    """Shrink any block that does not divide its sequence length to the
+    largest power-of-two divisor ≤ it (the kernels require T % block
+    == 0)."""
+    def fit(b: int, T: int) -> int:
+        b = min(b, T)
+        while b > 1 and T % b:
+            b //= 2
+        return max(b, 1)
+    return BlockSizes(fit(blocks.bq, Tq), fit(blocks.bk, Tk),
+                      fit(blocks.bq_bwd, Tq), fit(blocks.bk_bwd, Tk))
+
+
+_cache: Optional[Dict[str, List[int]]] = None
+_cache_path_loaded: Optional[str] = None
+
+
+def _load_cache(path: str) -> Dict[str, List[int]]:
+    global _cache, _cache_path_loaded
+    if _cache is not None and _cache_path_loaded == path:
+        return _cache
+    data: Dict[str, List[int]] = {}
+    try:
+        with open(path) as f:
+            raw = json.load(f)
+        data = {k: v for k, v in raw.get("blocks", {}).items()
+                if isinstance(v, list) and len(v) == 4}
+    except (OSError, ValueError):
+        data = {}
+    _cache, _cache_path_loaded = data, path
+    return data
+
+
+def _cache_key(T: int, d: int, dtype: str) -> str:
+    return f"t{T}_d{d}_{dtype}"
+
+
+def select_block_sizes(Tq: int, d: int, dtype: str, Tk: Optional[int] = None,
+                       *, vmem_budget: int = DEFAULT_VMEM_BUDGET,
+                       cache_path: Optional[str] = DEFAULT_CACHE_PATH
+                       ) -> BlockSizes:
+    """Pick block sizes for a (T, d, dtype) shape.
+
+    Priority: autotune cache (measured on-chip) → static table →
+    default; then clamp to the sequence lengths, align to divisibility,
+    and apply the VMEM-budget fallback. ``dtype`` is the operand dtype
+    name ("bfloat16"/"float32")."""
+    Tk = Tq if Tk is None else Tk
+    dtype = str(dtype)
+    picked: Optional[BlockSizes] = None
+    src = "default"
+    if cache_path:
+        hit = _load_cache(cache_path).get(_cache_key(Tk, d, dtype))
+        if hit:
+            picked, src = BlockSizes(*hit), "cache"
+    if picked is None:
+        hit = _TABLE.get((Tk, d, dtype))
+        if hit is not None:
+            picked, src = hit, "table"
+    if picked is None:
+        picked = _DEFAULT
+    picked = _align_to_seq(picked.clamp(Tq, Tk), Tq, Tk)
+    import numpy as np
+    itemsize = np.dtype(dtype).itemsize if dtype else 4
+    fitted = _fit_to_budget(picked, Tq, Tk, d, itemsize, vmem_budget)
+    fitted = _align_to_seq(fitted, Tq, Tk)
+    select_block_sizes.last_source = src if fitted == picked else "vmem"
+    return fitted
+
+
+select_block_sizes.last_source = "default"
+
+
+# ---------------------------------------------------------------------------
+# on-chip autotune
+
+# candidate (bq, bk) pairs; bwd reuses the fwd winner's bq/bk by default
+# (one compile per candidate keeps the sweep inside a tunnel window)
+_CANDIDATES = ((128, 128), (256, 256), (256, 512), (512, 512),
+               (512, 1024), (1024, 512))
+
+
+def autotune(shapes: Iterable[Tuple[int, int, int, int, str]],
+             *, reps: int = 3, cache_path: str = DEFAULT_CACHE_PATH,
+             include_bwd: bool = False) -> List[dict]:
+    """Measure candidate block sizes on the current device and cache the
+    winners.
+
+    ``shapes``: iterables of (B, H, T, d, dtype). Returns one record per
+    measured candidate (``{"shape", "blocks", "time_us", "best"}``) so
+    callers can emit sweep rows; winners are written to ``cache_path``
+    (merged over any existing entries) for ``select_block_sizes`` to
+    pick up."""
+    import jax
+    import jax.numpy as jnp
+
+    from tosem_tpu.ops.flash_attention import flash_attention
+    from tosem_tpu.utils.timing import DeviceLoopBench
+
+    records: List[dict] = []
+    winners: Dict[str, List[int]] = {}
+    for B, H, T, d, dtype in shapes:
+        dt = jnp.dtype(dtype)
+        ks = jax.random.split(jax.random.PRNGKey(0), 3)
+        q = jax.random.normal(ks[0], (B, H, T, d), jnp.float32).astype(dt)
+        k = jax.random.normal(ks[1], (B, H, T, d), jnp.float32).astype(dt)
+        v = jax.random.normal(ks[2], (B, H, T, d), jnp.float32).astype(dt)
+        cands = []
+        for bq, bk in _CANDIDATES:
+            bq, bk = min(bq, T), min(bk, T)
+            if T % bq or T % bk:
+                continue
+            bs = _fit_to_budget(BlockSizes(bq, bk, bq, bk), T, T, d,
+                                dt.itemsize, DEFAULT_VMEM_BUDGET)
+            if (bs.bq, bs.bk) != (bq, bk):
+                continue                     # over budget at this shape
+            if (bq, bk) not in cands:
+                cands.append((bq, bk))
+        best = None
+        timed = []
+        for bq, bk in cands:
+            fwd = jax.jit(lambda a, b, c, bq=bq, bk=bk:
+                          flash_attention(a, b, c, None, False, bq, bk))
+            if include_bwd:
+                fn = jax.jit(jax.grad(
+                    lambda a, b, c, bq=bq, bk=bk: jnp.sum(
+                        flash_attention(a, b, c, None, False, bq, bk)
+                        .astype(jnp.float32) ** 2)))
+                op = lambda a, b, c, fn=fn: jnp.stack(
+                    [jnp.mean(fn(a, b, c).astype(jnp.float32))])
+            else:
+                op = fwd
+            sec = DeviceLoopBench(op=op, args=(q, k, v),
+                                  perturb=0).time(reps=reps)
+            timed.append(((bq, bk), sec))
+            if best is None or sec < best[1]:
+                best = ((bq, bk), sec)
+        for (bq, bk), sec in timed:
+            records.append({"shape": [B, H, T, d, dtype],
+                            "blocks": [bq, bk, bq, bk],
+                            "time_us": sec * 1e6,
+                            "best": (bq, bk) == best[0]})
+        if best is not None:
+            bq, bk = best[0]
+            winners[_cache_key(T, d, str(dtype))] = [bq, bk, bq, bk]
+    if winners:
+        save_cache(winners, cache_path)
+    return records
+
+
+def save_cache(winners: Dict[str, List[int]],
+               cache_path: str = DEFAULT_CACHE_PATH) -> None:
+    """Merge winners into the JSON cache (atomic write)."""
+    global _cache, _cache_path_loaded
+    merged = dict(_load_cache(cache_path))
+    merged.update(winners)
+    payload = {"blocks": merged}
+    d = os.path.dirname(cache_path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    tmp = cache_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, indent=1, sort_keys=True)
+    os.replace(tmp, cache_path)
+    _cache, _cache_path_loaded = merged, cache_path
+
+
+def reset_cache() -> None:
+    """Drop the in-process cache view (tests; after external writes)."""
+    global _cache, _cache_path_loaded
+    _cache, _cache_path_loaded = None, None

@@ -13,6 +13,7 @@ import jax
 import jax.numpy as jnp
 
 from tosem_tpu.ops.flash_attention import flash_attention
+from tosem_tpu.ops.flash_blocks import select_block_sizes
 from tosem_tpu.ops.fused_norms import fused_layernorm, fused_softmax
 from tosem_tpu.utils.results import ResultRow
 from tosem_tpu.utils.timing import DeviceLoopBench
@@ -25,11 +26,33 @@ def _row(bench_id, metric, value, unit, extra):
                      extra=extra)
 
 
-def attention_flops(B, H, T, D, *, bwd: bool) -> float:
+def causal_block_fraction(T: int, bq: int, bk: int) -> float:
+    """Fraction of (q-chunk, k-chunk) grid cells a causal kernel actually
+    executes: cells fully above the diagonal are grid-skipped (no copy,
+    no MXU work). Both loop nests (K streamed past Q, Q streamed past
+    K/V) execute exactly the straddle-or-below pairs, so one fraction
+    serves fwd and bwd at a given chunking. → 1.0 at full-T blocks
+    (nothing skippable — the diagonal block IS the grid), → ~0.5 as
+    blocks shrink."""
+    bq, bk = min(bq, T), min(bk, T)
+    n_q, n_k = T // bq, T // bk
+    done = sum(min((i * bq + bq - 1) // bk + 1, n_k) for i in range(n_q))
+    return done / float(n_q * n_k)
+
+
+def attention_flops(B, H, T, D, *, bwd: bool,
+                    causal_fraction: float = 1.0) -> float:
     """fwd: QK^T + PV = 2 matmuls = 4*B*H*T^2*D. bwd (flash, recompute):
-    S recompute + dV + dP + dK + dQ = 5 matmuls = 10*B*H*T^2*D."""
+    S recompute + dV + dP + dK + dQ = 5 matmuls = 10*B*H*T^2*D.
+
+    ``causal_fraction`` (from :func:`causal_block_fraction`) scales the
+    T² terms down to the block pairs the causal grid actually schedules
+    — derived from the REAL chunking, not an asymptotic /2, so MFU never
+    under- or over-counts (at full-T blocks nothing is skipped and the
+    fraction is 1.0)."""
     fwd = 4.0 * B * H * T * T * D
-    return fwd + (10.0 * B * H * T * T * D if bwd else 0.0)
+    total = fwd + (10.0 * B * H * T * T * D if bwd else 0.0)
+    return total * causal_fraction
 
 
 def bert_kernel_suite(*, batch: int = 8, seq: int = 512, heads: int = 12,
@@ -44,37 +67,32 @@ def bert_kernel_suite(*, batch: int = 8, seq: int = 512, heads: int = 12,
     v = jax.random.normal(ks[2], (B, H, T, D), jnp.float32).astype(dt)
     rows: List[ResultRow] = []
 
-    # attention forward — autotune the block sizes on the device (the
-    # TensorRT-plugin practice of tactic selection): sweep fwd, reuse the
-    # winning blocks for fwd+bwd so the bwd pass compiles only once
-    # candidates above 512 only help (and only tile) at long T; scores
-    # block stays ≤2 MB f32 so VMEM holds q/k/v blocks + stats alongside
-    sweep = {(min(bq, T), min(bk, T))
-             for bq, bk in ((128, 128), (256, 256), (256, 512),
-                            (512, 512), (512, 1024), (1024, 512))
-             if T % min(bq, T) == 0 and T % min(bk, T) == 0}
+    # attention block sizes: the selection table / autotune cache
+    # (tosem_tpu.ops.flash_blocks — the TensorRT-plugin practice of
+    # tactic selection, measured once by the flash_autotune leg and
+    # cached to results/flash_blocks.json; the north-star b8_t512 d64
+    # bf16 entry is pinned in the table so a cold cache still runs the
+    # tuned shape)
+    blocks = select_block_sizes(T, D, dtype)
+    blocks_src = select_block_sizes.last_source
     fl = attention_flops(B, H, T, D, bwd=False)
-    best = None
-    for bq, bk in sorted(sweep):
-        fwd = jax.jit(lambda a, b, c, bq=bq, bk=bk:
-                      flash_attention(a, b, c, None, False, bq, bk))
-        sec = DeviceLoopBench(op=fwd, args=(q, k, v),
-                              perturb=0).time(reps=reps)
-        if best is None or sec < best[0]:
-            best = (sec, bq, bk)
-    sec, bq, bk = best
+    fwd = jax.jit(lambda a, b, c: flash_attention(a, b, c, None, False,
+                                                  block_sizes=blocks))
+    sec = DeviceLoopBench(op=fwd, args=(q, k, v), perturb=0).time(reps=reps)
     rows.append(_row(f"attention_fwd_b{B}_t{T}_{dtype}", "gflops",
                      fl / sec / 1e9, "GFLOPS",
                      {"flop_model": "4BHT^2D", "time_us": sec * 1e6,
                       "shape": [B, H, T, D], "dtype": dtype,
-                      "blocks": [bq, bk]}))
+                      "blocks": blocks.as_list(),
+                      "blocks_src": blocks_src}))
 
     # attention forward+backward. The op must consume dq AND dk/dv — the
     # dKV pallas_call is independent of dq, so returning grads[0] alone
     # would let XLA dead-code-eliminate it and inflate the GFLOPS ~40%.
     grad_fn = jax.jit(jax.grad(
-        lambda a, b, c: jnp.sum(flash_attention(a, b, c, None, False, bq, bk)
-                                .astype(jnp.float32) ** 2), (0, 1, 2)))
+        lambda a, b, c: jnp.sum(
+            flash_attention(a, b, c, None, False, block_sizes=blocks)
+            .astype(jnp.float32) ** 2), (0, 1, 2)))
 
     def _all_grads(fn):
         return lambda *xs: jnp.stack(
@@ -87,7 +105,54 @@ def bert_kernel_suite(*, batch: int = 8, seq: int = 512, heads: int = 12,
                      fl / sec / 1e9, "GFLOPS",
                      {"flop_model": "14BHT^2D", "time_us": sec * 1e6,
                       "shape": [B, H, T, D], "dtype": dtype,
-                      "blocks": [bq, bk]}))
+                      "blocks": blocks.as_list(),
+                      "blocks_src": blocks_src}))
+
+    # causal legs: the flop model counts only the block pairs the causal
+    # grid actually schedules (causal_block_fraction of the square, from
+    # the REAL chunking — ~0.5 at fine blocks, 1.0 at full-T blocks
+    # where nothing is grid-skippable), so MFU measures work the
+    # hardware ran, never a fake 2× from counting skipped blocks — and
+    # never an understated half when the chunking can't skip any
+    frac_fwd = causal_block_fraction(T, blocks.bq, blocks.bk)
+    frac_bwd = causal_block_fraction(T, blocks.bq_bwd, blocks.bk_bwd)
+    fwd_c = jax.jit(lambda a, b, c: flash_attention(a, b, c, None, True,
+                                                    block_sizes=blocks))
+    sec = DeviceLoopBench(op=fwd_c, args=(q, k, v),
+                          perturb=0).time(reps=reps)
+    fl = attention_flops(B, H, T, D, bwd=False, causal_fraction=frac_fwd)
+    rows.append(_row(f"attention_fwd_causal_b{B}_t{T}_{dtype}", "gflops",
+                     fl / sec / 1e9, "GFLOPS",
+                     {"flop_model": f"4BHT^2D x {frac_fwd:.4g} (causal: "
+                                    "executed block pairs only)",
+                      "causal": True, "causal_fraction": frac_fwd,
+                      "time_us": sec * 1e6,
+                      "shape": [B, H, T, D], "dtype": dtype,
+                      "blocks": blocks.as_list(),
+                      "blocks_src": blocks_src}))
+    grad_c = jax.jit(jax.grad(
+        lambda a, b, c: jnp.sum(
+            flash_attention(a, b, c, None, True, block_sizes=blocks)
+            .astype(jnp.float32) ** 2), (0, 1, 2)))
+    sec = DeviceLoopBench(op=_all_grads(grad_c), args=(q, k, v),
+                          perturb=0).time(reps=reps)
+    # fwd term skips at the fwd chunking, bwd terms at the bwd chunking
+    fl = (attention_flops(B, H, T, D, bwd=False,
+                          causal_fraction=frac_fwd)
+          + (attention_flops(B, H, T, D, bwd=True,
+                             causal_fraction=frac_bwd)
+             - attention_flops(B, H, T, D, bwd=False,
+                               causal_fraction=frac_bwd)))
+    rows.append(_row(f"attention_fwdbwd_causal_b{B}_t{T}_{dtype}",
+                     "gflops", fl / sec / 1e9, "GFLOPS",
+                     {"flop_model": f"(4 x {frac_fwd:.4g} + 10 x "
+                                    f"{frac_bwd:.4g})BHT^2D (causal: "
+                                    "executed block pairs only)",
+                      "causal": True, "causal_fraction": frac_bwd,
+                      "time_us": sec * 1e6,
+                      "shape": [B, H, T, D], "dtype": dtype,
+                      "blocks": blocks.as_list(),
+                      "blocks_src": blocks_src}))
 
     # XLA-path attention at the same shape: the direct flash-vs-XLA
     # comparison rows (quantifies what the Pallas kernel buys — or

@@ -1,0 +1,75 @@
+"""Sharded flash attention: the Pallas kernel under ``shard_map``.
+
+The streamed kernel in :mod:`tosem_tpu.ops.flash_attention` is a
+single-chip program; this wrapper partitions it over the mesh the way
+the SNIPPETS [1] reference does — batch over the data axis, heads over
+the model axis, sequence unsharded (every chip owns its heads' full K/V
+stream; sequence-sharded long context is :mod:`tosem_tpu.parallel.ring`'s
+job). ``shard_map`` composes under ``jit``, so the returned callable
+drops into a GSPMD-partitioned train step, and the per-chip body is the
+unmodified kernel — Mosaic still double-buffers the K/V chunks locally.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tosem_tpu.parallel.compat import shard_map
+from tosem_tpu.ops.flash_attention import (BlockSizes, SegmentIds,
+                                           flash_attention)
+
+
+def sharded_flash_attention(mesh: Mesh, *, causal: bool = False,
+                            sm_scale: Optional[float] = None,
+                            data_axis: str = "dp",
+                            model_axis: Optional[str] = "tp",
+                            layout: str = "bthd",
+                            block_sizes: Optional[BlockSizes] = None):
+    """Build a jitted ``(q, k, v[, segment_ids]) -> out`` over ``mesh``.
+
+    q/k/v use ``layout`` ("bthd" = the nn-layer [B, T, H, D] default);
+    batch shards over ``data_axis``, heads over ``model_axis`` (pass
+    None for a data-only mesh). ``segment_ids`` (optional) shards its
+    batch dim over ``data_axis`` alongside q/k/v."""
+    h_axis = model_axis
+    if h_axis is not None and h_axis not in mesh.axis_names:
+        raise ValueError(f"model axis {h_axis!r} not in mesh "
+                         f"{mesh.axis_names}")
+    if data_axis not in mesh.axis_names:
+        raise ValueError(f"data axis {data_axis!r} not in mesh "
+                         f"{mesh.axis_names}")
+    if layout == "bthd":
+        op_spec = P(data_axis, None, h_axis, None)
+    elif layout == "bhtd":
+        op_spec = P(data_axis, h_axis, None, None)
+    else:
+        raise ValueError(f"unknown layout {layout!r}")
+    seg_spec = SegmentIds(P(data_axis, None), P(data_axis, None))
+
+    def _local(q, k, v, segment_ids):
+        return flash_attention(q, k, v, sm_scale, causal,
+                               block_sizes=block_sizes,
+                               segment_ids=segment_ids, layout=layout)
+
+    # segment_ids' None-ness is static at trace time: the unmasked call
+    # gets the plain kernel (no broadcast seg operands, no per-block
+    # where), the masked one the segmented variant
+    sharded_plain = shard_map(
+        lambda q, k, v: _local(q, k, v, None), mesh=mesh,
+        in_specs=(op_spec, op_spec, op_spec),
+        out_specs=op_spec, check_vma=False)
+    sharded_seg = shard_map(
+        _local, mesh=mesh,
+        in_specs=(op_spec, op_spec, op_spec, seg_spec),
+        out_specs=op_spec, check_vma=False)
+
+    @jax.jit
+    def run(q, k, v, segment_ids: Optional[SegmentIds] = None):
+        if segment_ids is None:
+            return sharded_plain(q, k, v)
+        return sharded_seg(q, k, v, segment_ids)
+
+    return run

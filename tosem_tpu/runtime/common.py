@@ -38,6 +38,10 @@ DEFAULT_MAX_TASK_RETRIES = 3  # reference: ray default task max_retries
 MAX_RECONSTRUCTION_ATTEMPTS = 3
 MAX_RECONSTRUCTION_DEPTH = 8
 MAX_LINEAGE_ENTRIES = 4096
+# completed-task-id memory for at-least-once dedup: a late duplicate
+# "done" (steal race: stolen AND finished by the original worker) must
+# be dropped, not re-applied — bounded FIFO like lineage
+MAX_COMPLETED_TIDS = 4096
 # actor state recovery: snapshot the actor every N calls; between
 # snapshots at most N method calls are kept for replay-on-restart
 ACTOR_SNAPSHOT_EVERY = 8

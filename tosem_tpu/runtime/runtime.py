@@ -232,6 +232,16 @@ class Runtime:
         self.lineage: "OrderedDict[bytes, _Lineage]" = OrderedDict()
         self._recon_attempts: Dict[bytes, int] = {}
         self._reconstructing: Set[bytes] = set()
+        # at-least-once dedup (steal races): task_id → (result kind,
+        # result oid) of completed tasks, bounded FIFO like lineage, so
+        # a duplicate "done" (task stolen AND finished by the original
+        # worker) is dropped instead of re-applied; _evicted tracks the
+        # result oids the DRIVER deleted from the store (chaos eviction)
+        # so a duplicate's worker-side re-put can be told apart from a
+        # legitimately live object and undone
+        self._completed: "OrderedDict[bytes, Tuple[str, bytes]]" = \
+            OrderedDict()
+        self._evicted: Set[bytes] = set()
         # task state. Scheduling is indexed, not scanned (the fast path):
         #  - pending: every undispatched spec, keyed by task_id
         #  - _ready_q: per-placement-pool FIFO of dep-free stateless task
@@ -1419,11 +1429,15 @@ class Runtime:
             return
         self.deadlined.discard(tid)
         rkey = spec.result_ref.oid.binary
+        self._completed[tid] = (kind, rkey)
+        while len(self._completed) > common.MAX_COMPLETED_TIDS:
+            self._completed.popitem(last=False)
         if kind == "inline":
             self.inline[rkey] = payload
         if kind == "inline" or kind == "store":
             if kind == "store":
                 self.in_store.add(rkey)
+                self._evicted.discard(rkey)
             if spec.fn_id is not None:
                 # remember how to re-derive this object (lineage);
                 # bounded FIFO — an evicted entry's object can no longer
@@ -1445,6 +1459,7 @@ class Runtime:
                 # ObjectLostError when reconstruction is off/exhausted
                 try:
                     self.store.delete(ObjectID(rkey))
+                    self._evicted.add(rkey)
                 except Exception:
                     pass
         self._reconstructing.discard(rkey)
@@ -1594,6 +1609,13 @@ class Runtime:
             return True
         elif kind == "done":
             _, tid, rkind, payload = msg
+            if tid not in self.specs and tid in self._completed:
+                # at-least-once duplicate: the task was stolen (or
+                # replayed) AND the original worker finished it too.
+                # Drop it — never re-put, never re-record lineage — so
+                # a stolen-then-finished task cannot resurrect an
+                # evicted object and skew recovery determinism.
+                return self._drop_duplicate_done_locked(w, tid, rkind)
             act = _chaos.fire("runtime.result",
                               target="actor" if w.actor_id
                               else "task", worker=w.wid)
@@ -1666,6 +1688,35 @@ class Runtime:
                 self._fail_actor_tasks_locked(w.actor_id, err)
                 return True
         return False
+
+    def _drop_duplicate_done_locked(self, w: _Worker, tid: bytes,
+                                    rkind: str) -> bool:
+        """Discard a "done" for an already-completed task id.
+
+        The reporting worker's bookkeeping still advances (inflight slot
+        freed, progress clock bumped) but completion state does NOT: the
+        first "done" already recorded inline/in_store and lineage. A
+        "store"-kind duplicate has already re-put the result object
+        worker-side (``robust_store_put_parts`` runs before the message
+        is sent); when the driver's copy is gone — ref released, or
+        deliberately evicted under chaos/memory pressure — that re-put
+        is a resurrection that would make a later ``get()`` silently
+        skip lineage reconstruction, so it is deleted here."""
+        if tid in w.inflight:
+            w.inflight.remove(tid)
+            self._push_idle_locked(w)
+        w.last_progress = time.monotonic()
+        _, rkey = self._completed[tid]
+        # never delete under an in-flight reconstruction: the healing
+        # task re-puts the SAME object id, and racing its completion
+        # here would destroy the freshly rebuilt result
+        if rkind == "store" and rkey not in self._reconstructing \
+                and (rkey not in self.in_store or rkey in self._evicted):
+            try:
+                self.store.delete(ObjectID(rkey))
+            except Exception:
+                pass
+        return True
 
     def _fail_actor_tasks_locked(self, actor_id: bytes,
                                  err: BaseException) -> None:

@@ -53,17 +53,27 @@ LEGS = [
     # the meter first: if the two timing harnesses disagree, every other
     # number this session needs the arbitration context
     ("timing_check", CLI + ["--config=timing_check"], 1200),
+    # block-size sweep BEFORE the kernel suite: winners cache to
+    # results/flash_blocks.json, so the bert_kernels MFU rows (the
+    # verdict-gated evidence) measure with tuned blocks
+    ("flash_autotune", CLI + ["--config=flash_autotune"], 2400),
     _north_star_leg("bert_kernels"),
     _north_star_leg("resnet_train"),
     _north_star_leg("bert_train"),
     _north_star_leg("conv_sweep"),
     _north_star_leg("allreduce"),
-    # long-context kernel evidence: the same suite at 4x/8x the
-    # north-star sequence (T^2 attention term dominates here)
+    # long-context kernel evidence: the same suite at 4x/8x/16x the
+    # north-star sequence (T^2 attention term dominates here; the
+    # streamed kernels keep VMEM at O(block·d) so all legs run at full
+    # block sizes — t8192 was impossible with full-T K/V blocks)
     ("bert_kernels_t2048", CLI + ["--config=bert_kernels", "--seq=2048"],
      2400),
     ("bert_kernels_t4096", CLI + ["--config=bert_kernels", "--seq=4096"],
      2400),
+    # b2 keeps the chained-loop call under the leg timeout (T² work is
+    # 4× the t4096 leg per batch row)
+    ("bert_kernels_t8192", CLI + ["--config=bert_kernels", "--seq=8192",
+                                  "--batch=2"], 2400),
     ("bert_train_remat_dots", CLI + ["--config=bert_train", "--remat=dots"],
      1500),
     ("bert_train_remat_full", CLI + ["--config=bert_train", "--remat=full"],
