@@ -11,9 +11,11 @@
 #                    numerics/unit files (no process-spawning suites)
 #                    + the 3-plan chaos smoke (the one deliberate
 #                    process-spawning step, so fault paths gate every PR)
-#   ./ci.sh --perf   perf_smoke tier (~2 min): syntax gate + the runtime
+#   ./ci.sh --perf   perf_smoke tier (~3 min): syntax gate + the runtime
 #                    microbenchmarks gated against the recorded baseline
-#                    (results/bench_runtime_post.json) — fails on >30%
+#                    (results/bench_runtime_post.json) + the serving
+#                    data-plane benches gated against
+#                    results/bench_serve.json — fails on >30%
 #                    throughput regression on any gated bench
 set -euo pipefail
 cd "$(dirname "$0")"
@@ -52,6 +54,19 @@ perf_smoke() {
   if ! JAX_PLATFORMS=cpu "${cmd[@]}"; then
     echo "== perf smoke: regression reported; one retry (noisy host?)"
     JAX_PLATFORMS=cpu "${cmd[@]}"
+  fi
+  # serving data plane: the micro-batching fast path (batched vs
+  # unbatched closed loop + the batch speedup ratio, which is phase-
+  # immune because both sides of a round share the host phase).
+  # Baseline floors are the min across recorded rounds (--serve --save
+  # writes min-of-rounds, per the bench-noise protocol).
+  echo "== perf smoke (serve microbench vs results/bench_serve.json)"
+  local scmd=(python -m tosem_tpu.cli microbench --serve --trials 2
+              --min-s 0.4 --quiet --only gated
+              --check results/bench_serve.json --threshold 0.30)
+  if ! JAX_PLATFORMS=cpu "${scmd[@]}"; then
+    echo "== perf smoke: serve regression reported; one retry (noisy host?)"
+    JAX_PLATFORMS=cpu "${scmd[@]}"
   fi
 }
 

@@ -1,0 +1,562 @@
+"""Micro-batching data plane tests (PR 5).
+
+Covers the serve fast path end to end: bit-exact parity of batched vs
+sequential responses on the model backends (BERT padded, speech
+bucketed), flush-on-size vs flush-on-timeout ordering, per-request
+poison isolation, chaos ``serve.dispatch`` faults while a batch is in
+flight, LOGICAL-request accounting in the circuit breaker and the
+autoscaler's load signal, and the deploy-time warm compile cache.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import tosem_tpu.runtime as rt
+from tosem_tpu.chaos import ChaosController, Fault, FaultPlan
+from tosem_tpu.data.feeding import bucket_for, pad_target
+from tosem_tpu.serve.batching import BatchPolicy
+from tosem_tpu.serve.breaker import (CLOSED, OPEN, CircuitBreaker,
+                                     CircuitOpen)
+from tosem_tpu.serve.compile_cache import CompileCache, shape_key
+from tosem_tpu.serve.core import Serve, ServeFuture
+
+
+# ---------------------------------------------------------- test backends
+
+class BatchEcho:
+    """Echoes each request back with the batch size and pad bucket it
+    was served under — the observable for flush-policy assertions."""
+
+    def call(self, request):
+        return {"i": request["i"], "n": 1, "bucket": None}
+
+    def call_batch(self, requests, pad_to=None):
+        n = len(requests)
+        return [{"i": r["i"], "n": n, "bucket": pad_to} for r in requests]
+
+
+class PoisonAware:
+    """Vectorized path refuses any batch containing a poison request;
+    the per-request path fails only the poison itself. Exercises the
+    replica wrapper's fallback isolation."""
+
+    def call(self, request):
+        if request.get("poison"):
+            raise ValueError("poison request rejected")
+        return request["i"] * 10
+
+    def call_batch(self, requests, pad_to=None):
+        if any(r.get("poison") for r in requests):
+            raise ValueError("poison batch rejected")
+        return [r["i"] * 10 for r in requests]
+
+
+class SlowBatch:
+    def call(self, request):
+        time.sleep(float(request.get("s", 0.3)))
+        return "done"
+
+    def call_batch(self, requests, pad_to=None):
+        time.sleep(max(float(r.get("s", 0.3)) for r in requests))
+        return ["done"] * len(requests)
+
+
+@pytest.fixture(scope="module")
+def serve():
+    own = not rt.is_initialized()
+    if own:
+        rt.init(num_workers=2, memory_monitor=False)
+    s = Serve()
+    yield s
+    for name in list(s.list_deployments()):
+        s.delete(name)
+    if own:
+        rt.shutdown()
+
+
+# ------------------------------------------------------------- unit layer
+
+class TestBucketRouting:
+    def test_bucket_for_smallest_fit(self):
+        assert bucket_for(3, [4, 8, 16]) == 4
+        assert bucket_for(4, [4, 8, 16]) == 4
+        assert bucket_for(5, [4, 8, 16]) == 8
+        assert bucket_for(17, [4, 8, 16]) is None
+
+    def test_pad_target_overlong_aligns(self):
+        assert pad_target(5, [4, 8], align=1) == 8
+        assert pad_target(9, [4, 8], align=1) == 9      # own shape
+        assert pad_target(9, [4, 8], align=128) == 128  # tile-aligned
+        assert pad_target(130, [128], align=128) == 256
+
+    def test_policy_bucket_of(self):
+        p = BatchPolicy(buckets=[4, 8],
+                        length_of=lambda r: len(r["seq"]), align=1)
+        assert p.bucket_of({"seq": [1, 2, 3]}) == 4
+        assert p.bucket_of({"seq": list(range(7))}) == 8
+        # no palette: everything shares the None bin
+        assert BatchPolicy().bucket_of({"seq": [1]}) is None
+
+    def test_policy_validation(self):
+        with pytest.raises(ValueError):
+            BatchPolicy(max_batch_size=0)
+        with pytest.raises(ValueError):
+            BatchPolicy(batch_wait_ms=-1.0)
+        with pytest.raises(ValueError):
+            BatchPolicy(max_inflight_per_replica=0)
+
+    def test_pad_ids_batch_shapes_and_overlong(self):
+        from tosem_tpu.models.bert import pad_ids_batch
+        ids, mask, lengths = pad_ids_batch([[1, 2], [3, 4, 5]], 8,
+                                           pad_batch_to=4)
+        assert ids.shape == mask.shape == (4, 8)
+        assert list(lengths) == [2, 3, 0, 0]
+        assert mask[2, 0] == 1 and mask[3, 0] == 1   # filler rows: 1 token
+        assert mask[0].sum() == 2 and mask[1].sum() == 3
+        with pytest.raises(ValueError, match="exceeds"):
+            pad_ids_batch([list(range(9))], 8)
+
+    def test_pad_feats_batch_shapes_and_overlong(self):
+        from tosem_tpu.models.speech import pad_feats_batch
+        feats, lengths = pad_feats_batch(
+            [np.ones((3, 5), np.float32), np.ones((6, 5), np.float32)],
+            8, pad_batch_to=4)
+        assert feats.shape == (4, 8, 5)
+        assert list(lengths) == [3, 6, 0, 0]
+        assert feats[0, 3:].sum() == 0               # zero tail
+        with pytest.raises(ValueError, match="exceeds"):
+            pad_feats_batch([np.ones((9, 5), np.float32)], 8)
+
+
+class TestCompileCache:
+    def test_build_once_and_stats(self):
+        c = CompileCache()
+        calls = []
+        k = shape_key("m", (8, 128), "bfloat16")
+        assert c.get_or_build(k, lambda: calls.append(1) or "exe") == "exe"
+        assert c.get_or_build(k, lambda: calls.append(1) or "exe2") == "exe"
+        assert len(calls) == 1
+        assert k in c and len(c) == 1
+        st = c.stats()
+        assert st["hits"] == 1 and st["misses"] == 1
+        c.clear()
+        assert len(c) == 0
+
+    def test_concurrent_builders_build_once(self):
+        c = CompileCache()
+        built = []
+
+        def build():
+            time.sleep(0.05)          # widen the race window
+            built.append(1)
+            return "exe"
+
+        results = []
+        threads = [threading.Thread(
+            target=lambda: results.append(c.get_or_build("k", build)))
+            for _ in range(8)]
+        [t.start() for t in threads]
+        [t.join() for t in threads]
+        assert built == [1]           # the losers blocked on the winner
+        assert results == ["exe"] * 8
+
+    def test_shape_key_canonical(self):
+        assert shape_key("m", [np.int64(8), 128], np.dtype("float32")) \
+            == ("m", (8, 128), "float32")
+
+    def test_cache_tag_distinguishes_models(self):
+        # the cache is process-wide: co-located replicas of DIFFERENT
+        # models (weights seed, config, flash routing) must never share
+        # an executable, while replicas of the same deployment must
+        from tosem_tpu.serve.backends import BertEncodeBackend
+        a = BertEncodeBackend(max_len=128, max_batch=4, seed=0)
+        b = BertEncodeBackend(max_len=128, max_batch=4, seed=1)
+        c = BertEncodeBackend(max_len=256, max_batch=4, seed=0)
+        d = BertEncodeBackend(max_len=128, max_batch=4, seed=0,
+                              use_flash=False)
+        same = BertEncodeBackend(max_len=128, max_batch=4, seed=0)
+        assert len({a._tag, b._tag, c._tag, d._tag}) == 4
+        assert a._tag == same._tag
+
+
+class TestBreakerLogicalCounts:
+    def test_batch_failure_counts_per_request(self):
+        # satellite: a 16-request batch loss is 16 trips of evidence —
+        # one record call with count=16 must open a threshold-16 breaker
+        b = CircuitBreaker(failure_threshold=16, cooldown_s=5.0)
+        b.record_failure(count=16)
+        assert b.state == OPEN
+
+    def test_count_below_threshold_stays_closed(self):
+        b = CircuitBreaker(failure_threshold=17, cooldown_s=5.0)
+        b.record_failure(count=16)
+        assert b.state == CLOSED
+        b.record_failure()            # the 17th consecutive request
+        assert b.state == OPEN
+
+    def test_count_validation(self):
+        b = CircuitBreaker()
+        with pytest.raises(ValueError):
+            b.record_failure(count=0)
+
+
+# ------------------------------------------------------ data-plane layer
+
+class TestFlushPolicy:
+    def test_flush_on_size(self, serve):
+        # adaptive off + long wait: ONLY a full bin may flush early
+        pol = BatchPolicy(max_batch_size=4, batch_wait_ms=2000.0,
+                          adaptive=False)
+        serve.deploy("flush-size", BatchEcho, num_replicas=1,
+                     batch_policy=pol)
+        h = serve.get_handle("flush-size")
+        warm = [h.remote({"i": i}) for i in range(4)]   # cold boot: one
+        [f.result(timeout=120.0) for f in warm]         # full batch
+        t0 = time.monotonic()
+        futs = [h.remote({"i": i}) for i in range(4)]
+        outs = [f.result(timeout=60.0) for f in futs]
+        assert time.monotonic() - t0 < 1.5     # did not wait out 2000ms
+        assert all(o["n"] == 4 for o in outs)
+        # scatter ordering: each future got ITS request back
+        assert [o["i"] for o in outs] == [0, 1, 2, 3]
+        serve.delete("flush-size")
+
+    def test_flush_on_timeout(self, serve):
+        pol = BatchPolicy(max_batch_size=8, batch_wait_ms=100.0,
+                          adaptive=False)
+        serve.deploy("flush-time", BatchEcho, num_replicas=1,
+                     batch_policy=pol)
+        h = serve.get_handle("flush-time")
+        futs = [h.remote({"i": i}) for i in range(3)]
+        outs = [f.result(timeout=60.0) for f in futs]
+        assert all(o["n"] == 3 for o in outs)  # partial batch, on deadline
+        assert [o["i"] for o in outs] == [0, 1, 2]
+        serve.delete("flush-time")
+
+    def test_adaptive_idle_dispatches_immediately(self, serve):
+        # the Clipper insight: an idle deployment must not tax a lone
+        # request with the batch wait
+        serve.deploy("adaptive", BatchEcho, num_replicas=1,
+                     max_batch_size=8, batch_wait_ms=5000.0)
+        h = serve.get_handle("adaptive")
+        h.call({"i": 0}, timeout=60.0)         # cold boot
+        t0 = time.monotonic()
+        out = h.call({"i": 1}, timeout=60.0)
+        assert time.monotonic() - t0 < 2.0     # nowhere near 5000ms
+        assert out["n"] == 1
+        serve.delete("adaptive")
+
+    def test_bucket_routing_segregates_batches(self, serve):
+        pol = BatchPolicy(max_batch_size=4, batch_wait_ms=150.0,
+                          adaptive=False, buckets=[4, 8], align=1,
+                          length_of=lambda r: len(r["seq"]))
+        serve.deploy("bucketed", BatchEcho, num_replicas=1,
+                     batch_policy=pol)
+        h = serve.get_handle("bucketed")
+        short = [h.remote({"i": i, "seq": [0] * 3}) for i in range(4)]
+        longer = [h.remote({"i": 10 + i, "seq": [0] * 7})
+                  for i in range(4)]
+        s_out = [f.result(timeout=60.0) for f in short]
+        l_out = [f.result(timeout=60.0) for f in longer]
+        # each batch carried exactly its palette bucket — short and long
+        # requests never shared a batch
+        assert all(o["bucket"] == 4 and o["n"] == 4 for o in s_out)
+        assert all(o["bucket"] == 8 and o["n"] == 4 for o in l_out)
+        serve.delete("bucketed")
+
+    def test_pinned_handle_bypasses_batching(self, serve):
+        dep = serve.deploy("pinned", BatchEcho, num_replicas=1,
+                           max_batch_size=4)
+        f = dep.handle(pin=0).remote({"i": 7})
+        assert isinstance(f, ServeFuture)      # session affinity: direct
+        assert f.result(timeout=60.0)["n"] == 1
+        serve.delete("pinned")
+
+    def test_batched_future_timeout_then_result(self, serve):
+        serve.deploy("slowq", SlowBatch, num_replicas=1,
+                     max_batch_size=2, batch_wait_ms=5.0)
+        h = serve.get_handle("slowq")
+        h.call({"s": 0.01}, timeout=60.0)      # cold boot
+        f = h.remote({"s": 1.0})
+        with pytest.raises(TimeoutError):
+            f.result(timeout=0.05)
+        assert f.result(timeout=60.0) == "done"
+        serve.delete("slowq")
+
+    def test_sync_call_timeout_bounds_inline_path(self, serve):
+        # the idle-queue sync fast path completes inline on the caller
+        # thread: the caller's timeout must still bound the wait (the
+        # inline rt.get is clipped to the deadline, like ServeFuture)
+        serve.deploy("synct", SlowBatch, num_replicas=1,
+                     max_batch_size=4, batch_wait_ms=5.0, max_retries=0)
+        h = serve.get_handle("synct")
+        h.call({"s": 0.01}, timeout=60.0)      # cold boot
+        t0 = time.monotonic()
+        with pytest.raises(TimeoutError):
+            h.call({"s": 30.0}, timeout=0.4)
+        assert time.monotonic() - t0 < 10.0    # nowhere near the 30s call
+        serve.delete("synct")
+
+    def test_delete_fails_queued_requests(self, serve):
+        pol = BatchPolicy(max_batch_size=1, batch_wait_ms=1.0,
+                          max_inflight_per_replica=1)
+        serve.deploy("doomedq", SlowBatch, num_replicas=1,
+                     batch_policy=pol)
+        h = serve.get_handle("doomedq")
+        h.call({"s": 0.01}, timeout=60.0)      # cold boot
+        futs = [h.remote({"s": 0.5}) for _ in range(4)]  # 1 flying, 3 queued
+        time.sleep(0.1)
+        serve.delete("doomedq")
+        errs = 0
+        for f in futs:
+            try:
+                f.result(timeout=60.0)
+            except Exception:
+                errs += 1
+        assert errs >= 3                       # every queued request failed
+        with pytest.raises(Exception, match="closed|deleted"):
+            h.remote({"s": 0.1})
+
+
+class TestPoisonIsolation:
+    def test_poison_fails_only_its_future(self, serve):
+        breaker = CircuitBreaker(failure_threshold=4, cooldown_s=5.0)
+        pol = BatchPolicy(max_batch_size=4, batch_wait_ms=150.0,
+                          adaptive=False)
+        dep = serve.deploy("poison", PoisonAware, num_replicas=1,
+                           batch_policy=pol, circuit_breaker=breaker)
+        h = serve.get_handle("poison")
+        reqs = [{"i": 0}, {"i": 1}, {"i": 2, "poison": True}, {"i": 3}]
+        futs = [h.remote(r) for r in reqs]
+        assert futs[0].result(timeout=60.0) == 0
+        assert futs[1].result(timeout=60.0) == 10
+        with pytest.raises(rt.TaskError, match="poison"):
+            futs[2].result(timeout=60.0)
+        assert futs[3].result(timeout=60.0) == 30
+        # one poison request is ONE failure — far from tripping the
+        # breaker, and the queue's per-request ledger shows 3/1
+        assert breaker.state == CLOSED
+        st = dep._queue.stats()
+        assert st["requests_ok"] == 3 and st["requests_err"] == 1
+        serve.delete("poison")
+
+
+class TestChaosBatchInFlight:
+    def test_batch_transport_failure_isolated_and_recovers(self, serve):
+        """serve.dispatch crash while a batch is in flight: with
+        retries exhausted, only THAT batch's futures error; the breaker
+        counts one trip per logical request and later batches (restarted
+        replica) succeed, closing the ledger."""
+        breaker = CircuitBreaker(failure_threshold=50, cooldown_s=0.5)
+        pol = BatchPolicy(max_batch_size=4, batch_wait_ms=150.0,
+                          adaptive=False)
+        serve.deploy("chaosb", BatchEcho, num_replicas=1, max_restarts=2,
+                     max_retries=0, batch_policy=pol,
+                     circuit_breaker=breaker)
+        h = serve.get_handle("chaosb")
+        plan = FaultPlan(seed=5, faults=[
+            Fault(site="serve.dispatch", action="crash_replica", at=1)])
+        with ChaosController(plan) as chaos:
+            futs = [h.remote({"i": i}) for i in range(4)]
+            for f in futs:
+                with pytest.raises((rt.ActorDiedError,
+                                    rt.WorkerCrashedError)):
+                    f.result(timeout=60.0)
+            assert chaos.injections("serve.dispatch")
+        assert breaker._consecutive_failures == 4   # 4 trips, 1 dispatch
+        assert breaker.state == CLOSED              # 4 < 50
+        # the restarted replica serves the next batch: sane recovery
+        futs = [h.remote({"i": i}) for i in range(4)]
+        outs = [f.result(timeout=60.0) for f in futs]
+        assert [o["i"] for o in outs] == [0, 1, 2, 3]
+        assert breaker._consecutive_failures == 0
+        serve.delete("chaosb")
+
+    def test_one_batch_loss_opens_request_threshold_breaker(self, serve):
+        """The satellite's headline: a 4-request batch loss must open a
+        threshold-4 breaker in ONE dispatch failure — and the batched
+        .remote() path rejects with CircuitOpen exactly like the
+        unbatched path."""
+        breaker = CircuitBreaker(failure_threshold=4, cooldown_s=30.0)
+        pol = BatchPolicy(max_batch_size=4, batch_wait_ms=150.0,
+                          adaptive=False)
+        serve.deploy("chaost", BatchEcho, num_replicas=1, max_restarts=2,
+                     max_retries=0, batch_policy=pol,
+                     circuit_breaker=breaker)
+        h = serve.get_handle("chaost")
+        plan = FaultPlan(seed=6, faults=[
+            Fault(site="serve.dispatch", action="crash_replica", at=1)])
+        with ChaosController(plan):
+            futs = [h.remote({"i": i}) for i in range(4)]
+            for f in futs:
+                with pytest.raises((rt.ActorDiedError,
+                                    rt.WorkerCrashedError)):
+                    f.result(timeout=60.0)
+        assert breaker.state == OPEN
+        with pytest.raises(CircuitOpen):
+            h.remote({"i": 9})
+        serve.delete("chaost")
+
+    def test_batch_retry_absorbs_crash(self, serve):
+        """With retries available, a chaos-crashed dispatch is retried
+        on the restarted replica: every future succeeds, breaker sane."""
+        breaker = CircuitBreaker(failure_threshold=50, cooldown_s=5.0)
+        pol = BatchPolicy(max_batch_size=4, batch_wait_ms=150.0,
+                          adaptive=False)
+        serve.deploy("chaosr", BatchEcho, num_replicas=2, max_restarts=2,
+                     max_retries=3, batch_policy=pol,
+                     circuit_breaker=breaker)
+        h = serve.get_handle("chaosr")
+        plan = FaultPlan(seed=7, faults=[
+            Fault(site="serve.dispatch", action="crash_replica", at=1)])
+        with ChaosController(plan) as chaos:
+            futs = [h.remote({"i": i}) for i in range(4)]
+            outs = [f.result(timeout=120.0) for f in futs]
+            assert chaos.injections("serve.dispatch")
+        assert [o["i"] for o in outs] == [0, 1, 2, 3]
+        assert all(o["n"] == 4 for o in outs)
+        assert breaker.state == CLOSED
+        assert breaker._consecutive_failures == 0
+        serve.delete("chaosr")
+
+
+class TestLogicalLoadSignal:
+    def test_queue_depth_drives_autoscaler(self, serve):
+        """Satellite: queue depth — not in-flight batches — is the
+        demand signal. One in-flight batch plus a deep queue must read
+        as many logical requests and scale the deployment up."""
+        from tosem_tpu.serve import ServeAutoscaler, ServeScaleConfig
+        pol = BatchPolicy(max_batch_size=2, batch_wait_ms=10.0,
+                          adaptive=False, max_inflight_per_replica=1)
+        dep = serve.deploy("scaleq", SlowBatch, num_replicas=1,
+                           batch_policy=pol)
+        a = ServeAutoscaler(serve, configs={"scaleq": ServeScaleConfig(
+            min_replicas=1, max_replicas=3,
+            target_inflight_per_replica=2.0,
+            idle_ticks_before_downscale=2)})
+        h = serve.get_handle("scaleq")
+        h.call({"s": 0.01}, timeout=120.0)     # cold boot
+        futs = [h.remote({"s": 0.5}) for _ in range(8)]
+        # at most one 2-request batch is in flight; the other >=5 are
+        # queued — load() must see LOGICAL requests, not dispatches
+        load = dep.load()
+        assert load >= 5, load
+        a.tick()
+        assert dep.num_replicas > 1
+        for f in futs:
+            f.result(timeout=120.0)
+        time.sleep(0.2)
+        for _ in range(8):
+            a.tick()
+        assert dep.num_replicas == 1           # idles back down
+        serve.delete("scaleq")
+
+
+# ------------------------------------------------------ model parity layer
+
+class TestModelBackendParity:
+    def test_bert_batched_vs_sequential_bitexact_and_flash(self, serve):
+        """Acceptance: batched and sequential BERT responses are
+        bit-exact, the deploy-time warm cache pre-compiles the bucket,
+        and the replica's dispatch tally proves the padded batches ran
+        the flash kernels (xla count stays 0)."""
+        from tosem_tpu.serve.backends import BertEncodeBackend
+        kw = {"max_len": 128, "max_batch": 4, "seed": 3}
+        dep = serve.deploy("bert", BertEncodeBackend, num_replicas=1,
+                           init_kwargs=kw, max_batch_size=4,
+                           batch_wait_ms=150.0, buckets=[128],
+                           length_of=BertEncodeBackend.length_of,
+                           warmup_shapes=[128])
+        # warm cache filled at deploy time, before any request
+        st = rt.get(dep._replicas[0].stats.remote(), timeout=120.0)
+        assert st["compile_cache"]["entries"] >= 1
+        reqs = [{"ids": list(range(1, 2 + 7 * (i + 1)))} for i in range(4)]
+        h = serve.get_handle("bert")
+        futs = [h.remote(r) for r in reqs]
+        batched = [f.result(timeout=300.0) for f in futs]
+        # sequential reference: same shapes, same weights, local process
+        local = BertEncodeBackend(**kw)
+        sequential = [local.call(r) for r in reqs]
+        for b, s, r in zip(batched, sequential, reqs):
+            assert b["len"] == s["len"] == len(r["ids"])
+            assert np.array_equal(b["pooled"], s["pooled"])   # bit-exact
+        st = rt.get(dep._replicas[0].stats.remote(), timeout=60.0)
+        disp = st["flash_dispatch"]
+        assert disp["flash"] >= 1 and disp.get("xla", 0) == 0
+        assert st["compile_cache"]["hits"] >= 1   # calls reused the warm exe
+        serve.delete("bert")
+
+    def test_bert_backend_rejects_poison_inputs(self):
+        # out-of-vocab ids would gather out of bounds and silently NaN
+        # the whole row; the backend must raise instead, so per-request
+        # isolation fails just the poison future (validation runs
+        # before padding/compile — no model execution needed)
+        from tosem_tpu.serve.backends import BertEncodeBackend
+        b = BertEncodeBackend(max_len=128, max_batch=4)
+        with pytest.raises(ValueError, match="out of range"):
+            b.call_batch([{"ids": [999]}], pad_to=128)
+        with pytest.raises(ValueError, match="out of range"):
+            b.call_batch([{"ids": [-1]}], pad_to=128)
+        with pytest.raises(ValueError, match="empty"):
+            b.call_batch([{"ids": []}], pad_to=128)
+
+    def test_speech_batched_vs_sequential_bitexact(self, serve):
+        from tosem_tpu.serve.speech import SpeechBatchBackend
+        kw = {"cfg_name": "tiny", "seed": 1, "max_batch": 4}
+        serve.deploy("speechb", SpeechBatchBackend, num_replicas=1,
+                     init_kwargs=kw, max_batch_size=4, batch_wait_ms=150.0,
+                     buckets=[16, 32],
+                     length_of=SpeechBatchBackend.length_of,
+                     warmup_shapes=[16, 32])
+        rng = np.random.default_rng(0)
+        lens = [10, 14, 25, 30]
+        reqs = [{"frames": rng.normal(size=(t, 13)).astype(
+            np.float32).tolist()} for t in lens]
+        h = serve.get_handle("speechb")
+        futs = [h.remote(r) for r in reqs]
+        batched = [f.result(timeout=300.0) for f in futs]
+        local = SpeechBatchBackend(**kw)
+        for out, r, t in zip(batched, reqs, lens):
+            bucket = pad_target(t, [16, 32])
+            ref = local.call_batch([r], pad_to=bucket)[0]
+            assert out["frames"] == ref["frames"] == t
+            assert out["text"] == ref["text"]
+        serve.delete("speechb")
+
+
+class TestStatsSurface:
+    def test_serve_stats_and_http_endpoint(self, serve):
+        import json
+        import urllib.request
+        from tosem_tpu.serve import HttpIngress
+        serve.deploy("statd", BatchEcho, num_replicas=1,
+                     max_batch_size=4, batch_wait_ms=5.0)
+        h = serve.get_handle("statd")
+        h.call({"i": 0}, timeout=60.0)
+        st = serve.stats()["statd"]
+        assert st["batched"] is True
+        assert st["max_batch_size"] == 4
+        assert st["requests_ok"] >= 1
+        ingress = HttpIngress(serve)
+        try:
+            with urllib.request.urlopen(f"{ingress.url}/-/stats",
+                                        timeout=30) as r:
+                body = json.loads(r.read())
+            assert body["deployments"]["statd"]["batched"] is True
+        finally:
+            ingress.shutdown()
+        serve.delete("statd")
+
+    def test_batch_metrics_registered(self, serve):
+        from tosem_tpu.obs.metrics import DEFAULT
+        serve.deploy("metd", BatchEcho, num_replicas=1,
+                     max_batch_size=4, batch_wait_ms=5.0)
+        h = serve.get_handle("metd")
+        h.call({"i": 0}, timeout=60.0)
+        assert DEFAULT.get("serve_queue_depth") is not None
+        assert DEFAULT.get("serve_batch_wait_ms") is not None
+        assert DEFAULT.get("serve_requests_total").value(
+            ("metd", "ok")) >= 1
+        serve.delete("metd")

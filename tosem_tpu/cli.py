@@ -27,7 +27,8 @@ from tosem_tpu.utils.flags import FlagSet
 CONFIGS = ("gemm", "timing_check", "conv_sweep", "allreduce",
            "resnet_train", "bert_kernels", "bert_train",
            "flash_autotune", "detection_train", "detection_infer",
-           "pointpillars_infer", "speech_train", "analysis")
+           "pointpillars_infer", "speech_train", "serve_bench",
+           "analysis")
 
 
 def make_flags() -> FlagSet:
@@ -840,6 +841,20 @@ def run_speech_train(fs: FlagSet) -> List[Any]:
         return rows
 
 
+def run_serve_bench(fs: FlagSet) -> List[Any]:
+    """Serving data-plane microbench as a capture-harness leg: the
+    closed-loop batched-vs-unbatched A/B plus the warm-vs-cold
+    first-request probe of the deploy-time compile cache
+    (see :mod:`tosem_tpu.serve.bench_serve`). Rows are re-tagged under
+    the ``serve_bench`` config so report bucketing keeps them out of
+    the north-star kernel configs."""
+    from tosem_tpu.serve.bench_serve import run_serve_benchmarks
+    rows = run_serve_benchmarks(trials=2, min_s=0.4)
+    for r in rows:
+        r.config = "serve_bench"
+    return rows
+
+
 def run_analysis(fs: FlagSet) -> List[Any]:
     """Study analysis layer (L8): classify this repo's test suite into the
     RQ3/RQ4 taxonomy and correlate the bench CSVs — the consumer role of
@@ -910,6 +925,7 @@ RUNNERS = {
     "detection_infer": run_detection_infer,
     "pointpillars_infer": run_pointpillars_infer,
     "speech_train": run_speech_train,
+    "serve_bench": run_serve_bench,
     "analysis": run_analysis,
 }
 

@@ -151,6 +151,31 @@ def bucket_boundaries(lengths: Sequence[int], n_buckets: int) -> List[int]:
     return out
 
 
+def bucket_for(length: int, boundaries: Sequence[int]) -> Optional[int]:
+    """Smallest palette bucket that fits ``length``, or None when it
+    exceeds the largest bucket. The single routing rule shared by the
+    training batcher (:class:`BucketedBatcher`) and the serving layer's
+    padding-bucket router (:mod:`tosem_tpu.serve.batching`), so the two
+    planes can never disagree about which pad shape a sequence gets."""
+    for b in boundaries:
+        if length <= b:
+            return b
+    return None
+
+
+def pad_target(length: int, boundaries: Sequence[int],
+               align: int = 1) -> int:
+    """Pad target for a sequence at serving time: its palette bucket
+    when one fits, else ``length`` rounded up to ``align`` (overlong
+    requests can't be dropped the way the training batcher drops them —
+    they get their own aligned shape, keeping e.g. flash-attention tile
+    eligibility where possible)."""
+    b = bucket_for(length, boundaries)
+    if b is not None:
+        return b
+    return int(math.ceil(length / align) * align) if align > 1 else length
+
+
 class BucketedBatcher:
     """Length-bucketed, padded batching (feeding.py batch_fn role).
 
@@ -168,10 +193,7 @@ class BucketedBatcher:
         self.dropped = 0   # samples rejected (overlong feature/transcript)
 
     def _bucket(self, t: int) -> Optional[int]:
-        for b in self.boundaries:
-            if t <= b:
-                return b
-        return None          # longer than the largest bucket: dropped
+        return bucket_for(t, self.boundaries)   # None: overlong, dropped
 
     def add(self, feats: np.ndarray, labels: Sequence[int]
             ) -> Optional[Batch]:

@@ -231,6 +231,49 @@ class Bert(Module):
         return self.tok.attend(variables(vs["params"]["tok"]),
                                encodings.astype(jnp.float32))
 
+    def encode_fn(self, vs, *, attn_fn=None):
+        """Batched-inference entry point: a pure ``fwd(ids, mask) ->
+        encodings`` closure over fixed variables, shaped for AOT
+        compilation per padding bucket (``jax.jit(fn).lower(...)
+        .compile()`` in the serving layer's compile cache). ``mask`` is
+        the [B, T] key-padding vector (1 = real token) the batcher
+        builds — with ``attn_fn=flash_attn_fn()`` it rides the flash
+        kernels as segment ids, so padded serving batches stay on the
+        fused path."""
+        def fwd(ids, mask):
+            enc, _ = self.apply(vs, ids, mask=mask, train=False,
+                                attn_fn=attn_fn)
+            return enc
+        return fwd
+
+
+def pad_ids_batch(id_seqs, pad_to: int, pad_batch_to: int = 0):
+    """Variable-length token-id sequences → one fixed-shape padded batch.
+
+    Returns ``(ids [B, T] int32, mask [B, T] int32, lengths)`` with
+    ``T = pad_to``; ``pad_batch_to`` additionally pads the BATCH dim
+    (zero-copy for callers at exactly that size) so the compiled-program
+    palette stays small — filler rows keep one real token so no
+    attention row is fully masked. The serving batcher pairs this with
+    the bucket palette from :func:`tosem_tpu.data.feeding.bucket_for`.
+    """
+    import numpy as np
+    B = len(id_seqs)
+    BP = max(B, pad_batch_to)
+    ids = np.zeros((BP, pad_to), np.int32)
+    mask = np.zeros((BP, pad_to), np.int32)
+    lengths = np.zeros((BP,), np.int32)
+    for i, seq in enumerate(id_seqs):
+        seq = np.asarray(seq, np.int32)
+        if len(seq) > pad_to:
+            raise ValueError(f"sequence {i} length {len(seq)} exceeds "
+                             f"pad target {pad_to}")
+        ids[i, :len(seq)] = seq
+        mask[i, :len(seq)] = 1
+        lengths[i] = len(seq)
+    mask[B:, 0] = 1            # filler rows: one real token, discarded
+    return ids, mask, lengths
+
 
 def bert_base() -> Bert:
     return Bert(BertConfig.base())

@@ -203,6 +203,39 @@ class SpeechModel(Module):
         zeros = jnp.zeros((batch, c, self.cfg.n_input))
         return self.streaming_step(vs, state, zeros)
 
+    def logits_fn(self, vs):
+        """Batched-inference entry point: a pure ``fwd(feats) -> logits``
+        closure over fixed variables, shaped for AOT compilation per
+        padding bucket in the serving layer's compile cache. The LSTM is
+        strictly left-to-right, so logits at frames < a request's true
+        length are untouched by its zero-padded tail — the batcher
+        slices each row back to its real length."""
+        def fwd(feats):
+            logits, _ = self.apply(vs, feats)
+            return logits
+        return fwd
+
+
+def pad_feats_batch(feats_list, pad_to: int, pad_batch_to: int = 0):
+    """Variable-length [T_i, F] feature sequences → one zero-padded
+    [B, pad_to, F] batch plus true lengths. ``pad_batch_to`` pads the
+    batch dim so the compiled-program palette stays small (filler rows
+    are all-zero and sliced away by their zero length)."""
+    import numpy as np
+    B = len(feats_list)
+    BP = max(B, pad_batch_to)
+    F = np.asarray(feats_list[0]).shape[-1]
+    feats = np.zeros((BP, pad_to, F), np.float32)
+    lengths = np.zeros((BP,), np.int32)
+    for i, f in enumerate(feats_list):
+        f = np.asarray(f, np.float32)
+        if f.shape[0] > pad_to:
+            raise ValueError(f"sequence {i} length {f.shape[0]} exceeds "
+                             f"pad target {pad_to}")
+        feats[i, :f.shape[0]] = f
+        lengths[i] = f.shape[0]
+    return feats, lengths
+
 
 # --------------------------------------------------------------- metrics
 

@@ -190,6 +190,50 @@ def prometheus_text() -> str:
     return DEFAULT.prometheus_text()
 
 
+# Serving data-plane buckets: micro-batch waits are bounded by
+# batch_wait_ms (single-digit ms), so the default 1ms-to-50s histogram
+# would collapse every observation into two buckets.
+_BATCH_WAIT_BUCKETS = (0.1, 0.5, 1.0, 2.0, 5.0, 10.0, 20.0, 50.0, 100.0,
+                       float("inf"))
+
+
+def serve_metrics(registry: Optional[Registry] = None) -> Dict[str, Metric]:
+    """The serving data plane's instruments, defined in ONE place so
+    :mod:`tosem_tpu.serve.batching`, the dashboard, and the tests share
+    metric names (the metric_defs.h discipline). All are labelled by
+    deployment:
+
+    - ``serve_queue_depth`` (gauge): logical requests waiting in the
+      micro-batch queue — the autoscaler-facing demand signal.
+    - ``serve_batch_size`` (gauge): size of the most recently flushed
+      micro-batch.
+    - ``serve_batch_wait_ms`` (histogram): per-request queue wait from
+      enqueue to dispatch.
+    - ``serve_requests_total`` (counter, labels deployment/outcome):
+      logical request outcomes (``ok`` / ``error``) — requests, never
+      dispatches, so a 16-request batch counts 16.
+    """
+    reg = registry or DEFAULT
+    return {
+        "queue_depth": reg.gauge(
+            "serve_queue_depth",
+            "logical requests waiting in the micro-batch queue",
+            labels=("deployment",)),
+        "batch_size": reg.gauge(
+            "serve_batch_size",
+            "size of the most recently dispatched micro-batch",
+            labels=("deployment",)),
+        "batch_wait_ms": reg.histogram(
+            "serve_batch_wait_ms",
+            "per-request wait from enqueue to micro-batch dispatch",
+            labels=("deployment",), buckets=_BATCH_WAIT_BUCKETS),
+        "requests": reg.counter(
+            "serve_requests_total",
+            "logical request outcomes (per request, not per dispatch)",
+            labels=("deployment", "outcome")),
+    }
+
+
 class MetricsServer:
     """Tiny /metrics HTTP endpoint (prometheus_exporter.py role)."""
 

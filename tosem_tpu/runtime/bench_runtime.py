@@ -264,14 +264,17 @@ def save_baseline(rows: List[ResultRow], path: str,
 
 
 def check_against_baseline(rows: List[ResultRow], baseline_path: str,
-                           threshold: float = 0.30
+                           threshold: float = 0.30,
+                           gated: Optional[Tuple[str, ...]] = None
                            ) -> Tuple[bool, List[str]]:
     """Compare a run against a recorded baseline (higher-is-better rows).
 
     Returns (ok, report_lines). A gated bench regressing by more than
     ``threshold`` (fractional) fails the gate; benches present in only
     one of the two sets are reported but do not fail (so adding a bench
-    does not break CI until a new baseline is recorded).
+    does not break CI until a new baseline is recorded). ``gated``
+    defaults to the runtime suite's :data:`GATED_BENCHES`; the serve
+    suite passes its own tuple.
     """
     try:
         with open(baseline_path) as f:
@@ -285,7 +288,7 @@ def check_against_baseline(rows: List[ResultRow], baseline_path: str,
     current = {r.bench_id: r for r in rows}
     ok = True
     report: List[str] = []
-    for bid in GATED_BENCHES:
+    for bid in (GATED_BENCHES if gated is None else gated):
         if bid not in base:
             continue
         if bid not in current:
@@ -326,29 +329,52 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="allowed fractional regression vs baseline")
     p.add_argument("--control-plane", action="store_true",
                    help="also run the RPC/channel/xlang/param benches")
+    p.add_argument("--serve", action="store_true",
+                   help="run the serving data-plane benches "
+                        "(serve/bench_serve.py) instead of the runtime "
+                        "ones — the micro-batching fast path")
     p.add_argument("--only", default=None,
                    help="comma-separated bench_id subset, or 'gated' for "
                         "exactly the perf_smoke-gated benches")
     p.add_argument("--quiet", action="store_true")
     args = p.parse_args(argv)
 
+    if args.serve:
+        from tosem_tpu.serve.bench_serve import GATED_SERVE_BENCHES
+        gated = GATED_SERVE_BENCHES
+    else:
+        gated = GATED_BENCHES
     only = None
     if args.only:
-        only = (set(GATED_BENCHES) if args.only == "gated"
+        only = (set(gated) if args.only == "gated"
                 else set(args.only.split(",")))
-    rows = run_microbenchmarks(num_workers=args.workers, trials=args.trials,
-                               min_s=args.min_s, quiet=args.quiet,
-                               only=only)
-    if args.control_plane:
-        rows += run_control_plane_benchmarks(trials=args.trials,
-                                             min_s=args.min_s,
-                                             quiet=args.quiet)
+    if args.serve:
+        from tosem_tpu.serve.bench_serve import run_serve_benchmarks
+        rows = run_serve_benchmarks(trials=args.trials, min_s=args.min_s,
+                                    quiet=args.quiet, only=only)
+    else:
+        rows = run_microbenchmarks(num_workers=args.workers,
+                                   trials=args.trials,
+                                   min_s=args.min_s, quiet=args.quiet,
+                                   only=only)
+        if args.control_plane:
+            rows += run_control_plane_benchmarks(trials=args.trials,
+                                                 min_s=args.min_s,
+                                                 quiet=args.quiet)
     if args.save:
+        if args.serve:
+            # bench-noise protocol for the bimodal shared hosts: the
+            # recorded serve floors are the MIN across interleaved
+            # rounds, not the mean — a gate floor set off a fast-phase
+            # mean fails spuriously in the slow phase
+            for r in rows:
+                r.value = float(r.extra.get("min", r.value))
         save_baseline(rows, args.save, num_workers=args.workers)
         print(f"baseline -> {args.save}")
     if args.check:
         ok, report = check_against_baseline(rows, args.check,
-                                            threshold=args.threshold)
+                                            threshold=args.threshold,
+                                            gated=gated)
         print(f"perf gate vs {args.check} (threshold "
               f"{args.threshold:.0%}):")
         for line in report:

@@ -3,18 +3,31 @@
 Ray Serve's controller/router/replica architecture (SURVEY §2.1) rebuilt on
 the single-controller actor runtime, plus the DeepSpeech native-client
 streaming surface (``deepspeech.h:107-358``) as a real C ABI
-(``native/speech_api.cpp``) fed by JAX callbacks.
+(``native/speech_api.cpp``) fed by JAX callbacks — and an adaptive
+micro-batching data plane (:mod:`tosem_tpu.serve.batching`) that
+coalesces concurrent requests into padding-bucketed batches on the flash
+kernels, behind a deploy-time-warmed compiled-program cache
+(:mod:`tosem_tpu.serve.compile_cache`).
 """
 from tosem_tpu.serve.autoscale import ServeAutoscaler, ServeScaleConfig
+from tosem_tpu.serve.backends import BertEncodeBackend
+from tosem_tpu.serve.batching import (BatchedFuture, BatchingReplica,
+                                      BatchPolicy, BatchQueue)
 from tosem_tpu.serve.breaker import CircuitBreaker, CircuitOpen
+from tosem_tpu.serve.compile_cache import (DEFAULT_COMPILE_CACHE,
+                                           CompileCache)
 from tosem_tpu.serve.core import Deployment, Handle, Serve, ServeFuture
 from tosem_tpu.serve.http import HttpIngress
-from tosem_tpu.serve.speech import (CStreamingModel, SpeechStreamBackend,
-                                    StreamingClient, greedy_ctc_text)
+from tosem_tpu.serve.speech import (CStreamingModel, SpeechBatchBackend,
+                                    SpeechStreamBackend, StreamingClient,
+                                    greedy_ctc_text)
 
 __all__ = [
     "Serve", "Deployment", "Handle", "ServeFuture", "HttpIngress",
     "CircuitBreaker", "CircuitOpen",
+    "BatchPolicy", "BatchQueue", "BatchedFuture", "BatchingReplica",
+    "CompileCache", "DEFAULT_COMPILE_CACHE",
+    "BertEncodeBackend", "SpeechBatchBackend",
     "CStreamingModel", "SpeechStreamBackend", "StreamingClient",
     "greedy_ctc_text",
 ]

@@ -7,6 +7,12 @@ per replica with upper/lower bounds). Same policy here over
 ``target_inflight_per_replica`` × replicas, scale down after sustained
 idleness. Deterministic ``tick()`` for tests; ``run()`` for the
 controller-loop behavior.
+
+With micro-batching enabled, ``load()`` counts LOGICAL requests —
+queued-in-the-batch-queue plus in-flight, a 16-request batch weighing
+16 — so the demand signal tracks users, never dispatches: a deployment
+absorbing its whole queue into one batch per flush still scales on the
+depth of that queue.
 """
 from __future__ import annotations
 

@@ -1,0 +1,174 @@
+"""Model serving backends for the micro-batching data plane.
+
+:class:`BertEncodeBackend` is the north-star inference backend: padded
+variable-length token requests are bucket-routed by the serve layer,
+padded here to the bucket shape with a key-padding mask, and run through
+ONE AOT-compiled program per (batch, bucket, dtype) — with
+``attn_fn=flash_attn_fn()`` the padded batch rides the Pallas flash
+kernels via segment ids (the PR-4 eligibility table), which only pay off
+at batch ≥ 8. The speech counterpart lives in
+:mod:`tosem_tpu.serve.speech` (:class:`SpeechBatchBackend`).
+
+Determinism note: every micro-batch is padded to the SAME batch size
+(``max_batch``), so whatever batch the queue happened to form, a request
+always runs the same executable with the same row-local inputs — batched
+and sequential responses are **bit-exact**, not merely close. The padded
+rows cost FLOPs, but keep the compiled-program palette at one program
+per bucket and make results independent of batching decisions.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence
+
+from tosem_tpu.serve.compile_cache import (DEFAULT_COMPILE_CACHE,
+                                           aot_compile, shape_key)
+
+# The flash kernels need lane-tile-aligned key lengths (Tk % 128 == 0):
+# bucket palettes for attention backends should be multiples of this.
+FLASH_ALIGN = 128
+
+
+def model_tag(name: str, cfg: Any, seed: int, **extra: Any) -> str:
+    """Cache-key fingerprint for a compiled model program.
+
+    The process-wide compile cache is shared by every replica in a
+    worker, so the key must capture everything that changes the
+    executable's BYTES — architecture config, weights seed, routing
+    flags — or co-located replicas of DIFFERENT models would silently
+    serve each other's programs. Replicas of the same deployment share
+    the same (cls, init args) and therefore the same tag, which is the
+    sharing the cache exists for."""
+    fields = (dataclasses.asdict(cfg) if dataclasses.is_dataclass(cfg)
+              else dict(vars(cfg)))
+    sig = ",".join(f"{k}={fields[k]}" for k in sorted(fields))
+    ex = "".join(f";{k}={v}" for k, v in sorted(extra.items()))
+    return f"{name}({sig};seed={seed}{ex})"
+
+
+class CompiledBackendMixin:
+    """Shared compile-cache surface for model serving backends.
+
+    Subclasses set ``self._tag`` (via :func:`model_tag`) in
+    ``__init__`` and implement ``_compiled(pad_to)`` with their own arg
+    specs; the deploy-time ``warmup`` loop and the cache-stats snapshot
+    live here so a cache-key fix never has to be applied twice."""
+
+    _tag: str
+
+    def warmup(self, shapes: Sequence[int]) -> Dict[str, Any]:
+        """Pre-compile one program per declared bucket (``shapes`` is
+        the pad-target palette). Called by ``Serve.deploy(
+        warmup_shapes=…)`` on every replica before serving starts."""
+        for pad_to in shapes:
+            self._compiled(int(pad_to))
+        return {"warmed": len(list(shapes)),
+                "cache": DEFAULT_COMPILE_CACHE.stats()}
+
+    def stats(self) -> Dict[str, Any]:
+        return {"compile_cache": DEFAULT_COMPILE_CACHE.stats()}
+
+
+class BertEncodeBackend(CompiledBackendMixin):
+    """Serve backend: ``{"ids": [int, …]}`` → pooled BERT encoding.
+
+    Responses are ``{"pooled": np.ndarray[dim], "len": int}`` (fp32 mean
+    over real tokens), or the full per-token ``{"encoding": [T_i, dim]}``
+    with ``pooled=False``. Works single-request too — a lone request
+    runs the same max_batch-padded program, so results never depend on
+    batch composition.
+    """
+
+    def __init__(self, preset: str = "tiny", seed: int = 0,
+                 max_batch: int = 8, use_flash: bool = True,
+                 pooled: bool = True, max_len: int = 128):
+        import jax
+        from tosem_tpu.models.bert import Bert, BertConfig
+        from tosem_tpu.nn.attention import flash_attn_fn
+        if preset == "base":
+            cfg = BertConfig.base()
+        else:
+            # tiny topology widened to flash-eligible sequence length
+            # (the stock tiny pins max_len=64 < the 128 lane tile)
+            cfg = BertConfig(vocab_size=128, max_len=max_len, dim=32,
+                             heads=2, layers=2, mlp_dim=64, dropout=0.0)
+        self.cfg = cfg
+        self.max_batch = max_batch
+        self.pooled = pooled
+        self.model = Bert(cfg)
+        self._vs = self.model.init(jax.random.PRNGKey(seed))
+        self._fwd = self.model.encode_fn(
+            self._vs, attn_fn=flash_attn_fn() if use_flash else None)
+        self._tag = model_tag("bert_encode", cfg, seed,
+                              use_flash=use_flash)
+
+    @staticmethod
+    def length_of(request: Dict[str, Any]) -> int:
+        """``length_of`` for ``Serve.deploy(buckets=…)`` routing."""
+        return len(request["ids"])
+
+    def _compiled(self, pad_to: int):
+        import numpy as np
+        key = shape_key(self._tag, (self.max_batch, pad_to),
+                        self.cfg.dtype)
+        return DEFAULT_COMPILE_CACHE.get_or_build(
+            key, lambda: aot_compile(
+                self._fwd, [((self.max_batch, pad_to), np.int32),
+                            ((self.max_batch, pad_to), np.int32)]))
+
+    def call(self, request: Dict[str, Any]) -> Any:
+        return self.call_batch([request])[0]
+
+    def call_batch(self, requests: List[Dict[str, Any]],
+                   pad_to: Optional[int] = None) -> List[Any]:
+        import numpy as np
+        from tosem_tpu.models.bert import pad_ids_batch
+        if len(requests) > self.max_batch:
+            raise ValueError(
+                f"batch of {len(requests)} exceeds max_batch="
+                f"{self.max_batch}; deploy with max_batch_size <= "
+                "the backend's max_batch")
+        for r in requests:
+            ids = r["ids"]
+            # reject poison inputs HERE, where per-request isolation
+            # can fail just this future: an out-of-vocab id would
+            # otherwise gather out of bounds and silently NaN the whole
+            # row (mode='fill'), and an empty sequence has no real key
+            # for its attention row to attend to
+            if len(ids) == 0:
+                raise ValueError("empty ids sequence")
+            if min(ids) < 0 or max(ids) >= self.cfg.vocab_size:
+                raise ValueError(
+                    f"token id out of range [0, {self.cfg.vocab_size})")
+        if pad_to is None:
+            longest = max(len(r["ids"]) for r in requests)
+            pad_to = -(-longest // FLASH_ALIGN) * FLASH_ALIGN
+        # an explicit pad target past max_len (the bucket router gives
+        # overlong requests their own aligned shape) must NOT compile a
+        # longer program: position embeddings only cover max_len, and
+        # jnp.take would clamp — silently-wrong encodings. Clamp here so
+        # a request longer than max_len fails its own future with
+        # pad_ids_batch's "exceeds pad target" instead
+        pad_to = min(int(pad_to), self.cfg.max_len)
+        ids, mask, lengths = pad_ids_batch(
+            [r["ids"] for r in requests], pad_to,
+            pad_batch_to=self.max_batch)
+        enc = np.asarray(self._compiled(pad_to)(ids, mask), np.float32)
+        out = []
+        for i, r in enumerate(requests):
+            n = int(lengths[i])
+            row = enc[i, :n]
+            if self.pooled:
+                out.append({"pooled": row.mean(axis=0), "len": n})
+            else:
+                out.append({"encoding": row, "len": n})
+        return out
+
+    def stats(self) -> Dict[str, Any]:
+        """Replica-process counters: compile-cache hits/misses plus the
+        flash/XLA dispatch tally — the assertion surface proving padded
+        batches actually ride the flash path in the replica."""
+        from tosem_tpu.nn.attention import FLASH_DISPATCH_COUNTS
+        out = super().stats()
+        out["flash_dispatch"] = dict(FLASH_DISPATCH_COUNTS)
+        return out

@@ -108,9 +108,17 @@ class CircuitBreaker:
             # any success is live evidence the backend serves requests
             self._state = CLOSED
 
-    def record_failure(self, probe: bool = False) -> None:
+    def record_failure(self, probe: bool = False, count: int = 1) -> None:
+        """``count``: how many LOGICAL requests this failure represents.
+        A 16-request micro-batch lost to a replica crash is 16 trips of
+        evidence, not one dispatch — the batching data plane passes the
+        batch's logical size so the breaker's view of the backend stays
+        request-accurate (one lock hold either way). ``probe`` still
+        applies once: a batch can carry at most one probe slot."""
+        if count < 1:
+            raise ValueError("count must be >= 1")
         with self._lock:
-            self._consecutive_failures += 1
+            self._consecutive_failures += count
             if probe:
                 self._probe_in_flight = False
             if probe and self._state == HALF_OPEN:

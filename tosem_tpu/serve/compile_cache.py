@@ -1,0 +1,113 @@
+"""Warm compiled-program cache for serving backends.
+
+A cold replica's first request pays the full XLA JIT of its model — on
+the north-star BERT shapes that is multi-second, which is exactly the
+tail latency a serving layer exists to hide. This module is the
+replica-side fix: a process-wide cache of AOT-lowered executables keyed
+by ``(model, bucket shape, dtype)``, shared by every backend instance
+(and therefore every replica thread) living in the same worker process.
+``Serve.deploy(warmup_shapes=…)`` drives :meth:`CompileCache.get_or_build`
+for each declared shape at deploy time, so replica 0's first real
+request finds its program already compiled.
+
+Design points:
+
+- **Per-key build locks.** Two replica threads racing for the same
+  bucket shape compile once; the loser blocks on the winner's build
+  instead of duplicating a multi-second lowering (double-checked
+  per-key locking, the memoization discipline XLA's own compilation
+  cache uses).
+- **AOT lowering.** :func:`aot_compile` goes through
+  ``jax.jit(fn).lower(*specs).compile()`` so warming never touches real
+  data — declared shapes become :class:`jax.ShapeDtypeStruct` specs.
+- **Observable.** Hit/miss/build-time counters surface through
+  :meth:`stats` and the deployment's ``/-/stats`` endpoint, so a bucket
+  palette that quietly recompiles per request is visible.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, Hashable, Optional, Sequence, Tuple
+
+
+class CompileCache:
+    """Thread-safe build-once cache (executables, or anything costly)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: Dict[Hashable, Any] = {}
+        self._building: Dict[Hashable, threading.Lock] = {}
+        self._hits = 0
+        self._misses = 0
+        self._build_s = 0.0
+
+    def get(self, key: Hashable) -> Optional[Any]:
+        with self._lock:
+            return self._entries.get(key)
+
+    def get_or_build(self, key: Hashable, build: Callable[[], Any]) -> Any:
+        """Return the cached value for ``key``, building it (once, even
+        under concurrency) when absent."""
+        with self._lock:
+            if key in self._entries:
+                self._hits += 1
+                return self._entries[key]
+            gate = self._building.setdefault(key, threading.Lock())
+        with gate:
+            # double-check: the winner of the race filled the entry
+            # while we waited on its gate
+            with self._lock:
+                if key in self._entries:
+                    self._hits += 1
+                    return self._entries[key]
+            t0 = time.perf_counter()
+            value = build()
+            dt = time.perf_counter() - t0
+            with self._lock:
+                self._entries[key] = value
+                self._misses += 1
+                self._build_s += dt
+                self._building.pop(key, None)
+            return value
+
+    def __contains__(self, key: Hashable) -> bool:
+        with self._lock:
+            return key in self._entries
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._building.clear()
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"entries": len(self._entries), "hits": self._hits,
+                    "misses": self._misses,
+                    "build_s": round(self._build_s, 3)}
+
+
+# One cache per process: replicas co-located in a worker share compiled
+# programs; the driver process gets its own for in-process backends.
+DEFAULT_COMPILE_CACHE = CompileCache()
+
+
+def shape_key(model: str, shape: Sequence[int], dtype: str) -> Tuple:
+    """Canonical cache key: ``(model, (dims…), dtype)`` — the
+    (model, bucket shape, dtype) triple of the design."""
+    return (model, tuple(int(d) for d in shape), str(dtype))
+
+
+def aot_compile(fn: Callable, arg_specs: Sequence[Tuple[Sequence[int], Any]]
+                ) -> Any:
+    """AOT-lower ``fn`` for the given ``(shape, dtype)`` specs and return
+    the compiled executable (callable with concrete arrays of exactly
+    those shapes). No real data is touched — safe for deploy-time
+    warming."""
+    import jax
+    specs = [jax.ShapeDtypeStruct(tuple(s), d) for s, d in arg_specs]
+    return jax.jit(fn).lower(*specs).compile()

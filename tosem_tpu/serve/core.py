@@ -23,12 +23,15 @@ from __future__ import annotations
 import itertools
 import threading
 import time
-from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+from typing import (Any, Callable, Dict, List, Optional, Sequence, Tuple,
+                    Union)
 
 import tosem_tpu.runtime as rt
 from tosem_tpu.chaos import hooks as _chaos
 from tosem_tpu.runtime.common import (ActorDiedError, TaskCancelledError,
                                       TaskError, WorkerCrashedError)
+from tosem_tpu.serve.batching import (BatchingReplica, BatchPolicy,
+                                      BatchQueue)
 from tosem_tpu.serve.breaker import CircuitBreaker, CircuitOpen
 
 RETRYABLE = (ActorDiedError, WorkerCrashedError)
@@ -133,7 +136,9 @@ class Deployment:
                  max_restarts: int, max_retries: int,
                  breaker: Optional[CircuitBreaker] = None,
                  backoff_base_s: float = 0.05,
-                 backoff_cap_s: float = 2.0):
+                 backoff_cap_s: float = 2.0,
+                 batch_policy: Optional[BatchPolicy] = None,
+                 warmup_shapes: Optional[Sequence] = None):
         self.name = name
         self.backend_cls = backend_cls
         self.max_retries = max_retries
@@ -142,26 +147,49 @@ class Deployment:
         self.backoff_cap_s = backoff_cap_s
         self._init_args = init_args
         self._init_kwargs = init_kwargs
-        self._actor_cls = rt.remote(max_restarts=max_restarts)(backend_cls)
+        self.batch_policy = batch_policy
+        self._warmup_shapes = list(warmup_shapes or [])
+        if batch_policy is not None:
+            # batched deployments run behind the replica wrapper: it
+            # owns the (status, value)-per-request wire and per-request
+            # error isolation, so one poison request can never fail its
+            # batchmates (see serve/batching.py)
+            self._actor_cls = rt.remote(max_restarts=max_restarts)(
+                BatchingReplica)
+            self._spawn = lambda: self._actor_cls.remote(
+                backend_cls, init_args, init_kwargs or {})
+        else:
+            self._actor_cls = rt.remote(max_restarts=max_restarts)(
+                backend_cls)
+            self._spawn = lambda: self._actor_cls.remote(
+                *init_args, **(init_kwargs or {}))
         self._lock = threading.Lock()
-        self._replicas: List[Any] = [
-            self._actor_cls.remote(*init_args, **init_kwargs)
-            for _ in range(num_replicas)]
+        self._replicas: List[Any] = [self._spawn()
+                                     for _ in range(num_replicas)]
         self._rr = itertools.count()
         self._closed = False
-        # (ref, replica) pairs not yet observed done — drives both the
-        # least-loaded dispatch and the autoscaler's demand signal.
-        # Pruned on every dispatch and load() call, so counts are true
-        # in-flight numbers and results never stay pinned.
+        # (ref, replica, n_logical) triples not yet observed done —
+        # drives both the least-loaded dispatch and the autoscaler's
+        # demand signal. n_logical is the LOGICAL request count behind
+        # a dispatch (a 16-request micro-batch weighs 16, not 1), so
+        # routing and scaling see requests, never dispatches. Pruned on
+        # every dispatch and load() call, so counts are true in-flight
+        # numbers and results never stay pinned.
         self._outstanding: List[Any] = []
+        self._queue: Optional[BatchQueue] = (
+            BatchQueue(self, batch_policy)
+            if batch_policy is not None else None)
+        if self._warmup_shapes:
+            self.warmup(self._warmup_shapes)
 
     def _counts_locked(self) -> Dict[int, int]:
-        """Per-replica outstanding counts from the current (possibly
-        slightly stale) list. Caller holds self._lock."""
+        """Per-replica outstanding LOGICAL request counts from the
+        current (possibly slightly stale) list. Caller holds
+        self._lock."""
         counts: Dict[int, int] = {id(r): 0 for r in self._replicas}
-        for _, rep in self._outstanding:
+        for _, rep, n in self._outstanding:
             if id(rep) in counts:
-                counts[id(rep)] += 1
+                counts[id(rep)] += n
         return counts
 
     def _prune_amortized(self) -> None:
@@ -174,10 +202,9 @@ class Deployment:
         if needs:
             self.load()
 
-    def _dispatch(self, request: Any, pin: Optional[int] = None):
-        # breaker admission is the caller's job (ServeFuture): it owns
-        # the per-request probe flag the breaker hands out
-        self._prune_amortized()
+    def _pick_replica(self, pin: Optional[int]) -> Tuple[Any, int]:
+        """Least-loaded routing over LOGICAL request counts (shared by
+        the single-request and micro-batch dispatch paths)."""
         with self._lock:
             replicas = list(self._replicas)
             if not replicas:
@@ -200,7 +227,9 @@ class Deployment:
                                        (j - order) % len(replicas)))
             else:
                 i = pin % len(replicas)
-            replica = replicas[i]
+            return replicas[i], i
+
+    def _fire_chaos(self, replica, i: int) -> None:
         act = _chaos.fire("serve.dispatch", target=self.name, replica=i)
         if act is not None:
             if act["action"] == "crash_replica":
@@ -212,10 +241,35 @@ class Deployment:
                 crash_actor_process(replica._actor_id)
             elif act["action"] == "slow_replica":
                 time.sleep(act["delay_s"])
+
+    def _dispatch(self, request: Any, pin: Optional[int] = None):
+        # breaker admission is the caller's job (ServeFuture): it owns
+        # the per-request probe flag the breaker hands out
+        self._prune_amortized()
+        replica, i = self._pick_replica(pin)
+        self._fire_chaos(replica, i)
         ref = replica.call.remote(request)
         with self._lock:
-            self._outstanding.append((ref, replica))
+            self._outstanding.append((ref, replica, 1))
         return ref
+
+    def _dispatch_batch(self, requests: List[Any],
+                        bucket: Optional[int] = None,
+                        pin: Optional[int] = None):
+        """Ship one micro-batch to a replica (the BatchQueue's dispatch
+        path). ``bucket`` is the padding target the batch was binned
+        under; the replica pads every request to exactly that shape, so
+        the compiled-program cache sees one program per bucket. Returns
+        ``(ref, replica)`` so the completion thread can retry elsewhere
+        on replica death. In-flight accounting weighs the batch by its
+        LOGICAL size."""
+        self._prune_amortized()
+        replica, i = self._pick_replica(pin)
+        self._fire_chaos(replica, i)
+        ref = replica.call_batch.remote(requests, bucket)
+        with self._lock:
+            self._outstanding.append((ref, replica, len(requests)))
+        return ref, replica
 
     @property
     def num_replicas(self) -> int:
@@ -223,20 +277,25 @@ class Deployment:
             return len(self._replicas)
 
     def load(self) -> int:
-        """In-flight request count (the autoscaler's demand signal, the
-        replica queue-length metric Serve's controller scrapes). Prunes
-        refs that completed since the last call."""
+        """In-flight LOGICAL request count plus micro-batch queue depth
+        (the autoscaler's demand signal, the replica queue-length metric
+        Serve's controller scrapes). Queued-but-undispatched requests
+        count too: demand waiting for a batch slot is exactly what
+        scale-up should relieve — and a 16-request batch in flight is 16
+        units of demand, not one dispatch. Prunes refs that completed
+        since the last call."""
+        queued = self._queue.depth() if self._queue is not None else 0
         with self._lock:
-            pairs = list(self._outstanding)
-        if not pairs:
-            return 0
-        refs = [r for r, _ in pairs]
+            triples = list(self._outstanding)
+        if not triples:
+            return queued
+        refs = [r for r, _, _ in triples]
         done, _ = rt.wait(refs, num_returns=len(refs), timeout=0.0)
         done_set = set(done)
         with self._lock:
-            self._outstanding = [(r, rep) for r, rep in self._outstanding
-                                 if r not in done_set]
-            return len(self._outstanding)
+            self._outstanding = [t for t in self._outstanding
+                                 if t[0] not in done_set]
+            return queued + sum(n for _, _, n in self._outstanding)
 
     def handle(self, pin: Optional[int] = None) -> "Handle":
         """``pin``: route every request of this handle to one replica —
@@ -260,10 +319,14 @@ class Deployment:
                 return
             cur = len(self._replicas)
             if num_replicas > cur:
-                self._replicas.extend(
-                    self._actor_cls.remote(*self._init_args,
-                                           **self._init_kwargs)
-                    for _ in range(num_replicas - cur))
+                fresh = [self._spawn() for _ in range(num_replicas - cur)]
+                self._replicas.extend(fresh)
+                # pre-warm new replicas without blocking the scaler:
+                # the warmup call queues FIRST on the fresh actor, so
+                # any request routed there waits behind the compile
+                # instead of paying it (actor queues are FIFO)
+                for r in fresh:
+                    self._warm_async(r)
             elif num_replicas < cur:
                 # counts computed UNDER the lock: a dispatch racing this
                 # scale-down either lands before (counted, replica looks
@@ -278,28 +341,104 @@ class Deployment:
                 for v in victims:
                     rt.kill(v)
 
+    def _can_warm(self) -> bool:
+        return (self.batch_policy is not None
+                or hasattr(self.backend_cls, "warmup"))
+
+    def _warm_async(self, replica) -> None:
+        if self._warmup_shapes and self._can_warm():
+            replica.warmup.remote(self._warmup_shapes)
+
+    def warmup(self, shapes: Sequence, timeout: Optional[float] = None
+               ) -> List[Any]:
+        """Pre-compile the declared shapes on EVERY replica and block
+        until done — the deploy-time warm-cache fill that keeps replica
+        0's first request from eating a multi-second JIT. ``shapes`` is
+        backend-defined (the model backends take their bucket palette).
+        Requires a backend with a ``warmup(shapes)`` method (batched
+        deployments always have one via the replica wrapper)."""
+        if not self._can_warm():
+            raise ValueError(
+                f"backend {self.backend_cls.__name__} has no warmup() "
+                "and the deployment is unbatched — nothing to pre-warm")
+        with self._lock:
+            replicas = list(self._replicas)
+        refs = [r.warmup.remote(list(shapes)) for r in replicas]
+        return [rt.get(ref, timeout=timeout) for ref in refs]
+
+    def stats(self) -> Dict[str, Any]:
+        """Data-plane snapshot: replica count, logical load, and (for
+        batched deployments) queue depth / batch-size telemetry — the
+        ``/-/stats`` ingress payload."""
+        out: Dict[str, Any] = {"replicas": self.num_replicas,
+                               "load": self.load(),
+                               "batched": self._queue is not None}
+        if self._queue is not None:
+            out.update(self._queue.stats())
+            out["max_batch_size"] = self.batch_policy.max_batch_size
+            out["batch_wait_ms"] = self.batch_policy.batch_wait_ms
+        return out
+
     def close(self) -> None:
-        """Kill every replica and refuse further scaling (delete path)."""
+        """Kill every replica and refuse further scaling (delete path).
+        Queued-but-undispatched requests fail with ActorDiedError."""
         with self._lock:
             self._closed = True
             victims = list(self._replicas)
             self._replicas = []
+        if self._queue is not None:
+            self._queue.close()
         for v in victims:
             rt.kill(v)
 
 
 class Handle:
-    """Client-side handle (``serve.get_handle`` role)."""
+    """Client-side handle (``serve.get_handle`` role).
+
+    On a batched deployment, un-pinned requests ride the micro-batch
+    queue (a :class:`~tosem_tpu.serve.batching.BatchedFuture` comes
+    back); pinned handles bypass batching — session affinity implies
+    stateful per-session ordering that must not interleave with other
+    sessions' requests inside one batch."""
 
     def __init__(self, deployment: Deployment, pin: Optional[int] = None):
         self._dep = deployment
         self._pin = pin
 
-    def remote(self, request: Any) -> ServeFuture:
-        return ServeFuture(self._dep, request, self._dep.max_retries,
-                           pin=self._pin)
+    def _submit_batched(self, request: Any, sync: bool,
+                        timeout: Optional[float] = None):
+        """Breaker-admitted submit to the micro-batch queue: admission
+        happens HERE (not at flush) so an open circuit rejects at
+        ``.remote()`` exactly like the unbatched path — per logical
+        request, owning its own probe flag. A submit that raises (queue
+        closed by delete) releases an acquired probe rather than
+        wedging the breaker in 'probe in flight' forever (mirror of
+        ``ServeFuture._dispatch_attempt``)."""
+        dep = self._dep
+        breaker = dep.breaker
+        probe = breaker.allow() if breaker is not None else False
+        try:
+            return dep._queue.submit(request, probe=probe, sync=sync,
+                                     timeout=timeout)
+        except BaseException:
+            if breaker is not None and probe:
+                breaker.release_probe()
+            raise
+
+    def remote(self, request: Any):
+        dep = self._dep
+        if dep._queue is not None and self._pin is None:
+            return self._submit_batched(request, sync=False)
+        return ServeFuture(dep, request, dep.max_retries, pin=self._pin)
 
     def call(self, request: Any, timeout: Optional[float] = None) -> Any:
+        dep = self._dep
+        if dep._queue is not None and self._pin is None:
+            # sync + idle queue: submit completes the request inline on
+            # this thread (no completion-thread spawn / Event handoff),
+            # keeping single-client p50 at the unbatched path's cost
+            return self._submit_batched(request, sync=True,
+                                        timeout=timeout).result(timeout)
         return self.remote(request).result(timeout)
 
 
@@ -317,29 +456,75 @@ class Serve:
                max_restarts: int = 2, max_retries: int = 3,
                circuit_breaker: Union[bool, CircuitBreaker, None] = None,
                backoff_base_s: float = 0.05,
-               backoff_cap_s: float = 2.0) -> Deployment:
+               backoff_cap_s: float = 2.0,
+               max_batch_size: int = 1,
+               batch_wait_ms: float = 5.0,
+               buckets: Optional[Sequence[int]] = None,
+               length_of: Optional[Callable[[Any], int]] = None,
+               batch_policy: Optional[BatchPolicy] = None,
+               warmup_shapes: Optional[Sequence] = None) -> Deployment:
         """``circuit_breaker``: True for a default breaker (5 consecutive
         failures open it for 5s), or a configured
         :class:`~tosem_tpu.serve.breaker.CircuitBreaker`; None disables
-        (the pre-breaker behavior)."""
+        (the pre-breaker behavior).
+
+        ``max_batch_size > 1`` (or an explicit ``batch_policy``) turns
+        on the adaptive micro-batching data plane: concurrent requests
+        coalesce into batches under the ``batch_wait_ms`` latency
+        budget, optionally binned into padding ``buckets`` via
+        ``length_of`` (see :mod:`tosem_tpu.serve.batching`).
+        ``warmup_shapes`` pre-compiles the declared shapes on every
+        replica before ``deploy`` returns, so the first request never
+        pays the JIT."""
         if circuit_breaker is True:
             breaker: Optional[CircuitBreaker] = CircuitBreaker()
         elif isinstance(circuit_breaker, CircuitBreaker):
             breaker = circuit_breaker
         else:
             breaker = None
+        if batch_policy is None and max_batch_size > 1:
+            batch_policy = BatchPolicy(max_batch_size=max_batch_size,
+                                       batch_wait_ms=batch_wait_ms,
+                                       buckets=buckets,
+                                       length_of=length_of)
+        # reserve the name, then construct OUTSIDE the registry lock:
+        # Deployment.__init__ blocks on warmup_shapes compiles (multi-
+        # second on model backends), and holding the global lock through
+        # that would stall every concurrent deploy/get_handle/stats call
         with self._lock:
             if name in self._deployments:
                 raise ValueError(f"deployment {name!r} already exists")
+            self._deployments[name] = None       # reservation marker
+        try:
             dep = Deployment(name, backend_cls, num_replicas, init_args,
                              init_kwargs or {}, max_restarts, max_retries,
                              breaker=breaker, backoff_base_s=backoff_base_s,
-                             backoff_cap_s=backoff_cap_s)
-            self._deployments[name] = dep
-            return dep
+                             backoff_cap_s=backoff_cap_s,
+                             batch_policy=batch_policy,
+                             warmup_shapes=warmup_shapes)
+        except BaseException:
+            with self._lock:
+                self._deployments.pop(name, None)
+            raise
+        with self._lock:
+            if name not in self._deployments:
+                deleted = True   # delete() raced the warmup
+            else:
+                self._deployments[name] = dep
+                deleted = False
+        if deleted:
+            dep.close()
+            raise RuntimeError(
+                f"deployment {name!r} was deleted while deploying")
+        return dep
 
     def get_handle(self, name: str) -> Handle:
-        return self._deployments[name].handle()
+        with self._lock:
+            dep = self._deployments[name]
+        if dep is None:
+            raise KeyError(f"deployment {name!r} is still deploying "
+                           "(warmup in progress)")
+        return dep.handle()
 
     def delete(self, name: str) -> None:
         with self._lock:
@@ -353,10 +538,17 @@ class Serve:
 
     def get_deployment(self, name: str) -> Optional[Deployment]:
         """Public registry accessor (autoscaler/dashboard use this, not
-        the private dict)."""
+        the private dict). Names still mid-deploy read as absent."""
         with self._lock:
             return self._deployments.get(name)
 
     def deployments(self) -> Dict[str, Deployment]:
         with self._lock:
-            return dict(self._deployments)
+            return {n: d for n, d in self._deployments.items()
+                    if d is not None}
+
+    def stats(self) -> Dict[str, Dict[str, Any]]:
+        """Per-deployment data-plane snapshot (the ``/-/stats`` ingress
+        payload): replica counts, logical load, batching telemetry."""
+        return {name: dep.stats()
+                for name, dep in sorted(self.deployments().items())}

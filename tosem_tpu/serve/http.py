@@ -42,6 +42,11 @@ class HttpIngress:
             def do_GET(self):
                 if self.path.rstrip("/") in ("", "/-", "/-/routes"):
                     self._reply(200, {"routes": serve.list_deployments()})
+                elif self.path.rstrip("/") == "/-/stats":
+                    # data-plane telemetry: queue depth, batch sizes,
+                    # per-request outcome counts — the operator's view
+                    # of whether batching is actually engaging
+                    self._reply(200, {"deployments": serve.stats()})
                 else:
                     self._reply(404, {"error": "POST to /<endpoint>"})
 

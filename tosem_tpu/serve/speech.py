@@ -22,6 +22,8 @@ from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
+from tosem_tpu.serve.backends import CompiledBackendMixin, model_tag
+
 _STREAM_INIT = ctypes.CFUNCTYPE(ctypes.c_void_p, ctypes.c_void_p)
 _STREAM_FREE = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_void_p)
 _INFER = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p, ctypes.c_void_p,
@@ -323,6 +325,71 @@ class SpeechStreamBackend:
             return {"text": text}
         raise ValueError(f"unknown op {op!r}")
 
+
+class SpeechBatchBackend(CompiledBackendMixin):
+    """Non-streaming utterance transcription behind the micro-batch
+    data plane: ``{"frames": [[float, …], …]}`` → ``{"text": str}``.
+
+    Variable-length utterances are bucket-routed by the serve layer and
+    zero-padded here to the bucket shape; one AOT-compiled program per
+    (max_batch, bucket) runs the whole batch (the LSTM is left-to-right,
+    so a request's logits are untouched by its padded tail), then each
+    row is sliced back to its true length and greedy-decoded. Batches
+    are always padded to ``max_batch`` rows, so batched and sequential
+    responses are bit-exact (see :mod:`tosem_tpu.serve.backends`).
+    """
+
+    def __init__(self, cfg_name: str = "tiny", seed: int = 0,
+                 max_batch: int = 8):
+        import jax
+        from tosem_tpu.models.speech import SpeechConfig, SpeechModel
+        from tosem_tpu.nn.core import variables as _vars
+        cfg = (SpeechConfig.tiny() if cfg_name == "tiny" else SpeechConfig())
+        self.cfg = cfg
+        self.max_batch = max_batch
+        self.model = SpeechModel(cfg)
+        params = self.model.init(jax.random.PRNGKey(seed))["params"]
+        self.alphabet = "abcdefghijklmnopqrstuvwxyz' -"[:cfg.n_classes - 1]
+        self._fwd = self.model.logits_fn(_vars(params))
+        self._tag = model_tag("speech_logits", cfg, seed)
+
+    @staticmethod
+    def length_of(request: Dict[str, Any]) -> int:
+        return len(request["frames"])
+
+    def _compiled(self, pad_to: int):
+        from tosem_tpu.serve.compile_cache import (DEFAULT_COMPILE_CACHE,
+                                                   aot_compile, shape_key)
+        key = shape_key(self._tag,
+                        (self.max_batch, pad_to, self.cfg.n_input),
+                        "float32")
+        return DEFAULT_COMPILE_CACHE.get_or_build(
+            key, lambda: aot_compile(
+                self._fwd,
+                [((self.max_batch, pad_to, self.cfg.n_input),
+                  np.float32)]))
+
+    def call(self, request: Dict[str, Any]) -> Any:
+        return self.call_batch([request])[0]
+
+    def call_batch(self, requests, pad_to: Optional[int] = None):
+        from tosem_tpu.models.speech import pad_feats_batch
+        if len(requests) > self.max_batch:
+            raise ValueError(f"batch of {len(requests)} exceeds "
+                             f"max_batch={self.max_batch}")
+        frames = [np.asarray(r["frames"], np.float32) for r in requests]
+        if pad_to is None:
+            pad_to = max(f.shape[0] for f in frames)
+        feats, lengths = pad_feats_batch(frames, pad_to,
+                                         pad_batch_to=self.max_batch)
+        logits = np.asarray(self._compiled(pad_to)(feats), np.float32)
+        out = []
+        for i in range(len(requests)):
+            n = int(lengths[i])
+            text = greedy_ctc_text(logits[i, :n], self.alphabet,
+                                   self.cfg.blank)
+            out.append({"text": text, "frames": n})
+        return out
 
 class StreamingClient:
     """Client-side stream with replay recovery (broken-stream retry).
