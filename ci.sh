@@ -11,11 +11,13 @@
 #                    numerics/unit files (no process-spawning suites)
 #                    + the 3-plan chaos smoke (the one deliberate
 #                    process-spawning step, so fault paths gate every PR)
-#   ./ci.sh --perf   perf_smoke tier (~3 min): syntax gate + the runtime
+#   ./ci.sh --perf   perf_smoke tier (~4 min): syntax gate + the runtime
 #                    microbenchmarks gated against the recorded baseline
 #                    (results/bench_runtime_post.json) + the serving
 #                    data-plane benches gated against
-#                    results/bench_serve.json — fails on >30%
+#                    results/bench_serve.json + the autoregressive-
+#                    decode benches gated against
+#                    results/bench_decode.json — fails on >30%
 #                    throughput regression on any gated bench
 set -euo pipefail
 cd "$(dirname "$0")"
@@ -29,15 +31,16 @@ echo "== byte-compile (syntax gate)"
 python -m compileall -q tosem_tpu tests examples bench.py __graft_entry__.py
 
 chaos_smoke() {
-  # fast chaos smoke: 5 canned fault plans, fixed seeds (<90s) — the
+  # fast chaos smoke: 6 canned fault plans, fixed seeds (<2.5 min) — the
   # runtime/serve/tune failure paths AND the recovery layer (lineage
-  # reconstruction of an evicted object, node-kill resubmission) run
-  # on every PR, not just when a chaos test file is touched
-  # (see tosem_tpu/chaos/); the recovery plans gate on zero surfaced
-  # errors — the workload must HEAL, not merely fail loudly
-  echo "== chaos smoke (5 canned fault plans, fixed seeds)"
+  # reconstruction of an evicted object, node-kill resubmission,
+  # KV-page eviction + replica crash mid-decode) run on every PR, not
+  # just when a chaos test file is touched (see tosem_tpu/chaos/); the
+  # recovery plans gate on zero surfaced errors — the workload must
+  # HEAL, not merely fail loudly
+  echo "== chaos smoke (6 canned fault plans, fixed seeds)"
   for plan in worker-carnage serve-flap trial-crash \
-              evict-heal node-kill-heal; do
+              evict-heal node-kill-heal decode-chaos; do
     JAX_PLATFORMS=cpu python -m tosem_tpu.cli chaos --plan "$plan"
   done
 }
@@ -67,6 +70,18 @@ perf_smoke() {
   if ! JAX_PLATFORMS=cpu "${scmd[@]}"; then
     echo "== perf smoke: serve regression reported; one retry (noisy host?)"
     JAX_PLATFORMS=cpu "${scmd[@]}"
+  fi
+  # autoregressive decode: continuous batching through the paged KV
+  # cache vs the re-encode baseline (token throughput at 1/16 clients +
+  # the phase-immune speedup ratio). Floors are min-of-rounds
+  # (--decode --save records them).
+  echo "== perf smoke (decode microbench vs results/bench_decode.json)"
+  local dcmd=(python -m tosem_tpu.cli microbench --decode --trials 2
+              --min-s 0.4 --quiet --only gated
+              --check results/bench_decode.json --threshold 0.30)
+  if ! JAX_PLATFORMS=cpu "${dcmd[@]}"; then
+    echo "== perf smoke: decode regression reported; one retry (noisy host?)"
+    JAX_PLATFORMS=cpu "${dcmd[@]}"
   fi
 }
 

@@ -1,0 +1,438 @@
+"""Continuous-batching decode tests (PR 6).
+
+Covers the generative-decode path end to end: prefill→decode
+bit-consistency with the full-context re-encode reference (BERT) and
+the full forward pass (speech), the (sequence id, step index)
+idempotency ledger that makes actor-replay at-least-once semantics safe,
+spill/restore and lost-payload re-prefill mid-decode, packing
+independence, and — behind the ``slow`` marker — the iteration-level
+scheduler through the real serve data plane with chaos faults.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+DECODE_KW = dict(max_batch=4, max_len=64, page_size=16, num_pages=24,
+                 max_new_tokens=6)
+
+
+def make_backend(**over):
+    from tosem_tpu.serve.backends import BertDecodeBackend
+    kw = dict(DECODE_KW)
+    kw.update(over)
+    return BertDecodeBackend(**kw)
+
+
+def drive(backend, sid, prompt):
+    """Sequential decode of one prompt; returns the final tokens."""
+    out = backend.admit(sid, {"ids": list(prompt)})
+    step = 0
+    while not out.get("done"):
+        out = backend.step_batch([sid], [step])[0]
+        step += 1
+    tokens = backend.result(sid)["tokens"]
+    backend.release(sid)
+    return tokens
+
+
+def reencode_reference(backend, prompt, n_new):
+    """The naive full-cache re-encode loop over the SAME params: the
+    dense, non-paged flash prefill path applied per token."""
+    toks = list(prompt)
+    for _ in range(n_new):
+        T = len(toks)
+        if T >= backend.cfg.max_len:
+            break
+        bucket = -(-T // backend.page_size) * backend.page_size
+        ids = np.zeros((1, bucket), np.int32)
+        mask = np.zeros((1, bucket), np.int32)
+        ids[0, :T] = toks
+        mask[0, :T] = 1
+        logits, _, _ = backend._prefill(ids, mask)
+        toks.append(int(np.argmax(np.asarray(logits, np.float32)[0, T - 1])))
+    return toks
+
+
+# ------------------------------------------------------- backend (in-process)
+
+class TestBertDecodeBackend:
+    def test_paged_decode_matches_reencode_reference(self):
+        """Prefill→decode bit-consistency: greedy tokens through the
+        paged cache equal the dense re-encode path, token for token."""
+        b = make_backend()
+        for i, prompt in enumerate([[1, 2, 3, 4, 5], [7, 8, 9],
+                                    [20] * 17]):       # crosses a page
+            got = drive(b, f"s{i}", prompt)
+            assert got == reencode_reference(
+                b, prompt, b.max_new_tokens), f"prompt {i} diverged"
+
+    def test_packing_independence(self):
+        """A sequence's tokens do not depend on its batchmates or row:
+        one compiled program serves every packing."""
+        b = make_backend()
+        solo = drive(b, "solo", [5, 6, 7])
+        b2 = make_backend()
+        outs = {sid: b2.admit(sid, {"ids": ids}) for sid, ids in
+                [("x", [11, 3, 2]), ("y", [5, 6, 7]), ("z", [9] * 6)]}
+        step = 0
+        active = [s for s in outs if not outs[s].get("done")]
+        while active:
+            for sid, out in zip(active, b2.step_batch(active,
+                                                      [step] * len(active))):
+                outs[sid] = out
+            active = [s for s in active if not outs[s].get("done")]
+            step += 1
+        assert b2.result("y")["tokens"] == solo
+
+    def test_step_replay_is_idempotent(self):
+        """The at-least-once regression (PR 2 actor replay): a replayed
+        (sequence, step) returns its memoized outcome and leaves the
+        cache untouched — no double-applied decode step."""
+        b = make_backend()
+        b.admit("s", {"ids": [1, 2, 3]})
+        first = b.step_batch(["s"], [0])
+        pools_before = (np.asarray(b.cache.k_pool).copy(),
+                        np.asarray(b.cache.v_pool).copy())
+        length_before = b.cache.length("s")
+        replay = b.step_batch(["s"], [0])
+        assert replay == first
+        assert b.cache.length("s") == length_before
+        np.testing.assert_array_equal(np.asarray(b.cache.k_pool),
+                                      pools_before[0])
+        np.testing.assert_array_equal(np.asarray(b.cache.v_pool),
+                                      pools_before[1])
+        # and the decode continues from where it really was
+        b.step_batch(["s"], [1])
+        assert b.cache.length("s") == length_before + 1
+
+    def test_admit_replay_is_idempotent(self):
+        b = make_backend()
+        first = b.admit("s", {"ids": [4, 5, 6]})
+        again = b.admit("s", {"ids": [4, 5, 6]})
+        assert again["token"] == first["token"]
+        assert b.cache.stats()["sequences"] == 1
+
+    def test_step_skipping_ahead_is_rejected(self):
+        b = make_backend()
+        b.admit("s", {"ids": [1, 2]})
+        with pytest.raises(RuntimeError, match="skips ahead"):
+            b.step_batch(["s"], [3])
+
+    def test_poison_prompts_fail_cleanly(self):
+        b = make_backend()
+        free0 = b.cache.stats()["pages_free"]
+        for bad in [{"ids": []}, {"ids": [999]}, {"ids": [-1]},
+                    {"ids": [1] * 64}]:
+            with pytest.raises(ValueError):
+                b.admit("bad", bad)
+        # nothing allocated, nothing leaked, the name is reusable
+        assert b.cache.stats()["pages_free"] == free0
+        assert b.admit("bad", {"ids": [1, 2]})["done"] in (True, False)
+
+    def test_spill_restore_mid_decode_keeps_tokens(self):
+        b = make_backend()
+        ref = drive(b, "ref", [3, 1, 4, 1, 5])
+        b.admit("s", {"ids": [3, 1, 4, 1, 5]})
+        out = b.step_batch(["s"], [0])[0]
+        b.spill_seq("s")
+        assert b.cache.is_spilled("s")
+        b.restore_seq("s")
+        step = 1
+        while not out.get("done"):
+            out = b.step_batch(["s"], [step])[0]
+            step += 1
+        assert b.result("s")["tokens"] == ref
+
+    def test_lost_spill_payload_reprefills_bit_consistently(self):
+        from tosem_tpu.serve.kv_cache import LocalSpillStore
+        store = LocalSpillStore()
+        b = make_backend(); b.cache._spill_store = store
+        ref = drive(b, "ref", [2, 7, 1, 8])
+        b.admit("s", {"ids": [2, 7, 1, 8]})
+        out = b.step_batch(["s"], [0])[0]
+        b.spill_seq("s")
+        store._data.clear()                 # chaos: payload evicted
+        b.restore_seq("s")                  # falls back to re-prefill
+        step = 1
+        while not out.get("done"):
+            out = b.step_batch(["s"], [step])[0]
+            step += 1
+        assert b.result("s")["tokens"] == ref
+
+    def test_lost_payload_restore_under_pressure_stays_coherent(self):
+        """Regression: when the spill payload is lost AND the pool is
+        momentarily full, restore_seq must raise CachePressure with
+        NOTHING changed — a half-torn fallback (spill entry dropped but
+        no pages) would make the retry a silent no-op and the next
+        step_batch a KeyError for the whole packed batch."""
+        from tosem_tpu.serve.kv_cache import CachePressure, LocalSpillStore
+        store = LocalSpillStore()
+        b = make_backend(num_pages=2)
+        b.cache._spill_store = store
+        ref = drive(make_backend(), "ref", [2, 7, 1, 8])
+        b.admit("s", {"ids": [2, 7, 1, 8]})
+        out = b.step_batch(["s"], [0])[0]
+        b.spill_seq("s")
+        store._data.clear()                 # payload gone
+        b.admit("hog", {"ids": [1] * 17})   # both pages taken
+        with pytest.raises(CachePressure):
+            b.restore_seq("s")
+        assert b.cache.is_spilled("s")      # still parked, retryable
+        b.release("hog")
+        b.restore_seq("s")                  # now re-prefills
+        step = 1
+        while not out.get("done"):
+            out = b.step_batch(["s"], [step])[0]
+            step += 1
+        assert b.result("s")["tokens"] == ref
+
+    def test_release_frees_pages(self):
+        b = make_backend()
+        total = b.cache.stats()["pages_free"]
+        b.admit("s", {"ids": [1, 2, 3]})
+        assert b.cache.stats()["pages_free"] < total
+        b.release("s")
+        assert b.cache.stats()["pages_free"] == total
+
+
+def test_max_active_beyond_backend_max_batch_rejected_at_deploy():
+    """Config guard: max_active > the compiled step program's batch
+    dimension would fail every packed sequence at runtime; it must
+    fail at deployment construction instead."""
+    from tosem_tpu.serve.backends import BertDecodeBackend
+    from tosem_tpu.serve.batching import DecodePolicy
+    from tosem_tpu.serve.core import Deployment
+    with pytest.raises(ValueError, match="max_active"):
+        Deployment("d", BertDecodeBackend, 1, (), {"max_batch": 4},
+                   max_restarts=0, max_retries=1,
+                   decode_policy=DecodePolicy(max_active=8))
+
+
+class TestSpeechDecodeBackend:
+    def make(self, **over):
+        from tosem_tpu.serve.speech import SpeechDecodeBackend
+        kw = dict(max_batch=4, chunk_frames=8, max_frames=128)
+        kw.update(over)
+        return SpeechDecodeBackend(**kw)
+
+    def drive_all(self, b, named_frames):
+        outs = {sid: b.admit(sid, {"frames": f})
+                for sid, f in named_frames.items()}
+        step = 0
+        active = [s for s in outs if not outs[s].get("done")]
+        while active:
+            for sid, out in zip(active, b.step_batch(
+                    active, [step] * len(active))):
+                outs[sid] = out
+            active = [s for s in active if not outs[s].get("done")]
+            step += 1
+        return {sid: b.result(sid) for sid in named_frames}
+
+    def test_streamed_decode_matches_full_pass(self):
+        import jax
+
+        from tosem_tpu.nn.core import variables as vars_
+        from tosem_tpu.serve.speech import greedy_ctc_text
+        b = self.make()
+        rng = np.random.default_rng(0)
+        frames = {f"u{i}": rng.normal(size=(n, b.cfg.n_input))
+                  .astype(np.float32) for i, n in enumerate((23, 40, 7))}
+        got = self.drive_all(b, frames)
+        params = b.model.init(jax.random.PRNGKey(0))["params"]
+        full = b.model.logits_fn(vars_(params))
+        for sid, f in frames.items():
+            ref = greedy_ctc_text(np.asarray(full(f[None]), np.float32)[0],
+                                  b.alphabet, b.cfg.blank)
+            assert got[sid]["text"] == ref
+            assert got[sid]["frames"] == f.shape[0]
+
+    def test_step_replay_is_idempotent(self):
+        b = self.make()
+        rng = np.random.default_rng(1)
+        b.admit("u", {"frames": rng.normal(size=(20, b.cfg.n_input))
+                      .astype(np.float32)})
+        first = b.step_batch(["u"], [0])
+        h_before = b._seqs["u"].h.copy()
+        assert b.step_batch(["u"], [0]) == first
+        np.testing.assert_array_equal(b._seqs["u"].h, h_before)
+
+    def test_poison_frames_rejected(self):
+        b = self.make()
+        with pytest.raises(ValueError):
+            b.admit("u", {"frames": np.zeros((0, b.cfg.n_input),
+                                             np.float32)})
+        with pytest.raises(ValueError):
+            b.admit("u", {"frames": np.zeros((4, 3), np.float32)})
+        with pytest.raises(ValueError):
+            b.admit("u", {"frames": np.zeros((999, b.cfg.n_input),
+                                             np.float32)})
+
+
+# ----------------------------------------------------- serve plane (slow)
+
+@pytest.mark.slow
+class TestDecodeQueueE2E:
+    @pytest.fixture(scope="class")
+    def runtime(self):
+        import tosem_tpu.runtime as rt
+        r = rt.init(num_workers=2, memory_monitor=False)
+        yield r
+        rt.shutdown()
+
+    def deploy(self, runtime, name, max_active=4, **over):
+        from tosem_tpu.serve.backends import BertDecodeBackend
+        from tosem_tpu.serve.batching import DecodePolicy
+        from tosem_tpu.serve.core import Serve
+        serve = Serve()
+        kw = dict(DECODE_KW)
+        kw.update(over)
+        serve.deploy(name, BertDecodeBackend, init_kwargs=kw,
+                     decode_policy=DecodePolicy(max_active=max_active),
+                     circuit_breaker=True)
+        return serve
+
+    def test_iteration_scheduling_parity_and_stats(self, runtime):
+        ref = make_backend()
+        prompts = [[1 + i, 2 + i, 3 + i] for i in range(6)]
+        expected = [drive(ref, f"r{i}", p) for i, p in enumerate(prompts)]
+
+        serve = self.deploy(runtime, "dq", max_active=4)
+        try:
+            h = serve.get_handle("dq")
+            futs = [h.remote({"ids": p}) for p in prompts]
+            got = [f.result(timeout=300.0)["tokens"] for f in futs]
+            assert got == expected
+            st = serve.get_deployment("dq").stats()
+            assert st["decode"] is True and st["batched"] is False
+            assert st["sequences_ok"] == 6 and st["sequences_err"] == 0
+            assert st["max_active"] == 4
+            # iteration-level packing: 6 sequences of ~6 steps each in
+            # FAR fewer scheduler iterations than 6 sequential decodes
+            assert st["decode_steps"] < 6 * (DECODE_KW["max_new_tokens"]
+                                             + 1)
+            assert st["tokens_emitted"] >= sum(
+                len(t) - 3 for t in expected)
+        finally:
+            serve.delete("dq")
+
+    def test_poison_isolation_through_the_queue(self, runtime):
+        serve = self.deploy(runtime, "dq-poison")
+        try:
+            h = serve.get_handle("dq-poison")
+            good = [h.remote({"ids": [1 + i, 2]}) for i in range(3)]
+            bad = h.remote({"ids": [999]})
+            from tosem_tpu.runtime.common import TaskError
+            with pytest.raises(TaskError):
+                bad.result(timeout=120.0)
+            for f in good:
+                assert f.result(timeout=120.0)["tokens"]
+        finally:
+            serve.delete("dq-poison")
+
+    def test_page_pressure_spills_and_all_complete(self, runtime):
+        ref = make_backend()
+        # 14-token prompts fit one page at admit, but cross into a
+        # second page mid-decode (14+6 = 20 tokens): with 4 sequences
+        # over a 5-page pool the growth demand (8 pages) forces the
+        # spill-and-requeue path while everyone is already active
+        prompts = [[2 + i] * 14 for i in range(4)]
+        expected = [drive(ref, f"r{i}", p) for i, p in enumerate(prompts)]
+        serve = self.deploy(runtime, "dq-pressure", max_active=4,
+                            num_pages=5)
+        try:
+            h = serve.get_handle("dq-pressure")
+            futs = [h.remote({"ids": p}) for p in prompts]
+            got = [f.result(timeout=600.0)["tokens"] for f in futs]
+            assert got == expected
+            st = serve.get_deployment("dq-pressure").stats()
+            assert st["kv_spills"] >= 1     # the pressure path really ran
+            assert st["sequences_err"] == 0
+        finally:
+            serve.delete("dq-pressure")
+
+    def test_oversized_sequence_fails_alone(self, runtime):
+        # a lone sequence that cannot ever fit fails with CachePressure
+        # instead of deadlocking the queue
+        serve = self.deploy(runtime, "dq-huge", num_pages=1)
+        try:
+            h = serve.get_handle("dq-huge")
+            fut = h.remote({"ids": [1] * 17})     # needs 2 pages, pool=1
+            with pytest.raises(Exception):
+                fut.result(timeout=120.0)
+        finally:
+            serve.delete("dq-huge")
+
+    def test_decode_gauges_exported(self, runtime):
+        from tosem_tpu.obs.metrics import prometheus_text
+        serve = self.deploy(runtime, "dq-metrics")
+        try:
+            h = serve.get_handle("dq-metrics")
+            h.call({"ids": [1, 2, 3]}, timeout=300.0)
+            serve.get_deployment("dq-metrics").stats()
+            text = prometheus_text()
+            assert "serve_decode_active_sequences" in text
+            assert "serve_decode_batch_occupancy" in text
+            assert "serve_kv_pages" in text
+        finally:
+            serve.delete("dq-metrics")
+
+
+@pytest.mark.slow
+class TestDecodeRecovery:
+    def test_actor_replay_does_not_double_apply_steps(self):
+        """The PR-6 recovery-determinism fix, end to end: a decode
+        replica with PR-2 restore_state dies mid-decode; the runtime
+        replays its method log (at-least-once — calls that raced the
+        corpse are retried AND replayed). The (sequence, step) ledger
+        must absorb the duplicates so decode continues on the replayed
+        state with the fault-free token path."""
+        import tosem_tpu.runtime as rt
+        from tosem_tpu.chaos.injector import crash_actor_process
+        from tosem_tpu.serve.backends import BertDecodeBackend
+        rt.init(num_workers=2, memory_monitor=False)
+        try:
+            ref = make_backend()
+            expected = drive(ref, "r", [1, 2, 3, 4])
+
+            cls = rt.remote(max_restarts=1,
+                            restore_state=True)(BertDecodeBackend)
+            a = cls.remote(**DECODE_KW)
+            out = rt.get(a.admit.remote("s", {"ids": [1, 2, 3, 4]}),
+                         timeout=300.0)
+            steps = 0
+            for _ in range(2):
+                out = rt.get(a.step_batch.remote(["s"], [steps]),
+                             timeout=120.0)[0]
+                steps += 1
+            assert crash_actor_process(a._actor_id)
+            # the restart replays admit + both steps; continue decoding
+            deadline = time.monotonic() + 120.0
+            while not out.get("done"):
+                try:
+                    out = rt.get(a.step_batch.remote(["s"], [steps]),
+                                 timeout=120.0)[0]
+                except rt.ActorDiedError:
+                    if time.monotonic() > deadline:
+                        raise
+                    time.sleep(0.2)
+                    continue
+                steps += 1
+            got = rt.get(a.result.remote("s"), timeout=120.0)["tokens"]
+            assert got == expected
+            assert steps == len(expected) - 4 - 1   # no extra steps
+        finally:
+            rt.shutdown()
+
+    def test_decode_chaos_canned_plan_survives(self):
+        """The acceptance run: evict KV pages + kill the replica
+        mid-decode; every sequence completes with fault-free tokens and
+        zero surfaced errors (also exercised by ci.sh chaos smoke)."""
+        from tosem_tpu.chaos.plan import CANNED_PLANS
+        from tosem_tpu.chaos.runner import run_plan
+        rep = run_plan(CANNED_PLANS["decode-chaos"])
+        assert rep.ok, rep.render()
+        assert rep.counts["errors_surfaced"] == 0
+        assert rep.counts["sequences_correct"] == rep.counts["sequences"]
+        assert len(rep.injections) == 2

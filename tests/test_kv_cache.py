@@ -1,0 +1,211 @@
+"""Block-table KV allocator tests (PR 6): free-list determinism,
+ref-counting / copy-on-write forks, all-or-nothing pressure, and the
+spill tier's byte-preserving round trip (:mod:`tosem_tpu.serve.kv_cache`).
+Pure host-side allocator logic — no model, no runtime."""
+import numpy as np
+import pytest
+
+from tosem_tpu.serve.kv_cache import (CachePressure, LocalSpillStore,
+                                      PagedKVCache, PagesLostError)
+
+
+def make_cache(num_pages=8, page_size=4, **kw):
+    kw.setdefault("layers", 2)
+    kw.setdefault("heads", 2)
+    kw.setdefault("head_dim", 4)
+    kw.setdefault("spill_store", LocalSpillStore())
+    return PagedKVCache(num_pages, page_size, **kw)
+
+
+def fill_pages(cache, seq_id, seed=0):
+    """Write recognizable bytes into a sequence's pages (the allocator
+    moves pages around; contents must follow)."""
+    rng = np.random.default_rng(seed)
+    idx = np.asarray(cache.pages_of(seq_id), np.int64)
+    k = rng.normal(size=(cache.layers, len(idx), cache.page_size,
+                         cache.heads, cache.head_dim)).astype(np.float32)
+    v = rng.normal(size=k.shape).astype(np.float32)
+    cache.set_pools(cache.k_pool.at[:, idx].set(k),
+                    cache.v_pool.at[:, idx].set(v))
+    return k, v
+
+
+def gather(cache, seq_id):
+    idx = np.asarray(cache.pages_of(seq_id), np.int64)
+    return (np.asarray(cache.k_pool[:, idx]),
+            np.asarray(cache.v_pool[:, idx]))
+
+
+def test_alloc_is_deterministic_creation_order():
+    c = make_cache()
+    c.create("a")
+    c.extend("a", 9)                       # 3 pages of 4
+    assert c.pages_of("a") == [0, 1, 2]
+    c.create("b")
+    c.extend("b", 1)
+    assert c.pages_of("b") == [3]
+
+
+def test_free_list_reuse_lifo():
+    c = make_cache()
+    c.create("a")
+    c.extend("a", 8)                       # pages 0, 1
+    c.free("a")
+    c.create("b")
+    c.extend("b", 4)
+    # LIFO free list: the most recently freed page comes back first
+    assert c.pages_of("b") == [1]
+
+
+def test_extend_returns_write_window():
+    c = make_cache()
+    c.create("a")
+    assert c.extend("a", 3) == (0, 3)
+    assert c.extend("a", 2) == (3, 5)
+    assert c.length("a") == 5
+    assert len(c.pages_of("a")) == 2
+
+
+def test_pressure_is_all_or_nothing():
+    c = make_cache(num_pages=2)
+    c.create("a")
+    c.extend("a", 4)                       # 1 page
+    with pytest.raises(CachePressure):
+        c.extend("a", 8)                   # needs 2 more, only 1 free
+    assert c.length("a") == 4              # nothing changed
+    assert len(c.pages_of("a")) == 1
+    c.extend("a", 4)                       # the 1-page growth still fits
+
+
+def test_fork_shares_pages_and_cow_on_append():
+    c = make_cache()
+    c.create("a")
+    c.extend("a", 6)                       # 2 pages, tail half-full
+    k0, _ = fill_pages(c, "a")
+    c.fork("a", "b")
+    assert c.pages_of("b") == c.pages_of("a")
+    # appending into the SHARED half-full tail page must copy it first
+    c.extend("b", 1)
+    pa, pb = c.pages_of("a"), c.pages_of("b")
+    assert pa[0] == pb[0]                  # full prefix page still shared
+    assert pa[1] != pb[1]                  # tail page copied
+    ka, _ = gather(c, "a")
+    kb, _ = gather(c, "b")
+    np.testing.assert_array_equal(ka, k0)  # a's bytes untouched
+    np.testing.assert_array_equal(kb, k0)  # b's copy preserved the tail
+
+
+def test_cow_page_counts_toward_capacity_check():
+    """Regression: growth that also needs a copy-on-write page must be
+    all-or-nothing — the old check admitted the COW copy and THEN hit
+    pressure on the growth page, mutating pages and the free list."""
+    c = make_cache(num_pages=3)
+    c.create("a")
+    c.extend("a", 6)                       # 2 pages, tail half-full
+    c.fork("a", "b")                       # tail shared (refs == 2)
+    pages_before = c.pages_of("b")
+    free_before = c.stats()["pages_free"]  # exactly 1 free
+    with pytest.raises(CachePressure):
+        c.extend("b", 3)                   # needs COW + 1 growth page
+    assert c.pages_of("b") == pages_before
+    assert c.stats()["pages_free"] == free_before
+    assert c.length("b") == 6
+    c.extend("b", 2)                       # COW-only growth still fits
+    assert c.pages_of("b")[-1] != pages_before[-1]
+
+
+def test_fork_then_free_refcounts():
+    c = make_cache(num_pages=4)
+    c.create("a")
+    c.extend("a", 8)                       # pages 0, 1
+    c.fork("a", "b")
+    c.free("a")
+    # b still holds both pages: nothing returned to the free list
+    assert c.stats()["pages_used"] == 2
+    c.free("b")
+    assert c.stats()["pages_used"] == 0
+
+
+def test_spill_restore_round_trip_is_byte_identical():
+    c = make_cache(num_pages=4)
+    c.create("a")
+    c.extend("a", 7)
+    k0, v0 = gather(c, "a")
+    c.spill("a")
+    assert c.is_spilled("a")
+    assert c.stats()["pages_used"] == 0
+    assert c.stats()["pages_spilled"] == 2
+    assert c.length("a") == 7              # length visible while spilled
+    # churn the pool so the restore lands on different physical pages
+    c.create("x")
+    c.extend("x", 4)
+    c.restore("a")
+    assert not c.is_spilled("a")
+    k1, v1 = gather(c, "a")
+    np.testing.assert_array_equal(k0, k1)
+    np.testing.assert_array_equal(v0, v1)
+    assert c.length("a") == 7
+
+
+def test_restore_under_pressure_changes_nothing():
+    c = make_cache(num_pages=2)
+    c.create("a")
+    c.extend("a", 8)                       # both pages
+    c.spill("a")
+    c.create("b")
+    c.extend("b", 8)                       # pool full again
+    with pytest.raises(CachePressure):
+        c.restore("a")
+    assert c.is_spilled("a")               # still parked, payload intact
+    c.free("b")
+    c.restore("a")
+    assert c.length("a") == 8
+
+
+def test_lost_payload_raises_and_drop_spilled_recovers():
+    store = LocalSpillStore()
+    c = make_cache(spill_store=store)
+    c.create("a")
+    c.extend("a", 4)
+    c.spill("a")
+    store._data.clear()                    # chaos: the payload is gone
+    with pytest.raises(PagesLostError):
+        c.restore("a")
+    # the re-prefill path: forget the spill, recreate from history
+    c.drop_spilled("a")
+    c.create("a")
+    c.extend("a", 4)
+    assert c.length("a") == 4
+
+
+def test_create_duplicate_and_spilled_duplicate_rejected():
+    c = make_cache()
+    c.create("a")
+    with pytest.raises(ValueError):
+        c.create("a")
+    c.extend("a", 1)
+    c.spill("a")
+    with pytest.raises(ValueError):
+        c.create("a")                      # spilled still owns the name
+
+
+def test_block_table_padding_and_width():
+    c = make_cache()
+    c.create("a")
+    c.extend("a", 9)                       # pages 0, 1, 2
+    bt = c.block_table("a", width=5)
+    assert bt.dtype == np.int32
+    assert list(bt) == [0, 1, 2, 0, 0]     # 0-padded, never read
+
+
+def test_stats_counts():
+    c = make_cache(num_pages=6)
+    c.create("a")
+    c.extend("a", 8)
+    c.create("b")
+    c.extend("b", 4)
+    c.spill("b")
+    s = c.stats()
+    assert s == {"pages_total": 6, "pages_used": 2, "pages_free": 4,
+                 "pages_spilled": 1, "sequences": 1,
+                 "sequences_spilled": 1}

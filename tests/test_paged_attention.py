@@ -1,0 +1,198 @@
+"""Paged-KV decode attention parity tests (PR 6).
+
+Pins all three lowerings of :func:`tosem_tpu.ops.paged_attention
+.paged_attention` against each other on CPU: the XLA gather lowering IS
+the dense reference by construction (so the off-chip serve decode path
+is bit-consistent with it), and the Pallas kernel (interpret mode here)
+must match to float32 round-off — its online softmax re-associates the
+reduction across pages, which moves the last ulp but nothing more.
+Includes ragged lengths, inactive rows, bf16, post-spill-restore pages,
+and the decode page-size selection table/cache.
+"""
+import numpy as np
+import pytest
+
+# fp32 parity budget for online-vs-dense softmax re-association: a few
+# ulps of the summed magnitudes, NOT a loose tolerance
+FP32_ATOL = 5e-6
+BF16_ATOL = 2e-2
+
+
+def _case(rng, B, H, D, page, P, n_pages, lens, dtype="float32"):
+    import jax.numpy as jnp
+    dt = jnp.dtype(dtype)
+    q = jnp.asarray(rng.normal(size=(B, H, D)), jnp.float32).astype(dt)
+    kp = jnp.asarray(rng.normal(size=(P, page, H, D)),
+                     jnp.float32).astype(dt)
+    vp = jnp.asarray(rng.normal(size=(P, page, H, D)),
+                     jnp.float32).astype(dt)
+    bt = jnp.asarray(rng.integers(0, P, size=(B, n_pages)), jnp.int32)
+    sl = jnp.asarray(lens, jnp.int32)
+    return q, kp, vp, bt, sl
+
+
+def test_xla_impl_is_the_reference_bit_exact():
+    """The off-chip serve path (impl=None on CPU -> xla) and the parity
+    reference are ONE definition: bit-consistent by construction."""
+    from tosem_tpu.ops.paged_attention import (paged_attention,
+                                               paged_attention_reference)
+    rng = np.random.default_rng(0)
+    q, kp, vp, bt, sl = _case(rng, 3, 2, 8, 4, 6, 3, [5, 0, 12])
+    ref = paged_attention_reference(q, kp, vp, bt, sl)
+    out = paged_attention(q, kp, vp, bt, sl, impl="xla")
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(out))
+    auto = paged_attention(q, kp, vp, bt, sl)        # CPU -> xla
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(auto))
+
+
+@pytest.mark.parametrize("lens", [[4], [7, 0, 16], [1, 8, 3, 13]])
+def test_pallas_interpret_matches_reference_fp32(lens):
+    from tosem_tpu.ops.paged_attention import (paged_attention,
+                                               paged_attention_reference)
+    rng = np.random.default_rng(1)
+    B = len(lens)
+    q, kp, vp, bt, sl = _case(rng, B, 2, 8, 4, 6, 4, lens)
+    ref = np.asarray(paged_attention_reference(q, kp, vp, bt, sl))
+    out = np.asarray(paged_attention(q, kp, vp, bt, sl, impl="pallas"))
+    np.testing.assert_allclose(out, ref, atol=FP32_ATOL, rtol=0)
+
+
+def test_pallas_interpret_matches_reference_bf16():
+    from tosem_tpu.ops.paged_attention import (paged_attention,
+                                               paged_attention_reference)
+    rng = np.random.default_rng(2)
+    q, kp, vp, bt, sl = _case(rng, 2, 2, 8, 4, 5, 3, [9, 12],
+                              dtype="bfloat16")
+    ref = np.asarray(paged_attention_reference(q, kp, vp, bt, sl),
+                     np.float32)
+    out = np.asarray(paged_attention(q, kp, vp, bt, sl, impl="pallas"),
+                     np.float32)
+    np.testing.assert_allclose(out, ref, atol=BF16_ATOL, rtol=0)
+
+
+def test_inactive_rows_emit_exact_zeros():
+    """seq_len == 0 rows are the decode batch's padding: their output
+    must be exactly zero in BOTH lowerings (the scheduler packs fewer
+    sequences than max_batch without a mask operand)."""
+    from tosem_tpu.ops.paged_attention import paged_attention
+    rng = np.random.default_rng(3)
+    q, kp, vp, bt, sl = _case(rng, 3, 2, 8, 4, 4, 2, [6, 0, 0])
+    for impl in ("xla", "pallas"):
+        out = np.asarray(paged_attention(q, kp, vp, bt, sl, impl=impl))
+        assert (out[1] == 0).all() and (out[2] == 0).all()
+        assert not (out[0] == 0).all()
+
+
+def test_pallas_is_run_to_run_deterministic():
+    from tosem_tpu.ops.paged_attention import paged_attention
+    rng = np.random.default_rng(4)
+    q, kp, vp, bt, sl = _case(rng, 2, 1, 8, 4, 4, 3, [10, 5])
+    a = np.asarray(paged_attention(q, kp, vp, bt, sl, impl="pallas"))
+    b = np.asarray(paged_attention(q, kp, vp, bt, sl, impl="pallas"))
+    np.testing.assert_array_equal(a, b)
+
+
+def test_attention_on_restored_pages_is_bit_identical():
+    """Spill a sequence, churn the pool, restore (pages land on
+    DIFFERENT physical ids) — the kernel output over the restored block
+    table must match the pre-spill output bit for bit."""
+    import jax.numpy as jnp
+
+    from tosem_tpu.ops.paged_attention import paged_attention
+    from tosem_tpu.serve.kv_cache import LocalSpillStore, PagedKVCache
+    rng = np.random.default_rng(5)
+    H, D, page = 2, 8, 4
+    c = PagedKVCache(6, page, layers=1, heads=H, head_dim=D,
+                     spill_store=LocalSpillStore())
+    c.create("a")
+    c.extend("a", 10)                      # pages 0, 1, 2
+    idx = np.asarray(c.pages_of("a"), np.int64)
+    k = rng.normal(size=(1, len(idx), page, H, D)).astype(np.float32)
+    v = rng.normal(size=k.shape).astype(np.float32)
+    c.set_pools(c.k_pool.at[:, idx].set(k), c.v_pool.at[:, idx].set(v))
+    q = jnp.asarray(rng.normal(size=(1, H, D)), jnp.float32)
+
+    def run():
+        bt = jnp.asarray(c.block_table("a", 3)[None], jnp.int32)
+        sl = jnp.asarray([10], jnp.int32)
+        return np.asarray(paged_attention(
+            q, c.k_pool[0], c.v_pool[0], bt, sl, impl="pallas"))
+
+    before = run()
+    c.spill("a")
+    c.create("x")
+    c.extend("x", 8)                       # steal the freed pages
+    c.free("x")
+    c.create("y")
+    c.extend("y", 4)                       # keep one stolen so ids shift
+    c.restore("a")
+    assert c.pages_of("a") != list(idx)    # really moved
+    np.testing.assert_array_equal(before, run())
+
+
+def test_input_validation():
+    from tosem_tpu.ops.paged_attention import paged_attention
+    rng = np.random.default_rng(6)
+    q, kp, vp, bt, sl = _case(rng, 2, 2, 8, 4, 4, 2, [3, 3])
+    with pytest.raises(ValueError):
+        paged_attention(q, kp, vp[:, :, :1], bt, sl)
+    with pytest.raises(ValueError):
+        paged_attention(q[:, :1], kp, vp, bt, sl)
+    with pytest.raises(ValueError):
+        paged_attention(q, kp, vp, bt[:1], sl)
+    with pytest.raises(ValueError):
+        paged_attention(q, kp, vp, bt, sl, impl="mosaic")
+
+
+# ------------------------------------------------------ page-size selection
+
+def test_select_page_size_table_and_default():
+    from tosem_tpu.ops import flash_blocks as fb
+    assert fb.select_page_size(64, "bfloat16", cache_path=None) == 128
+    assert fb.select_page_size.last_source == "table"
+    assert fb.select_page_size(96, "float32", cache_path=None) == 128
+    assert fb.select_page_size.last_source == "default"
+
+
+def test_select_page_size_clamps_to_max_len():
+    from tosem_tpu.ops import flash_blocks as fb
+    assert fb.select_page_size(64, "bfloat16", max_len=32,
+                               cache_path=None) == 32
+    assert fb.select_page_size(64, "bfloat16", max_len=3,
+                               cache_path=None) == 8   # sublane floor
+
+
+def test_page_cache_override_and_sections(tmp_path):
+    from tosem_tpu.ops import flash_blocks as fb
+    path = str(tmp_path / "blocks.json")
+    try:
+        # the pages section must coexist with the blocks section
+        fb.save_cache({"t128_d32_float32": [64, 64, 64, 64]},
+                      path, section="blocks")
+        fb.save_cache({"decode_d64_bfloat16": 256}, path,
+                      section="pages")
+        fb.reset_cache()
+        assert fb.select_page_size(64, "bfloat16", cache_path=path) == 256
+        assert fb.select_page_size.last_source == "cache"
+        assert fb.select_block_sizes(128, 32, "float32",
+                                     cache_path=path).bq == 64
+        with pytest.raises(ValueError):
+            fb.save_cache({}, path, section="chunks")
+    finally:
+        fb.reset_cache()
+
+
+@pytest.mark.slow
+def test_autotune_decode_pages_end_to_end(tmp_path):
+    from tosem_tpu.ops import flash_blocks as fb
+    path = str(tmp_path / "blocks.json")
+    try:
+        recs = fb.autotune_decode_pages([(1, 1, 128, 8, "float32")],
+                                        reps=1, cache_path=path)
+        assert recs and any(r["best"] for r in recs)
+        fb.reset_cache()
+        picked = fb.select_page_size(8, "float32", cache_path=path)
+        assert picked == next(r["page"] for r in recs if r["best"])
+        assert fb.select_page_size.last_source == "cache"
+    finally:
+        fb.reset_cache()

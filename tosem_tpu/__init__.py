@@ -43,6 +43,16 @@ _LAZY_EXPORTS = {
                            "select_block_sizes"),
     "sharded_flash_attention": ("tosem_tpu.parallel.flash",
                                 "sharded_flash_attention"),
+    # autoregressive-decode surface (round 7): paged-KV decode kernel,
+    # the block-table allocator, and the iteration-level scheduler knobs
+    "paged_attention": ("tosem_tpu.ops.paged_attention",
+                        "paged_attention"),
+    "PagedKVCache": ("tosem_tpu.serve.kv_cache", "PagedKVCache"),
+    "CachePressure": ("tosem_tpu.serve.kv_cache", "CachePressure"),
+    "PagesLostError": ("tosem_tpu.serve.kv_cache", "PagesLostError"),
+    "DecodePolicy": ("tosem_tpu.serve.batching", "DecodePolicy"),
+    "select_page_size": ("tosem_tpu.ops.flash_blocks",
+                         "select_page_size"),
 }
 
 __all__ = sorted(_LAZY_EXPORTS)

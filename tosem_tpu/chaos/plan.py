@@ -29,7 +29,8 @@ from typing import Any, Dict, List, Optional
 # inject.
 VALID_SITES = (
     "runtime.dispatch", "runtime.result", "runtime.store",
-    "serve.dispatch", "tune.step", "cluster.submit", "train.step",
+    "serve.dispatch", "serve.decode_step", "tune.step", "cluster.submit",
+    "train.step",
 )
 
 VALID_ACTIONS = {
@@ -37,6 +38,9 @@ VALID_ACTIONS = {
     "runtime.result": ("drop_result", "delay_result"),
     "runtime.store": ("evict_object",),
     "serve.dispatch": ("crash_replica", "slow_replica"),
+    # fired once per decode-scheduler iteration: evict_pages spills the
+    # coldest active sequence's KV pages out of the pool mid-decode
+    "serve.decode_step": ("evict_pages", "slow_step"),
     "tune.step": ("crash_trial",),
     "cluster.submit": ("kill_node",),
     "train.step": ("preempt",),
@@ -166,6 +170,15 @@ def _canned() -> Dict[str, FaultPlan]:
         # metric history (not re-diverge, not restart from step 0)
         "train-preempt": FaultPlan(seed=29, name="train-preempt", faults=[
             Fault(site="train.step", action="preempt", at=5),
+        ]),
+        # the decode acceptance plan: evict KV pages mid-decode AND
+        # crash the decode replica a few steps later — every sequence
+        # must complete with the SAME tokens a fault-free run produces
+        # (greedy decode is deterministic; spill-restore is byte-
+        # preserving; replica loss re-prefills from token history)
+        "decode-chaos": FaultPlan(seed=37, name="decode-chaos", faults=[
+            Fault(site="serve.decode_step", action="evict_pages", at=2),
+            Fault(site="serve.dispatch", action="crash_replica", at=9),
         ]),
         # the self-healing acceptance plan: a live object evicted, a
         # worker killed mid-task, AND a node agent killed — one run,

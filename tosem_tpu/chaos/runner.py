@@ -346,6 +346,65 @@ def _scenario_state_plane(chaos: ChaosController,
         rt.shutdown()
 
 
+def _scenario_decode(chaos: ChaosController,
+                     rep: SurvivalReport) -> None:
+    """The decode acceptance run: 8 sequences decode through the
+    iteration-level scheduler while the plan spills KV pages out from
+    under an active sequence AND crashes the (only) replica mid-decode.
+    Every sequence must complete with the SAME tokens a fault-free run
+    produces — greedy decode is deterministic, spill-restore is
+    byte-preserving, and replica loss re-prefills from token history —
+    with zero surfaced errors."""
+    import tosem_tpu.runtime as rt
+    from tosem_tpu.serve.backends import BertDecodeBackend
+    from tosem_tpu.serve.batching import DecodePolicy
+    from tosem_tpu.serve.core import Serve
+
+    kw = dict(max_batch=4, max_len=64, page_size=16, num_pages=24,
+              max_new_tokens=6)
+    prompts = [{"ids": [1 + i, 2 + i, 3 + i, 4 + i]} for i in range(8)]
+    # fault-free reference: the same backend driven sequentially
+    # in-process (no serve data plane, so no chaos sites fire)
+    ref_backend = BertDecodeBackend(**kw)
+    expected = []
+    for i, p in enumerate(prompts):
+        out = ref_backend.admit(f"ref{i}", p)
+        step = 0
+        while not out.get("done"):
+            out = ref_backend.step_batch([f"ref{i}"], [step])[0]
+            step += 1
+        expected.append(ref_backend.result(f"ref{i}")["tokens"])
+        ref_backend.release(f"ref{i}")
+
+    rt.init(num_workers=2, memory_monitor=False)
+    try:
+        serve = Serve()
+        serve.deploy("decode", BertDecodeBackend, init_kwargs=kw,
+                     decode_policy=DecodePolicy(max_active=4),
+                     max_restarts=2, max_retries=3)
+        h = serve.get_handle("decode")
+        futs = [h.remote(p) for p in prompts]
+        got, errors = [], 0
+        for f in futs:
+            try:
+                got.append(f.result(timeout=300.0)["tokens"])
+            except BaseException:
+                got.append(None)
+                errors += 1
+        correct = sum(1 for g, e in zip(got, expected) if g == e)
+        rep.counts["sequences"] = len(prompts)
+        rep.counts["sequences_correct"] = correct
+        rep.counts["errors_surfaced"] = errors
+        st = serve.get_deployment("decode").stats()
+        rep.counts["kv_spills"] = st.get("kv_spills", 0)
+        rep.ok = errors == 0 and correct == len(prompts)
+        if not rep.ok:
+            rep.notes.append(f"expected {expected}, got {got}")
+        serve.delete("decode")
+    finally:
+        rt.shutdown()
+
+
 SCENARIOS: Dict[str, Callable[[ChaosController, SurvivalReport], None]] = {
     "worker-carnage": _scenario_runtime,
     "serve-flap": _scenario_serve,
@@ -355,6 +414,7 @@ SCENARIOS: Dict[str, Callable[[ChaosController, SurvivalReport], None]] = {
     "node-kill-heal": _scenario_node_kill,
     "train-preempt": _scenario_train_preempt,
     "state-plane-survival": _scenario_state_plane,
+    "decode-chaos": _scenario_decode,
 }
 
 

@@ -28,7 +28,7 @@ CONFIGS = ("gemm", "timing_check", "conv_sweep", "allreduce",
            "resnet_train", "bert_kernels", "bert_train",
            "flash_autotune", "detection_train", "detection_infer",
            "pointpillars_infer", "speech_train", "serve_bench",
-           "analysis")
+           "decode_bench", "analysis")
 
 
 def make_flags() -> FlagSet:
@@ -499,6 +499,28 @@ def run_flash_autotune(fs: FlagSet) -> List[Any]:
     for r in rows:
         star = " *" if r.extra["best"] else ""
         print(f"  {r.bench_id}: {r.value:.1f} {r.unit}{star}")
+    # decode page-size rows (the paged-attention analog of the block
+    # sweep): winners land in the same cache's "pages" section, where
+    # select_page_size — and therefore BertDecodeBackend — reads them
+    from tosem_tpu.ops.flash_blocks import autotune_decode_pages
+    if fs.device == "cpu":
+        page_shapes = [(2, 2, 128, 32, "float32")]
+    else:
+        page_shapes = [(8, 12, 512, 64, "bfloat16"),
+                       (8, 12, 2048, 64, "bfloat16")]
+    for r in autotune_decode_pages(page_shapes, reps=3):
+        B, H, T, D, dtype = r["shape"]
+        row = ResultRow(
+            project="ops", config="flash_autotune",
+            bench_id=f"decode_pages_b{B}_t{T}_{dtype}_p{r['page']}",
+            metric="time_us", value=r["time_us"], unit="us",
+            device=platform, n_devices=1,
+            extra={"shape": [B, H, T, D], "dtype": dtype,
+                   "page": r["page"], "best": r["best"],
+                   "cache": DEFAULT_CACHE_PATH})
+        rows.append(row)
+        star = " *" if r["best"] else ""
+        print(f"  {row.bench_id}: {row.value:.1f} {row.unit}{star}")
     print(f"  winners -> {DEFAULT_CACHE_PATH}")
     return rows
 
@@ -855,6 +877,19 @@ def run_serve_bench(fs: FlagSet) -> List[Any]:
     return rows
 
 
+def run_decode_bench(fs: FlagSet) -> List[Any]:
+    """Autoregressive-decode microbench as a capture-harness leg:
+    closed-loop token throughput of continuous batching over the paged
+    KV cache vs the naive re-encode baseline at 1/16 concurrent
+    sequences (see :mod:`tosem_tpu.serve.bench_decode`). Rows land
+    under the ``decode_bench`` config."""
+    from tosem_tpu.serve.bench_decode import run_decode_benchmarks
+    rows = run_decode_benchmarks(trials=2, min_s=0.4)
+    for r in rows:
+        r.config = "decode_bench"
+    return rows
+
+
 def run_analysis(fs: FlagSet) -> List[Any]:
     """Study analysis layer (L8): classify this repo's test suite into the
     RQ3/RQ4 taxonomy and correlate the bench CSVs — the consumer role of
@@ -926,6 +961,7 @@ RUNNERS = {
     "pointpillars_infer": run_pointpillars_infer,
     "speech_train": run_speech_train,
     "serve_bench": run_serve_bench,
+    "decode_bench": run_decode_bench,
     "analysis": run_analysis,
 }
 

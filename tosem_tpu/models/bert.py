@@ -231,6 +231,107 @@ class Bert(Module):
         return self.tok.attend(variables(vs["params"]["tok"]),
                                encodings.astype(jnp.float32))
 
+    # ------------------------------------------------------- decode path
+    #
+    # The causal-decoder member of the Bert family: the SAME params and
+    # per-layer math as the encoder, with causal attention and the tied
+    # -embedding LM head — split into a prefill function (full-context
+    # causal forward that also RETURNS per-layer K/V, which the serving
+    # layer writes into KV pages) and a one-token decode step that reads
+    # the pages back through the paged-attention kernel. Both are pure
+    # closures over fixed variables with static shapes, AOT-compilable
+    # once per (page config, max-batch) in the serve compile cache.
+
+    def _check_decodable(self) -> None:
+        if self.cfg.moe_experts:
+            raise ValueError("decode path supports dense-FFN configs "
+                             "only (moe_experts must be 0)")
+        if self.cfg.remat != "none":
+            raise ValueError("decode path is inference-only; set "
+                             "remat='none'")
+
+    def prefill_fn(self, vs, *, attn_fn=None):
+        """Causal prefill: ``fwd(ids [B,T], mask [B,T]) -> (logits
+        [B,T,vocab], k [L,B,T,H,Dh], v [L,B,T,H,Dh])``.
+
+        ``attn_fn`` defaults to the PR-4 flash dispatch with
+        ``causal=True`` (eligible shapes ride the Pallas kernels; causal
+        pads at the END of a prompt never leak into real positions, so
+        no padding mask is needed for correctness — ``mask`` only
+        selects which logits the caller trusts). The per-layer K/V are
+        the values the serving layer scatters into KV pages.
+        """
+        self._check_decodable()
+        from tosem_tpu.nn.attention import flash_attn_fn
+        core = attn_fn or flash_attn_fn(causal=True)
+        p = vs["params"]
+
+        def fwd(ids, mask):
+            B, T = ids.shape
+            h = self._embed(p, ids, jnp.arange(T)[None, :])
+            ks, vs_ = [], []
+            for i, layer in enumerate(self.layers):
+                h, k_l, v_l = _decode_layer_full(
+                    layer, p[f"layer{i}"], h, core)
+                ks.append(k_l)
+                vs_.append(v_l)
+            h, _ = self.ln_out.apply(variables(p["ln_out"]), h)
+            logits = self.tok.attend(variables(p["tok"]),
+                                     h.astype(jnp.float32))
+            return logits, jnp.stack(ks), jnp.stack(vs_)
+        return fwd
+
+    def decode_step_fn(self, vs, *, page_size: int, impl=None):
+        """One-token decode step over the paged cache: ``fwd(ids [B],
+        positions [B], k_pool, v_pool [L,P,page,H,Dh], block_tables
+        [B,max_pages], seq_lens [B]) -> (logits [B,vocab], k_pool',
+        v_pool')``.
+
+        ``seq_lens`` INCLUDE the current token (``positions ==
+        seq_lens - 1`` for active rows); inactive rows carry
+        ``seq_lens == 0`` and ``positions`` pointing anywhere — their
+        K/V write is routed out of bounds (dropped by the scatter) and
+        their attention output is zeros (kernel contract), so a decode
+        batch pads to a static max-batch with no extra mask operand.
+        Pools are updated functionally and returned — the caller swaps
+        them back into the cache (one compiled program per (page
+        config, max-batch); nothing here depends on step index)."""
+        self._check_decodable()
+        from tosem_tpu.ops.paged_attention import paged_attention
+        p = vs["params"]
+
+        def fwd(ids, positions, k_pool, v_pool, block_tables, seq_lens):
+            B = ids.shape[0]
+            active = seq_lens.astype(jnp.int32) > 0
+            h = self._embed(p, ids[:, None], positions[:, None])[:, 0]
+            page_idx = positions // page_size
+            rows = positions % page_size
+            # inactive rows scatter out of bounds → dropped (jax scatter
+            # OOB semantics), so padding rows never corrupt page 0
+            P = k_pool.shape[1]
+            pages = jnp.where(
+                active,
+                jnp.take_along_axis(block_tables,
+                                    page_idx[:, None], axis=1)[:, 0],
+                P)
+            for i, layer in enumerate(self.layers):
+                h, k_pool, v_pool = _decode_layer_step(
+                    layer, p[f"layer{i}"], h, i, k_pool, v_pool,
+                    pages, rows, block_tables, seq_lens, impl)
+            h, _ = self.ln_out.apply(variables(p["ln_out"]), h[:, None])
+            logits = self.tok.attend(variables(p["tok"]),
+                                     h[:, 0].astype(jnp.float32))
+            return logits, k_pool, v_pool
+        return fwd
+
+    def _embed(self, p, ids, pos_ids):
+        """Shared embedding stack (ids+pos → ln_emb), eval mode."""
+        h, _ = self.tok.apply(variables(p["tok"]), ids)
+        hp, _ = self.pos.apply(variables(p["pos"]), pos_ids)
+        h = h + hp
+        h, _ = self.ln_emb.apply(variables(p["ln_emb"]), h)
+        return h
+
     def encode_fn(self, vs, *, attn_fn=None):
         """Batched-inference entry point: a pure ``fwd(ids, mask) ->
         encodings`` closure over fixed variables, shaped for AOT
@@ -245,6 +346,59 @@ class Bert(Module):
                                 attn_fn=attn_fn)
             return enc
         return fwd
+
+
+def _decode_layer_full(layer, p_l, x, core):
+    """EncoderLayer.apply with the K/V projections surfaced (prefill).
+    Reuses the layer's own module objects, so the math — precisions,
+    dtypes, layernorm statistics — is the encoder path's, bit for bit."""
+    B, T, _ = x.shape
+    attn = layer.attn
+    h, _ = layer.ln1.apply(variables(p_l["ln1"]), x)
+    proj = lambda name, m: m.apply(variables(p_l["attn"][name]), h)[0] \
+        .reshape(B, T, attn.heads, attn.head_dim)
+    q = proj("q", attn.q)
+    k = proj("k", attn.k)
+    v = proj("v", attn.v)
+    out = core(q, k, v, None).reshape(B, T, attn.dim)
+    out, _ = attn.o.apply(variables(p_l["attn"]["o"]), out)
+    x = x + out
+    h, _ = layer.ln2.apply(variables(p_l["ln2"]), x)
+    h, _ = layer.fc1.apply(variables(p_l["fc1"]), h)
+    h = gelu(h)
+    h, _ = layer.fc2.apply(variables(p_l["fc2"]), h)
+    return x + h, k, v
+
+
+def _decode_layer_step(layer, p_l, x, layer_idx, k_pool, v_pool, pages,
+                       rows, block_tables, seq_lens, impl):
+    """One layer of the single-token decode step: project q/k/v for the
+    current token, scatter K/V into its page slot, attend over the
+    paged cache (which now includes the token itself), then the same
+    residual/MLP chain as the encoder layer."""
+    from tosem_tpu.ops.paged_attention import paged_attention
+    B = x.shape[0]
+    attn = layer.attn
+    h, _ = layer.ln1.apply(variables(p_l["ln1"]), x)
+    proj = lambda name, m: m.apply(variables(p_l["attn"][name]), h)[0] \
+        .reshape(B, attn.heads, attn.head_dim)
+    q = proj("q", attn.q)
+    k = proj("k", attn.k)
+    v = proj("v", attn.v)
+    k_pool = k_pool.at[layer_idx, pages, rows].set(
+        k.astype(k_pool.dtype))
+    v_pool = v_pool.at[layer_idx, pages, rows].set(
+        v.astype(v_pool.dtype))
+    out = paged_attention(q, k_pool[layer_idx], v_pool[layer_idx],
+                          block_tables, seq_lens, impl=impl)
+    out = out.reshape(B, attn.dim).astype(x.dtype)
+    out, _ = attn.o.apply(variables(p_l["attn"]["o"]), out)
+    x = x + out
+    h, _ = layer.ln2.apply(variables(p_l["ln2"]), x)
+    h, _ = layer.fc1.apply(variables(p_l["fc1"]), h)
+    h = gelu(h)
+    h, _ = layer.fc2.apply(variables(p_l["fc2"]), h)
+    return x + h, k_pool, v_pool
 
 
 def pad_ids_batch(id_seqs, pad_to: int, pad_batch_to: int = 0):

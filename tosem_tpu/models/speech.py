@@ -203,6 +203,22 @@ class SpeechModel(Module):
         zeros = jnp.zeros((batch, c, self.cfg.n_input))
         return self.streaming_step(vs, state, zeros)
 
+    def decode_step_fn(self, vs):
+        """Streaming-decode hook for the iteration-level serve
+        scheduler: a pure ``step(h, c, buf, chunk) -> (logits, h, c,
+        buf)`` closure over fixed variables with STATIC shapes (``h``/
+        ``c``: [B, n_cell]; ``buf``: [B, 2·n_context, n_input];
+        ``chunk``: [B, chunk_frames, n_input]), AOT-compilable once per
+        chunk shape in the serve compile cache — the speech analog of
+        the paged decode step (the LSTM carry is the "cache"; there are
+        no pages to manage). Emits ``chunk_frames`` logit rows per call
+        once the context buffer is primed."""
+        def step(h, c, buf, chunk):
+            logits, ((h2, c2), buf2) = self.streaming_step(
+                vs, ((h, c), buf), chunk)
+            return logits, h2, c2, buf2
+        return step
+
     def logits_fn(self, vs):
         """Batched-inference entry point: a pure ``fwd(feats) -> logits``
         closure over fixed variables, shaped for AOT compilation per

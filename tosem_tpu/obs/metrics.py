@@ -196,6 +196,11 @@ def prometheus_text() -> str:
 _BATCH_WAIT_BUCKETS = (0.1, 0.5, 1.0, 2.0, 5.0, 10.0, 20.0, 50.0, 100.0,
                        float("inf"))
 
+# Decode-step occupancy is an integer row count bounded by the backend's
+# max_batch (small powers of two), not a latency.
+_OCCUPANCY_BUCKETS = (0.0, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0,
+                      float("inf"))
+
 
 def serve_metrics(registry: Optional[Registry] = None) -> Dict[str, Metric]:
     """The serving data plane's instruments, defined in ONE place so
@@ -212,6 +217,17 @@ def serve_metrics(registry: Optional[Registry] = None) -> Dict[str, Metric]:
     - ``serve_requests_total`` (counter, labels deployment/outcome):
       logical request outcomes (``ok`` / ``error``) — requests, never
       dispatches, so a 16-request batch counts 16.
+
+    Decode (continuous-batching) instruments, fed by
+    :class:`~tosem_tpu.serve.batching.DecodeQueue`:
+
+    - ``serve_decode_active_sequences`` (gauge): sequences currently
+      packed into the decode batch.
+    - ``serve_decode_batch_occupancy`` (histogram): live rows per decode
+      step — low occupancy with a deep queue means page pressure, not
+      lack of demand.
+    - ``serve_kv_pages`` (gauge, labels deployment/state): KV-cache
+      pages ``used`` / ``free`` / ``spilled``.
     """
     reg = registry or DEFAULT
     return {
@@ -231,6 +247,18 @@ def serve_metrics(registry: Optional[Registry] = None) -> Dict[str, Metric]:
             "serve_requests_total",
             "logical request outcomes (per request, not per dispatch)",
             labels=("deployment", "outcome")),
+        "decode_active": reg.gauge(
+            "serve_decode_active_sequences",
+            "sequences currently packed into the decode batch",
+            labels=("deployment",)),
+        "decode_occupancy": reg.histogram(
+            "serve_decode_batch_occupancy",
+            "live rows per decode step",
+            labels=("deployment",), buckets=_OCCUPANCY_BUCKETS),
+        "kv_pages": reg.gauge(
+            "serve_kv_pages",
+            "KV-cache pages by state (used/free/spilled)",
+            labels=("deployment", "state")),
     }
 
 

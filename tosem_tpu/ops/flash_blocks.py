@@ -153,23 +153,34 @@ def _align_to_seq(blocks: BlockSizes, Tq: int, Tk: int) -> BlockSizes:
 
 
 _cache: Optional[Dict[str, List[int]]] = None
+_pages_cache: Optional[Dict[str, int]] = None
 _cache_path_loaded: Optional[str] = None
 
 
-def _load_cache(path: str) -> Dict[str, List[int]]:
-    global _cache, _cache_path_loaded
-    if _cache is not None and _cache_path_loaded == path:
-        return _cache
-    data: Dict[str, List[int]] = {}
+def _load_raw(path: str) -> dict:
     try:
         with open(path) as f:
-            raw = json.load(f)
-        data = {k: v for k, v in raw.get("blocks", {}).items()
-                if isinstance(v, list) and len(v) == 4}
+            return json.load(f)
     except (OSError, ValueError):
-        data = {}
-    _cache, _cache_path_loaded = data, path
+        return {}
+
+
+def _load_cache(path: str) -> Dict[str, List[int]]:
+    global _cache, _pages_cache, _cache_path_loaded
+    if _cache is not None and _cache_path_loaded == path:
+        return _cache
+    raw = _load_raw(path)
+    data = {k: v for k, v in raw.get("blocks", {}).items()
+            if isinstance(v, list) and len(v) == 4}
+    pages = {k: int(v) for k, v in raw.get("pages", {}).items()
+             if isinstance(v, (int, float)) and int(v) > 0}
+    _cache, _pages_cache, _cache_path_loaded = data, pages, path
     return data
+
+
+def _load_pages(path: str) -> Dict[str, int]:
+    _load_cache(path)
+    return _pages_cache or {}
 
 
 def _cache_key(T: int, d: int, dtype: str) -> str:
@@ -290,12 +301,20 @@ def autotune(shapes: Iterable[Tuple[int, int, int, int, str]],
 
 
 def save_cache(winners: Dict[str, List[int]],
-               cache_path: str = DEFAULT_CACHE_PATH) -> None:
-    """Merge winners into the JSON cache (atomic write)."""
-    global _cache, _cache_path_loaded
-    merged = dict(_load_cache(cache_path))
-    merged.update(winners)
-    payload = {"blocks": merged}
+               cache_path: str = DEFAULT_CACHE_PATH, *,
+               section: str = "blocks") -> None:
+    """Merge winners into the JSON cache (atomic write). ``section`` is
+    ``"blocks"`` (flash chunk sizes, list-of-4 values) or ``"pages"``
+    (decode page sizes, scalar values); the other section is preserved."""
+    global _cache, _pages_cache, _cache_path_loaded
+    if section not in ("blocks", "pages"):
+        raise ValueError(f"unknown cache section {section!r}")
+    blocks = dict(_load_cache(cache_path))
+    pages = dict(_pages_cache or {})
+    (blocks if section == "blocks" else pages).update(winners)
+    payload: dict = {"blocks": blocks}
+    if pages:
+        payload["pages"] = pages
     d = os.path.dirname(cache_path)
     if d:
         os.makedirs(d, exist_ok=True)
@@ -303,10 +322,124 @@ def save_cache(winners: Dict[str, List[int]],
     with open(tmp, "w") as f:
         json.dump(payload, f, indent=1, sort_keys=True)
     os.replace(tmp, cache_path)
-    _cache, _cache_path_loaded = merged, cache_path
+    _cache, _pages_cache, _cache_path_loaded = blocks, pages, cache_path
 
 
 def reset_cache() -> None:
     """Drop the in-process cache view (tests; after external writes)."""
-    global _cache, _cache_path_loaded
-    _cache, _cache_path_loaded = None, None
+    global _cache, _pages_cache, _cache_path_loaded
+    _cache, _pages_cache, _cache_path_loaded = None, None, None
+
+
+# ---------------------------------------------------------------------------
+# decode page-size selection (paged_attention)
+
+# (d, dtype) -> KV page size for the paged decode kernel. The trade is
+# the flash one rotated 90°: bigger pages stream fewer, larger chunks
+# (better DMA amortization) but waste more pool memory per sequence
+# (internal fragmentation averages page_size/2 tokens per sequence) and
+# coarsen the allocator's eviction granularity. 128 tokens = one lane
+# tile of scores per page — the smallest size whose (SUB, page) scores
+# block is still a full Mosaic tile.
+DECODE_PAGE_TABLE: Dict[Tuple[int, str], int] = {
+    (64, "bfloat16"): 128,
+    (64, "float32"): 128,
+}
+
+_DEFAULT_PAGE = 128
+
+_PAGE_CANDIDATES = (64, 128, 256, 512)
+
+
+def _page_key(d: int, dtype: str) -> str:
+    return f"decode_d{d}_{dtype}"
+
+
+def select_page_size(d: int, dtype: str, *, max_len: Optional[int] = None,
+                     cache_path: Optional[str] = DEFAULT_CACHE_PATH) -> int:
+    """Pick the KV page size for a (d, dtype) decode config.
+
+    Priority mirrors :func:`select_block_sizes`: autotune cache →
+    static table → default; then clamp to ``max_len`` (a cache that can
+    only ever hold short sequences gains nothing from big pages),
+    flooring at 8 sublanes. Sets ``select_page_size.last_source``.
+    """
+    dtype = str(dtype)
+    picked: Optional[int] = None
+    src = "default"
+    if cache_path:
+        hit = _load_pages(cache_path).get(_page_key(d, dtype))
+        if hit:
+            picked, src = int(hit), "cache"
+    if picked is None:
+        hit = DECODE_PAGE_TABLE.get((d, dtype))
+        if hit is not None:
+            picked, src = int(hit), "table"
+    if picked is None:
+        picked = _DEFAULT_PAGE
+    if max_len is not None:
+        while picked > max(_SUBLANES, 1) and picked > max_len:
+            picked //= 2
+    select_page_size.last_source = src
+    return max(picked, _SUBLANES)
+
+
+select_page_size.last_source = "default"
+
+
+def autotune_decode_pages(shapes: Iterable[Tuple[int, int, int, int, str]],
+                          *, reps: int = 3,
+                          cache_path: str = DEFAULT_CACHE_PATH
+                          ) -> List[dict]:
+    """Measure candidate page sizes for the paged decode kernel on the
+    current device and cache the winners (the decode rows of the
+    flash-blocks autotune discipline).
+
+    ``shapes``: iterables of (B, H, T, d, dtype) where T is the cached
+    context length per sequence. Returns one record per measured
+    candidate; winners land in the ``"pages"`` section of
+    ``cache_path`` for :func:`select_page_size` to pick up. Winners are
+    keyed (d, dtype) — the same key the selector reads — so when
+    several shapes share one, the FIRST shape's winner sticks: order
+    your sweep north-star shape first."""
+    import jax
+    import jax.numpy as jnp
+
+    from tosem_tpu.ops.paged_attention import paged_attention
+    from tosem_tpu.utils.timing import DeviceLoopBench
+
+    records: List[dict] = []
+    winners: Dict[str, int] = {}
+    for B, H, T, d, dtype in shapes:
+        dt = jnp.dtype(dtype)
+        ks = jax.random.split(jax.random.PRNGKey(0), 3)
+        q = jax.random.normal(ks[0], (B, H, d), jnp.float32).astype(dt)
+        best = None
+        timed = []
+        for page in _PAGE_CANDIDATES:
+            if page > T or T % page:
+                continue
+            n_pages = T // page
+            P = B * n_pages
+            kp = jax.random.normal(ks[1], (P, page, H, d),
+                                   jnp.float32).astype(dt)
+            vp = jax.random.normal(ks[2], (P, page, H, d),
+                                   jnp.float32).astype(dt)
+            bt = jnp.arange(P, dtype=jnp.int32).reshape(B, n_pages)
+            sl = jnp.full((B,), T, jnp.int32)
+            op = jax.jit(lambda q, k, v, bt=bt, sl=sl:
+                         paged_attention(q, k, v, bt, sl, impl="pallas"))
+            sec = DeviceLoopBench(op=op, args=(q, kp, vp),
+                                  perturb=0).time(reps=reps)
+            timed.append((page, sec))
+            if best is None or sec < best[1]:
+                best = (page, sec)
+        for page, sec in timed:
+            records.append({"shape": [B, H, T, d, dtype], "page": page,
+                            "time_us": sec * 1e6,
+                            "best": page == best[0]})
+        if best is not None:
+            winners.setdefault(_page_key(d, str(dtype)), best[0])
+    if winners:
+        save_cache(winners, cache_path, section="pages")
+    return records

@@ -1,0 +1,230 @@
+"""Paged-KV decode attention — Pallas TPU kernel over a block table.
+
+Autoregressive decode attends ONE query token per sequence against that
+sequence's whole cached history. Keeping each sequence's K/V contiguous
+would force max-length pre-allocation per sequence (the memory waste
+vLLM's PagedAttention removed); instead the serving layer stores K/V in
+fixed-size **pages** drawn from a shared pool and hands the kernel a
+per-sequence **block table** of physical page ids. The kernel streams a
+sequence's pages through VMEM exactly like the PR-4 flash kernels stream
+K/V chunks — online softmax in fp32 VMEM scratch, outputs written on the
+final page — except the page index comes from the (scalar-prefetched)
+block table instead of the grid position, so pages can live anywhere in
+the pool.
+
+Layout contracts:
+
+- ``q``: [B, H, D] — one decode token per sequence.
+- ``k_pages``/``v_pages``: [P, page_size, H, D] — the shared pools; a
+  physical page is one ``pages[p]`` slab.
+- ``block_tables``: [B, max_pages] int32 — logical page j of sequence b
+  lives at physical page ``block_tables[b, j]``; slots past the
+  sequence's last page MUST hold a valid page id (0 is fine) — they are
+  never read for real, but the index map touches them.
+- ``seq_lens``: [B] int32 — tokens cached per sequence (0 = inactive
+  row: output is zeros, letting the decode scheduler pad its batch to a
+  static max-batch without a separate mask operand).
+
+The query travels broadcast across 8 sublanes (the flash kernels'
+statistic trick, sideways: a (1, D) tile is not Mosaic-tileable, a
+(8, D) one is) and the caller reads row 0 back. Grid is
+``(B, H, max_pages)`` with the page dimension ``"arbitrary"`` so the
+scratch accumulators persist across the page sweep; skipped pages
+(beyond a sequence's last) cost neither MXU work (``pl.when``) nor HBM
+copies (the index map clamps to the last real page, and Mosaic elides
+the copy of a revisited block).
+
+Off-TPU the kernel runs in interpret mode (tier-1's CPU mesh). Because
+interpret mode unrolls the grid at trace time — expensive for the large
+(B·H·pages) decode grids the serve bench runs — :func:`paged_attention`
+also carries a pure-XLA lowering of the same computation
+(``impl="xla"``, a gather + masked softmax); ``impl=None`` picks Pallas
+on TPU and XLA elsewhere, the 2304.12576 one-kernel-many-lowerings
+argument applied to decode. Parity tests pin all three paths
+(pallas-interpret, xla, dense reference) against each other.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tosem_tpu.ops.common import interpret_default as _interpret
+
+_NEG_INF = -1e30
+_LANES = 128
+_SUBLANES = 8
+
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or \
+    pltpu.TPUCompilerParams
+# (B, H) cells are independent; the page sweep carries the online-softmax
+# scratch between cells and must run in order
+_PAGED = _CompilerParams(
+    dimension_semantics=("parallel", "parallel", "arbitrary"))
+
+
+def _decode_kernel(bt_ref, sl_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_sc, l_sc, acc_sc, *, sm_scale, page_size, n_pages):
+    del bt_ref                      # consumed by the index maps
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    sl = sl_ref[b]
+    # last page holding real tokens; clamped so sl == 0 degenerates to
+    # page 0 (whose compute is masked off entirely below)
+    j_last = jnp.maximum(lax.div(sl + page_size - 1, page_size) - 1, 0)
+
+    @pl.when(j == 0)
+    def _init():
+        m_sc[...] = jnp.full(m_sc.shape, _NEG_INF, jnp.float32)
+        l_sc[...] = jnp.zeros(l_sc.shape, jnp.float32)
+        acc_sc[...] = jnp.zeros(acc_sc.shape, jnp.float32)
+
+    @pl.when(jnp.logical_and(j <= j_last, sl > 0))
+    def _step():
+        q = q_ref[...]                                # (SUB, D), native
+        k = k_ref[...]                                # (page, D)
+        v = v_ref[...]
+        cdt = q.dtype
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * sm_scale
+        pos = j * page_size + lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)                    # (SUB, page)
+        s = jnp.where(pos < sl, s, _NEG_INF)
+        m_prev = jnp.max(m_sc[...], axis=-1, keepdims=True)
+        l_prev = jnp.max(l_sc[...], axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, jnp.max(s, -1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, -1, keepdims=True)
+        acc_sc[...] = acc_sc[...] * alpha + lax.dot_general(
+            p.astype(cdt), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_sc[...] = jnp.broadcast_to(m_new, m_sc.shape)
+        l_sc[...] = jnp.broadcast_to(l_new, l_sc.shape)
+
+    @pl.when(j == jnp.minimum(j_last, n_pages - 1))
+    def _epilogue():
+        l = jnp.max(l_sc[...], axis=-1, keepdims=True)
+        l_safe = jnp.where(l == 0.0, 1.0, l)          # sl == 0 rows
+        o_ref[...] = (acc_sc[...] / l_safe).astype(o_ref.dtype)
+
+
+def _paged_attention_pallas(q, k_pages, v_pages, block_tables, seq_lens,
+                            sm_scale):
+    B, H, D = q.shape
+    P, page_size, Hk, Dk = k_pages.shape
+    n_pages = block_tables.shape[1]
+    qb = jnp.broadcast_to(q[:, :, None, :], (B, H, _SUBLANES, D))
+    bt = block_tables.astype(jnp.int32)
+    sl = seq_lens.astype(jnp.int32)
+
+    def kv_idx(b, h, j, bt_ref, sl_ref):
+        # clamp skipped pages (past the sequence's last) to the last real
+        # one: the revisited block index suppresses their HBM copy
+        last = jnp.maximum(
+            lax.div(sl_ref[b] + page_size - 1, page_size) - 1, 0)
+        return (bt_ref[b, jnp.minimum(j, last)], 0, h, 0)
+
+    def q_idx(b, h, j, bt_ref, sl_ref):
+        return (b, h, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, H, n_pages),
+        in_specs=[
+            pl.BlockSpec((None, None, _SUBLANES, D), q_idx),
+            pl.BlockSpec((None, page_size, None, D), kv_idx),
+            pl.BlockSpec((None, page_size, None, D), kv_idx),
+        ],
+        out_specs=pl.BlockSpec((None, None, _SUBLANES, D), q_idx),
+        scratch_shapes=[pltpu.VMEM((_SUBLANES, _LANES), jnp.float32),
+                        pltpu.VMEM((_SUBLANES, _LANES), jnp.float32),
+                        pltpu.VMEM((_SUBLANES, D), jnp.float32)],
+    )
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, sm_scale=sm_scale,
+                          page_size=page_size, n_pages=n_pages),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, _SUBLANES, D), q.dtype),
+        compiler_params=_PAGED,
+        interpret=_interpret(),
+    )(bt, sl, qb, k_pages, v_pages)
+    return out[:, :, 0, :]
+
+
+def _paged_attention_xla(q, k_pages, v_pages, block_tables, seq_lens,
+                         sm_scale):
+    """Pure-XLA lowering of the identical computation: gather the pages
+    into per-sequence [T, H, D] views, masked softmax over real
+    positions. The CPU-fast path AND the dense parity reference — one
+    definition, so the reference can never drift from what the serve
+    path actually runs off-chip."""
+    B, H, D = q.shape
+    page_size = k_pages.shape[1]
+    T = block_tables.shape[1] * page_size
+    # [B, max_pages, page, H, D] → [B, T, H, D]
+    k = k_pages[block_tables].reshape(B, T, -1, k_pages.shape[-1])
+    v = v_pages[block_tables].reshape(B, T, -1, v_pages.shape[-1])
+    s = jnp.einsum("bhd,bthd->bht", q, k,
+                   preferred_element_type=jnp.float32) * sm_scale
+    pos = jnp.arange(T, dtype=jnp.int32)[None, None, :]
+    valid = pos < seq_lens.astype(jnp.int32)[:, None, None]
+    s = jnp.where(valid, s, _NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    p = jnp.where(valid, p, 0.0)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    l = jnp.where(l == 0.0, 1.0, l)                  # sl == 0 rows
+    p = (p / l).astype(v.dtype)
+    out = jnp.einsum("bht,bthd->bhd", p, v,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
+def paged_attention(q, k_pages, v_pages, block_tables, seq_lens, *,
+                    sm_scale: Optional[float] = None,
+                    impl: Optional[str] = None):
+    """Decode attention over a paged KV cache.
+
+    ``q``: [B, H, D] (one token per sequence); ``k_pages``/``v_pages``:
+    [P, page_size, H, D] pools; ``block_tables``: [B, max_pages] int32;
+    ``seq_lens``: [B] int32 (0 = inactive row → zero output). ``impl``:
+    ``"pallas"`` (TPU kernel; interpret mode off-chip), ``"xla"`` (the
+    gather lowering), or None to pick pallas on TPU and xla elsewhere.
+    """
+    B, H, D = q.shape
+    if k_pages.shape != v_pages.shape:
+        raise ValueError(f"k_pages {k_pages.shape} != v_pages "
+                         f"{v_pages.shape}")
+    if k_pages.shape[2] != H or k_pages.shape[3] != D:
+        raise ValueError(f"pool heads/dim {k_pages.shape[2:]} do not "
+                         f"match q {(H, D)}")
+    if block_tables.ndim != 2 or block_tables.shape[0] != B:
+        raise ValueError(f"block_tables must be [B={B}, max_pages], got "
+                         f"{block_tables.shape}")
+    scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(D)
+    if impl is None:
+        impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+    if impl == "pallas":
+        return _paged_attention_pallas(q, k_pages, v_pages, block_tables,
+                                       seq_lens, scale)
+    if impl == "xla":
+        return _paged_attention_xla(q, k_pages, v_pages, block_tables,
+                                    seq_lens, scale)
+    raise ValueError(f"unknown impl {impl!r}; expected pallas|xla")
+
+
+def paged_attention_reference(q, k_pages, v_pages, block_tables,
+                              seq_lens, *, sm_scale=None):
+    """Dense reference for parity tests (the XLA lowering by
+    construction — see :func:`_paged_attention_xla`)."""
+    D = q.shape[-1]
+    scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(D)
+    return _paged_attention_xla(q, k_pages, v_pages, block_tables,
+                                seq_lens, scale)

@@ -333,6 +333,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="run the serving data-plane benches "
                         "(serve/bench_serve.py) instead of the runtime "
                         "ones — the micro-batching fast path")
+    p.add_argument("--decode", action="store_true",
+                   help="run the autoregressive-decode benches "
+                        "(serve/bench_decode.py) instead — continuous "
+                        "batching vs the re-encode baseline")
     p.add_argument("--only", default=None,
                    help="comma-separated bench_id subset, or 'gated' for "
                         "exactly the perf_smoke-gated benches")
@@ -342,6 +346,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.serve:
         from tosem_tpu.serve.bench_serve import GATED_SERVE_BENCHES
         gated = GATED_SERVE_BENCHES
+    elif args.decode:
+        from tosem_tpu.serve.bench_decode import GATED_DECODE_BENCHES
+        gated = GATED_DECODE_BENCHES
     else:
         gated = GATED_BENCHES
     only = None
@@ -352,6 +359,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         from tosem_tpu.serve.bench_serve import run_serve_benchmarks
         rows = run_serve_benchmarks(trials=args.trials, min_s=args.min_s,
                                     quiet=args.quiet, only=only)
+    elif args.decode:
+        from tosem_tpu.serve.bench_decode import run_decode_benchmarks
+        rows = run_decode_benchmarks(trials=args.trials, min_s=args.min_s,
+                                     quiet=args.quiet, only=only)
     else:
         rows = run_microbenchmarks(num_workers=args.workers,
                                    trials=args.trials,
@@ -362,11 +373,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                                                  min_s=args.min_s,
                                                  quiet=args.quiet)
     if args.save:
-        if args.serve:
+        if args.serve or args.decode:
             # bench-noise protocol for the bimodal shared hosts: the
-            # recorded serve floors are the MIN across interleaved
-            # rounds, not the mean — a gate floor set off a fast-phase
-            # mean fails spuriously in the slow phase
+            # recorded serve/decode floors are the MIN across
+            # interleaved rounds, not the mean — a gate floor set off a
+            # fast-phase mean fails spuriously in the slow phase
             for r in rows:
                 r.value = float(r.extra.get("min", r.value))
         save_baseline(rows, args.save, num_workers=args.workers)

@@ -19,6 +19,7 @@ per bucket and make results independent of batching decisions.
 from __future__ import annotations
 
 import dataclasses
+import threading
 from typing import Any, Dict, List, Optional, Sequence
 
 from tosem_tpu.serve.compile_cache import (DEFAULT_COMPILE_CACHE,
@@ -171,4 +172,357 @@ class BertEncodeBackend(CompiledBackendMixin):
         from tosem_tpu.nn.attention import FLASH_DISPATCH_COUNTS
         out = super().stats()
         out["flash_dispatch"] = dict(FLASH_DISPATCH_COUNTS)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# generative decode
+
+
+class _DecodeSeq:
+    """Replica-side record of one decoding sequence. ``tokens`` is
+    prompt + everything sampled so far; the KV cache always holds
+    ``len(tokens) - 1`` positions (the newest token's K/V is written
+    when it is FED, on the next step). ``outcomes[k]`` memoizes step
+    ``k``'s result — the idempotency ledger: a replayed (seq, step)
+    returns its recorded outcome without touching the cache, so the
+    PR-2 at-least-once actor replay can never double-apply a step."""
+
+    __slots__ = ("tokens", "prompt_len", "next_step", "done", "outcomes")
+
+    def __init__(self, tokens: List[int], prompt_len: int):
+        self.tokens = tokens
+        self.prompt_len = prompt_len
+        self.next_step = 0
+        self.done = False
+        self.outcomes: List[Dict[str, Any]] = []
+
+
+class BertDecodeBackend(CompiledBackendMixin):
+    """Autoregressive greedy decode over the paged KV cache.
+
+    Requests are ``{"ids": [int, …]}`` prompts; responses carry the
+    generated continuation. Prefill runs the causal flash path
+    (:meth:`~tosem_tpu.models.bert.Bert.prefill_fn`) over the prompt
+    padded to a page multiple and scatters per-layer K/V into the
+    sequence's pages; every subsequent token runs ONE compiled decode
+    step (:meth:`~tosem_tpu.models.bert.Bert.decode_step_fn`) for the
+    whole packed batch — static ``(max_batch, max_pages)`` shapes, so
+    the compile cache holds exactly one step program per (page config,
+    max-batch) and warm steps never recompile.
+
+    Implements the decode-client protocol the
+    :class:`~tosem_tpu.serve.batching.DecodeQueue` drives: ``admit`` /
+    ``step_batch`` / ``result`` / ``release`` / ``spill_seq`` /
+    ``restore_seq`` / ``cache_stats``. All methods are idempotent per
+    (sequence id, step index) — see :class:`_DecodeSeq`.
+    """
+
+    def __init__(self, preset: str = "tiny", seed: int = 0,
+                 max_batch: int = 8, max_len: int = 128,
+                 page_size: Optional[int] = None, num_pages: int = 64,
+                 max_new_tokens: int = 16, eos_id: Optional[int] = None,
+                 impl: Optional[str] = None):
+        import jax
+        from tosem_tpu.models.bert import Bert, BertConfig
+        from tosem_tpu.ops.flash_blocks import select_page_size
+        if preset == "base":
+            cfg = BertConfig.base()
+        else:
+            cfg = BertConfig(vocab_size=128, max_len=max_len, dim=32,
+                             heads=2, layers=2, mlp_dim=64, dropout=0.0)
+        self.cfg = cfg
+        self.max_batch = max_batch
+        self.max_new_tokens = max_new_tokens
+        self.eos_id = eos_id
+        self.impl = impl
+        head_dim = cfg.dim // cfg.heads
+        self.page_size = page_size or select_page_size(
+            head_dim, cfg.dtype, max_len=cfg.max_len)
+        self.max_pages = -(-cfg.max_len // self.page_size)
+        self.model = Bert(cfg)
+        self._vs = self.model.init(jax.random.PRNGKey(seed))
+        self._prefill = self.model.prefill_fn(self._vs)
+        self._step = self.model.decode_step_fn(
+            self._vs, page_size=self.page_size, impl=impl)
+        from tosem_tpu.serve.kv_cache import PagedKVCache
+        self.cache = PagedKVCache(num_pages, self.page_size,
+                                  layers=cfg.layers, heads=cfg.heads,
+                                  head_dim=head_dim, dtype=cfg.dtype)
+        self._seqs: Dict[Any, _DecodeSeq] = {}
+        self._lock = threading.RLock()
+        self._tag = model_tag("bert_decode", cfg, seed,
+                              page=self.page_size, pages=num_pages,
+                              impl=impl or "auto")
+
+    # --------------------------------------------------------- compiled fns
+
+    def _prefill_compiled(self, pad_to: int):
+        """Fused prefill + page scatter, ONE compiled program per
+        bucket: running the causal forward and then scattering K/V into
+        the pools as separate eager dispatches costs more than the
+        whole decode step on slow hosts — admission must be as cheap as
+        a step. Pad slots carry an out-of-bounds page id, so the
+        scatter drops them (jax OOB semantics) and pad K/V never lands
+        in a page."""
+        import numpy as np
+        key = shape_key(self._tag + ";prefill", (1, pad_to),
+                        self.cfg.dtype)
+        pool = self.cache.k_pool
+
+        def fused(ids, mask, k_pool, v_pool, pages, rows):
+            logits, k, v = self._prefill(ids, mask)
+            k_pool = k_pool.at[:, pages, rows].set(
+                k[:, 0].astype(k_pool.dtype))
+            v_pool = v_pool.at[:, pages, rows].set(
+                v[:, 0].astype(v_pool.dtype))
+            return logits, k_pool, v_pool
+
+        return DEFAULT_COMPILE_CACHE.get_or_build(
+            key, lambda: aot_compile(
+                fused, [((1, pad_to), np.int32), ((1, pad_to), np.int32),
+                        (tuple(pool.shape), pool.dtype),
+                        (tuple(pool.shape), pool.dtype),
+                        ((pad_to,), np.int32), ((pad_to,), np.int32)]))
+
+    def _step_compiled(self):
+        import numpy as np
+        B = self.max_batch
+        pool = self.cache.k_pool
+        key = shape_key(self._tag + ";step",
+                        (B, self.max_pages, self.page_size),
+                        self.cfg.dtype)
+        return DEFAULT_COMPILE_CACHE.get_or_build(
+            key, lambda: aot_compile(
+                self._step,
+                [((B,), np.int32), ((B,), np.int32),
+                 (tuple(pool.shape), pool.dtype),
+                 (tuple(pool.shape), pool.dtype),
+                 ((B, self.max_pages), np.int32), ((B,), np.int32)]))
+
+    def warmup(self, shapes: Sequence[int]) -> Dict[str, Any]:
+        """``shapes`` is the prompt-bucket palette (page multiples);
+        the decode step program is always warmed too."""
+        for pad_to in shapes:
+            self._prefill_compiled(int(pad_to))
+        self._step_compiled()
+        return {"warmed": len(list(shapes)) + 1,
+                "cache": DEFAULT_COMPILE_CACHE.stats()}
+
+    # ------------------------------------------------------- decode client
+
+    def _prefill_into_cache(self, seq_id, toks: List[int]):
+        """Run the fused causal-prefill + page-scatter program over
+        ``toks`` (pages must already be allocated). Returns the logits
+        row of the LAST real token (fp32 np)."""
+        import numpy as np
+        T = len(toks)
+        bucket = -(-T // self.page_size) * self.page_size
+        ids = np.zeros((1, bucket), np.int32)
+        mask = np.zeros((1, bucket), np.int32)
+        ids[0, :T] = toks
+        mask[0, :T] = 1
+        pages = np.asarray(self.cache.pages_of(seq_id), np.int64)
+        pos = np.arange(T)
+        # pad positions route to page id == num_pages: out of bounds,
+        # dropped by the in-program scatter
+        pages_t = np.full((bucket,), self.cache.num_pages, np.int32)
+        pages_t[:T] = pages[pos // self.page_size]
+        rows_t = (np.arange(bucket) % self.page_size).astype(np.int32)
+        logits, k_pool, v_pool = self._prefill_compiled(bucket)(
+            ids, mask, self.cache.k_pool, self.cache.v_pool,
+            pages_t, rows_t)
+        self.cache.set_pools(k_pool, v_pool)
+        return np.asarray(logits, np.float32)[0, T - 1]
+
+    def _finished(self, seq: _DecodeSeq, token: int) -> bool:
+        gen = len(seq.tokens) - seq.prompt_len
+        return (token == self.eos_id if self.eos_id is not None
+                else False) or gen >= self.max_new_tokens \
+            or len(seq.tokens) >= self.cfg.max_len
+
+    def admit(self, seq_id, request: Dict[str, Any]) -> Dict[str, Any]:
+        """Validate, allocate pages, prefill, sample the first token.
+        Raises :class:`~tosem_tpu.serve.kv_cache.CachePressure` (pool
+        full — nothing allocated) or ``ValueError`` (poison request —
+        fails only this sequence). Idempotent: re-admitting a known
+        sequence returns its recorded outcome."""
+        import numpy as np
+        with self._lock:
+            if seq_id in self._seqs:          # at-least-once replay
+                seq = self._seqs[seq_id]
+                return {"token": seq.tokens[seq.prompt_len],
+                        "done": seq.done and seq.next_step == 0}
+            ids = list(request["ids"])
+            if not ids:
+                raise ValueError("empty ids sequence")
+            if min(ids) < 0 or max(ids) >= self.cfg.vocab_size:
+                raise ValueError(
+                    f"token id out of range [0, {self.cfg.vocab_size})")
+            if len(ids) >= self.cfg.max_len:
+                raise ValueError(
+                    f"prompt length {len(ids)} >= max_len "
+                    f"{self.cfg.max_len}")
+            self.cache.create(seq_id)
+            try:
+                self.cache.extend(seq_id, len(ids))
+            except BaseException:
+                self.cache.free(seq_id)
+                raise
+            try:
+                last = self._prefill_into_cache(seq_id, ids)
+            except BaseException:
+                self.cache.free(seq_id)
+                raise
+            token = int(np.argmax(last))
+            seq = _DecodeSeq(tokens=ids + [token],
+                             prompt_len=len(ids))
+            seq.done = self._finished(seq, token)
+            self._seqs[seq_id] = seq
+            out = {"token": token, "done": seq.done}
+            if seq.done:
+                # final payload rides the outcome: retiring a sequence
+                # costs the scheduler zero extra round trips
+                out["result"] = self._result_locked(seq)
+            return out
+
+    def step_batch(self, seq_ids: List[Any],
+                   step_idxs: List[int]) -> List[Dict[str, Any]]:
+        """One decode iteration for the packed batch. Per-sequence
+        outcomes: ``{"token", "done"}``, ``{"pressure": True}`` (no
+        pages — nothing applied for that row), or the memoized outcome
+        for an already-applied (seq, step). The program call itself is
+        one executable for ANY packing (inactive rows ride along with
+        seq_len 0), so results never depend on batch composition."""
+        import numpy as np
+
+        from tosem_tpu.serve.kv_cache import CachePressure
+        if len(seq_ids) > self.max_batch:
+            raise ValueError(f"batch of {len(seq_ids)} exceeds "
+                             f"max_batch={self.max_batch}")
+        with self._lock:
+            B = self.max_batch
+            ids_t = np.zeros((B,), np.int32)
+            positions = np.zeros((B,), np.int32)
+            tables = np.zeros((B, self.max_pages), np.int32)
+            lens = np.zeros((B,), np.int32)
+            outcomes: List[Optional[Dict[str, Any]]] = []
+            live: List[tuple] = []          # (row, seq_id, seq)
+            for row, (sid, step) in enumerate(zip(seq_ids, step_idxs)):
+                seq = self._seqs[sid]
+                if step < seq.next_step:    # replayed step: memo only
+                    outcomes.append(seq.outcomes[step])
+                    continue
+                if step > seq.next_step:
+                    raise RuntimeError(
+                        f"step {step} for {sid!r} skips ahead of "
+                        f"{seq.next_step} (scheduler bug)")
+                if seq.done:
+                    outcomes.append({"token": seq.tokens[-1],
+                                     "done": True})
+                    continue
+                try:
+                    start, new_len = self.cache.extend(sid, 1)
+                except CachePressure:
+                    outcomes.append({"pressure": True})
+                    continue
+                ids_t[row] = seq.tokens[start]
+                positions[row] = start
+                tables[row] = self.cache.block_table(sid, self.max_pages)
+                lens[row] = new_len
+                outcomes.append(None)
+                live.append((row, sid, seq))
+            if live:
+                logits, k_pool, v_pool = self._step_compiled()(
+                    ids_t, positions, self.cache.k_pool,
+                    self.cache.v_pool, tables, lens)
+                self.cache.set_pools(k_pool, v_pool)
+                logits = np.asarray(logits, np.float32)
+                for row, sid, seq in live:
+                    token = int(np.argmax(logits[row]))
+                    seq.tokens.append(token)
+                    out = {"token": token,
+                           "done": self._finished(seq, token)}
+                    seq.done = out["done"]
+                    if seq.done:
+                        out["result"] = self._result_locked(seq)
+                    seq.outcomes.append(out)
+                    seq.next_step += 1
+                    outcomes[row] = out
+            # every row appended exactly one entry (memo / done /
+            # pressure / live), so outcomes is positionally aligned
+            # with seq_ids — the caller zips them
+            return outcomes
+
+    @staticmethod
+    def _result_locked(seq: _DecodeSeq) -> Dict[str, Any]:
+        return {"tokens": list(seq.tokens),
+                "generated": list(seq.tokens[seq.prompt_len:]),
+                "prompt_len": seq.prompt_len}
+
+    def result(self, seq_id) -> Dict[str, Any]:
+        with self._lock:
+            return self._result_locked(self._seqs[seq_id])
+
+    def release(self, seq_id) -> None:
+        with self._lock:
+            if seq_id in self._seqs:
+                if self.cache.is_spilled(seq_id):
+                    self.cache.drop_spilled(seq_id)
+                else:
+                    try:
+                        self.cache.free(seq_id)
+                    except KeyError:
+                        pass
+                del self._seqs[seq_id]
+
+    def spill_seq(self, seq_id) -> None:
+        with self._lock:
+            if not self.cache.is_spilled(seq_id):
+                self.cache.spill(seq_id)
+
+    def restore_seq(self, seq_id) -> None:
+        """Bring a spilled sequence back. Byte-identical restore when
+        the payload survived; a LOST payload (chaos eviction) falls
+        back to re-prefilling the cache from the sequence's token
+        history — same values by determinism, so decode continues
+        bit-consistently either way. Raises
+        :class:`~tosem_tpu.serve.kv_cache.CachePressure` when the pool
+        has no room (nothing changed)."""
+        from tosem_tpu.serve.kv_cache import CachePressure, PagesLostError
+        with self._lock:
+            if not self.cache.is_spilled(seq_id):
+                return
+            try:
+                self.cache.restore(seq_id)
+            except PagesLostError:
+                seq = self._seqs[seq_id]
+                cached = seq.tokens[:-1]    # cache holds len(tokens)-1
+                # capacity check BEFORE dropping the spilled entry: the
+                # CachePressure contract is 'nothing changed', and a
+                # half-torn fallback (dropped but not re-prefilled)
+                # would make the next restore a silent no-op and the
+                # next step a KeyError for the whole packed batch
+                need = -(-len(cached) // self.page_size)
+                if need > self.cache.stats()["pages_free"]:
+                    raise CachePressure(
+                        f"re-prefill of {seq_id!r} needs {need} pages; "
+                        "parked until something retires")
+                self.cache.drop_spilled(seq_id)
+                self.cache.create(seq_id)
+                try:
+                    self.cache.extend(seq_id, len(cached))
+                    self._prefill_into_cache(seq_id, cached)
+                except BaseException:
+                    self.cache.free(seq_id)
+                    raise
+
+    def cache_stats(self) -> Dict[str, int]:
+        return self.cache.stats()
+
+    def stats(self) -> Dict[str, Any]:
+        out = super().stats()
+        out.update(self.cache.stats())
+        with self._lock:
+            out["decode_sequences"] = len(self._seqs)
         return out

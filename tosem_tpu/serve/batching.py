@@ -40,6 +40,7 @@ import traceback
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
+from tosem_tpu.chaos import hooks as _chaos
 from tosem_tpu.data.feeding import pad_target
 from tosem_tpu.obs.metrics import serve_metrics
 from tosem_tpu.runtime.common import TaskError
@@ -558,3 +559,609 @@ class BatchQueue:
                 it.future._set_exception(TaskError(cause, tb))
                 err += 1
         self._count(ok=ok, err=err)
+
+
+# ---------------------------------------------------------------------------
+# iteration-level decode scheduling (continuous batching)
+
+
+@dataclass
+class DecodePolicy:
+    """Knobs for a deployment's continuous-batching decode queue.
+
+    ``max_active`` bounds the sequences packed into one replica's decode
+    step — it must not exceed the backend's ``max_batch`` (the static
+    batch dimension of the compiled step program). ``idle_wait_s`` is
+    the scheduler's sleep when admission is blocked but work remains
+    (page pressure with nothing retiring yet)."""
+    max_active: int = 8
+    idle_wait_s: float = 0.01
+
+    def __post_init__(self):
+        if self.max_active < 1:
+            raise ValueError("max_active must be >= 1")
+        if self.idle_wait_s < 0:
+            raise ValueError("idle_wait_s must be >= 0")
+
+
+@dataclass
+class _DecodeItem:
+    request: Any
+    future: BatchedFuture
+    probe: bool
+    seq_id: str
+    step: int = 0                    # next decode-step index
+    replica: Any = None              # pinned actor handle (cache lives there)
+    attempts: int = 0                # transport-failure re-admissions spent
+    stalls: int = 0                  # consecutive page-pressured steps
+    enqueued_at: float = field(default_factory=time.monotonic)
+
+
+class DecodeQueue:
+    """Iteration-level scheduler for autoregressive decode (the
+    Orca/vLLM continuous-batching discipline on the Serve-lite data
+    plane).
+
+    Where :class:`BatchQueue` batches whole REQUESTS, this queue
+    schedules per decode STEP: every iteration it admits new sequences
+    into free batch slots, packs all active sequences into one
+    ``step_batch`` call per replica (one compiled program regardless of
+    packing — retired rows ride along inactive, so there are no per-step
+    recompiles), retires finished sequences immediately (their slot and
+    KV pages free THIS step, not when the batch drains), and under page
+    pressure spills the pressured sequence's KV pages to the object
+    store and requeues it instead of OOMing.
+
+    Contracts carried over from the micro-batch plane:
+
+    - **Per-request error isolation** — a poison prompt fails only its
+      own future (``admit`` validates replica-side); a transport failure
+      re-admits only the dead replica's sequences.
+    - **Logical accounting** — the breaker sees one verdict per
+      SEQUENCE (a replica death with 6 active sequences is 6 trips of
+      evidence); :meth:`depth` counts queued + active + spilled
+      sequences, so the autoscaler sees demand, not dispatches.
+    - **Determinism** — greedy decode is deterministic and spill/
+      restore is byte-preserving, so outputs never depend on scheduling
+      decisions, evictions, or replica deaths (recovery re-prefills
+      from token history and replays the identical token path).
+
+    Chaos site ``serve.decode_step`` fires once per scheduler iteration
+    (actions: ``evict_pages`` spills the coldest active sequence,
+    ``slow_step`` delays the loop); each per-replica step dispatch also
+    fires the ``serve.dispatch`` site, so canned plans can kill a
+    replica mid-decode.
+    """
+
+    def __init__(self, deployment, policy: DecodePolicy):
+        self._dep = deployment
+        self.policy = policy
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._pending: collections.deque = collections.deque()
+        self._active: List[_DecodeItem] = []      # admit order
+        self._waiting: List[_DecodeItem] = []     # spilled, awaiting restore
+        self._closed = False
+        self._close_error: Optional[BaseException] = None
+        self._seq_counter = 0
+        self._steps = 0
+        self._tokens = 0
+        self._loop_errors = 0
+        self._seqs_ok = 0
+        self._seqs_err = 0
+        self._spills = 0
+        self._restores = 0
+        self._cache_stats: Dict[str, Any] = {}
+        self._can_spill = hasattr(deployment.backend_cls, "spill_seq")
+        self._metrics = serve_metrics()
+        self._last_scrape = 0.0
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"serve-decode-{deployment.name}")
+        self._thread.start()
+
+    # ----------------------------------------------------------- client side
+
+    def submit(self, request: Any, probe: bool = False,
+               sync: bool = False,
+               timeout: Optional[float] = None) -> BatchedFuture:
+        """Queue one sequence for decode. ``sync``/``timeout`` exist for
+        Handle-surface compatibility; a decode request spans many
+        scheduler iterations, so there is no inline fast path — the
+        caller bounds its wait via ``result(timeout)``."""
+        del sync, timeout
+        with self._cv:
+            if self._closed:
+                raise self._close_error or RuntimeError(
+                    f"deployment {self._dep.name!r} decode queue closed")
+            self._seq_counter += 1
+            item = _DecodeItem(
+                request=request, future=BatchedFuture(), probe=probe,
+                seq_id=f"{self._dep.name}/{self._seq_counter}")
+            self._pending.append(item)
+            self._cv.notify_all()
+        return item.future
+
+    def depth(self) -> int:
+        """Demand signal: queued + active + spilled sequences (every
+        sequence the data plane still owes a completion)."""
+        with self._lock:
+            return (len(self._pending) + len(self._active)
+                    + len(self._waiting))
+
+    def replica_loads(self) -> Dict[int, int]:
+        """Per-replica sequence counts keyed ``id(replica)`` — the
+        decode plane's own in-flight accounting (steps never pass
+        through ``Deployment._dispatch``, so ``_outstanding`` can't see
+        them). ``Deployment.scale`` uses this to retire the
+        least-loaded replica instead of one packing live sequences."""
+        with self._lock:
+            counts: Dict[int, int] = {}
+            for it in self._active + self._waiting:
+                counts[id(it.replica)] = counts.get(id(it.replica), 0) + 1
+            return counts
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            out = {
+                "queued": len(self._pending),
+                "active_sequences": len(self._active),
+                "spilled_sequences": len(self._waiting),
+                "decode_steps": self._steps,
+                "tokens_emitted": self._tokens,
+                "sequences_ok": self._seqs_ok,
+                "sequences_err": self._seqs_err,
+                "kv_spills": self._spills,
+                "kv_restores": self._restores,
+                "scheduler_loop_errors": self._loop_errors,
+            }
+            out.update({f"kv_{k}": v
+                        for k, v in sorted(self._cache_stats.items())})
+            return out
+
+    def close(self, error: Optional[BaseException] = None) -> None:
+        with self._cv:
+            self._closed = True
+            self._close_error = error
+            doomed = (list(self._pending) + list(self._active)
+                      + list(self._waiting))
+            self._pending.clear()
+            self._active = []
+            self._waiting = []
+            self._cv.notify_all()
+        from tosem_tpu.runtime.common import ActorDiedError
+        exc = error or ActorDiedError(
+            f"deployment {self._dep.name!r} deleted with sequences "
+            "in flight")
+        for it in doomed:
+            self._release_probe(it)
+            it.future._set_exception(exc)
+        self._thread.join(timeout=2.0)
+
+    # -------------------------------------------------------- scheduler side
+
+    def _release_probe(self, item: _DecodeItem) -> None:
+        if item.probe:
+            breaker = self._dep.breaker
+            if breaker is not None:
+                breaker.release_probe()
+            item.probe = False
+
+    def _release_replica_state(self, item: _DecodeItem) -> None:
+        """Best-effort fire-and-forget release of an ADMITTED sequence's
+        replica-side state (KV pages, ledger). Every post-admission
+        failure path must call this or the failed sequence's pages leak
+        out of the pool forever (backend ``release`` is idempotent)."""
+        if item.replica is None:
+            return
+        try:
+            item.replica.release.remote(item.seq_id)
+        except BaseException:
+            pass                  # dead replica: its pool died with it
+
+    def _succeed(self, item: _DecodeItem, value: Any) -> None:
+        breaker = self._dep.breaker
+        if breaker is not None:
+            breaker.record_success(probe=item.probe)
+        item.probe = False
+        item.future._set_result(value)
+        with self._lock:
+            self._seqs_ok += 1
+        self._metrics["requests"].inc(1, (self._dep.name, "ok"))
+
+    def _fail(self, item: _DecodeItem, exc: BaseException,
+              verdict: bool = True) -> None:
+        breaker = self._dep.breaker
+        if breaker is not None:
+            if verdict:
+                breaker.record_failure(probe=item.probe)
+                item.probe = False
+            else:
+                self._release_probe(item)
+        item.probe = False
+        item.future._set_exception(exc)
+        with self._lock:
+            self._seqs_err += 1
+        self._metrics["requests"].inc(1, (self._dep.name, "error"))
+
+    def _replicas(self) -> List[Any]:
+        with self._dep._lock:
+            return list(self._dep._replicas)
+
+    def _replica_index(self, replica) -> int:
+        with self._dep._lock:
+            for i, r in enumerate(self._dep._replicas):
+                if r is replica:
+                    return i
+        return 0
+
+    def _pick_replica(self) -> Optional[Any]:
+        """Least-loaded replica with free decode slots, by THIS queue's
+        own sequence counts (active + spilled both hold replica-side
+        state). Deterministic: ties break by replica index."""
+        replicas = self._replicas()
+        if not replicas:
+            from tosem_tpu.runtime.common import ActorDiedError
+            raise ActorDiedError(
+                f"deployment {self._dep.name!r} has no replicas "
+                "(deleted?)")
+        counts = self.replica_loads()
+        best = min(range(len(replicas)),
+                   key=lambda j: (counts.get(id(replicas[j]), 0), j))
+        if counts.get(id(replicas[best]), 0) >= self.policy.max_active:
+            return None
+        return replicas[best]
+
+    def _requeue_for_readmission(self, items: List[_DecodeItem],
+                                 cause: BaseException) -> None:
+        """Replica-death recovery: reset each surviving sequence to step
+        0 and put it at the FRONT of the pending queue — re-admission
+        re-prefills from the prompt and greedy decode replays the
+        identical token path, so the client sees the same output it
+        would have seen without the death. Sequences out of retry
+        budget fail instead."""
+        for it in items:
+            # if the actor restarts (max_restarts) with replayed state,
+            # the dead incarnation's pages would otherwise be
+            # resurrected and leak; release is idempotent and a no-op
+            # on a fresh restart, and actor FIFO orders it before any
+            # re-admission to the same replica
+            self._release_replica_state(it)
+            it.attempts += 1
+            if it.attempts > self._dep.max_retries:
+                self._fail(it, cause, verdict=False)
+                continue
+            it.step = 0
+            it.replica = None
+            with self._cv:
+                closed = self._closed
+                if not closed:
+                    self._pending.appendleft(it)
+            if closed:
+                self._fail(it, self._close_error or cause, verdict=False)
+
+    def _spill_item(self, item: _DecodeItem) -> bool:
+        """Move one active sequence's KV pages out of the pool (page
+        pressure or chaos eviction); the sequence parks in ``_waiting``
+        until pages free up."""
+        if not self._can_spill:
+            return False
+        import tosem_tpu.runtime as rt
+        try:
+            rt.get(item.replica.spill_seq.remote(item.seq_id),
+                   timeout=60.0)
+        except self._retryable() as e:
+            self._on_replica_death(item.replica, e)
+            return False
+        with self._lock:
+            if item in self._active:
+                self._active.remove(item)
+                self._waiting.append(item)
+                self._spills += 1
+        return True
+
+    def _retryable(self):
+        from tosem_tpu.serve.core import RETRYABLE
+        return RETRYABLE
+
+    def _on_replica_death(self, replica, cause: BaseException) -> None:
+        """Every sequence pinned to the dead replica loses its cache;
+        the breaker sees one trip per LOGICAL sequence."""
+        with self._lock:
+            affected = [it for it in self._active + self._waiting
+                        if it.replica is replica]
+            self._active = [it for it in self._active
+                            if it.replica is not replica]
+            self._waiting = [it for it in self._waiting
+                             if it.replica is not replica]
+        if not affected:
+            return
+        breaker = self._dep.breaker
+        if breaker is not None:
+            probe = False
+            for it in affected:
+                if it.probe:
+                    probe = True
+                    it.probe = False
+            breaker.record_failure(probe=probe, count=len(affected))
+        self._requeue_for_readmission(affected, cause)
+
+    def _fire_decode_chaos(self) -> None:
+        act = _chaos.fire("serve.decode_step", target=self._dep.name,
+                          step=self._steps)
+        if act is None:
+            return
+        if act["action"] == "evict_pages":
+            with self._lock:
+                victim = self._active[0] if self._active else None
+            if victim is not None:
+                self._spill_item(victim)
+        elif act["action"] == "slow_step":
+            time.sleep(act["delay_s"])
+
+    def _restore_waiting(self) -> None:
+        """Bring spilled sequences back before admitting new ones
+        (oldest spill first — FIFO fairness). CachePressure leaves a
+        sequence parked; the backend resolves a LOST payload internally
+        by re-prefilling from token history."""
+        import tosem_tpu.runtime as rt
+        from tosem_tpu.serve.kv_cache import CachePressure
+        with self._lock:
+            waiting = list(self._waiting)
+        for it in waiting:
+            try:
+                rt.get(it.replica.restore_seq.remote(it.seq_id),
+                       timeout=60.0)
+            except TaskError as e:
+                if isinstance(e.cause, CachePressure):
+                    continue              # stays parked; retried next tick
+                with self._lock:
+                    if it in self._waiting:
+                        self._waiting.remove(it)
+                self._release_replica_state(it)
+                self._fail(it, e)
+                continue
+            except self._retryable() as e:
+                self._on_replica_death(it.replica, e)
+                continue
+            with self._lock:
+                if it in self._waiting:
+                    self._waiting.remove(it)
+                    self._active.append(it)
+                    self._restores += 1
+
+    def _admit_pending(self) -> None:
+        """Fill free batch slots from the queue — the iteration-level
+        half of continuous batching: admission happens every step, not
+        when a batch drains."""
+        import tosem_tpu.runtime as rt
+        from tosem_tpu.serve.kv_cache import CachePressure
+        while True:
+            with self._cv:
+                if self._closed or not self._pending:
+                    return
+                item = self._pending[0]
+            try:
+                replica = self._pick_replica()
+            except Exception:
+                return                    # no replicas: close() will sweep
+            if replica is None:
+                return                    # all slots busy
+            with self._cv:
+                if self._closed or not self._pending \
+                        or self._pending[0] is not item:
+                    continue
+                self._pending.popleft()
+            item.replica = replica
+            try:
+                first = rt.get(
+                    replica.admit.remote(item.seq_id, item.request),
+                    timeout=120.0)
+            except TaskError as e:
+                if isinstance(e.cause, CachePressure):
+                    # pool full. With sequences still draining, requeue
+                    # and wait for their pages; with NOTHING active the
+                    # pool can never fit this prompt — fail it.
+                    with self._cv:
+                        busy = bool(self._active or self._waiting)
+                        closed = self._closed
+                        if busy and not closed:
+                            self._pending.appendleft(item)
+                    if busy and not closed:
+                        return
+                    self._fail(item, self._close_error or e)
+                    continue
+                # poison prompt (bad ids, overlong): fails alone
+                self._fail(item, e)
+                continue
+            except self._retryable() as e:
+                self._on_replica_death(replica, e)
+                self._requeue_for_readmission([item], e)
+                continue
+            except BaseException as e:
+                # no clear verdict (e.g. the wait timed out): the admit
+                # may still have landed replica-side — release it
+                self._release_replica_state(item)
+                self._fail(item, e, verdict=False)
+                continue
+            with self._lock:
+                self._active.append(item)
+            self._tokens += 1
+            if first.get("done"):
+                self._retire(item, result=first.get("result"))
+
+    def _retire(self, item: _DecodeItem,
+                result: Optional[Any] = None) -> None:
+        """``result`` is the final payload when the backend shipped it
+        inline with the done outcome (the fast path — no extra round
+        trip per retired sequence); otherwise it is fetched here."""
+        import tosem_tpu.runtime as rt
+        try:
+            if result is None:
+                result = rt.get(item.replica.result.remote(item.seq_id),
+                                timeout=60.0)
+            # release is fire-and-forget: nothing waits on page frees,
+            # the next step's extend sees them (actor FIFO ordering)
+            item.replica.release.remote(item.seq_id)
+        except self._retryable() as e:
+            self._on_replica_death(item.replica, e)
+            return
+        with self._lock:
+            if item in self._active:
+                self._active.remove(item)
+        self._succeed(item, result)
+
+    def _step_replicas(self) -> None:
+        """One decode iteration: one ``step_batch`` per replica holding
+        active sequences."""
+        import tosem_tpu.runtime as rt
+        with self._lock:
+            groups: Dict[int, List[_DecodeItem]] = {}
+            handles: Dict[int, Any] = {}
+            for it in self._active:
+                groups.setdefault(id(it.replica), []).append(it)
+                handles[id(it.replica)] = it.replica
+        for key in sorted(groups, key=lambda k: self._replica_index(
+                handles[k])):
+            items = groups[key]
+            replica = handles[key]
+            self._dep._fire_chaos(replica, self._replica_index(replica))
+            self._metrics["decode_occupancy"].observe(
+                len(items), (self._dep.name,))
+            try:
+                outcomes = rt.get(replica.step_batch.remote(
+                    [it.seq_id for it in items],
+                    [it.step for it in items]), timeout=120.0)
+            except self._retryable() as e:
+                self._on_replica_death(replica, e)
+                continue
+            except TaskError as e:
+                # whole-step application error (scheduler/backend bug):
+                # every packed sequence sees it — isolation held at
+                # admit-time validation, a step failure is systemic
+                with self._lock:
+                    for it in items:
+                        if it in self._active:
+                            self._active.remove(it)
+                for it in items:
+                    self._release_replica_state(it)
+                    self._fail(it, e)
+                continue
+            pressured: Optional[_DecodeItem] = None
+            for it, out in zip(items, outcomes):
+                # a mid-loop _retire can hit a dead replica and requeue
+                # this whole group at step 0 (_on_replica_death); items
+                # no longer active must not have their step advanced —
+                # a stale step would hit the backend's 'skips ahead'
+                # guard after re-admission and fail the batch
+                with self._lock:
+                    if it not in self._active:
+                        continue
+                if out.get("pressure"):
+                    if pressured is None:
+                        pressured = it
+                    continue
+                it.step += 1
+                it.stalls = 0
+                self._tokens += 1
+                if out.get("done"):
+                    self._retire(it, result=out.get("result"))
+            if pressured is not None:
+                # Page pressure is usually TRANSIENT: batchmates retire
+                # (their release is in flight on the actor's queue) or
+                # spilled peers rotate back in. So: spill the pressured
+                # sequence when that frees pages someone can use (other
+                # actives, or a waiting set to rotate through), retry
+                # quietly otherwise, and only a sequence that stays
+                # pressured across PRESSURE_STALL_LIMIT iterations
+                # without emitting a token — the pool genuinely cannot
+                # hold it plus anyone — fails.
+                pressured.stalls += 1
+                with self._lock:
+                    others = len([i for i in self._active
+                                  if i.replica is replica]) > 1
+                    rotating = bool(self._waiting)
+                if pressured.stalls > self.PRESSURE_STALL_LIMIT:
+                    from tosem_tpu.serve.kv_cache import CachePressure
+                    with self._lock:
+                        if pressured in self._active:
+                            self._active.remove(pressured)
+                    self._release_replica_state(pressured)
+                    self._fail(pressured, CachePressure(
+                        f"sequence {pressured.seq_id} cannot grow: KV "
+                        f"pool still exhausted after "
+                        f"{self.PRESSURE_STALL_LIMIT} eviction attempts"))
+                elif others or rotating:
+                    self._spill_item(pressured)
+        with self._lock:
+            self._steps += 1
+
+    # KV-page gauges need a replica round trip (cache_stats lives actor-
+    # side); scraping every decode step would cost as much as the step
+    # itself, so the remote half refreshes at most this often.
+    SCRAPE_INTERVAL_S = 0.25
+
+    # consecutive token-less pressured iterations before a sequence is
+    # declared unplaceable (pool can't hold it plus anyone else). Each
+    # iteration spans an actor round trip, so in-flight page releases
+    # have long since landed by the time this trips.
+    PRESSURE_STALL_LIMIT = 6
+
+    def _refresh_gauges(self) -> None:
+        name = self._dep.name
+        with self._lock:
+            self._metrics["decode_active"].set(len(self._active), (name,))
+            self._metrics["queue_depth"].set(len(self._pending), (name,))
+        now = time.monotonic()
+        if now - self._last_scrape < self.SCRAPE_INTERVAL_S:
+            return
+        self._last_scrape = now
+        import tosem_tpu.runtime as rt
+        replicas = self._replicas()
+        if not replicas or not hasattr(self._dep.backend_cls,
+                                       "cache_stats"):
+            return
+        try:
+            stats = rt.get(replicas[0].cache_stats.remote(), timeout=30.0)
+        except BaseException:
+            return
+        with self._lock:
+            self._cache_stats = dict(stats)
+        for state in ("used", "free", "spilled"):
+            v = stats.get(f"pages_{state}")
+            if v is not None:
+                self._metrics["kv_pages"].set(v, (name, state))
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                while not (self._pending or self._active
+                           or self._waiting) and not self._closed:
+                    self._cv.wait()
+                if self._closed:
+                    return
+                had_active = bool(self._active)
+            try:
+                self._fire_decode_chaos()
+                self._restore_waiting()
+                self._admit_pending()
+                with self._lock:
+                    stepping = bool(self._active)
+                if stepping:
+                    self._step_replicas()
+                self._refresh_gauges()
+            except BaseException:
+                # anything the per-call handlers didn't classify (e.g.
+                # a builtin TimeoutError from rt.get on a slow host):
+                # the scheduler thread must NEVER die — every pending
+                # future would hang forever. State is safe to retry:
+                # items keep their step, and the backends' (seq, step)
+                # ledger makes re-sending a step idempotent.
+                with self._lock:
+                    self._loop_errors += 1
+                time.sleep(max(self.policy.idle_wait_s, 0.05))
+                continue
+            if not had_active and not stepping:
+                # admission blocked (page pressure, no replicas): don't
+                # spin — pages free when something retires or restores
+                time.sleep(self.policy.idle_wait_s)

@@ -1,0 +1,251 @@
+"""Autoregressive-decode microbenchmarks (the decode leg of the serve
+suite).
+
+Closed-loop token throughput through the iteration-level scheduler
+(:class:`~tosem_tpu.serve.batching.DecodeQueue` over
+:class:`~tosem_tpu.serve.backends.BertDecodeBackend`) against the naive
+baseline the paged cache replaces: re-encoding the WHOLE prefix through
+the causal prefill for every generated token (O(T²) per sequence, no KV
+reuse). Both arms serve the same tiny-topology causal decoder with the
+same seed, so their greedy token paths are identical — the A/B isolates
+exactly what continuous batching + the paged cache buy.
+
+Interleaved A/B rounds per the bench-noise protocol (both arms of a
+round share the host phase; the speedup ratio is phase-immune), at 1 and
+16 concurrent sequences. After warmup the decode arm must never
+recompile — one step program per (page config, max-batch) — which the
+bench ASSERTS via the replica's compile-cache miss count before/after
+the timed rounds.
+
+``python -m tosem_tpu.cli microbench --decode`` runs it; ``--save`` /
+``--check`` record/gate against ``results/bench_decode.json`` floors
+(min-of-rounds, like the other suites) in ``ci.sh --perf``.
+"""
+from __future__ import annotations
+
+import statistics
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from tosem_tpu.utils.results import ResultRow
+
+# Gated by ci.sh --perf. The c16 arms and the speedup ratio are the
+# acceptance surface: >=3x tokens/s at 16 concurrent sequences vs the
+# re-encode baseline (ISSUE 6), floored well below measured so host
+# noise can't flake the gate.
+GATED_DECODE_BENCHES = (
+    "decode_paged_c1", "decode_paged_c16", "decode_speedup_c16",
+)
+
+DEFAULT_BASELINE = "results/bench_decode.json"
+
+# One model config for both arms (and the parity pin): tiny topology,
+# page-multiple max_len, enough pages for 16 sequences of
+# prompt+generated <= 3 pages each. 32 generated tokens per prompt is
+# where the paged-vs-re-encode physics shows: the baseline's per-token
+# cost GROWS with the prefix (O(T^2) per sequence) while the paged
+# arm's stays one step-program share.
+MODEL_KW = dict(max_batch=16, max_len=128, page_size=16, num_pages=96,
+                max_new_tokens=32)
+PROMPT_LEN = 12
+
+
+def _prompt(i: int) -> Dict[str, Any]:
+    return {"ids": [1 + ((i * 7 + j) % 126) for j in range(PROMPT_LEN)]}
+
+
+class NaiveRecodeBackend:
+    """The no-KV-cache baseline: every generated token re-runs the
+    causal prefill over the whole prefix (padded to the page-multiple
+    bucket palette), argmaxes the last row, appends, repeats. Same
+    model, seed, and greedy rule as :class:`BertDecodeBackend`, so both
+    arms emit identical tokens — this arm just recomputes every cached
+    K/V from scratch each step."""
+
+    def __init__(self, preset: str = "tiny", seed: int = 0,
+                 max_len: int = 128, page_size: int = 16,
+                 max_new_tokens: int = 16):
+        import jax
+
+        from tosem_tpu.models.bert import Bert, BertConfig
+        cfg = BertConfig(vocab_size=128, max_len=max_len, dim=32,
+                         heads=2, layers=2, mlp_dim=64, dropout=0.0)
+        self.cfg = cfg
+        self.page = page_size
+        self.max_new_tokens = max_new_tokens
+        self.model = Bert(cfg)
+        self._vs = self.model.init(jax.random.PRNGKey(seed))
+        self._prefill = self.model.prefill_fn(self._vs)
+        from tosem_tpu.serve.backends import model_tag
+        self._tag = model_tag("bert_recode", cfg, seed)
+        self._lock = threading.Lock()
+
+    def _compiled(self, pad_to: int):
+        import numpy as np
+
+        from tosem_tpu.serve.compile_cache import (DEFAULT_COMPILE_CACHE,
+                                                   aot_compile, shape_key)
+        key = shape_key(self._tag, (1, pad_to), self.cfg.dtype)
+        return DEFAULT_COMPILE_CACHE.get_or_build(
+            key, lambda: aot_compile(
+                self._prefill, [((1, pad_to), np.int32),
+                                ((1, pad_to), np.int32)]))
+
+    def warmup(self, shapes) -> Dict[str, Any]:
+        for pad_to in shapes:
+            self._compiled(int(pad_to))
+        return {"warmed": len(list(shapes))}
+
+    def call(self, request: Dict[str, Any]) -> Any:
+        import numpy as np
+        toks = list(request["ids"])
+        prompt_len = len(toks)
+        with self._lock:
+            for _ in range(self.max_new_tokens):
+                T = len(toks)
+                if T >= self.cfg.max_len:
+                    break
+                bucket = -(-T // self.page) * self.page
+                ids = np.zeros((1, bucket), np.int32)
+                mask = np.zeros((1, bucket), np.int32)
+                ids[0, :T] = toks
+                mask[0, :T] = 1
+                logits, _, _ = self._compiled(bucket)(ids, mask)
+                toks.append(int(np.argmax(
+                    np.asarray(logits[0, T - 1], np.float32))))
+        return {"tokens": toks, "generated": toks[prompt_len:],
+                "prompt_len": prompt_len}
+
+
+def _token_loop(handle, n_clients: int, min_s: float) -> float:
+    """``n_clients`` threads, each submitting prompts closed-loop for
+    >= ``min_s`` → generated tokens/s across the fleet."""
+    stop = time.perf_counter() + min_s
+    tokens = [0] * n_clients
+    errors: List[BaseException] = []
+
+    def client(i):
+        k = i
+        try:
+            while time.perf_counter() < stop:
+                out = handle.call(_prompt(k), timeout=120.0)
+                tokens[i] += len(out["generated"])
+                k += n_clients
+        except BaseException as e:   # pragma: no cover - surfaced below
+            errors.append(e)
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(n_clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errors:
+        raise errors[0]
+    return sum(tokens) / (time.perf_counter() - t0)
+
+
+def run_decode_benchmarks(trials: int = 3, min_s: float = 0.5,
+                          quiet: bool = False,
+                          only: Optional[set] = None) -> List[ResultRow]:
+    """Interleaved A/B decode benches; ``only`` restricts bench_ids."""
+    import tosem_tpu.runtime as rt
+    from tosem_tpu.runtime.bench_runtime import _record
+    from tosem_tpu.serve.backends import BertDecodeBackend
+    from tosem_tpu.serve.batching import DecodePolicy
+    from tosem_tpu.serve.core import Serve
+
+    def want(bid):
+        return only is None or bid in only
+
+    own_runtime = not rt.is_initialized()
+    if own_runtime:
+        rt.init(num_workers=2, memory_monitor=False)
+    rows: List[ResultRow] = []
+    lines: List[str] = []
+
+    def record(bench_id, name, mean, sd, unit="tokens/s"):
+        _record(rows, lines, bench_id, name, mean, sd, unit=unit)
+        rows[-1].extra["suite"] = "decode"
+
+    def emit(bid, name, vals, unit="tokens/s"):
+        if want(bid) and vals:
+            m = statistics.mean(vals)
+            sd = statistics.stdev(vals) if len(vals) > 1 else 0.0
+            record(bid, name, m, sd, unit=unit)
+            rows[-1].extra["rounds"] = [round(v, 2) for v in vals]
+            rows[-1].extra["min"] = round(min(vals), 2)
+            return rows[-1]
+        return None
+
+    serve = Serve()
+    # prompt bucket (one page) is the only prefill shape the paged arm
+    # sees; the naive arm re-encodes through every growth bucket
+    buckets = list(range(16, MODEL_KW["max_len"] + 1, 16))
+    serve.deploy("bench-decode", BertDecodeBackend,
+                 num_replicas=1, max_retries=1, init_kwargs=dict(MODEL_KW),
+                 decode_policy=DecodePolicy(max_active=16),
+                 warmup_shapes=[16])
+    serve.deploy("bench-recode", NaiveRecodeBackend,
+                 num_replicas=1, max_retries=1,
+                 init_kwargs=dict(max_len=MODEL_KW["max_len"],
+                                  page_size=MODEL_KW["page_size"],
+                                  max_new_tokens=MODEL_KW["max_new_tokens"]),
+                 warmup_shapes=buckets)
+    h_paged = serve.get_handle("bench-decode")
+    h_naive = serve.get_handle("bench-recode")
+    dep_paged = serve.get_deployment("bench-decode")
+
+    # pre-warm both arms end to end (first call compiles anything the
+    # declared warmup missed) AND pin parity: same greedy tokens
+    out_p = h_paged.call(_prompt(0), timeout=300.0)
+    out_n = h_naive.call(_prompt(0), timeout=300.0)
+    if out_p["tokens"] != out_n["tokens"]:
+        raise RuntimeError(
+            f"paged and re-encode arms diverged: {out_p['tokens']} vs "
+            f"{out_n['tokens']}")
+
+    def cache_misses():
+        st = rt.get(dep_paged._replicas[0].stats.remote(), timeout=60.0)
+        return st["compile_cache"]["misses"]
+
+    misses_before = cache_misses()
+    naive1, paged1, naive16, paged16, speedups = [], [], [], [], []
+    for _ in range(max(trials, 1)):
+        # one A/B round: every leg sees the same host phase
+        if want("decode_naive_c1") or want("decode_paged_c1"):
+            naive1.append(_token_loop(h_naive, 1, min_s))
+            paged1.append(_token_loop(h_paged, 1, min_s))
+        a = _token_loop(h_naive, 16, min_s)
+        b = _token_loop(h_paged, 16, min_s)
+        naive16.append(a)
+        paged16.append(b)
+        speedups.append(b / a if a else float("inf"))
+    misses_after = cache_misses()
+    if misses_after != misses_before:
+        # the one-program-per-(page config, max-batch) contract: steps
+        # after warmup must be pure cache hits, whatever the packing
+        raise RuntimeError(
+            f"decode arm recompiled during the timed rounds "
+            f"({misses_after - misses_before} new compile-cache misses)")
+
+    emit("decode_naive_c1", "decode re-encode baseline c1", naive1)
+    emit("decode_paged_c1", "decode paged c1", paged1)
+    emit("decode_naive_c16", "decode re-encode baseline c16", naive16)
+    row = emit("decode_paged_c16", "decode paged c16", paged16)
+    if row is not None:
+        row.extra["compile_cache_misses_during_rounds"] = (
+            misses_after - misses_before)
+    emit("decode_speedup_c16", "decode paged vs re-encode speedup c16",
+         speedups, unit="x")
+
+    serve.delete("bench-decode")
+    serve.delete("bench-recode")
+    if not quiet:
+        for ln in lines:
+            print(ln)
+    if own_runtime:
+        rt.shutdown()
+    return rows

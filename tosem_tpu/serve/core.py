@@ -31,7 +31,8 @@ from tosem_tpu.chaos import hooks as _chaos
 from tosem_tpu.runtime.common import (ActorDiedError, TaskCancelledError,
                                       TaskError, WorkerCrashedError)
 from tosem_tpu.serve.batching import (BatchingReplica, BatchPolicy,
-                                      BatchQueue)
+                                      BatchQueue, DecodePolicy,
+                                      DecodeQueue)
 from tosem_tpu.serve.breaker import CircuitBreaker, CircuitOpen
 
 RETRYABLE = (ActorDiedError, WorkerCrashedError)
@@ -138,7 +139,24 @@ class Deployment:
                  backoff_base_s: float = 0.05,
                  backoff_cap_s: float = 2.0,
                  batch_policy: Optional[BatchPolicy] = None,
+                 decode_policy: Optional[DecodePolicy] = None,
                  warmup_shapes: Optional[Sequence] = None):
+        if batch_policy is not None and decode_policy is not None:
+            raise ValueError("a deployment is either micro-batched "
+                             "(batch_policy) or continuous-batching "
+                             "decode (decode_policy), not both")
+        if decode_policy is not None:
+            # best-effort deploy-time guard: max_active beyond the
+            # backend's static batch dimension would fail every packed
+            # sequence at the first oversized step_batch
+            backend_max = (init_kwargs or {}).get(
+                "max_batch", getattr(backend_cls, "max_batch", None))
+            if (isinstance(backend_max, int)
+                    and decode_policy.max_active > backend_max):
+                raise ValueError(
+                    f"decode_policy.max_active={decode_policy.max_active}"
+                    f" exceeds the backend's max_batch={backend_max} "
+                    "(the compiled step program's batch dimension)")
         self.name = name
         self.backend_cls = backend_cls
         self.max_retries = max_retries
@@ -148,6 +166,7 @@ class Deployment:
         self._init_args = init_args
         self._init_kwargs = init_kwargs
         self.batch_policy = batch_policy
+        self.decode_policy = decode_policy
         self._warmup_shapes = list(warmup_shapes or [])
         if batch_policy is not None:
             # batched deployments run behind the replica wrapper: it
@@ -176,9 +195,15 @@ class Deployment:
         # every dispatch and load() call, so counts are true in-flight
         # numbers and results never stay pinned.
         self._outstanding: List[Any] = []
-        self._queue: Optional[BatchQueue] = (
-            BatchQueue(self, batch_policy)
-            if batch_policy is not None else None)
+        # the two data planes share the queue slot: Handle routing,
+        # load() accounting, stats(), and close() treat them uniformly
+        # (both expose submit/depth/stats/close)
+        if batch_policy is not None:
+            self._queue: Optional[Any] = BatchQueue(self, batch_policy)
+        elif decode_policy is not None:
+            self._queue = DecodeQueue(self, decode_policy)
+        else:
+            self._queue = None
         if self._warmup_shapes:
             self.warmup(self._warmup_shapes)
 
@@ -330,8 +355,16 @@ class Deployment:
             elif num_replicas < cur:
                 # counts computed UNDER the lock: a dispatch racing this
                 # scale-down either lands before (counted, replica looks
-                # busy and survives) or after (sees the shrunken list)
+                # busy and survives) or after (sees the shrunken list).
+                # Decode steps bypass _dispatch, so fold in the decode
+                # queue's own per-replica sequence counts — killing a
+                # replica packing live sequences forces a full re-decode
+                # of each one (and a breaker trip per logical sequence)
                 counts = self._counts_locked()
+                if self.decode_policy is not None and \
+                        self._queue is not None:
+                    for key, n in self._queue.replica_loads().items():
+                        counts[key] = counts.get(key, 0) + n
                 victims = sorted(self._replicas,
                                  key=lambda r: counts.get(id(r), 0))[
                                      :cur - num_replicas]
@@ -372,11 +405,15 @@ class Deployment:
         ``/-/stats`` ingress payload."""
         out: Dict[str, Any] = {"replicas": self.num_replicas,
                                "load": self.load(),
-                               "batched": self._queue is not None}
+                               "batched": self.batch_policy is not None,
+                               "decode": self.decode_policy is not None}
         if self._queue is not None:
             out.update(self._queue.stats())
-            out["max_batch_size"] = self.batch_policy.max_batch_size
-            out["batch_wait_ms"] = self.batch_policy.batch_wait_ms
+            if self.batch_policy is not None:
+                out["max_batch_size"] = self.batch_policy.max_batch_size
+                out["batch_wait_ms"] = self.batch_policy.batch_wait_ms
+            else:
+                out["max_active"] = self.decode_policy.max_active
         return out
 
     def close(self) -> None:
@@ -462,6 +499,7 @@ class Serve:
                buckets: Optional[Sequence[int]] = None,
                length_of: Optional[Callable[[Any], int]] = None,
                batch_policy: Optional[BatchPolicy] = None,
+               decode_policy: Optional[DecodePolicy] = None,
                warmup_shapes: Optional[Sequence] = None) -> Deployment:
         """``circuit_breaker``: True for a default breaker (5 consecutive
         failures open it for 5s), or a configured
@@ -475,7 +513,14 @@ class Serve:
         ``length_of`` (see :mod:`tosem_tpu.serve.batching`).
         ``warmup_shapes`` pre-compiles the declared shapes on every
         replica before ``deploy`` returns, so the first request never
-        pays the JIT."""
+        pays the JIT.
+
+        ``decode_policy`` turns on the iteration-level decode data plane
+        instead (continuous batching for autoregressive backends — see
+        :class:`~tosem_tpu.serve.batching.DecodeQueue`): the backend
+        must implement the decode-client protocol (``admit`` /
+        ``step_batch`` / ``result`` / ``release``). Mutually exclusive
+        with micro-batching."""
         if circuit_breaker is True:
             breaker: Optional[CircuitBreaker] = CircuitBreaker()
         elif isinstance(circuit_breaker, CircuitBreaker):
@@ -501,6 +546,7 @@ class Serve:
                              breaker=breaker, backoff_base_s=backoff_base_s,
                              backoff_cap_s=backoff_cap_s,
                              batch_policy=batch_policy,
+                             decode_policy=decode_policy,
                              warmup_shapes=warmup_shapes)
         except BaseException:
             with self._lock:

@@ -1,0 +1,324 @@
+"""Paged KV-cache: block-table allocator over object-store-backed spill.
+
+The decode kernel (:mod:`tosem_tpu.ops.paged_attention`) reads K/V
+through per-sequence block tables into a shared page pool; this module
+owns that pool. Design follows the vLLM block manager, grafted onto this
+repo's state plane:
+
+- **Fixed-size pages, free-list reuse.** A sequence owns a list of
+  physical page ids; growth allocates from a LIFO free list (hot pages
+  get reused first, and allocation order is deterministic — the chaos
+  tests replay byte-identical schedules).
+- **Ref-counting + copy-on-write.** :meth:`fork` shares a prefix's pages
+  between sequences (beam/branch decoding); a shared, partially-filled
+  page is copied the first time either branch appends into it, so no
+  write ever aliases another sequence's history.
+- **Spill tier = the object store.** Under page pressure the scheduler
+  demotes a COLD sequence instead of OOMing: :meth:`spill` serializes
+  its pages into the PR-2/3 object plane (``rt.put`` when the runtime is
+  up — which gives the payload the store's own disk-spill/eviction
+  machinery — or an in-process store otherwise) and returns the pages to
+  the free list; :meth:`restore` reallocates and rehydrates them
+  byte-identically. A payload lost to chaos eviction surfaces as
+  :class:`PagesLostError` — the decode scheduler's cue to re-prefill the
+  sequence from its token history (lineage-style recompute for data the
+  store cannot reconstruct itself).
+
+Pools are JAX arrays handed to the jitted decode step each iteration and
+swapped back functionally (:meth:`set_pools`): the step's shapes are
+static, so one compiled program serves every step.
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+class CachePressure(RuntimeError):
+    """Not enough free pages — the scheduler should evict or requeue."""
+
+
+class PagesLostError(RuntimeError):
+    """A spilled sequence's payload is gone (chaos eviction, store
+    loss); the caller must recompute the cache from token history."""
+
+
+class LocalSpillStore:
+    """In-process spill backend (no runtime needed — tests, benches)."""
+
+    def __init__(self):
+        self._data: Dict[int, Any] = {}
+        self._next = 0
+
+    def put(self, payload: Any):
+        self._next += 1
+        self._data[self._next] = payload
+        return self._next
+
+    def get(self, ref):
+        if ref not in self._data:
+            raise PagesLostError(f"spill ref {ref!r} lost")
+        return self._data[ref]
+
+    def drop(self, ref) -> None:
+        self._data.pop(ref, None)
+
+
+class RuntimeSpillStore:
+    """Spill backend over the runtime object plane: payloads become
+    store objects, inheriting the PR-2 disk-spill tier (cold payloads
+    demote to disk transparently) and its failure modes (an evicted,
+    unreconstructible payload raises — mapped to PagesLostError)."""
+
+    def put(self, payload: Any):
+        import tosem_tpu.runtime as rt
+        return rt.put(payload)
+
+    def get(self, ref):
+        import tosem_tpu.runtime as rt
+        from tosem_tpu.runtime.common import ObjectLostError
+        try:
+            return rt.get(ref, timeout=30.0)
+        except (ObjectLostError, TimeoutError) as e:
+            raise PagesLostError(f"KV spill payload lost: {e}") from e
+
+    def drop(self, ref) -> None:
+        pass                      # store lifetime owns reclamation
+
+
+def default_spill_store():
+    import tosem_tpu.runtime as rt
+    return RuntimeSpillStore() if rt.is_initialized() else LocalSpillStore()
+
+
+@dataclass
+class _Seq:
+    pages: List[int] = field(default_factory=list)
+    length: int = 0
+
+
+@dataclass
+class _Spilled:
+    ref: Any
+    length: int
+    n_pages: int
+
+
+class PagedKVCache:
+    """Page pool + block-table allocator for one decode model.
+
+    Pools are ``[layers, num_pages, page_size, heads, head_dim]`` for K
+    and V. Thread-safe (the decode scheduler's step loop and the stats
+    scrapers race).
+    """
+
+    def __init__(self, num_pages: int, page_size: int, layers: int,
+                 heads: int, head_dim: int, dtype: str = "float32",
+                 spill_store=None):
+        import jax.numpy as jnp
+        if num_pages < 1 or page_size < 1:
+            raise ValueError("num_pages and page_size must be >= 1")
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self.layers = layers
+        self.heads = heads
+        self.head_dim = head_dim
+        self.dtype = str(dtype)
+        shape = (layers, num_pages, page_size, heads, head_dim)
+        self.k_pool = jnp.zeros(shape, jnp.dtype(self.dtype))
+        self.v_pool = jnp.zeros(shape, jnp.dtype(self.dtype))
+        self._lock = threading.RLock()
+        # LIFO free list: page ids descending so pop() hands out 0, 1, …
+        # in creation order (deterministic schedules)
+        self._free: List[int] = list(range(num_pages - 1, -1, -1))
+        self._refs: Dict[int, int] = {}
+        self._seqs: Dict[Any, _Seq] = {}
+        self._spilled: Dict[Any, _Spilled] = {}
+        self._spill_store = spill_store or default_spill_store()
+
+    # ------------------------------------------------------------ allocation
+
+    def _alloc_page(self) -> int:
+        if not self._free:
+            raise CachePressure(
+                f"KV pool exhausted ({self.num_pages} pages in use)")
+        p = self._free.pop()
+        self._refs[p] = 1
+        return p
+
+    def _decref(self, page: int) -> None:
+        self._refs[page] -= 1
+        if self._refs[page] == 0:
+            del self._refs[page]
+            self._free.append(page)
+
+    def create(self, seq_id) -> None:
+        with self._lock:
+            if seq_id in self._seqs or seq_id in self._spilled:
+                raise ValueError(f"sequence {seq_id!r} already exists")
+            self._seqs[seq_id] = _Seq()
+
+    def extend(self, seq_id, n_tokens: int = 1) -> Tuple[int, int]:
+        """Grow a sequence by ``n_tokens``, allocating pages as needed
+        (all-or-nothing: on :class:`CachePressure` nothing changed).
+        Returns ``(start_pos, new_length)`` — the caller writes K/V for
+        positions ``[start_pos, new_length)``."""
+        with self._lock:
+            seq = self._seqs[seq_id]
+            start = seq.length
+            new_len = start + n_tokens
+            need = -(-new_len // self.page_size)
+            extra = need - len(seq.pages)
+            # copy-on-write: appending into a shared partially-filled
+            # tail page must not scribble on the other branch's history.
+            # Its page counts toward the capacity check UP FRONT — the
+            # all-or-nothing contract forbids copying the tail and THEN
+            # discovering the growth pages don't fit.
+            need_cow = bool(seq.length % self.page_size != 0 and seq.pages
+                            and self._refs[seq.pages[-1]] > 1)
+            if extra + int(need_cow) > len(self._free):
+                raise CachePressure(
+                    f"need {extra + int(need_cow)} pages, "
+                    f"{len(self._free)} free")
+            if need_cow:
+                old = seq.pages[-1]
+                fresh = self._alloc_page()
+                self._copy_page(old, fresh)
+                self._decref(old)
+                seq.pages[-1] = fresh
+            for _ in range(max(extra, 0)):
+                seq.pages.append(self._alloc_page())
+            seq.length = new_len
+            return start, new_len
+
+    def _copy_page(self, src: int, dst: int) -> None:
+        self.k_pool = self.k_pool.at[:, dst].set(self.k_pool[:, src])
+        self.v_pool = self.v_pool.at[:, dst].set(self.v_pool[:, src])
+
+    def fork(self, src_id, dst_id) -> None:
+        """Share ``src``'s pages with a new sequence (refcount++); the
+        branches diverge via copy-on-write on their next append."""
+        with self._lock:
+            src = self._seqs[src_id]
+            if dst_id in self._seqs or dst_id in self._spilled:
+                raise ValueError(f"sequence {dst_id!r} already exists")
+            for p in src.pages:
+                self._refs[p] += 1
+            self._seqs[dst_id] = _Seq(pages=list(src.pages),
+                                      length=src.length)
+
+    def free(self, seq_id) -> None:
+        with self._lock:
+            seq = self._seqs.pop(seq_id, None)
+            if seq is not None:
+                for p in seq.pages:
+                    self._decref(p)
+                return
+            spilled = self._spilled.pop(seq_id, None)
+            if spilled is not None:
+                self._spill_store.drop(spilled.ref)
+
+    # ------------------------------------------------------------- kernel IO
+
+    def block_table(self, seq_id, width: Optional[int] = None) -> np.ndarray:
+        """[width] int32 physical page ids, 0-padded (padding slots are
+        never read: the kernel clamps to the last real page)."""
+        with self._lock:
+            pages = self._seqs[seq_id].pages
+            w = width if width is not None else len(pages)
+            out = np.zeros((max(w, 1),), np.int32)
+            out[:len(pages)] = pages
+            return out
+
+    def length(self, seq_id) -> int:
+        with self._lock:
+            if seq_id in self._seqs:
+                return self._seqs[seq_id].length
+            return self._spilled[seq_id].length
+
+    def pages_of(self, seq_id) -> List[int]:
+        with self._lock:
+            return list(self._seqs[seq_id].pages)
+
+    def is_spilled(self, seq_id) -> bool:
+        with self._lock:
+            return seq_id in self._spilled
+
+    def set_pools(self, k_pool, v_pool) -> None:
+        """Swap in the functionally-updated pools a jitted step
+        returned (shapes must match — the one-program-per-config
+        contract)."""
+        if (tuple(k_pool.shape) != tuple(self.k_pool.shape)
+                or tuple(v_pool.shape) != tuple(self.v_pool.shape)):
+            raise ValueError("pool shape changed across a step")
+        with self._lock:
+            self.k_pool, self.v_pool = k_pool, v_pool
+
+    # ----------------------------------------------------------- spill tier
+
+    def spill(self, seq_id) -> None:
+        """Demote a sequence's pages to the spill store and return them
+        to the free list. Byte-preserving: restore + same kernel ==
+        same outputs, bit for bit."""
+        with self._lock:
+            seq = self._seqs[seq_id]
+            pages = np.asarray(seq.pages, np.int64)
+            payload = {
+                "k": np.asarray(self.k_pool[:, pages]),
+                "v": np.asarray(self.v_pool[:, pages]),
+                "length": seq.length,
+            }
+            ref = self._spill_store.put(payload)
+            for p in seq.pages:
+                self._decref(p)
+            del self._seqs[seq_id]
+            self._spilled[seq_id] = _Spilled(ref=ref, length=seq.length,
+                                             n_pages=len(seq.pages))
+
+    def restore(self, seq_id) -> None:
+        """Rehydrate a spilled sequence into fresh pages. Raises
+        :class:`CachePressure` when the pool can't hold it (nothing
+        changed) and :class:`PagesLostError` when the payload is gone
+        (caller re-prefills from token history)."""
+        with self._lock:
+            spilled = self._spilled[seq_id]
+            if spilled.n_pages > len(self._free):
+                raise CachePressure(
+                    f"restore needs {spilled.n_pages} pages, "
+                    f"{len(self._free)} free")
+            payload = self._spill_store.get(spilled.ref)   # may raise
+            pages = [self._alloc_page() for _ in range(spilled.n_pages)]
+            if pages:
+                idx = np.asarray(pages, np.int64)
+                self.k_pool = self.k_pool.at[:, idx].set(payload["k"])
+                self.v_pool = self.v_pool.at[:, idx].set(payload["v"])
+            del self._spilled[seq_id]
+            self._spill_store.drop(spilled.ref)
+            self._seqs[seq_id] = _Seq(pages=pages,
+                                      length=payload["length"])
+
+    def drop_spilled(self, seq_id) -> None:
+        """Forget a spilled sequence WITHOUT restoring (the re-prefill
+        path after :class:`PagesLostError`)."""
+        with self._lock:
+            spilled = self._spilled.pop(seq_id, None)
+            if spilled is not None:
+                self._spill_store.drop(spilled.ref)
+
+    # ---------------------------------------------------------------- stats
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            used = self.num_pages - len(self._free)
+            return {
+                "pages_total": self.num_pages,
+                "pages_used": used,
+                "pages_free": len(self._free),
+                "pages_spilled": sum(s.n_pages
+                                     for s in self._spilled.values()),
+                "sequences": len(self._seqs),
+                "sequences_spilled": len(self._spilled),
+            }

@@ -18,7 +18,7 @@ from __future__ import annotations
 import ctypes
 import itertools
 import threading
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -390,6 +390,203 @@ class SpeechBatchBackend(CompiledBackendMixin):
                                    self.cfg.blank)
             out.append({"text": text, "frames": n})
         return out
+
+class _SpeechDecodeSeq:
+    """Replica-side record of one streaming utterance. ``chunks`` is the
+    pre-chunked remaining input; ``rows`` collects emitted logit rows;
+    ``outcomes[k]`` memoizes step ``k``'s result (the idempotency ledger
+    — see :class:`tosem_tpu.serve.backends._DecodeSeq`)."""
+
+    __slots__ = ("h", "c", "buf", "chunks", "rows", "n_frames",
+                 "next_step", "done", "outcomes")
+
+    def __init__(self, h, c, buf, chunks, n_frames: int):
+        self.h = h
+        self.c = c
+        self.buf = buf
+        self.chunks = chunks
+        self.rows: list = []
+        self.n_frames = n_frames
+        self.next_step = 0
+        self.done = not chunks
+        self.outcomes: list = []
+
+
+class SpeechDecodeBackend(CompiledBackendMixin):
+    """Streaming CTC decode behind the iteration-level scheduler — the
+    DeepSpeech decode loop as a continuous-batching workload.
+
+    The LSTM carry is the "KV cache" (there are no pages to manage):
+    each scheduler step feeds every packed utterance its next
+    ``chunk_frames`` frames through ONE compiled
+    :meth:`~tosem_tpu.models.speech.SpeechModel.decode_step_fn` program
+    with static ``(max_batch, chunk)`` shapes — retired utterances ride
+    along as zero rows, so packing never recompiles.
+
+    Bit-exactness with the full forward pass: admission primes the
+    context buffer with the pass's own LEFT zero-padding (``c`` zeros)
+    plus the first ``c`` real frames, so every window the streamed LSTM
+    consumes is a window the full pass consumes, in the same order —
+    chunking only re-associates the recurrence, which is exact.
+
+    Implements the decode-client protocol of
+    :class:`~tosem_tpu.serve.batching.DecodeQueue` (``admit`` /
+    ``step_batch`` / ``result`` / ``release``); no ``spill_seq`` — carry
+    state is a few KB per utterance, page pressure does not exist here.
+    """
+
+    def __init__(self, cfg_name: str = "tiny", seed: int = 0,
+                 max_batch: int = 8, chunk_frames: int = 8,
+                 max_frames: int = 512):
+        import jax
+        from tosem_tpu.models.speech import SpeechConfig, SpeechModel
+        from tosem_tpu.nn.core import variables as _vars
+        cfg = (SpeechConfig.tiny() if cfg_name == "tiny" else SpeechConfig())
+        self.cfg = cfg
+        self.max_batch = max_batch
+        self.chunk_frames = chunk_frames
+        self.max_frames = max_frames
+        self.model = SpeechModel(cfg)
+        params = self.model.init(jax.random.PRNGKey(seed))["params"]
+        self.alphabet = "abcdefghijklmnopqrstuvwxyz' -"[:cfg.n_classes - 1]
+        self._step = self.model.decode_step_fn(_vars(params))
+        self._seqs: Dict[Any, _SpeechDecodeSeq] = {}
+        self._lock = threading.RLock()
+        self._tag = model_tag("speech_decode", cfg, seed,
+                              chunk=chunk_frames)
+
+    def _step_compiled(self):
+        from tosem_tpu.serve.compile_cache import (DEFAULT_COMPILE_CACHE,
+                                                   aot_compile, shape_key)
+        B, cfg = self.max_batch, self.cfg
+        key = shape_key(self._tag + ";step",
+                        (B, self.chunk_frames, cfg.n_input), "float32")
+        return DEFAULT_COMPILE_CACHE.get_or_build(
+            key, lambda: aot_compile(
+                self._step,
+                [((B, cfg.n_cell), np.float32),
+                 ((B, cfg.n_cell), np.float32),
+                 ((B, 2 * cfg.n_context, cfg.n_input), np.float32),
+                 ((B, self.chunk_frames, cfg.n_input), np.float32)]))
+
+    def warmup(self, shapes: Sequence[Any]) -> Dict[str, Any]:
+        from tosem_tpu.serve.compile_cache import DEFAULT_COMPILE_CACHE
+        del shapes                   # one step program serves every chunk
+        self._step_compiled()
+        return {"warmed": 1, "cache": DEFAULT_COMPILE_CACHE.stats()}
+
+    # ------------------------------------------------------- decode client
+
+    def admit(self, seq_id, request: Dict[str, Any]) -> Dict[str, Any]:
+        c, cfg = self.cfg.n_context, self.cfg
+        with self._lock:
+            if seq_id in self._seqs:          # at-least-once replay
+                seq = self._seqs[seq_id]
+                return {"done": seq.done and seq.next_step == 0}
+            frames = np.asarray(request["frames"], np.float32)
+            if frames.ndim != 2 or frames.shape[1] != cfg.n_input:
+                raise ValueError(f"frames must be [n, {cfg.n_input}], "
+                                 f"got {frames.shape}")
+            n = frames.shape[0]
+            if n < 1:
+                raise ValueError("empty frames sequence")
+            if n > self.max_frames:
+                raise ValueError(f"utterance of {n} frames exceeds "
+                                 f"max_frames={self.max_frames}")
+            # the full pass pads c zeros each side; stream the padded
+            # sequence so every consumed window is a full-pass window
+            padded = np.concatenate(
+                [np.zeros((c, cfg.n_input), np.float32), frames,
+                 np.zeros((c, cfg.n_input), np.float32)], axis=0)
+            buf, rest = padded[:2 * c], padded[2 * c:]
+            pad = -len(rest) % self.chunk_frames
+            if pad:
+                rest = np.concatenate(
+                    [rest, np.zeros((pad, cfg.n_input), np.float32)])
+            chunks = [rest[i:i + self.chunk_frames]
+                      for i in range(0, len(rest), self.chunk_frames)]
+            zeros = np.zeros((cfg.n_cell,), np.float32)
+            self._seqs[seq_id] = _SpeechDecodeSeq(
+                h=zeros.copy(), c=zeros.copy(), buf=buf.copy(),
+                chunks=chunks, n_frames=n)
+            return {"done": self._seqs[seq_id].done}
+
+    def step_batch(self, seq_ids: List[Any],
+                   step_idxs: List[int]) -> List[Dict[str, Any]]:
+        """One scheduler iteration: feed each live utterance its next
+        chunk through the shared static-shape step program."""
+        if len(seq_ids) > self.max_batch:
+            raise ValueError(f"batch of {len(seq_ids)} exceeds "
+                             f"max_batch={self.max_batch}")
+        cfg = self.cfg
+        with self._lock:
+            B = self.max_batch
+            h = np.zeros((B, cfg.n_cell), np.float32)
+            ch = np.zeros((B, cfg.n_cell), np.float32)
+            buf = np.zeros((B, 2 * cfg.n_context, cfg.n_input), np.float32)
+            chunk = np.zeros((B, self.chunk_frames, cfg.n_input),
+                             np.float32)
+            outcomes: List[Optional[Dict[str, Any]]] = []
+            live: List[Tuple[int, Any, _SpeechDecodeSeq]] = []
+            for row, (sid, step) in enumerate(zip(seq_ids, step_idxs)):
+                seq = self._seqs[sid]
+                if step < seq.next_step:      # replayed step: memo only
+                    outcomes.append(seq.outcomes[step])
+                    continue
+                if step > seq.next_step:
+                    raise RuntimeError(
+                        f"step {step} for {sid!r} skips ahead of "
+                        f"{seq.next_step} (scheduler bug)")
+                if seq.done:
+                    outcomes.append({"done": True})
+                    continue
+                h[row], ch[row], buf[row] = seq.h, seq.c, seq.buf
+                chunk[row] = seq.chunks[seq.next_step]
+                outcomes.append(None)
+                live.append((row, sid, seq))
+            if live:
+                logits, h2, c2, buf2 = self._step_compiled()(h, ch, buf,
+                                                             chunk)
+                logits = np.asarray(logits, np.float32)
+                h2, c2 = np.asarray(h2), np.asarray(c2)
+                buf2 = np.asarray(buf2)
+                for row, sid, seq in live:
+                    seq.h, seq.c = h2[row], c2[row]
+                    seq.buf = buf2[row]
+                    seq.rows.append(logits[row])
+                    seq.next_step += 1
+                    out = {"done": seq.next_step >= len(seq.chunks),
+                           "frames": self.chunk_frames}
+                    seq.done = out["done"]
+                    if seq.done:
+                        # final payload rides the outcome (zero extra
+                        # round trips to retire — see BertDecodeBackend)
+                        out["result"] = self._result_locked(seq)
+                    seq.outcomes.append(out)
+                    outcomes[row] = out
+            return outcomes
+
+    def _result_locked(self, seq: _SpeechDecodeSeq) -> Dict[str, Any]:
+        rows = (np.concatenate(seq.rows)[:seq.n_frames]
+                if seq.rows else
+                np.zeros((0, self.cfg.n_classes), np.float32))
+        text = greedy_ctc_text(rows, self.alphabet, self.cfg.blank)
+        return {"text": text, "frames": seq.n_frames}
+
+    def result(self, seq_id) -> Dict[str, Any]:
+        with self._lock:
+            return self._result_locked(self._seqs[seq_id])
+
+    def release(self, seq_id) -> None:
+        with self._lock:
+            self._seqs.pop(seq_id, None)
+
+    def stats(self) -> Dict[str, Any]:
+        out = super().stats()
+        with self._lock:
+            out["decode_sequences"] = len(self._seqs)
+        return out
+
 
 class StreamingClient:
     """Client-side stream with replay recovery (broken-stream retry).
