@@ -1,12 +1,15 @@
 """Block-table KV allocator tests (PR 6): free-list determinism,
 ref-counting / copy-on-write forks, all-or-nothing pressure, and the
 spill tier's byte-preserving round trip (:mod:`tosem_tpu.serve.kv_cache`).
-Pure host-side allocator logic — no model, no runtime."""
+Mostly pure host-side allocator logic; the RuntimeSpillStore tests at the
+bottom bring up a real runtime to assert payload reclamation (``drop`` →
+``rt.free``) and the mapped (zero-copy) restore path."""
 import numpy as np
 import pytest
 
 from tosem_tpu.serve.kv_cache import (CachePressure, LocalSpillStore,
-                                      PagedKVCache, PagesLostError)
+                                      PagedKVCache, PagesLostError,
+                                      RuntimeSpillStore)
 
 
 def make_cache(num_pages=8, page_size=4, **kw):
@@ -209,3 +212,81 @@ def test_stats_counts():
     assert s == {"pages_total": 6, "pages_used": 2, "pages_free": 4,
                  "pages_spilled": 1, "sequences": 1,
                  "sequences_spilled": 1}
+
+
+# --------------------------------------------------------------------------
+# spill-payload reclamation: a dropped sequence's payload must be freed
+# (long decode sessions were leaking store/disk space through a no-op drop)
+
+
+def test_free_spilled_sequence_reclaims_payload():
+    store = LocalSpillStore()
+    c = make_cache(spill_store=store)
+    c.create("a")
+    c.extend("a", 4)
+    c.spill("a")
+    assert len(store._data) == 1
+    c.free("a")
+    assert len(store._data) == 0               # payload reclaimed
+
+
+def test_restore_reclaims_payload():
+    store = LocalSpillStore()
+    c = make_cache(spill_store=store)
+    c.create("a")
+    c.extend("a", 4)
+    c.spill("a")
+    c.restore("a")
+    assert len(store._data) == 0               # restore drops the payload
+
+
+def _runtime_kv_cache():
+    return make_cache(num_pages=8, page_size=64, layers=2, heads=8,
+                      head_dim=32, spill_store=RuntimeSpillStore())
+
+
+def test_runtime_spill_drop_frees_store_object():
+    """RuntimeSpillStore.drop routes to rt.free: the payload's store
+    object (and any spill file) is reclaimed NOW, not at driver ref GC."""
+    import tosem_tpu.runtime as rt
+    from tosem_tpu.runtime import api
+    from tosem_tpu.runtime.object_store import ObjectID
+    rt.init(num_workers=1, memory_monitor=False)
+    try:
+        c = _runtime_kv_cache()
+        c.create("a")
+        c.extend("a", 256)                     # 4 pages, ~512KB payload
+        c.spill("a")
+        ref = c._spilled["a"].ref
+        store = api._runtime.store
+        assert store.contains(ObjectID(ref.oid.binary))
+        c.free("a")                            # drop → rt.free
+        assert not store.contains(ObjectID(ref.oid.binary))
+    finally:
+        rt.shutdown()
+
+
+def test_runtime_spill_restore_round_trip_mapped():
+    """The runtime-backed spill tier round-trips bit-identically through
+    the MAPPED read path (restore scatters straight from pinned shm
+    pages) and reclaims the payload object afterwards."""
+    import tosem_tpu.runtime as rt
+    from tosem_tpu.runtime import api
+    from tosem_tpu.runtime.object_store import ObjectID
+    rt.init(num_workers=1, memory_monitor=False)
+    try:
+        c = _runtime_kv_cache()
+        c.create("a")
+        c.extend("a", 200)
+        k0, v0 = fill_pages(c, "a", seed=3)
+        k0g, v0g = gather(c, "a")
+        c.spill("a")
+        ref = c._spilled["a"].ref
+        c.restore("a")
+        k1, v1 = gather(c, "a")
+        np.testing.assert_array_equal(k0g, k1)
+        np.testing.assert_array_equal(v0g, v1)
+        store = api._runtime.store
+        assert not store.contains(ObjectID(ref.oid.binary))  # reclaimed
+    finally:
+        rt.shutdown()

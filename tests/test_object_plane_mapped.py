@@ -1,0 +1,301 @@
+"""Zero-copy object plane: mapped-in-place reads with pinned-page
+eviction (the plasma ``client.cc`` Get contract).
+
+Safety invariants under test: mapped buffers are READONLY and
+bit-identical to copied gets; a pinned object is never spilled, never
+LRU-evicted, and never deleted by the pressure path out from under a
+live mapping; fork children inherit views without stealing the parent's
+pin; a SIGKILLed reader's pin is reclaimed (no wedged eviction); and the
+store outlives its mappings at close time."""
+import gc
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import tosem_tpu.runtime as rt
+from tosem_tpu.chaos import ChaosController, Fault, FaultPlan
+from tosem_tpu.runtime import common
+from tosem_tpu.runtime.object_store import (ObjectID, ObjectStore,
+                                            ObjectStoreError)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def store():
+    s = ObjectStore(f"/tosem_map_{os.getpid()}_{time.monotonic_ns() % 10**9}",
+                    capacity=32 << 20)
+    yield s
+    s.close()
+
+
+def _put_array(store, arr):
+    oid = ObjectID.random()
+    common.store_put_value(store, oid, arr)
+    return oid
+
+
+class TestMappedReadSafety:
+    def test_mapped_is_readonly_and_bit_identical(self, store):
+        arr = np.arange(1 << 20, dtype=np.float32)
+        oid = _put_array(store, arr)
+        found, mapped = common.store_get_value(store, oid, copy=False)
+        assert found
+        assert not mapped.flags.writeable
+        with pytest.raises(ValueError):
+            mapped[0] = 1.0                     # readonly: mutation raises
+        found, copied = common.store_get_value(store, oid, copy=True)
+        np.testing.assert_array_equal(mapped, copied)
+        np.testing.assert_array_equal(mapped, arr)
+
+    def test_pin_rides_the_arrays_not_the_handle(self, store):
+        arr = np.arange(1 << 18, dtype=np.int64)
+        oid = _put_array(store, arr)
+        _, mapped = common.store_get_value(store, oid, copy=False)
+        assert store.refcount(oid) == 1
+        # a derived slice keeps the pin after the parent array dies
+        tail = mapped[-16:]
+        del mapped
+        gc.collect()
+        assert store.refcount(oid) == 1
+        del tail
+        gc.collect()
+        assert store.refcount(oid) == 0
+
+    def test_raw_bytes_mapped_get_copies_and_unpins(self, store):
+        oid = ObjectID.random()
+        common.store_put_value(store, oid, b"q" * 300_000)
+        found, val = common.store_get_value(store, oid, copy=False)
+        assert found and isinstance(val, bytes) and val == b"q" * 300_000
+        assert store.refcount(oid) == 0         # bytes contract: no pin
+
+    def test_handle_context_manager_releases(self, store):
+        oid = ObjectID.random()
+        store.put(oid, b"x" * 4096)
+        with store.get_mapped(oid) as h:
+            assert h.pinned
+            assert bytes(h.view) == b"x" * 4096
+            assert h.view.readonly
+        assert not h.pinned
+        assert store.refcount(oid) == 0
+
+
+class TestPinVsEvictAndSpill:
+    def test_pinned_object_is_not_spillable(self, store):
+        arr = np.arange(1 << 20, dtype=np.float32)
+        oid = _put_array(store, arr)
+        _, mapped = common.store_get_value(store, oid, copy=False)
+        assert store.spill(oid) is False        # pinned: not a victim
+        assert store.contains_shm(oid)
+        assert not store.has_spilled(oid)
+        np.testing.assert_array_equal(mapped, arr)  # pages untouched
+        del mapped
+        gc.collect()
+        assert store.spill(oid) is True         # unpinned: spillable
+        found, back = common.store_get_value(store, oid, copy=False)
+        assert found
+        np.testing.assert_array_equal(back, arr)    # restore bit-identical
+
+    def test_delete_if_unpinned_refuses_pinned(self, store):
+        arr = np.ones(1 << 18, np.float32)
+        oid = _put_array(store, arr)
+        _, mapped = common.store_get_value(store, oid, copy=False)
+        assert store.delete_if_unpinned(oid) is False
+        assert store.contains_shm(oid)
+        np.testing.assert_array_equal(mapped, arr)
+        del mapped
+        gc.collect()
+        assert store.delete_if_unpinned(oid) is True
+        assert not store.contains(oid)
+
+    def test_lru_eviction_skips_pinned_slot(self, store):
+        """Fill the store past capacity: the pinned object survives
+        every eviction wave; unpinned neighbours are the victims."""
+        pinned_arr = np.full(1 << 18, 7, np.int32)      # 1 MB
+        pinned_oid = _put_array(store, pinned_arr)
+        _, mapped = common.store_get_value(store, pinned_oid, copy=False)
+        filler = np.zeros(1 << 19, np.int32)            # 2 MB each
+        oids = []
+        for _ in range(40):                             # >> 32 MB capacity
+            oids.append(_put_array(store, filler))
+        assert store.contains_shm(pinned_oid)           # never evicted
+        np.testing.assert_array_equal(mapped, pinned_arr)
+        assert any(not store.contains_shm(o) for o in oids)  # others were
+
+    def test_deferred_delete_keeps_mapping_valid(self, store):
+        """A plain delete (owner dropped the id) under a live mapping
+        defers the free: the consumer's view stays intact, and the slot
+        is reclaimed when the pin drops."""
+        arr = np.arange(1 << 19, dtype=np.float32)
+        oid = _put_array(store, arr)
+        _, mapped = common.store_get_value(store, oid, copy=False)
+        store.delete(oid)
+        assert not store.contains(oid)          # id is gone...
+        np.testing.assert_array_equal(mapped, arr)  # ...pages are not
+        used_before = store.stats()[0]
+        del mapped
+        gc.collect()
+        assert store.stats()[0] < used_before   # last release freed it
+
+    def test_put_full_when_everything_pinned(self, store):
+        """A put into a store whose every byte is pinned surfaces the
+        typed FULL error (nothing evictable) — the runtime/robust-writer
+        layers above turn that into a bounded wait for pins to drop."""
+        big = np.zeros(3 << 20, np.uint8)
+        oids = [_put_array(store, big) for _ in range(8)]  # ~24 of 32 MB
+        maps = [common.store_get_value(store, o, copy=False)[1]
+                for o in oids]
+        with pytest.raises(ObjectStoreError):
+            # nothing evictable (all pinned): -3 surfaces
+            store.put(ObjectID.random(), b"x" * (8 << 20))
+        assert all(m is not None for m in maps)
+
+
+class TestCrossProcess:
+    def test_fork_child_mapping_does_not_steal_parent_pin(self, store):
+        """A fork child inherits the parent's mapped views; its exit
+        (running the inherited finalizers) must NOT release the
+        parent's pin — and its own mapping pins/releases normally."""
+        arr = np.arange(1 << 18, dtype=np.float32)
+        oid = _put_array(store, arr)
+        _, mapped = common.store_get_value(store, oid, copy=False)
+        assert store.refcount(oid) == 1
+        pid = os.fork()
+        if pid == 0:                            # child
+            ok = bool(np.array_equal(mapped, arr))      # inherited view
+            _, own = common.store_get_value(store, oid, copy=False)
+            ok = ok and bool(np.array_equal(own, arr))  # own mapping
+            del own, mapped
+            gc.collect()                        # inherited finalizer runs
+            os._exit(0 if ok else 1)
+        _, status = os.waitpid(pid, 0)
+        assert os.waitstatus_to_exitcode(status) == 0
+        # parent's pin survived the child's exit-time finalizers
+        assert store.refcount(oid) == 1
+        np.testing.assert_array_equal(mapped, arr)
+
+    def test_dead_reader_pin_is_reclaimed(self, store):
+        """A reader SIGKILLed while holding a mapping must not wedge
+        the slot: its pin is reclaimed and the object is evictable
+        again."""
+        oid = ObjectID.random()
+        store.put(oid, b"h" * 200_000)
+        code = (
+            "import sys, os, signal\n"
+            "sys.path.insert(0, %r)\n"
+            "from tosem_tpu.runtime.object_store import ObjectID, "
+            "ObjectStore\n"
+            "s = ObjectStore(%r, create=False)\n"
+            "h = s.get_mapped(ObjectID(bytes.fromhex(%r)))\n"
+            "assert h.pinned\n"
+            "print('PINNED', flush=True)\n"
+            "os.kill(os.getpid(), signal.SIGKILL)\n"
+        ) % (REPO, store.name, oid.hex())
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True, timeout=60)
+        assert "PINNED" in proc.stdout
+        assert proc.returncode == -signal.SIGKILL
+        # dead pin reclaimed: refcount reads 0 and eviction can take it
+        assert store.refcount(oid) == 0
+        assert store.delete_if_unpinned(oid) is True
+
+
+def _payload_arr():
+    return (np.arange(1 << 20, dtype=np.float32) * 3.0)
+
+
+class TestRuntimeMapped:
+    def test_driver_get_mapped_vs_copy_bit_identical(self):
+        rt.init(num_workers=2, memory_monitor=False)
+        try:
+            ref = rt.put(_payload_arr())
+            mapped = rt.get(ref, timeout=60.0)
+            copied = rt.get(ref, timeout=60.0, copy=True)
+            assert not mapped.flags.writeable
+            with pytest.raises(ValueError):
+                mapped += 1.0
+            np.testing.assert_array_equal(mapped, copied)
+            np.testing.assert_array_equal(mapped, _payload_arr())
+        finally:
+            rt.shutdown()
+
+    def test_worker_arg_is_mapped_readonly_for_task_duration(self):
+        rt.init(num_workers=2, memory_monitor=False)
+        try:
+            ref = rt.put(_payload_arr())
+
+            @rt.remote
+            def inspect(x):
+                # the arg aliases the store readonly; in-place writes
+                # raise rather than scribbling on shared pages
+                assert not x.flags.writeable
+                try:
+                    x[0] = 1.0
+                except ValueError:
+                    return float(x.sum())
+                return None
+            assert rt.get(inspect.remote(ref), timeout=60.0) == \
+                float(_payload_arr().sum())
+        finally:
+            rt.shutdown()
+
+    def test_chaos_evict_under_pin_stays_zero_error(self):
+        """The state-plane-survival interplay: chaos pressure-evicts
+        sealed store results while the driver holds mapped reads. The
+        eviction path skips pinned slots, lost-but-unpinned results are
+        lineage-reconstructed, and every value — held mapping or
+        re-get — is fault-free-identical. Zero surfaced errors."""
+        plan = FaultPlan(seed=11, faults=[
+            Fault(site="runtime.store", action="evict_object", at=2),
+            Fault(site="runtime.store", action="evict_object", at=4),
+        ])
+        rt.init(num_workers=2, memory_monitor=False)
+        try:
+            with ChaosController(plan):
+                f = rt.remote(_payload_arr)
+                refs = [f.remote() for _ in range(6)]
+                held = [rt.get(r, timeout=120.0) for r in refs]
+                # re-read everything: evicted results reconstruct, a
+                # pinned result must be served in place (a pinned object
+                # can never need reconstruction — impossible by
+                # construction)
+                again = [rt.get(r, timeout=120.0) for r in refs]
+            expect = _payload_arr()
+            for v in held + again:
+                np.testing.assert_array_equal(v, expect)
+        finally:
+            rt.shutdown()
+
+    def test_shutdown_with_outstanding_mapping_keeps_pages_valid(self):
+        """Runtime shutdown closes the store while a consumer still
+        holds a mapped value: the close leaks the mapping (unlink, no
+        munmap) so the view stays readable until process exit."""
+        rt.init(num_workers=2, memory_monitor=False)
+        ref = rt.put(_payload_arr())
+        mapped = rt.get(ref, timeout=60.0)
+        rt.shutdown()
+        np.testing.assert_array_equal(mapped, _payload_arr())
+
+    def test_free_reclaims_now_but_spares_live_mappings(self):
+        rt.init(num_workers=2, memory_monitor=False)
+        try:
+            from tosem_tpu.runtime import api
+            store = api._runtime.store
+            ref = rt.put(_payload_arr())
+            mapped = rt.get(ref, timeout=60.0)
+            rt.free(ref)
+            # id forgotten (deferred delete), mapping intact
+            np.testing.assert_array_equal(mapped, _payload_arr())
+            # the held ref resolves to a typed error NOW, not a hang
+            with pytest.raises(rt.ObjectLostError):
+                rt.get(ref, timeout=60.0)
+            del mapped
+            gc.collect()
+            assert not store.contains(ObjectID(ref.oid.binary))
+        finally:
+            rt.shutdown()

@@ -26,9 +26,9 @@ from tosem_tpu.utils.flags import FlagSet
 
 CONFIGS = ("gemm", "timing_check", "conv_sweep", "allreduce",
            "resnet_train", "bert_kernels", "bert_train",
-           "flash_autotune", "detection_train", "detection_infer",
-           "pointpillars_infer", "speech_train", "serve_bench",
-           "decode_bench", "analysis")
+           "flash_autotune", "autotune_decode_pages", "detection_train",
+           "detection_infer", "pointpillars_infer", "speech_train",
+           "serve_bench", "decode_bench", "analysis")
 
 
 def make_flags() -> FlagSet:
@@ -525,6 +525,49 @@ def run_flash_autotune(fs: FlagSet) -> List[Any]:
     return rows
 
 
+def run_autotune_decode_pages(fs: FlagSet) -> List[Any]:
+    """Dedicated on-chip decode page-size sweep (ROADMAP item 2
+    follow-up). The ``flash_autotune`` leg sweeps decode pages too, but
+    only after its (long) block sweep — a tunnel that flaps mid-leg
+    records block winners while the cache's "pages" section still
+    carries CPU-smoke winners only. This focused leg runs JUST the
+    paged-attention sweep, so a short liveness window is enough to land
+    on-chip page winners where ``select_page_size`` — and therefore
+    ``BertDecodeBackend`` — reads them."""
+    import jax
+    from tosem_tpu.ops.flash_blocks import (DEFAULT_CACHE_PATH,
+                                            autotune_decode_pages)
+    from tosem_tpu.utils.results import ResultRow
+
+    if fs.device == "cpu":   # interpret-mode smoke: one tiny shape
+        page_shapes = [(2, 2, 128, 32, "float32")]
+    elif fs.seq:
+        page_shapes = [(8, 12, fs.seq, 64, fs.dtype or "bfloat16")]
+    else:
+        # north-star decode shape first, then the long-context rows the
+        # continuous-batching bench exercises
+        page_shapes = [(8, 12, 512, 64, "bfloat16"),
+                       (8, 12, 2048, 64, "bfloat16"),
+                       (16, 12, 1024, 64, "bfloat16")]
+    platform = jax.devices()[0].platform
+    rows = []
+    for r in autotune_decode_pages(page_shapes, reps=3):
+        B, H, T, D, dtype = r["shape"]
+        row = ResultRow(
+            project="ops", config="autotune_decode_pages",
+            bench_id=f"decode_pages_b{B}_t{T}_{dtype}_p{r['page']}",
+            metric="time_us", value=r["time_us"], unit="us",
+            device=platform, n_devices=1,
+            extra={"shape": [B, H, T, D], "dtype": dtype,
+                   "page": r["page"], "best": r["best"],
+                   "cache": DEFAULT_CACHE_PATH})
+        rows.append(row)
+        star = " *" if r["best"] else ""
+        print(f"  {row.bench_id}: {row.value:.1f} {row.unit}{star}")
+    print(f"  page winners -> {DEFAULT_CACHE_PATH}")
+    return rows
+
+
 def run_detection_train(fs: FlagSet) -> List[Any]:
     """EfficientDet training smoke on synthetic boxes + COCO-style AP
     (``efficientdet/main.py`` train + ``coco_metric.py`` eval roles)."""
@@ -956,6 +999,7 @@ RUNNERS = {
     "bert_kernels": run_bert_kernels,
     "bert_train": run_bert_train,
     "flash_autotune": run_flash_autotune,
+    "autotune_decode_pages": run_autotune_decode_pages,
     "detection_train": run_detection_train,
     "detection_infer": run_detection_infer,
     "pointpillars_infer": run_pointpillars_infer,

@@ -29,10 +29,11 @@
 namespace {
 
 constexpr uint64_t kMagic = 0x544f53454d4f5354ULL;  // "TOSEMOST"
-constexpr uint32_t kVersion = 1;
+constexpr uint32_t kVersion = 2;  // v2: per-pid pin ledger in Slot
 constexpr uint32_t kIdLen = 20;
-constexpr uint32_t kTableSlots = 1 << 13;  // open-addressed index (~460KB)
+constexpr uint32_t kTableSlots = 1 << 13;  // open-addressed index
 constexpr uint64_t kAlign = 64;            // cache-line aligned payloads
+constexpr uint32_t kMaxPinners = 12;       // distinct pids per pin ledger
 
 enum SlotState : uint32_t { kEmpty = 0, kUsed = 1, kTombstone = 2,
                             kCreating = 3, kPendingDelete = 4 };
@@ -45,7 +46,81 @@ struct Slot {
   uint64_t size;    // payload size
   uint64_t lru;     // last-touch tick, for eviction
   int64_t creator_pid;  // reserver's pid; orphan detection for kCreating
+  // Pin ledger: which processes hold zero-copy mappings (get() without a
+  // matching release()). A pinned object (refcount > 0) is skipped by LRU
+  // eviction AND refused by delete_if_unpinned (the spill/pressure path),
+  // so a mapped-in-place consumer can never have the pages freed out from
+  // under it. refcount == sum(pin_count) + anon_pins; entries whose pid is
+  // dead are reclaimed lazily under allocation pressure so a SIGKILLed
+  // reader cannot wedge eviction forever. A 13th distinct SIMULTANEOUS
+  // pinner overflows into anon_pins — still a pin, just not crash-
+  // reclaimable; record_pin reclaims dead entries before overflowing,
+  // so getting there takes 13+ live pinner processes on ONE object
+  // (bounded worker pools never do).
+  int64_t pin_pid[kMaxPinners];
+  uint32_t pin_count[kMaxPinners];
+  uint32_t anon_pins;
 };
+
+// getpid() is a real syscall (pathologically slow under some sandboxed
+// kernels) — cache it and refresh in fork children via pthread_atfork.
+pid_t g_pid = getpid();
+void refresh_cached_pid() { g_pid = getpid(); }
+struct PidInit {
+  PidInit() { pthread_atfork(nullptr, nullptr, refresh_cached_pid); }
+} g_pid_init;
+
+void reclaim_dead_pins(Slot* s);
+
+void record_pin(Slot* s, int64_t pid) {
+  for (int attempt = 0; attempt < 2; attempt++) {
+    int64_t free_i = -1;
+    for (uint32_t i = 0; i < kMaxPinners; i++) {
+      if (s->pin_count[i] > 0 && s->pin_pid[i] == pid) {
+        s->pin_count[i]++;
+        return;
+      }
+      if (s->pin_count[i] == 0 && free_i < 0) free_i = (int64_t)i;
+    }
+    if (free_i >= 0) {
+      s->pin_pid[free_i] = pid;
+      s->pin_count[free_i] = 1;
+      return;
+    }
+    // ledger full: entries held by dead processes are reclaimable —
+    // evict them before overflowing into the anonymous count
+    if (attempt == 0) reclaim_dead_pins(s);
+  }
+  s->anon_pins++;  // 13+ live pinners: pinned but not crash-reclaimable
+}
+
+void drop_pin(Slot* s, int64_t pid) {
+  for (uint32_t i = 0; i < kMaxPinners; i++) {
+    if (s->pin_count[i] > 0 && s->pin_pid[i] == pid) {
+      s->pin_count[i]--;
+      if (s->pin_count[i] == 0) s->pin_pid[i] = 0;
+      return;
+    }
+  }
+  if (s->anon_pins > 0) s->anon_pins--;
+}
+
+// Drop pins whose owning process died (crashed mid-read, SIGKILLed
+// worker holding a mapping): each dead entry's count is subtracted from
+// refcount so the object becomes evictable/spillable again.
+void reclaim_dead_pins(Slot* s) {
+  if (s->refcount == 0) return;
+  for (uint32_t i = 0; i < kMaxPinners; i++) {
+    if (s->pin_count[i] == 0) continue;
+    pid_t p = (pid_t)s->pin_pid[i];
+    if (p > 0 && kill(p, 0) != 0 && errno == ESRCH) {
+      uint32_t c = s->pin_count[i];
+      s->refcount = s->refcount > c ? s->refcount - c : 0;
+      s->pin_count[i] = 0;
+      s->pin_pid[i] = 0;
+    }
+  }
+}
 
 // A kCreating slot whose creator died mid-write is an orphan: nobody can
 // seal it, so it is reclaimable (plasma's disconnect-cleanup role).
@@ -208,13 +283,28 @@ void free_block(Handle* h, uint64_t off) {
   freelist_push(h, off);
 }
 
+// Complete a deferred delete whose last pin just vanished (the reader
+// died instead of releasing): kPendingDelete + refcount 0 frees now.
+void finish_pending_delete(Handle* h, Slot* s) {
+  Header* H = hdr(h);
+  if (s->state != kPendingDelete || s->refcount != 0) return;
+  H->used_bytes -= s->size;
+  H->num_objects--;
+  uint64_t block_off = s->offset - sizeof(BlockHeader);
+  s->state = kTombstone;
+  free_block(h, block_off);
+}
+
 // Evict the least-recently-touched zero-refcount object (plasma
 // `eviction_policy.cc` analog, LRU flavour). Caller retries its allocation
 // after each eviction; coalescing in free_block grows contiguous space.
+// Pinned slots (live zero-copy mappings) are never victims; dead readers'
+// pins are reclaimed first so crashes can't wedge eviction.
 int evict_lru(Handle* h) {
   Header* H = hdr(h);
   // Orphaned kCreating blocks (creator died mid-write) are reclaimed first:
-  // nothing can ever seal them, so they are pure leaks otherwise.
+  // nothing can ever seal them, so they are pure leaks otherwise. The same
+  // pass drops pins held by dead processes.
   for (uint32_t i = 0; i < kTableSlots; i++) {
     Slot* s = &H->table[i];
     if (slot_is_orphan(s)) {
@@ -222,6 +312,12 @@ int evict_lru(Handle* h) {
       s->state = kTombstone;  // kCreating was never counted in used_bytes
       free_block(h, block_off);
       return 0;
+    }
+    if ((s->state == kUsed || s->state == kPendingDelete) &&
+        s->refcount > 0) {
+      reclaim_dead_pins(s);
+      finish_pending_delete(h, s);
+      if (s->state == kTombstone) return 0;  // deferred delete completed
     }
   }
   Slot* victim = nullptr;
@@ -252,6 +348,7 @@ enum {
   OS_ERR_FULL = -3,
   OS_ERR_SYS = -4,
   OS_ERR_TOOBIG = -5,
+  OS_ERR_PINNED = -6,
 };
 
 void* objstore_create(const char* name, uint64_t capacity) {
@@ -337,6 +434,9 @@ int objstore_put(void* vh, const uint8_t* id, const uint8_t* data,
   memcpy(s->id, id, kIdLen);
   s->state = kUsed;
   s->refcount = 0;
+  memset(s->pin_pid, 0, sizeof(s->pin_pid));
+  memset(s->pin_count, 0, sizeof(s->pin_count));
+  s->anon_pins = 0;
   s->offset = payload;
   s->size = size;
   s->lru = ++H->lru_tick;
@@ -348,7 +448,9 @@ int objstore_put(void* vh, const uint8_t* id, const uint8_t* data,
 
 // Returns a pointer into the shared mapping (zero-copy) and bumps refcount;
 // pair with objstore_release. Pointer stays valid until refcount drops to 0
-// and the object is evicted/deleted.
+// and the object is evicted/deleted. The refcount IS the pin: while held,
+// the object is skipped by eviction and refused by delete_if_unpinned, and
+// the caller's pid is recorded so a crashed reader's pin is reclaimable.
 int objstore_get(void* vh, const uint8_t* id, const uint8_t** out_ptr,
                  uint64_t* out_size) {
   Handle* h = static_cast<Handle*>(vh);
@@ -357,11 +459,31 @@ int objstore_get(void* vh, const uint8_t* id, const uint8_t** out_ptr,
   Slot* s = find_slot(h, id, 0);
   if (!s || s->state != kUsed) { unlock(H); return OS_ERR_NOTFOUND; }
   s->refcount++;
+  record_pin(s, (int64_t)g_pid);
   s->lru = ++H->lru_tick;
   *out_ptr = h->base + s->offset;
   *out_size = s->size;
   unlock(H);
   return OS_OK;
+}
+
+// Current refcount (pin count) of a sealed object; OS_ERR_NOTFOUND when
+// absent. Reclaims dead-process pins first so the answer reflects LIVE
+// consumers only (the spill path's pinned-victim check reads this).
+int objstore_refcount(void* vh, const uint8_t* id) {
+  Handle* h = static_cast<Handle*>(vh);
+  Header* H = hdr(h);
+  if (lock(H) != 0) return OS_ERR_SYS;
+  Slot* s = find_slot(h, id, 0);
+  if (!s || (s->state != kUsed && s->state != kPendingDelete)) {
+    unlock(H);
+    return OS_ERR_NOTFOUND;
+  }
+  reclaim_dead_pins(s);
+  finish_pending_delete(h, s);
+  int r = s->state == kTombstone ? OS_ERR_NOTFOUND : (int)s->refcount;
+  unlock(H);
+  return r;
 }
 
 // Two-phase write (plasma Create/Seal): reserve space, let the caller write
@@ -386,6 +508,9 @@ int objstore_reserve(void* vh, const uint8_t* id, uint64_t size,
   memcpy(s->id, id, kIdLen);
   s->state = kCreating;
   s->refcount = 0;
+  memset(s->pin_pid, 0, sizeof(s->pin_pid));
+  memset(s->pin_count, 0, sizeof(s->pin_count));
+  s->anon_pins = 0;
   s->offset = off + sizeof(BlockHeader);
   s->size = size;
   s->lru = ++H->lru_tick;
@@ -458,7 +583,10 @@ int objstore_release(void* vh, const uint8_t* id) {
   if (lock(H) != 0) return OS_ERR_SYS;
   Slot* s = find_slot(h, id, 0);
   if (!s) { unlock(H); return OS_ERR_NOTFOUND; }
-  if (s->refcount > 0) s->refcount--;
+  if (s->refcount > 0) {
+    s->refcount--;
+    drop_pin(s, (int64_t)g_pid);
+  }
   if (s->state == kPendingDelete && s->refcount == 0) {
     // last reader gone: perform the deferred delete (plasma semantics —
     // the get() contract promises the zero-copy pointer stays valid
@@ -469,6 +597,33 @@ int objstore_release(void* vh, const uint8_t* id) {
     s->state = kTombstone;
     free_block(h, block_off);
   }
+  unlock(H);
+  return OS_OK;
+}
+
+// Delete ONLY when no live consumer pins the object: the eviction-under-
+// pressure path (spill, chaos evict). Unlike objstore_delete it never
+// defers — a pinned object is simply NOT a victim (OS_ERR_PINNED), so an
+// in-place mapping can never observe its pages freed or its id vanish
+// into a deferred-delete state that blocks a later re-put. Dead readers'
+// pins are reclaimed first.
+int objstore_delete_if_unpinned(void* vh, const uint8_t* id) {
+  Handle* h = static_cast<Handle*>(vh);
+  Header* H = hdr(h);
+  if (lock(H) != 0) return OS_ERR_SYS;
+  Slot* s = find_slot(h, id, 0);
+  if (!s || s->state == kCreating) { unlock(H); return OS_ERR_NOTFOUND; }
+  reclaim_dead_pins(s);
+  finish_pending_delete(h, s);
+  if (s->state == kTombstone) { unlock(H); return OS_OK; }
+  if (s->refcount > 0) { unlock(H); return OS_ERR_PINNED; }
+  if (s->state == kUsed) {
+    H->used_bytes -= s->size;
+    H->num_objects--;
+  }
+  uint64_t block_off = s->offset - sizeof(BlockHeader);
+  s->state = kTombstone;
+  free_block(h, block_off);
   unlock(H);
   return OS_OK;
 }
@@ -525,6 +680,17 @@ void objstore_close(void* vh) {
   Handle* h = static_cast<Handle*>(vh);
   if (h->owner) shm_unlink(h->name);
   munmap(h->base, h->capacity);
+  delete h;
+}
+
+// Close WITHOUT unmapping: used when live in-place mappings (consumer
+// views into the segment) still exist at close time — the pages must
+// survive until the process exits or the last view dies. The name is
+// still unlinked (owner), so the segment is unreachable for attachers
+// and the kernel reclaims the memory when the mapping finally goes.
+void objstore_close_keepmap(void* vh) {
+  Handle* h = static_cast<Handle*>(vh);
+  if (h->owner) shm_unlink(h->name);
   delete h;
 }
 

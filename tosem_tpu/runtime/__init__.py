@@ -11,16 +11,18 @@ from tosem_tpu.runtime.api import (ActorDiedError, DeadlineExceeded,
                                    ObjectRef, PlacementGroup,
                                    PlacementTimeout, TaskCancelledError,
                                    TaskError, WorkerCrashedError,
-                                   add_worker, cancel, get, init,
+                                   add_worker, cancel, free, get, init,
                                    is_initialized, kill, placement_group,
                                    put, remote, remove_idle_worker,
                                    remove_placement_group, shutdown,
                                    stats, wait)
-from tosem_tpu.runtime.object_store import ObjectID, ObjectStore
+from tosem_tpu.runtime.object_store import (MappedHandle, ObjectID,
+                                            ObjectStore)
 
 __all__ = [
     "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
-    "kill", "cancel", "stats", "add_worker", "remove_idle_worker",
+    "free", "kill", "cancel", "stats", "add_worker", "remove_idle_worker",
+    "MappedHandle",
     "placement_group", "remove_placement_group", "PlacementGroup",
     "PlacementTimeout", "ObjectRef", "ObjectID", "ObjectStore", "TaskError",
     "WorkerCrashedError", "ObjectLostError", "ActorDiedError",

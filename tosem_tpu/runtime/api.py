@@ -39,10 +39,10 @@ from tosem_tpu.runtime.runtime import Runtime
 
 __all__ = [
     "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
-    "kill", "cancel", "ObjectRef", "TaskError", "WorkerCrashedError",
-    "ObjectLostError", "ActorDiedError", "TaskCancelledError",
-    "DeadlineExceeded", "PlacementGroup", "PlacementTimeout",
-    "placement_group", "remove_placement_group",
+    "free", "kill", "cancel", "ObjectRef", "TaskError",
+    "WorkerCrashedError", "ObjectLostError", "ActorDiedError",
+    "TaskCancelledError", "DeadlineExceeded", "PlacementGroup",
+    "PlacementTimeout", "placement_group", "remove_placement_group",
 ]
 
 _runtime: Optional[Runtime] = None
@@ -271,15 +271,31 @@ def remote(*args, **options):
 
 
 def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
-        timeout: Optional[float] = None) -> Any:
+        timeout: Optional[float] = None, copy: bool = False) -> Any:
+    """Resolve refs to values.
+
+    Large (store-resident) objects come back MAPPED IN PLACE by default:
+    ndarray buffers are readonly views over the shared-memory segment —
+    no heap copy — pinned against eviction/spill until the caller's last
+    reference dies. Pass ``copy=True`` for the previous heap-copying
+    read (no aliasing, no pin; unpickled arrays are readonly either
+    way — out-of-band buffers always were)."""
     rt = _rt()
     if isinstance(refs, ObjectRef):
-        return rt.get(refs, timeout=timeout)
-    return [rt.get(r, timeout=timeout) for r in refs]
+        return rt.get(refs, timeout=timeout, copy=copy)
+    return [rt.get(r, timeout=timeout, copy=copy) for r in refs]
 
 
 def put(value: Any) -> ObjectRef:
     return _rt().put(value)
+
+
+def free(refs: Union[ObjectRef, Sequence[ObjectRef]]) -> None:
+    """Explicitly release objects now instead of waiting for ref GC
+    (``ray.internal.free`` role): the store copy + spill file are
+    deleted and the id is forgotten driver-side. Live mappings of the
+    object stay valid (deferred free); later ``get`` of the ref raises."""
+    _rt().free(refs)
 
 
 def wait(refs: Sequence[ObjectRef], num_returns: int = 1,

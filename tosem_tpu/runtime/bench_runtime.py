@@ -25,7 +25,43 @@ GATED_BENCHES = (
     "single_client_get", "single_client_put", "tasks_sync", "tasks_async",
     "small_result_async", "large_object_roundtrip", "wait_fanout",
     "actor_calls_sync", "actor_calls_async",
+    # zero-copy object plane (mapped-in-place reads): absolute rates for
+    # both arms plus the mapped/copy ratio — the ratio is what the
+    # acceptance pins (>= 3x on an 8 MB ndarray), and it is robust to
+    # the bimodal hosts because both arms ride the same phase
+    "large_get_mapped", "large_get_mapped_speedup",
+    "serve_handoff_mapped", "serve_handoff_mapped_speedup",
 )
+
+
+def _timeit_ab(fn_a: Callable[[], int], fn_b: Callable[[], int],
+               trials: int = 3, min_s: float = 0.5
+               ) -> Tuple[List[float], List[float]]:
+    """Finely interleaved A/B rates: within every round the two arms
+    alternate op-batch by op-batch until the round has run >= 2*min_s,
+    and each arm's rate is its ops over ITS accumulated time. The
+    alternation keeps both arms inside the same host phase (the 2-CPU
+    bench hosts are bimodal — coarse per-arm rounds measure the phase,
+    not the code), so per-round A/B ratios are phase-cancelled and the
+    min-of-rounds floor is meaningful."""
+    fn_a()
+    fn_b()  # untimed warmup for both arms
+    rates_a: List[float] = []
+    rates_b: List[float] = []
+    for _ in range(trials):
+        ops_a = ops_b = 0
+        t_a = t_b = 0.0
+        while t_a + t_b < 2 * min_s:
+            t0 = time.perf_counter()
+            ops_a += fn_a()
+            t1 = time.perf_counter()
+            ops_b += fn_b()
+            t2 = time.perf_counter()
+            t_a += t1 - t0
+            t_b += t2 - t1
+        rates_a.append(ops_a / t_a)
+        rates_b.append(ops_b / t_b)
+    return rates_a, rates_b
 
 
 def _timeit(name: str, fn: Callable[[], int], trials: int = 3,
@@ -49,16 +85,20 @@ def _timeit(name: str, fn: Callable[[], int], trials: int = 3,
 
 def _record(rows: List[ResultRow], lines: List[str], bench_id: str,
             name: str, mean: float, sd: float,
-            unit: str = "ops/s") -> None:
+            unit: str = "ops/s", extra: Optional[dict] = None) -> None:
     """Shared row/release-line emitter for every microbench runner —
     one place for the schema (project/config/metric/stddev) so the two
-    harnesses cannot diverge."""
+    harnesses cannot diverge. ``extra`` merges into the row's extra dict
+    (A/B rows carry their min-of-rounds floor there)."""
     lines.append(_release_line(name, mean, sd))
+    row_extra = {"stddev": sd}
+    if extra:
+        row_extra.update(extra)
     rows.append(ResultRow(project="runtime", config="microbenchmark",
                           bench_id=bench_id,
                           metric=name.replace(" ", "_"),
                           value=mean, unit=unit, device="cpu",
-                          n_devices=1, extra={"stddev": sd}))
+                          n_devices=1, extra=row_extra))
 
 
 def _release_line(name: str, mean: float, sd: float) -> str:
@@ -169,6 +209,89 @@ def run_microbenchmarks(num_workers: int = 4, trials: int = 3,
         m, s = _timeit("large_object", large_roundtrip, trials, min_s)
         record("large_object_roundtrip", "large object (4MB) put+task",
                m, s)
+
+    # --- zero-copy object plane: mapped-vs-copy A/B ------------------------
+    # a get() of a large ndarray maps its buffer IN PLACE over the shm
+    # segment (readonly, pinned) instead of memcpying it to the heap.
+    # Interleaved A/B + min-of-rounds floors (bimodal-host protocol);
+    # both arms proven bit-identical first.
+    def record_ab(bench_id, name, rates, unit="ops/s"):
+        mean = statistics.mean(rates)
+        sd = statistics.stdev(rates) if len(rates) > 1 else 0.0
+        _record(rows, lines, bench_id, name, mean, sd, unit,
+                extra={"min": min(rates)})
+
+    mapped_ids = {"large_get_copy", "large_get_mapped",
+                  "large_get_mapped_speedup"}
+    if only is None or mapped_ids & only:
+        import numpy as np
+        big_arr = np.arange(2 << 20, dtype=np.float32)      # 8 MB
+        big_ref = rt.put(big_arr)
+        mapped = rt.get(big_ref)
+        copied = rt.get(big_ref, copy=True)
+        assert not mapped.flags.writeable       # mapped reads are readonly
+        assert np.array_equal(mapped, copied)   # and bit-identical
+        del mapped, copied
+        GETS = 8
+
+        def get_copy():
+            for _ in range(GETS):
+                rt.get(big_ref, copy=True)
+            return GETS
+
+        def get_mapped():
+            for _ in range(GETS):
+                rt.get(big_ref)
+            return GETS
+        rc_, rm_ = _timeit_ab(get_copy, get_mapped, trials, min_s)
+        record_ab("large_get_copy", "large get (8MB ndarray) copied", rc_)
+        record_ab("large_get_mapped", "large get (8MB ndarray) mapped", rm_)
+        ratios = [m / c for m, c in zip(rm_, rc_)]
+        record_ab("large_get_mapped_speedup",
+                  "large get mapped over copied", ratios, unit="x")
+        del big_ref
+
+    # serve-handoff A/B: a replica-actor's large batch result fetched by
+    # the serving data plane (the BatchQueue._complete shape) — mapped
+    # removes the driver-side memcpy from the handoff
+    handoff_ids = {"serve_handoff_copy", "serve_handoff_mapped",
+                   "serve_handoff_mapped_speedup"}
+    if only is None or handoff_ids & only:
+        import numpy as np
+
+        @rt.remote
+        class _BatchProducer:
+            def __init__(self):
+                import numpy as _np
+                self._out = _np.arange(2 << 20, dtype=_np.float32)  # 8 MB
+
+            def batch(self):
+                return self._out
+
+        prod = _BatchProducer.remote()
+        a = rt.get(prod.batch.remote())
+        b = rt.get(prod.batch.remote(), copy=True)
+        assert np.array_equal(a, b)
+        del a, b
+        CALLS = 5
+
+        def handoff_copy():
+            for _ in range(CALLS):
+                rt.get(prod.batch.remote(), copy=True)
+            return CALLS
+
+        def handoff_mapped():
+            for _ in range(CALLS):
+                rt.get(prod.batch.remote())
+            return CALLS
+        hc, hm = _timeit_ab(handoff_copy, handoff_mapped, trials, min_s)
+        record_ab("serve_handoff_copy",
+                  "serve handoff (8MB actor result) copied", hc)
+        record_ab("serve_handoff_mapped",
+                  "serve handoff (8MB actor result) mapped", hm)
+        ratios = [m / c for m, c in zip(hm, hc)]
+        record_ab("serve_handoff_mapped_speedup",
+                  "serve handoff mapped over copied", ratios, unit="x")
 
     # wait() fan-out: N outstanding tasks collected through rt.wait
     if want("wait_fanout"):
@@ -373,13 +496,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                                                  min_s=args.min_s,
                                                  quiet=args.quiet)
     if args.save:
-        if args.serve or args.decode:
-            # bench-noise protocol for the bimodal shared hosts: the
-            # recorded serve/decode floors are the MIN across
-            # interleaved rounds, not the mean — a gate floor set off a
-            # fast-phase mean fails spuriously in the slow phase
-            for r in rows:
-                r.value = float(r.extra.get("min", r.value))
+        # bench-noise protocol for the bimodal shared hosts: rows that
+        # carry per-round minima (all serve/decode rows, the runtime
+        # suite's interleaved A/B rows) record the MIN across rounds as
+        # their floor, not the mean — a gate floor set off a fast-phase
+        # mean fails spuriously in the slow phase
+        for r in rows:
+            r.value = float(r.extra.get("min", r.value))
         save_baseline(rows, args.save, num_workers=args.workers)
         print(f"baseline -> {args.save}")
     if args.check:

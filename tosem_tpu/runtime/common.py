@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import pickle
 from dataclasses import dataclass
-from typing import Any, Optional
+from typing import Any, Optional, Tuple
 
 import cloudpickle
 
@@ -202,12 +202,22 @@ def dumps_parts(value: Any):
     return _P5, [header] + [b.raw() for b in buffers]
 
 
-def loads_parts(kind: int, parts) -> Any:
+def loads_parts(kind: int, parts, copy: bool = True) -> Any:
+    """Inverse of :func:`dumps_parts`.
+
+    ``copy=True`` (default) copies every buffer onto the heap — the
+    result never aliases the source parts. ``copy=False`` hands the
+    buffers to pickle AS-IS (zero-copy): callers pass READONLY
+    memoryviews over PINNED shm pages (see ``store_get_value``'s mapped
+    path), so unpickled ndarrays alias the segment with
+    ``writeable=False`` and in-place mutation raises. RAW payloads are
+    ``bytes`` either way (the type contract)."""
     if kind == _RAW:
         return bytes(parts[0])
-    # copy the buffers out: the result must not alias evictable shm pages
-    return pickle.loads(bytes(parts[0]),
-                        buffers=[bytes(p) for p in parts[1:]])
+    if copy:
+        return pickle.loads(bytes(parts[0]),
+                            buffers=[bytes(p) for p in parts[1:]])
+    return pickle.loads(bytes(parts[0]), buffers=parts[1:])
 
 
 def store_put_parts(store, oid, kind: int, parts) -> None:
@@ -243,6 +253,14 @@ def robust_store_put_parts(store, oid, kind, parts) -> None:
             store_put_parts(store, oid, kind, parts)
             return
         except ObjectStoreError as e:
+            if e.code == -3:
+                # store full with nothing evictable: every resident byte
+                # is pinned by live mappings — wait-with-deadline for
+                # pins to drop instead of failing the task outright
+                if _time.monotonic() > deadline:
+                    raise
+                _time.sleep(0.02)
+                continue
             if e.code != -1:
                 raise
         state = store.is_sealed(oid)
@@ -257,22 +275,53 @@ def robust_store_put_parts(store, oid, kind, parts) -> None:
                                 f"stuck mid-write")
 
 
-def store_get_value(store, oid):
-    """→ (found, value); copying read of the parts layout."""
-    view = store.get_view(oid)
-    if view is None:
+def split_parts(view) -> Tuple[int, list]:
+    """Parse the ``[u32 kind][u32 n][u64 sizes…][parts…]`` store layout
+    into ``(kind, [part views])`` — slices of ``view``, zero-copy. The
+    single parser behind both read paths of :func:`store_get_value`."""
+    kind, n = _struct.unpack_from("<II", view, 0)
+    sizes = _struct.unpack_from(f"<{n}Q", view, 8)
+    off = 8 + 8 * n
+    parts = []
+    for s in sizes:
+        parts.append(view[off:off + s])
+        off += s
+    return kind, parts
+
+
+def store_get_value(store, oid, copy: bool = True):
+    """→ (found, value); read of the parts layout.
+
+    ``copy=True``: heap-copying read (today's semantics — safe for
+    callers that mutate the result). ``copy=False``: mapped-in-place
+    read — pickle-5 buffer parts are READONLY memoryviews aliasing the
+    object's shm pages, held alive (and the object pinned against
+    eviction/spill) by the unpickled arrays themselves via the
+    :class:`~tosem_tpu.runtime.object_store.MappedHandle` machinery.
+    RAW payloads copy either way (``bytes`` contract) and drop the pin
+    immediately."""
+    if copy:
+        view = store.get_view(oid)
+        if view is None:
+            return False, None
+        try:
+            kind, parts = split_parts(view)
+            return True, loads_parts(kind, parts)
+        finally:
+            store.release(oid)
+    handle = store.get_mapped(oid)
+    if handle is None:
         return False, None
-    try:
-        kind, n = _struct.unpack_from("<II", view, 0)
-        sizes = _struct.unpack_from(f"<{n}Q", view, 8)
-        off = 8 + 8 * n
-        parts = []
-        for s in sizes:
-            parts.append(view[off:off + s])
-            off += s
-        return True, loads_parts(kind, parts)
-    finally:
-        store.release(oid)
+    kind, parts = split_parts(handle.view)
+    if kind == _RAW:
+        try:
+            return True, bytes(parts[0])
+        finally:
+            del parts
+            handle.release()
+    # zero-copy: the readonly slices ride into the unpickled value; the
+    # pin rides the slices (released by GC when the last array dies)
+    return True, loads_parts(kind, parts, copy=False)
 
 
 def parts_nbytes(parts) -> int:

@@ -791,15 +791,7 @@ class Runtime:
         kind, parts = common.dumps_parts(value)
         ref = self._new_ref()
         if common.parts_nbytes(parts) > common.INLINE_THRESHOLD:
-            try:
-                common.store_put_parts(self.store, ref.oid, kind, parts)
-            except ObjectStoreError as e:
-                if e.code != -3:
-                    raise
-                # store full: demote cold objects to the disk spill tier
-                # and retry once — pressure becomes slow, not fatal
-                self.spill_under_pressure(target_fraction=0.25)
-                common.store_put_parts(self.store, ref.oid, kind, parts)
+            self._store_put_pressure(ref.oid, kind, parts)
             with self.lock:
                 self.in_store.add(ref.oid.binary)
         else:
@@ -808,7 +800,49 @@ class Runtime:
                     (kind, [bytes(p) for p in parts])
         return ref
 
-    def get(self, ref: ObjectRef, timeout: Optional[float] = None) -> Any:
+    def _store_put_pressure(self, oid: ObjectID, kind: int, parts,
+                            deadline_s: float = 5.0) -> None:
+        """Store write that turns pressure into slow, not fatal: on a
+        full store demote cold objects to the disk spill tier and retry;
+        when nothing is spillable because every resident byte is PINNED
+        by live mappings, wait-with-deadline for consumers to drop their
+        pins before giving up."""
+        deadline = time.monotonic() + deadline_s
+        while True:
+            try:
+                common.store_put_parts(self.store, oid, kind, parts)
+                return
+            except ObjectStoreError as e:
+                if e.code != -3:
+                    raise
+                if time.monotonic() > deadline:
+                    raise
+                if not self.spill_under_pressure(target_fraction=0.25):
+                    time.sleep(0.02)   # all pinned: wait for pins to drop
+
+    def free(self, refs) -> None:
+        """Explicitly release objects (the ``ray.internal.free`` role):
+        drop the driver-table entry, lineage, and the store copy + spill
+        file NOW instead of waiting for the ObjectRef to be GC'd. A
+        consumer holding a live mapping keeps the pages alive (the store
+        defers the free to the last release). Unlike ref GC, the key
+        gets an :class:`ObjectLostError` tombstone — the caller still
+        HOLDS the ref, so a later ``get`` must raise immediately rather
+        than wait forever for an object nobody will produce. (The
+        tombstone itself dies with the ref's finalizer.) Accepts a
+        single ref or an iterable."""
+        if isinstance(refs, ObjectRef):
+            refs = [refs]
+        for ref in refs:
+            key = ref.oid.binary
+            self._release_oid(key)
+            with self.lock:
+                self.errors[key] = ObjectLostError(
+                    f"object {key.hex()[:12]} was explicitly freed")
+                self.cv.notify_all()
+
+    def get(self, ref: ObjectRef, timeout: Optional[float] = None,
+            copy: bool = False) -> Any:
         key = ref.oid.binary
         # fast path: one lock hold, one dict probe — the overwhelmingly
         # common case of getting an already-resolved inline object (the
@@ -835,7 +869,11 @@ class Runtime:
                     watch += [r.worker for r in self.actors.values()
                               if not r.dead]
             if stored:
-                found, value = common.store_get_value(self.store, ref.oid)
+                # copy=False (default): mapped-in-place read — array
+                # buffers alias the shm pages readonly, pinned against
+                # eviction/spill until the caller's last reference dies
+                found, value = common.store_get_value(self.store, ref.oid,
+                                                      copy=copy)
                 if found:
                     return value
                 # lost from the store (evicted / producing worker died
@@ -1456,10 +1494,14 @@ class Runtime:
                 # chaos: memory-pressure eviction of a sealed result —
                 # a later get() transparently re-executes the producing
                 # task (lineage reconstruction), or raises the typed
-                # ObjectLostError when reconstruction is off/exhausted
+                # ObjectLostError when reconstruction is off/exhausted.
+                # Pressure eviction NEVER takes a pinned object (a live
+                # mapping makes "lost but pinned" impossible by
+                # construction — the eviction just picks another victim,
+                # here: skips), so reconstruction can't race a consumer
                 try:
-                    self.store.delete(ObjectID(rkey))
-                    self._evicted.add(rkey)
+                    if self.store.delete_if_unpinned(ObjectID(rkey)):
+                        self._evicted.add(rkey)
                 except Exception:
                     pass
         self._reconstructing.discard(rkey)
@@ -1713,7 +1755,9 @@ class Runtime:
         if rkind == "store" and rkey not in self._reconstructing \
                 and (rkey not in self.in_store or rkey in self._evicted):
             try:
-                self.store.delete(ObjectID(rkey))
+                # pin-safe: a consumer that mapped the re-put object in
+                # the meantime keeps it (identical bytes either way)
+                self.store.delete_if_unpinned(ObjectID(rkey))
             except Exception:
                 pass
         return True

@@ -52,7 +52,14 @@ def _resolve(store_name: str, store_box: list, obj: Any) -> Any:
     """Replace top-level StoreRef/InlineParts markers with values."""
     if isinstance(obj, common.StoreRef):
         store = _attach(store_name, store_box)
-        found, value = common.store_get_value(store, ObjectID(obj.binary))
+        # mapped-in-place arg fetch (copy=False): large-arg ndarrays
+        # alias the shm pages READONLY for the duration of the task —
+        # the pin (which blocks eviction/spill) rides the arrays and
+        # drops when the task's last reference dies. Tasks that need a
+        # mutable copy own that copy (np.array(arg)), like the
+        # reference's plasma-backed args.
+        found, value = common.store_get_value(store, ObjectID(obj.binary),
+                                              copy=False)
         if not found:
             # typed so the driver can reconstruct the dep and requeue
             # this task instead of surfacing a TaskError
@@ -162,6 +169,10 @@ def worker_main(conn, store_name: str) -> None:
                 kwargs = {k: _resolve(store_name, store_box, v)
                           for k, v in kwargs.items()}
                 value = fns[fn_id](*args, **kwargs)
+                # drop mapped-arg pins the result does not alias BEFORE
+                # the result put: in a near-full store the task's own
+                # pinned args must not block its result's allocation
+                del args, kwargs
                 emit(_make_result(store_name, store_box, tid,
                                   result_binary, value))
             except BaseException as e:  # noqa: BLE001 — ship to driver
@@ -215,6 +226,7 @@ def worker_main(conn, store_name: str) -> None:
                 kwargs = {k: _resolve(store_name, store_box, v)
                           for k, v in kwargs.items()}
                 value = getattr(actor, method)(*args, **kwargs)
+                del args, kwargs   # as in the task path: unpin pre-put
                 emit(_make_result(store_name, store_box, tid,
                                   result_binary, value))
             except BaseException as e:  # noqa: BLE001

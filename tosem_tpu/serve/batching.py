@@ -473,7 +473,11 @@ class BatchQueue:
             try:
                 remaining = (None if deadline is None
                              else max(deadline - time.monotonic(), 0.001))
-                outcomes = rt.get(ref, timeout=remaining)
+                # single-memcpy result handoff: a batch result above the
+                # inline threshold rides a store handle and is mapped in
+                # place here — item values scattered to futures alias
+                # the (pinned, readonly) shm pages, no heap copy
+                outcomes = rt.get(ref, timeout=remaining, copy=False)
                 if (not isinstance(outcomes, list)
                         or len(outcomes) != len(items)):
                     raise TaskError(RuntimeError(
@@ -998,8 +1002,11 @@ class DecodeQueue:
         import tosem_tpu.runtime as rt
         try:
             if result is None:
+                # mapped handoff: a large final payload (logits/tokens)
+                # comes back as readonly views over the store, pinned
+                # until the caller drops it
                 result = rt.get(item.replica.result.remote(item.seq_id),
-                                timeout=60.0)
+                                timeout=60.0, copy=False)
             # release is fire-and-forget: nothing waits on page frees,
             # the next step's extend sees them (actor FIFO ordering)
             item.replica.release.remote(item.seq_id)

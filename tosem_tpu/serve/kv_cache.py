@@ -71,7 +71,15 @@ class RuntimeSpillStore:
     """Spill backend over the runtime object plane: payloads become
     store objects, inheriting the PR-2 disk-spill tier (cold payloads
     demote to disk transparently) and its failure modes (an evicted,
-    unreconstructible payload raises — mapped to PagesLostError)."""
+    unreconstructible payload raises — mapped to PagesLostError).
+
+    Single-memcpy each way: ``put`` writes the page ndarrays as raw
+    pickle-5 store parts (one reserve/seal memcpy into shm), ``get``
+    maps them back IN PLACE (``copy=False`` — the restore scatters
+    straight from the pinned shm pages into the pools, no intermediate
+    heap copy), and ``drop`` routes to ``rt.free`` so a retired or
+    restored sequence's payload is reclaimed NOW (store + spill file)
+    instead of leaking until driver ref GC."""
 
     def put(self, payload: Any):
         import tosem_tpu.runtime as rt
@@ -81,12 +89,14 @@ class RuntimeSpillStore:
         import tosem_tpu.runtime as rt
         from tosem_tpu.runtime.common import ObjectLostError
         try:
-            return rt.get(ref, timeout=30.0)
+            return rt.get(ref, timeout=30.0, copy=False)
         except (ObjectLostError, TimeoutError) as e:
             raise PagesLostError(f"KV spill payload lost: {e}") from e
 
     def drop(self, ref) -> None:
-        pass                      # store lifetime owns reclamation
+        import tosem_tpu.runtime as rt
+        if rt.is_initialized():
+            rt.free(ref)
 
 
 def default_spill_store():

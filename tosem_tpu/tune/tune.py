@@ -316,10 +316,13 @@ def run(trainable, config_space: Dict[str, Any], *, metric: str, mode: str,
             if decision == STOP:
                 finish(t, TERMINATED)
                 continue
-            # periodic checkpoint for failure recovery + PBT exploit source
+            # periodic checkpoint for failure recovery + PBT exploit
+            # source. copy=True: the snapshot is RETAINED for the
+            # trial's lifetime — a mapped read would pin store capacity
+            # per live trial and starve later puts
             if checkpoint_freq and t.iteration % checkpoint_freq == 0:
                 try:
-                    t.snapshot = rt.get(t.handle.save.remote())
+                    t.snapshot = rt.get(t.handle.save.remote(), copy=True)
                 except Exception:
                     pass
             directive = None

@@ -57,6 +57,13 @@ LEGS = [
     # results/flash_blocks.json, so the bert_kernels MFU rows (the
     # verdict-gated evidence) measure with tuned blocks
     ("flash_autotune", CLI + ["--config=flash_autotune"], 2400),
+    # focused decode page-size sweep right behind the block sweep: the
+    # pages cache section has only ever carried CPU-smoke winners (the
+    # flash_autotune leg reaches its pages half last, so tunnel flaps
+    # kept eating it) — a short dedicated leg lands on-chip page winners
+    # for select_page_size/BertDecodeBackend even in a narrow window
+    ("autotune_decode_pages", CLI + ["--config=autotune_decode_pages"],
+     1200),
     _north_star_leg("bert_kernels"),
     _north_star_leg("resnet_train"),
     _north_star_leg("bert_train"),
