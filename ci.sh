@@ -31,16 +31,17 @@ echo "== byte-compile (syntax gate)"
 python -m compileall -q tosem_tpu tests examples bench.py __graft_entry__.py
 
 chaos_smoke() {
-  # fast chaos smoke: 6 canned fault plans, fixed seeds (<2.5 min) — the
+  # fast chaos smoke: 7 canned fault plans, fixed seeds (<3 min) — the
   # runtime/serve/tune failure paths AND the recovery layer (lineage
   # reconstruction of an evicted object, node-kill resubmission,
-  # KV-page eviction + replica crash mid-decode) run on every PR, not
-  # just when a chaos test file is touched (see tosem_tpu/chaos/); the
-  # recovery plans gate on zero surfaced errors — the workload must
-  # HEAL, not merely fail loudly
-  echo "== chaos smoke (6 canned fault plans, fixed seeds)"
+  # KV-page eviction + replica crash mid-decode, router + replica-node
+  # kill under cluster-serve traffic) run on every PR, not just when a
+  # chaos test file is touched (see tosem_tpu/chaos/); the recovery
+  # plans gate on zero surfaced errors — the workload must HEAL, not
+  # merely fail loudly
+  echo "== chaos smoke (7 canned fault plans, fixed seeds)"
   for plan in worker-carnage serve-flap trial-crash \
-              evict-heal node-kill-heal decode-chaos; do
+              evict-heal node-kill-heal decode-chaos router-chaos; do
     JAX_PLATFORMS=cpu python -m tosem_tpu.cli chaos --plan "$plan"
   done
 }
@@ -82,6 +83,19 @@ perf_smoke() {
   if ! JAX_PLATFORMS=cpu "${dcmd[@]}"; then
     echo "== perf smoke: decode regression reported; one retry (noisy host?)"
     JAX_PLATFORMS=cpu "${dcmd[@]}"
+  fi
+  # cluster serving plane: the multi-process closed-loop bench — router
+  # tier vs single-process serve, plus the node-kill failover leg
+  # (in-bench hard asserts: zero lost logical requests, full
+  # re-placement, no catastrophic (<0.5x) throughput collapse; the
+  # recovery level itself is held by the gated row's floor below)
+  echo "== perf smoke (cluster microbench vs results/bench_cluster.json)"
+  local ccmd=(python -m tosem_tpu.cli microbench --cluster --trials 2
+              --min-s 0.4 --quiet --only gated
+              --check results/bench_cluster.json --threshold 0.30)
+  if ! JAX_PLATFORMS=cpu "${ccmd[@]}"; then
+    echo "== perf smoke: cluster regression reported; one retry (noisy host?)"
+    JAX_PLATFORMS=cpu "${ccmd[@]}"
   fi
 }
 

@@ -53,6 +53,18 @@ _LAZY_EXPORTS = {
     "DecodePolicy": ("tosem_tpu.serve.batching", "DecodePolicy"),
     "select_page_size": ("tosem_tpu.ops.flash_blocks",
                          "select_page_size"),
+    # cluster serving plane (round 8): node-spanning deployments behind
+    # the replicated router tier, with placement + node-death failover
+    "ClusterServe": ("tosem_tpu.serve.cluster_serve", "ClusterServe"),
+    "ClusterHandle": ("tosem_tpu.serve.cluster_serve", "ClusterHandle"),
+    "PlacementError": ("tosem_tpu.serve.cluster_serve",
+                       "PlacementError"),
+    "RouterPolicy": ("tosem_tpu.serve.router", "RouterPolicy"),
+    "NoReplicaAvailable": ("tosem_tpu.serve.router",
+                           "NoReplicaAvailable"),
+    "ShardedAttentionBackend": ("tosem_tpu.serve.backends",
+                                "ShardedAttentionBackend"),
+    "dp_tp_mesh": ("tosem_tpu.parallel.flash", "dp_tp_mesh"),
 }
 
 __all__ = sorted(_LAZY_EXPORTS)

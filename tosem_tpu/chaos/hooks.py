@@ -17,6 +17,8 @@ runtime.result      "done" message drained from a worker    drop_result,
 runtime.store       large result sealed into the store      evict_object
 serve.dispatch      request routed to a replica             crash_replica,
                                                             slow_replica
+serve.route         request routed via a ClusterHandle      kill_router,
+                                                            kill_node
 tune.step           trial step result processed             crash_trial
 cluster.submit      NodePool routes work to a node agent    kill_node
 train.step          trainer fit() finished one step         preempt

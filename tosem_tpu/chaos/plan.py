@@ -29,8 +29,8 @@ from typing import Any, Dict, List, Optional
 # inject.
 VALID_SITES = (
     "runtime.dispatch", "runtime.result", "runtime.store",
-    "serve.dispatch", "serve.decode_step", "tune.step", "cluster.submit",
-    "train.step",
+    "serve.dispatch", "serve.decode_step", "serve.route", "tune.step",
+    "cluster.submit", "train.step",
 )
 
 VALID_ACTIONS = {
@@ -41,6 +41,12 @@ VALID_ACTIONS = {
     # fired once per decode-scheduler iteration: evict_pages spills the
     # coldest active sequence's KV pages out of the pool mid-decode
     "serve.decode_step": ("evict_pages", "slow_step"),
+    # fired per client request routed through a ClusterHandle:
+    # kill_router SIGKILLs the first live router process (the client
+    # must fail over), kill_node SIGKILLs a node hosting one of the
+    # deployment's replicas and declares it dead (the controller must
+    # re-place, the routers must re-admit in-flight requests)
+    "serve.route": ("kill_router", "kill_node"),
     "tune.step": ("crash_trial",),
     "cluster.submit": ("kill_node",),
     "train.step": ("preempt",),
@@ -179,6 +185,16 @@ def _canned() -> Dict[str, FaultPlan]:
         "decode-chaos": FaultPlan(seed=37, name="decode-chaos", faults=[
             Fault(site="serve.decode_step", action="evict_pages", at=2),
             Fault(site="serve.dispatch", action="crash_replica", at=9),
+        ]),
+        # the cluster-serving acceptance plan: kill a ROUTER mid-traffic
+        # (clients must fail over to the surviving router), then kill a
+        # REPLICA NODE a few requests later (the controller must
+        # re-place its replicas on the survivor and the routers must
+        # re-admit from step 0) — bounded error budget: zero
+        # client-surfaced errors, every response correct
+        "router-chaos": FaultPlan(seed=43, name="router-chaos", faults=[
+            Fault(site="serve.route", action="kill_router", at=6),
+            Fault(site="serve.route", action="kill_node", at=14),
         ]),
         # the self-healing acceptance plan: a live object evicted, a
         # worker killed mid-task, AND a node agent killed — one run,

@@ -405,6 +405,60 @@ def _scenario_decode(chaos: ChaosController,
         rt.shutdown()
 
 
+def _scenario_router(chaos: ChaosController,
+                     rep: SurvivalReport) -> None:
+    """The cluster-serving acceptance run: 24 requests through the
+    router tier (2 router processes over 2 node agents × replica each)
+    while the plan kills a router mid-traffic and then a replica node.
+    Bounded error budget: ZERO client-surfaced errors — the handle
+    fails over routers, the routers re-admit in-flight requests on
+    survivors, and the controller re-places the dead node's replicas
+    (journal-logged, same replica ids)."""
+    from tosem_tpu.cluster.node import RemoteNode
+    from tosem_tpu.cluster.supervisor import NodePool
+    from tosem_tpu.serve.cluster_serve import ClusterServe
+    pool = NodePool(miss_threshold=1, probe_timeout=3.0)
+    cs = None
+    try:
+        for i in range(2):
+            pool.add_node(RemoteNode.spawn_local(num_workers=2),
+                          name=f"n{i}")
+        cs = ClusterServe(pool, num_routers=2, router_procs=True)
+        dep = cs.deploy("echo", "tosem_tpu.chaos.runner:_EchoBackend",
+                        num_replicas=2, strategy="spread")
+        h = cs.get_handle("echo")
+        ok = errors = 0
+        for i in range(24):
+            try:
+                if h.call({"i": i}) == {"echo": {"i": i}}:
+                    ok += 1
+            except BaseException:
+                errors += 1
+        inj = chaos.injections("serve.route")
+        rep.counts["requests"] = 24
+        rep.counts["requests_ok"] = ok
+        rep.counts["errors_surfaced"] = errors
+        rep.counts["routers_killed"] = len(
+            [e for e in inj if e["action"] == "kill_router"])
+        rep.counts["nodes_killed"] = len(
+            [e for e in inj if e["action"] == "kill_node"])
+        rep.counts["replicas_live"] = len(dep.replicas)
+        rep.counts["nodes_surviving"] = len(pool.live_nodes())
+        rep.ok = (errors == 0 and ok == 24
+                  and rep.counts["routers_killed"] >= 1
+                  and rep.counts["nodes_killed"] >= 1
+                  and rep.counts["nodes_surviving"] >= 1
+                  and rep.counts["replicas_live"] >= 1)
+        if errors:
+            rep.notes.append(f"{errors} requests surfaced errors "
+                             "(budget is zero: handle failover + router "
+                             "re-admission must absorb both kills)")
+    finally:
+        if cs is not None:
+            cs.close()
+        pool.close(close_nodes=True)
+
+
 SCENARIOS: Dict[str, Callable[[ChaosController, SurvivalReport], None]] = {
     "worker-carnage": _scenario_runtime,
     "serve-flap": _scenario_serve,
@@ -415,6 +469,7 @@ SCENARIOS: Dict[str, Callable[[ChaosController, SurvivalReport], None]] = {
     "train-preempt": _scenario_train_preempt,
     "state-plane-survival": _scenario_state_plane,
     "decode-chaos": _scenario_decode,
+    "router-chaos": _scenario_router,
 }
 
 

@@ -28,7 +28,7 @@ CONFIGS = ("gemm", "timing_check", "conv_sweep", "allreduce",
            "resnet_train", "bert_kernels", "bert_train",
            "flash_autotune", "autotune_decode_pages", "detection_train",
            "detection_infer", "pointpillars_infer", "speech_train",
-           "serve_bench", "decode_bench", "analysis")
+           "serve_bench", "decode_bench", "cluster_bench", "analysis")
 
 
 def make_flags() -> FlagSet:
@@ -933,6 +933,19 @@ def run_decode_bench(fs: FlagSet) -> List[Any]:
     return rows
 
 
+def run_cluster_bench(fs: FlagSet) -> List[Any]:
+    """Cluster serving microbench as a capture-harness leg: 2 nodes × 2
+    replicas behind the router tier vs the single-process data plane,
+    the node-kill failover leg, and the sharded dp×tp parity pin (see
+    :mod:`tosem_tpu.serve.bench_cluster`). Rows land under the
+    ``cluster_bench`` config."""
+    from tosem_tpu.serve.bench_cluster import run_cluster_benchmarks
+    rows = run_cluster_benchmarks(trials=2, min_s=0.4)
+    for r in rows:
+        r.config = "cluster_bench"
+    return rows
+
+
 def run_analysis(fs: FlagSet) -> List[Any]:
     """Study analysis layer (L8): classify this repo's test suite into the
     RQ3/RQ4 taxonomy and correlate the bench CSVs — the consumer role of
@@ -1006,6 +1019,7 @@ RUNNERS = {
     "speech_train": run_speech_train,
     "serve_bench": run_serve_bench,
     "decode_bench": run_decode_bench,
+    "cluster_bench": run_cluster_bench,
     "analysis": run_analysis,
 }
 

@@ -36,6 +36,71 @@ def _run_blob(blob: bytes) -> bytes:
     return pickle.dumps(fn(*args, **kwargs))
 
 
+def read_announce(fd: int, timeout: float) -> bytes:
+    """Read one announce line ("host:port\\n") from a child's pipe,
+    select-bounded so a wedged child (stuck import, bind deadlock)
+    cannot block past ``timeout``. Closes ``fd``. Returns the raw line
+    (possibly without its newline when the child died or timed out —
+    callers check ``endswith(b"\\n")``). Shared by the agent bootstrap,
+    the serve replica plane, and the router tier."""
+    import select
+    line = b""
+    deadline = time.monotonic() + timeout
+    try:
+        while not line.endswith(b"\n"):
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            ready, _, _ = select.select([fd], [], [], remaining)
+            if not ready:
+                break
+            chunk = os.read(fd, 256)
+            if not chunk:
+                break                    # EOF: child died pre-announce
+            line += chunk
+    finally:
+        os.close(fd)
+    return line
+
+
+# resolved at MODULE import, never inside preexec_fn: the fn runs in
+# the forked child of a multithreaded parent, where an `import` can
+# deadlock on the import lock another thread held at fork time
+try:
+    import ctypes as _ctypes
+    _LIBC = _ctypes.CDLL("libc.so.6", use_errno=True)
+except Exception:                # non-Linux: orphans are close()'s job
+    _LIBC = None
+
+
+def die_with_parent():
+    """preexec_fn: SIGKILL this child when its parent dies (Linux
+    PR_SET_PDEATHSIG). A node agent SIGKILLed by chaos (or a crashed
+    driver) must take its replica/router children with it — on a real
+    node death the machine is gone, and the single-host simulation has
+    to match, or every bench/chaos run leaks orphan replica processes
+    that still answer on their old ports. (Belt only — some sandbox
+    kernels never deliver PDEATHSIG; the lifeline pipe is the
+    suspenders.) Async-signal-safe by construction: no imports, no
+    allocation-heavy work — just the prctl syscall."""
+    if _LIBC is not None:
+        try:
+            _LIBC.prctl(1, 9)    # PR_SET_PDEATHSIG = 1, SIGKILL = 9
+        except Exception:
+            pass
+
+
+def _with_device_count(flags: str, n: int) -> str:
+    """Pin ``--xla_force_host_platform_device_count`` in an XLA_FLAGS
+    string, replacing any inherited value (the CI conftest exports an
+    8-device flag that would otherwise shadow a sharded replica's
+    dp*tp request)."""
+    kept = [f for f in flags.split()
+            if not f.startswith("--xla_force_host_platform_device_count")]
+    kept.append(f"--xla_force_host_platform_device_count={n}")
+    return " ".join(kept)
+
+
 class _AgentHandlers:
     """RPC surface of one node (the NodeManagerService analog)."""
 
@@ -63,6 +128,12 @@ class _AgentHandlers:
         self._trials: Dict[str, Dict[str, Any]] = {}
         self._trials_lock = threading.Lock()
         self._trial_dir = tempfile.mkdtemp(prefix="agent_trials_")
+        # serve replica plane: long-lived backend processes this node
+        # hosts for the cluster serving tier (each is its own RpcServer
+        # the router tier talks to directly — the agent only does
+        # lifecycle, like a raylet hosting replica workers)
+        self._sreps: Dict[str, Dict[str, Any]] = {}
+        self._sreps_lock = threading.Lock()
         # drain state: an unhealthy node stops taking new work but lets
         # in-flight work finish, so callers fail fast instead of hanging
         self._draining = False
@@ -102,11 +173,22 @@ class _AgentHandlers:
             active_trials = sum(
                 1 for t in self._trials.values()
                 if t["status"] in ("WAITING", "RUNNING"))
+        with self._sreps_lock:
+            live = [r for r in self._sreps.values()
+                    if r["proc"].poll() is None]
+            # capacity metadata the placement layer plans against:
+            # unsharded replicas weigh one slot; sharded (gang) replicas
+            # hold their dp*tp slots through the task-plane reservation
+            # their driver took, so they are NOT double-counted here
+            replica_slots = sum(1 for r in live if not r["devices"])
         return {"num_workers": self._num_workers,
                 "tasks_done": self._tasks_done,
                 "reserved_slots": reserved,
                 "free_slots": self._num_workers - reserved,
-                "active_trials": active_trials}
+                "active_trials": active_trials,
+                "replicas_active": len(live),
+                "replica_slots_free": max(
+                    0, self._num_workers - reserved - replica_slots)}
 
     # -- gang slots ----------------------------------------------------
 
@@ -338,14 +420,121 @@ class _AgentHandlers:
             proc.kill()
         return True
 
+    # -- serve replica plane --------------------------------------------
+
+    def start_replica(self, replica_id: str, backend_ref: str,
+                      init_kwargs_json: str = "{}", devices: int = 0,
+                      startup_timeout: float = 120.0) -> str:
+        """Spawn a long-lived serve replica process hosting
+        ``backend_ref`` ("module:qualname") and return its RPC address.
+        Idempotent per id while the process lives (a re-placement retry
+        must not leak a second process). ``devices`` > 0 pins that many
+        virtual XLA host devices before the backend imports jax — the
+        dp*tp mesh of a sharded replica."""
+        if self._draining:
+            raise NodeDrainingError(
+                "node agent is draining; rejecting new replicas")
+        with self._sreps_lock:
+            prior = self._sreps.pop(replica_id, None)
+            if prior is not None and prior["proc"].poll() is None:
+                self._sreps[replica_id] = prior
+                return prior["address"]
+        if prior is not None:
+            # dead prior under the same id: its lifeline write end is
+            # ours to close, or crash/re-place cycles leak one fd each
+            try:
+                os.close(prior["lifeline"])
+            except OSError:
+                pass
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        # the agent's sys.path (repo root + --path extras) must reach
+        # the replica, or the backend is not importable there
+        env["PYTHONPATH"] = os.pathsep.join(p for p in sys.path if p)
+        if devices:
+            env["XLA_FLAGS"] = _with_device_count(
+                env.get("XLA_FLAGS", ""), int(devices))
+        errp = os.path.join(self._trial_dir, f"rep_{replica_id}.err")
+        r, w = os.pipe()
+        # lifeline: the replica blocks on the read end; THIS process
+        # holds the write end, so the replica exits on our death
+        # however it happens (SIGKILL included — PDEATHSIG alone is
+        # not deliverable on every kernel this runs under)
+        life_r, life_w = os.pipe()
+        with open(errp, "wb") as errf:
+            proc = subprocess.Popen(
+                [sys.executable, "-c",
+                 "from tosem_tpu.serve.replica_worker import main; main()",
+                 "--backend", backend_ref,
+                 "--init-kwargs", init_kwargs_json,
+                 "--announce-fd", str(w),
+                 "--lifeline-fd", str(life_r)],
+                pass_fds=(w, life_r), env=env,
+                preexec_fn=die_with_parent,
+                stdout=subprocess.DEVNULL, stderr=errf)
+        os.close(w)
+        os.close(life_r)
+        line = read_announce(r, startup_timeout)
+        if not line.endswith(b"\n"):
+            proc.kill()
+            proc.wait()
+            os.close(life_w)
+            err = b""
+            if os.path.exists(errp):
+                with open(errp, "rb") as f:
+                    err = f.read()
+            raise RuntimeError(
+                f"replica {replica_id!r} failed to announce within "
+                f"{startup_timeout}s: {err[-500:].decode(errors='replace')}")
+        address = line.decode().strip()
+        with self._sreps_lock:
+            self._sreps[replica_id] = {"proc": proc, "address": address,
+                                       "devices": int(devices),
+                                       "backend_ref": backend_ref,
+                                       "lifeline": life_w}
+        return address
+
+    def stop_replica(self, replica_id: str) -> bool:
+        with self._sreps_lock:
+            rec = self._sreps.pop(replica_id, None)
+        if rec is None:
+            return False
+        if rec["proc"].poll() is None:
+            rec["proc"].kill()
+            rec["proc"].wait()
+        try:
+            os.close(rec["lifeline"])
+        except OSError:
+            pass
+        return True
+
+    def list_replicas(self) -> Dict[str, Dict[str, Any]]:
+        """Live view of the replicas this node hosts — what a recovered
+        head asks to re-adopt placements that survived its own crash."""
+        with self._sreps_lock:
+            return {rid: {"address": r["address"],
+                          "alive": r["proc"].poll() is None,
+                          "devices": r["devices"],
+                          "backend_ref": r["backend_ref"]}
+                    for rid, r in self._sreps.items()}
+
     def close(self) -> None:
         self._pool.shutdown(wait=False, cancel_futures=True)
         with self._trials_lock:
             procs = [t["proc"] for t in self._trials.values()
                      if t["proc"] is not None]
+        with self._sreps_lock:
+            procs += [r["proc"] for r in self._sreps.values()]
+            lifelines = [r["lifeline"] for r in self._sreps.values()]
+            self._sreps.clear()
         for p in procs:
             if p.poll() is None:
                 p.kill()
+        for fd in lifelines:
+            try:
+                os.close(fd)
+            except OSError:
+                pass
         import shutil
         shutil.rmtree(self._trial_dir, ignore_errors=True)
 
@@ -470,6 +659,27 @@ class RemoteNode:
     def kill_trial(self, task_id: str) -> bool:
         return bool(self._client.call("kill_trial", task_id))
 
+    # -- serve replica plane -------------------------------------------
+
+    def start_replica(self, replica_id: str, backend_ref: str,
+                      init_kwargs: Optional[Dict[str, Any]] = None,
+                      devices: int = 0,
+                      startup_timeout: float = 120.0) -> str:
+        """Host a serve replica on this node; returns its RPC address."""
+        import json
+        try:
+            return str(self._client.call(
+                "start_replica", replica_id, backend_ref,
+                json.dumps(init_kwargs or {}), devices, startup_timeout))
+        except RpcError as e:
+            raise self._translate(e) from None
+
+    def stop_replica(self, replica_id: str) -> bool:
+        return bool(self._client.call("stop_replica", replica_id))
+
+    def list_replicas(self) -> Dict[str, Dict[str, Any]]:
+        return self._client.call("list_replicas")
+
     # -- lifecycle -----------------------------------------------------
 
     @classmethod
@@ -498,23 +708,7 @@ class RemoteNode:
         os.close(w)
         # select-bounded read: a wedged child (stuck import, bind
         # deadlock) must not block past startup_timeout
-        import select
-        line = b""
-        deadline = time.monotonic() + startup_timeout
-        try:
-            while not line.endswith(b"\n"):
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    break
-                ready, _, _ = select.select([r], [], [], remaining)
-                if not ready:
-                    break
-                chunk = os.read(r, 256)
-                if not chunk:
-                    break                    # EOF: child died pre-announce
-                line += chunk
-        finally:
-            os.close(r)
+        line = read_announce(r, startup_timeout)
         if not line.endswith(b"\n"):
             proc.kill()
             proc.wait()

@@ -94,11 +94,16 @@ class HeadJournal:
     @staticmethod
     def reconcile(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         """Replay the journal into the head's last known state:
-        registered node addresses, work submitted-but-not-finished, and
-        trials started-but-not-finished (with their last known node)."""
+        registered node addresses, work submitted-but-not-finished,
+        trials started-but-not-finished (with their last known node),
+        plus the serving control plane — deployments declared and the
+        replica placements live at crash time, so a recovered head can
+        rebuild the routing table (``ClusterServe.recover``)."""
         nodes: Dict[str, str] = {}           # name -> address
         work: Dict[str, Dict[str, Any]] = {}
         trials: Dict[str, Dict[str, Any]] = {}
+        deployments: Dict[str, Dict[str, Any]] = {}
+        placements: Dict[str, Dict[str, Any]] = {}  # replica_id -> event
         for e in events:
             ev = e.get("event")
             if ev == "node_added":
@@ -113,8 +118,19 @@ class HeadJournal:
                 trials[e["trial_id"]] = e
             elif ev in ("trial_done", "trial_failed", "trial_canceled"):
                 trials.pop(e["trial_id"], None)
+            elif ev == "deployment_created":
+                deployments[e["deployment"]] = e
+            elif ev == "deployment_deleted":
+                deployments.pop(e["deployment"], None)
+                placements = {rid: p for rid, p in placements.items()
+                              if p["deployment"] != e["deployment"]}
+            elif ev == "replica_placed":
+                placements[e["replica_id"]] = e
+            elif ev == "replica_removed":
+                placements.pop(e["replica_id"], None)
         return {"nodes": nodes, "outstanding_work": work,
-                "outstanding_trials": trials}
+                "outstanding_trials": trials,
+                "deployments": deployments, "placements": placements}
 
 
 # ------------------------------------------------------ failure detector
@@ -239,6 +255,10 @@ class NodePool:
         self._rr = 0
         self._journal = HeadJournal(journal_path) if journal_path else None
         self._trials: Dict[str, Dict[str, Any]] = {}
+        # node-death listeners beyond the trial plane (the cluster
+        # serving controller re-places a dead node's replicas through
+        # one of these) — called AFTER the pool's own resubmission
+        self._death_listeners: List[Callable[[str, RemoteNode], None]] = []
         self.detector = FailureDetector(
             interval_s=heartbeat_interval_s, miss_threshold=miss_threshold,
             probe_timeout=probe_timeout, on_dead=self._on_node_dead)
@@ -271,6 +291,21 @@ class NodePool:
         if self._journal is not None:
             self._journal.record(event, **fields)
 
+    def record_event(self, event: str, **fields: Any) -> None:
+        """Journal a control event on behalf of a layer composed onto
+        this pool (the serving controller's placements ride the SAME
+        journal, so one ``recover`` rebuilds both planes)."""
+        self._record(event, **fields)
+
+    def add_death_listener(
+            self, fn: Callable[[str, RemoteNode], None]) -> None:
+        """Run ``fn(name, node)`` whenever a node is declared dead,
+        after the pool's own trial resubmission. Listener errors are
+        journaled, never propagated — one broken listener must not
+        stop the detector sweep or other listeners."""
+        with self._lock:
+            self._death_listeners.append(fn)
+
     def _on_node_dead(self, name: str, node: RemoteNode) -> None:
         """Detector callback: drop the corpse and resubmit its trials
         to survivors (same trial id ⇒ checkpoint resume)."""
@@ -278,6 +313,7 @@ class NodePool:
             self._nodes.pop(name, None)
             stranded = [tid for tid, t in self._trials.items()
                         if t["node"] == name and not t.get("terminal")]
+            listeners = list(self._death_listeners)
         self._record("node_removed", name=name, reason="heartbeat")
         for tid in stranded:
             try:
@@ -287,6 +323,12 @@ class NodePool:
                 with self._lock:
                     self._trials[tid]["terminal"] = True
                     self._trials[tid]["error"] = repr(e)
+        for fn in listeners:
+            try:
+                fn(name, node)
+            except Exception as e:
+                self._record("death_listener_error", name=name,
+                             error=repr(e))
 
     # -- task plane ----------------------------------------------------
 
@@ -459,6 +501,12 @@ class NodePool:
                              reason="dead at recovery")
         pool.outstanding_work = state["outstanding_work"]
         pool.outstanding_trials = state["outstanding_trials"]
+        # serving control plane at crash time: deployments declared and
+        # replicas placed — ClusterServe.recover consumes these to
+        # rebuild the routing table (re-adopting replica processes that
+        # outlived the head, re-placing the rest)
+        pool.deployments = state["deployments"]
+        pool.placements = state["placements"]
         return pool
 
     def close(self, close_nodes: bool = False) -> None:

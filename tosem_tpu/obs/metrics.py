@@ -262,6 +262,49 @@ def serve_metrics(registry: Optional[Registry] = None) -> Dict[str, Metric]:
     }
 
 
+def cluster_serve_metrics(registry: Optional[Registry] = None
+                          ) -> Dict[str, Metric]:
+    """The cluster serving plane's instruments — the node/replica-
+    labelled tier above :func:`serve_metrics`' per-deployment gauges.
+    Fed by :class:`~tosem_tpu.serve.router.RouterCore` (each router
+    feeds its OWN process registry) and rolled up driver-side by
+    ``ClusterServe.stats()``, which mirrors router-process counters
+    into the driver registry for one ``/metrics`` scrape surface:
+
+    - ``serve_router_requests_total`` (counter, labels deployment/
+      router/path): logical requests by routing path — ``routed``
+      (affinity or least-loaded pick honored) vs ``spilled``
+      (consistent-hash affinity overridden by queue depth).
+    - ``serve_replica_queue_depth`` (gauge, labels deployment/node/
+      replica): per-replica in-flight depth as last seen by a router.
+    - ``serve_node_queue_depth`` (gauge, labels node): per-node rollup
+      of replica queue depths — the signal node-level autoscaling and
+      the dashboard's hot-node view read.
+    - ``serve_replicas_placed`` (gauge, labels deployment/node):
+      replicas currently placed per (deployment, node) — failover
+      visibly moves this mass off a dead node.
+    """
+    reg = registry or DEFAULT
+    return {
+        "router_requests": reg.counter(
+            "serve_router_requests_total",
+            "logical requests by routing path (routed vs spilled)",
+            labels=("deployment", "router", "path")),
+        "replica_queue_depth": reg.gauge(
+            "serve_replica_queue_depth",
+            "per-replica in-flight request depth (router view)",
+            labels=("deployment", "node", "replica")),
+        "node_queue_depth": reg.gauge(
+            "serve_node_queue_depth",
+            "summed replica queue depth per node (router rollup)",
+            labels=("node",)),
+        "replicas_placed": reg.gauge(
+            "serve_replicas_placed",
+            "replicas currently placed per deployment and node",
+            labels=("deployment", "node")),
+    }
+
+
 class MetricsServer:
     """Tiny /metrics HTTP endpoint (prometheus_exporter.py role)."""
 

@@ -22,6 +22,25 @@ from tosem_tpu.ops.flash_attention import (BlockSizes, SegmentIds,
                                            flash_attention)
 
 
+def dp_tp_mesh(dp: int, tp: int, devices=None) -> Mesh:
+    """Build the conventional ``(dp, tp)`` mesh from available devices
+    — the bring-up step of a sharded serve replica, whose process was
+    spawned with ``dp*tp`` virtual host devices pinned in XLA_FLAGS
+    (``cluster/node.py:start_replica``). Fails loudly when the process
+    has fewer devices than the declared sharding."""
+    import numpy as np
+    devs = list(devices if devices is not None else jax.devices())
+    if dp < 1 or tp < 1:
+        raise ValueError(f"sharding axes must be >= 1, got ({dp}, {tp})")
+    if len(devs) < dp * tp:
+        raise ValueError(
+            f"sharding ({dp}, {tp}) needs {dp * tp} devices, this "
+            f"process has {len(devs)} (was XLA_FLAGS' "
+            "--xla_force_host_platform_device_count set before jax "
+            "imported?)")
+    return Mesh(np.array(devs[:dp * tp]).reshape(dp, tp), ("dp", "tp"))
+
+
 def sharded_flash_attention(mesh: Mesh, *, causal: bool = False,
                             sm_scale: Optional[float] = None,
                             data_axis: str = "dp",

@@ -460,6 +460,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="run the autoregressive-decode benches "
                         "(serve/bench_decode.py) instead — continuous "
                         "batching vs the re-encode baseline")
+    p.add_argument("--cluster", action="store_true",
+                   help="run the cluster serving benches "
+                        "(serve/bench_cluster.py) instead — 2 nodes x 2 "
+                        "replicas behind the router tier vs the single-"
+                        "process data plane, plus the node-kill "
+                        "failover leg")
     p.add_argument("--only", default=None,
                    help="comma-separated bench_id subset, or 'gated' for "
                         "exactly the perf_smoke-gated benches")
@@ -472,6 +478,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     elif args.decode:
         from tosem_tpu.serve.bench_decode import GATED_DECODE_BENCHES
         gated = GATED_DECODE_BENCHES
+    elif args.cluster:
+        from tosem_tpu.serve.bench_cluster import GATED_CLUSTER_BENCHES
+        gated = GATED_CLUSTER_BENCHES
     else:
         gated = GATED_BENCHES
     only = None
@@ -486,6 +495,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         from tosem_tpu.serve.bench_decode import run_decode_benchmarks
         rows = run_decode_benchmarks(trials=args.trials, min_s=args.min_s,
                                      quiet=args.quiet, only=only)
+    elif args.cluster:
+        from tosem_tpu.serve.bench_cluster import run_cluster_benchmarks
+        rows = run_cluster_benchmarks(trials=args.trials,
+                                      min_s=args.min_s,
+                                      quiet=args.quiet, only=only)
     else:
         rows = run_microbenchmarks(num_workers=args.workers,
                                    trials=args.trials,

@@ -14,16 +14,25 @@ from tosem_tpu.serve.backends import BertEncodeBackend
 from tosem_tpu.serve.batching import (BatchedFuture, BatchingReplica,
                                       BatchPolicy, BatchQueue)
 from tosem_tpu.serve.breaker import CircuitBreaker, CircuitOpen
+from tosem_tpu.serve.cluster_serve import (ClusterDeployment,
+                                           ClusterHandle, ClusterServe,
+                                           PlacementError)
 from tosem_tpu.serve.compile_cache import (DEFAULT_COMPILE_CACHE,
                                            CompileCache)
 from tosem_tpu.serve.core import Deployment, Handle, Serve, ServeFuture
 from tosem_tpu.serve.http import HttpIngress
+from tosem_tpu.serve.router import (NoReplicaAvailable, RemoteRouter,
+                                    ReplicaAppError, RouterCore,
+                                    RouterPolicy)
 from tosem_tpu.serve.speech import (CStreamingModel, SpeechBatchBackend,
                                     SpeechStreamBackend, StreamingClient,
                                     greedy_ctc_text)
 
 __all__ = [
     "Serve", "Deployment", "Handle", "ServeFuture", "HttpIngress",
+    "ClusterServe", "ClusterDeployment", "ClusterHandle",
+    "PlacementError", "RouterCore", "RouterPolicy", "RemoteRouter",
+    "NoReplicaAvailable", "ReplicaAppError",
     "CircuitBreaker", "CircuitOpen",
     "BatchPolicy", "BatchQueue", "BatchedFuture", "BatchingReplica",
     "CompileCache", "DEFAULT_COMPILE_CACHE",

@@ -526,3 +526,83 @@ class BertDecodeBackend(CompiledBackendMixin):
         with self._lock:
             out["decode_sequences"] = len(self._seqs)
         return out
+
+
+# ---------------------------------------------------------------------------
+# sharded replicas (cluster serving plane)
+
+
+class ShardedAttentionBackend:
+    """Sharded serve replica: ONE logical replica spanning a dp×tp mesh.
+
+    The cluster serving plane spawns this backend in a process whose
+    virtual device count was pinned to ``dp*tp`` before jax imported
+    (``ClusterServe.deploy(sharding=(dp, tp))`` → gang-reserved agent
+    slots → ``start_replica(devices=dp*tp)``); it builds the
+    conventional mesh and answers requests through
+    :func:`~tosem_tpu.parallel.flash.sharded_flash_attention` — batch
+    split over ``dp``, heads over ``tp``, the per-chip body the
+    unmodified PR-4 streamed kernel.
+
+    Requests are ``{"seed": int}``: the replica derives a deterministic
+    (q, k, v) batch from the seed, so the SAME inputs are computable
+    anywhere — :meth:`reference` runs them through the single-process
+    kernel, and the cluster bench pins the two **bit-identical**
+    (sharding splits batch and heads, never the softmax reduction
+    axis, and block selection depends only on (T, d, dtype))."""
+
+    def __init__(self, dp: int = 1, tp: int = 1, batch: int = 4,
+                 heads: int = 4, seq: int = 128, dim: int = 64,
+                 causal: bool = True, seed: int = 0):
+        from tosem_tpu.parallel.flash import (dp_tp_mesh,
+                                              sharded_flash_attention)
+        if batch % dp:
+            raise ValueError(f"batch={batch} not divisible by dp={dp}")
+        if heads % tp:
+            raise ValueError(f"heads={heads} not divisible by tp={tp}")
+        self.dp, self.tp = dp, tp
+        self.batch, self.heads, self.seq, self.dim = batch, heads, seq, dim
+        self.causal = causal
+        self.seed = seed
+        self._mesh = dp_tp_mesh(dp, tp)
+        self._run = sharded_flash_attention(self._mesh, causal=causal)
+
+    @staticmethod
+    def _qkv(batch: int, heads: int, seq: int, dim: int, req_seed: int):
+        """Deterministic request inputs — pure function of the seed, so
+        replica and reference build byte-equal arrays independently."""
+        import numpy as np
+        rng = np.random.default_rng(0xC1A0 + req_seed)
+        shape = (batch, seq, heads, dim)
+        return (rng.standard_normal(shape, dtype=np.float32),
+                rng.standard_normal(shape, dtype=np.float32),
+                rng.standard_normal(shape, dtype=np.float32))
+
+    def call(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        import numpy as np
+        q, k, v = self._qkv(self.batch, self.heads, self.seq, self.dim,
+                            int(request.get("seed", 0)))
+        out = self._run(q, k, v)
+        return {"out": np.asarray(out),
+                "mesh": [self.dp, self.tp],
+                "devices": int(np.prod(self._mesh.devices.shape))}
+
+    def warmup(self, shapes: Sequence) -> Dict[str, Any]:
+        """Trace + compile the sharded program once (``shapes`` is
+        ignored: this backend serves one static shape)."""
+        self.call({"seed": 0})
+        return {"warmed": 1}
+
+    @classmethod
+    def reference(cls, request: Dict[str, Any], batch: int = 4,
+                  heads: int = 4, seq: int = 128, dim: int = 64,
+                  causal: bool = True):
+        """Single-process reference on the same inputs: the unsharded
+        kernel, no mesh — what a dp×tp response must match bit for
+        bit."""
+        import numpy as np
+        from tosem_tpu.ops.flash_attention import flash_attention
+        q, k, v = cls._qkv(batch, heads, seq, dim,
+                           int(request.get("seed", 0)))
+        return np.asarray(flash_attention(q, k, v, None, causal,
+                                          layout="bthd"))

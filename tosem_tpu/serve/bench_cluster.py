@@ -1,0 +1,293 @@
+"""Cluster serving microbenchmarks — the multi-process closed-loop leg.
+
+The first bench in the repo whose workload spans process trees: 2 node
+agents × 2 replicas each behind the router tier
+(:mod:`tosem_tpu.serve.cluster_serve`), interleaved A/B against the
+single-process serve data plane on the SAME backend (per the
+bench-noise protocol: both arms of a round share the host phase; the
+absolute floors are min-of-rounds).
+
+The acceptance leg is **failover**: a 16-client closed-loop fleet runs
+THROUGH a mid-run node kill — the failure detector declares the node
+dead, the controller re-places its replicas on the survivor under the
+same ids, and routers re-admit in-flight requests from step 0. The
+deterministic criteria are hard asserts: ZERO client-surfaced errors
+(no logical request lost beyond transparent retries) and full
+re-placement off the dead node. Throughput recovery is scored against
+a same-shape CONTROL cluster deployment measured concurrently (the
+only phase control that works here — see the leg's comment for the
+measurement history), hard-failed only below a catastrophic 0.5x
+bound, and recorded as a gated row so the perf gate tracks recovery
+(vs the 1.0 baseline, standard threshold) release over release.
+
+A non-gated parity leg deploys a ``sharding=(1, 2)`` replica (dp×tp
+mesh in its own process, gang-reserved slots) and pins its response
+bit-identical to the single-process kernel on the same inputs — run by
+the full bench (``cli --config=cluster_bench``), skipped under
+``--only gated`` (it pays a jax import + compile in a fresh process).
+
+``python -m tosem_tpu.cli microbench --cluster`` runs it; ``--save`` /
+``--check`` record/gate against ``results/bench_cluster.json`` floors
+in ``ci.sh --perf``.
+"""
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+import time
+from typing import List, Optional
+
+from tosem_tpu.serve.bench_common import (SuiteEmitter, closed_loop,
+                                          paired_loop)
+from tosem_tpu.utils.results import ResultRow
+
+# Gated by ci.sh --perf: absolute throughput floors for both arms (min
+# of rounds) plus the failover recovery ratio (phase-immune: pre and
+# post rounds are adjacent in time). The cluster arm pays two RPC hops
+# per request — its floor documents the cost of crossing process trees,
+# it is NOT expected to beat the in-process data plane on a 2-CPU host.
+GATED_CLUSTER_BENCHES = (
+    "cluster_router_c16", "cluster_single_ref_c16",
+    "cluster_failover_recovery",
+)
+
+DEFAULT_BASELINE = "results/bench_cluster.json"
+
+BACKEND_REF = "tosem_tpu.serve.bench_serve:VectorWorkBackend"
+BACKEND_KW = {"n": 256}
+
+
+def _fleet_with_errors(handle, n_clients: int, duration_s: float):
+    """Closed-loop fleet that RECORDS failures instead of aborting —
+    the failover window's client view. Returns (completed, errors)."""
+    stop = time.perf_counter() + duration_s
+    done = [0] * n_clients
+    errors: List[BaseException] = []
+    lock = threading.Lock()
+
+    def client(i):
+        while time.perf_counter() < stop:
+            try:
+                handle.call({"x": i}, timeout=120.0)
+                done[i] += 1
+            except BaseException as e:
+                with lock:
+                    errors.append(e)
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(n_clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return sum(done), errors
+
+
+def run_cluster_benchmarks(trials: int = 3, min_s: float = 0.5,
+                           quiet: bool = False,
+                           only: Optional[set] = None) -> List[ResultRow]:
+    """Interleaved A/B cluster benches; ``only`` restricts bench_ids."""
+    import tosem_tpu.runtime as rt
+    from tosem_tpu.cluster.node import RemoteNode
+    from tosem_tpu.cluster.supervisor import NodePool
+    from tosem_tpu.serve.bench_serve import VectorWorkBackend
+    from tosem_tpu.serve.cluster_serve import ClusterServe
+    from tosem_tpu.serve.core import Serve
+
+    em = SuiteEmitter("cluster", only)
+
+    own_runtime = not rt.is_initialized()
+    if own_runtime:
+        rt.init(num_workers=2, memory_monitor=False)
+
+    # single-process reference arm: the PR-5 serve data plane, same
+    # backend, 2 in-process replica actors
+    serve = Serve()
+    serve.deploy("bench-ref", VectorWorkBackend, num_replicas=2,
+                 max_retries=1, init_kwargs=dict(BACKEND_KW))
+    h_ref = serve.get_handle("bench-ref")
+
+    # cluster arm: 2 agents × capacity 4 (the survivor must be able to
+    # re-host the victim's replicas), 4 replicas spread 2+2, 2 router
+    # processes — every request crosses two process boundaries
+    journal = os.path.join(tempfile.mkdtemp(prefix="bench_cluster_"),
+                           "head.jsonl")
+    pool = NodePool(journal_path=journal, miss_threshold=1,
+                    probe_timeout=3.0)
+    nodes = [RemoteNode.spawn_local(num_workers=8) for _ in range(2)]
+    for i, n in enumerate(nodes):
+        pool.add_node(n, name=f"n{i}")
+    cs = ClusterServe(pool, num_routers=2, router_procs=True)
+    try:
+        dep = cs.deploy("bench-vec", BACKEND_REF, num_replicas=4,
+                        strategy="spread", init_kwargs=dict(BACKEND_KW))
+        h_cl = cs.get_handle("bench-vec")
+        h_ref.call({"x": 0}, timeout=120.0)       # warm both arms
+        h_cl.call({"x": 0})
+
+        throughput_ids = {"cluster_router_c16", "cluster_single_ref_c16",
+                          "cluster_vs_single"}
+        if only is None or throughput_ids & only:
+            cl16, ref16, ratios = [], [], []
+            for _ in range(max(trials, 1)):
+                # one A/B round: both arms see the same host phase
+                a = closed_loop(h_cl.call, 16, min_s,
+                                lambda i, k: {"x": i})
+                b = closed_loop(h_ref.call, 16, min_s,
+                                lambda i, k: {"x": i}, timeout=60.0)
+                cl16.append(a)
+                ref16.append(b)
+                ratios.append(a / b if b else float("inf"))
+            em.emit("cluster_router_c16",
+                    "cluster serve 16 clients via router tier", cl16)
+            em.emit("cluster_single_ref_c16",
+                    "single-process serve 16 clients reference", ref16)
+            em.emit("cluster_vs_single",
+                    "cluster vs single-process throughput", ratios,
+                    unit="x")
+
+        # ---- failover: node kill under live traffic -------------------
+        if em.want("cluster_failover_recovery"):
+            # pre/post windows are seconds apart on a bimodal host, so
+            # raw throughput is NOT comparable across the kill
+            # (measured 6x phase swings). Recovery is therefore scored
+            # against a CONTROL cluster deployment that shares the
+            # victim arm's whole stack (same backend, replica count,
+            # router tier) but is packed on the surviving node, with
+            # both fleets run CONCURRENTLY over the same wall-clock
+            # window (paired_loop) — a phase flip or GIL convoy hits
+            # both arms in the same milliseconds. Even so, identical
+            # deployments measure up to ~1.3x apart round to round on
+            # this 2-CPU host (driver-GIL scheduling luck), so the
+            # ratio-of-medians is asserted only against a CATASTROPHIC
+            # bound (0.5x: a real failover bug — retry storms, lost
+            # capacity, per-request timeouts — is a 5-100x drop), while
+            # the deterministic acceptance criteria are hard: zero
+            # client-surfaced errors, full re-placement. The recorded
+            # row (capped at 1.0) lets the perf gate track recovery
+            # release over release at the standard threshold.
+            ctrl = cs.deploy("bench-control", BACKEND_REF,
+                             num_replicas=4, strategy="pack",
+                             init_kwargs=dict(BACKEND_KW))
+            h_ctrl = cs.get_handle("bench-control")
+            h_ctrl.call({"x": 0})
+            ctrl_nodes = {r.node for r in ctrl.replicas}
+            # the victim hosts failover-arm replicas but NO control
+            # replicas (the control must ride through the kill intact)
+            victim = next(r.node for r in dep.replicas
+                          if r.node not in ctrl_nodes)
+
+            def paired_ratio():
+                a, b = paired_loop(h_cl.call, h_ctrl.call, 8, min_s,
+                                   lambda i, k: {"x": i})
+                return a, (a / b if b else float("inf"))
+
+            import statistics
+            pre = [paired_ratio() for _ in range(3)]
+            pre_med = statistics.median(r for _, r in pre)
+            live = pool.live_nodes()
+
+            killer_done = threading.Event()
+
+            def killer():
+                # kill mid-window, then drive the detector so death is
+                # DISCOVERED (probe path), not merely announced
+                time.sleep(min_s / 2)
+                live[victim].kill()
+                while victim in pool.live_nodes():
+                    pool.detector.check_once()
+                killer_done.set()
+
+            kt = threading.Thread(target=killer)
+            kt.start()
+            completed, errors = _fleet_with_errors(
+                h_cl, 16, duration_s=max(3.0, 4 * min_s))
+            kt.join()
+            if not killer_done.is_set() or victim in pool.live_nodes():
+                raise RuntimeError("victim node was never declared dead")
+            if errors:
+                raise RuntimeError(
+                    f"{len(errors)} logical requests surfaced errors "
+                    f"across the node kill (first: {errors[0]!r}) — "
+                    "failover must lose nothing beyond transparent "
+                    "retries")
+            survivors = {r.node for r in dep.replicas}
+            if victim in survivors or len(dep.replicas) != 4:
+                raise RuntimeError(
+                    f"replicas not re-placed off {victim}: "
+                    f"{[(r.replica_id, r.node) for r in dep.replicas]}")
+            post = [paired_ratio() for _ in range(3)]
+            post_med = statistics.median(r for _, r in post)
+            recovery = post_med / pre_med if pre_med else 0.0
+            if recovery < 0.5:
+                raise RuntimeError(
+                    f"post-failover victim/control ratio "
+                    f"{post_med:.2f} is {recovery:.2f}x of the "
+                    f"pre-kill median {pre_med:.2f} — below even the "
+                    "catastrophic 0.5x bound; failover is broken, not "
+                    "noisy")
+            # recorded capped at 1.0 ("fully recovered"): an above-1.0
+            # raw ratio (noise favoring the post window) would bake an
+            # unmeetable baseline into the perf gate. Enforcement is
+            # split: the in-bench hard-fail above catches catastrophic
+            # (<0.5x) breakage deterministically, while the >=0.8x
+            # acceptance level is held by this gated row's baseline +
+            # threshold across runs — a single run's ratio is too
+            # noisy on this host to hard-assert 0.8 (identical
+            # deployments measure up to ~1.3x apart)
+            row = em.emit("cluster_failover_recovery",
+                          "post-node-kill throughput vs pre-kill floor",
+                          [min(recovery, 1.0)], unit="x")
+            if row is not None:
+                row.extra.update({
+                    "raw_recovery": round(recovery, 2),
+                    "pre_rounds": [[round(v, 1), round(r, 2)]
+                                   for v, r in pre],
+                    "post_rounds": [[round(v, 1), round(r, 2)]
+                                    for v, r in post],
+                    "killed_node": victim,
+                    "requests_through_kill": completed,
+                    "errors_through_kill": len(errors)})
+            erow = em.emit("cluster_failover_errors",
+                           "client-surfaced errors across node kill",
+                           [float(len(errors))], unit="errors")
+            if erow is not None:
+                erow.extra["completed"] = completed
+            cs.delete("bench-control")
+
+        # ---- sharded parity (not gated: fresh-process jax import) -----
+        if em.want("cluster_sharded_parity"):
+            import numpy as np
+            from tosem_tpu.serve.backends import ShardedAttentionBackend
+            t0 = time.perf_counter()
+            cs.deploy("bench-shard", ShardedAttentionBackend,
+                      num_replicas=1, sharding=(1, 2),
+                      init_kwargs={"batch": 2, "heads": 2, "seq": 128,
+                                   "dim": 64},
+                      warmup_shapes=[0])
+            h_sh = cs.get_handle("bench-shard")
+            out = h_sh.call({"seed": 7})
+            ref = ShardedAttentionBackend.reference(
+                {"seed": 7}, batch=2, heads=2, seq=128, dim=64)
+            got = np.asarray(out["out"])
+            if got.tobytes() != ref.tobytes():
+                raise RuntimeError(
+                    "sharded dp×tp response is not bit-identical to the "
+                    f"single-process reference (max abs diff "
+                    f"{np.abs(got - ref).max()})")
+            row = em.record("cluster_sharded_parity",
+                            "sharded replica bit-identity vs reference",
+                            1.0, 0.0, unit="bool")
+            row.extra.update({"mesh": out["mesh"],
+                              "devices": out["devices"],
+                              "deploy_s": round(time.perf_counter() - t0,
+                                                1)})
+            cs.delete("bench-shard")
+    finally:
+        cs.close()
+        pool.close(close_nodes=True)
+        serve.delete("bench-ref")
+        if own_runtime:
+            rt.shutdown()
+    return em.flush(quiet)

@@ -1,0 +1,143 @@
+"""Shared harness for the serving microbench suites.
+
+The serve, decode, and cluster benches all follow the bench-noise
+protocol for the bimodal shared CI hosts: interleaved A/B rounds (both
+arms of a round see the same host phase), per-round rates recorded so
+``--save`` can floor the baseline at the min across rounds, and the
+speedup ratio computed in-round (phase-immune). The closed-loop client
+fleet and the row/release-line emission were copy-pasted between
+``bench_serve.py`` and ``bench_decode.py``; this module is the single
+copy both (and ``bench_cluster.py``) now ride.
+"""
+from __future__ import annotations
+
+import statistics
+import threading
+import time
+from typing import Any, Callable, List, Optional
+
+from tosem_tpu.utils.results import ResultRow
+
+
+def closed_loop(call: Callable[..., Any], n_clients: int, min_s: float,
+                make_request: Callable[[int, int], Any],
+                count_of: Optional[Callable[[Any], float]] = None,
+                timeout: float = 120.0) -> float:
+    """``n_clients`` threads calling ``call(request, timeout=...)`` in a
+    loop for >= ``min_s`` → completed units per second.
+
+    ``make_request(client_idx, iteration)`` builds each call's payload
+    (fixed-per-client fleets ignore ``iteration``; the decode fleet
+    cycles prompts with it). ``count_of(response)`` weighs a completed
+    call (default 1.0; the token fleets count generated tokens). The
+    first client error aborts the measurement and is re-raised — a
+    bench must never average over silent failures."""
+    stop = time.perf_counter() + min_s
+    counts = [0.0] * n_clients
+    errors: List[BaseException] = []
+
+    def client(i: int) -> None:
+        k = 0
+        try:
+            while time.perf_counter() < stop:
+                out = call(make_request(i, k), timeout=timeout)
+                counts[i] += count_of(out) if count_of is not None else 1.0
+                k += 1
+        except BaseException as e:   # pragma: no cover - surfaced below
+            errors.append(e)
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(n_clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errors:
+        raise errors[0]
+    return sum(counts) / (time.perf_counter() - t0)
+
+
+def paired_loop(call_a: Callable[..., Any], call_b: Callable[..., Any],
+                n_each: int, min_s: float,
+                make_request: Callable[[int, int], Any],
+                timeout: float = 120.0) -> "tuple[float, float]":
+    """Two closed-loop fleets run CONCURRENTLY over the same wall-clock
+    window → (rate_a, rate_b). The strongest phase control this host
+    allows: both arms see the same milliseconds, so a host-phase flip
+    or GIL convoy hits them together — the ratio is a relative-capacity
+    measurement, not a which-window-was-slow lottery. (Sequential A/B
+    windows measure the phase; see the failover leg's history.)"""
+    stop = time.perf_counter() + min_s
+    counts = [0.0, 0.0]
+    lock = threading.Lock()
+    errors: List[BaseException] = []
+
+    def client(arm: int, call, i: int) -> None:
+        c, k = 0, 0
+        try:
+            while time.perf_counter() < stop:
+                call(make_request(i, k), timeout=timeout)
+                c += 1
+                k += 1
+        except BaseException as e:   # pragma: no cover - surfaced below
+            errors.append(e)
+        with lock:
+            counts[arm] += c
+
+    threads = ([threading.Thread(target=client, args=(0, call_a, i))
+                for i in range(n_each)]
+               + [threading.Thread(target=client, args=(1, call_b, i))
+                  for i in range(n_each)])
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errors:
+        raise errors[0]
+    dt = time.perf_counter() - t0
+    return counts[0] / dt, counts[1] / dt
+
+
+class SuiteEmitter:
+    """Row/release-line emission for one bench suite: the ``want``
+    subset filter, the mean±sd row with per-round minima in ``extra``
+    (what ``--save`` floors baselines at), and the quiet-mode line
+    buffer."""
+
+    def __init__(self, suite: str, only: Optional[set] = None):
+        self.suite = suite
+        self.only = only
+        self.rows: List[ResultRow] = []
+        self.lines: List[str] = []
+
+    def want(self, bench_id: str) -> bool:
+        return self.only is None or bench_id in self.only
+
+    def record(self, bench_id: str, name: str, mean: float, sd: float,
+               unit: str = "ops/s") -> ResultRow:
+        from tosem_tpu.runtime.bench_runtime import _record
+        _record(self.rows, self.lines, bench_id, name, mean, sd, unit=unit)
+        self.rows[-1].extra["suite"] = self.suite
+        return self.rows[-1]
+
+    def emit(self, bench_id: str, name: str, vals: List[float],
+             unit: str = "ops/s") -> Optional[ResultRow]:
+        """Per-round values → one row carrying mean, sd, rounds, and
+        the min-of-rounds floor. Skipped (None) when filtered out or
+        empty."""
+        if not self.want(bench_id) or not vals:
+            return None
+        m = statistics.mean(vals)
+        sd = statistics.stdev(vals) if len(vals) > 1 else 0.0
+        row = self.record(bench_id, name, m, sd, unit=unit)
+        row.extra["rounds"] = [round(v, 2) for v in vals]
+        row.extra["min"] = round(min(vals), 2)
+        return row
+
+    def flush(self, quiet: bool) -> List[ResultRow]:
+        if not quiet:
+            for ln in self.lines:
+                print(ln)
+        return self.rows

@@ -23,11 +23,10 @@ the timed rounds.
 """
 from __future__ import annotations
 
-import statistics
 import threading
-import time
 from typing import Any, Dict, List, Optional
 
+from tosem_tpu.serve.bench_common import SuiteEmitter, closed_loop
 from tosem_tpu.utils.results import ResultRow
 
 # Gated by ci.sh --perf. The c16 arms and the speedup ratio are the
@@ -120,31 +119,14 @@ class NaiveRecodeBackend:
 
 def _token_loop(handle, n_clients: int, min_s: float) -> float:
     """``n_clients`` threads, each submitting prompts closed-loop for
-    >= ``min_s`` → generated tokens/s across the fleet."""
-    stop = time.perf_counter() + min_s
-    tokens = [0] * n_clients
-    errors: List[BaseException] = []
-
-    def client(i):
-        k = i
-        try:
-            while time.perf_counter() < stop:
-                out = handle.call(_prompt(k), timeout=120.0)
-                tokens[i] += len(out["generated"])
-                k += n_clients
-        except BaseException as e:   # pragma: no cover - surfaced below
-            errors.append(e)
-
-    t0 = time.perf_counter()
-    threads = [threading.Thread(target=client, args=(i,))
-               for i in range(n_clients)]
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
-    if errors:
-        raise errors[0]
-    return sum(tokens) / (time.perf_counter() - t0)
+    >= ``min_s`` → generated tokens/s across the fleet. (Thin wrapper
+    over the shared fleet in :mod:`tosem_tpu.serve.bench_common` —
+    prompts cycle per client, completed calls weigh their generated
+    token count.)"""
+    return closed_loop(handle.call, n_clients, min_s,
+                       lambda i, k: _prompt(i + k * n_clients),
+                       count_of=lambda out: len(out["generated"]),
+                       timeout=120.0)
 
 
 def run_decode_benchmarks(trials: int = 3, min_s: float = 0.5,
@@ -152,33 +134,19 @@ def run_decode_benchmarks(trials: int = 3, min_s: float = 0.5,
                           only: Optional[set] = None) -> List[ResultRow]:
     """Interleaved A/B decode benches; ``only`` restricts bench_ids."""
     import tosem_tpu.runtime as rt
-    from tosem_tpu.runtime.bench_runtime import _record
     from tosem_tpu.serve.backends import BertDecodeBackend
     from tosem_tpu.serve.batching import DecodePolicy
     from tosem_tpu.serve.core import Serve
 
-    def want(bid):
-        return only is None or bid in only
+    em = SuiteEmitter("decode", only)
+    want = em.want
+
+    def emit(bid, name, vals, unit="tokens/s"):
+        return em.emit(bid, name, vals, unit=unit)
 
     own_runtime = not rt.is_initialized()
     if own_runtime:
         rt.init(num_workers=2, memory_monitor=False)
-    rows: List[ResultRow] = []
-    lines: List[str] = []
-
-    def record(bench_id, name, mean, sd, unit="tokens/s"):
-        _record(rows, lines, bench_id, name, mean, sd, unit=unit)
-        rows[-1].extra["suite"] = "decode"
-
-    def emit(bid, name, vals, unit="tokens/s"):
-        if want(bid) and vals:
-            m = statistics.mean(vals)
-            sd = statistics.stdev(vals) if len(vals) > 1 else 0.0
-            record(bid, name, m, sd, unit=unit)
-            rows[-1].extra["rounds"] = [round(v, 2) for v in vals]
-            rows[-1].extra["min"] = round(min(vals), 2)
-            return rows[-1]
-        return None
 
     serve = Serve()
     # prompt bucket (one page) is the only prefill shape the paged arm
@@ -243,9 +211,6 @@ def run_decode_benchmarks(trials: int = 3, min_s: float = 0.5,
 
     serve.delete("bench-decode")
     serve.delete("bench-recode")
-    if not quiet:
-        for ln in lines:
-            print(ln)
     if own_runtime:
         rt.shutdown()
-    return rows
+    return em.flush(quiet)

@@ -16,11 +16,10 @@ rounds spanning fast AND slow host phases).
 """
 from __future__ import annotations
 
-import statistics
-import threading
 import time
 from typing import List, Optional
 
+from tosem_tpu.serve.bench_common import SuiteEmitter, closed_loop
 from tosem_tpu.utils.results import ResultRow
 
 # Gated by ci.sh --perf (higher-is-better throughput + the batched/
@@ -69,31 +68,11 @@ def _closed_loop(handle, n_clients: int, min_s: float,
                  make_request=None) -> float:
     """``n_clients`` threads in a call loop for >= min_s → ops/s.
     ``make_request(client_idx)`` builds each client's (fixed) payload;
-    defaults to the synthetic backend's ``{"x": i}``."""
-    make_request = make_request or (lambda i: {"x": i})
-    stop = time.perf_counter() + min_s
-    counts = [0] * n_clients
-    errors: List[BaseException] = []
-
-    def client(i):
-        req = make_request(i)
-        try:
-            while time.perf_counter() < stop:
-                handle.call(req, timeout=60.0)
-                counts[i] += 1
-        except BaseException as e:   # pragma: no cover - surfaced below
-            errors.append(e)
-
-    t0 = time.perf_counter()
-    threads = [threading.Thread(target=client, args=(i,))
-               for i in range(n_clients)]
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
-    if errors:
-        raise errors[0]
-    return sum(counts) / (time.perf_counter() - t0)
+    defaults to the synthetic backend's ``{"x": i}``. (Thin wrapper
+    over the shared fleet in :mod:`tosem_tpu.serve.bench_common`.)"""
+    mk = make_request or (lambda i: {"x": i})
+    return closed_loop(handle.call, n_clients, min_s,
+                       lambda i, k: mk(i), timeout=60.0)
 
 
 def _open_loop(handle, rate: float, duration_s: float) -> float:
@@ -120,21 +99,14 @@ def run_serve_benchmarks(trials: int = 3, min_s: float = 0.5,
                          skip_warm: bool = False) -> List[ResultRow]:
     """Interleaved A/B serve benches; ``only`` restricts bench_ids."""
     import tosem_tpu.runtime as rt
-    from tosem_tpu.runtime.bench_runtime import _record
     from tosem_tpu.serve.core import Serve
 
-    def want(bid):
-        return only is None or bid in only
+    em = SuiteEmitter("serve", only)
+    want, record, emit = em.want, em.record, em.emit
 
     own_runtime = not rt.is_initialized()
     if own_runtime:
         rt.init(num_workers=2, memory_monitor=False)
-    rows: List[ResultRow] = []
-    lines: List[str] = []
-
-    def record(bench_id, name, mean, sd, unit="ops/s"):
-        _record(rows, lines, bench_id, name, mean, sd, unit=unit)
-        rows[-1].extra["suite"] = "serve"
 
     serve = Serve()
     un = serve.deploy("bench-unbatched", VectorWorkBackend,
@@ -146,16 +118,6 @@ def run_serve_benchmarks(trials: int = 3, min_s: float = 0.5,
         serve.get_handle("bench-batched")
     h_un.call({"x": 0}, timeout=120.0)     # cold-boot both replicas
     h_ba.call({"x": 0}, timeout=120.0)
-
-    def emit(bid, name, vals, unit="ops/s"):
-        if want(bid) and vals:
-            m = statistics.mean(vals)
-            sd = statistics.stdev(vals) if len(vals) > 1 else 0.0
-            record(bid, name, m, sd, unit=unit)
-            rows[-1].extra["rounds"] = [round(v, 2) for v in vals]
-            rows[-1].extra["min"] = round(min(vals), 2)
-            return rows[-1]
-        return None
 
     throughput_ids = {"serve_single_closed_loop", "serve_single_unbatched",
                       "serve_single_latency_ratio", "serve_unbatched_c16",
@@ -283,15 +245,12 @@ def run_serve_benchmarks(trials: int = 3, min_s: float = 0.5,
                                             timeout=300.0)
         warm_ms = (time.perf_counter() - t0) * 1e3
         serve.delete("bench-warm")
-        record("serve_warm_first_request",
-               "serve warm vs cold first request", cold_ms / warm_ms, 0.0,
-               unit="x")
-        rows[-1].extra.update({"cold_ms": round(cold_ms, 1),
-                               "warm_ms": round(warm_ms, 1)})
+        row = record("serve_warm_first_request",
+                     "serve warm vs cold first request",
+                     cold_ms / warm_ms, 0.0, unit="x")
+        row.extra.update({"cold_ms": round(cold_ms, 1),
+                          "warm_ms": round(warm_ms, 1)})
 
-    if not quiet:
-        for ln in lines:
-            print(ln)
     if own_runtime:
         rt.shutdown()
-    return rows
+    return em.flush(quiet)

@@ -1,0 +1,724 @@
+"""Cluster serving plane: node-spanning deployments behind a router tier.
+
+The reference serves traffic across a cluster — a controller places
+backend replicas on raylets, router/proxy actors load-balance over
+them, and the GCS re-homes replicas when a node dies. This module is
+that composition for OUR substrate: :class:`ClusterServe` turns a
+deployment into a node-spanning service over the PR-2 cluster plane.
+
+Three layers:
+
+- **Placement** — replicas spread (or packed) across
+  :class:`~tosem_tpu.cluster.supervisor.NodePool` nodes using the
+  per-node capacity the agents report (``replica_slots_free``), with
+  every placement journaled through the pool's
+  :class:`~tosem_tpu.cluster.supervisor.HeadJournal` so
+  :meth:`ClusterServe.recover` can rebuild a crashed head's routing
+  table. A deployment may declare ``sharding=(dp, tp)``: each logical
+  replica then pins ``dp*tp`` virtual devices (the agent sets
+  ``XLA_FLAGS`` pre-spawn) and runs
+  :func:`~tosem_tpu.parallel.flash.sharded_flash_attention` under a
+  dp×tp mesh, with the node slots withheld from the task plane via a
+  :mod:`~tosem_tpu.cluster.gang` reservation.
+- **Routing** — replicated, stateless
+  :class:`~tosem_tpu.serve.router.RouterCore` processes in front
+  (consistent-hash affinity with queue-depth-aware spillover); the
+  controller pushes versioned routing tables, clients fail over across
+  routers (:class:`ClusterHandle`).
+- **Failover** — the pool's failure detector declares a node dead →
+  this controller drops its replicas from the table (pushed
+  immediately, so routers stop picking corpses), journals the
+  removals, and re-places the replicas on surviving nodes under the
+  same replica ids (the consistent-hash ring stays stable). Requests
+  in flight on the dead node are re-admitted from step 0 by the
+  routers — exact for the deterministic backends (greedy decode,
+  padded-program encode), one breaker trip per logical request.
+
+Chaos seam: ``serve.route`` fires per client request routed through a
+:class:`ClusterHandle` (actions ``kill_router`` / ``kill_node``), so
+the canned ``router-chaos`` plan can kill a router mid-traffic and
+then a replica node, deterministically.
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+from tosem_tpu.chaos import hooks as _chaos
+from tosem_tpu.cluster.gang import GangReservation, _plan, reserve_gang
+from tosem_tpu.cluster.node import RemoteNode
+from tosem_tpu.cluster.supervisor import NodePool
+from tosem_tpu.serve.breaker import CircuitOpen
+from tosem_tpu.serve.router import (NoReplicaAvailable, RemoteRouter,
+                                    ReplicaAppError, RouterCore,
+                                    RouterPolicy)
+
+
+class PlacementError(RuntimeError):
+    """The requested replica layout does not fit the live nodes'
+    reported capacity."""
+
+
+class ClusterReplica:
+    """One placed replica: id, host node, direct RPC address, and (for
+    sharded replicas) the gang reservation withholding its slots."""
+
+    def __init__(self, replica_id: str, deployment: str, node: str,
+                 address: str, devices: int = 0,
+                 gang: Optional[GangReservation] = None):
+        self.replica_id = replica_id
+        self.deployment = deployment
+        self.node = node
+        self.address = address
+        self.devices = devices
+        self.gang = gang
+
+    def info(self) -> Dict[str, Any]:
+        return {"replica_id": self.replica_id, "node": self.node,
+                "address": self.address, "devices": self.devices}
+
+
+class ClusterDeployment:
+    """Spec + live placements of one node-spanning deployment."""
+
+    def __init__(self, name: str, backend_ref: str,
+                 init_kwargs: Dict[str, Any], num_replicas: int,
+                 strategy: str, sharding: Optional[Tuple[int, int]],
+                 warmup_shapes: Optional[Sequence] = None):
+        self.name = name
+        self.backend_ref = backend_ref
+        self.init_kwargs = dict(init_kwargs)
+        self.num_replicas = num_replicas
+        self.strategy = strategy
+        self.sharding = tuple(sharding) if sharding else None
+        self.warmup_shapes = list(warmup_shapes or [])
+        self.replicas: List[ClusterReplica] = []
+
+    @property
+    def devices_per_replica(self) -> int:
+        return (self.sharding[0] * self.sharding[1]
+                if self.sharding else 0)
+
+    def spec(self) -> Dict[str, Any]:
+        """Journal-serializable deployment spec (what recover replays)."""
+        return {"deployment": self.name, "backend_ref": self.backend_ref,
+                "init_kwargs": json.dumps(self.init_kwargs,
+                                          sort_keys=True),
+                "num_replicas": self.num_replicas,
+                "strategy": self.strategy,
+                "sharding": list(self.sharding) if self.sharding else None,
+                "warmup_shapes": self.warmup_shapes}
+
+    @classmethod
+    def from_spec(cls, spec: Dict[str, Any]) -> "ClusterDeployment":
+        return cls(spec["deployment"], spec["backend_ref"],
+                   json.loads(spec.get("init_kwargs") or "{}"),
+                   int(spec["num_replicas"]), spec.get("strategy", "spread"),
+                   tuple(spec["sharding"]) if spec.get("sharding") else None,
+                   spec.get("warmup_shapes") or [])
+
+
+def plan_replicas(capacities: Dict[str, int], num_replicas: int,
+                  strategy: str = "spread") -> Dict[str, int]:
+    """Node → replica-count layout over reported free capacity.
+
+    Rides the gang scheduler's planner (same spread/pack vocabulary —
+    one placement algebra for bundles and replicas). Raises
+    :class:`PlacementError` when the layout cannot fit right now."""
+    if strategy not in ("spread", "pack"):
+        raise ValueError(f"unknown placement strategy {strategy!r}; "
+                         "choose 'spread' or 'pack'")
+    usable = {n: c for n, c in capacities.items() if c > 0}
+    plan = _plan(usable, num_replicas, strategy) if usable else None
+    if plan is None:
+        raise PlacementError(
+            f"cannot place {num_replicas} replicas ({strategy}) on "
+            f"capacities {capacities}")
+    return plan
+
+
+class ClusterHandle:
+    """Client handle: routes through the router tier with failover.
+
+    ``key`` pins a request to its consistent-hash replica (session /
+    KV / compile-cache affinity); keyless requests go least-loaded.
+    Router loss fails over to the next router transparently — the
+    logical request is only surfaced as failed when NO router answers
+    or the routed call itself fails typed (application error, open
+    breaker, no replicas)."""
+
+    def __init__(self, cs: "ClusterServe", name: str):
+        self._cs = cs
+        self._name = name
+        self._rr = itertools.count()
+
+    def call(self, request: Any, timeout: Optional[float] = None,
+             key: Optional[str] = None) -> Any:
+        """Route one request. ``timeout`` is accepted for interface
+        parity with :class:`~tosem_tpu.serve.core.Handle` but bounds
+        nothing here: the RPC layer fails fast on dead peers (the only
+        unbounded wait is a healthy backend legitimately computing)."""
+        self._cs._fire_route_chaos(self._name)
+        routers = self._cs._routers_snapshot()
+        if not routers:
+            raise ConnectionError("no routers configured")
+        start = next(self._rr)
+        last: Optional[BaseException] = None
+        for k in range(len(routers)):
+            router = routers[(start + k) % len(routers)]
+            try:
+                return router.route(self._name, request, key=key)
+            except (NoReplicaAvailable, ReplicaAppError, CircuitOpen):
+                raise               # typed verdicts: not a router death
+            except (ConnectionError, TimeoutError, OSError) as e:
+                last = e            # router gone: fail over to the next
+                continue
+            except Exception as e:
+                raise self._translate(e) from None
+        raise ConnectionError(
+            f"no live router for deployment {self._name!r}"
+            + (f" (last error: {last!r})" if last else ""))
+
+    @staticmethod
+    def _translate(e: Exception) -> BaseException:
+        """Re-type a remote router error (the RPC layer ships
+        ``repr(exc)``; prefix-match like RemoteNode._translate)."""
+        msg = str(e)
+        for prefix, typ in (("NoReplicaAvailable(", NoReplicaAvailable),
+                            ("ReplicaAppError(", ReplicaAppError),
+                            ("CircuitOpen(", CircuitOpen)):
+            if msg.startswith(prefix):
+                return typ(msg)
+        return e
+
+
+class ClusterServe:
+    """The cluster serving controller (single-controller, like Serve —
+    but its replicas are processes on OTHER nodes and its data plane is
+    the replicated router tier, so the controller is off the request
+    path entirely)."""
+
+    def __init__(self, pool: NodePool, num_routers: int = 1,
+                 router_procs: bool = True,
+                 router_policy: Optional[RouterPolicy] = None,
+                 replica_startup_timeout: float = 120.0):
+        self.pool = pool
+        self._lock = threading.RLock()
+        self._deployments: Dict[str, ClusterDeployment] = {}
+        self._version = 0
+        self._rid_next: Dict[str, int] = {}
+        self._replica_startup_timeout = replica_startup_timeout
+        self._closed = False
+        # telemetry state (guarded by self._lock in stats(): /-/stats
+        # is served by a threaded HTTP server, so scrapes race)
+        self._metrics: Optional[Dict[str, Any]] = None
+        self._exported_placed: set = set()
+        self._exported_nodes: set = set()
+        self._mirrored: Dict[Tuple[str, str, str], int] = {}
+        self._routers: List[Union[RemoteRouter, RouterCore]] = []
+        for i in range(max(1, num_routers)):
+            if router_procs:
+                self._routers.append(RemoteRouter.spawn_local(
+                    name=f"router{i}", policy=router_policy))
+            else:
+                self._routers.append(
+                    RouterCore(name=f"router{i}", policy=router_policy))
+        pool.add_death_listener(self._on_node_dead)
+
+    # -- capacity / placement ------------------------------------------
+
+    def _capacities(self, per_replica: int = 1,
+                    exclude: Sequence[str] = ()) -> Dict[str, int]:
+        """Free replica slots per live node, in units of ONE replica
+        (a dp×tp replica consumes ``per_replica`` agent slots)."""
+        caps: Dict[str, int] = {}
+        for name, node in self.pool.live_nodes().items():
+            if name in exclude:
+                continue
+            try:
+                st = node.stats()
+            except Exception:
+                continue            # unprobeable now: not a candidate
+            free = int(st.get("replica_slots_free",
+                              st.get("free_slots", 0)))
+            caps[name] = free // max(1, per_replica)
+        return caps
+
+    def _next_rid(self, name: str) -> str:
+        n = self._rid_next.get(name, 0)
+        self._rid_next[name] = n + 1
+        return f"{name}#r{n}"
+
+    def _start_replica(self, dep: ClusterDeployment, node_name: str,
+                       node: RemoteNode, replica_id: str
+                       ) -> ClusterReplica:
+        """Place one replica on ``node``: gang-reserve its device slots
+        (sharded), spawn the worker, journal the placement."""
+        devices = dep.devices_per_replica
+        gang: Optional[GangReservation] = None
+        init_kwargs = dict(dep.init_kwargs)
+        if dep.sharding:
+            dp, tp = dep.sharding
+            init_kwargs.setdefault("dp", dp)
+            init_kwargs.setdefault("tp", tp)
+            # withhold the replica's cores from the task plane for its
+            # whole lifetime — all-or-nothing on this node, no waiting
+            # (the planner already checked capacity; a race just fails
+            # this node and the caller picks another)
+            gang = reserve_gang([node], devices, strategy="strict_pack",
+                                timeout=0.0)
+        try:
+            address = node.start_replica(
+                replica_id, dep.backend_ref, init_kwargs,
+                devices=devices,
+                startup_timeout=self._replica_startup_timeout)
+        except BaseException:
+            if gang is not None:
+                gang.release()
+            raise
+        rep = ClusterReplica(replica_id, dep.name, node_name, address,
+                             devices=devices, gang=gang)
+        self.pool.record_event(
+            "replica_placed", deployment=dep.name, replica_id=replica_id,
+            node=node_name, address=address, devices=devices,
+            gang_id=gang.pg_id if gang else None)
+        return rep
+
+    def _warm_replica(self, dep: ClusterDeployment,
+                      rep: ClusterReplica) -> None:
+        if not dep.warmup_shapes:
+            return
+        from tosem_tpu.cluster.rpc import RpcClient
+        with RpcClient(rep.address) as cli:
+            cli.call("warmup", list(dep.warmup_shapes))
+
+    # -- control plane -------------------------------------------------
+
+    def deploy(self, name: str, backend: Any, *, num_replicas: int = 2,
+               strategy: str = "spread",
+               sharding: Optional[Tuple[int, int]] = None,
+               init_kwargs: Optional[Dict[str, Any]] = None,
+               warmup_shapes: Optional[Sequence] = None
+               ) -> ClusterDeployment:
+        """Place ``num_replicas`` of ``backend`` (a class or a
+        ``"module:qualname"`` ref importable on the nodes) across the
+        pool and route traffic to them. ``sharding=(dp, tp)`` makes
+        each logical replica a dp×tp-meshed sharded program (the
+        backend receives ``dp``/``tp`` kwargs)."""
+        ref = (backend if isinstance(backend, str)
+               else f"{backend.__module__}:{backend.__qualname__}")
+        dep = ClusterDeployment(name, ref, init_kwargs or {},
+                                num_replicas, strategy, sharding,
+                                warmup_shapes)
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("controller is closed")
+            if name in self._deployments:
+                raise ValueError(f"deployment {name!r} already exists")
+            self._deployments[name] = dep
+        self.pool.record_event("deployment_created", **dep.spec())
+        try:
+            caps = self._capacities(
+                per_replica=max(1, dep.devices_per_replica))
+            counts = plan_replicas(caps, num_replicas, strategy)
+            nodes = self.pool.live_nodes()
+            for node_name in sorted(counts):
+                for _ in range(counts[node_name]):
+                    rep = self._start_replica(
+                        dep, node_name, nodes[node_name],
+                        self._next_rid(name))
+                    with self._lock:
+                        dep.replicas.append(rep)
+            with self._lock:
+                to_warm = list(dep.replicas)
+            for rep in to_warm:
+                self._warm_replica(dep, rep)
+        except BaseException:
+            # unregister FIRST: the deployment is already visible to
+            # the node-death listener, and a failover re-placement
+            # racing this teardown must find the deployment gone
+            # rather than re-place into a dying one
+            with self._lock:
+                self._deployments.pop(name, None)
+            self._teardown_deployment(dep)
+            self.pool.record_event("deployment_deleted", deployment=name,
+                                   reason="deploy failed")
+            raise
+        self._push_table()
+        return dep
+
+    def get_handle(self, name: str) -> ClusterHandle:
+        with self._lock:
+            if name not in self._deployments:
+                raise KeyError(f"no deployment {name!r}")
+        return ClusterHandle(self, name)
+
+    def get_deployment(self, name: str) -> Optional[ClusterDeployment]:
+        with self._lock:
+            return self._deployments.get(name)
+
+    def list_deployments(self) -> List[str]:
+        with self._lock:
+            return sorted(self._deployments)
+
+    def delete(self, name: str) -> None:
+        with self._lock:
+            dep = self._deployments.pop(name, None)
+        if dep is None:
+            return
+        self._teardown_deployment(dep)
+        self.pool.record_event("deployment_deleted", deployment=name)
+        self._push_table()
+
+    def _teardown_deployment(self, dep: ClusterDeployment) -> None:
+        nodes = self.pool.live_nodes()
+        with self._lock:
+            reps, dep.replicas = list(dep.replicas), []
+        for rep in reps:
+            node = nodes.get(rep.node)
+            if node is not None:
+                try:
+                    node.stop_replica(rep.replica_id)
+                except Exception:
+                    pass            # dead node: its replicas died too
+            if rep.gang is not None:
+                rep.gang.release()
+            self.pool.record_event("replica_removed", deployment=dep.name,
+                                   replica_id=rep.replica_id,
+                                   reason="deleted")
+
+    # -- routing table -------------------------------------------------
+
+    def _routers_snapshot(self) -> List[Union[RemoteRouter, RouterCore]]:
+        with self._lock:
+            return list(self._routers)
+
+    def _push_table(self) -> int:
+        """Push the current placements to every router (versioned, so a
+        racing push over another connection can never roll a router
+        back). Unreachable routers are skipped — they are either dead
+        (clients fail over) or will catch up on the next push."""
+        with self._lock:
+            self._version += 1
+            version = self._version
+            table = {name: [rep.info() for rep in dep.replicas]
+                     for name, dep in self._deployments.items()}
+            routers = list(self._routers)
+        for router in routers:
+            try:
+                router.update_table(table, version)
+            except Exception:
+                pass
+        return version
+
+    def table_version(self) -> int:
+        with self._lock:
+            return self._version
+
+    # -- failover ------------------------------------------------------
+
+    def _on_node_dead(self, node_name: str, node: RemoteNode) -> None:
+        """Pool death listener: drop the node's replicas from routing
+        (pushed immediately), then re-place them on survivors under
+        the SAME replica ids — the hash ring stays stable, so affinity
+        keys land on the re-placed replica, not a shuffled one."""
+        with self._lock:
+            lost: List[Tuple[ClusterDeployment, ClusterReplica]] = []
+            for dep in self._deployments.values():
+                mine = [r for r in dep.replicas if r.node == node_name]
+                for rep in mine:
+                    dep.replicas.remove(rep)
+                    lost.append((dep, rep))
+        if not lost:
+            return
+        self._push_table()
+        for dep, rep in lost:
+            self.pool.record_event(
+                "replica_removed", deployment=dep.name,
+                replica_id=rep.replica_id, reason="node_death",
+                node=node_name)
+            # the gang died with its node; release() is a no-op on a
+            # dead agent but clears the driver-side handle
+            if rep.gang is not None:
+                rep.gang.release()
+            try:
+                self._place_one(dep, rep.replica_id,
+                                exclude=(node_name,))
+            except Exception as e:
+                self.pool.record_event(
+                    "replica_lost", deployment=dep.name,
+                    replica_id=rep.replica_id, error=repr(e))
+        self._push_table()
+
+    def _place_one(self, dep: ClusterDeployment, replica_id: str,
+                   exclude: Sequence[str] = ()) -> ClusterReplica:
+        """Re-place one replica on the best-capacity surviving node."""
+        caps = self._capacities(
+            per_replica=max(1, dep.devices_per_replica), exclude=exclude)
+        candidates = [n for n, c in caps.items() if c > 0]
+        if not candidates:
+            raise PlacementError(
+                f"no surviving capacity for {replica_id} "
+                f"(capacities {caps})")
+        node_name = max(candidates, key=lambda n: caps[n])
+        node = self.pool.live_nodes()[node_name]
+        rep = self._start_replica(dep, node_name, node, replica_id)
+        self._warm_replica(dep, rep)
+        with self._lock:
+            # a delete/failed-deploy can race this re-placement: if
+            # the deployment is no longer registered, the fresh
+            # replica must be torn down, not leaked as an orphan the
+            # journal records placed after deployment_deleted
+            if self._deployments.get(dep.name) is not dep:
+                registered = False
+            else:
+                dep.replicas.append(rep)
+                registered = True
+        if not registered:
+            try:
+                node.stop_replica(replica_id)
+            except Exception:
+                pass
+            if rep.gang is not None:
+                rep.gang.release()
+            self.pool.record_event("replica_removed", deployment=dep.name,
+                                   replica_id=replica_id,
+                                   reason="deployment gone")
+            raise PlacementError(
+                f"deployment {dep.name!r} was deleted during "
+                "re-placement")
+        return rep
+
+    # -- chaos seam ----------------------------------------------------
+
+    def _fire_route_chaos(self, deployment: str) -> None:
+        act = _chaos.fire("serve.route", target=deployment)
+        if act is None:
+            return
+        if act["action"] == "kill_router":
+            self.chaos_kill_router()
+        elif act["action"] == "kill_node":
+            self.chaos_kill_replica_node(deployment)
+
+    def chaos_kill_router(self) -> Optional[str]:
+        """SIGKILL the first live router process (chaos: the client's
+        next attempt on it fails and must fail over)."""
+        for router in self._routers_snapshot():
+            if isinstance(router, RemoteRouter) and \
+                    router._proc is not None and \
+                    router._proc.poll() is None:
+                router.kill()
+                return router.name
+        return None
+
+    def chaos_kill_replica_node(self, deployment: str) -> Optional[str]:
+        """SIGKILL the first live node hosting a replica of
+        ``deployment`` and declare it dead out-of-band (the detector's
+        declare_dead path) — failover runs synchronously, the caller's
+        request then rides the refreshed table."""
+        with self._lock:
+            dep = self._deployments.get(deployment)
+            hosts = [r.node for r in dep.replicas] if dep else []
+        live = self.pool.live_nodes()
+        for node_name in hosts:
+            node = live.get(node_name)
+            if node is not None:
+                node.kill()
+                self.pool.detector.declare_dead(node_name)
+                return node_name
+        return None
+
+    # -- head crash-restart --------------------------------------------
+
+    @classmethod
+    def recover(cls, journal_path: str, num_routers: int = 1,
+                router_procs: bool = True, probe_timeout: float = 2.0,
+                router_policy: Optional[RouterPolicy] = None,
+                **pool_kwargs: Any) -> "ClusterServe":
+        """Rebuild a crashed head's serving plane from its journal:
+        recover the node pool, re-adopt replica processes that
+        OUTLIVED the head (a head crash is not a node crash — the
+        agents and their replicas keep serving), re-place the ones
+        that did not, and push a fresh routing table."""
+        pool = NodePool.recover(journal_path, probe_timeout=probe_timeout,
+                                **pool_kwargs)
+        cs = cls(pool, num_routers=num_routers, router_procs=router_procs,
+                 router_policy=router_policy)
+        specs: Dict[str, Dict[str, Any]] = getattr(pool, "deployments", {})
+        placements: Dict[str, Dict[str, Any]] = getattr(
+            pool, "placements", {})
+        with cs._lock:
+            for name, spec in specs.items():
+                cs._deployments[name] = ClusterDeployment.from_spec(spec)
+        live = pool.live_nodes()
+        listings: Dict[str, Dict[str, Any]] = {}
+        for node_name, node in live.items():
+            try:
+                listings[node_name] = node.list_replicas()
+            except Exception:
+                listings[node_name] = {}
+        for rid, p in sorted(placements.items()):
+            dep = cs._deployments.get(p["deployment"])
+            if dep is None:
+                continue
+            node_name = p["node"]
+            hosted = listings.get(node_name, {}).get(rid)
+            if hosted is not None and hosted.get("alive"):
+                rep = ClusterReplica(rid, dep.name, node_name,
+                                     hosted["address"],
+                                     devices=int(p.get("devices") or 0))
+                if p.get("gang_id") and node_name in live:
+                    # re-own the surviving agent-side reservation so a
+                    # later release (delete / node death) still frees it
+                    rep.gang = GangReservation(
+                        p["gang_id"], {live[node_name].address:
+                                       live[node_name]},
+                        {live[node_name].address: rep.devices})
+                dep.replicas.append(rep)
+                pool.record_event("replica_adopted", deployment=dep.name,
+                                  replica_id=rid, node=node_name)
+                # keep ids monotonic past the adopted ones
+                cs._bump_rid(dep.name, rid)
+            else:
+                pool.record_event("replica_removed", deployment=dep.name,
+                                  replica_id=rid,
+                                  reason="lost at recovery")
+                cs._bump_rid(dep.name, rid)
+                if p.get("gang_id") and node_name in live:
+                    # the replica died but its AGENT survived: the
+                    # agent's in-memory reservation is still holding
+                    # the dead replica's dp*tp slots — release it or
+                    # the node's capacity is leaked until agent restart
+                    GangReservation(
+                        p["gang_id"],
+                        {live[node_name].address: live[node_name]},
+                        {live[node_name].address:
+                         int(p.get("devices") or 0)}).release()
+                try:
+                    cs._place_one(dep, rid)
+                except Exception as e:
+                    pool.record_event("replica_lost",
+                                      deployment=dep.name,
+                                      replica_id=rid, error=repr(e))
+        cs._push_table()
+        return cs
+
+    def _bump_rid(self, name: str, rid: str) -> None:
+        """Advance the id counter past a journal-recovered replica id
+        so fresh placements never collide with adopted ones."""
+        try:
+            n = int(rid.rsplit("#r", 1)[1])
+        except (IndexError, ValueError):
+            return
+        self._rid_next[name] = max(self._rid_next.get(name, 0), n + 1)
+
+    # -- telemetry -----------------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        """Aggregate control+data-plane snapshot (the ``/-/stats``
+        payload): per-deployment placements, per-router routed/spilled
+        counters, and the per-node queue-depth rollup — mirrored into
+        the driver registry's cluster gauges so one Prometheus scrape
+        sees the whole tier."""
+        with self._lock:
+            deps = {name: {"replicas": len(dep.replicas),
+                           "nodes": sorted({r.node for r in dep.replicas}),
+                           "strategy": dep.strategy,
+                           "sharding": (list(dep.sharding)
+                                        if dep.sharding else None),
+                           "placement": [r.info() for r in dep.replicas]}
+                    for name, dep in self._deployments.items()}
+            routers = list(self._routers)
+            version = self._version
+        router_stats: List[Dict[str, Any]] = []
+        remote_stats: List[Dict[str, Any]] = []
+        for router in routers:
+            try:
+                rs = router.stats()
+            except Exception:
+                rs = {"name": getattr(router, "name", "?"), "dead": True}
+            router_stats.append(rs)
+            if isinstance(router, RemoteRouter):
+                remote_stats.append(rs)
+        nodes: Dict[str, Dict[str, Any]] = {}
+        routed = spilled = 0
+        for rs in router_stats:
+            routed += rs.get("routed", 0)
+            spilled += rs.get("spilled", 0)
+            for node, depth in rs.get("node_queue_depth", {}).items():
+                cur = nodes.setdefault(node, {"queue_depth": 0,
+                                              "replicas": 0})
+                # each router has its own (cached) view; the max is the
+                # honest rollup — summing would count a request once
+                # per router that saw it
+                cur["queue_depth"] = max(cur["queue_depth"], depth)
+        # export under the controller lock: /-/stats is served by a
+        # threaded HTTP server, and a racing scrape must not double-
+        # apply a mirrored counter delta or cross the departed-label
+        # bookkeeping mid-update
+        with self._lock:
+            if self._metrics is None:
+                from tosem_tpu.obs.metrics import cluster_serve_metrics
+                self._metrics = cluster_serve_metrics()
+            # mirror PROCESS routers' routed/spilled counters into the
+            # driver registry by delta (their own registries have no
+            # scrape endpoint; in-proc routers already feed this
+            # registry directly — mirroring those would double-count)
+            for rs in remote_stats:
+                rname = rs.get("name", "?")
+                for dep_name, paths in rs.get("requests", {}).items():
+                    for path, total in paths.items():
+                        mkey = (dep_name, rname, path)
+                        delta = total - self._mirrored.get(mkey, 0)
+                        if delta > 0:
+                            self._metrics["router_requests"].inc(
+                                delta, mkey)
+                            self._mirrored[mkey] = total
+            placed_now: set = set()
+            for name, d in deps.items():
+                per_node: Dict[str, int] = {}
+                for r in d["placement"]:
+                    per_node[r["node"]] = per_node.get(r["node"], 0) + 1
+                for node, count in per_node.items():
+                    nodes.setdefault(node, {"queue_depth": 0,
+                                            "replicas": 0})
+                    nodes[node]["replicas"] += count
+                    self._metrics["replicas_placed"].set(count,
+                                                         (name, node))
+                    placed_now.add((name, node))
+            for node, d in nodes.items():
+                self._metrics["node_queue_depth"].set(d["queue_depth"],
+                                                      (node,))
+            # zero series whose label sets departed (a dead node
+            # keeping its last replica count/queue depth forever would
+            # read as mass that failover never moved)
+            for name, node in self._exported_placed - placed_now:
+                self._metrics["replicas_placed"].set(0, (name, node))
+            for node in self._exported_nodes - set(nodes):
+                self._metrics["node_queue_depth"].set(0, (node,))
+            self._exported_placed = placed_now
+            self._exported_nodes = set(nodes)
+        return {"deployments": deps, "routers": router_stats,
+                "nodes": nodes, "version": version,
+                "routed": routed, "spilled": spilled}
+
+    def close(self, stop_replicas: bool = True,
+              close_pool: bool = False) -> None:
+        with self._lock:
+            self._closed = True
+            deployments = list(self._deployments.values())
+            self._deployments = {}
+            routers = list(self._routers)
+            self._routers = []
+        if stop_replicas:
+            for dep in deployments:
+                self._teardown_deployment(dep)
+        for router in routers:
+            try:
+                router.close()
+            except Exception:
+                pass
+        if close_pool:
+            self.pool.close(close_nodes=True)

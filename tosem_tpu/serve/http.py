@@ -4,13 +4,24 @@
 ``POST /<endpoint>`` into router calls; single-controller here, so the
 proxy is a threaded stdlib HTTP server in the driver process. JSON in,
 JSON out; backend errors map to 500, unknown endpoints to 404.
+
+The controller argument duck-types: a
+:class:`~tosem_tpu.serve.core.Serve` (in-process deployments) or a
+:class:`~tosem_tpu.serve.cluster_serve.ClusterServe` (node-spanning
+deployments behind the router tier) both expose ``get_deployment`` /
+``get_handle`` / ``list_deployments`` / ``stats``. Against the cluster
+plane, ``POST /<endpoint>?key=<affinity>`` pins the request to its
+consistent-hash replica, and ``/-/stats`` serves the router-tier
+rollup (per-node queue depth, routed-vs-spilled counters).
 """
 from __future__ import annotations
 
+import inspect
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
+from urllib.parse import parse_qs, urlsplit
 
 from tosem_tpu.serve.core import Serve
 
@@ -25,7 +36,8 @@ class HttpIngress:
                 pass
 
             def do_POST(self):
-                name = self.path.strip("/")
+                parts = urlsplit(self.path)
+                name = parts.path.strip("/")
                 if serve.get_deployment(name) is None:
                     self._reply(404, {"error": f"no endpoint {name!r}"})
                     return
@@ -33,8 +45,19 @@ class HttpIngress:
                     n = int(self.headers.get("Content-Length", 0))
                     request = json.loads(self.rfile.read(n) or b"null")
                     handle = serve.get_handle(name)
-                    result = handle.call(request,
-                                         timeout=ingress.request_timeout)
+                    key = parse_qs(parts.query).get("key", [None])[0]
+                    # affinity key: only a handle whose call() declares
+                    # key= routes on it (the cluster handle); detected
+                    # by SIGNATURE, never by catching TypeError around
+                    # the live call — a backend's own TypeError must
+                    # not trigger a second execution of the request
+                    kwargs = {}
+                    if key is not None and "key" in inspect.signature(
+                            handle.call).parameters:
+                        kwargs["key"] = key
+                    result = handle.call(
+                        request, timeout=ingress.request_timeout,
+                        **kwargs)
                     self._reply(200, {"result": result})
                 except Exception as e:  # backend failure → 500, not a crash
                     self._reply(500, {"error": f"{type(e).__name__}: {e}"})

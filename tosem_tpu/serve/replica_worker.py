@@ -1,0 +1,181 @@
+"""Serve replica process — one backend behind its own RPC server.
+
+The reference's replicas are actor processes the router talks to
+directly (``serve/backend_worker.py``); the cluster serving plane keeps
+that shape: a node agent spawns this worker (one process per replica,
+``cluster/node.py:start_replica``), it instantiates the backend named
+by ``--backend module:qualname`` and serves ``call``/``call_batch``/
+``warmup``/``load``/``stats`` over :class:`~tosem_tpu.cluster.rpc.RpcServer`.
+The router tier holds a client per replica address — requests never
+bounce through the agent.
+
+Two wire details the router relies on:
+
+- ``call`` returns ``{"value": ..., "load": n}`` — the replica's
+  in-flight depth rides every response, so the router's queue-depth
+  view refreshes for free instead of paying a scrape RPC per request
+  (the bench-noise rule: no per-step remote scrapes).
+- A backend exception travels as an ``RpcError`` (application error:
+  never retried, counted against the breaker); a dead replica surfaces
+  as ``ConnectionError`` (retried on a surviving replica).
+
+Import discipline: this module must not import jax or numpy — cheap
+backends (echo, bench synthetics) boot in well under a second, and a
+sharded backend's jax import happens AFTER ``--devices`` has pinned
+``XLA_FLAGS`` in the environment (the agent sets it pre-spawn).
+"""
+from __future__ import annotations
+
+import importlib
+import json
+import os
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+
+def resolve_backend(ref: str):
+    """``"module:qualname"`` → class/factory (the trainable_ref idiom
+    of the trial plane, reused so one addressing scheme names every
+    code object that ships to another process)."""
+    mod_name, _, qual = ref.partition(":")
+    if not mod_name or not qual:
+        raise ValueError(f"backend ref {ref!r} is not 'module:qualname'")
+    obj = importlib.import_module(mod_name)
+    for part in qual.split("."):
+        obj = getattr(obj, part)
+    return obj
+
+
+class ReplicaHandlers:
+    """RPC surface of one replica (the backend_worker role)."""
+
+    def __init__(self, backend: Any):
+        self._backend = backend
+        self._lock = threading.Lock()
+        self._inflight = 0
+        self._served = 0
+        self._errors = 0
+        self._started = time.time()
+
+    def _enter(self) -> None:
+        with self._lock:
+            self._inflight += 1
+
+    def _leave(self, ok: bool) -> int:
+        with self._lock:
+            self._inflight -= 1
+            self._served += 1
+            if not ok:
+                self._errors += 1
+            return self._inflight
+
+    def call(self, request: Any) -> Dict[str, Any]:
+        self._enter()
+        ok = False
+        try:
+            value = self._backend.call(request)
+            ok = True
+        finally:
+            depth = self._leave(ok)
+        return {"value": value, "load": depth}
+
+    def call_batch(self, requests: List[Any],
+                   bucket: Optional[int] = None) -> Dict[str, Any]:
+        self._enter()
+        ok = False
+        try:
+            values = self._backend.call_batch(requests, bucket)
+            ok = True
+        finally:
+            depth = self._leave(ok)
+        return {"value": values, "load": depth}
+
+    def warmup(self, shapes: List[Any]) -> Any:
+        if hasattr(self._backend, "warmup"):
+            return self._backend.warmup(shapes)
+        return {"warmed": 0}
+
+    def load(self) -> int:
+        with self._lock:
+            return self._inflight
+
+    def health(self) -> Dict[str, Any]:
+        return {"ok": True, "pid": os.getpid(),
+                "uptime_s": time.time() - self._started}
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            out = {"pid": os.getpid(), "inflight": self._inflight,
+                   "served": self._served, "errors": self._errors}
+        if hasattr(self._backend, "stats"):
+            try:
+                backend_stats = self._backend.stats()
+                if isinstance(backend_stats, dict):
+                    out.update(backend_stats)
+            except Exception:
+                pass          # telemetry must never fail the data plane
+        return out
+
+
+def serve_replica(backend_ref: str, init_kwargs: Dict[str, Any],
+                  port: int = 0, announce_fd: Optional[int] = None,
+                  lifeline_fd: Optional[int] = None) -> None:
+    """Run one replica until killed, or until the lifeline pipe hits
+    EOF — the write end lives in the spawning agent, so the replica
+    dies WITH its agent however the agent goes (SIGKILL included; a
+    dead node must not leave orphan replicas answering on old ports —
+    PDEATHSIG is not deliverable on every kernel this runs under)."""
+    from tosem_tpu.cluster.rpc import RpcServer
+    backend = resolve_backend(backend_ref)(**init_kwargs)
+    server = RpcServer(ReplicaHandlers(backend), port=port)
+    line = f"{server.address}\n".encode()
+    if announce_fd is not None:
+        os.write(announce_fd, line)
+        os.close(announce_fd)
+    else:
+        sys.stdout.write(line.decode())
+        sys.stdout.flush()
+    try:
+        if lifeline_fd is not None:
+            while os.read(lifeline_fd, 1):
+                pass             # nothing is ever written; EOF = parent died
+        else:
+            while True:
+                time.sleep(3600)
+    except (KeyboardInterrupt, OSError):
+        pass
+    finally:
+        server.shutdown()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    backend_ref, kwargs_json, port = "", "{}", 0
+    announce_fd, lifeline_fd = None, None
+    i = 0
+    while i < len(args):
+        if args[i] == "--backend":
+            backend_ref = args[i + 1]; i += 2
+        elif args[i] == "--init-kwargs":
+            kwargs_json = args[i + 1]; i += 2
+        elif args[i] == "--port":
+            port = int(args[i + 1]); i += 2
+        elif args[i] == "--announce-fd":
+            announce_fd = int(args[i + 1]); i += 2
+        elif args[i] == "--lifeline-fd":
+            lifeline_fd = int(args[i + 1]); i += 2
+        else:
+            print(f"unknown arg {args[i]}", file=sys.stderr)
+            return 2
+    if not backend_ref:
+        print("--backend module:qualname is required", file=sys.stderr)
+        return 2
+    serve_replica(backend_ref, json.loads(kwargs_json), port=port,
+                  announce_fd=announce_fd, lifeline_fd=lifeline_fd)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
