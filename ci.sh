@@ -97,6 +97,18 @@ perf_smoke() {
     echo "== perf smoke: cluster regression reported; one retry (noisy host?)"
     JAX_PLATFORMS=cpu "${ccmd[@]}"
   fi
+  # block-sparse mask programs: t8192 LocalMask(1024) vs dense-causal,
+  # interleaved A/B with the in-round (phase-immune) speedup ratio as
+  # the gated row — the executed-blocks win must hold release over
+  # release (floors are min-of-rounds in results/bench_sparse.json)
+  echo "== perf smoke (sparse microbench vs results/bench_sparse.json)"
+  local spcmd=(python -m tosem_tpu.cli microbench --sparse --trials 2
+               --min-s 0.4 --quiet --only gated
+               --check results/bench_sparse.json --threshold 0.30)
+  if ! JAX_PLATFORMS=cpu "${spcmd[@]}"; then
+    echo "== perf smoke: sparse regression reported; one retry (noisy host?)"
+    JAX_PLATFORMS=cpu "${spcmd[@]}"
+  fi
 }
 
 if [[ "$PERF" == "1" ]]; then
@@ -109,10 +121,12 @@ if [[ "$QUICK" == "1" ]]; then
   echo "== quick tier: numerics + unit tests + chaos smoke"
   # test_pallas_kernels = the interpret-mode flash parity gate (streamed
   # kernels vs XLA on causal/none/padding/segment masks, fp32 + bf16);
-  # test_flash_blocks = the block-selector + VMEM-budget-fallback smoke
+  # test_flash_blocks = the block-selector + VMEM-budget-fallback smoke;
+  # test_mask_programs = the block-sparse schedule gate (schedule-vs-
+  # oracle correctness, kernel parity per mask type, sparse cache)
   python -m pytest -q -m "not slow" \
     tests/test_ops.py tests/test_pallas_kernels.py tests/test_nn.py \
-    tests/test_flash_blocks.py \
+    tests/test_flash_blocks.py tests/test_mask_programs.py \
     tests/test_sharding.py tests/test_serial.py tests/test_utils.py \
     tests/test_analysis.py tests/test_image_ops.py tests/test_htm.py \
     tests/test_compress.py tests/test_scorer.py tests/test_ring.py \

@@ -65,6 +65,17 @@ _LAZY_EXPORTS = {
     "ShardedAttentionBackend": ("tosem_tpu.serve.backends",
                                 "ShardedAttentionBackend"),
     "dp_tp_mesh": ("tosem_tpu.parallel.flash", "dp_tp_mesh"),
+    # block-sparse mask programs (round 10): splash-style per-head
+    # block schedules driving the flash kernels' stream dimension
+    "FullMask": ("tosem_tpu.ops.mask_programs", "FullMask"),
+    "CausalMask": ("tosem_tpu.ops.mask_programs", "CausalMask"),
+    "LocalMask": ("tosem_tpu.ops.mask_programs", "LocalMask"),
+    "PrefixLMMask": ("tosem_tpu.ops.mask_programs", "PrefixLMMask"),
+    "DocumentMask": ("tosem_tpu.ops.mask_programs", "DocumentMask"),
+    "MultiHeadMask": ("tosem_tpu.ops.mask_programs", "MultiHeadMask"),
+    "mask_from_spec": ("tosem_tpu.ops.mask_programs", "mask_from_spec"),
+    "compile_mask_programs": ("tosem_tpu.ops.mask_programs",
+                              "compile_mask_programs"),
 }
 
 __all__ = sorted(_LAZY_EXPORTS)

@@ -26,9 +26,10 @@ from tosem_tpu.utils.flags import FlagSet
 
 CONFIGS = ("gemm", "timing_check", "conv_sweep", "allreduce",
            "resnet_train", "bert_kernels", "bert_train",
-           "flash_autotune", "autotune_decode_pages", "detection_train",
-           "detection_infer", "pointpillars_infer", "speech_train",
-           "serve_bench", "decode_bench", "cluster_bench", "analysis")
+           "flash_autotune", "autotune_decode_pages", "flash_sparse",
+           "detection_train", "detection_infer", "pointpillars_infer",
+           "speech_train", "serve_bench", "decode_bench",
+           "cluster_bench", "analysis")
 
 
 def make_flags() -> FlagSet:
@@ -53,6 +54,10 @@ def make_flags() -> FlagSet:
     fs.define_integer("max_bytes", 0,
                       "cap collective sweep size in bytes (0 = full sweep)")
     fs.define_string("dtype", "", "dtype override for sweeps")
+    fs.define_string("mask", "",
+                     "comma-separated sparse mask specs for the "
+                     "flash_autotune sparse sweep (e.g. local:1024,doc; "
+                     "empty = dense sweep only)")
     fs.define_bool("fake_data", True,
                    "use synthetic data (the --use_fake_data pattern)")
     fs.define_string("speech_data", "",
@@ -521,6 +526,37 @@ def run_flash_autotune(fs: FlagSet) -> List[Any]:
         rows.append(row)
         star = " *" if r["best"] else ""
         print(f"  {row.bench_id}: {row.value:.1f} {row.unit}{star}")
+    # sparse schedule sweep (--mask=local:1024,doc): per-mask-signature
+    # winners land in the cache's "sparse" section, where
+    # select_block_sizes(mask_sig=…) — and therefore every sparse
+    # flash_attention call — reads them distinctly from dense winners
+    if fs.mask:
+        from tosem_tpu.ops.flash_blocks import autotune_sparse
+        if fs.device == "cpu":
+            sparse_shapes = [(1, 2, fs.seq or 256, 32, "float32")]
+        elif fs.seq:
+            sparse_shapes = [(max(1, (8 * 512) // fs.seq), 12, fs.seq,
+                              64, fs.dtype or "bfloat16")]
+        else:
+            sparse_shapes = [(1, 12, 8192, 64, "bfloat16")]
+        specs = [s for s in fs.mask.split(",") if s]
+        for r in autotune_sparse(sparse_shapes, specs, reps=3):
+            B, H, T, D, dtype = r["shape"]
+            bq, bk = r["blocks"][0], r["blocks"][1]
+            row = ResultRow(
+                project="ops", config="flash_autotune",
+                bench_id=f"flash_sparse_b{B}_t{T}_{dtype}_"
+                         f"{r['mask']}_bq{bq}_bk{bk}",
+                metric="time_us", value=r["time_us"], unit="us",
+                device=platform, n_devices=1,
+                extra={"shape": [B, H, T, D], "dtype": dtype,
+                       "mask": r["mask"], "blocks": r["blocks"],
+                       "executed_block_fraction":
+                           r["executed_block_fraction"],
+                       "best": r["best"], "cache": DEFAULT_CACHE_PATH})
+            rows.append(row)
+            star = " *" if r["best"] else ""
+            print(f"  {row.bench_id}: {row.value:.1f} {row.unit}{star}")
     print(f"  winners -> {DEFAULT_CACHE_PATH}")
     return rows
 
@@ -565,6 +601,51 @@ def run_autotune_decode_pages(fs: FlagSet) -> List[Any]:
         star = " *" if r["best"] else ""
         print(f"  {row.bench_id}: {row.value:.1f} {row.unit}{star}")
     print(f"  page winners -> {DEFAULT_CACHE_PATH}")
+    return rows
+
+
+def run_flash_sparse(fs: FlagSet) -> List[Any]:
+    """Block-sparse mask-program evidence leg: sweep sparse schedules
+    (winners → the cache's "sparse" section) then run the long-context
+    scenario rows — dense-causal vs sliding-window vs doc-packed at the
+    same shape, each with the schedule-aware FLOP model
+    (``extra.executed_block_fraction``). On-chip this is where the
+    t8192 local-attention claim gets its MFU-honest numbers; on CPU a
+    tiny interpret-mode smoke keeps the leg CI-runnable."""
+    from tosem_tpu.ops.flash_blocks import DEFAULT_CACHE_PATH, autotune_sparse
+    from tosem_tpu.ops.kernel_suite import sparse_kernel_suite
+
+    if fs.device == "cpu":   # interpret-mode smoke: one tiny shape
+        seq, window = fs.seq or 512, 128
+        rows = sparse_kernel_suite(batch=1, seq=seq, heads=2, head_dim=32,
+                                   dtype=fs.dtype or "float32",
+                                   window=window, reps=1)
+    else:
+        seq = fs.seq or 8192
+        window = 1024
+        batch = fs.batch or max(1, (8 * 512) // seq)
+        # land sparse block winners BEFORE the scenario rows so they
+        # measure with tuned blocks (the flash_autotune discipline).
+        # Sweep BOTH the scenario signatures (causal window, doc+causal)
+        # and the signatures serve actually routes onto — the symmetric
+        # encoder band local:W:W-1 and the block-diagonal doc:L
+        # (feeding.sparse_mask_spec) — since the "sparse" cache is
+        # keyed by exact signature
+        autotune_sparse([(batch, 12, seq, 64, fs.dtype or "bfloat16")],
+                        (f"local:{window}",
+                         f"local:{window}:{window - 1}",
+                         f"doc:{seq // 4}",
+                         f"doc:{seq // 4}+causal"),
+                        reps=3)
+        rows = sparse_kernel_suite(batch=batch, seq=seq, heads=12,
+                                   head_dim=64,
+                                   dtype=fs.dtype or "bfloat16",
+                                   window=window, reps=3)
+        print(f"  sparse winners -> {DEFAULT_CACHE_PATH}")
+    for r in rows:
+        frac = r.extra.get("executed_block_fraction")
+        print(f"  {r.bench_id} {r.metric}: {r.value:.2f} {r.unit} "
+              f"(executed {frac:.3f}, blocks {r.extra['blocks_src']})")
     return rows
 
 
@@ -1013,6 +1094,7 @@ RUNNERS = {
     "bert_train": run_bert_train,
     "flash_autotune": run_flash_autotune,
     "autotune_decode_pages": run_autotune_decode_pages,
+    "flash_sparse": run_flash_sparse,
     "detection_train": run_detection_train,
     "detection_infer": run_detection_infer,
     "pointpillars_infer": run_pointpillars_infer,

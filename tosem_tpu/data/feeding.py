@@ -163,6 +163,31 @@ def bucket_for(length: int, boundaries: Sequence[int]) -> Optional[int]:
     return None
 
 
+def sparse_mask_spec(pad_t: int, *, local_window: Optional[int] = None,
+                     doc_len: Optional[int] = None) -> Optional[str]:
+    """Which block-sparse mask spec a batch padded to ``pad_t`` should
+    ride, or None for the dense path.
+
+    The single routing rule shared by the serving backends (the
+    :func:`bucket_for` companion for sparsity): a sliding window only
+    pays once the bucket spans more than twice the window (below that
+    the band covers every block and the schedule is the dense grid with
+    extra bookkeeping), and document packing only once a row holds more
+    than one document. Windowed buckets get the symmetric encoder band
+    ``local:W:W-1`` (W keys of left context incl. self, W-1 right);
+    doc-packed buckets get the block-diagonal ``doc:L``. Both compose
+    — longest-context rule first — and either way the request-level
+    key-padding mask still applies dynamically as segment ids on top.
+    """
+    specs = []
+    if doc_len is not None and doc_len >= 1 and pad_t > doc_len:
+        specs.append(f"doc:{doc_len}")
+    if local_window is not None and local_window >= 1 \
+            and pad_t > 2 * local_window:
+        specs.append(f"local:{local_window}:{local_window - 1}")
+    return "+".join(specs) if specs else None
+
+
 def pad_target(length: int, boundaries: Sequence[int],
                align: int = 1) -> int:
     """Pad target for a sequence at serving time: its palette bucket

@@ -38,8 +38,18 @@ def dot_product_attention(q, k, v, mask: Optional[jax.Array] = None, *,
 # trace-time dispatch tally: which attention core ran per traced call.
 # The padded-batch A/B test asserts the flash path actually fired (a
 # silent XLA fallback is exactly the regression this guards against).
-# A Counter so callers may clear() it between measurements.
+# Besides the "flash"/"xla" aggregates, every dispatch also bumps a
+# mask-signature-qualified key ("flash:causal", "flash:local:1024:0",
+# "xla:dense", …) so A/B tests can assert the SPARSE path specifically —
+# the aggregate alone cannot distinguish a causal-flash dispatch from a
+# dense-flash one. A Counter so callers may clear() it between
+# measurements.
 FLASH_DISPATCH_COUNTS = collections.Counter({"flash": 0, "xla": 0})
+
+
+def _tally(path: str, sig: str) -> None:
+    FLASH_DISPATCH_COUNTS[path] += 1
+    FLASH_DISPATCH_COUNTS[f"{path}:{sig}"] += 1
 
 
 def _as_key_padding(mask, B: int, Tk: int) -> Optional[jax.Array]:
@@ -57,7 +67,8 @@ def _as_key_padding(mask, B: int, Tk: int) -> Optional[jax.Array]:
     return kv
 
 
-def flash_attn_fn(causal: bool = False, precision: str = "default"):
+def flash_attn_fn(causal: bool = False, precision: str = "default",
+                  mask=None):
     """An ``attn_fn`` for :class:`MultiHeadAttention` that routes
     eligible shapes through the Pallas flash kernel (bf16-native MXU
     path) and falls back to the XLA path otherwise. Key-padding masks
@@ -66,33 +77,64 @@ def flash_attn_fn(causal: bool = False, precision: str = "default"):
     ids the mask — which reproduces the XLA key-mask semantics exactly
     (every query attends exactly the real keys). Only query-/
     head-dependent dense masks, or sequence lengths that don't tile,
-    fall back; the fallback preserves causality (folded into the mask)
-    and the requested matmul precision, so swapping ``attn_fn`` never
-    changes semantics, only the kernel. Thread it through a model's
-    ``apply(..., attn_fn=flash_attn_fn())`` — e.g. BERT-base on TPU."""
+    fall back; the fallback preserves causality and any mask program
+    (both folded into a dense mask) and the requested matmul precision,
+    so swapping ``attn_fn`` never changes semantics, only the kernel
+    (caveat: a query row whose mask admits NO keys is finite garbage on
+    both paths but not the SAME garbage — the ``SegmentIds`` empty-row
+    caveat; standard masks never create such rows at Tq == Tk).
+
+    ``mask`` is a static :class:`~tosem_tpu.ops.mask_programs.Mask`
+    (sliding window, prefix-LM, packed documents, per-head
+    compositions) compiled once into a block schedule — skipped blocks
+    pay neither MXU nor HBM, and the model's runtime key-padding mask
+    still composes as segment ids on top. Thread it through a model's
+    ``apply(..., attn_fn=flash_attn_fn(mask=LocalMask(1024)))`` — e.g.
+    long-document BERT serving at t8192."""
     from tosem_tpu.ops.flash_attention import (SegmentIds,
                                                mha_flash_attention)
 
-    def core(q, k, v, mask):
+    if mask is not None:
+        # the tally key carries the EFFECTIVE mask: causal composes
+        # with the program the same way the kernel composes them
+        if causal:
+            from tosem_tpu.ops.mask_programs import CausalMask
+            sig = (mask & CausalMask()).signature()
+        else:
+            sig = mask.signature()
+    else:
+        sig = "causal" if causal else "dense"
+
+    def core(q, k, v, attn_mask):
         B, Tq = q.shape[0], q.shape[1]
         Tk = k.shape[1]
         # the Mosaic kernel needs (sublane, lane) tile-aligned sequence
         # lengths, so short ragged T falls back to XLA
         blocks_ok = Tq % 8 == 0 and Tk % 128 == 0
-        kv_mask = _as_key_padding(mask, B, Tk)
-        if blocks_ok and (mask is None or kv_mask is not None):
+        kv_mask = _as_key_padding(attn_mask, B, Tk)
+        if blocks_ok and (attn_mask is None or kv_mask is not None):
             seg = None
             if kv_mask is not None:
                 seg = SegmentIds(q=jnp.ones((B, Tq), jnp.int32),
                                  kv=kv_mask.astype(jnp.int32))
-            FLASH_DISPATCH_COUNTS["flash"] += 1
+            _tally("flash", sig)
             return mha_flash_attention(q, k, v, causal=causal,
-                                       segment_ids=seg)
-        FLASH_DISPATCH_COUNTS["xla"] += 1
+                                       segment_ids=seg,
+                                       mask_program=mask)
+        _tally("xla", sig)
         if causal:
             cm = jnp.tril(jnp.ones((Tq, Tk), bool))[None, None]
-            mask = cm if mask is None else jnp.logical_and(mask, cm)
-        return dot_product_attention(q, k, v, mask, precision=precision)
+            attn_mask = cm if attn_mask is None \
+                else jnp.logical_and(attn_mask, cm)
+        if mask is not None:
+            # fold the mask program into the dense fallback: [Tq, Tk]
+            # (uniform) or [H, Tq, Tk] (per-head) broadcast over batch
+            dm = jnp.asarray(mask.dense(Tq, Tk))
+            dm = dm[None, None] if dm.ndim == 2 else dm[None]
+            attn_mask = dm if attn_mask is None \
+                else jnp.logical_and(attn_mask, dm)
+        return dot_product_attention(q, k, v, attn_mask,
+                                     precision=precision)
     return core
 
 

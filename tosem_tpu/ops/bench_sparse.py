@@ -1,0 +1,143 @@
+"""Block-sparse attention microbench: t8192 LocalMask vs dense-causal.
+
+The acceptance measurement for the mask-program subsystem: at t8192 a
+sliding-window schedule (``LocalMask(1024)``) must beat the dense-causal
+flash path, because it executes ~1/3 of causal's block pairs — and the
+speedup must be HONEST (the row carries both schedules'
+``executed_block_fraction``, and the per-arm rates are its/s, not
+flop-model-inflated MFU).
+
+Bench-noise protocol (the ``bench_runtime`` A/B discipline): each round
+runs BOTH arms back to back (interleaved — both see the same host
+phase), per-round rates are recorded, the speedup is computed in-round
+(phase-immune), and ``--save`` floors the baseline at the min across
+rounds. ``ci.sh --perf`` gates the speedup row against
+``results/bench_sparse.json``.
+
+Off-chip the arms run :func:`~tosem_tpu.ops.mask_programs.
+schedule_attention_xla` — the pure-XLA lowering of the SAME schedules
+(PR-6 ``impl="pallas"|"xla"`` pattern), so the CPU gate measures the
+real executed-blocks effect instead of interpret-mode noise; on TPU the
+arms are the Pallas kernels themselves.
+"""
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+from tosem_tpu.utils.results import ResultRow
+
+# the ci.sh --perf gated subset: the phase-immune in-round ratio
+GATED_SPARSE_BENCHES = ("sparse_local_speedup_t8192",)
+
+
+def _rate(fn, args, budget_s: float) -> float:
+    """Iterations/second of ``fn`` over a >= ``budget_s`` window. One
+    untimed warmup call per window (page faults / allocator warm-up
+    land outside the measurement) and at least TWO timed iterations —
+    t8192 iterations are seconds on CPU, and a one-iteration window
+    measures launch jitter, not the kernel."""
+    import jax
+    jax.block_until_ready(fn(*args))
+    n, t0 = 0, time.perf_counter()
+    while True:
+        jax.block_until_ready(fn(*args))
+        n += 1
+        dt = time.perf_counter() - t0
+        if dt >= budget_s and n >= 2:
+            return n / dt
+
+
+def run_sparse_benchmarks(trials: int = 3, min_s: float = 0.5,
+                          quiet: bool = False,
+                          only: Optional[set] = None, *,
+                          seq: int = 8192, window: int = 1024,
+                          batch: int = 1, heads: int = 1,
+                          head_dim: int = 64) -> List[ResultRow]:
+    import jax
+    import jax.numpy as jnp
+
+    from tosem_tpu.ops.flash_attention import flash_attention
+    from tosem_tpu.ops.flash_blocks import select_block_sizes
+    from tosem_tpu.ops.mask_programs import (CausalMask, LocalMask,
+                                             compile_mask_programs,
+                                             program_stats,
+                                             schedule_attention_xla)
+    from tosem_tpu.serve.bench_common import SuiteEmitter
+
+    on_tpu = jax.default_backend() == "tpu"
+    impl = "pallas" if on_tpu else "xla"
+    dtype = "bfloat16" if on_tpu else "float32"
+    dt = jnp.dtype(dtype)
+    B, H, T, D = batch, heads, seq, head_dim
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (B, H, T, D), jnp.float32).astype(dt)
+    k = jax.random.normal(ks[1], (B, H, T, D), jnp.float32).astype(dt)
+    v = jax.random.normal(ks[2], (B, H, T, D), jnp.float32).astype(dt)
+
+    arms = {}
+    fracs = {}
+    for name, mask in (("causal", CausalMask()),
+                       ("local", LocalMask(window))):
+        sig = mask.signature()
+        blocks = select_block_sizes(T, D, dtype, mask_sig=sig)
+        stats = program_stats(mask, T, T, blocks, heads=H)["fwd"]
+        if on_tpu:
+            fracs[name] = stats.fraction
+            fn = jax.jit(lambda a, b, c, m=mask, bl=blocks:
+                         flash_attention(a, b, c, mask=m, block_sizes=bl))
+        else:
+            # the XLA gather lowering pads every row to the schedule's
+            # max stream length, so its honest executed fraction is
+            # L/n_minor — 1.0 for causal (effectively dense off-chip,
+            # which is exactly why the local schedule wins there), the
+            # banded ~3/16 for local
+            progs = compile_mask_programs(mask, T, T, blocks, heads=H)
+            n_minor = T // int(progs.fwd.mask_blocks.shape[2])
+            fracs[name] = stats.stream_len / float(n_minor)
+            fn = jax.jit(lambda a, b, c, s=progs.fwd:
+                         schedule_attention_xla(a, b, c, s))
+        jax.block_until_ready(fn(q, k, v))            # compile outside
+        arms[name] = fn
+
+    em = SuiteEmitter("sparse", only)
+    per_round: dict = {"causal": [], "local": [], "speedup": []}
+    for _ in range(max(trials, 1)):
+        # interleaved: both arms share this round's host phase
+        rc = _rate(arms["causal"], (q, k, v), min_s)
+        rl = _rate(arms["local"], (q, k, v), min_s)
+        per_round["causal"].append(rc)
+        per_round["local"].append(rl)
+        per_round["speedup"].append(rl / rc)
+
+    extra = {"impl": impl, "dtype": dtype, "window": window,
+             "shape": [B, H, T, D],
+             "executed_block_fraction_causal": fracs["causal"],
+             "executed_block_fraction_local": fracs["local"]}
+    r = em.emit(f"sparse_causal_t{T}", f"dense-causal t{T} fwd ({impl})",
+                per_round["causal"], unit="it/s")
+    if r:
+        r.extra.update(extra,
+                       executed_block_fraction=fracs["causal"])
+    r = em.emit(f"sparse_local_t{T}",
+                f"LocalMask({window}) t{T} fwd ({impl})",
+                per_round["local"], unit="it/s")
+    if r:
+        r.extra.update(extra, executed_block_fraction=fracs["local"])
+    r = em.emit(f"sparse_local_speedup_t{T}",
+                f"t{T} local-vs-causal speedup (in-round)",
+                per_round["speedup"], unit="x")
+    if r:
+        r.extra.update(extra)
+    return em.flush(quiet)
+
+
+def main(argv=None) -> int:
+    """Standalone entry: ``python -m tosem_tpu.ops.bench_sparse`` — the
+    cli route is ``python -m tosem_tpu.cli microbench --sparse``."""
+    from tosem_tpu.runtime.bench_runtime import main as micro_main
+    return micro_main(["--sparse"] + (argv or []))
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -19,18 +19,28 @@ Mosaic double-buffers the HBM→VMEM chunk copies against MXU compute, and
 VMEM residency is O(block·d) per operand instead of O(T·d) — long-context
 legs (t4096+) run at full block sizes.
 
-Causal block skipping happens at the grid level: chunks strictly above the
-diagonal are masked off with ``pl.when`` (no MXU work) AND their BlockSpec
-index maps are clamped to the last needed chunk (no HBM copy) — skipped
-cells cost nothing, halving causal FLOPs, and only diagonal-straddling
-blocks pay the ``jnp.where`` (via ``lax.cond``; interior blocks skip it).
+Block-sparse masks (the round-10 generalization of PR 4's causal clamp):
+a static :class:`~tosem_tpu.ops.mask_programs.Mask` — causal, sliding
+window, prefix-LM, packed documents, per-head compositions — compiles
+ONCE into a :class:`~tosem_tpu.ops.mask_programs.BlockSchedule`, and the
+grid's stream dimension walks the SCHEDULE instead of the dense chunk
+range: schedule arrays ride in as Mosaic scalar-prefetch operands, the
+BlockSpec index maps gather exactly the scheduled chunks (a skipped
+block pays neither MXU nor HBM — its revisited index suppresses the
+copy), KIND_FULL entries skip the mask ``jnp.where`` entirely, and only
+KIND_PARTIAL entries fetch their (bq, bk) bitmap and mask in-cell.
+``causal=True`` is now literally ``mask=CausalMask()`` — the old
+hard-coded diagonal clamp is one schedule among many, with unchanged
+numerics (same blocks, same order, same arithmetic).
 
-Padding/segment masks are kernel-level: ``SegmentIds`` (q, kv) int32
-arrays gate attention to equal ids — a key-padding mask is q=1 everywhere,
-kv=the mask — so padded BERT batches stay on the flash path. Per-row
-statistics (m, l, lse, delta) travel broadcast across a 128-lane minor dim
-(the official TPU flash kernel's MIN_BLOCK_SIZE trick); kv segment ids
-travel broadcast across 8 sublanes.
+Padding/segment masks stay kernel-level and DYNAMIC: ``SegmentIds``
+(q, kv) int32 arrays gate attention to equal ids — a key-padding mask is
+q=1 everywhere, kv=the mask — so padded BERT batches stay on the flash
+path, composing with any schedule (the schedule prunes statically, the
+segment ``where`` refines in-cell). Per-row statistics (m, l, lse,
+delta) travel broadcast across a 128-lane minor dim (the official TPU
+flash kernel's MIN_BLOCK_SIZE trick); kv segment ids travel broadcast
+across 8 sublanes.
 
 Layouts: the kernels slice one (rows, d) head tile per grid cell via
 ``None``-squeezed BlockSpecs, so the SAME kernel body serves the
@@ -46,7 +56,8 @@ cast back to the operand dtype only for the PV-style matmuls. The softmax
 scale is applied to the fp32 scores, never to the operands.
 
 Block sizes come from :mod:`tosem_tpu.ops.flash_blocks` (selection table
-+ VMEM-budget fallback + on-chip autotune cache). The XLA reference for
++ VMEM-budget fallback + on-chip autotune cache, with a mask-signature-
+keyed "sparse" section for scheduled shapes). The XLA reference for
 parity tests is ``tosem_tpu.nn.attention.dot_product_attention``.
 """
 from __future__ import annotations
@@ -63,6 +74,9 @@ from jax.experimental.pallas import tpu as pltpu
 
 from tosem_tpu.ops.common import interpret_default as _interpret
 from tosem_tpu.ops.flash_blocks import BlockSizes, select_block_sizes
+from tosem_tpu.ops.mask_programs import (KIND_PARTIAL, CausalMask, Mask,
+                                         MaskPrograms,
+                                         compile_mask_programs)
 
 DEFAULT_BQ = 128
 DEFAULT_BK = 128
@@ -83,6 +97,10 @@ _CompilerParams = getattr(pltpu, "CompilerParams", None) or \
 _STREAMED = _CompilerParams(
     dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"))
 
+# number of schedule arrays fed to Mosaic as scalar-prefetch operands
+# (num, blk, kind, mid — see mask_programs.BlockSchedule)
+_N_SCHED = 4
+
 
 class SegmentIds(NamedTuple):
     """Per-token segment ids gating attention to equal ids.
@@ -97,30 +115,14 @@ class SegmentIds(NamedTuple):
     kv: jax.Array
 
 
-def _causal_mask(bq: int, bk: int, qi, kj):
-    rows = lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + qi
-    cols = lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + kj
-    return rows >= cols
-
-
-def _apply_masks(s, *, causal, qi, kj, bq, bk, qseg_ref, kseg_ref):
-    """Mask fp32 scores in place of the score matrix.
-
-    Causal: skipped entirely for interior (fully-unmasked) blocks — the
-    grid never schedules fully-masked blocks, so only diagonal-straddling
-    chunks pay the ``jnp.where`` (``lax.cond`` keeps it off the interior
-    blocks' critical path)."""
-    if causal:
-        s = lax.cond(
-            qi < kj + bk - 1,       # block straddles the diagonal
-            lambda x: jnp.where(_causal_mask(bq, bk, qi, kj), x, _NEG_INF),
-            lambda x: x,
-            s)
-    if qseg_ref is not None:
-        qseg = qseg_ref[:, 0:1]                      # (bq, 1), lanes equal
-        kseg = kseg_ref[0:1, :]                      # (1, bk), sublanes eq.
-        s = jnp.where(qseg == kseg, s, _NEG_INF)
-    return s
+def _seg_where(s, qseg_ref, kseg_ref):
+    """Apply the dynamic segment mask to fp32 scores. Runs AFTER the
+    schedule bitmap (schedule prunes statically; segments refine)."""
+    if qseg_ref is None:
+        return s
+    qseg = qseg_ref[:, 0:1]                      # (bq, 1), lanes equal
+    kseg = kseg_ref[0:1, :]                      # (1, bk), sublanes eq.
+    return jnp.where(qseg == kseg, s, _NEG_INF)
 
 
 def _read_stat(ref):
@@ -131,33 +133,66 @@ def _read_stat(ref):
 def _tile_spec(layout: str, rows: int, d: int, row_idx):
     """BlockSpec slicing one (rows, d) single-head tile.
 
-    ``row_idx(t, s)`` maps the (tile, stream) grid ids to the T-axis
-    block index; B and H grid dims index their array dims directly. The
-    ``None`` entries squeeze the B/H axes so the kernel sees a plain
-    (rows, d) ref in BOTH layouts — no transposed copies anywhere."""
+    ``row_idx(h, t, s, *sched_refs)`` maps the (head, tile, stream)
+    grid ids — plus, on scheduled calls, the scalar-prefetched schedule
+    refs — to the T-axis block index; B and H grid dims index their
+    array dims directly. The ``None`` entries squeeze the B/H axes so
+    the kernel sees a plain (rows, d) ref in BOTH layouts — no
+    transposed copies anywhere."""
     if layout == "bhtd":
         return pl.BlockSpec((None, None, rows, d),
-                            lambda b, h, t, s: (b, h, row_idx(t, s), 0))
+                            lambda b, h, t, s, *sr:
+                            (b, h, row_idx(h, t, s, *sr), 0))
     if layout == "bthd":
         return pl.BlockSpec((None, rows, None, d),
-                            lambda b, h, t, s: (b, row_idx(t, s), h, 0))
+                            lambda b, h, t, s, *sr:
+                            (b, row_idx(h, t, s, *sr), h, 0))
     raise ValueError(f"unknown layout {layout!r}")
 
 
 def _lanes_spec(rows: int, row_idx):
     """BlockSpec for a [B, H, T, LANES] lanes-broadcast statistic."""
     return pl.BlockSpec((None, None, rows, _LANES),
-                        lambda b, h, t, s: (b, h, row_idx(t, s), 0))
+                        lambda b, h, t, s, *sr:
+                        (b, h, row_idx(h, t, s, *sr), 0))
 
 
 def _qseg_spec(rows: int, row_idx):
     return pl.BlockSpec((None, rows, _LANES),
-                        lambda b, h, t, s: (b, row_idx(t, s), 0))
+                        lambda b, h, t, s, *sr:
+                        (b, row_idx(h, t, s, *sr), 0))
 
 
 def _kseg_spec(cols: int, col_idx):
     return pl.BlockSpec((None, _SUBLANES, cols),
-                        lambda b, h, t, s: (b, 0, col_idx(t, s)))
+                        lambda b, h, t, s, *sr:
+                        (b, 0, col_idx(h, t, s, *sr)))
+
+
+def _maskblock_spec(bq: int, bk: int):
+    """BlockSpec streaming the (bq, bk) partial-mask bitmap the
+    schedule's ``mid`` entry names; full-block entries carry the
+    previous id forward, so the revisited index suppresses refetches."""
+    def idx(b, h, t, s, num_ref, blk_ref, kind_ref, mid_ref):
+        hs = jnp.minimum(h, num_ref.shape[0] - 1)
+        return (mid_ref[hs, t, jnp.minimum(s, num_ref[hs, t] - 1)], 0, 0)
+    return pl.BlockSpec((None, bq, bk), idx)
+
+
+def _sched_row(h, t, s, num_ref, blk_ref, kind_ref, mid_ref):
+    """Minor-axis block index for stream step ``s`` of resident tile
+    ``t`` — inactive trailing steps clamp to the last active entry, so
+    their (revisited) index map suppresses the HBM→VMEM copy."""
+    hs = jnp.minimum(h, num_ref.shape[0] - 1)
+    return blk_ref[hs, t, jnp.minimum(s, num_ref[hs, t] - 1)]
+
+
+def _resident(h, t, s, *sr):
+    return t
+
+
+def _stream_id(h, t, s, *sr):
+    return s
 
 
 def _seg_operands(segment_ids, B, Tq, Tk):
@@ -167,6 +202,27 @@ def _seg_operands(segment_ids, B, Tq, Tk):
     kseg = jnp.broadcast_to(
         segment_ids.kv.astype(jnp.int32)[:, None, :], (B, _SUBLANES, Tk))
     return qseg, kseg
+
+
+def _sched_args(sched):
+    """Schedule arrays in scalar-prefetch order, as int32."""
+    return tuple(jnp.asarray(a, jnp.int32)
+                 for a in (sched.num, sched.blk, sched.kind, sched.mid))
+
+
+def _check_schedule(sched, n_major: int, bq: int, bk: int, who: str):
+    """Trace-time shape validation of a schedule against the resolved
+    blocks — catches a program compiled for different chunk sizes
+    before Mosaic turns it into an opaque index-map error."""
+    if tuple(sched.mask_blocks.shape[1:]) != (bq, bk):
+        raise ValueError(
+            f"{who} schedule bitmaps are {tuple(sched.mask_blocks.shape[1:])}"
+            f", kernel blocks are ({bq}, {bk}) — recompile the mask "
+            "programs at the resolved BlockSizes")
+    if sched.num.shape[1] != n_major:
+        raise ValueError(
+            f"{who} schedule covers {sched.num.shape[1]} resident tiles, "
+            f"kernel grid has {n_major}")
 
 
 def _shapes(layout, x):
@@ -184,21 +240,47 @@ def _check_blocks(Tq, Tk, bq, bk):
                          f"blocks ({bq},{bk})")
 
 
+def _pallas_call(kernel, *, grid, in_specs, out_specs, out_shape,
+                 scratch_shapes, scheduled):
+    """One pallas_call surface for both paths: scheduled calls wrap the
+    grid in ``PrefetchScalarGridSpec`` (schedule arrays land in SMEM
+    before the body runs; every index map receives them trailing), the
+    dense path keeps the plain grid."""
+    if scheduled:
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=_N_SCHED, grid=grid, in_specs=in_specs,
+            out_specs=out_specs, scratch_shapes=scratch_shapes)
+        return pl.pallas_call(kernel, grid_spec=grid_spec,
+                              out_shape=out_shape,
+                              compiler_params=_STREAMED,
+                              interpret=_interpret())
+    return pl.pallas_call(kernel, grid=grid, in_specs=in_specs,
+                          out_specs=out_specs, out_shape=out_shape,
+                          scratch_shapes=scratch_shapes,
+                          compiler_params=_STREAMED,
+                          interpret=_interpret())
+
+
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, *rest, sm_scale, causal, segmented,
-                bq, bk, n_k):
+def _fwd_kernel(*refs, sm_scale, segmented, scheduled, bq, bk, n_k):
+    if scheduled:
+        num_ref, blk_ref, kind_ref, mid_ref = refs[:_N_SCHED]
+        refs = refs[_N_SCHED:]
+    q_ref, k_ref, v_ref = refs[:3]
+    refs = refs[3:]
+    mb_ref = None
+    if scheduled:
+        mb_ref, *refs = refs
     if segmented:
-        qseg_ref, kseg_ref, o_ref, lse_ref, m_sc, l_sc, acc_sc = rest
+        qseg_ref, kseg_ref, *refs = refs
     else:
-        o_ref, lse_ref, m_sc, l_sc, acc_sc = rest
         qseg_ref = kseg_ref = None
+    o_ref, lse_ref, m_sc, l_sc, acc_sc = refs
     i = pl.program_id(2)                             # q tile
     j = pl.program_id(3)                             # streamed k/v chunk
-    qi = i * bq
-    kj = j * bk
 
     @pl.when(j == 0)
     def _init():
@@ -206,11 +288,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, sm_scale, causal, segmented,
         l_sc[...] = jnp.zeros(l_sc.shape, jnp.float32)
         acc_sc[...] = jnp.zeros(acc_sc.shape, jnp.float32)
 
-    # causal: the last K chunk this Q tile attends (clamped to the K
-    # buffer so Tq > Tk never reads past the end); chunks beyond it are
-    # never computed and (via the clamped index maps) never copied
-    j_last = jnp.minimum((qi + bq - 1) // bk, n_k - 1) if causal \
-        else n_k - 1
+    if scheduled:
+        hs = jnp.minimum(pl.program_id(1), num_ref.shape[0] - 1)
+        j_last = num_ref[hs, i] - 1      # schedules always hold >= 1 entry
+    else:
+        j_last = n_k - 1
 
     def _step():
         q = q_ref[...]                               # (bq, d), native dtype
@@ -219,8 +301,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, sm_scale, causal, segmented,
         cdt = q.dtype                                # MXU operand dtype
         s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * sm_scale
-        s = _apply_masks(s, causal=causal, qi=qi, kj=kj, bq=bq, bk=bk,
-                         qseg_ref=qseg_ref, kseg_ref=kseg_ref)
+        if scheduled:
+            # KIND_FULL entries skip the where (lax.cond keeps it off
+            # their critical path); only KIND_PARTIAL pays the bitmap
+            s = lax.cond(
+                kind_ref[hs, i, j] == KIND_PARTIAL,
+                lambda x: jnp.where(mb_ref[...] != 0, x, _NEG_INF),
+                lambda x: x, s)
+        s = _seg_where(s, qseg_ref, kseg_ref)
         m_prev = _read_stat(m_sc)
         l_prev = _read_stat(l_sc)
         m_new = jnp.maximum(m_prev, jnp.max(s, -1, keepdims=True))
@@ -233,7 +321,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, sm_scale, causal, segmented,
         m_sc[...] = jnp.broadcast_to(m_new, m_sc.shape)
         l_sc[...] = jnp.broadcast_to(l_new, l_sc.shape)
 
-    if causal:
+    if scheduled:
         @pl.when(j <= j_last)
         def _run():
             _step()
@@ -249,45 +337,56 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, sm_scale, causal, segmented,
         lse_ref[...] = jnp.broadcast_to(m + jnp.log(l_safe), lse_ref.shape)
 
 
-def _flash_fwd(q, k, v, segment_ids, sm_scale, causal, blocks, layout):
+def _flash_fwd(q, k, v, segment_ids, programs, sm_scale, blocks, layout):
     B, Tq, H, d = _shapes(layout, q)
     _, Tk, _, _ = _shapes(layout, k)
     blocks = blocks.clamp(Tq, Tk)
     bq, bk = blocks.bq, blocks.bk
     _check_blocks(Tq, Tk, bq, bk)
     n_k = Tk // bk
+    scheduled = programs is not None
 
-    def kv_idx(t, s):
-        # clamp skipped (fully-masked) chunks to the last needed one so
-        # the revisited index suppresses their HBM→VMEM copy entirely
-        return jnp.minimum(s, (t * bq + bq - 1) // bk) if causal else s
+    if scheduled:
+        sched = programs.fwd
+        _check_schedule(sched, Tq // bq, bq, bk, "fwd")
+        stream = sched.blk.shape[2]
+        kv_idx = _sched_row
+    else:
+        stream = n_k
+        kv_idx = _stream_id
 
-    in_specs = [_tile_spec(layout, bq, d, lambda t, s: t),
+    in_specs = [_tile_spec(layout, bq, d, _resident),
                 _tile_spec(layout, bk, d, kv_idx),
                 _tile_spec(layout, bk, d, kv_idx)]
     args = [q, k, v]
+    if scheduled:
+        in_specs.append(_maskblock_spec(bq, bk))
+        args.append(jnp.asarray(sched.mask_blocks, jnp.int32))
     segmented = segment_ids is not None
     if segmented:
         qseg, kseg = _seg_operands(segment_ids, B, Tq, Tk)
-        in_specs += [_qseg_spec(bq, lambda t, s: t),
+        in_specs += [_qseg_spec(bq, _resident),
                      _kseg_spec(bk, kv_idx)]
         args += [qseg, kseg]
     o_shape = ((B, H, Tq, d) if layout == "bhtd" else (B, Tq, H, d))
-    out, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
-                          segmented=segmented, bq=bq, bk=bk, n_k=n_k),
-        grid=(B, H, Tq // bq, n_k),
+    call = _pallas_call(
+        functools.partial(_fwd_kernel, sm_scale=sm_scale,
+                          segmented=segmented, scheduled=scheduled,
+                          bq=bq, bk=bk, n_k=n_k),
+        grid=(B, H, Tq // bq, stream),
         in_specs=in_specs,
-        out_specs=[_tile_spec(layout, bq, d, lambda t, s: t),
-                   _lanes_spec(bq, lambda t, s: t)],
+        out_specs=[_tile_spec(layout, bq, d, _resident),
+                   _lanes_spec(bq, _resident)],
         out_shape=[jax.ShapeDtypeStruct(o_shape, q.dtype),
                    jax.ShapeDtypeStruct((B, H, Tq, _LANES), jnp.float32)],
         scratch_shapes=[pltpu.VMEM((bq, _LANES), jnp.float32),
                         pltpu.VMEM((bq, _LANES), jnp.float32),
                         pltpu.VMEM((bq, d), jnp.float32)],
-        compiler_params=_STREAMED,
-        interpret=_interpret(),
-    )(*args)
+        scheduled=scheduled)
+    if scheduled:
+        out, lse = call(*_sched_args(sched), *args)
+    else:
+        out, lse = call(*args)
     return out, lse                                  # lse in lanes layout
 
 
@@ -295,22 +394,33 @@ def _flash_fwd(q, k, v, segment_ids, sm_scale, causal, blocks, layout):
 # backward
 # ---------------------------------------------------------------------------
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
-                    sm_scale, causal, segmented, bq, bk, n_q):
+def _bwd_dkv_kernel(*refs, sm_scale, segmented, scheduled, bq, bk, n_q):
+    if scheduled:
+        num_ref, blk_ref, kind_ref, mid_ref = refs[:_N_SCHED]
+        refs = refs[_N_SCHED:]
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[:6]
+    refs = refs[6:]
+    mb_ref = None
+    if scheduled:
+        mb_ref, *refs = refs
     if segmented:
-        qseg_ref, kseg_ref, dk_ref, dv_ref, dk_sc, dv_sc = rest
+        qseg_ref, kseg_ref, *refs = refs
     else:
-        dk_ref, dv_ref, dk_sc, dv_sc = rest
         qseg_ref = kseg_ref = None
+    dk_ref, dv_ref, dk_sc, dv_sc = refs
     j = pl.program_id(2)                             # resident k/v tile
     i = pl.program_id(3)                             # streamed q/do chunk
-    kj = j * bk
-    qi = i * bq
 
     @pl.when(i == 0)
     def _init():
         dk_sc[...] = jnp.zeros(dk_sc.shape, jnp.float32)
         dv_sc[...] = jnp.zeros(dv_sc.shape, jnp.float32)
+
+    if scheduled:
+        hs = jnp.minimum(pl.program_id(1), num_ref.shape[0] - 1)
+        i_last = num_ref[hs, j] - 1
+    else:
+        i_last = n_q - 1
 
     def _step():
         k = k_ref[...]                               # (bk, d), native
@@ -322,8 +432,12 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
         delta = _read_stat(delta_ref)
         s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * sm_scale
-        s = _apply_masks(s, causal=causal, qi=qi, kj=kj, bq=bq, bk=bk,
-                         qseg_ref=qseg_ref, kseg_ref=kseg_ref)
+        if scheduled:
+            s = lax.cond(
+                kind_ref[hs, j, i] == KIND_PARTIAL,
+                lambda x: jnp.where(mb_ref[...] != 0, x, _NEG_INF),
+                lambda x: x, s)
+        s = _seg_where(s, qseg_ref, kseg_ref)
         p = jnp.exp(s - lse)                         # (bq, bk) fp32
         dv_sc[...] = dv_sc[...] + lax.dot_general(
             p.astype(cdt), do, (((0,), (0,)), ((), ())),
@@ -336,40 +450,45 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
             ds.astype(cdt), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    if causal:
-        # chunks whose every row precedes this K tile are fully masked:
-        # first contributing chunk is kj // bq (same bound the r5 in-cell
-        # loop used), earlier ones are never computed nor copied
-        @pl.when(i >= kj // bq)
+    if scheduled:
+        @pl.when(i <= i_last)
         def _run():
             _step()
     else:
         _step()
 
-    @pl.when(i == n_q - 1)
+    @pl.when(i == i_last)
     def _epilogue():
         dk_ref[...] = dk_sc[...].astype(dk_ref.dtype)
         dv_ref[...] = dv_sc[...].astype(dv_ref.dtype)
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
-                   sm_scale, causal, segmented, bq, bk, n_k):
+def _bwd_dq_kernel(*refs, sm_scale, segmented, scheduled, bq, bk, n_k):
+    if scheduled:
+        num_ref, blk_ref, kind_ref, mid_ref = refs[:_N_SCHED]
+        refs = refs[_N_SCHED:]
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[:6]
+    refs = refs[6:]
+    mb_ref = None
+    if scheduled:
+        mb_ref, *refs = refs
     if segmented:
-        qseg_ref, kseg_ref, dq_ref, dq_sc = rest
+        qseg_ref, kseg_ref, *refs = refs
     else:
-        dq_ref, dq_sc = rest
         qseg_ref = kseg_ref = None
+    dq_ref, dq_sc = refs
     i = pl.program_id(2)                             # resident q tile
     j = pl.program_id(3)                             # streamed k/v chunk
-    qi = i * bq
-    kj = j * bk
 
     @pl.when(j == 0)
     def _init():
         dq_sc[...] = jnp.zeros(dq_sc.shape, jnp.float32)
 
-    j_last = jnp.minimum((qi + bq - 1) // bk, n_k - 1) if causal \
-        else n_k - 1
+    if scheduled:
+        hs = jnp.minimum(pl.program_id(1), num_ref.shape[0] - 1)
+        j_last = num_ref[hs, i] - 1
+    else:
+        j_last = n_k - 1
 
     def _step():
         q = q_ref[...]                               # native, unscaled
@@ -381,8 +500,12 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
         delta = _read_stat(delta_ref)
         s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * sm_scale
-        s = _apply_masks(s, causal=causal, qi=qi, kj=kj, bq=bq, bk=bk,
-                         qseg_ref=qseg_ref, kseg_ref=kseg_ref)
+        if scheduled:
+            s = lax.cond(
+                kind_ref[hs, i, j] == KIND_PARTIAL,
+                lambda x: jnp.where(mb_ref[...] != 0, x, _NEG_INF),
+                lambda x: x, s)
+        s = _seg_where(s, qseg_ref, kseg_ref)
         p = jnp.exp(s - lse)
         dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
@@ -391,7 +514,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
             ds.astype(cdt), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    if causal:
+    if scheduled:
         @pl.when(j <= j_last)
         def _run():
             _step()
@@ -403,8 +526,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
         dq_ref[...] = (dq_sc[...] * sm_scale).astype(dq_ref.dtype)
 
 
-def _flash_bwd(sm_scale, causal, blocks, layout, res, g):
-    q, k, v, out, lse, segment_ids = res
+def _flash_bwd(sm_scale, blocks, layout, res, g):
+    q, k, v, out, lse, segment_ids, programs = res
     do = g
     B, Tq, H, d = _shapes(layout, q)
     _, Tk, _, _ = _shapes(layout, k)
@@ -412,6 +535,7 @@ def _flash_bwd(sm_scale, causal, blocks, layout, res, g):
     bq, bk = blocks.bq_bwd, blocks.bk_bwd
     _check_blocks(Tq, Tk, bq, bk)
     n_q, n_k = Tq // bq, Tk // bk
+    scheduled = programs is not None
     # delta = rowsum(do * out), fp32, in the lanes-broadcast layout —
     # [B, H, Tq, LANES] regardless of operand layout (d is reduced away,
     # so the bthd transpose here moves stats only, never a d-sized tensor)
@@ -426,63 +550,87 @@ def _flash_bwd(sm_scale, causal, blocks, layout, res, g):
         qseg, kseg = _seg_operands(segment_ids, B, Tq, Tk)
         seg_args = [qseg, kseg]
 
-    # dKV: resident K/V tile (grid dim 2), streamed Q/dO (grid dim 3)
-    def q_idx(t, s):
-        # skipped leading chunks (fully above the diagonal) clamp to the
-        # first contributing one, suppressing their copies
-        return jnp.minimum(jnp.maximum(s, (t * bk) // bq), n_q - 1) \
-            if causal else s
+    # dKV: resident K/V tile (grid dim 2), streamed Q/dO (grid dim 3) —
+    # the kv-major schedule lists which q chunks touch each kv tile
+    if scheduled:
+        _check_schedule(programs.dkv, n_k, bq, bk, "dkv")
+        dkv_stream = programs.dkv.blk.shape[2]
+        q_idx = _sched_row
+    else:
+        dkv_stream = n_q
+        q_idx = _stream_id
 
     dkv_in = [_tile_spec(layout, bq, d, q_idx),              # q
-              _tile_spec(layout, bk, d, lambda t, s: t),     # k
-              _tile_spec(layout, bk, d, lambda t, s: t),     # v
+              _tile_spec(layout, bk, d, _resident),          # k
+              _tile_spec(layout, bk, d, _resident),          # v
               _tile_spec(layout, bq, d, q_idx),              # do
               _lanes_spec(bq, q_idx),                        # lse
               _lanes_spec(bq, q_idx)]                        # delta
+    dkv_args = [q, k, v, do, lse, delta_lanes]
+    if scheduled:
+        dkv_in.append(_maskblock_spec(bq, bk))
+        dkv_args.append(jnp.asarray(programs.dkv.mask_blocks, jnp.int32))
     if segmented:
         dkv_in += [_qseg_spec(bq, q_idx),
-                   _kseg_spec(bk, lambda t, s: t)]
+                   _kseg_spec(bk, _resident)]
+        dkv_args += seg_args
     kv_shape = ((B, H, Tk, d) if layout == "bhtd" else (B, Tk, H, d))
-    dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
-                          segmented=segmented, bq=bq, bk=bk, n_q=n_q),
-        grid=(B, H, n_k, n_q),
+    dkv_call = _pallas_call(
+        functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale,
+                          segmented=segmented, scheduled=scheduled,
+                          bq=bq, bk=bk, n_q=n_q),
+        grid=(B, H, n_k, dkv_stream),
         in_specs=dkv_in,
-        out_specs=[_tile_spec(layout, bk, d, lambda t, s: t),
-                   _tile_spec(layout, bk, d, lambda t, s: t)],
+        out_specs=[_tile_spec(layout, bk, d, _resident),
+                   _tile_spec(layout, bk, d, _resident)],
         out_shape=[jax.ShapeDtypeStruct(kv_shape, k.dtype),
                    jax.ShapeDtypeStruct(kv_shape, v.dtype)],
         scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
                         pltpu.VMEM((bk, d), jnp.float32)],
-        compiler_params=_STREAMED,
-        interpret=_interpret(),
-    )(q, k, v, do, lse, delta_lanes, *seg_args)
+        scheduled=scheduled)
+    if scheduled:
+        dk, dv = dkv_call(*_sched_args(programs.dkv), *dkv_args)
+    else:
+        dk, dv = dkv_call(*dkv_args)
 
     # dQ: resident Q tile (grid dim 2), streamed K/V (grid dim 3)
-    def kv_idx(t, s):
-        return jnp.minimum(s, (t * bq + bq - 1) // bk) if causal else s
+    if scheduled:
+        _check_schedule(programs.dq, n_q, bq, bk, "dq")
+        dq_stream = programs.dq.blk.shape[2]
+        kv_idx = _sched_row
+    else:
+        dq_stream = n_k
+        kv_idx = _stream_id
 
-    dq_in = [_tile_spec(layout, bq, d, lambda t, s: t),      # q
-             _tile_spec(layout, bk, d, kv_idx),              # k
-             _tile_spec(layout, bk, d, kv_idx),              # v
-             _tile_spec(layout, bq, d, lambda t, s: t),      # do
-             _lanes_spec(bq, lambda t, s: t),                # lse
-             _lanes_spec(bq, lambda t, s: t)]                # delta
+    dq_in = [_tile_spec(layout, bq, d, _resident),            # q
+             _tile_spec(layout, bk, d, kv_idx),               # k
+             _tile_spec(layout, bk, d, kv_idx),               # v
+             _tile_spec(layout, bq, d, _resident),            # do
+             _lanes_spec(bq, _resident),                      # lse
+             _lanes_spec(bq, _resident)]                      # delta
+    dq_args = [q, k, v, do, lse, delta_lanes]
+    if scheduled:
+        dq_in.append(_maskblock_spec(bq, bk))
+        dq_args.append(jnp.asarray(programs.dq.mask_blocks, jnp.int32))
     if segmented:
-        dq_in += [_qseg_spec(bq, lambda t, s: t),
+        dq_in += [_qseg_spec(bq, _resident),
                   _kseg_spec(bk, kv_idx)]
+        dq_args += seg_args
     q_shape = ((B, H, Tq, d) if layout == "bhtd" else (B, Tq, H, d))
-    dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
-                          segmented=segmented, bq=bq, bk=bk, n_k=n_k),
-        grid=(B, H, n_q, n_k),
+    dq_call = _pallas_call(
+        functools.partial(_bwd_dq_kernel, sm_scale=sm_scale,
+                          segmented=segmented, scheduled=scheduled,
+                          bq=bq, bk=bk, n_k=n_k),
+        grid=(B, H, n_q, dq_stream),
         in_specs=dq_in,
-        out_specs=_tile_spec(layout, bq, d, lambda t, s: t),
+        out_specs=_tile_spec(layout, bq, d, _resident),
         out_shape=jax.ShapeDtypeStruct(q_shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
-        compiler_params=_STREAMED,
-        interpret=_interpret(),
-    )(q, k, v, do, lse, delta_lanes, *seg_args)
+        scheduled=scheduled)
+    if scheduled:
+        dq = dq_call(*_sched_args(programs.dq), *dq_args)
+    else:
+        dq = dq_call(*dq_args)
     return dq, dk, dv
 
 
@@ -490,42 +638,46 @@ def _flash_bwd(sm_scale, causal, blocks, layout, res, g):
 # public op
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
-def _flash_attention(q, k, v, segment_ids, sm_scale, causal, blocks,
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def _flash_attention(q, k, v, segment_ids, programs, sm_scale, blocks,
                      layout):
-    out, _ = _flash_fwd(q, k, v, segment_ids, sm_scale, causal, blocks,
+    out, _ = _flash_fwd(q, k, v, segment_ids, programs, sm_scale, blocks,
                         layout)
     return out
 
 
-def _vjp_fwd(q, k, v, segment_ids, sm_scale, causal, blocks, layout):
-    out, lse = _flash_fwd(q, k, v, segment_ids, sm_scale, causal, blocks,
-                          layout)
-    return out, (q, k, v, out, lse, segment_ids)
+def _vjp_fwd(q, k, v, segment_ids, programs, sm_scale, blocks, layout):
+    out, lse = _flash_fwd(q, k, v, segment_ids, programs, sm_scale,
+                          blocks, layout)
+    return out, (q, k, v, out, lse, segment_ids, programs)
 
 
 def _float0_zeros(x):
-    return np.zeros(x.shape, jax.dtypes.float0)
+    return np.zeros(np.shape(x), jax.dtypes.float0)
 
 
-def _vjp_bwd(sm_scale, causal, blocks, layout, res, g):
-    dq, dk, dv = _flash_bwd(sm_scale, causal, blocks, layout, res, g)
-    segment_ids = res[5]
+def _vjp_bwd(sm_scale, blocks, layout, res, g):
+    dq, dk, dv = _flash_bwd(sm_scale, blocks, layout, res, g)
+    segment_ids, programs = res[5], res[6]
     dseg = None if segment_ids is None else SegmentIds(
         _float0_zeros(segment_ids.q), _float0_zeros(segment_ids.kv))
-    return dq, dk, dv, dseg
+    dprog = None if programs is None else jax.tree_util.tree_map(
+        _float0_zeros, programs)
+    return dq, dk, dv, dseg, dprog
 
 
 _flash_attention.defvjp(_vjp_fwd, _vjp_bwd)
 
 
-def _resolve(q, k, v, sm_scale, bq, bk, block_sizes, layout):
+def _resolve(q, k, v, sm_scale, bq, bk, block_sizes, layout,
+             mask_sig=None):
     _, Tq, _, d = _shapes(layout, q)
     _, Tk, _, _ = _shapes(layout, k)
     scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(d)
     if block_sizes is None:
         if bq is None and bk is None:
-            block_sizes = select_block_sizes(Tq, d, str(q.dtype), Tk)
+            block_sizes = select_block_sizes(Tq, d, str(q.dtype), Tk,
+                                             mask_sig=mask_sig)
         else:
             bq = DEFAULT_BQ if bq is None else bq
             bk = DEFAULT_BK if bk is None else bk
@@ -538,31 +690,57 @@ def flash_attention(q, k, v, sm_scale: Optional[float] = None,
                     bk: Optional[int] = None, *,
                     block_sizes: Optional[BlockSizes] = None,
                     segment_ids: Optional[SegmentIds] = None,
-                    layout: str = "bhtd"):
+                    layout: str = "bhtd",
+                    mask: Optional[Mask] = None,
+                    programs: Optional[MaskPrograms] = None):
     """q,k,v: [B, H, T, D] (``layout="bhtd"``, default) or [B, T, H, D]
     (``layout="bthd"``) → same layout out. With neither bq/bk nor
     ``block_sizes`` given, blocks come from the selection table /
-    autotune cache (:func:`select_block_sizes`); ``block_sizes``
-    overrides the positional bq/bk with independent fwd/bwd chunks;
-    ``segment_ids`` enables kernel-level padding/segment masking."""
-    scale, blocks = _resolve(q, k, v, sm_scale, bq, bk, block_sizes, layout)
-    return _flash_attention(q, k, v, segment_ids, scale, causal, blocks,
+    autotune cache (:func:`select_block_sizes`, consulting the
+    mask-signature-keyed "sparse" section for scheduled calls);
+    ``block_sizes`` overrides the positional bq/bk with independent
+    fwd/bwd chunks; ``segment_ids`` enables kernel-level
+    padding/segment masking.
+
+    ``mask`` is a static :class:`~tosem_tpu.ops.mask_programs.Mask`
+    compiled once into a block schedule that drives the stream grid
+    dimension — skipped blocks pay neither MXU nor HBM. ``causal=True``
+    is sugar for ``mask=CausalMask()`` (ANDed with ``mask`` when both
+    are given). Advanced callers (the sharded per-head path) may pass
+    precompiled ``programs`` directly — then ``mask`` is only used for
+    block selection and may be None."""
+    if causal:
+        mask = CausalMask() if mask is None else (mask & CausalMask())
+    sig = mask.signature() if mask is not None else None
+    scale, blocks = _resolve(q, k, v, sm_scale, bq, bk, block_sizes,
+                             layout, mask_sig=sig)
+    if programs is None and mask is not None:
+        _, Tq, H, _ = _shapes(layout, q)
+        _, Tk, _, _ = _shapes(layout, k)
+        programs = compile_mask_programs(mask, Tq, Tk, blocks, heads=H)
+    return _flash_attention(q, k, v, segment_ids, programs, scale, blocks,
                             layout)
 
 
 def mha_flash_attention(q, k, v, mask=None, *, causal: bool = False,
                         segment_ids: Optional[SegmentIds] = None,
-                        block_sizes: Optional[BlockSizes] = None):
+                        block_sizes: Optional[BlockSizes] = None,
+                        mask_program: Optional[Mask] = None):
     """Flash attention in the native [B, T, H, D] layout of
     :func:`tosem_tpu.nn.attention.dot_product_attention` — the kernels
     index heads via BlockSpecs, so no transposed copy of q/k/v/o is ever
-    materialized. ``mask`` must be None: express padding as
-    ``segment_ids`` (``flash_attn_fn`` converts key-padding masks
-    automatically; arbitrary dense masks take the XLA path)."""
+    materialized. ``mask`` (a dense jax array) must be None: express
+    padding as ``segment_ids`` (``flash_attn_fn`` converts key-padding
+    masks automatically; arbitrary dense masks take the XLA path) and
+    static sparsity as ``mask_program`` (a
+    :class:`~tosem_tpu.ops.mask_programs.Mask` compiled to a block
+    schedule)."""
     if mask is not None:
-        raise ValueError("flash path takes causal/segment masks only; "
-                         "pass padding as segment_ids (flash_attn_fn "
-                         "does this) or use the XLA path")
+        raise ValueError("flash path takes causal/segment/program masks "
+                         "only; pass padding as segment_ids "
+                         "(flash_attn_fn does this), static sparsity as "
+                         "mask_program, or use the XLA path")
     return flash_attention(q, k, v, None, causal,
                            block_sizes=block_sizes,
-                           segment_ids=segment_ids, layout="bthd")
+                           segment_ids=segment_ids, layout="bthd",
+                           mask=mask_program)

@@ -154,6 +154,7 @@ def _align_to_seq(blocks: BlockSizes, Tq: int, Tk: int) -> BlockSizes:
 
 _cache: Optional[Dict[str, List[int]]] = None
 _pages_cache: Optional[Dict[str, int]] = None
+_sparse_cache: Optional[Dict[str, List[int]]] = None
 _cache_path_loaded: Optional[str] = None
 
 
@@ -165,16 +166,30 @@ def _load_raw(path: str) -> dict:
         return {}
 
 
+def _valid_blocks(section) -> Dict[str, List[int]]:
+    """Filter a blocks-shaped cache section (list-of-4 values),
+    tolerating a missing/corrupt section: a bad "sparse" entry must
+    degrade to the dense selection path, never crash the kernel."""
+    if not isinstance(section, dict):
+        return {}
+    return {k: v for k, v in section.items()
+            if isinstance(v, list) and len(v) == 4
+            and all(isinstance(x, int) and x > 0 for x in v)}
+
+
 def _load_cache(path: str) -> Dict[str, List[int]]:
-    global _cache, _pages_cache, _cache_path_loaded
+    global _cache, _pages_cache, _sparse_cache, _cache_path_loaded
     if _cache is not None and _cache_path_loaded == path:
         return _cache
     raw = _load_raw(path)
-    data = {k: v for k, v in raw.get("blocks", {}).items()
-            if isinstance(v, list) and len(v) == 4}
-    pages = {k: int(v) for k, v in raw.get("pages", {}).items()
-             if isinstance(v, (int, float)) and int(v) > 0}
-    _cache, _pages_cache, _cache_path_loaded = data, pages, path
+    data = _valid_blocks(raw.get("blocks", {}))
+    pages = {}
+    if isinstance(raw.get("pages", {}), dict):
+        pages = {k: int(v) for k, v in raw.get("pages", {}).items()
+                 if isinstance(v, (int, float)) and int(v) > 0}
+    sparse = _valid_blocks(raw.get("sparse", {}))
+    _cache, _pages_cache, _sparse_cache = data, pages, sparse
+    _cache_path_loaded = path
     return data
 
 
@@ -183,25 +198,42 @@ def _load_pages(path: str) -> Dict[str, int]:
     return _pages_cache or {}
 
 
+def _load_sparse(path: str) -> Dict[str, List[int]]:
+    _load_cache(path)
+    return _sparse_cache or {}
+
+
 def _cache_key(T: int, d: int, dtype: str) -> str:
     return f"t{T}_d{d}_{dtype}"
 
 
+def _sparse_key(T: int, d: int, dtype: str, mask_sig: str) -> str:
+    return f"{_cache_key(T, d, dtype)}_{mask_sig}"
+
+
 def select_block_sizes(Tq: int, d: int, dtype: str, Tk: Optional[int] = None,
                        *, vmem_budget: int = DEFAULT_VMEM_BUDGET,
-                       cache_path: Optional[str] = DEFAULT_CACHE_PATH
-                       ) -> BlockSizes:
+                       cache_path: Optional[str] = DEFAULT_CACHE_PATH,
+                       mask_sig: Optional[str] = None) -> BlockSizes:
     """Pick block sizes for a (T, d, dtype) shape.
 
-    Priority: autotune cache (measured on-chip) → static table →
-    default; then clamp to the sequence lengths, align to divisibility,
-    and apply the VMEM-budget fallback. ``dtype`` is the operand dtype
-    name ("bfloat16"/"float32")."""
+    Priority: sparse autotune cache (``mask_sig`` given — per-schedule
+    winners keyed (T, d, dtype, mask signature)) → dense autotune cache
+    (measured on-chip) → static table → default; then clamp to the
+    sequence lengths, align to divisibility, and apply the VMEM-budget
+    fallback. ``dtype`` is the operand dtype name
+    ("bfloat16"/"float32"). ``last_source`` reports "sparse" distinctly
+    from "cache" so sparse-cache hits are auditable."""
     Tk = Tq if Tk is None else Tk
     dtype = str(dtype)
     picked: Optional[BlockSizes] = None
     src = "default"
-    if cache_path:
+    if cache_path and mask_sig:
+        hit = _load_sparse(cache_path).get(
+            _sparse_key(Tk, d, dtype, mask_sig))
+        if hit:
+            picked, src = BlockSizes(*hit), "sparse"
+    if picked is None and cache_path:
         hit = _load_cache(cache_path).get(_cache_key(Tk, d, dtype))
         if hit:
             picked, src = BlockSizes(*hit), "cache"
@@ -232,6 +264,25 @@ _CANDIDATES = ((128, 128), (256, 256), (256, 512), (512, 512),
                (512, 1024), (1024, 512))
 
 
+def _budget_candidates(T: int, d: int, itemsize: int) -> List[Tuple[int, int]]:
+    """The (bq, bk) candidates a sweep actually measures at this shape:
+    clamped to T, T-divisible, within the VMEM budget at their own
+    size, deduplicated. One filter shared by the dense and sparse
+    sweeps so their candidate sets can never drift apart."""
+    out: List[Tuple[int, int]] = []
+    for bq, bk in _CANDIDATES:
+        bq, bk = min(bq, T), min(bk, T)
+        if T % bq or T % bk:
+            continue
+        bs = _fit_to_budget(BlockSizes(bq, bk, bq, bk), T, T, d,
+                            itemsize, DEFAULT_VMEM_BUDGET)
+        if (bs.bq, bs.bk) != (bq, bk):
+            continue                     # over budget at this shape
+        if (bq, bk) not in out:
+            out.append((bq, bk))
+    return out
+
+
 def autotune(shapes: Iterable[Tuple[int, int, int, int, str]],
              *, reps: int = 3, cache_path: str = DEFAULT_CACHE_PATH,
              include_bwd: bool = False) -> List[dict]:
@@ -257,17 +308,7 @@ def autotune(shapes: Iterable[Tuple[int, int, int, int, str]],
         q = jax.random.normal(ks[0], (B, H, T, d), jnp.float32).astype(dt)
         k = jax.random.normal(ks[1], (B, H, T, d), jnp.float32).astype(dt)
         v = jax.random.normal(ks[2], (B, H, T, d), jnp.float32).astype(dt)
-        cands = []
-        for bq, bk in _CANDIDATES:
-            bq, bk = min(bq, T), min(bk, T)
-            if T % bq or T % bk:
-                continue
-            bs = _fit_to_budget(BlockSizes(bq, bk, bq, bk), T, T, d,
-                                dt.itemsize, DEFAULT_VMEM_BUDGET)
-            if (bs.bq, bs.bk) != (bq, bk):
-                continue                     # over budget at this shape
-            if (bq, bk) not in cands:
-                cands.append((bq, bk))
+        cands = _budget_candidates(T, d, dt.itemsize)
         best = None
         timed = []
         for bq, bk in cands:
@@ -300,21 +341,100 @@ def autotune(shapes: Iterable[Tuple[int, int, int, int, str]],
     return records
 
 
+def autotune_sparse(shapes: Iterable[Tuple[int, int, int, int, str]],
+                    mask_specs: Iterable[str] = ("local:1024",),
+                    *, reps: int = 3, include_bwd: bool = False,
+                    cache_path: str = DEFAULT_CACHE_PATH) -> List[dict]:
+    """Measure candidate block sizes under block-sparse mask schedules
+    and cache the winners in the ``"sparse"`` section.
+
+    The dense winner is not automatically the sparse winner: a schedule
+    changes the executed-block set (a local window at coarse blocks may
+    execute MORE of the grid than at fine blocks), so sparse shapes get
+    their own sweep, keyed ``t{T}_d{d}_{dtype}_{mask signature}`` — the
+    key :func:`select_block_sizes` consults when ``mask_sig`` is given.
+    ``mask_specs`` use the :func:`~tosem_tpu.ops.mask_programs.
+    mask_from_spec` mini-language (``local:1024``, ``doc``, …). Returns
+    one record per measured candidate, carrying the schedule's honest
+    ``executed_block_fraction``."""
+    import jax
+    import jax.numpy as jnp
+
+    from tosem_tpu.ops.flash_attention import flash_attention
+    from tosem_tpu.ops.mask_programs import (executed_block_fraction,
+                                             mask_from_spec)
+    from tosem_tpu.utils.timing import DeviceLoopBench
+
+    records: List[dict] = []
+    winners: Dict[str, List[int]] = {}
+    for B, H, T, d, dtype in shapes:
+        dt = jnp.dtype(dtype)
+        ks = jax.random.split(jax.random.PRNGKey(0), 3)
+        q = jax.random.normal(ks[0], (B, H, T, d), jnp.float32).astype(dt)
+        k = jax.random.normal(ks[1], (B, H, T, d), jnp.float32).astype(dt)
+        v = jax.random.normal(ks[2], (B, H, T, d), jnp.float32).astype(dt)
+        for spec in mask_specs:
+            mask = mask_from_spec(spec, T)
+            sig = mask.signature()
+            best = None
+            timed = []
+            for bq, bk in _budget_candidates(T, d, dt.itemsize):
+                blocks = BlockSizes(bq, bk, bq, bk)
+                frac = executed_block_fraction(mask, T, T, blocks)
+                if include_bwd:
+                    fn = jax.jit(jax.grad(
+                        lambda a, b, c, m=mask, bl=blocks: jnp.sum(
+                            flash_attention(a, b, c, mask=m,
+                                            block_sizes=bl)
+                            .astype(jnp.float32) ** 2)))
+                    op = lambda a, b, c, fn=fn: jnp.stack(
+                        [jnp.mean(fn(a, b, c).astype(jnp.float32))])
+                else:
+                    op = jax.jit(lambda a, b, c, m=mask, bl=blocks:
+                                 flash_attention(a, b, c, mask=m,
+                                                 block_sizes=bl))
+                sec = DeviceLoopBench(op=op, args=(q, k, v),
+                                      perturb=0).time(reps=reps)
+                timed.append(((bq, bk), sec, frac))
+                if best is None or sec < best[1]:
+                    best = ((bq, bk), sec)
+            for (bq, bk), sec, frac in timed:
+                records.append({"shape": [B, H, T, d, dtype],
+                                "mask": sig,
+                                "blocks": [bq, bk, bq, bk],
+                                "time_us": sec * 1e6,
+                                "executed_block_fraction": frac,
+                                "best": (bq, bk) == best[0]})
+            if best is not None:
+                bq, bk = best[0]
+                winners[_sparse_key(T, d, str(dtype), sig)] = \
+                    [bq, bk, bq, bk]
+    if winners:
+        save_cache(winners, cache_path, section="sparse")
+    return records
+
+
 def save_cache(winners: Dict[str, List[int]],
                cache_path: str = DEFAULT_CACHE_PATH, *,
                section: str = "blocks") -> None:
     """Merge winners into the JSON cache (atomic write). ``section`` is
-    ``"blocks"`` (flash chunk sizes, list-of-4 values) or ``"pages"``
-    (decode page sizes, scalar values); the other section is preserved."""
-    global _cache, _pages_cache, _cache_path_loaded
-    if section not in ("blocks", "pages"):
+    ``"blocks"`` (flash chunk sizes, list-of-4 values), ``"pages"``
+    (decode page sizes, scalar values), or ``"sparse"`` (per-mask-
+    signature chunk sizes, list-of-4 values); the other sections are
+    preserved."""
+    global _cache, _pages_cache, _sparse_cache, _cache_path_loaded
+    if section not in ("blocks", "pages", "sparse"):
         raise ValueError(f"unknown cache section {section!r}")
     blocks = dict(_load_cache(cache_path))
     pages = dict(_pages_cache or {})
-    (blocks if section == "blocks" else pages).update(winners)
+    sparse = dict(_sparse_cache or {})
+    {"blocks": blocks, "pages": pages,
+     "sparse": sparse}[section].update(winners)
     payload: dict = {"blocks": blocks}
     if pages:
         payload["pages"] = pages
+    if sparse:
+        payload["sparse"] = sparse
     d = os.path.dirname(cache_path)
     if d:
         os.makedirs(d, exist_ok=True)
@@ -322,13 +442,15 @@ def save_cache(winners: Dict[str, List[int]],
     with open(tmp, "w") as f:
         json.dump(payload, f, indent=1, sort_keys=True)
     os.replace(tmp, cache_path)
-    _cache, _pages_cache, _cache_path_loaded = blocks, pages, cache_path
+    _cache, _pages_cache, _sparse_cache = blocks, pages, sparse
+    _cache_path_loaded = cache_path
 
 
 def reset_cache() -> None:
     """Drop the in-process cache view (tests; after external writes)."""
-    global _cache, _pages_cache, _cache_path_loaded
-    _cache, _pages_cache, _cache_path_loaded = None, None, None
+    global _cache, _pages_cache, _sparse_cache, _cache_path_loaded
+    _cache, _pages_cache, _sparse_cache = None, None, None
+    _cache_path_loaded = None
 
 
 # ---------------------------------------------------------------------------

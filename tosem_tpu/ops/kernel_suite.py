@@ -19,8 +19,8 @@ from tosem_tpu.utils.results import ResultRow
 from tosem_tpu.utils.timing import DeviceLoopBench
 
 
-def _row(bench_id, metric, value, unit, extra):
-    return ResultRow(project="ops", config="bert_kernel_suite",
+def _row(bench_id, metric, value, unit, extra, config="bert_kernel_suite"):
+    return ResultRow(project="ops", config=config,
                      bench_id=bench_id, metric=metric, value=value, unit=unit,
                      device=jax.devices()[0].platform, n_devices=1,
                      extra=extra)
@@ -45,11 +45,14 @@ def attention_flops(B, H, T, D, *, bwd: bool,
     """fwd: QK^T + PV = 2 matmuls = 4*B*H*T^2*D. bwd (flash, recompute):
     S recompute + dV + dP + dK + dQ = 5 matmuls = 10*B*H*T^2*D.
 
-    ``causal_fraction`` (from :func:`causal_block_fraction`) scales the
-    T² terms down to the block pairs the causal grid actually schedules
-    — derived from the REAL chunking, not an asymptotic /2, so MFU never
-    under- or over-counts (at full-T blocks nothing is skipped and the
-    fraction is 1.0)."""
+    ``causal_fraction`` is the executed-block fraction — historically
+    the causal special case (:func:`causal_block_fraction`), now any
+    mask schedule's honest count
+    (:func:`tosem_tpu.ops.mask_programs.program_stats`). It scales the
+    T² terms down to the block pairs the grid actually schedules —
+    derived from the REAL chunking, not an asymptotic constant, so MFU
+    never under- or over-counts (at full-T blocks nothing is skipped
+    and the fraction is 1.0)."""
     fwd = 4.0 * B * H * T * T * D
     total = fwd + (10.0 * B * H * T * T * D if bwd else 0.0)
     return total * causal_fraction
@@ -231,4 +234,86 @@ def bert_kernel_suite(*, batch: int = 8, seq: int = 512, heads: int = 12,
                      4 * s.nbytes / sec / 1e9, "GB/s",
                      {"bytes": 4 * s.nbytes, "time_us": sec * 1e6,
                       "dtype": dtype}))
+    return rows
+
+
+def sparse_kernel_suite(*, batch: int = 1, seq: int = 8192,
+                        heads: int = 12, head_dim: int = 64,
+                        dtype: str = "bfloat16", window: int = 1024,
+                        doc_len: int = 0, reps: int = 3
+                        ) -> List[ResultRow]:
+    """Block-sparse mask-program rows: the long-context scenarios where
+    skipped blocks, not block sizes, carry the win.
+
+    One fwd + one fwd/bwd row per scenario — dense-causal (the
+    comparison anchor), sliding window (``LocalMask(window)``), and
+    doc-packed (block-diagonal documents of ``doc_len`` ∧ causal) — at
+    the SAME shape, each with the schedule-aware FLOP model: GFLOPS/MFU
+    count only the block pairs the schedule executes
+    (``extra.executed_block_fraction``), so a sparse row can never fake
+    a speedup by counting skipped work."""
+    from tosem_tpu.ops.flash_blocks import select_block_sizes
+    from tosem_tpu.ops.mask_programs import (mask_from_spec,
+                                             program_stats)
+    dt = jnp.dtype(dtype)
+    B, H, T, D = batch, heads, seq, head_dim
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (B, H, T, D), jnp.float32).astype(dt)
+    k = jax.random.normal(ks[1], (B, H, T, D), jnp.float32).astype(dt)
+    v = jax.random.normal(ks[2], (B, H, T, D), jnp.float32).astype(dt)
+    doc_len = doc_len or max(seq // 4, 1)
+    scenarios = [("causal", "causal"),
+                 (f"local{window}", f"local:{window}"),
+                 (f"docpack{doc_len}", f"doc:{doc_len}+causal")]
+    rows: List[ResultRow] = []
+
+    def _all_grads(fn):
+        return lambda *xs: jnp.stack(
+            [jnp.mean(g.astype(jnp.float32)) for g in fn(*xs)])
+
+    for name, spec in scenarios:
+        mask = mask_from_spec(spec, T)
+        sig = mask.signature()
+        blocks = select_block_sizes(T, D, dtype, mask_sig=sig)
+        blocks_src = select_block_sizes.last_source
+        stats = program_stats(mask, T, T, blocks, heads=H)
+        frac_fwd, frac_bwd = stats["fwd"].fraction, stats["bwd"].fraction
+        extra_base = {"shape": [B, H, T, D], "dtype": dtype,
+                      "mask": sig, "blocks": blocks.as_list(),
+                      "blocks_src": blocks_src}
+        fwd = jax.jit(lambda a, b, c, m=mask, bl=blocks:
+                      flash_attention(a, b, c, mask=m, block_sizes=bl))
+        sec = DeviceLoopBench(op=fwd, args=(q, k, v),
+                              perturb=0).time(reps=reps)
+        fl = attention_flops(B, H, T, D, bwd=False,
+                             causal_fraction=frac_fwd)
+        rows.append(_row(f"attention_fwd_{name}_b{B}_t{T}_{dtype}",
+                         "gflops", fl / sec / 1e9, "GFLOPS",
+                         dict(extra_base,
+                              flop_model=f"4BHT^2D x {frac_fwd:.4g} "
+                                         "(executed blocks only)",
+                              executed_block_fraction=frac_fwd,
+                              time_us=sec * 1e6),
+                         config="flash_sparse"))
+        grad = jax.jit(jax.grad(
+            lambda a, b, c, m=mask, bl=blocks: jnp.sum(
+                flash_attention(a, b, c, mask=m, block_sizes=bl)
+                .astype(jnp.float32) ** 2), (0, 1, 2)))
+        sec = DeviceLoopBench(op=_all_grads(grad), args=(q, k, v),
+                              perturb=0).time(reps=reps)
+        fl = (attention_flops(B, H, T, D, bwd=False,
+                              causal_fraction=frac_fwd)
+              + (attention_flops(B, H, T, D, bwd=True,
+                                 causal_fraction=frac_bwd)
+                 - attention_flops(B, H, T, D, bwd=False,
+                                   causal_fraction=frac_bwd)))
+        rows.append(_row(f"attention_fwdbwd_{name}_b{B}_t{T}_{dtype}",
+                         "gflops", fl / sec / 1e9, "GFLOPS",
+                         dict(extra_base,
+                              flop_model=f"(4 x {frac_fwd:.4g} + 10 x "
+                                         f"{frac_bwd:.4g})BHT^2D "
+                                         "(executed blocks only)",
+                              executed_block_fraction=frac_bwd,
+                              time_us=sec * 1e6),
+                         config="flash_sparse"))
     return rows

@@ -8,6 +8,15 @@ stream; sequence-sharded long context is :mod:`tosem_tpu.parallel.ring`'s
 job). ``shard_map`` composes under ``jit``, so the returned callable
 drops into a GSPMD-partitioned train step, and the per-chip body is the
 unmodified kernel — Mosaic still double-buffers the K/V chunks locally.
+
+Block-sparse mask programs shard with the heads: a uniform
+:class:`~tosem_tpu.ops.mask_programs.Mask` (causal, local window, …)
+compiles identically inside every shard's trace, while a per-head
+:class:`~tosem_tpu.ops.mask_programs.MultiHeadMask` is compiled ONCE for
+the full head set and its schedule arrays ride into ``shard_map`` as
+operands partitioned over the tp axis — each chip's kernel sees exactly
+its own heads' schedule rows, so head-heterogeneous sparsity costs a
+chip only the blocks its heads execute.
 """
 from __future__ import annotations
 
@@ -20,6 +29,10 @@ from jax.sharding import Mesh, PartitionSpec as P
 from tosem_tpu.parallel.compat import shard_map
 from tosem_tpu.ops.flash_attention import (BlockSizes, SegmentIds,
                                            flash_attention)
+from tosem_tpu.ops.mask_programs import (BlockSchedule, Mask, MaskPrograms,
+                                         MultiHeadMask, CausalMask,
+                                         compile_mask_programs)
+from tosem_tpu.ops.flash_blocks import select_block_sizes
 
 
 def dp_tp_mesh(dp: int, tp: int, devices=None) -> Mesh:
@@ -41,18 +54,33 @@ def dp_tp_mesh(dp: int, tp: int, devices=None) -> Mesh:
     return Mesh(np.array(devs[:dp * tp]).reshape(dp, tp), ("dp", "tp"))
 
 
+def _program_specs(axis: Optional[str]) -> MaskPrograms:
+    """PartitionSpec pytree for per-head schedule operands: the head
+    row axis shards over ``axis``; the bitmap pool replicates (ids are
+    pool-global — a shard may reference any bitmap)."""
+    sched = BlockSchedule(num=P(axis, None), blk=P(axis, None, None),
+                          kind=P(axis, None, None),
+                          mid=P(axis, None, None),
+                          mask_blocks=P(None, None, None))
+    return MaskPrograms(fwd=sched, dq=sched, dkv=sched)
+
+
 def sharded_flash_attention(mesh: Mesh, *, causal: bool = False,
                             sm_scale: Optional[float] = None,
                             data_axis: str = "dp",
                             model_axis: Optional[str] = "tp",
                             layout: str = "bthd",
-                            block_sizes: Optional[BlockSizes] = None):
+                            block_sizes: Optional[BlockSizes] = None,
+                            mask: Optional[Mask] = None):
     """Build a jitted ``(q, k, v[, segment_ids]) -> out`` over ``mesh``.
 
     q/k/v use ``layout`` ("bthd" = the nn-layer [B, T, H, D] default);
     batch shards over ``data_axis``, heads over ``model_axis`` (pass
     None for a data-only mesh). ``segment_ids`` (optional) shards its
-    batch dim over ``data_axis`` alongside q/k/v."""
+    batch dim over ``data_axis`` alongside q/k/v. ``mask`` enables the
+    block-sparse schedule path: uniform masks replicate their schedule
+    into every shard, a :class:`MultiHeadMask` slices its per-head
+    schedule rows across ``model_axis``."""
     h_axis = model_axis
     if h_axis is not None and h_axis not in mesh.axis_names:
         raise ValueError(f"model axis {h_axis!r} not in mesh "
@@ -62,33 +90,75 @@ def sharded_flash_attention(mesh: Mesh, *, causal: bool = False,
                          f"{mesh.axis_names}")
     if layout == "bthd":
         op_spec = P(data_axis, None, h_axis, None)
+        h_dim, t_dim = 2, 1
     elif layout == "bhtd":
         op_spec = P(data_axis, h_axis, None, None)
+        h_dim, t_dim = 1, 2
     else:
         raise ValueError(f"unknown layout {layout!r}")
     seg_spec = SegmentIds(P(data_axis, None), P(data_axis, None))
 
-    def _local(q, k, v, segment_ids):
-        return flash_attention(q, k, v, sm_scale, causal,
-                               block_sizes=block_sizes,
-                               segment_ids=segment_ids, layout=layout)
+    eff_mask = mask
+    if causal:
+        eff_mask = CausalMask() if mask is None else (mask & CausalMask())
+    # a per-head mask must split along the sharded head axis: schedules
+    # become shard_map operands; uniform masks recompile (cached)
+    # identically inside each shard's single SPMD trace
+    per_head = isinstance(eff_mask, MultiHeadMask)
+    tp_size = mesh.shape[h_axis] if h_axis is not None else 1
+    if per_head and len(eff_mask.masks) % tp_size:
+        raise ValueError(
+            f"MultiHeadMask has {len(eff_mask.masks)} head masks, not "
+            f"divisible over {tp_size} '{h_axis}' shards")
 
-    # segment_ids' None-ness is static at trace time: the unmasked call
-    # gets the plain kernel (no broadcast seg operands, no per-block
-    # where), the masked one the segmented variant
-    sharded_plain = shard_map(
-        lambda q, k, v: _local(q, k, v, None), mesh=mesh,
-        in_specs=(op_spec, op_spec, op_spec),
-        out_specs=op_spec, check_vma=False)
-    sharded_seg = shard_map(
-        _local, mesh=mesh,
-        in_specs=(op_spec, op_spec, op_spec, seg_spec),
-        out_specs=op_spec, check_vma=False)
+    def _local(q, k, v, segment_ids, programs, blocks):
+        return flash_attention(q, k, v, sm_scale, False,
+                               block_sizes=blocks,
+                               segment_ids=segment_ids, layout=layout,
+                               mask=None if per_head else eff_mask,
+                               programs=programs)
+
+    # segment_ids'/programs' None-ness is static at trace time: each
+    # combination traces its own shard_map body, so the unmasked call
+    # gets the plain kernel, the masked ones the segmented/scheduled
+    # variants. ``blocks`` pins the per-shard kernel to the chunk sizes
+    # the per-head schedule was compiled at (the shard-local resolve
+    # could otherwise diverge from the outer, sparse-keyed selection).
+    def _make(segmented: bool, programmed: bool, blocks):
+        in_specs = [op_spec, op_spec, op_spec]
+        if segmented:
+            in_specs.append(seg_spec)
+        if programmed:
+            in_specs.append(_program_specs(h_axis))
+
+        def body(q, k, v, *rest):
+            rest = list(rest)
+            seg = rest.pop(0) if segmented else None
+            progs = rest.pop(0) if programmed else None
+            return _local(q, k, v, seg, progs, blocks)
+
+        return shard_map(body, mesh=mesh, in_specs=tuple(in_specs),
+                         out_specs=op_spec, check_vma=False)
 
     @jax.jit
     def run(q, k, v, segment_ids: Optional[SegmentIds] = None):
-        if segment_ids is None:
-            return sharded_plain(q, k, v)
-        return sharded_seg(q, k, v, segment_ids)
+        progs = None
+        blocks = block_sizes
+        if per_head:
+            H = q.shape[h_dim]
+            Tq, Tk = q.shape[t_dim], k.shape[t_dim]
+            blocks = (block_sizes or select_block_sizes(
+                Tq, q.shape[-1], str(q.dtype), Tk,
+                mask_sig=eff_mask.signature())).clamp(Tq, Tk)
+            progs = jax.tree_util.tree_map(
+                jnp.asarray,
+                compile_mask_programs(eff_mask, Tq, Tk, blocks, heads=H))
+        fn = _make(segment_ids is not None, progs is not None, blocks)
+        args = [q, k, v]
+        if segment_ids is not None:
+            args.append(segment_ids)
+        if progs is not None:
+            args.append(progs)
+        return fn(*args)
 
     return run

@@ -466,6 +466,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "replicas behind the router tier vs the single-"
                         "process data plane, plus the node-kill "
                         "failover leg")
+    p.add_argument("--sparse", action="store_true",
+                   help="run the block-sparse attention benches "
+                        "(ops/bench_sparse.py) instead — t8192 "
+                        "LocalMask(1024) vs the dense-causal flash "
+                        "path, interleaved A/B")
     p.add_argument("--only", default=None,
                    help="comma-separated bench_id subset, or 'gated' for "
                         "exactly the perf_smoke-gated benches")
@@ -481,6 +486,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     elif args.cluster:
         from tosem_tpu.serve.bench_cluster import GATED_CLUSTER_BENCHES
         gated = GATED_CLUSTER_BENCHES
+    elif args.sparse:
+        from tosem_tpu.ops.bench_sparse import GATED_SPARSE_BENCHES
+        gated = GATED_SPARSE_BENCHES
     else:
         gated = GATED_BENCHES
     only = None
@@ -500,6 +508,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         rows = run_cluster_benchmarks(trials=args.trials,
                                       min_s=args.min_s,
                                       quiet=args.quiet, only=only)
+    elif args.sparse:
+        from tosem_tpu.ops.bench_sparse import run_sparse_benchmarks
+        rows = run_sparse_benchmarks(trials=args.trials,
+                                     min_s=args.min_s,
+                                     quiet=args.quiet, only=only)
     else:
         rows = run_microbenchmarks(num_workers=args.workers,
                                    trials=args.trials,

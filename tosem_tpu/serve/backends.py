@@ -82,7 +82,9 @@ class BertEncodeBackend(CompiledBackendMixin):
 
     def __init__(self, preset: str = "tiny", seed: int = 0,
                  max_batch: int = 8, use_flash: bool = True,
-                 pooled: bool = True, max_len: int = 128):
+                 pooled: bool = True, max_len: int = 128,
+                 local_window: Optional[int] = None,
+                 doc_len: Optional[int] = None):
         import jax
         from tosem_tpu.models.bert import Bert, BertConfig
         from tosem_tpu.nn.attention import flash_attn_fn
@@ -96,26 +98,57 @@ class BertEncodeBackend(CompiledBackendMixin):
         self.cfg = cfg
         self.max_batch = max_batch
         self.pooled = pooled
+        # long-document routing knobs: buckets long enough per
+        # data.feeding.sparse_mask_spec ride a block-sparse schedule
+        # (sliding window / packed documents) instead of paying the
+        # dense O(T²) cost; short buckets keep the dense program
+        self.local_window = local_window
+        self.doc_len = doc_len
+        self._use_flash = use_flash
         self.model = Bert(cfg)
         self._vs = self.model.init(jax.random.PRNGKey(seed))
         self._fwd = self.model.encode_fn(
             self._vs, attn_fn=flash_attn_fn() if use_flash else None)
+        self._sparse_fwd: Dict[int, Any] = {}
         self._tag = model_tag("bert_encode", cfg, seed,
-                              use_flash=use_flash)
+                              use_flash=use_flash,
+                              local_window=local_window, doc_len=doc_len)
 
     @staticmethod
     def length_of(request: Dict[str, Any]) -> int:
         """``length_of`` for ``Serve.deploy(buckets=…)`` routing."""
         return len(request["ids"])
 
+    def _fwd_for(self, pad_to: int):
+        """(encode fn, mask signature) for a bucket shape: the shared
+        feeding-layer rule decides whether this pad target rides a
+        sparse schedule; the compiled mask is cached per bucket."""
+        from tosem_tpu.data.feeding import sparse_mask_spec
+        spec = None
+        if self._use_flash:
+            spec = sparse_mask_spec(pad_to, local_window=self.local_window,
+                                    doc_len=self.doc_len)
+        if spec is None:
+            return self._fwd, ""
+        if pad_to not in self._sparse_fwd:
+            from tosem_tpu.nn.attention import flash_attn_fn
+            from tosem_tpu.ops.mask_programs import mask_from_spec
+            mask = mask_from_spec(spec, pad_to)
+            self._sparse_fwd[pad_to] = (
+                self.model.encode_fn(self._vs,
+                                     attn_fn=flash_attn_fn(mask=mask)),
+                mask.signature())
+        return self._sparse_fwd[pad_to]
+
     def _compiled(self, pad_to: int):
         import numpy as np
-        key = shape_key(self._tag, (self.max_batch, pad_to),
-                        self.cfg.dtype)
+        fwd, sig = self._fwd_for(pad_to)
+        key = shape_key(self._tag + (f";mask={sig}" if sig else ""),
+                        (self.max_batch, pad_to), self.cfg.dtype)
         return DEFAULT_COMPILE_CACHE.get_or_build(
             key, lambda: aot_compile(
-                self._fwd, [((self.max_batch, pad_to), np.int32),
-                            ((self.max_batch, pad_to), np.int32)]))
+                fwd, [((self.max_batch, pad_to), np.int32),
+                      ((self.max_batch, pad_to), np.int32)]))
 
     def call(self, request: Dict[str, Any]) -> Any:
         return self.call_batch([request])[0]

@@ -64,6 +64,11 @@ LEGS = [
     # for select_page_size/BertDecodeBackend even in a narrow window
     ("autotune_decode_pages", CLI + ["--config=autotune_decode_pages"],
      1200),
+    # block-sparse mask programs right behind the autotune legs: the
+    # sparse-schedule sweep lands "sparse" cache winners, then the
+    # t8192 sliding-window/doc-packed scenario rows measure with them
+    # (executed-blocks FLOP model — the honest long-context MFU story)
+    ("flash_sparse", CLI + ["--config=flash_sparse"], 2400),
     _north_star_leg("bert_kernels"),
     _north_star_leg("resnet_train"),
     _north_star_leg("bert_train"),
