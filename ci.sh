@@ -123,10 +123,14 @@ if [[ "$QUICK" == "1" ]]; then
   # kernels vs XLA on causal/none/padding/segment masks, fp32 + bf16);
   # test_flash_blocks = the block-selector + VMEM-budget-fallback smoke;
   # test_mask_programs = the block-sparse schedule gate (schedule-vs-
-  # oracle correctness, kernel parity per mask type, sparse cache)
+  # oracle correctness, kernel parity per mask type, sparse cache);
+  # test_decode_modes = the decode fast-path gate (multi-token/window/
+  # offset kernel parity, window eviction bounds, speculative
+  # bit-identity, COW beam groups, the "decode" cache section)
   python -m pytest -q -m "not slow" \
     tests/test_ops.py tests/test_pallas_kernels.py tests/test_nn.py \
     tests/test_flash_blocks.py tests/test_mask_programs.py \
+    tests/test_decode_modes.py \
     tests/test_sharding.py tests/test_serial.py tests/test_utils.py \
     tests/test_analysis.py tests/test_image_ops.py tests/test_htm.py \
     tests/test_compress.py tests/test_scorer.py tests/test_ring.py \

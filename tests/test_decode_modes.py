@@ -1,0 +1,667 @@
+"""Decode fast-path modes (ISSUE 11): sliding-window paged decode with
+page eviction, COW beam/parallel sampling, and draft-k speculative
+scoring in one paged-attention step.
+
+Covers the kernel modes (multi-token queries, window masking + page
+schedules, rolling-table page offsets) across all three lowerings, the
+cache's release/truncate/rollback surface, the backend modes (window
+eviction bounds, speculative bit-identity to greedy, beam/sampling
+groups over COW fork), the flash-blocks "decode" cache section, and —
+behind the ``slow`` marker — the serve data plane end to end with the
+new gauges.
+"""
+import json
+
+import numpy as np
+import pytest
+
+
+# ------------------------------------------------------------------- kernel
+
+
+def _pools(rng, B, H, D, page, npg):
+    import jax.numpy as jnp
+    P = B * npg + 2
+    kp = jnp.asarray(rng.standard_normal((P, page, H, D)), jnp.float32)
+    vp = jnp.asarray(rng.standard_normal((P, page, H, D)), jnp.float32)
+    bt = jnp.asarray(rng.permutation(P)[:B * npg]
+                     .reshape(B, npg).astype(np.int32))
+    return kp, vp, bt
+
+
+def _dense_ref(q4, kp, vp, bt, sl, *, window=None, q_rows=None,
+               page_offsets=None):
+    """Brute-force numpy oracle for the general kernel modes."""
+    kp, vp, bt = np.asarray(kp), np.asarray(vp), np.asarray(bt)
+    B, K, H, D = np.asarray(q4).shape
+    page = kp.shape[1]
+    T = bt.shape[1] * page
+    k = kp[bt].reshape(B, T, H, D)
+    v = vp[bt].reshape(B, T, H, D)
+    po = np.zeros(B, int) if page_offsets is None else \
+        np.asarray(page_offsets)
+    out = np.zeros((B, K, H, D), np.float32)
+    for b in range(B):
+        kr = K if q_rows is None else int(q_rows[b])
+        for r in range(K):
+            bound = int(sl[b]) - kr + min(r, kr - 1)
+            lo = bound - window + 1 if window else 0
+            # t indexes the TABLE (rolling); absolute pos = po*page + t
+            idx = [t - po[b] * page for t in
+                   range(max(lo, po[b] * page),
+                         min(bound + 1, po[b] * page + T))]
+            for h in range(H):
+                s = np.asarray(q4)[b, r, h] @ k[b, idx, h].T / np.sqrt(D)
+                p = np.exp(s - s.max())
+                p /= p.sum()
+                out[b, r, h] = p @ v[b, idx, h]
+    return out
+
+
+def test_multi_token_rows_match_sequential_single_token():
+    """Row r of a k-token step must equal the single-token kernel at
+    seq_len - (k - 1 - r) — the intra-step causal mask contract that
+    makes speculative scoring exact."""
+    import jax.numpy as jnp
+    from tosem_tpu.ops.paged_attention import paged_attention
+    rng = np.random.default_rng(0)
+    B, H, D, page, npg, K = 2, 2, 16, 8, 4, 4
+    kp, vp, bt = _pools(rng, B, H, D, page, npg)
+    sl = jnp.asarray([29, 17], jnp.int32)
+    q4 = jnp.asarray(rng.standard_normal((B, K, H, D)), jnp.float32)
+    multi = paged_attention(q4, kp, vp, bt, sl, impl="xla")
+    for r in range(K):
+        ref = paged_attention(q4[:, r], kp, vp, bt,
+                              sl - (K - 1 - r), impl="xla")
+        np.testing.assert_array_equal(np.asarray(multi[:, r]),
+                                      np.asarray(ref))
+
+
+@pytest.mark.parametrize("window", [None, 10])
+def test_pallas_interpret_matches_xla_multi(window):
+    import jax.numpy as jnp
+    from tosem_tpu.ops.paged_attention import paged_attention
+    rng = np.random.default_rng(1)
+    B, H, D, page, npg, K = 2, 2, 16, 8, 4, 4
+    kp, vp, bt = _pools(rng, B, H, D, page, npg)
+    sl = jnp.asarray([29, 17], jnp.int32)
+    krs = jnp.asarray([4, 3], jnp.int32)
+    q4 = jnp.asarray(rng.standard_normal((B, K, H, D)), jnp.float32)
+    x = paged_attention(q4, kp, vp, bt, sl, impl="xla", q_rows=krs,
+                        window=window)
+    p = paged_attention(q4, kp, vp, bt, sl, impl="pallas", q_rows=krs,
+                        window=window)
+    for b in range(B):
+        kr = int(krs[b])
+        np.testing.assert_allclose(np.asarray(p[b, :kr]),
+                                   np.asarray(x[b, :kr]), atol=5e-6)
+    ref = _dense_ref(q4, kp, vp, bt, np.asarray(sl), window=window,
+                     q_rows=np.asarray(krs))
+    for b in range(B):
+        kr = int(krs[b])
+        np.testing.assert_allclose(np.asarray(x[b, :kr]), ref[b, :kr],
+                                   atol=5e-6)
+
+
+def test_window_with_rolling_table_and_offsets():
+    """A narrow rolling block table + page_offsets must reproduce the
+    full-table windowed result exactly (both lowerings) — the contract
+    window eviction relies on."""
+    import jax.numpy as jnp
+    from tosem_tpu.ops.paged_attention import paged_attention
+    rng = np.random.default_rng(2)
+    B, H, D, page, npg, K = 2, 2, 16, 8, 4, 2
+    kp, vp, bt = _pools(rng, B, H, D, page, npg)
+    sl = jnp.asarray([30, 20], jnp.int32)
+    q4 = jnp.asarray(rng.standard_normal((B, K, H, D)), jnp.float32)
+    w = 6
+    full = paged_attention(q4, kp, vp, bt, sl, impl="xla", window=w)
+    po = jnp.asarray([2, 1], jnp.int32)
+    bt_n = jnp.stack([bt[0, 2:4], bt[1, 1:3]])
+    narrow = paged_attention(q4, kp, vp, bt_n, sl, impl="xla",
+                             window=w, page_offsets=po)
+    np.testing.assert_array_equal(np.asarray(full), np.asarray(narrow))
+    pn = paged_attention(q4, kp, vp, bt_n, sl, impl="pallas", window=w,
+                         page_offsets=po)
+    np.testing.assert_allclose(np.asarray(pn), np.asarray(narrow),
+                               atol=5e-6)
+
+
+def test_k1_general_path_matches_legacy():
+    import jax.numpy as jnp
+    from tosem_tpu.ops.paged_attention import paged_attention
+    rng = np.random.default_rng(3)
+    B, H, D, page, npg = 2, 2, 16, 8, 4
+    kp, vp, bt = _pools(rng, B, H, D, page, npg)
+    sl = jnp.asarray([29, 0], jnp.int32)       # incl. an inactive row
+    q = jnp.asarray(rng.standard_normal((B, H, D)), jnp.float32)
+    legacy = paged_attention(q, kp, vp, bt, sl, impl="xla")
+    gen = paged_attention(q[:, None], kp, vp, bt, sl, impl="xla")[:, 0]
+    np.testing.assert_allclose(np.asarray(gen), np.asarray(legacy),
+                               atol=5e-6)
+    assert np.all(np.asarray(gen[1]) == 0.0)   # inactive row still zero
+    pg = paged_attention(q[:, None], kp, vp, bt, sl,
+                         impl="pallas")[:, 0]
+    np.testing.assert_allclose(np.asarray(pg), np.asarray(legacy),
+                               atol=5e-6)
+
+
+def test_kernel_mode_validation():
+    import jax.numpy as jnp
+    from tosem_tpu.ops.paged_attention import paged_attention
+    rng = np.random.default_rng(4)
+    kp, vp, bt = _pools(rng, 1, 2, 16, 8, 2)
+    sl = jnp.asarray([9], jnp.int32)
+    q9 = jnp.asarray(rng.standard_normal((1, 9, 2, 16)), jnp.float32)
+    with pytest.raises(ValueError, match="q tokens"):
+        paged_attention(q9, kp, vp, bt, sl, impl="xla")
+    q1 = jnp.asarray(rng.standard_normal((1, 2, 16)), jnp.float32)
+    with pytest.raises(ValueError, match="window"):
+        paged_attention(q1, kp, vp, bt, sl, impl="xla", window=0)
+
+
+# -------------------------------------------------------------------- cache
+
+
+def make_cache(num_pages=16, page_size=4):
+    from tosem_tpu.serve.kv_cache import LocalSpillStore, PagedKVCache
+    return PagedKVCache(num_pages, page_size, layers=1, heads=1,
+                        head_dim=8, spill_store=LocalSpillStore())
+
+
+def test_release_below_frees_leading_pages_and_counts():
+    c = make_cache()
+    c.create("a")
+    c.extend("a", 15)                 # pages 0..3 (page_size 4)
+    free0 = c.stats()["pages_free"]
+    n = c.release_below("a", 9)       # pages 0,1 wholly below pos 9
+    assert n == 2
+    assert c.page_offset("a") == 2
+    assert c.stats()["pages_free"] == free0 + 2
+    assert c.stats()["pages_evicted_total"] == 2
+    assert len(c.pages_of("a")) == 2
+    # further extends map positions through the offset
+    start, new_len = c.extend("a", 1)
+    assert (start, new_len) == (15, 16)
+    # the newest page is never released, whatever the floor
+    c.release_below("a", 999)
+    assert len(c.pages_of("a")) == 1
+
+
+def test_truncate_rolls_back_pages_via_refcounts():
+    c = make_cache()
+    c.create("a")
+    c.extend("a", 10)                 # 3 pages
+    used = c.stats()["pages_used"]
+    c.truncate("a", 5)                # back to 2 pages
+    assert c.length("a") == 5
+    assert c.stats()["pages_used"] == used - 1
+    with pytest.raises(ValueError):
+        c.truncate("a", 7)            # can't truncate UP
+    # truncate of a COW-shared tail decrefs, never frees the sibling's
+    c.fork("a", "b")
+    c.truncate("a", 2)
+    assert c.length("b") == 5         # sibling untouched
+    c.extend("b", 1)                  # still writable
+    c.free("a")
+    c.free("b")
+    assert c.stats()["pages_used"] == 0
+
+
+def test_release_below_respects_fork_refcounts():
+    c = make_cache()
+    c.create("a")
+    c.extend("a", 12)
+    c.fork("a", "b")
+    used = c.stats()["pages_used"]
+    c.release_below("a", 9)           # a drops pages 0,1 — b keeps them
+    assert c.stats()["pages_used"] == used       # still referenced by b
+    c.release_below("b", 9)
+    assert c.stats()["pages_used"] == used - 2   # now truly free
+    c.free("a")
+    c.free("b")
+    assert c.stats()["pages_used"] == 0
+
+
+def test_spill_restore_carries_released_offset():
+    import jax.numpy as jnp
+    c = make_cache()
+    c.create("a")
+    c.extend("a", 15)
+    c.set_pools(jnp.arange(c.k_pool.size, dtype=jnp.float32)
+                .reshape(c.k_pool.shape), c.v_pool)
+    c.release_below("a", 9)
+    tail = np.asarray(c.k_pool[:, np.asarray(c.pages_of("a"))])
+    c.spill("a")
+    c.restore("a")
+    assert c.page_offset("a") == 2
+    assert c.length("a") == 15
+    np.testing.assert_array_equal(
+        np.asarray(c.k_pool[:, np.asarray(c.pages_of("a"))]), tail)
+
+
+# ------------------------------------------------------------------ backend
+
+DECODE_KW = dict(max_batch=8, max_len=128, page_size=16, num_pages=96,
+                 max_new_tokens=24)
+LONG_KW = dict(max_batch=8, max_len=256, page_size=16, num_pages=96,
+               max_new_tokens=96)
+
+
+def make_backend(**over):
+    from tosem_tpu.serve.backends import BertDecodeBackend
+    kw = dict(DECODE_KW)
+    kw.update(over)
+    return BertDecodeBackend(**kw)
+
+
+def drive(backend, sid, req):
+    out = backend.admit(sid, req)
+    step = 0
+    while not out.get("done"):
+        out = backend.step_batch([sid], [step])[0]
+        step += 1
+    res = backend.result(sid)
+    backend.release(sid)
+    return res
+
+
+PROMPT = {"ids": [1 + ((7 + j) % 126) for j in range(12)]}
+
+
+class TestSpeculative:
+    def test_bit_identical_to_greedy(self):
+        plain = make_backend()
+        spec = make_backend(spec_k=4)
+        for i in range(3):
+            p = {"ids": [1 + ((i * 7 + j) % 126) for j in range(10)]}
+            a = drive(plain, f"p{i}", dict(p))
+            b = drive(spec, f"s{i}", dict(p))
+            assert a["tokens"] == b["tokens"]
+        st = spec.cache_stats()
+        assert st["spec_proposed"] > 0
+        assert 0 <= st["spec_accepted"] <= st["spec_proposed"]
+        assert spec.cache.stats()["pages_used"] == 0
+
+    def test_multi_token_steps_commit_multiple(self):
+        spec = make_backend(spec_k=4)
+        out = spec.admit("a", dict(PROMPT))
+        steps = tokens = 0
+        while not out.get("done"):
+            out = spec.step_batch(["a"], [steps])[0]
+            steps += 1
+            tokens += out.get("n_tokens", 1)
+        # the repetitive tiny-model chains must accept SOME drafts —
+        # otherwise the whole mode is a no-op
+        assert tokens > steps
+        spec.release("a")
+
+    def test_replayed_spec_step_returns_memo(self):
+        spec = make_backend(spec_k=4)
+        spec.admit("a", dict(PROMPT))
+        first = spec.step_batch(["a"], [0])[0]
+        replay = spec.step_batch(["a"], [0])[0]
+        assert replay == first
+        spec.release("a")
+
+    def test_near_max_len_clamps_draft_block(self):
+        spec = make_backend(spec_k=4, max_len=32, max_new_tokens=64,
+                            num_pages=8)
+        long_prompt = {"ids": [3] * 28}
+        res = drive(spec, "edge", long_prompt)
+        assert len(res["tokens"]) <= 32
+        assert spec.cache.stats()["pages_used"] == 0
+
+
+class TestWindow:
+    def test_bounded_pages_and_eviction(self):
+        win = make_backend(**LONG_KW, window=32)
+        bound = -(-32 // 16) + 2
+        out = win.admit("w", dict(PROMPT))
+        step, max_seen = 0, 0
+        while not out.get("done"):
+            out = win.step_batch(["w"], [step])[0]
+            step += 1
+            max_seen = max(max_seen, win.cache.stats()["pages_used"])
+        assert max_seen <= bound
+        st = win.cache.stats()
+        assert st["pages_evicted_total"] > 0
+        win.release("w")
+        assert win.cache.stats()["pages_used"] == 0
+
+    def test_window_covering_history_matches_unwindowed(self):
+        """A window wider than anything the sequence reaches must not
+        change the greedy outputs (the masking and rolling tables are
+        no-ops until eviction starts)."""
+        plain = make_backend()
+        win = make_backend(window=DECODE_KW["max_len"])
+        a = drive(plain, "p", dict(PROMPT))
+        b = drive(win, "w", dict(PROMPT))
+        assert a["tokens"] == b["tokens"]
+
+    def test_window_spec_composition_matches_windowed_greedy(self):
+        ws = make_backend(**LONG_KW, window=32, spec_k=4)
+        wo = make_backend(**LONG_KW, window=32)
+        a = drive(ws, "ws", dict(PROMPT))
+        b = drive(wo, "wo", dict(PROMPT))
+        assert a["tokens"] == b["tokens"]
+
+    def test_eviction_never_outruns_the_kernel_window(self):
+        """At every step the lowest cached position must be <= the
+        lowest position the NEXT step's window attends
+        (len(tokens) - window) — an off-by-one here silently computes
+        attention over W-1 keys on page-aligned steps."""
+        win = make_backend(**LONG_KW, window=32)
+        out = win.admit("w", dict(PROMPT))
+        step = 0
+        while not out.get("done"):
+            seq = win._seqs["w"]
+            needed_low = max(len(seq.tokens) - 32, 0)
+            cached_low = win.cache.page_offset("w") * win.page_size
+            assert cached_low <= needed_low, (
+                f"step {step}: evicted up to {cached_low} but the "
+                f"kernel still attends {needed_low}")
+            out = win.step_batch(["w"], [step])[0]
+            step += 1
+        win.release("w")
+
+    def test_unrecoverable_reprefill_fails_terminally(self):
+        """A windowed pool is sized for the rolling window, not the
+        history: a lost spill payload whose re-prefill can NEVER fit
+        must fail the sequence (PagesLostError), not park it forever
+        under CachePressure."""
+        from tosem_tpu.serve.kv_cache import (LocalSpillStore,
+                                              PagesLostError)
+        b = make_backend(max_batch=4, max_len=256, page_size=8,
+                         num_pages=8, max_new_tokens=80, window=16)
+        b.cache._spill_store = LocalSpillStore()
+        out = b.admit("w", dict(PROMPT))
+        step = 0
+        while not out.get("done"):
+            out = b.step_batch(["w"], [step])[0]
+            step += 1
+        assert len(b._seqs["w"].tokens) > 64   # re-prefill needs > pool
+        b.spill_seq("w")
+        b.cache._spill_store._data.clear()     # chaos: payload gone
+        with pytest.raises(PagesLostError, match="unrecoverable"):
+            b.restore_seq("w")
+        b.release("w")
+
+    def test_windowed_spill_restore_mid_decode(self):
+        win = make_backend(**LONG_KW, window=32)
+        out = win.admit("w", dict(PROMPT))
+        step = 0
+        while not out.get("done"):
+            if step == 40:                   # deep enough to have evicted
+                assert win.cache.page_offset("w") > 0
+                win.spill_seq("w")
+                assert win.cache.is_spilled("w")
+                win.restore_seq("w")
+            out = win.step_batch(["w"], [step])[0]
+            step += 1
+        toks = win.result("w")["tokens"]
+        win.release("w")
+        # token path must be unchanged by the spill/restore round trip
+        ref = make_backend(**LONG_KW, window=32)
+        assert toks == drive(ref, "x", dict(PROMPT))["tokens"]
+
+
+class TestGroups:
+    def test_beam_result_sorted_and_best_at_least_greedy(self):
+        import math
+        b = make_backend()
+        res = drive(b, "g", {**PROMPT, "n": 4, "beam": True})
+        assert len(res["beams"]) == 4
+        lps = [e["logprob"] for e in res["beams"]]
+        assert lps == sorted(lps, reverse=True)
+        assert all(math.isfinite(lp) for lp in lps)
+        assert res["tokens"] == res["beams"][0]["tokens"]
+        assert b.cache.stats()["pages_used"] == 0
+
+    def test_group_shares_prefix_pages(self):
+        b = make_backend()
+        long_prompt = {"ids": [1 + (j % 126) for j in range(48)]}
+        b.admit("s", dict(long_prompt))
+        single = b.cache.stats()["pages_used"]
+        b.admit("g", {**long_prompt, "n": 4, "beam": True})
+        group = b.cache.stats()["pages_used"] - single
+        assert group <= 1.5 * single
+        b.release("s")
+        b.release("g")
+        assert b.cache.stats()["pages_used"] == 0
+
+    def test_sampling_deterministic_and_isolated(self):
+        b = make_backend()
+        req = {**PROMPT, "n": 3, "seed": 7, "temperature": 0.9}
+        r1 = drive(b, "p1", dict(req))
+        r2 = drive(b, "p2", dict(req))
+        assert [e["tokens"] for e in r1["samples"]] == \
+            [e["tokens"] for e in r2["samples"]]
+        # COW divergence must not corrupt an unrelated greedy sequence
+        g1 = drive(b, "q1", dict(PROMPT))
+        b2 = make_backend()
+        assert g1["tokens"] == drive(b2, "q2", dict(PROMPT))["tokens"]
+        assert b.cache.stats()["pages_used"] == 0
+
+    def test_group_replay_and_release(self):
+        b = make_backend()
+        b.admit("g", {**PROMPT, "n": 2, "beam": True})
+        first = b.step_batch(["g"], [0])[0]
+        assert b.step_batch(["g"], [0])[0] == first
+        b.release("g")
+        assert b.cache.stats()["pages_used"] == 0
+
+    def test_group_admit_replay_stable_across_beam_transitions(self):
+        """A replayed admit must return the RECORDED first token —
+        beam transitions rewrite beams[0].tokens wholesale, so the
+        answer cannot be recomputed from live beam state."""
+        b = make_backend()
+        first = b.admit("g", {**PROMPT, "n": 4, "beam": True})
+        for step in range(4):                  # beams reshuffle
+            b.step_batch(["g"], [step])
+        replay = b.admit("g", {**PROMPT, "n": 4, "beam": True})
+        assert replay["token"] == first["token"]
+        assert replay["done"] is False
+        b.release("g")
+
+    def test_oversized_group_rejected(self):
+        b = make_backend(max_batch=4)
+        with pytest.raises(ValueError, match="max_batch"):
+            b.admit("g", {**PROMPT, "n": 8, "beam": True})
+        assert b.cache.stats()["pages_used"] == 0
+
+    def test_group_finishing_at_admit_retires_cleanly(self):
+        """Every branch done on its first token (max_new_tokens=1): the
+        admit must fork all branches before settling any — freeing the
+        root when branch 0 finishes used to KeyError the later forks."""
+        b = make_backend(max_new_tokens=1)
+        out = b.admit("g", {**PROMPT, "n": 4, "beam": True})
+        assert out["done"]
+        assert len(out["result"]["beams"]) == 4
+        b.release("g")
+        assert b.cache.stats()["pages_used"] == 0
+
+    def test_row_overflow_raises_before_cache_mutation(self):
+        """An over-packed step_batch (scheduler bug / misconfigured
+        max_active) must raise BEFORE any cache.extend lands — a
+        post-planning raise would leave cache lengths ahead of the
+        token history and corrupt every retried step."""
+        b = make_backend(max_batch=2)
+        for i in range(2):
+            b.admit(f"s{i}", dict(PROMPT))
+        b.admit("g", {**PROMPT, "n": 2, "beam": True})   # 2 more rows
+        lengths = {cid: b.cache.length(cid)
+                   for cid in ("s0", "s1", "g#0", "g#f1")}
+        with pytest.raises(ValueError, match="packed rows"):
+            b.step_batch(["s0", "s1", "g"], [0, 0, 0])
+        for cid, n in lengths.items():
+            assert b.cache.length(cid) == n              # untouched
+        # a correctly-sized step still advances afterwards
+        out = b.step_batch(["s0", "s1"], [0, 0])
+        assert all("token" in o for o in out)
+        for sid in ("s0", "s1", "g"):
+            b.release(sid)
+        assert b.cache.stats()["pages_used"] == 0
+
+
+# -------------------------------------------------- flash_blocks "decode"
+
+
+def test_spec_q_selector_and_cache_sections(tmp_path):
+    from tosem_tpu.ops import flash_blocks as fb
+    p = str(tmp_path / "fb.json")
+    fb.reset_cache()
+    try:
+        assert fb.select_spec_q(64, "bfloat16", cache_path=p) == 4
+        assert fb.select_spec_q.last_source == "table"
+        assert fb.select_spec_q(32, "float32", cache_path=p) == 4
+        assert fb.select_spec_q.last_source == "default"
+        fb.save_cache({"spec_q_d64_bfloat16": 8}, p, section="decode")
+        fb.reset_cache()
+        assert fb.select_spec_q(64, "bfloat16", cache_path=p) == 8
+        assert fb.select_spec_q.last_source == "cache"
+        # other sections survive a decode-section write
+        fb.save_cache({"t512_d64_bfloat16": [256, 256, 256, 256]}, p)
+        fb.reset_cache()
+        assert fb.select_spec_q(64, "bfloat16", cache_path=p) == 8
+        # corrupt decode section degrades to the table, never raises
+        doc = json.load(open(p))
+        doc["decode"] = {"spec_q_d64_bfloat16": "junk"}
+        json.dump(doc, open(p, "w"))
+        fb.reset_cache()
+        assert fb.select_spec_q(64, "bfloat16", cache_path=p) == 4
+        # missing file: defaults
+        fb.reset_cache()
+        assert fb.select_spec_q(64, "bfloat16",
+                                cache_path=str(tmp_path / "no.json")) == 4
+    finally:
+        fb.reset_cache()
+
+
+def test_autotune_spec_q_end_to_end(tmp_path):
+    from tosem_tpu.ops import flash_blocks as fb
+    p = str(tmp_path / "fb.json")
+    fb.reset_cache()
+    try:
+        recs = fb.autotune_spec_q([(1, 1, 64, 16, "float32")], reps=1,
+                                  ks=(2, 4), cache_path=p)
+        assert {r["k"] for r in recs} == {2, 4}
+        assert sum(r["best"] for r in recs) == 1
+        assert all(r["per_token_us"] > 0 for r in recs)
+        fb.reset_cache()
+        assert fb.select_spec_q(16, "float32", cache_path=p) in (2, 4)
+        assert fb.select_spec_q.last_source == "cache"
+    finally:
+        fb.reset_cache()
+
+
+# --------------------------------------------------------- serve data plane
+
+
+@pytest.fixture(scope="module")
+def runtime():
+    import tosem_tpu.runtime as rt
+    own = not rt.is_initialized()
+    if own:
+        rt.init(num_workers=2, memory_monitor=False)
+    yield rt
+    if own:
+        rt.shutdown()
+
+
+@pytest.mark.slow
+class TestServeModes:
+    def test_spec_deployment_parity_and_gauges(self, runtime):
+        from tosem_tpu.obs.metrics import DEFAULT
+        from tosem_tpu.serve.backends import BertDecodeBackend
+        from tosem_tpu.serve.batching import DecodePolicy
+        from tosem_tpu.serve.core import Serve
+        serve = Serve()
+        serve.deploy("spec-dep", BertDecodeBackend, num_replicas=1,
+                     init_kwargs=dict(DECODE_KW, spec_k=4),
+                     decode_policy=DecodePolicy(max_active=8),
+                     warmup_shapes=[16])
+        try:
+            h = serve.get_handle("spec-dep")
+            outs = [h.call({**PROMPT}, timeout=120.0) for _ in range(2)]
+            assert outs[0]["tokens"] == outs[1]["tokens"]
+            ref = drive(make_backend(spec_k=4), "r", dict(PROMPT))
+            assert outs[0]["tokens"] == ref["tokens"]
+            stats = serve.get_deployment("spec-dep").stats()
+            assert stats["tokens_emitted"] >= \
+                2 * len(outs[0]["generated"])
+            # acceptance gauge exported (scrape is throttled — poke the
+            # queue's refresher directly)
+            serve.get_deployment("spec-dep")._queue._last_scrape = 0.0
+            serve.get_deployment("spec-dep")._queue._refresh_gauges()
+            g = DEFAULT.get("serve_spec_acceptance_rate")
+            assert g is not None
+            assert 0.0 <= g.value(("spec-dep",)) <= 1.0
+        finally:
+            serve.delete("spec-dep")
+
+    def test_window_deployment_evicts_and_exports(self, runtime):
+        from tosem_tpu.obs.metrics import DEFAULT
+        from tosem_tpu.serve.backends import BertDecodeBackend
+        from tosem_tpu.serve.batching import DecodePolicy
+        from tosem_tpu.serve.core import Serve
+        serve = Serve()
+        serve.deploy("win-dep", BertDecodeBackend, num_replicas=1,
+                     init_kwargs=dict(LONG_KW, window=32),
+                     decode_policy=DecodePolicy(max_active=8),
+                     warmup_shapes=[16])
+        try:
+            h = serve.get_handle("win-dep")
+            out = h.call(dict(PROMPT), timeout=180.0)
+            assert len(out["generated"]) == LONG_KW["max_new_tokens"]
+            dep = serve.get_deployment("win-dep")
+            dep._queue._last_scrape = 0.0
+            dep._queue._refresh_gauges()
+            assert dep.stats()["kv_pages_evicted_total"] > 0
+            g = DEFAULT.get("serve_kv_pages_evicted_total")
+            assert g is not None and g.value(("win-dep",)) > 0
+        finally:
+            serve.delete("win-dep")
+
+    def test_sampling_policy_fanout_through_queue(self, runtime):
+        from tosem_tpu.serve.backends import BertDecodeBackend
+        from tosem_tpu.serve.batching import DecodePolicy, SamplingPolicy
+        from tosem_tpu.serve.core import Serve
+        serve = Serve()
+        serve.deploy("beam-dep", BertDecodeBackend, num_replicas=1,
+                     init_kwargs=dict(DECODE_KW),
+                     decode_policy=DecodePolicy(
+                         max_active=8,
+                         sampling=SamplingPolicy(n=4, beam=True)),
+                     warmup_shapes=[16])
+        try:
+            h = serve.get_handle("beam-dep")
+            out = h.call(dict(PROMPT), timeout=180.0)
+            assert len(out["beams"]) == 4
+            # per-request override: plain greedy rides the same queue
+            single = h.call({**PROMPT, "n": 1}, timeout=180.0)
+            assert "beams" not in single
+            ref = drive(make_backend(), "r", dict(PROMPT))
+            assert single["tokens"] == ref["tokens"]
+        finally:
+            serve.delete("beam-dep")
+
+    def test_oversized_group_fails_alone_in_queue(self, runtime):
+        from tosem_tpu.runtime.common import TaskError
+        from tosem_tpu.serve.backends import BertDecodeBackend
+        from tosem_tpu.serve.batching import DecodePolicy
+        from tosem_tpu.serve.core import Serve
+        serve = Serve()
+        serve.deploy("cap-dep", BertDecodeBackend, num_replicas=1,
+                     init_kwargs=dict(DECODE_KW),
+                     decode_policy=DecodePolicy(max_active=4),
+                     warmup_shapes=[16])
+        try:
+            h = serve.get_handle("cap-dep")
+            with pytest.raises((ValueError, TaskError)):
+                h.call({**PROMPT, "n": 8, "beam": True}, timeout=60.0)
+            # the queue survives: a plain request still completes
+            out = h.call(dict(PROMPT), timeout=120.0)
+            assert out["generated"]
+        finally:
+            serve.delete("cap-dep")

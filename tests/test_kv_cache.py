@@ -210,8 +210,71 @@ def test_stats_counts():
     c.spill("b")
     s = c.stats()
     assert s == {"pages_total": 6, "pages_used": 2, "pages_free": 4,
-                 "pages_spilled": 1, "sequences": 1,
-                 "sequences_spilled": 1}
+                 "pages_spilled": 1, "pages_evicted_total": 0,
+                 "sequences": 1, "sequences_spilled": 1}
+
+
+# --------------------------------------------------------------------------
+# fork × spill composition (ISSUE 11 satellite): the COW sharing and the
+# spill tier must not double-free or tear each other's pages
+
+
+def test_forked_child_survives_parent_spill_and_restore():
+    c = make_cache(num_pages=12)
+    c.create("a")
+    c.extend("a", 9)
+    fill_pages(c, "a")
+    c.fork("a", "b")
+    child_before = gather(c, "b")
+    c.spill("a")                           # parent demoted
+    # the shared pages stay live under the child's refcounts
+    np.testing.assert_array_equal(gather(c, "b")[0], child_before[0])
+    c.extend("b", 1)                       # child keeps decoding (COW)
+    c.restore("a")                         # parent back on FRESH pages
+    a_bytes = gather(c, "a")
+    np.testing.assert_array_equal(a_bytes[0], child_before[0])
+    # restored parent shares nothing with the child anymore: writes to
+    # its pages can't alias the child's
+    assert not set(c.pages_of("a")) & set(c.pages_of("b")[:2])
+    c.free("a")
+    c.free("b")
+    assert c.stats()["pages_used"] == 0    # refcounts never double-free
+
+
+def test_parent_drop_spilled_leaves_child_intact():
+    c = make_cache(num_pages=12)
+    c.create("a")
+    c.extend("a", 9)
+    fill_pages(c, "a")
+    c.fork("a", "b")
+    before = gather(c, "b")
+    c.spill("a")
+    c.drop_spilled("a")                    # re-prefill path: forget it
+    np.testing.assert_array_equal(gather(c, "b")[0], before[0])
+    c.free("b")
+    assert c.stats()["pages_used"] == 0
+    # the spill payload was reclaimed exactly once (LocalSpillStore
+    # would raise PagesLostError on a second lookup)
+    assert c.stats()["sequences_spilled"] == 0
+
+
+def test_both_forks_spilled_restore_independently():
+    c = make_cache(num_pages=16)
+    c.create("a")
+    c.extend("a", 9)
+    fill_pages(c, "a")
+    c.fork("a", "b")
+    shared = gather(c, "a")
+    c.spill("a")
+    c.spill("b")
+    assert c.stats()["pages_used"] == 0    # shared pages freed ONCE each
+    c.restore("b")
+    c.restore("a")
+    np.testing.assert_array_equal(gather(c, "a")[0], shared[0])
+    np.testing.assert_array_equal(gather(c, "b")[0], shared[0])
+    c.free("a")
+    c.free("b")
+    assert c.stats()["pages_used"] == 0
 
 
 # --------------------------------------------------------------------------

@@ -51,6 +51,7 @@ _LAZY_EXPORTS = {
     "CachePressure": ("tosem_tpu.serve.kv_cache", "CachePressure"),
     "PagesLostError": ("tosem_tpu.serve.kv_cache", "PagesLostError"),
     "DecodePolicy": ("tosem_tpu.serve.batching", "DecodePolicy"),
+    "SamplingPolicy": ("tosem_tpu.serve.batching", "SamplingPolicy"),
     "select_page_size": ("tosem_tpu.ops.flash_blocks",
                          "select_page_size"),
     # cluster serving plane (round 8): node-spanning deployments behind

@@ -29,7 +29,7 @@ CONFIGS = ("gemm", "timing_check", "conv_sweep", "allreduce",
            "flash_autotune", "autotune_decode_pages", "flash_sparse",
            "detection_train", "detection_infer", "pointpillars_infer",
            "speech_train", "serve_bench", "decode_bench",
-           "cluster_bench", "analysis")
+           "decode_scenarios", "cluster_bench", "analysis")
 
 
 def make_flags() -> FlagSet:
@@ -526,6 +526,29 @@ def run_flash_autotune(fs: FlagSet) -> List[Any]:
         rows.append(row)
         star = " *" if r["best"] else ""
         print(f"  {row.bench_id}: {row.value:.1f} {row.unit}{star}")
+    # multi-token decode q-block sweep (speculative scoring): winners
+    # land in the cache's "decode" section, where select_spec_q — and
+    # therefore speculative BertDecodeBackend configs — reads the draft
+    # block alongside the page size
+    from tosem_tpu.ops.flash_blocks import autotune_spec_q
+    if fs.device == "cpu":
+        spec_shapes = [(2, 2, 128, 32, "float32")]
+    else:
+        spec_shapes = [(8, 12, 512, 64, "bfloat16"),
+                       (8, 12, 2048, 64, "bfloat16")]
+    for r in autotune_spec_q(spec_shapes, reps=3):
+        B, H, T, D, dtype = r["shape"]
+        row = ResultRow(
+            project="ops", config="flash_autotune",
+            bench_id=f"decode_spec_q_b{B}_t{T}_{dtype}_k{r['k']}",
+            metric="per_token_us", value=r["per_token_us"], unit="us",
+            device=platform, n_devices=1,
+            extra={"shape": [B, H, T, D], "dtype": dtype,
+                   "k": r["k"], "time_us": r["time_us"],
+                   "best": r["best"], "cache": DEFAULT_CACHE_PATH})
+        rows.append(row)
+        star = " *" if r["best"] else ""
+        print(f"  {row.bench_id}: {row.value:.1f} {row.unit}{star}")
     # sparse schedule sweep (--mask=local:1024,doc): per-mask-signature
     # winners land in the cache's "sparse" section, where
     # select_block_sizes(mask_sig=…) — and therefore every sparse
@@ -1014,6 +1037,24 @@ def run_decode_bench(fs: FlagSet) -> List[Any]:
     return rows
 
 
+def run_decode_scenarios(fs: FlagSet) -> List[Any]:
+    """Decode fast-path scenario legs as a capture-harness leg: the
+    sliding-window t8192 step A/B (live-page bound asserted), the
+    speculative k=4 accepted-tokens/s A/B (bit-identical greedy pinned),
+    and the beam n=4 COW fanout (page-sharing ratio asserted) — see
+    :mod:`tosem_tpu.serve.bench_decode`. Runs AFTER
+    ``autotune_decode_pages`` in the capture queue so the window arm's
+    page size and the spec arm's draft block read on-chip winners. Rows
+    land under the ``decode_scenarios`` config."""
+    from tosem_tpu.serve.bench_decode import (SCENARIO_BENCHES,
+                                              run_decode_benchmarks)
+    only = {b for ids in SCENARIO_BENCHES.values() for b in ids}
+    rows = run_decode_benchmarks(trials=2, min_s=0.4, only=only)
+    for r in rows:
+        r.config = "decode_scenarios"
+    return rows
+
+
 def run_cluster_bench(fs: FlagSet) -> List[Any]:
     """Cluster serving microbench as a capture-harness leg: 2 nodes × 2
     replicas behind the router tier vs the single-process data plane,
@@ -1101,6 +1142,7 @@ RUNNERS = {
     "speech_train": run_speech_train,
     "serve_bench": run_serve_bench,
     "decode_bench": run_decode_bench,
+    "decode_scenarios": run_decode_scenarios,
     "cluster_bench": run_cluster_bench,
     "analysis": run_analysis,
 }

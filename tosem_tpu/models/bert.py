@@ -324,6 +324,62 @@ class Bert(Module):
             return logits, k_pool, v_pool
         return fwd
 
+    def decode_multi_fn(self, vs, *, page_size: int, q_tokens: int,
+                        impl=None, window: Optional[int] = None):
+        """K-token decode step over the paged cache — the speculative-
+        scoring / sliding-window generalization of
+        :meth:`decode_step_fn`: ``fwd(ids [B,K], positions [B,K],
+        k_pool, v_pool, block_tables [B,W], seq_lens [B], q_rows [B],
+        page_offsets [B]) -> (logits [B,K,vocab], k_pool', v_pool')``.
+
+        Row r of an active sequence feeds the token at absolute position
+        ``positions[b, r]`` (the last ``q_rows[b]`` consecutive
+        positions, ending at ``seq_lens[b] - 1``); its K/V scatters into
+        the pages and its logits row scores the NEXT position —
+        exactly what ``q_rows[b]`` sequential single-token steps would
+        compute, in ONE program call (intra-step causal mask in the
+        kernel). Padding columns (r >= q_rows[b]) mirror the last real
+        one host-side, scatter out of bounds, and emit garbage logits
+        the caller ignores. ``window`` composes: attention sees each
+        row's ``window`` most recent positions only, and
+        ``page_offsets`` names the rolling block table's first logical
+        page (the window-eviction contract)."""
+        self._check_decodable()
+        if not 1 <= q_tokens <= 8:
+            raise ValueError(f"q_tokens {q_tokens} outside [1, 8]")
+        from tosem_tpu.ops.paged_attention import paged_attention
+        p = vs["params"]
+        K = q_tokens
+
+        def fwd(ids, positions, k_pool, v_pool, block_tables, seq_lens,
+                q_rows, page_offsets):
+            B = ids.shape[0]
+            sl = seq_lens.astype(jnp.int32)
+            kr = q_rows.astype(jnp.int32)
+            po = page_offsets.astype(jnp.int32)
+            h = self._embed(p, ids, positions)            # [B, K, dim]
+            col = jnp.arange(K, dtype=jnp.int32)[None, :]
+            active = (sl[:, None] > 0) & (col < kr[:, None])
+            page_idx = positions // page_size - po[:, None]
+            P = k_pool.shape[1]
+            pages = jnp.where(
+                active,
+                jnp.take_along_axis(block_tables,
+                                    jnp.clip(page_idx, 0,
+                                             block_tables.shape[1] - 1),
+                                    axis=1),
+                P)                                        # OOB → dropped
+            rows = positions % page_size
+            for i, layer in enumerate(self.layers):
+                h, k_pool, v_pool = _decode_layer_multi(
+                    layer, p[f"layer{i}"], h, i, k_pool, v_pool, pages,
+                    rows, block_tables, sl, kr, po, impl, window)
+            h, _ = self.ln_out.apply(variables(p["ln_out"]), h)
+            logits = self.tok.attend(variables(p["tok"]),
+                                     h.astype(jnp.float32))
+            return logits, k_pool, v_pool
+        return fwd
+
     def _embed(self, p, ids, pos_ids):
         """Shared embedding stack (ids+pos → ln_emb), eval mode."""
         h, _ = self.tok.apply(variables(p["tok"]), ids)
@@ -392,6 +448,40 @@ def _decode_layer_step(layer, p_l, x, layer_idx, k_pool, v_pool, pages,
     out = paged_attention(q, k_pool[layer_idx], v_pool[layer_idx],
                           block_tables, seq_lens, impl=impl)
     out = out.reshape(B, attn.dim).astype(x.dtype)
+    out, _ = attn.o.apply(variables(p_l["attn"]["o"]), out)
+    x = x + out
+    h, _ = layer.ln2.apply(variables(p_l["ln2"]), x)
+    h, _ = layer.fc1.apply(variables(p_l["fc1"]), h)
+    h = gelu(h)
+    h, _ = layer.fc2.apply(variables(p_l["fc2"]), h)
+    return x + h, k_pool, v_pool
+
+
+def _decode_layer_multi(layer, p_l, x, layer_idx, k_pool, v_pool, pages,
+                        rows, block_tables, seq_lens, q_rows,
+                        page_offsets, impl, window):
+    """One layer of the K-token decode step (the multi-query sibling of
+    :func:`_decode_layer_step`): project q/k/v for all K fed tokens,
+    scatter their K/V into the page slots ([B, K] index arrays — OOB
+    padding columns drop), attend with the intra-step causal mask."""
+    from tosem_tpu.ops.paged_attention import paged_attention
+    B, K, _ = x.shape
+    attn = layer.attn
+    h, _ = layer.ln1.apply(variables(p_l["ln1"]), x)
+    proj = lambda name, m: m.apply(variables(p_l["attn"][name]), h)[0] \
+        .reshape(B, K, attn.heads, attn.head_dim)
+    q = proj("q", attn.q)
+    k = proj("k", attn.k)
+    v = proj("v", attn.v)
+    k_pool = k_pool.at[layer_idx, pages, rows].set(
+        k.astype(k_pool.dtype))
+    v_pool = v_pool.at[layer_idx, pages, rows].set(
+        v.astype(v_pool.dtype))
+    out = paged_attention(q, k_pool[layer_idx], v_pool[layer_idx],
+                          block_tables, seq_lens, impl=impl,
+                          q_rows=q_rows, window=window,
+                          page_offsets=page_offsets)
+    out = out.reshape(B, K, attn.dim).astype(x.dtype)
     out, _ = attn.o.apply(variables(p_l["attn"]["o"]), out)
     x = x + out
     h, _ = layer.ln2.apply(variables(p_l["ln2"]), x)

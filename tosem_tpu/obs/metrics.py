@@ -228,6 +228,13 @@ def serve_metrics(registry: Optional[Registry] = None) -> Dict[str, Metric]:
       lack of demand.
     - ``serve_kv_pages`` (gauge, labels deployment/state): KV-cache
       pages ``used`` / ``free`` / ``spilled``.
+    - ``serve_kv_pages_evicted_total`` (gauge mirroring a replica-side
+      monotonic counter): pages released by sliding-window eviction —
+      rising means bounded-memory long-context decode is actually
+      evicting, flat with a long window means the window never filled.
+    - ``serve_spec_acceptance_rate`` (gauge): accepted / proposed draft
+      tokens of speculative decode — the knob that decides whether
+      ``spec_k`` pays for itself (commit rate ~ 1 + rate * (k - 1)).
     """
     reg = registry or DEFAULT
     return {
@@ -259,6 +266,14 @@ def serve_metrics(registry: Optional[Registry] = None) -> Dict[str, Metric]:
             "serve_kv_pages",
             "KV-cache pages by state (used/free/spilled)",
             labels=("deployment", "state")),
+        "kv_evicted": reg.gauge(
+            "serve_kv_pages_evicted_total",
+            "KV pages released by sliding-window eviction (lifetime)",
+            labels=("deployment",)),
+        "spec_acceptance": reg.gauge(
+            "serve_spec_acceptance_rate",
+            "speculative decode accepted/proposed draft-token ratio",
+            labels=("deployment",)),
     }
 
 

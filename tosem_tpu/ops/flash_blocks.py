@@ -155,6 +155,7 @@ def _align_to_seq(blocks: BlockSizes, Tq: int, Tk: int) -> BlockSizes:
 _cache: Optional[Dict[str, List[int]]] = None
 _pages_cache: Optional[Dict[str, int]] = None
 _sparse_cache: Optional[Dict[str, List[int]]] = None
+_decode_cache: Optional[Dict[str, int]] = None
 _cache_path_loaded: Optional[str] = None
 
 
@@ -177,18 +178,28 @@ def _valid_blocks(section) -> Dict[str, List[int]]:
             and all(isinstance(x, int) and x > 0 for x in v)}
 
 
+def _valid_scalars(section) -> Dict[str, int]:
+    """Filter a scalar-valued cache section ("pages"/"decode"),
+    tolerating a missing/corrupt section — a bad entry degrades to the
+    table/default path, never crashes selection."""
+    if not isinstance(section, dict):
+        return {}
+    return {k: int(v) for k, v in section.items()
+            if isinstance(v, (int, float)) and int(v) > 0}
+
+
 def _load_cache(path: str) -> Dict[str, List[int]]:
-    global _cache, _pages_cache, _sparse_cache, _cache_path_loaded
+    global _cache, _pages_cache, _sparse_cache, _decode_cache
+    global _cache_path_loaded
     if _cache is not None and _cache_path_loaded == path:
         return _cache
     raw = _load_raw(path)
     data = _valid_blocks(raw.get("blocks", {}))
-    pages = {}
-    if isinstance(raw.get("pages", {}), dict):
-        pages = {k: int(v) for k, v in raw.get("pages", {}).items()
-                 if isinstance(v, (int, float)) and int(v) > 0}
+    pages = _valid_scalars(raw.get("pages", {}))
     sparse = _valid_blocks(raw.get("sparse", {}))
+    decode = _valid_scalars(raw.get("decode", {}))
     _cache, _pages_cache, _sparse_cache = data, pages, sparse
+    _decode_cache = decode
     _cache_path_loaded = path
     return data
 
@@ -196,6 +207,11 @@ def _load_cache(path: str) -> Dict[str, List[int]]:
 def _load_pages(path: str) -> Dict[str, int]:
     _load_cache(path)
     return _pages_cache or {}
+
+
+def _load_decode(path: str) -> Dict[str, int]:
+    _load_cache(path)
+    return _decode_cache or {}
 
 
 def _load_sparse(path: str) -> Dict[str, List[int]]:
@@ -419,22 +435,27 @@ def save_cache(winners: Dict[str, List[int]],
                section: str = "blocks") -> None:
     """Merge winners into the JSON cache (atomic write). ``section`` is
     ``"blocks"`` (flash chunk sizes, list-of-4 values), ``"pages"``
-    (decode page sizes, scalar values), or ``"sparse"`` (per-mask-
-    signature chunk sizes, list-of-4 values); the other sections are
-    preserved."""
-    global _cache, _pages_cache, _sparse_cache, _cache_path_loaded
-    if section not in ("blocks", "pages", "sparse"):
+    (decode page sizes, scalar values), ``"sparse"`` (per-mask-
+    signature chunk sizes, list-of-4 values), or ``"decode"``
+    (multi-token decode q-block rows, scalar values); the other
+    sections are preserved."""
+    global _cache, _pages_cache, _sparse_cache, _decode_cache
+    global _cache_path_loaded
+    if section not in ("blocks", "pages", "sparse", "decode"):
         raise ValueError(f"unknown cache section {section!r}")
     blocks = dict(_load_cache(cache_path))
     pages = dict(_pages_cache or {})
     sparse = dict(_sparse_cache or {})
-    {"blocks": blocks, "pages": pages,
-     "sparse": sparse}[section].update(winners)
+    decode = dict(_decode_cache or {})
+    {"blocks": blocks, "pages": pages, "sparse": sparse,
+     "decode": decode}[section].update(winners)
     payload: dict = {"blocks": blocks}
     if pages:
         payload["pages"] = pages
     if sparse:
         payload["sparse"] = sparse
+    if decode:
+        payload["decode"] = decode
     d = os.path.dirname(cache_path)
     if d:
         os.makedirs(d, exist_ok=True)
@@ -443,13 +464,16 @@ def save_cache(winners: Dict[str, List[int]],
         json.dump(payload, f, indent=1, sort_keys=True)
     os.replace(tmp, cache_path)
     _cache, _pages_cache, _sparse_cache = blocks, pages, sparse
+    _decode_cache = decode
     _cache_path_loaded = cache_path
 
 
 def reset_cache() -> None:
     """Drop the in-process cache view (tests; after external writes)."""
-    global _cache, _pages_cache, _sparse_cache, _cache_path_loaded
+    global _cache, _pages_cache, _sparse_cache, _decode_cache
+    global _cache_path_loaded
     _cache, _pages_cache, _sparse_cache = None, None, None
+    _decode_cache = None
     _cache_path_loaded = None
 
 
@@ -564,4 +588,117 @@ def autotune_decode_pages(shapes: Iterable[Tuple[int, int, int, int, str]],
             winners.setdefault(_page_key(d, str(dtype)), best[0])
     if winners:
         save_cache(winners, cache_path, section="pages")
+    return records
+
+
+# ---------------------------------------------------------------------------
+# multi-token decode q-block selection (speculative scoring)
+
+# (d, dtype) -> draft block k for the multi-query decode kernel. The k
+# draft tokens ride the 8 sublane rows the single-token path spends on
+# broadcast, so any k <= 8 costs ONE step program; bigger k amortizes
+# the per-step dispatch over more scored positions but wastes work when
+# the drafter's acceptance rate is low. 4 is the classic speculative
+# sweet spot (and the acceptance-rate break-even is a serving-side
+# concern — this table only prices the KERNEL).
+DECODE_SPEC_Q_TABLE: Dict[Tuple[int, str], int] = {
+    (64, "bfloat16"): 4,
+    (64, "float32"): 4,
+}
+
+_DEFAULT_SPEC_Q = 4
+
+_SPEC_Q_CANDIDATES = (2, 4, 8)
+
+
+def _spec_q_key(d: int, dtype: str) -> str:
+    return f"spec_q_d{d}_{dtype}"
+
+
+def select_spec_q(d: int, dtype: str, *,
+                  cache_path: Optional[str] = DEFAULT_CACHE_PATH) -> int:
+    """Pick the draft block (q rows per speculative step) for a
+    (d, dtype) decode config. Priority mirrors the other selectors:
+    autotune cache ("decode" section) → static table → default; result
+    clamped to the 8 sublane rows. Sets ``select_spec_q.last_source``.
+    """
+    dtype = str(dtype)
+    picked: Optional[int] = None
+    src = "default"
+    if cache_path:
+        hit = _load_decode(cache_path).get(_spec_q_key(d, dtype))
+        if hit:
+            picked, src = int(hit), "cache"
+    if picked is None:
+        hit = DECODE_SPEC_Q_TABLE.get((d, dtype))
+        if hit is not None:
+            picked, src = int(hit), "table"
+    if picked is None:
+        picked = _DEFAULT_SPEC_Q
+    select_spec_q.last_source = src
+    return max(1, min(picked, _SUBLANES))
+
+
+select_spec_q.last_source = "default"
+
+
+def autotune_spec_q(shapes: Iterable[Tuple[int, int, int, int, str]],
+                    *, reps: int = 3, ks: Tuple[int, ...] = _SPEC_Q_CANDIDATES,
+                    cache_path: str = DEFAULT_CACHE_PATH) -> List[dict]:
+    """Measure candidate multi-token q-blocks for the decode kernel and
+    cache the winners in the ``"decode"`` section.
+
+    ``shapes``: iterables of (B, H, T, d, dtype) with T the cached
+    context. Candidates are scored on time per SCORED TOKEN (step time
+    / k — what speculative throughput is made of, assuming acceptance),
+    so a k that only wins by batching more garbage loses. Winners are
+    keyed (d, dtype) like the page selector: first shape sticks."""
+    import jax
+    import jax.numpy as jnp
+
+    from tosem_tpu.ops.paged_attention import paged_attention
+    from tosem_tpu.utils.timing import DeviceLoopBench
+
+    records: List[dict] = []
+    winners: Dict[str, int] = {}
+    for B, H, T, d, dtype in shapes:
+        dt = jnp.dtype(dtype)
+        page = select_page_size(d, str(dtype), max_len=T,
+                                cache_path=cache_path)
+        page = min(page, T)
+        while T % page:
+            page //= 2
+        n_pages = T // page
+        P = B * n_pages
+        ks_rng = jax.random.split(jax.random.PRNGKey(0), 3)
+        kp = jax.random.normal(ks_rng[1], (P, page, H, d),
+                               jnp.float32).astype(dt)
+        vp = jax.random.normal(ks_rng[2], (P, page, H, d),
+                               jnp.float32).astype(dt)
+        bt = jnp.arange(P, dtype=jnp.int32).reshape(B, n_pages)
+        sl = jnp.full((B,), T, jnp.int32)
+        best = None
+        timed = []
+        for k in ks:
+            if not 1 <= k <= _SUBLANES:
+                continue
+            q = jax.random.normal(ks_rng[0], (B, k, H, d),
+                                  jnp.float32).astype(dt)
+            op = jax.jit(lambda q, kp, vp, bt=bt, sl=sl:
+                         paged_attention(q, kp, vp, bt, sl,
+                                         impl="pallas"))
+            sec = DeviceLoopBench(op=op, args=(q, kp, vp),
+                                  perturb=0).time(reps=reps)
+            timed.append((k, sec))
+            if best is None or sec / k < best[1] / best[0]:
+                best = (k, sec)
+        for k, sec in timed:
+            records.append({"shape": [B, H, T, d, dtype], "k": k,
+                            "time_us": sec * 1e6,
+                            "per_token_us": sec * 1e6 / k,
+                            "best": k == best[0]})
+        if best is not None:
+            winners.setdefault(_spec_q_key(d, str(dtype)), best[0])
+    if winners:
+        save_cache(winners, cache_path, section="decode")
     return records

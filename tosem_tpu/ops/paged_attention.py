@@ -42,6 +42,30 @@ also carries a pure-XLA lowering of the same computation
 on TPU and XLA elsewhere, the 2304.12576 one-kernel-many-lowerings
 argument applied to decode. Parity tests pin all three paths
 (pallas-interpret, xla, dense reference) against each other.
+
+Three composable decode fast-path modes extend the base kernel (each
+with the same dual lowering and parity discipline):
+
+- **Multi-token queries** (speculative scoring): ``q`` may be
+  ``[B, k, H, D]`` with ``k <= 8`` — the ``k`` draft tokens ride the
+  sublane rows the single-token path spends on broadcast, so scoring k
+  draft positions costs ONE kernel step. ``q_rows [B]`` gives the
+  per-sequence count of REAL rows (padding rows mirror the last real
+  one); row r holds the token at absolute position
+  ``seq_len - q_rows + r`` and attends causally up to itself — the
+  intra-step causal mask that makes the k scores exactly what k
+  sequential single-token steps would compute.
+- **Sliding window** (``window=W``): row r sees only keys in
+  ``(pos_r - W, pos_r]``. The page schedule skips pages wholly below
+  the window — no MXU (``pl.when``) and no HBM (the clamped index map
+  revisits an in-window page, eliding the copy) — so per-token cost is
+  O(window), not O(history).
+- **Page offsets** (``page_offsets [B]``): block-table slot j holds
+  logical page ``page_offsets[b] + j``, so a window-evicted sequence
+  hands the kernel a NARROW rolling table (width ~ window/page_size)
+  instead of a max_len-wide one — the XLA lowering then gathers only
+  in-window pages, which is where the long-context constant-latency
+  claim comes from off-chip.
 """
 from __future__ import annotations
 
@@ -187,18 +211,200 @@ def _paged_attention_xla(q, k_pages, v_pages, block_tables, seq_lens,
     return out.astype(q.dtype)
 
 
+# ---------------------------------------------------------------------------
+# general path: multi-token queries (speculative scoring) + sliding
+# window + page offsets. The single-token/full-history kernel above is
+# kept verbatim so the PR-6 decode path stays bit-identical.
+
+
+def _decode_multi_kernel(bt_ref, sl_ref, kr_ref, po_ref, q_ref, k_ref,
+                         v_ref, o_ref, m_sc, l_sc, acc_sc, *, sm_scale,
+                         page_size, n_pages, window):
+    del bt_ref                      # consumed by the index maps
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    sl = sl_ref[b]
+    kr = kr_ref[b]
+    po = po_ref[b]
+    j_last = jnp.maximum(
+        lax.div(sl + page_size - 1, page_size) - 1 - po, 0)
+    if window is None:
+        j_first = 0
+    else:
+        first_pos = jnp.maximum(sl - kr - window + 1, 0)
+        j_first = jnp.maximum(lax.div(first_pos, page_size) - po, 0)
+
+    @pl.when(j == 0)
+    def _init():
+        m_sc[...] = jnp.full(m_sc.shape, _NEG_INF, jnp.float32)
+        l_sc[...] = jnp.zeros(l_sc.shape, jnp.float32)
+        acc_sc[...] = jnp.zeros(acc_sc.shape, jnp.float32)
+
+    @pl.when(jnp.logical_and(
+        jnp.logical_and(j >= j_first, j <= j_last), sl > 0))
+    def _step():
+        q = q_ref[...]                                # (SUB, D)
+        k = k_ref[...]                                # (page, D)
+        v = v_ref[...]
+        cdt = q.dtype
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * sm_scale
+        pos = (po + j) * page_size + lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)                    # (SUB, page)
+        row = lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        # row r holds the query at absolute position sl - kr + r; it
+        # attends causally up to itself (the intra-step causal mask).
+        # Padding rows (r >= kr) mirror the last real row, so the k=1
+        # degenerate case is bit-identical to the single-token kernel.
+        bound = sl - kr + jnp.minimum(row, kr - 1)
+        valid = pos <= bound
+        if window is not None:
+            valid = jnp.logical_and(valid, pos > bound - window)
+        s = jnp.where(valid, s, _NEG_INF)
+        m_prev = jnp.max(m_sc[...], axis=-1, keepdims=True)
+        l_prev = jnp.max(l_sc[...], axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, jnp.max(s, -1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        p = jnp.where(valid, p, 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, -1, keepdims=True)
+        acc_sc[...] = acc_sc[...] * alpha + lax.dot_general(
+            p.astype(cdt), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_sc[...] = jnp.broadcast_to(m_new, m_sc.shape)
+        l_sc[...] = jnp.broadcast_to(l_new, l_sc.shape)
+
+    @pl.when(j == jnp.minimum(j_last, n_pages - 1))
+    def _epilogue():
+        l = jnp.max(l_sc[...], axis=-1, keepdims=True)
+        l_safe = jnp.where(l == 0.0, 1.0, l)          # sl == 0 rows
+        o_ref[...] = (acc_sc[...] / l_safe).astype(o_ref.dtype)
+
+
+def _paged_attention_pallas_multi(q, k_pages, v_pages, block_tables,
+                                  seq_lens, q_rows, page_offsets,
+                                  sm_scale, window):
+    B, K, H, D = q.shape
+    page_size = k_pages.shape[1]
+    n_pages = block_tables.shape[1]
+    if K < _SUBLANES:
+        pad = jnp.broadcast_to(q[:, -1:], (B, _SUBLANES - K, H, D))
+        q = jnp.concatenate([q, pad], axis=1)
+    qb = jnp.transpose(q, (0, 2, 1, 3))               # [B, H, SUB, D]
+    bt = block_tables.astype(jnp.int32)
+    sl = seq_lens.astype(jnp.int32)
+    kr = q_rows.astype(jnp.int32)
+    po = page_offsets.astype(jnp.int32)
+
+    def kv_idx(b, h, j, bt_ref, sl_ref, kr_ref, po_ref):
+        po_b = po_ref[b]
+        last = jnp.maximum(
+            lax.div(sl_ref[b] + page_size - 1, page_size) - 1 - po_b, 0)
+        if window is None:
+            first = 0
+        else:
+            first_pos = jnp.maximum(
+                sl_ref[b] - kr_ref[b] - window + 1, 0)
+            first = jnp.maximum(lax.div(first_pos, page_size) - po_b, 0)
+        # out-of-schedule pages clamp into the visited range: the
+        # revisited block index suppresses their HBM copy
+        return (bt_ref[b, jnp.clip(j, first, last)], 0, h, 0)
+
+    def q_idx(b, h, j, bt_ref, sl_ref, kr_ref, po_ref):
+        return (b, h, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(B, H, n_pages),
+        in_specs=[
+            pl.BlockSpec((None, None, _SUBLANES, D), q_idx),
+            pl.BlockSpec((None, page_size, None, D), kv_idx),
+            pl.BlockSpec((None, page_size, None, D), kv_idx),
+        ],
+        out_specs=pl.BlockSpec((None, None, _SUBLANES, D), q_idx),
+        scratch_shapes=[pltpu.VMEM((_SUBLANES, _LANES), jnp.float32),
+                        pltpu.VMEM((_SUBLANES, _LANES), jnp.float32),
+                        pltpu.VMEM((_SUBLANES, D), jnp.float32)],
+    )
+    out = pl.pallas_call(
+        functools.partial(_decode_multi_kernel, sm_scale=sm_scale,
+                          page_size=page_size, n_pages=n_pages,
+                          window=window),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, _SUBLANES, D), q.dtype),
+        compiler_params=_PAGED,
+        interpret=_interpret(),
+    )(bt, sl, kr, po, qb, k_pages, v_pages)
+    return jnp.transpose(out[:, :, :K], (0, 2, 1, 3))  # [B, K, H, D]
+
+
+def _paged_attention_xla_multi(q, k_pages, v_pages, block_tables,
+                               seq_lens, q_rows, page_offsets, sm_scale,
+                               window):
+    """Pure-XLA lowering of the general path. Gathers ONLY the pages the
+    block table names — a window-evicted sequence's narrow rolling table
+    makes per-token cost O(window) off-chip, the same work-skipping the
+    Pallas schedule gets from ``pl.when`` + clamped index maps."""
+    B, K, H, D = q.shape
+    page_size = k_pages.shape[1]
+    T = block_tables.shape[1] * page_size
+    k = k_pages[block_tables].reshape(B, T, -1, k_pages.shape[-1])
+    v = v_pages[block_tables].reshape(B, T, -1, v_pages.shape[-1])
+    s = jnp.einsum("bkhd,bthd->bkht", q, k,
+                   preferred_element_type=jnp.float32) * sm_scale
+    sl = seq_lens.astype(jnp.int32)
+    kr = q_rows.astype(jnp.int32)
+    po = page_offsets.astype(jnp.int32)
+    pos = po[:, None] * page_size + jnp.arange(T, dtype=jnp.int32)[None]
+    row = jnp.arange(K, dtype=jnp.int32)[None, :]
+    bound = sl[:, None] - kr[:, None] + jnp.minimum(row, kr[:, None] - 1)
+    valid = pos[:, None, :] <= bound[:, :, None]      # [B, K, T]
+    if window is not None:
+        valid = valid & (pos[:, None, :] > bound[:, :, None] - window)
+    valid = valid[:, :, None, :]                      # [B, K, 1, T]
+    s = jnp.where(valid, s, _NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    p = jnp.where(valid, p, 0.0)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    l = jnp.where(l == 0.0, 1.0, l)                   # sl == 0 rows
+    p = (p / l).astype(v.dtype)
+    out = jnp.einsum("bkht,bthd->bkhd", p, v,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
 def paged_attention(q, k_pages, v_pages, block_tables, seq_lens, *,
                     sm_scale: Optional[float] = None,
-                    impl: Optional[str] = None):
+                    impl: Optional[str] = None,
+                    q_rows=None, window: Optional[int] = None,
+                    page_offsets=None):
     """Decode attention over a paged KV cache.
 
-    ``q``: [B, H, D] (one token per sequence); ``k_pages``/``v_pages``:
-    [P, page_size, H, D] pools; ``block_tables``: [B, max_pages] int32;
-    ``seq_lens``: [B] int32 (0 = inactive row → zero output). ``impl``:
+    ``q``: [B, H, D] (one token per sequence) or [B, k, H, D] with
+    ``k <= 8`` (speculative scoring: the k tokens occupy absolute
+    positions ``seq_len - k .. seq_len - 1`` and attend causally up to
+    themselves); ``k_pages``/``v_pages``: [P, page_size, H, D] pools;
+    ``block_tables``: [B, max_pages] int32; ``seq_lens``: [B] int32
+    (0 = inactive row → zero output). ``q_rows``: [B] int32 count of
+    REAL query rows per sequence (defaults to k; padding rows mirror the
+    last real one and their outputs are garbage the caller discards).
+    ``window``: sliding-window width — each query row sees only its
+    ``window`` most recent keys (itself included), and out-of-window
+    pages are skipped, not just masked. ``page_offsets``: [B] int32 —
+    block-table slot j holds logical page ``page_offsets[b] + j`` (the
+    rolling-table contract for window-evicted sequences). ``impl``:
     ``"pallas"`` (TPU kernel; interpret mode off-chip), ``"xla"`` (the
     gather lowering), or None to pick pallas on TPU and xla elsewhere.
     """
-    B, H, D = q.shape
+    multi = q.ndim == 4
+    if multi:
+        B, K, H, D = q.shape
+        if K < 1 or K > _SUBLANES:
+            raise ValueError(f"q tokens {K} outside [1, {_SUBLANES}]")
+    else:
+        B, H, D = q.shape
+        K = 1
     if k_pages.shape != v_pages.shape:
         raise ValueError(f"k_pages {k_pages.shape} != v_pages "
                          f"{v_pages.shape}")
@@ -208,23 +414,50 @@ def paged_attention(q, k_pages, v_pages, block_tables, seq_lens, *,
     if block_tables.ndim != 2 or block_tables.shape[0] != B:
         raise ValueError(f"block_tables must be [B={B}, max_pages], got "
                          f"{block_tables.shape}")
+    if window is not None and window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
     scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(D)
     if impl is None:
         impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+    general = multi or window is not None or page_offsets is not None \
+        or q_rows is not None
+    if not general:
+        if impl == "pallas":
+            return _paged_attention_pallas(q, k_pages, v_pages,
+                                           block_tables, seq_lens, scale)
+        if impl == "xla":
+            return _paged_attention_xla(q, k_pages, v_pages,
+                                        block_tables, seq_lens, scale)
+        raise ValueError(f"unknown impl {impl!r}; expected pallas|xla")
+    q4 = q if multi else q[:, None]
+    kr = (jnp.full((B,), K, jnp.int32) if q_rows is None
+          else jnp.asarray(q_rows, jnp.int32))
+    po = (jnp.zeros((B,), jnp.int32) if page_offsets is None
+          else jnp.asarray(page_offsets, jnp.int32))
     if impl == "pallas":
-        return _paged_attention_pallas(q, k_pages, v_pages, block_tables,
-                                       seq_lens, scale)
-    if impl == "xla":
-        return _paged_attention_xla(q, k_pages, v_pages, block_tables,
-                                    seq_lens, scale)
-    raise ValueError(f"unknown impl {impl!r}; expected pallas|xla")
+        out = _paged_attention_pallas_multi(
+            q4, k_pages, v_pages, block_tables, seq_lens, kr, po, scale,
+            window)
+    elif impl == "xla":
+        out = _paged_attention_xla_multi(
+            q4, k_pages, v_pages, block_tables, seq_lens, kr, po, scale,
+            window)
+    else:
+        raise ValueError(f"unknown impl {impl!r}; expected pallas|xla")
+    return out if multi else out[:, 0]
 
 
 def paged_attention_reference(q, k_pages, v_pages, block_tables,
-                              seq_lens, *, sm_scale=None):
+                              seq_lens, *, sm_scale=None, q_rows=None,
+                              window=None, page_offsets=None):
     """Dense reference for parity tests (the XLA lowering by
     construction — see :func:`_paged_attention_xla`)."""
     D = q.shape[-1]
     scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(D)
-    return _paged_attention_xla(q, k_pages, v_pages, block_tables,
-                                seq_lens, scale)
+    if (q.ndim == 3 and window is None and page_offsets is None
+            and q_rows is None):
+        return _paged_attention_xla(q, k_pages, v_pages, block_tables,
+                                    seq_lens, scale)
+    return paged_attention(q, k_pages, v_pages, block_tables, seq_lens,
+                           sm_scale=scale, impl="xla", q_rows=q_rows,
+                           window=window, page_offsets=page_offsets)

@@ -370,10 +370,14 @@ def run_microbenchmarks(num_workers: int = 4, trials: int = 3,
 def save_baseline(rows: List[ResultRow], path: str,
                   num_workers: int) -> None:
     """Record a microbench run as the regression-gate baseline JSON."""
-    benches = {r.bench_id: {"metric": r.metric, "value": r.value,
-                            "unit": r.unit,
-                            "stddev": r.extra.get("stddev", 0.0)}
-               for r in rows}
+    benches = {}
+    for r in rows:
+        entry = {"metric": r.metric, "value": r.value, "unit": r.unit,
+                 "stddev": r.extra.get("stddev", 0.0)}
+        if r.extra.get("lower_is_better"):
+            entry["direction"] = "lower"   # latency-style row: the gate
+            #                                fails on INCREASE
+        benches[r.bench_id] = entry
     doc = {"schema": "bench_runtime/v1",
            "captured_unix": time.time(),
            "num_workers": num_workers,
@@ -419,6 +423,18 @@ def check_against_baseline(rows: List[ResultRow], baseline_path: str,
             continue
         b, c = base[bid]["value"], current[bid].value
         ratio = c / b if b else float("inf")
+        if base[bid].get("direction") == "lower":
+            # latency row: regression = got SLOWER than the ceiling
+            if c > b * (1.0 + threshold):
+                ok = False
+                report.append(
+                    f"  {bid}: REGRESSION {c:,.3f} vs baseline "
+                    f"{b:,.3f} ({ratio:.2f}x > "
+                    f"{1 + threshold:.2f}x ceiling)")
+            else:
+                report.append(f"  {bid}: ok {c:,.3f} vs baseline "
+                              f"{b:,.3f} ({ratio:.2f}x, lower=better)")
+            continue
         floor = b * (1.0 - threshold)
         if c < floor:
             ok = False
@@ -471,6 +487,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "(ops/bench_sparse.py) instead — t8192 "
                         "LocalMask(1024) vs the dense-causal flash "
                         "path, interleaved A/B")
+    p.add_argument("--scenario", default=None,
+                   choices=("window", "beam", "spec"),
+                   help="with --decode: run one decode fast-path "
+                        "scenario's legs only (sliding-window t8192 "
+                        "A/B, beam fanout, speculative k=4)")
     p.add_argument("--only", default=None,
                    help="comma-separated bench_id subset, or 'gated' for "
                         "exactly the perf_smoke-gated benches")
@@ -495,6 +516,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.only:
         only = (set(gated) if args.only == "gated"
                 else set(args.only.split(",")))
+    if args.scenario:
+        if not args.decode:
+            p.error("--scenario requires --decode")
+        from tosem_tpu.serve.bench_decode import SCENARIO_BENCHES
+        scen = set(SCENARIO_BENCHES[args.scenario])
+        only = scen if only is None else (only & scen)
     if args.serve:
         from tosem_tpu.serve.bench_serve import run_serve_benchmarks
         rows = run_serve_benchmarks(trials=args.trials, min_s=args.min_s,

@@ -47,6 +47,15 @@ def model_tag(name: str, cfg: Any, seed: int, **extra: Any) -> str:
     return f"{name}({sig};seed={seed}{ex})"
 
 
+def _log_softmax(row):
+    """fp64 log-softmax of one logits row (beam scores accumulate over
+    many steps; fp32 cumulative sums drift across packings)."""
+    import numpy as np
+    z = np.asarray(row, np.float64)
+    z = z - z.max()
+    return z - np.log(np.exp(z).sum())
+
+
 class CompiledBackendMixin:
     """Shared compile-cache surface for model serving backends.
 
@@ -231,6 +240,102 @@ class _DecodeSeq:
         self.outcomes: List[Dict[str, Any]] = []
 
 
+class NGramDrafter:
+    """Prompt-lookup drafting (n-gram speculation): propose the tokens
+    that followed the most recent earlier occurrence of the current
+    suffix — bigram match first, unigram fallback, repeat-last when the
+    history never repeats. No model calls; the scan is capped at the
+    last ``lookback`` tokens so the host cost per step stays O(1) as the
+    sequence grows (long-context decode must not trade the window
+    mode's constant per-token latency for drafting). The accept-prefix
+    + rollback contract makes ANY drafter safe — a wrong proposal costs
+    speedup, never correctness."""
+
+    def __init__(self, lookback: int = 512):
+        self.lookback = lookback
+
+    def propose(self, tokens: List[int], k: int) -> List[int]:
+        out: List[int] = []
+        hist = list(tokens[-self.lookback:])
+        for _ in range(max(k, 0)):
+            nxt = self._predict(hist)
+            out.append(nxt)
+            hist.append(nxt)
+        return out
+
+    @staticmethod
+    def _predict(hist: List[int]) -> int:
+        if len(hist) >= 3:
+            big = (hist[-2], hist[-1])
+            for j in range(len(hist) - 3, -1, -1):
+                if (hist[j], hist[j + 1]) == big:
+                    return hist[j + 2]
+        last = hist[-1]
+        for j in range(len(hist) - 2, -1, -1):
+            if hist[j] == last:
+                return hist[j + 1]
+        return last
+
+
+class _Beam:
+    """One branch of a beam-search / parallel-sampling group. ``cid`` is
+    its cache sequence id (COW-forked from the group root); ``done``
+    branches have released their cache already."""
+
+    __slots__ = ("cid", "tokens", "logprob", "done")
+
+    def __init__(self, cid, tokens: List[int], logprob: float):
+        self.cid = cid
+        self.tokens = tokens
+        self.logprob = logprob
+        self.done = False
+
+
+class _DecodeGroup:
+    """Replica-side record of an N-branch request (``n > 1``): beam
+    search (``beam=True``) or independent parallel sampling. All
+    branches share the prompt's KV pages through ``PagedKVCache.fork``
+    (~1x prefix cost for N branches), diverge copy-on-write at the
+    first divergent page, and retire/rollback through page refcounts.
+    Carries the same (step index -> outcome) idempotency ledger as
+    :class:`_DecodeSeq`."""
+
+    __slots__ = ("beams", "prompt_len", "beam", "n", "temperature",
+                 "seed", "next_step", "done", "outcomes", "forks",
+                 "admit_token")
+
+    def __init__(self, n: int, beam: bool, temperature: float, seed: int,
+                 prompt_len: int):
+        self.beams: List[_Beam] = []
+        self.prompt_len = prompt_len
+        self.beam = beam
+        self.n = n
+        self.temperature = temperature
+        self.seed = seed
+        self.next_step = 0
+        self.done = False
+        self.outcomes: List[Dict[str, Any]] = []
+        self.forks = 0               # monotonic fork-id counter
+        # the admit outcome's token, RECORDED: beam transitions rewrite
+        # beams[0].tokens wholesale, so a replayed admit must not
+        # recompute its answer from mutable beam state
+        self.admit_token: int = -1
+
+
+class _RowPlan:
+    """One packed row of a decode step: ``fed`` tokens (1 for plain
+    decode and beams, up to K for speculative drafts) occupying
+    positions ``start .. start + kr - 1`` of cache sequence ``cid``."""
+
+    __slots__ = ("cid", "fed", "start", "kr")
+
+    def __init__(self, cid, fed: List[int], start: int):
+        self.cid = cid
+        self.fed = fed
+        self.start = start
+        self.kr = len(fed)
+
+
 class BertDecodeBackend(CompiledBackendMixin):
     """Autoregressive greedy decode over the paged KV cache.
 
@@ -249,13 +354,38 @@ class BertDecodeBackend(CompiledBackendMixin):
     ``step_batch`` / ``result`` / ``release`` / ``spill_seq`` /
     ``restore_seq`` / ``cache_stats``. All methods are idempotent per
     (sequence id, step index) — see :class:`_DecodeSeq`.
+
+    Three composable fast-path modes on top of plain greedy decode:
+
+    - ``window=W`` — sliding-window attention: every step attends only
+      the ``W`` most recent positions, out-of-window pages are both
+      SKIPPED by the kernel (narrow rolling block tables + page
+      offsets) and EVICTED from the pool
+      (:meth:`~tosem_tpu.serve.kv_cache.PagedKVCache.release_below`),
+      so per-sequence KV footprint and per-token latency are bounded by
+      the window, not the history.
+    - ``spec_k=k`` — speculative decoding: an
+      :class:`NGramDrafter` proposes ``k - 1`` tokens and the target
+      scores all of them in ONE multi-query paged-attention step
+      (intra-step causal mask); the accepted prefix plus the target's
+      own correction token commit, the rejected tail rolls back via
+      :meth:`~tosem_tpu.serve.kv_cache.PagedKVCache.truncate` — output
+      tokens are bit-identical to non-speculative greedy by
+      construction (each score row is exactly the sequential step's
+      computation).
+    - requests with ``{"n": N}`` (+ optional ``"beam": True``,
+      ``"temperature"``, ``"seed"``) — N-branch beam search or parallel
+      sampling sharing the prompt KV through copy-on-write ``fork``
+      (~1x prefix pages for N branches; rollback via refcounts). Beam
+      branches always feed one token per step (no draft composition).
     """
 
     def __init__(self, preset: str = "tiny", seed: int = 0,
                  max_batch: int = 8, max_len: int = 128,
                  page_size: Optional[int] = None, num_pages: int = 64,
                  max_new_tokens: int = 16, eos_id: Optional[int] = None,
-                 impl: Optional[str] = None):
+                 impl: Optional[str] = None,
+                 window: Optional[int] = None, spec_k: int = 0):
         import jax
         from tosem_tpu.models.bert import Bert, BertConfig
         from tosem_tpu.ops.flash_blocks import select_page_size
@@ -269,24 +399,60 @@ class BertDecodeBackend(CompiledBackendMixin):
         self.max_new_tokens = max_new_tokens
         self.eos_id = eos_id
         self.impl = impl
+        if window is not None and window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        if not 0 <= spec_k <= 8:
+            raise ValueError(f"spec_k must be in [0, 8], got {spec_k}")
+        self.window = window
+        self.spec_k = 0 if spec_k <= 1 else int(spec_k)
+        self.K = max(self.spec_k, 1)
         head_dim = cfg.dim // cfg.heads
         self.page_size = page_size or select_page_size(
             head_dim, cfg.dtype, max_len=cfg.max_len)
         self.max_pages = -(-cfg.max_len // self.page_size)
+        if window is not None and self.spec_k and window < self.spec_k:
+            raise ValueError(f"window={window} < spec_k={spec_k}")
+        # window-evicted sequences hand the kernel a narrow ROLLING
+        # table: in-window pages (<= ceil(w/page)+2 after the post-step
+        # release), plus the <= ceil(K/page)+1 pages a step's K-token
+        # extend can add before that release runs
+        self.table_w = (min(-(-window // self.page_size)
+                            + -(-self.K // self.page_size) + 3,
+                            self.max_pages)
+                        if window is not None else self.max_pages)
         self.model = Bert(cfg)
         self._vs = self.model.init(jax.random.PRNGKey(seed))
-        self._prefill = self.model.prefill_fn(self._vs)
-        self._step = self.model.decode_step_fn(
-            self._vs, page_size=self.page_size, impl=impl)
+        if window is not None:
+            # prefill must match the step semantics: a prompt longer
+            # than the window attends through the same sliding band
+            from tosem_tpu.nn.attention import flash_attn_fn
+            from tosem_tpu.ops.mask_programs import LocalMask
+            self._prefill = self.model.prefill_fn(
+                self._vs, attn_fn=flash_attn_fn(mask=LocalMask(window)))
+        else:
+            self._prefill = self.model.prefill_fn(self._vs)
+        self._general = bool(window is not None or self.spec_k)
+        if self._general:
+            self._step = self.model.decode_multi_fn(
+                self._vs, page_size=self.page_size, q_tokens=self.K,
+                impl=impl, window=window)
+        else:
+            self._step = self.model.decode_step_fn(
+                self._vs, page_size=self.page_size, impl=impl)
+        self._drafter = NGramDrafter() if self.spec_k else None
         from tosem_tpu.serve.kv_cache import PagedKVCache
         self.cache = PagedKVCache(num_pages, self.page_size,
                                   layers=cfg.layers, heads=cfg.heads,
                                   head_dim=head_dim, dtype=cfg.dtype)
         self._seqs: Dict[Any, _DecodeSeq] = {}
+        self._groups: Dict[Any, _DecodeGroup] = {}
+        self._spec_proposed = 0
+        self._spec_accepted = 0
         self._lock = threading.RLock()
         self._tag = model_tag("bert_decode", cfg, seed,
                               page=self.page_size, pages=num_pages,
-                              impl=impl or "auto")
+                              impl=impl or "auto",
+                              window=window or 0, spec_k=self.spec_k)
 
     # --------------------------------------------------------- compiled fns
 
@@ -323,15 +489,24 @@ class BertDecodeBackend(CompiledBackendMixin):
         B = self.max_batch
         pool = self.cache.k_pool
         key = shape_key(self._tag + ";step",
-                        (B, self.max_pages, self.page_size),
+                        (B, self.table_w, self.page_size, self.K),
                         self.cfg.dtype)
+        if self._general:
+            return DEFAULT_COMPILE_CACHE.get_or_build(
+                key, lambda: aot_compile(
+                    self._step,
+                    [((B, self.K), np.int32), ((B, self.K), np.int32),
+                     (tuple(pool.shape), pool.dtype),
+                     (tuple(pool.shape), pool.dtype),
+                     ((B, self.table_w), np.int32), ((B,), np.int32),
+                     ((B,), np.int32), ((B,), np.int32)]))
         return DEFAULT_COMPILE_CACHE.get_or_build(
             key, lambda: aot_compile(
                 self._step,
                 [((B,), np.int32), ((B,), np.int32),
                  (tuple(pool.shape), pool.dtype),
                  (tuple(pool.shape), pool.dtype),
-                 ((B, self.max_pages), np.int32), ((B,), np.int32)]))
+                 ((B, self.table_w), np.int32), ((B,), np.int32)]))
 
     def warmup(self, shapes: Sequence[int]) -> Dict[str, Any]:
         """``shapes`` is the prompt-bucket palette (page multiples);
@@ -369,40 +544,50 @@ class BertDecodeBackend(CompiledBackendMixin):
         return np.asarray(logits, np.float32)[0, T - 1]
 
     def _finished(self, seq: _DecodeSeq, token: int) -> bool:
-        gen = len(seq.tokens) - seq.prompt_len
-        return (token == self.eos_id if self.eos_id is not None
-                else False) or gen >= self.max_new_tokens \
-            or len(seq.tokens) >= self.cfg.max_len
+        return self._finished_at(len(seq.tokens), seq.prompt_len, token)
+
+    def _validate_ids(self, ids: List[int]) -> None:
+        if not ids:
+            raise ValueError("empty ids sequence")
+        if min(ids) < 0 or max(ids) >= self.cfg.vocab_size:
+            raise ValueError(
+                f"token id out of range [0, {self.cfg.vocab_size})")
+        if len(ids) >= self.cfg.max_len:
+            raise ValueError(
+                f"prompt length {len(ids)} >= max_len "
+                f"{self.cfg.max_len}")
+
+    def _release_floor(self, tokens_len: int) -> int:
+        """Lowest cached position any future query's window can still
+        see: the next step feeds ``tokens[-1]`` at position
+        ``tokens_len - 1``, whose window spans
+        ``[tokens_len - window, tokens_len - 1]`` — the same
+        ``first_pos`` formula the kernel's page schedule uses."""
+        return max(tokens_len - self.window, 0)
 
     def admit(self, seq_id, request: Dict[str, Any]) -> Dict[str, Any]:
         """Validate, allocate pages, prefill, sample the first token.
         Raises :class:`~tosem_tpu.serve.kv_cache.CachePressure` (pool
         full — nothing allocated) or ``ValueError`` (poison request —
         fails only this sequence). Idempotent: re-admitting a known
-        sequence returns its recorded outcome."""
+        sequence returns its recorded outcome. A request with ``n > 1``
+        admits an N-branch group (beam search with ``beam=True``,
+        parallel sampling otherwise) whose branches COW-share the
+        prompt pages — it occupies ``n`` rows of every decode step."""
         import numpy as np
         with self._lock:
+            n = int(request.get("n", 1) or 1)
+            if n > 1:
+                return self._admit_group(seq_id, request, n)
             if seq_id in self._seqs:          # at-least-once replay
                 seq = self._seqs[seq_id]
                 return {"token": seq.tokens[seq.prompt_len],
                         "done": seq.done and seq.next_step == 0}
             ids = list(request["ids"])
-            if not ids:
-                raise ValueError("empty ids sequence")
-            if min(ids) < 0 or max(ids) >= self.cfg.vocab_size:
-                raise ValueError(
-                    f"token id out of range [0, {self.cfg.vocab_size})")
-            if len(ids) >= self.cfg.max_len:
-                raise ValueError(
-                    f"prompt length {len(ids)} >= max_len "
-                    f"{self.cfg.max_len}")
+            self._validate_ids(ids)
             self.cache.create(seq_id)
             try:
                 self.cache.extend(seq_id, len(ids))
-            except BaseException:
-                self.cache.free(seq_id)
-                raise
-            try:
                 last = self._prefill_into_cache(seq_id, ids)
             except BaseException:
                 self.cache.free(seq_id)
@@ -411,6 +596,9 @@ class BertDecodeBackend(CompiledBackendMixin):
             seq = _DecodeSeq(tokens=ids + [token],
                              prompt_len=len(ids))
             seq.done = self._finished(seq, token)
+            if self.window is not None:
+                self.cache.release_below(
+                    seq_id, self._release_floor(len(seq.tokens)))
             self._seqs[seq_id] = seq
             out = {"token": token, "done": seq.done}
             if seq.done:
@@ -419,73 +607,380 @@ class BertDecodeBackend(CompiledBackendMixin):
                 out["result"] = self._result_locked(seq)
             return out
 
+    def _admit_group(self, seq_id, request: Dict[str, Any],
+                     n: int) -> Dict[str, Any]:
+        import numpy as np
+        if seq_id in self._groups:            # at-least-once replay
+            g = self._groups[seq_id]
+            return {"token": g.admit_token, "n_tokens": g.n,
+                    "done": g.done and g.next_step == 0}
+        if n > self.max_batch:
+            raise ValueError(f"n={n} branches exceed max_batch="
+                             f"{self.max_batch}")
+        ids = list(request["ids"])
+        self._validate_ids(ids)
+        group = _DecodeGroup(
+            n=n, beam=bool(request.get("beam", False)),
+            temperature=float(request.get("temperature", 1.0) or 1.0),
+            seed=int(request.get("seed", 0) or 0), prompt_len=len(ids))
+        root = f"{seq_id}#0"
+        self.cache.create(root)
+        try:
+            self.cache.extend(root, len(ids))
+            last = self._prefill_into_cache(root, ids)   # ~1x prefix
+        except BaseException:
+            self.cache.free(root)
+            raise
+        lp = _log_softmax(last)
+        if group.beam:
+            order = np.argsort(-lp)[:n]
+            firsts = [(int(t), float(lp[t])) for t in order]
+        else:
+            firsts = [(self._sample(lp, group, i, 0), 0.0)
+                      for i in range(n)]
+            firsts = [(t, float(lp[t])) for t, _ in firsts]
+        # fork EVERY branch before settling any: a branch finishing on
+        # its first token frees its cache, and freeing the root before
+        # a later fork reads it would KeyError (same deferred-settle
+        # discipline as _beam_select)
+        for i, (tok, tok_lp) in enumerate(firsts):
+            cid = root if i == 0 else f"{seq_id}#f{i}"
+            if i > 0:
+                # branches share every prompt page; the first divergent
+                # append copy-on-writes the shared tail
+                self.cache.fork(root, cid)
+            group.beams.append(_Beam(cid, ids + [tok], tok_lp))
+        for beam in group.beams:
+            self._settle_branch(group, beam)
+        group.forks = n
+        group.done = all(b.done for b in group.beams)
+        group.admit_token = group.beams[0].tokens[-1]
+        self._groups[seq_id] = group
+        out = {"token": group.admit_token, "n_tokens": n,
+               "done": group.done}
+        if group.done:
+            out["result"] = self._group_result(group)
+        return out
+
+    def _sample(self, lp: "np.ndarray", group: _DecodeGroup,
+                branch: int, step: int) -> int:
+        """Deterministic per-(seed, branch, step) categorical draw from
+        the temperature-scaled distribution — parallel sampling is
+        replayable byte-for-byte, like everything else on this path."""
+        import numpy as np
+        rng = np.random.default_rng((group.seed, branch, step))
+        t = max(group.temperature, 1e-4)
+        z = lp.astype(np.float64) / t
+        z -= z.max()
+        p = np.exp(z)
+        p /= p.sum()
+        return int(rng.choice(len(p), p=p))
+
+    def _finished_at(self, n_tokens: int, prompt_len: int,
+                     token: int) -> bool:
+        gen = n_tokens - prompt_len
+        return (token == self.eos_id if self.eos_id is not None
+                else False) or gen >= self.max_new_tokens \
+            or n_tokens >= self.cfg.max_len
+
     def step_batch(self, seq_ids: List[Any],
                    step_idxs: List[int]) -> List[Dict[str, Any]]:
         """One decode iteration for the packed batch. Per-sequence
-        outcomes: ``{"token", "done"}``, ``{"pressure": True}`` (no
-        pages — nothing applied for that row), or the memoized outcome
-        for an already-applied (seq, step). The program call itself is
-        one executable for ANY packing (inactive rows ride along with
-        seq_len 0), so results never depend on batch composition."""
-        import numpy as np
-
-        from tosem_tpu.serve.kv_cache import CachePressure
-        if len(seq_ids) > self.max_batch:
-            raise ValueError(f"batch of {len(seq_ids)} exceeds "
-                             f"max_batch={self.max_batch}")
+        outcomes: ``{"token", "done"[, "n_tokens", "result"]}``,
+        ``{"pressure": True}`` (no pages — nothing applied for that
+        entry), or the memoized outcome for an already-applied
+        (seq, step). A speculative sequence feeds its drafts and may
+        commit up to ``spec_k`` tokens (``n_tokens``); an N-branch group
+        occupies N rows and commits one token per live branch. The
+        program call itself is one executable for ANY packing (inactive
+        rows ride along with seq_len 0), so results never depend on
+        batch composition."""
         with self._lock:
-            B = self.max_batch
+            # row-budget check BEFORE any planning: _plan_* applies
+            # cache.extend side effects, and raising after them would
+            # leave cache lengths ahead of the token history on the
+            # scheduler's retry of the same step numbers
+            rows_needed = 0
+            for sid in seq_ids:
+                if sid in self._groups:
+                    g = self._groups[sid]
+                    rows_needed += sum(1 for b in g.beams if not b.done)
+                else:
+                    rows_needed += 1
+            if rows_needed > self.max_batch:
+                raise ValueError(
+                    f"{rows_needed} packed rows exceed max_batch="
+                    f"{self.max_batch} (group branches count)")
+            outcomes: List[Optional[Dict[str, Any]]] = []
+            plans: List[_RowPlan] = []
+            # pending[i] = (outcome index, sid, (plan_lo, plan_hi))
+            pending: List[tuple] = []
+            for sid, step in zip(seq_ids, step_idxs):
+                lo = len(plans)
+                if sid in self._groups:
+                    out = self._plan_group(sid, step, plans)
+                else:
+                    out = self._plan_seq(sid, step, plans)
+                outcomes.append(out)
+                if out is None:
+                    pending.append((len(outcomes) - 1, sid,
+                                    (lo, len(plans))))
+            rows = self._run_step(plans) if plans else []
+            for idx, sid, (lo, hi) in pending:
+                if sid in self._groups:
+                    outcomes[idx] = self._commit_group(sid, rows[lo:hi])
+                else:
+                    outcomes[idx] = self._commit_seq(sid, plans[lo],
+                                                     rows[lo])
+            # every entry resolved exactly once (memo / done / pressure
+            # / committed), so outcomes is positionally aligned with
+            # seq_ids — the caller zips them
+            return outcomes
+
+    def _replay_or_advance(self, rec, step: int, sid) -> Optional[Dict]:
+        """Shared ledger logic: memoized outcome for a replayed step,
+        terminal outcome for a done sequence, None when the step must
+        actually run (``rec`` is a :class:`_DecodeSeq` or group)."""
+        if step < rec.next_step:              # replayed step: memo only
+            return rec.outcomes[step]
+        if step > rec.next_step:
+            raise RuntimeError(
+                f"step {step} for {sid!r} skips ahead of "
+                f"{rec.next_step} (scheduler bug)")
+        if rec.done:
+            if isinstance(rec, _DecodeGroup):
+                return {"token": rec.beams[0].tokens[-1], "done": True}
+            return {"token": rec.tokens[-1], "done": True}
+        return None
+
+    def _plan_seq(self, sid, step: int,
+                  plans: List[_RowPlan]) -> Optional[Dict[str, Any]]:
+        from tosem_tpu.serve.kv_cache import CachePressure
+        seq = self._seqs[sid]
+        out = self._replay_or_advance(seq, step, sid)
+        if out is not None:
+            return out
+        L = len(seq.tokens)
+        kr = 1
+        drafts: List[int] = []
+        if self.spec_k:
+            kr = min(self.K, self.cfg.max_len - (L - 1))
+            drafts = self._drafter.propose(seq.tokens, kr - 1)
+        try:
+            start, _ = self.cache.extend(sid, kr)
+        except CachePressure:
+            return {"pressure": True}
+        plans.append(_RowPlan(sid, [seq.tokens[-1]] + drafts, start))
+        return None
+
+    def _commit_seq(self, sid, plan: _RowPlan,
+                    logits_rows) -> Dict[str, Any]:
+        """Greedy accept-prefix: row r of the multi-query step scores
+        position ``start + r + 1`` exactly as the sequential step would,
+        so committing the matched draft prefix plus the target's own
+        next token reproduces non-speculative greedy bit for bit; the
+        rejected tail rolls back via ``truncate``."""
+        import numpy as np
+        seq = self._seqs[sid]
+        L = len(seq.tokens)
+        kr = plan.kr
+        drafts = plan.fed[1:]
+        targets = [int(np.argmax(logits_rows[r])) for r in range(kr)]
+        j = 0
+        while j < len(drafts) and drafts[j] == targets[j]:
+            j += 1
+        # accepted draft prefix + the target's own token at the first
+        # divergence (or the bonus token after a fully-accepted run):
+        # always >= 1 committed token per step
+        committed = drafts[:j] + [targets[j]]
+        if drafts:
+            self._spec_proposed += len(drafts)
+            self._spec_accepted += j
+        done = False
+        for tok in committed:
+            seq.tokens.append(tok)
+            if self._finished(seq, tok):
+                done = True
+                break
+        # cache holds L - 1 + kr positions; the committed sequence
+        # needs len(tokens) - 1 — drop the rejected/overshot tail
+        if len(seq.tokens) - 1 < L - 1 + kr:
+            self.cache.truncate(sid, len(seq.tokens) - 1)
+        if self.window is not None and not done:
+            self.cache.release_below(
+                sid, self._release_floor(len(seq.tokens)))
+        out = {"token": seq.tokens[-1], "done": done}
+        m = len(seq.tokens) - L
+        if m != 1:
+            out["n_tokens"] = m
+        seq.done = done
+        if done:
+            out["result"] = self._result_locked(seq)
+        seq.outcomes.append(out)
+        seq.next_step += 1
+        return out
+
+    def _plan_group(self, sid, step: int,
+                    plans: List[_RowPlan]) -> Optional[Dict[str, Any]]:
+        from tosem_tpu.serve.kv_cache import CachePressure
+        g = self._groups[sid]
+        out = self._replay_or_advance(g, step, sid)
+        if out is not None:
+            return out
+        live = [b for b in g.beams if not b.done]
+        extended: List[_Beam] = []
+        try:
+            for b in live:
+                self.cache.extend(b.cid, 1)
+                extended.append(b)
+        except CachePressure:
+            # all-or-nothing for the whole group: roll the extends back
+            # so a retried step starts from the identical state
+            for b in extended:
+                self.cache.truncate(b.cid, len(b.tokens) - 1)
+            return {"pressure": True}
+        for b in live:
+            plans.append(_RowPlan(b.cid, [b.tokens[-1]],
+                                  len(b.tokens) - 1))
+        return None
+
+    def _commit_group(self, sid, rows) -> Dict[str, Any]:
+        import numpy as np
+        g = self._groups[sid]
+        live = [b for b in g.beams if not b.done]
+        lps = [_log_softmax(rows[i][0]) for i in range(len(live))]
+        step_no = g.next_step + 1          # admit consumed draw 0
+        if g.beam:
+            self._beam_select(sid, g, live, lps)
+        else:
+            for i, b in enumerate(live):
+                branch = g.beams.index(b)
+                tok = self._sample(lps[i], g, branch, step_no)
+                b.tokens.append(tok)
+                b.logprob += float(lps[i][tok])
+                self._settle_branch(g, b)
+        n_tok = len(live)
+        g.done = all(b.done for b in g.beams)
+        best = max(g.beams, key=lambda b: b.logprob)
+        out = {"token": best.tokens[-1], "done": g.done,
+               "n_tokens": n_tok}
+        if g.done:
+            out["result"] = self._group_result(g)
+        g.outcomes.append(out)
+        g.next_step += 1
+        return out
+
+    def _settle_branch(self, g: _DecodeGroup, b: _Beam) -> None:
+        """Post-append bookkeeping shared by beam and sampling commits:
+        a finished branch retires its cache NOW (refcount rollback —
+        shared prefix pages survive for its siblings); a live windowed
+        branch evicts below its floor."""
+        if self._finished_at(len(b.tokens), g.prompt_len, b.tokens[-1]):
+            b.done = True
+            self.cache.free(b.cid)
+        elif self.window is not None:
+            self.cache.release_below(
+                b.cid, self._release_floor(len(b.tokens)))
+
+    def _beam_select(self, sid, g: _DecodeGroup, live: List[_Beam],
+                     lps) -> None:
+        """One beam-search transition over the live branches: global
+        top-|live| continuations by cumulative logprob. A parent chosen
+        twice forks (COW — the shared pages split only when the
+        branches' appends diverge); an unchosen parent's pages roll
+        back via refcount free."""
+        import numpy as np
+        width = len(live)
+        cands = []                          # (score, live idx, token)
+        for i, b in enumerate(live):
+            lp = lps[i]
+            top = np.argsort(-lp)[:width]
+            for t in top:
+                cands.append((b.logprob + float(lp[t]), i, int(t)))
+        # deterministic tie-break: score desc, then branch, then token
+        cands.sort(key=lambda c: (-c[0], c[1], c[2]))
+        chosen = cands[:width]
+        used = {i for _, i, _ in chosen}
+        for i, b in enumerate(live):
+            if i not in used:
+                self.cache.free(b.cid)      # dropped beam: rollback
+        parents = [(b.cid, list(b.tokens)) for b in live]
+        taken: Dict[int, int] = {}
+        assigned = []                       # (slot, cid, tokens, score)
+        for slot, (score, i, tok) in enumerate(chosen):
+            cid, toks = parents[i]
+            if i in taken:
+                g.forks += 1
+                new_cid = f"{sid}#f{g.forks}"
+                self.cache.fork(cid, new_cid)
+                cid = new_cid
+            else:
+                taken[i] = 1
+            assigned.append((slot, cid, toks + [tok], score))
+        # settle AFTER every fork landed: a finished first child frees
+        # the parent's cache name, which a later fork still needs
+        for slot, cid, toks, score in assigned:
+            b = live[slot]
+            b.cid = cid
+            b.tokens = toks
+            b.logprob = score
+            self._settle_branch(g, b)
+
+    def _group_result(self, g: _DecodeGroup) -> Dict[str, Any]:
+        branches = sorted(g.beams, key=lambda b: -b.logprob)
+        entries = [{"tokens": list(b.tokens),
+                    "generated": list(b.tokens[g.prompt_len:]),
+                    "prompt_len": g.prompt_len,
+                    "logprob": b.logprob} for b in branches]
+        best = entries[0]
+        key = "beams" if g.beam else "samples"
+        return {"tokens": best["tokens"], "generated": best["generated"],
+                "prompt_len": g.prompt_len, key: entries}
+
+    def _run_step(self, plans: List[_RowPlan]) -> List[Any]:
+        """Run the ONE compiled step program over the packed rows;
+        returns the fp32 logits rows ``[kr_i, vocab]`` per plan."""
+        import numpy as np
+        B = self.max_batch
+        if not self._general:
             ids_t = np.zeros((B,), np.int32)
             positions = np.zeros((B,), np.int32)
-            tables = np.zeros((B, self.max_pages), np.int32)
+            tables = np.zeros((B, self.table_w), np.int32)
             lens = np.zeros((B,), np.int32)
-            outcomes: List[Optional[Dict[str, Any]]] = []
-            live: List[tuple] = []          # (row, seq_id, seq)
-            for row, (sid, step) in enumerate(zip(seq_ids, step_idxs)):
-                seq = self._seqs[sid]
-                if step < seq.next_step:    # replayed step: memo only
-                    outcomes.append(seq.outcomes[step])
-                    continue
-                if step > seq.next_step:
-                    raise RuntimeError(
-                        f"step {step} for {sid!r} skips ahead of "
-                        f"{seq.next_step} (scheduler bug)")
-                if seq.done:
-                    outcomes.append({"token": seq.tokens[-1],
-                                     "done": True})
-                    continue
-                try:
-                    start, new_len = self.cache.extend(sid, 1)
-                except CachePressure:
-                    outcomes.append({"pressure": True})
-                    continue
-                ids_t[row] = seq.tokens[start]
-                positions[row] = start
-                tables[row] = self.cache.block_table(sid, self.max_pages)
-                lens[row] = new_len
-                outcomes.append(None)
-                live.append((row, sid, seq))
-            if live:
-                logits, k_pool, v_pool = self._step_compiled()(
-                    ids_t, positions, self.cache.k_pool,
-                    self.cache.v_pool, tables, lens)
-                self.cache.set_pools(k_pool, v_pool)
-                logits = np.asarray(logits, np.float32)
-                for row, sid, seq in live:
-                    token = int(np.argmax(logits[row]))
-                    seq.tokens.append(token)
-                    out = {"token": token,
-                           "done": self._finished(seq, token)}
-                    seq.done = out["done"]
-                    if seq.done:
-                        out["result"] = self._result_locked(seq)
-                    seq.outcomes.append(out)
-                    seq.next_step += 1
-                    outcomes[row] = out
-            # every row appended exactly one entry (memo / done /
-            # pressure / live), so outcomes is positionally aligned
-            # with seq_ids — the caller zips them
-            return outcomes
+            for row, p in enumerate(plans):
+                ids_t[row] = p.fed[0]
+                positions[row] = p.start
+                tables[row] = self.cache.block_table(p.cid, self.table_w)
+                lens[row] = p.start + 1
+            logits, k_pool, v_pool = self._step_compiled()(
+                ids_t, positions, self.cache.k_pool, self.cache.v_pool,
+                tables, lens)
+            self.cache.set_pools(k_pool, v_pool)
+            lg = np.asarray(logits, np.float32)
+            return [lg[row:row + 1] for row in range(len(plans))]
+        K = self.K
+        ids_t = np.zeros((B, K), np.int32)
+        positions = np.zeros((B, K), np.int32)
+        tables = np.zeros((B, self.table_w), np.int32)
+        lens = np.zeros((B,), np.int32)
+        q_rows = np.ones((B,), np.int32)
+        offs = np.zeros((B,), np.int32)
+        for row, p in enumerate(plans):
+            kr = p.kr
+            ids_t[row, :kr] = p.fed
+            ids_t[row, kr:] = p.fed[-1]        # padding mirrors last
+            positions[row, :kr] = np.arange(p.start, p.start + kr)
+            positions[row, kr:] = p.start + kr - 1
+            tables[row] = self.cache.block_table(p.cid, self.table_w)
+            lens[row] = p.start + kr
+            q_rows[row] = kr
+            offs[row] = self.cache.page_offset(p.cid)
+        logits, k_pool, v_pool = self._step_compiled()(
+            ids_t, positions, self.cache.k_pool, self.cache.v_pool,
+            tables, lens, q_rows, offs)
+        self.cache.set_pools(k_pool, v_pool)
+        lg = np.asarray(logits, np.float32)
+        return [lg[row, :plans[row].kr] for row in range(len(plans))]
 
     @staticmethod
     def _result_locked(seq: _DecodeSeq) -> Dict[str, Any]:
@@ -495,69 +990,116 @@ class BertDecodeBackend(CompiledBackendMixin):
 
     def result(self, seq_id) -> Dict[str, Any]:
         with self._lock:
+            if seq_id in self._groups:
+                return self._group_result(self._groups[seq_id])
             return self._result_locked(self._seqs[seq_id])
 
     def release(self, seq_id) -> None:
         with self._lock:
+            group = self._groups.pop(seq_id, None)
+            if group is not None:
+                for b in group.beams:
+                    if not b.done:
+                        self._release_cid(b.cid)
+                return
             if seq_id in self._seqs:
-                if self.cache.is_spilled(seq_id):
-                    self.cache.drop_spilled(seq_id)
-                else:
-                    try:
-                        self.cache.free(seq_id)
-                    except KeyError:
-                        pass
+                self._release_cid(seq_id)
                 del self._seqs[seq_id]
+
+    def _release_cid(self, cid) -> None:
+        if self.cache.is_spilled(cid):
+            self.cache.drop_spilled(cid)
+        else:
+            try:
+                self.cache.free(cid)
+            except KeyError:
+                pass
+
+    def _live_cids(self, seq_id) -> List[tuple]:
+        """(cache id, cached-token history) per live cache sequence of
+        this request — one for a plain sequence, one per live branch of
+        a group (done branches freed theirs at retirement)."""
+        if seq_id in self._groups:
+            g = self._groups[seq_id]
+            return [(b.cid, b.tokens[:-1]) for b in g.beams
+                    if not b.done]
+        seq = self._seqs[seq_id]
+        return [(seq_id, seq.tokens[:-1])]
 
     def spill_seq(self, seq_id) -> None:
         with self._lock:
-            if not self.cache.is_spilled(seq_id):
-                self.cache.spill(seq_id)
+            for cid, _ in self._live_cids(seq_id):
+                if not self.cache.is_spilled(cid):
+                    self.cache.spill(cid)
 
     def restore_seq(self, seq_id) -> None:
-        """Bring a spilled sequence back. Byte-identical restore when
-        the payload survived; a LOST payload (chaos eviction) falls
-        back to re-prefilling the cache from the sequence's token
-        history — same values by determinism, so decode continues
-        bit-consistently either way. Raises
+        """Bring a spilled request back (every live branch). Byte-
+        identical restore when the payload survived; a LOST payload
+        (chaos eviction) falls back to re-prefilling the cache from the
+        branch's token history — same values by determinism, so decode
+        continues bit-consistently either way. Raises
         :class:`~tosem_tpu.serve.kv_cache.CachePressure` when the pool
-        has no room (nothing changed)."""
-        from tosem_tpu.serve.kv_cache import CachePressure, PagesLostError
+        has no room (nothing changed for the branch that hit it)."""
         with self._lock:
-            if not self.cache.is_spilled(seq_id):
-                return
+            for cid, cached in self._live_cids(seq_id):
+                self._restore_cid(cid, cached)
+
+    def _restore_cid(self, cid, cached: List[int]) -> None:
+        from tosem_tpu.serve.kv_cache import CachePressure, PagesLostError
+        if not self.cache.is_spilled(cid):
+            return
+        try:
+            self.cache.restore(cid)
+        except PagesLostError:
+            # the re-prefill fallback recomputes the FULL history (a
+            # windowed position's K/V depends on its whole in-window
+            # context at every layer, so a suffix-only prefill would
+            # not be bit-consistent) — transiently O(history) pages
+            need = -(-len(cached) // self.page_size)
+            if need > self.cache.num_pages:
+                # can NEVER fit this pool, however much retires: fail
+                # the sequence terminally instead of parking it forever
+                # under CachePressure (windowed pools are sized for the
+                # rolling window, not the history)
+                raise PagesLostError(
+                    f"re-prefill of {cid!r} needs {need} pages but the "
+                    f"pool holds {self.cache.num_pages}; sequence is "
+                    "unrecoverable on this replica")
+            # capacity check BEFORE dropping the spilled entry: the
+            # CachePressure contract is 'nothing changed', and a
+            # half-torn fallback (dropped but not re-prefilled)
+            # would make the next restore a silent no-op and the
+            # next step a KeyError for the whole packed batch
+            if need > self.cache.stats()["pages_free"]:
+                raise CachePressure(
+                    f"re-prefill of {cid!r} needs {need} pages; "
+                    "parked until something retires")
+            self.cache.drop_spilled(cid)
+            self.cache.create(cid)
             try:
-                self.cache.restore(seq_id)
-            except PagesLostError:
-                seq = self._seqs[seq_id]
-                cached = seq.tokens[:-1]    # cache holds len(tokens)-1
-                # capacity check BEFORE dropping the spilled entry: the
-                # CachePressure contract is 'nothing changed', and a
-                # half-torn fallback (dropped but not re-prefilled)
-                # would make the next restore a silent no-op and the
-                # next step a KeyError for the whole packed batch
-                need = -(-len(cached) // self.page_size)
-                if need > self.cache.stats()["pages_free"]:
-                    raise CachePressure(
-                        f"re-prefill of {seq_id!r} needs {need} pages; "
-                        "parked until something retires")
-                self.cache.drop_spilled(seq_id)
-                self.cache.create(seq_id)
-                try:
-                    self.cache.extend(seq_id, len(cached))
-                    self._prefill_into_cache(seq_id, cached)
-                except BaseException:
-                    self.cache.free(seq_id)
-                    raise
+                self.cache.extend(cid, len(cached))
+                self._prefill_into_cache(cid, cached)
+                if self.window is not None:
+                    # a forked/windowed branch re-enters the rolling-
+                    # table contract: evict below its current floor
+                    self.cache.release_below(
+                        cid, self._release_floor(len(cached) + 1))
+            except BaseException:
+                self.cache.free(cid)
+                raise
 
     def cache_stats(self) -> Dict[str, int]:
-        return self.cache.stats()
+        out = dict(self.cache.stats())
+        with self._lock:
+            out["spec_proposed"] = self._spec_proposed
+            out["spec_accepted"] = self._spec_accepted
+        return out
 
     def stats(self) -> Dict[str, Any]:
         out = super().stats()
-        out.update(self.cache.stats())
+        out.update(self.cache_stats())
         with self._lock:
-            out["decode_sequences"] = len(self._seqs)
+            out["decode_sequences"] = len(self._seqs) + len(self._groups)
         return out
 
 

@@ -570,22 +570,53 @@ class BatchQueue:
 
 
 @dataclass
+class SamplingPolicy:
+    """Default branch-fanout for a decode deployment's requests.
+
+    ``n > 1`` turns every request into an N-branch group: beam search
+    when ``beam`` is set (branches scored by cumulative logprob, COW-
+    forked/rolled-back through the paged cache's refcounts), independent
+    parallel sampling otherwise (deterministic per-(seed, branch, step)
+    draws at ``temperature``). Per-request keys (``"n"``, ``"beam"``,
+    ``"temperature"``, ``"seed"``) override these defaults. A group
+    occupies ``n`` rows of every decode step — the scheduler weighs it
+    as ``n`` slots against ``max_active``."""
+    n: int = 1
+    beam: bool = False
+    temperature: float = 1.0
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.n < 1:
+            raise ValueError("n must be >= 1")
+        if self.temperature <= 0:
+            raise ValueError("temperature must be > 0")
+
+
+@dataclass
 class DecodePolicy:
     """Knobs for a deployment's continuous-batching decode queue.
 
-    ``max_active`` bounds the sequences packed into one replica's decode
-    step — it must not exceed the backend's ``max_batch`` (the static
-    batch dimension of the compiled step program). ``idle_wait_s`` is
-    the scheduler's sleep when admission is blocked but work remains
-    (page pressure with nothing retiring yet)."""
+    ``max_active`` bounds the step-program rows packed into one
+    replica's decode step (an N-branch sampling/beam group counts N) —
+    it must not exceed the backend's ``max_batch`` (the static batch
+    dimension of the compiled step program). ``idle_wait_s`` is the
+    scheduler's sleep when admission is blocked but work remains (page
+    pressure with nothing retiring yet). ``sampling`` sets the default
+    :class:`SamplingPolicy` merged into every request."""
     max_active: int = 8
     idle_wait_s: float = 0.01
+    sampling: Optional[SamplingPolicy] = None
 
     def __post_init__(self):
         if self.max_active < 1:
             raise ValueError("max_active must be >= 1")
         if self.idle_wait_s < 0:
             raise ValueError("idle_wait_s must be >= 0")
+        if self.sampling is not None and self.sampling.n > self.max_active:
+            raise ValueError(
+                f"sampling.n={self.sampling.n} exceeds max_active="
+                f"{self.max_active}")
 
 
 @dataclass
@@ -598,6 +629,7 @@ class _DecodeItem:
     replica: Any = None              # pinned actor handle (cache lives there)
     attempts: int = 0                # transport-failure re-admissions spent
     stalls: int = 0                  # consecutive page-pressured steps
+    slots: int = 1                   # step rows this item packs (group: n)
     enqueued_at: float = field(default_factory=time.monotonic)
 
 
@@ -674,6 +706,20 @@ class DecodeQueue:
         scheduler iterations, so there is no inline fast path — the
         caller bounds its wait via ``result(timeout)``."""
         del sync, timeout
+        sampling = self.policy.sampling
+        if sampling is not None and sampling.n > 1 \
+                and isinstance(request, dict):
+            # deployment-default fanout: merge the policy's knobs under
+            # any per-request overrides (never mutate the caller's dict)
+            request = {"n": sampling.n, "beam": sampling.beam,
+                       "temperature": sampling.temperature,
+                       "seed": sampling.seed, **request}
+        slots = 1
+        if isinstance(request, dict):
+            try:
+                slots = max(int(request.get("n", 1) or 1), 1)
+            except (TypeError, ValueError):
+                slots = 1                # poison n: fails at admit
         with self._cv:
             if self._closed:
                 raise self._close_error or RuntimeError(
@@ -681,7 +727,8 @@ class DecodeQueue:
             self._seq_counter += 1
             item = _DecodeItem(
                 request=request, future=BatchedFuture(), probe=probe,
-                seq_id=f"{self._dep.name}/{self._seq_counter}")
+                seq_id=f"{self._dep.name}/{self._seq_counter}",
+                slots=slots)
             self._pending.append(item)
             self._cv.notify_all()
         return item.future
@@ -694,15 +741,17 @@ class DecodeQueue:
                     + len(self._waiting))
 
     def replica_loads(self) -> Dict[int, int]:
-        """Per-replica sequence counts keyed ``id(replica)`` — the
+        """Per-replica step-row counts keyed ``id(replica)`` — the
         decode plane's own in-flight accounting (steps never pass
         through ``Deployment._dispatch``, so ``_outstanding`` can't see
-        them). ``Deployment.scale`` uses this to retire the
-        least-loaded replica instead of one packing live sequences."""
+        them; an N-branch group weighs N). ``Deployment.scale`` uses
+        this to retire the least-loaded replica instead of one packing
+        live sequences."""
         with self._lock:
             counts: Dict[int, int] = {}
             for it in self._active + self._waiting:
-                counts[id(it.replica)] = counts.get(id(it.replica), 0) + 1
+                counts[id(it.replica)] = (counts.get(id(it.replica), 0)
+                                          + it.slots)
             return counts
 
     def stats(self) -> Dict[str, Any]:
@@ -799,9 +848,9 @@ class DecodeQueue:
                     return i
         return 0
 
-    def _pick_replica(self) -> Optional[Any]:
-        """Least-loaded replica with free decode slots, by THIS queue's
-        own sequence counts (active + spilled both hold replica-side
+    def _pick_replica(self, slots: int = 1) -> Optional[Any]:
+        """Least-loaded replica with ``slots`` free decode rows, by THIS
+        queue's own row counts (active + spilled both hold replica-side
         state). Deterministic: ties break by replica index."""
         replicas = self._replicas()
         if not replicas:
@@ -812,7 +861,8 @@ class DecodeQueue:
         counts = self.replica_loads()
         best = min(range(len(replicas)),
                    key=lambda j: (counts.get(id(replicas[j]), 0), j))
-        if counts.get(id(replicas[best]), 0) >= self.policy.max_active:
+        if counts.get(id(replicas[best]), 0) + slots \
+                > self.policy.max_active:
             return None
         return replicas[best]
 
@@ -945,8 +995,18 @@ class DecodeQueue:
                 if self._closed or not self._pending:
                     return
                 item = self._pending[0]
+            if item.slots > self.policy.max_active:
+                # an N > max_active group can NEVER fit a step program:
+                # fail it alone instead of wedging the queue head
+                with self._cv:
+                    if self._pending and self._pending[0] is item:
+                        self._pending.popleft()
+                self._fail(item, ValueError(
+                    f"n={item.slots} branches exceed max_active="
+                    f"{self.policy.max_active}"))
+                continue
             try:
-                replica = self._pick_replica()
+                replica = self._pick_replica(item.slots)
             except Exception:
                 return                    # no replicas: close() will sweep
             if replica is None:
@@ -990,7 +1050,7 @@ class DecodeQueue:
                 continue
             with self._lock:
                 self._active.append(item)
-            self._tokens += 1
+            self._tokens += int(first.get("n_tokens", 1))
             if first.get("done"):
                 self._retire(item, result=first.get("result"))
 
@@ -1070,7 +1130,9 @@ class DecodeQueue:
                     continue
                 it.step += 1
                 it.stalls = 0
-                self._tokens += 1
+                # a speculative step commits up to spec_k tokens, a
+                # group step one per live branch
+                self._tokens += int(out.get("n_tokens", 1))
                 if out.get("done"):
                     self._retire(it, result=out.get("result"))
             if pressured is not None:
@@ -1138,6 +1200,13 @@ class DecodeQueue:
             v = stats.get(f"pages_{state}")
             if v is not None:
                 self._metrics["kv_pages"].set(v, (name, state))
+        evicted = stats.get("pages_evicted_total")
+        if evicted is not None:
+            self._metrics["kv_evicted"].set(evicted, (name,))
+        proposed = stats.get("spec_proposed") or 0
+        if proposed:
+            self._metrics["spec_acceptance"].set(
+                stats.get("spec_accepted", 0) / proposed, (name,))
 
     def _loop(self) -> None:
         while True:

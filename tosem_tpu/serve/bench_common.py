@@ -22,7 +22,8 @@ from tosem_tpu.utils.results import ResultRow
 def closed_loop(call: Callable[..., Any], n_clients: int, min_s: float,
                 make_request: Callable[[int, int], Any],
                 count_of: Optional[Callable[[Any], float]] = None,
-                timeout: float = 120.0) -> float:
+                timeout: float = 120.0,
+                samples: Optional[List[tuple]] = None) -> float:
     """``n_clients`` threads calling ``call(request, timeout=...)`` in a
     loop for >= ``min_s`` → completed units per second.
 
@@ -31,17 +32,27 @@ def closed_loop(call: Callable[..., Any], n_clients: int, min_s: float,
     cycles prompts with it). ``count_of(response)`` weighs a completed
     call (default 1.0; the token fleets count generated tokens). The
     first client error aborts the measurement and is re-raised — a
-    bench must never average over silent failures."""
+    bench must never average over silent failures. ``samples``, when
+    given, collects one ``(latency_s, units)`` tuple per completed call
+    — the raw material for per-unit latency percentiles (p50/p99
+    per-token rows)."""
     stop = time.perf_counter() + min_s
     counts = [0.0] * n_clients
     errors: List[BaseException] = []
+    lock = threading.Lock()
 
     def client(i: int) -> None:
         k = 0
         try:
             while time.perf_counter() < stop:
+                c0 = time.perf_counter()
                 out = call(make_request(i, k), timeout=timeout)
-                counts[i] += count_of(out) if count_of is not None else 1.0
+                dt = time.perf_counter() - c0
+                units = count_of(out) if count_of is not None else 1.0
+                counts[i] += units
+                if samples is not None:
+                    with lock:
+                        samples.append((dt, units))
                 k += 1
         except BaseException as e:   # pragma: no cover - surfaced below
             errors.append(e)
@@ -56,6 +67,22 @@ def closed_loop(call: Callable[..., Any], n_clients: int, min_s: float,
     if errors:
         raise errors[0]
     return sum(counts) / (time.perf_counter() - t0)
+
+
+def per_unit_percentiles(samples: List[tuple],
+                         pcts=(50, 99)) -> List[float]:
+    """Per-unit latencies (call latency / units completed by that call)
+    → the requested percentiles, in ms. A decode call that generated 32
+    tokens contributes ONE sample of its per-token cost — the caller-
+    visible amortized latency, not a fabricated per-token timeline."""
+    per_unit = sorted(dt / max(u, 1.0) for dt, u in samples)
+    if not per_unit:
+        return [float("nan")] * len(pcts)
+    out = []
+    for p in pcts:
+        idx = min(int(len(per_unit) * p / 100.0), len(per_unit) - 1)
+        out.append(per_unit[idx] * 1e3)
+    return out
 
 
 def paired_loop(call_a: Callable[..., Any], call_b: Callable[..., Any],
@@ -123,17 +150,24 @@ class SuiteEmitter:
         return self.rows[-1]
 
     def emit(self, bench_id: str, name: str, vals: List[float],
-             unit: str = "ops/s") -> Optional[ResultRow]:
+             unit: str = "ops/s",
+             lower_is_better: bool = False) -> Optional[ResultRow]:
         """Per-round values → one row carrying mean, sd, rounds, and
-        the min-of-rounds floor. Skipped (None) when filtered out or
-        empty."""
+        the conservative floor (min of rounds for throughput rows, MAX
+        for ``lower_is_better`` latency rows — ``--save`` reads
+        ``extra["min"]`` as the baseline value either way, and the gate
+        inverts its direction off ``extra["lower_is_better"]``).
+        Skipped (None) when filtered out or empty."""
         if not self.want(bench_id) or not vals:
             return None
         m = statistics.mean(vals)
         sd = statistics.stdev(vals) if len(vals) > 1 else 0.0
         row = self.record(bench_id, name, m, sd, unit=unit)
-        row.extra["rounds"] = [round(v, 2) for v in vals]
-        row.extra["min"] = round(min(vals), 2)
+        row.extra["rounds"] = [round(v, 4) for v in vals]
+        floor = max(vals) if lower_is_better else min(vals)
+        row.extra["min"] = round(floor, 4)
+        if lower_is_better:
+            row.extra["lower_is_better"] = True
         return row
 
     def flush(self, quiet: bool) -> List[ResultRow]:

@@ -106,8 +106,13 @@ def default_spill_store():
 
 @dataclass
 class _Seq:
+    # OWNED pages only: ``pages[t]`` is logical page ``released + t``.
+    # ``released`` counts leading pages evicted by sliding-window decode
+    # (:meth:`PagedKVCache.release_below`); their positions are out of
+    # every query's window, so the kernel never reads them.
     pages: List[int] = field(default_factory=list)
     length: int = 0
+    released: int = 0
 
 
 @dataclass
@@ -115,6 +120,7 @@ class _Spilled:
     ref: Any
     length: int
     n_pages: int
+    released: int = 0
 
 
 class PagedKVCache:
@@ -147,6 +153,7 @@ class PagedKVCache:
         self._refs: Dict[int, int] = {}
         self._seqs: Dict[Any, _Seq] = {}
         self._spilled: Dict[Any, _Spilled] = {}
+        self._evicted = 0            # window-released pages, lifetime
         self._spill_store = spill_store or default_spill_store()
 
     # ------------------------------------------------------------ allocation
@@ -180,7 +187,7 @@ class PagedKVCache:
             seq = self._seqs[seq_id]
             start = seq.length
             new_len = start + n_tokens
-            need = -(-new_len // self.page_size)
+            need = -(-new_len // self.page_size) - seq.released
             extra = need - len(seq.pages)
             # copy-on-write: appending into a shared partially-filled
             # tail page must not scribble on the other branch's history.
@@ -218,7 +225,51 @@ class PagedKVCache:
             for p in src.pages:
                 self._refs[p] += 1
             self._seqs[dst_id] = _Seq(pages=list(src.pages),
-                                      length=src.length)
+                                      length=src.length,
+                                      released=src.released)
+
+    def release_below(self, seq_id, floor_pos: int) -> int:
+        """Sliding-window eviction: release leading pages whose EVERY
+        position is below ``floor_pos`` (the lowest position any future
+        query's window can still see). Returns the number of pages
+        released this call. The sequence keeps its absolute ``length``;
+        released history is gone for good — the block table shrinks from
+        the front and :meth:`page_offset` reports how many logical pages
+        it now starts past (the kernel's ``page_offsets`` operand)."""
+        with self._lock:
+            seq = self._seqs[seq_id]
+            n = 0
+            # never release the page holding the newest cached position
+            while (len(seq.pages) > 1
+                   and (seq.released + 1) * self.page_size
+                   <= min(floor_pos, seq.length)):
+                self._decref(seq.pages.pop(0))
+                seq.released += 1
+                n += 1
+            self._evicted += n
+            return n
+
+    def truncate(self, seq_id, new_length: int) -> None:
+        """Rollback: drop cached positions past ``new_length`` (the
+        speculative-decode reject path). Trailing pages a shorter
+        sequence no longer needs return to the pool via refcounts — a
+        page still shared with a fork survives for the other branch.
+        Stale K/V inside the kept tail page is unreachable (every
+        attention masks ``pos < seq_len``)."""
+        with self._lock:
+            seq = self._seqs[seq_id]
+            if not 0 <= new_length <= seq.length:
+                raise ValueError(
+                    f"truncate({new_length}) outside [0, {seq.length}]")
+            if new_length < seq.released * self.page_size:
+                raise ValueError(
+                    f"truncate({new_length}) reaches into "
+                    f"{seq.released} released pages")
+            need = max(-(-new_length // self.page_size) - seq.released,
+                       0)
+            while len(seq.pages) > need:
+                self._decref(seq.pages.pop())
+            seq.length = new_length
 
     def free(self, seq_id) -> None:
         with self._lock:
@@ -235,13 +286,22 @@ class PagedKVCache:
 
     def block_table(self, seq_id, width: Optional[int] = None) -> np.ndarray:
         """[width] int32 physical page ids, 0-padded (padding slots are
-        never read: the kernel clamps to the last real page)."""
+        never read: the kernel clamps to the last real page). For a
+        window-evicted sequence this is the ROLLING table — slot t holds
+        logical page ``page_offset(seq_id) + t`` and the kernel must be
+        handed that offset."""
         with self._lock:
             pages = self._seqs[seq_id].pages
             w = width if width is not None else len(pages)
             out = np.zeros((max(w, 1),), np.int32)
             out[:len(pages)] = pages
             return out
+
+    def page_offset(self, seq_id) -> int:
+        """Logical page index of block-table slot 0 (the kernel's
+        ``page_offsets`` operand; 0 until window eviction starts)."""
+        with self._lock:
+            return self._seqs[seq_id].released
 
     def length(self, seq_id) -> int:
         with self._lock:
@@ -280,13 +340,15 @@ class PagedKVCache:
                 "k": np.asarray(self.k_pool[:, pages]),
                 "v": np.asarray(self.v_pool[:, pages]),
                 "length": seq.length,
+                "released": seq.released,
             }
             ref = self._spill_store.put(payload)
             for p in seq.pages:
                 self._decref(p)
             del self._seqs[seq_id]
             self._spilled[seq_id] = _Spilled(ref=ref, length=seq.length,
-                                             n_pages=len(seq.pages))
+                                             n_pages=len(seq.pages),
+                                             released=seq.released)
 
     def restore(self, seq_id) -> None:
         """Rehydrate a spilled sequence into fresh pages. Raises
@@ -308,7 +370,8 @@ class PagedKVCache:
             del self._spilled[seq_id]
             self._spill_store.drop(spilled.ref)
             self._seqs[seq_id] = _Seq(pages=pages,
-                                      length=payload["length"])
+                                      length=payload["length"],
+                                      released=payload.get("released", 0))
 
     def drop_spilled(self, seq_id) -> None:
         """Forget a spilled sequence WITHOUT restoring (the re-prefill
@@ -329,6 +392,7 @@ class PagedKVCache:
                 "pages_free": len(self._free),
                 "pages_spilled": sum(s.n_pages
                                      for s in self._spilled.values()),
+                "pages_evicted_total": self._evicted,
                 "sequences": len(self._seqs),
                 "sequences_spilled": len(self._spilled),
             }

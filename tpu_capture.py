@@ -64,6 +64,11 @@ LEGS = [
     # for select_page_size/BertDecodeBackend even in a narrow window
     ("autotune_decode_pages", CLI + ["--config=autotune_decode_pages"],
      1200),
+    # decode fast-path scenarios right behind the page sweep: the
+    # sliding-window t8192 A/B, speculative k=4 A/B, and beam COW
+    # fanout measure with the page/draft-block winners the sweep just
+    # landed (window-arm page size + spec-arm q-block read the cache)
+    ("decode_scenarios", CLI + ["--config=decode_scenarios"], 1500),
     # block-sparse mask programs right behind the autotune legs: the
     # sparse-schedule sweep lands "sparse" cache winners, then the
     # t8192 sliding-window/doc-packed scenario rows measure with them
