@@ -31,17 +31,19 @@ echo "== byte-compile (syntax gate)"
 python -m compileall -q tosem_tpu tests examples bench.py __graft_entry__.py
 
 chaos_smoke() {
-  # fast chaos smoke: 7 canned fault plans, fixed seeds (<3 min) — the
+  # fast chaos smoke: 8 canned fault plans, fixed seeds — the
   # runtime/serve/tune failure paths AND the recovery layer (lineage
   # reconstruction of an evicted object, node-kill resubmission,
-  # KV-page eviction + replica crash mid-decode, router + replica-node
-  # kill under cluster-serve traffic) run on every PR, not just when a
-  # chaos test file is touched (see tosem_tpu/chaos/); the recovery
-  # plans gate on zero surfaced errors — the workload must HEAL, not
-  # merely fail loudly
-  echo "== chaos smoke (7 canned fault plans, fixed seeds)"
+  # KV-page eviction + replica crash mid-decode, live-drain migration
+  # + prefill-node kill on a disaggregated decode deployment, router +
+  # replica-node kill under cluster-serve traffic) run on every PR,
+  # not just when a chaos test file is touched (see tosem_tpu/chaos/);
+  # the recovery plans gate on zero surfaced errors — the workload
+  # must HEAL, not merely fail loudly
+  echo "== chaos smoke (8 canned fault plans, fixed seeds)"
   for plan in worker-carnage serve-flap trial-crash \
-              evict-heal node-kill-heal decode-chaos router-chaos; do
+              evict-heal node-kill-heal decode-chaos decode-migrate \
+              router-chaos; do
     JAX_PLATFORMS=cpu python -m tosem_tpu.cli chaos --plan "$plan"
   done
 }
@@ -85,10 +87,15 @@ perf_smoke() {
     JAX_PLATFORMS=cpu "${dcmd[@]}"
   fi
   # cluster serving plane: the multi-process closed-loop bench — router
-  # tier vs single-process serve, plus the node-kill failover leg
+  # tier vs single-process serve, the node-kill failover leg
   # (in-bench hard asserts: zero lost logical requests, full
   # re-placement, no catastrophic (<0.5x) throughput collapse; the
-  # recovery level itself is held by the gated row's floor below)
+  # recovery level itself is held by the gated row's floor below),
+  # plus the cluster-decode legs: disaggregated prefill/decode vs
+  # colocated on the mixed c16 fleet (hard assert: migrations > 0) and
+  # drain-with-migration vs step-0 re-admission (hard asserts: zero
+  # surfaced errors, zero step-0 restarts under migration; gated on
+  # the deterministic tokens-to-catch-up ratio)
   echo "== perf smoke (cluster microbench vs results/bench_cluster.json)"
   local ccmd=(python -m tosem_tpu.cli microbench --cluster --trials 2
               --min-s 0.4 --quiet --only gated
@@ -126,11 +133,16 @@ if [[ "$QUICK" == "1" ]]; then
   # oracle correctness, kernel parity per mask type, sparse cache);
   # test_decode_modes = the decode fast-path gate (multi-token/window/
   # offset kernel parity, window eviction bounds, speculative
-  # bit-identity, COW beam groups, the "decode" cache section)
+  # bit-identity, COW beam groups, the "decode" cache section);
+  # test_sharded_decode = the dp×tp paged-decode bit-identity gate;
+  # test_cluster_transport = the tensor-transport framing gate (torn
+  # stream / truncated header / out-of-order chunks typed, mapped
+  # arrivals)
   python -m pytest -q -m "not slow" \
     tests/test_ops.py tests/test_pallas_kernels.py tests/test_nn.py \
     tests/test_flash_blocks.py tests/test_mask_programs.py \
-    tests/test_decode_modes.py \
+    tests/test_decode_modes.py tests/test_sharded_decode.py \
+    tests/test_cluster_transport.py \
     tests/test_sharding.py tests/test_serial.py tests/test_utils.py \
     tests/test_analysis.py tests/test_image_ops.py tests/test_htm.py \
     tests/test_compress.py tests/test_scorer.py tests/test_ring.py \

@@ -112,7 +112,10 @@ class TestAutoMLEndToEnd:
         am.fit(X[:180], y[:180])
         assert am.score(X[180:], y[180:]) > 0.6
 
+    @pytest.mark.slow
     def test_hung_trial_times_out_without_killing_fit(self):
+        # ~10s wall-clock deadline soak (tier-1's budget is tight;
+        # full CI's unfiltered `pytest tests/` still runs it)
         # pynisher-role test: a trial that never returns must be cancelled
         # (worker killed + respawned), recorded as a timeout, and the rest
         # of the search must proceed to a fitted ensemble

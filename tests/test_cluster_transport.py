@@ -1,0 +1,250 @@
+"""Chunked cross-node tensor transport: framing, mapped arrival,
+typed wire errors (tosem_tpu/cluster/transport.py)."""
+import json
+import socket
+import struct
+import time
+
+import numpy as np
+import pytest
+
+from tosem_tpu.cluster.transport import (DEFAULT_CHUNK_BYTES, MAGIC,
+                                         TensorReceiver, TransportError,
+                                         WireFormatError,
+                                         received_kv_payload,
+                                         send_kv_payload, send_tensors)
+
+_H = struct.Struct(">I")
+_C = struct.Struct(">IQI")
+
+
+@pytest.fixture()
+def rx():
+    r = TensorReceiver()
+    yield r
+    r.shutdown()
+
+
+def _raw(rx, payload: bytes) -> None:
+    s = socket.create_connection(("127.0.0.1", rx.port), timeout=5.0)
+    try:
+        s.sendall(payload)
+    finally:
+        s.close()
+
+
+def _wait_errors(rx, n, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if rx.stats()["errors"] >= n:
+            return rx.stats()
+    raise AssertionError(
+        f"receiver never recorded {n} errors: {rx.stats()}")
+
+
+def _header(total, name="z", shape=None, nbytes=None):
+    nbytes = total if nbytes is None else nbytes
+    return json.dumps({
+        "version": 1, "total_bytes": total,
+        "arrays": [{"name": name, "dtype": "uint8",
+                    "shape": shape or [total], "offset": 0,
+                    "nbytes": nbytes}],
+        "meta": {}}).encode()
+
+
+class TestRoundTrip:
+    def test_multi_chunk_bit_identity(self, rx):
+        a = np.arange(700_000, dtype=np.float32).reshape(7, 100_000)
+        b = np.arange(64, dtype=np.int64)
+        n = send_tensors(rx.address, {"key": "k1"},
+                         {"a": a, "b": b}, chunk_bytes=1 << 16)
+        assert n == a.nbytes + b.nbytes
+        assert n > (1 << 16)          # really chunked
+        got = rx.pop("k1", timeout=10.0)
+        arrs = got.arrays()
+        assert arrs["a"].tobytes() == a.tobytes()
+        assert arrs["b"].tobytes() == b.tobytes()
+        assert arrs["a"].shape == a.shape
+        got.release()
+
+    def test_arrivals_are_readonly_views(self, rx):
+        a = np.ones((8, 8), np.float32)
+        send_tensors(rx.address, {"key": "ro"}, {"a": a})
+        got = rx.pop("ro", timeout=10.0)
+        assert not got.arrays()["a"].flags.writeable
+        got.release()
+
+    def test_keyless_fifo_take(self, rx):
+        send_tensors(rx.address, {"tag": 1},
+                     {"x": np.arange(4, dtype=np.int32)})
+        got = rx.take(timeout=10.0)
+        assert got.meta["tag"] == 1
+        got.release()
+
+    def test_take_timeout(self, rx):
+        with pytest.raises(TimeoutError):
+            rx.take(timeout=0.05)
+
+    def test_pop_timeout_names_key(self, rx):
+        with pytest.raises(TimeoutError, match="nope"):
+            rx.pop("nope", timeout=0.05)
+
+    def test_bfloat16_round_trip(self, rx):
+        import jax.numpy as jnp
+        a = np.asarray(jnp.arange(256, dtype=jnp.bfloat16))
+        send_tensors(rx.address, {"key": "bf"}, {"a": a})
+        got = rx.pop("bf", timeout=10.0)
+        out = got.arrays()["a"]
+        assert str(out.dtype) == "bfloat16"
+        assert out.tobytes() == a.tobytes()
+        got.release()
+
+    def test_put_back_repops(self, rx):
+        send_tensors(rx.address, {"key": "pb"},
+                     {"x": np.arange(4, dtype=np.int32)})
+        got = rx.pop("pb", timeout=10.0)
+        rx.put_back("pb", got)
+        again = rx.pop("pb", timeout=1.0)
+        assert again.arrays()["x"].tolist() == [0, 1, 2, 3]
+        again.release()
+
+    def test_bytes_counters(self, rx):
+        from tosem_tpu.obs.metrics import prometheus_text
+        a = np.arange(1024, dtype=np.float64)
+        send_tensors(rx.address, {"key": "m"}, {"a": a})
+        rx.pop("m", timeout=10.0).release()
+        text = prometheus_text()
+        assert "cluster_transport_bytes_total" in text
+        assert 'direction="sent"' in text
+        assert 'direction="received"' in text
+        assert rx.stats()["bytes_received"] >= a.nbytes
+
+
+class TestFraming:
+    def test_torn_stream_mid_chunk(self, rx):
+        hdr = _header(100)
+        _raw(rx, MAGIC + _H.pack(len(hdr)) + hdr
+             + _C.pack(0, 0, 100) + b"xy")          # dies mid-chunk
+        st = _wait_errors(rx, 1)
+        assert "torn stream" in st["last_error"]
+
+    def test_truncated_header(self, rx):
+        _raw(rx, MAGIC + _H.pack(64) + b"notjson")
+        st = _wait_errors(rx, 1)
+        assert ("torn stream" in st["last_error"]
+                or "header" in st["last_error"])
+
+    def test_garbled_header_json(self, rx):
+        blob = b"x" * 32
+        _raw(rx, MAGIC + _H.pack(len(blob)) + blob)
+        st = _wait_errors(rx, 1)
+        assert "WireFormatError" in st["last_error"]
+
+    def test_bad_magic(self, rx):
+        _raw(rx, b"NOPE" + _H.pack(4) + b"{}!!")
+        st = _wait_errors(rx, 1)
+        assert "magic" in st["last_error"]
+
+    def test_out_of_order_chunk_rejected(self, rx):
+        hdr = _header(100)
+        _raw(rx, MAGIC + _H.pack(len(hdr)) + hdr
+             + _C.pack(5, 0, 50) + b"a" * 50)
+        st = _wait_errors(rx, 1)
+        assert "out-of-order" in st["last_error"]
+
+    def test_chunk_past_extent_rejected(self, rx):
+        hdr = _header(10)
+        _raw(rx, MAGIC + _H.pack(len(hdr)) + hdr
+             + _C.pack(0, 0, 64) + b"a" * 64)
+        st = _wait_errors(rx, 1)
+        assert "extent" in st["last_error"]
+
+    def test_fin_short_rejected(self, rx):
+        hdr = _header(100)
+        _raw(rx, MAGIC + _H.pack(len(hdr)) + hdr
+             + _C.pack(0xFFFFFFFF, 0, 0))           # FIN before bytes
+        st = _wait_errors(rx, 1)
+        assert "FIN" in st["last_error"]
+
+    def test_version_mismatch_rejected(self, rx):
+        blob = json.dumps({"version": 99, "total_bytes": 0,
+                           "arrays": [], "meta": {}}).encode()
+        _raw(rx, MAGIC + _H.pack(len(blob)) + blob)
+        st = _wait_errors(rx, 1)
+        assert "version" in st["last_error"]
+
+    def test_specs_must_sum_to_total(self, rx):
+        hdr = _header(100, nbytes=40)
+        _raw(rx, MAGIC + _H.pack(len(hdr)) + hdr)
+        st = _wait_errors(rx, 1)
+        assert "sum" in st["last_error"]
+
+    def test_errors_do_not_break_later_streams(self, rx):
+        _raw(rx, b"NOPE")
+        _wait_errors(rx, 1)
+        a = np.arange(16, dtype=np.int32)
+        send_tensors(rx.address, {"key": "after"}, {"a": a})
+        got = rx.pop("after", timeout=10.0)
+        assert got.arrays()["a"].tolist() == list(range(16))
+        got.release()
+
+    def test_sender_sees_peer_loss_typed(self):
+        # a peer that dies mid-stream surfaces as TransportError on
+        # the SENDER (torn send or torn ack, both typed)
+        import threading
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        port = srv.getsockname()[1]
+
+        def slam():
+            conn, _ = srv.accept()
+            conn.close()
+
+        t = threading.Thread(target=slam, daemon=True)
+        t.start()
+        with pytest.raises(TransportError):
+            send_tensors(f"127.0.0.1:{port}", {},
+                         {"a": np.zeros(1 << 22, np.uint8)},
+                         timeout=5.0)
+        t.join()
+        srv.close()
+
+    def test_chunk_bytes_validated(self, rx):
+        with pytest.raises(ValueError):
+            send_tensors(rx.address, {}, {"a": np.zeros(4)},
+                         chunk_bytes=0)
+
+
+class TestKvGlue:
+    def test_kv_payload_round_trip(self, rx):
+        from tosem_tpu.serve.kv_cache import PagedKVCache
+        import jax.numpy as jnp
+        src = PagedKVCache(8, 4, layers=2, heads=2, head_dim=8)
+        src.create("s")
+        src.extend("s", 10)
+        rng = np.random.default_rng(3)
+        src.set_pools(
+            jnp.asarray(rng.standard_normal(src.k_pool.shape),
+                        jnp.float32),
+            jnp.asarray(rng.standard_normal(src.v_pool.shape),
+                        jnp.float32))
+        payload = src.export_seq("s")
+        send_kv_payload(rx.address, payload, key="s")
+        got = rx.pop("s", timeout=10.0)
+        back = received_kv_payload(got)
+        assert back["header"] == payload["header"]
+        assert back["k"].tobytes() == payload["k"].tobytes()
+        assert back["v"].tobytes() == payload["v"].tobytes()
+        dst = PagedKVCache(8, 4, layers=2, heads=2, head_dim=8)
+        dst.import_seq("s", back)
+        got.release()
+        assert dst.length("s") == 10
+
+    def test_stream_without_kv_header_rejected(self, rx):
+        send_tensors(rx.address, {"key": "nohdr"},
+                     {"k": np.zeros(4), "v": np.zeros(4)})
+        got = rx.pop("nohdr", timeout=10.0)
+        with pytest.raises(WireFormatError):
+            received_kv_payload(got)
+        got.release()

@@ -114,7 +114,10 @@ class TestLoss:
 
 
 class TestTrainFakeData:
+    @pytest.mark.slow
     def test_tiny_overfit_single_box(self, tiny):
+        # ~12s training soak (tier-1's wall budget is tight; full CI's
+        # unfiltered `pytest tests/` still runs it)
         """--use_fake_data style end-to-end: overfit one image + one box
         until the top detection localizes it."""
         import optax

@@ -1,0 +1,534 @@
+"""Live KV migration: versioned wire payloads, export/import across
+pools and backends, migration x COW forks, migration mid-spill, the
+DecodeQueue drain/disaggregation paths (serve-level legs slow-marked)."""
+import numpy as np
+import pytest
+
+from tosem_tpu.serve.kv_cache import (KV_WIRE_VERSION, CachePressure,
+                                      KVWireError, PagedKVCache)
+
+KW = dict(max_batch=4, max_len=64, page_size=16, num_pages=24,
+          max_new_tokens=8)
+PROMPT = {"ids": [1, 2, 3, 4]}
+
+
+def _pool(num_pages=8, page_size=4, layers=2, heads=2, head_dim=8,
+          seed=0):
+    import jax.numpy as jnp
+    c = PagedKVCache(num_pages, page_size, layers=layers, heads=heads,
+                     head_dim=head_dim)
+    rng = np.random.default_rng(seed)
+    c.set_pools(
+        jnp.asarray(rng.standard_normal(c.k_pool.shape), jnp.float32),
+        jnp.asarray(rng.standard_normal(c.v_pool.shape), jnp.float32))
+    return c
+
+
+def _decode_all(backend, sid, request):
+    out = backend.admit(sid, request)
+    step = 0
+    while not out.get("done"):
+        out = backend.step_batch([sid], [step])[0]
+        step += 1
+    res = backend.result(sid)
+    backend.release(sid)
+    return res
+
+
+def _decode_from(backend, sid, out, step):
+    while not out.get("done"):
+        out = backend.step_batch([sid], [step])[0]
+        step += 1
+    return backend.result(sid)
+
+
+class TestWireFormat:
+    def test_spill_payload_carries_versioned_header(self):
+        c = _pool()
+        c.create("a")
+        c.extend("a", 10)
+        payload = c.export_seq("a")
+        h = payload["header"]
+        assert h["version"] == KV_WIRE_VERSION
+        assert h["layout"] == "lpshd"
+        assert h["page_size"] == 4 and h["dtype"] == "float32"
+        assert h["n_pages"] == 3 and h["length"] == 10
+        assert h["page_offset"] == 0
+
+    def test_import_into_mismatched_pool_raises_typed(self):
+        c = _pool()
+        c.create("a")
+        c.extend("a", 10)
+        payload = c.export_seq("a")
+        for bad in (
+                PagedKVCache(8, 8, layers=2, heads=2, head_dim=8),
+                PagedKVCache(8, 4, layers=2, heads=2, head_dim=8,
+                             dtype="bfloat16"),
+                PagedKVCache(8, 4, layers=1, heads=2, head_dim=8),
+                PagedKVCache(8, 4, layers=2, heads=4, head_dim=8),
+        ):
+            with pytest.raises(KVWireError):
+                bad.import_seq("a", payload)
+            assert bad.stats()["pages_used"] == 0   # nothing changed
+
+    def test_version_and_layout_mismatch_rejected(self):
+        c = _pool()
+        c.create("a")
+        c.extend("a", 4)
+        good = c.export_seq("a")
+        dst = _pool()
+        with pytest.raises(KVWireError):
+            dst.import_seq("x", {**good,
+                                 "header": {**good["header"],
+                                            "version": 99}})
+        with pytest.raises(KVWireError):
+            dst.import_seq("x", {**good,
+                                 "header": {**good["header"],
+                                            "layout": "phsld"}})
+        with pytest.raises(KVWireError):
+            dst.import_seq("x", {**good, "header": None})
+
+    def test_restore_validates_header(self):
+        c = _pool()
+        c.create("a")
+        c.extend("a", 6)
+        c.spill("a")
+        # corrupt the stored payload's header in place
+        ref = c._spilled["a"].ref
+        payload = c._spill_store.get(ref)
+        payload["header"] = {**payload["header"], "version": 99}
+        with pytest.raises(KVWireError):
+            c.restore("a")
+
+    def test_array_shape_must_match_header(self):
+        c = _pool()
+        c.create("a")
+        c.extend("a", 10)
+        payload = c.export_seq("a")
+        dst = _pool()
+        bad = dict(payload)
+        bad["k"] = payload["k"][:, :1]
+        with pytest.raises(KVWireError):
+            dst.import_seq("a", bad)
+
+
+class TestCacheMigration:
+    def test_export_import_bit_identical_attention(self):
+        from tosem_tpu.ops.paged_attention import paged_attention
+        src = _pool(seed=1)
+        dst = _pool(seed=2)                  # different resident bytes
+        src.create("s")
+        src.extend("s", 10)
+        payload = src.export_seq("s")
+        dst.import_seq("s", payload)
+        rng = np.random.default_rng(9)
+        q = rng.standard_normal((1, 2, 8)).astype(np.float32)
+        sl = np.array([10], np.int32)
+        o1 = np.asarray(paged_attention(
+            q, src.k_pool[0], src.v_pool[0],
+            src.block_table("s", 3)[None], sl, impl="xla"))
+        o2 = np.asarray(paged_attention(
+            q, dst.k_pool[0], dst.v_pool[0],
+            dst.block_table("s", 3)[None], sl, impl="xla"))
+        assert o1.tobytes() == o2.tobytes()
+
+    def test_export_leaves_source_untouched(self):
+        src = _pool()
+        src.create("s")
+        src.extend("s", 10)
+        before = src.stats()
+        refs = dict(src._refs)
+        src.export_seq("s")
+        assert src.stats() == before
+        assert dict(src._refs) == refs
+
+    def test_import_all_or_nothing_under_pressure(self):
+        src = _pool(num_pages=8)
+        src.create("s")
+        src.extend("s", 20)                  # 5 pages
+        payload = src.export_seq("s")
+        dst = _pool(num_pages=8)
+        dst.create("hog")
+        dst.extend("hog", 20)                # 5 of 8 pages taken
+        with pytest.raises(CachePressure):
+            dst.import_seq("s", payload)
+        assert dst.stats()["pages_used"] == 5    # nothing allocated
+        dst.free("hog")
+        dst.import_seq("s", payload)             # retry succeeds
+
+    def test_import_duplicate_id_rejected(self):
+        src = _pool()
+        src.create("s")
+        src.extend("s", 4)
+        payload = src.export_seq("s")
+        with pytest.raises(ValueError):
+            src.import_seq("s", payload)
+
+    def test_migrating_fork_leaves_sibling_refcounts_intact(self):
+        src = _pool()
+        src.create("a")
+        src.extend("a", 6)                   # spans 2 pages
+        src.fork("a", "b")
+        refs_shared = dict(src._refs)
+        assert any(v == 2 for v in refs_shared.values())
+        payload = src.export_seq("b")
+        dst = _pool()
+        dst.import_seq("b", payload)
+        # export touched nothing; freeing the migrated branch returns
+        # ONLY its refcounts — the sibling keeps every page
+        assert dict(src._refs) == refs_shared
+        src.free("b")
+        assert all(v == 1 for v in src._refs.values())
+        assert len(src.pages_of("a")) == 2
+
+    def test_migration_mid_spill(self):
+        src = _pool()
+        src.create("s")
+        src.extend("s", 10)
+        expect_k = None
+        payload_live = src.export_seq("s")
+        expect_k = payload_live["k"].tobytes()
+        src.spill("s")
+        payload = src.export_seq("s")        # export of a SPILLED seq
+        assert payload["k"].tobytes() == expect_k
+        dst = _pool()
+        dst.import_seq("s", payload)         # restores on the dest
+        assert dst.length("s") == 10
+        assert not dst.is_spilled("s")
+
+    def test_window_offset_survives_migration(self):
+        src = _pool(num_pages=16)
+        src.create("w")
+        src.extend("w", 14)                  # 4 pages
+        src.release_below("w", 9)            # 2 leading pages gone
+        assert src.page_offset("w") == 2
+        payload = src.export_seq("w")
+        assert payload["header"]["page_offset"] == 2
+        dst = _pool(num_pages=16)
+        dst.import_seq("w", payload)
+        assert dst.page_offset("w") == 2
+        assert dst.length("w") == 14
+
+
+class TestBackendMigration:
+    @pytest.fixture(scope="class")
+    def reference_tokens(self):
+        from tosem_tpu.serve.backends import BertDecodeBackend
+        ref = BertDecodeBackend(**KW)
+        return _decode_all(ref, "ref", PROMPT)["tokens"]
+
+    def test_greedy_migration_bit_identical(self, reference_tokens):
+        from tosem_tpu.serve.backends import BertDecodeBackend
+        src = BertDecodeBackend(**KW)
+        dst = BertDecodeBackend(**KW)
+        out = src.admit("s", PROMPT)
+        for st in range(2):
+            out = src.step_batch(["s"], [st])[0]
+        state = src.export_seq("s")
+        dst.import_seq("s", state)
+        src.release("s")
+        got = _decode_from(dst, "s", out, 2)
+        assert got["tokens"] == reference_tokens
+
+    def test_transport_migration_bit_identical(self, reference_tokens):
+        from tosem_tpu.serve.backends import BertDecodeBackend
+        src = BertDecodeBackend(**KW)
+        dst = BertDecodeBackend(**KW)
+        out = src.admit("s", PROMPT)
+        out = src.step_batch(["s"], [0])[0]
+        n = src.send_seq("s", dst.transport_address())
+        assert n > 0
+        dst.adopt_seq("s")
+        src.release("s")
+        got = _decode_from(dst, "s", out, 1)
+        assert got["tokens"] == reference_tokens
+
+    def test_adopt_is_idempotent(self, reference_tokens):
+        from tosem_tpu.serve.backends import BertDecodeBackend
+        src = BertDecodeBackend(**KW)
+        dst = BertDecodeBackend(**KW)
+        out = src.admit("s", PROMPT)
+        src.send_seq("s", dst.transport_address())
+        dst.adopt_seq("s")
+        dst.import_seq("s", {"kind": "seq"})  # replayed import: no-op
+        got = _decode_from(dst, "s", out, 0)
+        assert got["tokens"] == reference_tokens
+
+    def test_mid_spill_backend_migration(self, reference_tokens):
+        from tosem_tpu.serve.backends import BertDecodeBackend
+        src = BertDecodeBackend(**KW)
+        dst = BertDecodeBackend(**KW)
+        out = src.admit("s", PROMPT)
+        out = src.step_batch(["s"], [0])[0]
+        src.spill_seq("s")
+        state = src.export_seq("s")
+        dst.import_seq("s", state)
+        src.release("s")
+        got = _decode_from(dst, "s", out, 1)
+        assert got["tokens"] == reference_tokens
+
+    def test_beam_group_migration_bit_identical(self):
+        from tosem_tpu.serve.backends import BertDecodeBackend
+        req = {"ids": [5, 6, 7], "n": 3, "beam": True}
+        ref = BertDecodeBackend(**KW)
+        want = _decode_all(ref, "g", req)
+        src = BertDecodeBackend(**KW)
+        dst = BertDecodeBackend(**KW)
+        out = src.admit("g", req)
+        out = src.step_batch(["g"], [0])[0]
+        dst.import_seq("g", src.export_seq("g"))
+        src.release("g")
+        got = _decode_from(dst, "g", out, 1)
+        assert got == want
+
+    def test_windowed_migration_bit_identical(self):
+        from tosem_tpu.serve.backends import BertDecodeBackend
+        kw = dict(max_batch=4, max_len=96, page_size=8, num_pages=48,
+                  max_new_tokens=10, window=24)
+        prompt = {"ids": list(range(1, 30))}
+        ref = BertDecodeBackend(**kw)
+        want = _decode_all(ref, "w", prompt)["tokens"]
+        src = BertDecodeBackend(**kw)
+        dst = BertDecodeBackend(**kw)
+        out = src.admit("w", prompt)
+        for st in range(3):
+            out = src.step_batch(["w"], [st])[0]
+        dst.import_seq("w", src.export_seq("w"))
+        src.release("w")
+        got = _decode_from(dst, "w", out, 3)
+        assert got["tokens"] == want
+
+    def test_list_seqs_and_release(self):
+        from tosem_tpu.serve.backends import BertDecodeBackend
+        b = BertDecodeBackend(**KW)
+        assert b.list_seqs() == []
+        b.admit("s1", PROMPT)
+        b.admit("s2", {"ids": [9, 8, 7]})
+        assert b.list_seqs() == ["s1", "s2"]
+        b.release("s1")
+        assert b.list_seqs() == ["s2"]
+
+    def test_per_request_token_budget(self):
+        from tosem_tpu.serve.backends import BertDecodeBackend
+        b = BertDecodeBackend(**KW)
+        res = b.call({"ids": [1, 2, 3], "max_new_tokens": 3})
+        assert len(res["generated"]) == 3
+        res = b.call({"ids": [1, 2, 3], "max_new_tokens": 1})
+        assert len(res["generated"]) == 1
+        with pytest.raises(ValueError):
+            b.admit("bad", {"ids": [1, 2, 3], "max_new_tokens": 0})
+        # clamped by the backend cap, not extended past it
+        res = b.call({"ids": [1, 2, 3], "max_new_tokens": 999})
+        assert len(res["generated"]) == KW["max_new_tokens"]
+
+    def test_budget_survives_migration(self):
+        from tosem_tpu.serve.backends import BertDecodeBackend
+        src = BertDecodeBackend(**KW)
+        dst = BertDecodeBackend(**KW)
+        req = {"ids": [1, 2, 3], "max_new_tokens": 4}
+        ref = BertDecodeBackend(**KW)
+        want = _decode_all(ref, "b", req)["tokens"]
+        out = src.admit("b", req)
+        out = src.step_batch(["b"], [0])[0]
+        dst.import_seq("b", src.export_seq("b"))
+        src.release("b")
+        got = _decode_from(dst, "b", out, 1)
+        assert got["tokens"] == want
+        assert len(got["generated"]) == 4
+
+    def test_step_on_unadopted_seq_reports_pending(self):
+        from tosem_tpu.serve.backends import BertDecodeBackend
+        b = BertDecodeBackend(**KW)
+        out = b.step_batch(["ghost"], [0])[0]
+        assert out == {"pending": True}
+
+
+@pytest.mark.slow
+class TestServeMigration:
+    """Serve-level drain + disaggregation over real replica actors."""
+
+    def _expected(self, prompts, kw):
+        from tosem_tpu.serve.backends import BertDecodeBackend
+        ref = BertDecodeBackend(**kw)
+        return [_decode_all(ref, f"r{i}", p)["tokens"]
+                for i, p in enumerate(prompts)]
+
+    def test_drain_with_migration_continues_from_current_step(self):
+        import time
+
+        import tosem_tpu.runtime as rt
+        from tosem_tpu.serve.backends import BertDecodeBackend
+        from tosem_tpu.serve.batching import DecodePolicy
+        from tosem_tpu.serve.core import Serve
+        kw = dict(KW, max_new_tokens=40)
+        prompts = [{"ids": [1 + i, 2 + i, 3 + i]} for i in range(4)]
+        expected = self._expected(prompts, kw)
+        own = not rt.is_initialized()
+        if own:
+            rt.init(num_workers=3, memory_monitor=False)
+        try:
+            serve = Serve()
+            serve.deploy("drain", BertDecodeBackend, init_kwargs=kw,
+                         num_replicas=2,
+                         decode_policy=DecodePolicy(max_active=4),
+                         max_retries=2)
+            dep = serve.get_deployment("drain")
+            h = serve.get_handle("drain")
+            futs = [h.remote(p) for p in prompts]
+            q = dep._queue
+            deadline = time.time() + 120
+            while time.time() < deadline:
+                with q._lock:
+                    if len(q._active) >= 2:
+                        break
+                time.sleep(0.02)
+            loads = q.replica_loads()
+            with dep._lock:
+                reps = list(dep._replicas)
+            victim = max(reps, key=lambda r: loads.get(id(r), 0))
+            res = q.drain_replica(victim, migrate=True)
+            assert res["migrated"] >= 1
+            got = [f.result(timeout=180.0)["tokens"] for f in futs]
+            assert got == expected
+            st = dep.stats()
+            assert st["kv_migrations"] >= 1
+            assert st["seqs_readmitted_step0"] == 0
+            assert st["sequences_err"] == 0
+            serve.delete("drain")
+        finally:
+            if own:
+                rt.shutdown()
+
+    def test_disaggregated_prefill_decode_bit_identical(self):
+        import tosem_tpu.runtime as rt
+        from tosem_tpu.serve.backends import BertDecodeBackend
+        from tosem_tpu.serve.batching import DecodePolicy
+        from tosem_tpu.serve.core import Serve
+        kw = dict(KW, max_new_tokens=20)
+        prompts = [{"ids": [1 + i, 2 + i, 3 + i]} for i in range(4)]
+        expected = self._expected(prompts, kw)
+        own = not rt.is_initialized()
+        if own:
+            rt.init(num_workers=3, memory_monitor=False)
+        try:
+            serve = Serve()
+            serve.deploy(
+                "disagg", BertDecodeBackend, init_kwargs=kw,
+                num_replicas=3,
+                decode_policy=DecodePolicy(max_active=4,
+                                           prefill_replicas=1),
+                max_retries=2)
+            h = serve.get_handle("disagg")
+            futs = [h.remote(p) for p in prompts]
+            got = [f.result(timeout=180.0)["tokens"] for f in futs]
+            assert got == expected
+            st = serve.get_deployment("disagg").stats()
+            assert st["kv_migrations"] >= len(prompts)
+            serve.delete("disagg")
+        finally:
+            if own:
+                rt.shutdown()
+
+    def test_disaggregated_single_replica_falls_back_colocated(self):
+        # prefill_replicas >= fleet size leaves no prefill tier
+        # (_split_replicas always keeps a decode replica): admission
+        # must fall back to the colocated path, not stall _pending
+        # forever waiting for a tier that cannot exist
+        import tosem_tpu.runtime as rt
+        from tosem_tpu.serve.backends import BertDecodeBackend
+        from tosem_tpu.serve.batching import DecodePolicy
+        from tosem_tpu.serve.core import Serve
+        kw = dict(KW, max_new_tokens=8)
+        prompts = [{"ids": [1 + i, 2 + i, 3 + i]} for i in range(2)]
+        expected = self._expected(prompts, kw)
+        own = not rt.is_initialized()
+        if own:
+            rt.init(num_workers=1, memory_monitor=False)
+        try:
+            serve = Serve()
+            serve.deploy(
+                "disagg1", BertDecodeBackend, init_kwargs=kw,
+                num_replicas=1,
+                decode_policy=DecodePolicy(max_active=4,
+                                           prefill_replicas=1),
+                max_retries=2)
+            h = serve.get_handle("disagg1")
+            futs = [h.remote(p) for p in prompts]
+            got = [f.result(timeout=120.0)["tokens"] for f in futs]
+            assert got == expected
+            serve.delete("disagg1")
+        finally:
+            if own:
+                rt.shutdown()
+
+    def test_decode_migrate_chaos_plan_survives(self):
+        from tosem_tpu.chaos.plan import CANNED_PLANS
+        from tosem_tpu.chaos.runner import run_plan
+        rep = run_plan(CANNED_PLANS["decode-migrate"])
+        assert rep.ok, rep.render()
+        assert rep.counts["errors_surfaced"] == 0
+        assert rep.counts["kv_migrations"] > 0
+
+
+class TestClusterDrain:
+    def test_prefill_replicas_requires_migration_surface(self):
+        from tosem_tpu.serve.batching import DecodePolicy
+        p = DecodePolicy(max_active=4, prefill_replicas=1)
+        assert p.prefill_replicas == 1
+        with pytest.raises(ValueError):
+            DecodePolicy(prefill_replicas=-1)
+
+    @pytest.mark.slow
+    def test_cluster_serve_drain_node_migrates_sequences(self):
+        from tosem_tpu.cluster.node import RemoteNode
+        from tosem_tpu.cluster.rpc import RpcClient
+        from tosem_tpu.cluster.supervisor import NodePool
+        from tosem_tpu.serve.backends import BertDecodeBackend
+        from tosem_tpu.serve.cluster_serve import ClusterServe
+        kw = dict(KW, max_new_tokens=12)
+        ref = BertDecodeBackend(**kw)
+        want = _decode_all(ref, "ref", PROMPT)["tokens"]
+        pool = NodePool(miss_threshold=2, probe_timeout=5.0)
+        cs = None
+        try:
+            for i in range(2):
+                pool.add_node(RemoteNode.spawn_local(num_workers=2),
+                              name=f"n{i}")
+            cs = ClusterServe(pool, num_routers=1, router_procs=False)
+            dep = cs.deploy(
+                "dec", "tosem_tpu.serve.backends:BertDecodeBackend",
+                num_replicas=2, strategy="spread", init_kwargs=kw)
+            by_node = {r.node: r for r in dep.replicas}
+            assert len(by_node) == 2
+            src_node = sorted(by_node)[0]
+            src = by_node[src_node]
+            # admit two sequences directly on the source replica and
+            # step them a bit — in-flight state a drain must preserve
+            with RpcClient(src.address) as cli:
+                cli.call("backend_call", "admit", "s1", PROMPT)
+                cli.call("backend_call", "step_batch", ["s1"], [0])
+            out = cs.drain_node(src_node)
+            assert out["replicas_moved"] == 1
+            assert out["sequences_migrated"] == 1
+            # the sequence now lives on the survivor, mid-decode
+            surv = next(r for r in dep.replicas if r.node != src_node
+                        and r.replica_id != src.replica_id)
+            with RpcClient(surv.address) as cli:
+                assert cli.call("backend_call", "list_seqs") == ["s1"]
+                step = 1
+                while True:
+                    o = cli.call("backend_call", "step_batch", ["s1"],
+                                 [step])[0]
+                    step += 1
+                    if o.get("done"):
+                        break
+                res = cli.call("backend_call", "result", "s1")
+            assert res["tokens"] == want
+            # capacity restored: the drained replica re-placed off the
+            # drained node under the same id
+            assert len(dep.replicas) == 2
+            assert all(r.node != src_node for r in dep.replicas)
+        finally:
+            if cs is not None:
+                cs.close()
+            pool.close(close_nodes=True)

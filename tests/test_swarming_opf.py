@@ -102,7 +102,12 @@ def _signal(n=400, anomalies=(250, 320)):
     return x
 
 
+@pytest.mark.slow
 def test_opf_runner_detects_injected_anomalies(tmp_path):
+    # ~2 min of pure-Python HTM stepping over 400 records — by far the
+    # single most expensive test in the suite (the quick tier's whole
+    # wall budget is ~15 min); full CI (`pytest tests/ -q`) still runs
+    # it, and the short OPF smokes below keep the runner gated per-PR
     csv = str(tmp_path / "opf.csv")
     desc = {"model": {"minval": -2.0, "maxval": 6.0},
             "probation": 150, "anomaly_threshold": 0.7, "seed": 0}

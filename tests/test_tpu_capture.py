@@ -51,3 +51,35 @@ class TestCli:
             capture_output=True, text=True, timeout=60, cwd=REPO)
         assert proc.returncode == 2
         assert "unknown legs" in proc.stderr
+
+
+class TestTunnelPreflight:
+    def test_down_tunnel_requeues_then_skips(self, monkeypatch, capsys,
+                                             tmp_path):
+        """A leg that finds the tunnel down at preflight is re-queued
+        (bounded) instead of burned; when the degraded run also fails
+        it reports ``skipped (tunnel)`` — never as a code failure and
+        never as on-chip evidence."""
+        monkeypatch.setattr(tpu_capture, "tunnel_alive", lambda: False)
+        monkeypatch.setattr(
+            tpu_capture, "wait_for_tunnel",
+            lambda deadline, poll_s=20.0: False)
+
+        class _Failed:
+            returncode = 1
+
+        monkeypatch.setattr(tpu_capture.subprocess, "run",
+                            lambda *a, **k: _Failed())
+        monkeypatch.setattr(tpu_capture, "rebuild_report", lambda: {})
+        monkeypatch.setattr(tpu_capture, "LOG_DIR", str(tmp_path))
+        monkeypatch.setattr(tpu_capture, "SUMMARY",
+                            str(tmp_path / "summary.json"))
+        monkeypatch.setattr(sys, "argv",
+                            ["tpu_capture.py", "--legs", "timing_check",
+                             "--budget-h", "0.01"])
+        rc = tpu_capture.main()
+        out = capsys.readouterr().out
+        assert rc == 1                      # not all-ok
+        assert out.count("requeued") >= tpu_capture.TUNNEL_REQUEUES
+        assert "skipped (tunnel" in out
+        assert "failed (" not in out        # a tunnel loss, not a bug

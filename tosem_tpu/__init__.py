@@ -66,6 +66,18 @@ _LAZY_EXPORTS = {
     "ShardedAttentionBackend": ("tosem_tpu.serve.backends",
                                 "ShardedAttentionBackend"),
     "dp_tp_mesh": ("tosem_tpu.parallel.flash", "dp_tp_mesh"),
+    # cluster-scale decode (round 12): model-sharded paged decode,
+    # chunked cross-node tensor transport, live KV migration
+    "sharded_paged_attention": ("tosem_tpu.parallel.flash",
+                                "sharded_paged_attention"),
+    "ShardedPagedDecodeBackend": ("tosem_tpu.serve.backends",
+                                  "ShardedPagedDecodeBackend"),
+    "KVWireError": ("tosem_tpu.serve.kv_cache", "KVWireError"),
+    "TensorReceiver": ("tosem_tpu.cluster.transport", "TensorReceiver"),
+    "send_tensors": ("tosem_tpu.cluster.transport", "send_tensors"),
+    "TransportError": ("tosem_tpu.cluster.transport", "TransportError"),
+    "WireFormatError": ("tosem_tpu.cluster.transport",
+                        "WireFormatError"),
     # block-sparse mask programs (round 10): splash-style per-head
     # block schedules driving the flash kernels' stream dimension
     "FullMask": ("tosem_tpu.ops.mask_programs", "FullMask"),

@@ -39,8 +39,13 @@ VALID_ACTIONS = {
     "runtime.store": ("evict_object",),
     "serve.dispatch": ("crash_replica", "slow_replica"),
     # fired once per decode-scheduler iteration: evict_pages spills the
-    # coldest active sequence's KV pages out of the pool mid-decode
-    "serve.decode_step": ("evict_pages", "slow_step"),
+    # coldest active sequence's KV pages out of the pool mid-decode;
+    # drain_replica live-migrates the oldest active sequence's replica
+    # (sequences must continue from the CURRENT step elsewhere);
+    # crash_prefill SIGKILLs the disaggregated prefill tier's first
+    # replica (in-flight admits re-admit, decode-tier sequences ride on)
+    "serve.decode_step": ("evict_pages", "slow_step", "drain_replica",
+                          "crash_prefill"),
     # fired per client request routed through a ClusterHandle:
     # kill_router SIGKILLs the first live router process (the client
     # must fail over), kill_node SIGKILLs a node hosting one of the
@@ -185,6 +190,21 @@ def _canned() -> Dict[str, FaultPlan]:
         "decode-chaos": FaultPlan(seed=37, name="decode-chaos", faults=[
             Fault(site="serve.decode_step", action="evict_pages", at=2),
             Fault(site="serve.dispatch", action="crash_replica", at=9),
+        ]),
+        # the cluster-decode acceptance plan: against a DISAGGREGATED
+        # prefill/decode deployment, live-drain a decode replica a few
+        # steps in (its sequences must MIGRATE and continue from the
+        # current step — zero step-0 restarts) and then kill the
+        # prefill node mid-stream (in-flight admits re-admit on the
+        # decode tier, migrated sequences must not notice) — every
+        # sequence completes with fault-free-identical tokens, zero
+        # surfaced errors
+        "decode-migrate": FaultPlan(seed=41, name="decode-migrate",
+                                    faults=[
+            Fault(site="serve.decode_step", action="drain_replica",
+                  at=3),
+            Fault(site="serve.decode_step", action="crash_prefill",
+                  at=6),
         ]),
         # the cluster-serving acceptance plan: kill a ROUTER mid-traffic
         # (clients must fail over to the surviving router), then kill a

@@ -405,6 +405,74 @@ def _scenario_decode(chaos: ChaosController,
         rt.shutdown()
 
 
+def _scenario_decode_migrate(chaos: ChaosController,
+                             rep: SurvivalReport) -> None:
+    """The cluster-decode acceptance run: 8 sequences decode through a
+    DISAGGREGATED deployment (1 prefill + 2 decode replicas) while the
+    plan live-drains a decode replica mid-stream (sequences must
+    MIGRATE — continue from the current step, zero step-0 restarts
+    from the drain) and then kills the prefill replica (in-flight
+    admits re-admit; migrated sequences ride on). Every sequence must
+    complete with the SAME tokens a fault-free run produces, with zero
+    surfaced errors and at least one live migration observed."""
+    import tosem_tpu.runtime as rt
+    from tosem_tpu.serve.backends import BertDecodeBackend
+    from tosem_tpu.serve.batching import DecodePolicy
+    from tosem_tpu.serve.core import Serve
+
+    kw = dict(max_batch=4, max_len=64, page_size=16, num_pages=24,
+              max_new_tokens=8)
+    prompts = [{"ids": [1 + i, 2 + i, 3 + i, 4 + i]} for i in range(8)]
+    ref_backend = BertDecodeBackend(**kw)
+    expected = []
+    for i, p in enumerate(prompts):
+        out = ref_backend.admit(f"ref{i}", p)
+        step = 0
+        while not out.get("done"):
+            out = ref_backend.step_batch([f"ref{i}"], [step])[0]
+            step += 1
+        expected.append(ref_backend.result(f"ref{i}")["tokens"])
+        ref_backend.release(f"ref{i}")
+
+    rt.init(num_workers=3, memory_monitor=False)
+    try:
+        serve = Serve()
+        serve.deploy("decode", BertDecodeBackend, init_kwargs=kw,
+                     num_replicas=3,
+                     decode_policy=DecodePolicy(max_active=4,
+                                                prefill_replicas=1),
+                     max_restarts=2, max_retries=3)
+        h = serve.get_handle("decode")
+        futs = [h.remote(p) for p in prompts]
+        got, errors = [], 0
+        for f in futs:
+            try:
+                got.append(f.result(timeout=300.0)["tokens"])
+            except BaseException:
+                got.append(None)
+                errors += 1
+        correct = sum(1 for g, e in zip(got, expected) if g == e)
+        st = serve.get_deployment("decode").stats()
+        inj = chaos.injections("serve.decode_step")
+        rep.counts["sequences"] = len(prompts)
+        rep.counts["sequences_correct"] = correct
+        rep.counts["errors_surfaced"] = errors
+        rep.counts["kv_migrations"] = st.get("kv_migrations", 0)
+        rep.counts["drains_injected"] = len(
+            [e for e in inj if e["action"] == "drain_replica"])
+        rep.counts["prefill_kills_injected"] = len(
+            [e for e in inj if e["action"] == "crash_prefill"])
+        rep.ok = (errors == 0 and correct == len(prompts)
+                  and rep.counts["kv_migrations"] > 0
+                  and rep.counts["drains_injected"] > 0)
+        if not rep.ok:
+            rep.notes.append(f"expected {expected}, got {got}; "
+                             f"stats {st}")
+        serve.delete("decode")
+    finally:
+        rt.shutdown()
+
+
 def _scenario_router(chaos: ChaosController,
                      rep: SurvivalReport) -> None:
     """The cluster-serving acceptance run: 24 requests through the
@@ -469,6 +537,7 @@ SCENARIOS: Dict[str, Callable[[ChaosController, SurvivalReport], None]] = {
     "train-preempt": _scenario_train_preempt,
     "state-plane-survival": _scenario_state_plane,
     "decode-chaos": _scenario_decode,
+    "decode-migrate": _scenario_decode_migrate,
     "router-chaos": _scenario_router,
 }
 

@@ -1058,7 +1058,10 @@ def run_decode_scenarios(fs: FlagSet) -> List[Any]:
 def run_cluster_bench(fs: FlagSet) -> List[Any]:
     """Cluster serving microbench as a capture-harness leg: 2 nodes × 2
     replicas behind the router tier vs the single-process data plane,
-    the node-kill failover leg, and the sharded dp×tp parity pin (see
+    the node-kill failover leg, the sharded dp×tp parity pins (flash
+    AND paged decode), and the cluster-decode legs — disaggregated
+    prefill/decode vs colocated on the mixed c16 fleet, and
+    drain-with-migration vs step-0 re-admission (see
     :mod:`tosem_tpu.serve.bench_cluster`). Rows land under the
     ``cluster_bench`` config."""
     from tosem_tpu.serve.bench_cluster import run_cluster_benchmarks

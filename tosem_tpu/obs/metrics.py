@@ -235,6 +235,17 @@ def serve_metrics(registry: Optional[Registry] = None) -> Dict[str, Metric]:
     - ``serve_spec_acceptance_rate`` (gauge): accepted / proposed draft
       tokens of speculative decode — the knob that decides whether
       ``spec_k`` pays for itself (commit rate ~ 1 + rate * (k - 1)).
+
+    Live-KV-migration instruments (cluster-scale decode — node drain
+    and prefill/decode disaggregation both ride them):
+
+    - ``serve_kv_migrations_total`` (counter, labels deployment/
+      outcome): sequence migrations by outcome — ``ok`` (continued
+      from the current step on the destination) vs ``fallback``
+      (migration failed; the sequence re-admitted from step 0, the
+      recompute path).
+    - ``serve_kv_migration_ms`` (histogram): wall time of one
+      successful export→import migration, per deployment.
     """
     reg = registry or DEFAULT
     return {
@@ -274,6 +285,17 @@ def serve_metrics(registry: Optional[Registry] = None) -> Dict[str, Metric]:
             "serve_spec_acceptance_rate",
             "speculative decode accepted/proposed draft-token ratio",
             labels=("deployment",)),
+        "kv_migrations": reg.counter(
+            "serve_kv_migrations_total",
+            "live KV-cache sequence migrations by outcome "
+            "(ok = continued from current step, fallback = re-admitted "
+            "from step 0)",
+            labels=("deployment", "outcome")),
+        "kv_migration_ms": reg.histogram(
+            "serve_kv_migration_ms",
+            "wall time of one successful sequence migration "
+            "(export + import)",
+            labels=("deployment",), buckets=_BATCH_WAIT_BUCKETS),
     }
 
 

@@ -447,6 +447,29 @@ def paged_attention(q, k_pages, v_pages, block_tables, seq_lens, *,
     return out if multi else out[:, 0]
 
 
+def paged_partition_specs(data_axis="dp", model_axis="tp", multi=False):
+    """``PartitionSpec`` pytree for sharding this kernel under
+    ``shard_map`` (the SNIPPETS [1] ``sharded_paged_attention``
+    contract): KV pools shard their HEAD dim over the model axis (each
+    chip owns its heads' pages — the pool's page dim stays whole so any
+    block-table id resolves locally), q shards batch over data and heads
+    over model, and the per-sequence operands (block tables, seq lens,
+    q_rows, page_offsets) follow the batch. Returns a dict keyed by
+    operand name; ``multi`` selects the [B, K, H, D] query layout."""
+    from jax.sharding import PartitionSpec as P
+    q_spec = (P(data_axis, None, model_axis, None) if multi
+              else P(data_axis, model_axis, None))
+    return {
+        "q": q_spec,
+        "kv_pages": P(None, None, model_axis, None),
+        "block_tables": P(data_axis, None),
+        "seq_lens": P(data_axis),
+        "q_rows": P(data_axis),
+        "page_offsets": P(data_axis),
+        "out": q_spec,
+    }
+
+
 def paged_attention_reference(q, k_pages, v_pages, block_tables,
                               seq_lens, *, sm_scale=None, q_rows=None,
                               window=None, page_offsets=None):

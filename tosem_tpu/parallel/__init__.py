@@ -15,4 +15,5 @@ from tosem_tpu.parallel.sharding import (bert_rules, image_batch_rules,
                                          tree_specs)
 from tosem_tpu.parallel.ring import (make_ring_attn_fn, make_ulysses_attn_fn,
                                      ring_attention, ulysses_attention)
-from tosem_tpu.parallel.flash import dp_tp_mesh, sharded_flash_attention
+from tosem_tpu.parallel.flash import (dp_tp_mesh, sharded_flash_attention,
+                                      sharded_paged_attention)

@@ -54,6 +54,96 @@ def dp_tp_mesh(dp: int, tp: int, devices=None) -> Mesh:
     return Mesh(np.array(devs[:dp * tp]).reshape(dp, tp), ("dp", "tp"))
 
 
+def sharded_paged_attention(mesh: Mesh, *,
+                            sm_scale: Optional[float] = None,
+                            window: Optional[int] = None,
+                            data_axis: str = "dp",
+                            model_axis: Optional[str] = "tp",
+                            impl: Optional[str] = None):
+    """Model-sharded paged decode attention under ``shard_map``.
+
+    Builds a jitted ``(q, k_pages, v_pages, block_tables, seq_lens[,
+    q_rows, page_offsets]) -> out`` over ``mesh``: the KV pools shard
+    their HEAD dim over ``model_axis`` (each chip owns its heads' slice
+    of every physical page, so a block-table id resolves locally on
+    every chip — the SNIPPETS [1] ``P("model", ...)`` pool layout,
+    transposed to our ``[P, page, H, D]`` pools), queries shard batch
+    over ``data_axis`` and heads over ``model_axis``, and the
+    per-sequence operands (block tables, seq lens, ``q_rows``,
+    ``page_offsets``) follow the batch. The per-shard body is the
+    unmodified :func:`~tosem_tpu.ops.paged_attention.paged_attention`
+    (same dual pallas/xla lowering, same ``window`` schedule), and
+    because decode attention reduces only within a (batch row, head)
+    cell, the sharded program is **bit-identical** to the
+    single-process kernel — pinned by tests and the cluster bench's
+    parity leg.
+
+    ``window`` is a trace-time constant (one compiled program per
+    window, matching the unsharded kernel's signature); ``q_rows`` /
+    ``page_offsets`` are optional CALL-time operands — each None/given
+    combination traces its own shard_map body, like the segment-ids
+    handling in :func:`sharded_flash_attention`."""
+    from tosem_tpu.ops.paged_attention import (paged_attention,
+                                               paged_partition_specs)
+    if data_axis not in mesh.axis_names:
+        raise ValueError(f"data axis {data_axis!r} not in mesh "
+                         f"{mesh.axis_names}")
+    if model_axis is not None and model_axis not in mesh.axis_names:
+        raise ValueError(f"model axis {model_axis!r} not in mesh "
+                         f"{mesh.axis_names}")
+    dp_size = mesh.shape[data_axis]
+    tp_size = mesh.shape[model_axis] if model_axis is not None else 1
+
+    def _make(multi: bool, have_rows: bool, have_offs: bool):
+        specs = paged_partition_specs(data_axis, model_axis, multi=multi)
+        in_specs = [specs["q"], specs["kv_pages"], specs["kv_pages"],
+                    specs["block_tables"], specs["seq_lens"]]
+        if have_rows:
+            in_specs.append(specs["q_rows"])
+        if have_offs:
+            in_specs.append(specs["page_offsets"])
+
+        def body(q, kp, vp, bt, sl, *rest):
+            rest = list(rest)
+            kr = rest.pop(0) if have_rows else None
+            po = rest.pop(0) if have_offs else None
+            return paged_attention(q, kp, vp, bt, sl, sm_scale=sm_scale,
+                                   impl=impl, q_rows=kr, window=window,
+                                   page_offsets=po)
+
+        return shard_map(body, mesh=mesh, in_specs=tuple(in_specs),
+                         out_specs=specs["out"], check_vma=False)
+
+    @jax.jit
+    def _run(q, k_pages, v_pages, block_tables, seq_lens,
+             q_rows=None, page_offsets=None):
+        fn = _make(q.ndim == 4, q_rows is not None,
+                   page_offsets is not None)
+        args = [q, k_pages, v_pages, block_tables, seq_lens]
+        if q_rows is not None:
+            args.append(jnp.asarray(q_rows, jnp.int32))
+        if page_offsets is not None:
+            args.append(jnp.asarray(page_offsets, jnp.int32))
+        return fn(*args)
+
+    def run(q, k_pages, v_pages, block_tables, seq_lens,
+            q_rows=None, page_offsets=None):
+        B = q.shape[0]
+        H = q.shape[2] if q.ndim == 4 else q.shape[1]
+        if B % dp_size:
+            raise ValueError(f"batch {B} not divisible by "
+                             f"{data_axis}={dp_size}")
+        if H % tp_size:
+            raise ValueError(f"heads {H} not divisible by "
+                             f"{model_axis}={tp_size}")
+        return _run(q, k_pages, v_pages,
+                    jnp.asarray(block_tables, jnp.int32),
+                    jnp.asarray(seq_lens, jnp.int32),
+                    q_rows=q_rows, page_offsets=page_offsets)
+
+    return run
+
+
 def _program_specs(axis: Optional[str]) -> MaskPrograms:
     """PartitionSpec pytree for per-head schedule operands: the head
     row axis shards over ``axis``; the bitmap pool replicates (ids are

@@ -488,10 +488,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "LocalMask(1024) vs the dense-causal flash "
                         "path, interleaved A/B")
     p.add_argument("--scenario", default=None,
-                   choices=("window", "beam", "spec"),
+                   choices=("window", "beam", "spec", "decode",
+                            "migrate"),
                    help="with --decode: run one decode fast-path "
                         "scenario's legs only (sliding-window t8192 "
-                        "A/B, beam fanout, speculative k=4)")
+                        "A/B, beam fanout, speculative k=4); with "
+                        "--cluster: decode (disaggregated prefill/"
+                        "decode A/B) or migrate (drain-with-migration "
+                        "vs step-0 re-admission)")
     p.add_argument("--only", default=None,
                    help="comma-separated bench_id subset, or 'gated' for "
                         "exactly the perf_smoke-gated benches")
@@ -517,10 +521,20 @@ def main(argv: Optional[List[str]] = None) -> int:
         only = (set(gated) if args.only == "gated"
                 else set(args.only.split(",")))
     if args.scenario:
-        if not args.decode:
-            p.error("--scenario requires --decode")
-        from tosem_tpu.serve.bench_decode import SCENARIO_BENCHES
-        scen = set(SCENARIO_BENCHES[args.scenario])
+        if args.cluster:
+            from tosem_tpu.serve.bench_cluster import CLUSTER_SCENARIOS
+            if args.scenario not in CLUSTER_SCENARIOS:
+                p.error(f"--scenario={args.scenario} is not a "
+                        "--cluster scenario (choose decode|migrate)")
+            scen = set(CLUSTER_SCENARIOS[args.scenario])
+        elif args.decode:
+            from tosem_tpu.serve.bench_decode import SCENARIO_BENCHES
+            if args.scenario not in SCENARIO_BENCHES:
+                p.error(f"--scenario={args.scenario} is not a "
+                        "--decode scenario (choose window|beam|spec)")
+            scen = set(SCENARIO_BENCHES[args.scenario])
+        else:
+            p.error("--scenario requires --decode or --cluster")
         only = scen if only is None else (only & scen)
     if args.serve:
         from tosem_tpu.serve.bench_serve import run_serve_benchmarks

@@ -18,8 +18,10 @@ per bucket and make results independent of batching decisions.
 """
 from __future__ import annotations
 
+import collections
 import dataclasses
 import threading
+import time
 from typing import Any, Dict, List, Optional, Sequence
 
 from tosem_tpu.serve.compile_cache import (DEFAULT_COMPILE_CACHE,
@@ -230,14 +232,19 @@ class _DecodeSeq:
     returns its recorded outcome without touching the cache, so the
     PR-2 at-least-once actor replay can never double-apply a step."""
 
-    __slots__ = ("tokens", "prompt_len", "next_step", "done", "outcomes")
+    __slots__ = ("tokens", "prompt_len", "next_step", "done", "outcomes",
+                 "budget")
 
-    def __init__(self, tokens: List[int], prompt_len: int):
+    def __init__(self, tokens: List[int], prompt_len: int,
+                 budget: Optional[int] = None):
         self.tokens = tokens
         self.prompt_len = prompt_len
         self.next_step = 0
         self.done = False
         self.outcomes: List[Dict[str, Any]] = []
+        # per-request new-token budget (the request-level max_tokens
+        # knob); None = the backend's max_new_tokens cap
+        self.budget = budget
 
 
 class NGramDrafter:
@@ -302,10 +309,10 @@ class _DecodeGroup:
 
     __slots__ = ("beams", "prompt_len", "beam", "n", "temperature",
                  "seed", "next_step", "done", "outcomes", "forks",
-                 "admit_token")
+                 "admit_token", "budget")
 
     def __init__(self, n: int, beam: bool, temperature: float, seed: int,
-                 prompt_len: int):
+                 prompt_len: int, budget: Optional[int] = None):
         self.beams: List[_Beam] = []
         self.prompt_len = prompt_len
         self.beam = beam
@@ -320,6 +327,7 @@ class _DecodeGroup:
         # beams[0].tokens wholesale, so a replayed admit must not
         # recompute its answer from mutable beam state
         self.admit_token: int = -1
+        self.budget = budget
 
 
 class _RowPlan:
@@ -380,20 +388,31 @@ class BertDecodeBackend(CompiledBackendMixin):
       branches always feed one token per step (no draft composition).
     """
 
+    # consecutive pressured (token-less) retries a self-driven call()
+    # tolerates before failing typed — concurrent calls retire in well
+    # under 2000 x 5 ms; a lone sequence that still can't get a page
+    # after 10 s never will
+    CALL_PRESSURE_LIMIT = 2000
+
     def __init__(self, preset: str = "tiny", seed: int = 0,
                  max_batch: int = 8, max_len: int = 128,
                  page_size: Optional[int] = None, num_pages: int = 64,
                  max_new_tokens: int = 16, eos_id: Optional[int] = None,
                  impl: Optional[str] = None,
-                 window: Optional[int] = None, spec_k: int = 0):
+                 window: Optional[int] = None, spec_k: int = 0,
+                 dim: int = 32, heads: int = 2, layers: int = 2,
+                 mlp_dim: int = 64):
         import jax
         from tosem_tpu.models.bert import Bert, BertConfig
         from tosem_tpu.ops.flash_blocks import select_page_size
         if preset == "base":
             cfg = BertConfig.base()
         else:
-            cfg = BertConfig(vocab_size=128, max_len=max_len, dim=32,
-                             heads=2, layers=2, mlp_dim=64, dropout=0.0)
+            # tiny topology by default; dim/heads/layers/mlp_dim widen
+            # it (the cluster-decode bench runs a heavier prefill)
+            cfg = BertConfig(vocab_size=128, max_len=max_len, dim=dim,
+                             heads=heads, layers=layers,
+                             mlp_dim=mlp_dim, dropout=0.0)
         self.cfg = cfg
         self.max_batch = max_batch
         self.max_new_tokens = max_new_tokens
@@ -446,6 +465,13 @@ class BertDecodeBackend(CompiledBackendMixin):
                                   head_dim=head_dim, dtype=cfg.dtype)
         self._seqs: Dict[Any, _DecodeSeq] = {}
         self._groups: Dict[Any, _DecodeGroup] = {}
+        # handoff-admit ledger: a sequence exported/streamed away at
+        # admit leaves no _seqs entry, so the at-least-once replay
+        # guard can't see it — this bounded memo stops a replayed
+        # admit from re-prefilling and re-sending (export replays drop
+        # the state; the scheduler's fallback re-admits from step 0)
+        self._handed: "collections.OrderedDict" = \
+            collections.OrderedDict()
         self._spec_proposed = 0
         self._spec_accepted = 0
         self._lock = threading.RLock()
@@ -482,7 +508,8 @@ class BertDecodeBackend(CompiledBackendMixin):
                 fused, [((1, pad_to), np.int32), ((1, pad_to), np.int32),
                         (tuple(pool.shape), pool.dtype),
                         (tuple(pool.shape), pool.dtype),
-                        ((pad_to,), np.int32), ((pad_to,), np.int32)]))
+                        ((pad_to,), np.int32), ((pad_to,), np.int32)],
+                donate_argnums=(2, 3)))
 
     def _step_compiled(self):
         import numpy as np
@@ -499,14 +526,16 @@ class BertDecodeBackend(CompiledBackendMixin):
                      (tuple(pool.shape), pool.dtype),
                      (tuple(pool.shape), pool.dtype),
                      ((B, self.table_w), np.int32), ((B,), np.int32),
-                     ((B,), np.int32), ((B,), np.int32)]))
+                     ((B,), np.int32), ((B,), np.int32)],
+                    donate_argnums=(2, 3)))
         return DEFAULT_COMPILE_CACHE.get_or_build(
             key, lambda: aot_compile(
                 self._step,
                 [((B,), np.int32), ((B,), np.int32),
                  (tuple(pool.shape), pool.dtype),
                  (tuple(pool.shape), pool.dtype),
-                 ((B, self.table_w), np.int32), ((B,), np.int32)]))
+                 ((B, self.table_w), np.int32), ((B,), np.int32)],
+                donate_argnums=(2, 3)))
 
     def warmup(self, shapes: Sequence[int]) -> Dict[str, Any]:
         """``shapes`` is the prompt-bucket palette (page multiples);
@@ -544,7 +573,19 @@ class BertDecodeBackend(CompiledBackendMixin):
         return np.asarray(logits, np.float32)[0, T - 1]
 
     def _finished(self, seq: _DecodeSeq, token: int) -> bool:
-        return self._finished_at(len(seq.tokens), seq.prompt_len, token)
+        return self._finished_at(len(seq.tokens), seq.prompt_len, token,
+                                 budget=seq.budget)
+
+    def _budget_of(self, request: Dict[str, Any]) -> Optional[int]:
+        """Per-request new-token budget (``{"max_new_tokens": n}``),
+        clamped by the backend cap; poison values fail the request."""
+        raw = request.get("max_new_tokens")
+        if raw is None:
+            return None
+        n = int(raw)
+        if n < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {n}")
+        return min(n, self.max_new_tokens)
 
     def _validate_ids(self, ids: List[int]) -> None:
         if not ids:
@@ -565,7 +606,9 @@ class BertDecodeBackend(CompiledBackendMixin):
         ``first_pos`` formula the kernel's page schedule uses."""
         return max(tokens_len - self.window, 0)
 
-    def admit(self, seq_id, request: Dict[str, Any]) -> Dict[str, Any]:
+    def admit(self, seq_id, request: Dict[str, Any],
+              export: bool = False,
+              send_to: Optional[str] = None) -> Dict[str, Any]:
         """Validate, allocate pages, prefill, sample the first token.
         Raises :class:`~tosem_tpu.serve.kv_cache.CachePressure` (pool
         full — nothing allocated) or ``ValueError`` (poison request —
@@ -573,18 +616,42 @@ class BertDecodeBackend(CompiledBackendMixin):
         sequence returns its recorded outcome. A request with ``n > 1``
         admits an N-branch group (beam search with ``beam=True``,
         parallel sampling otherwise) whose branches COW-share the
-        prompt pages — it occupies ``n`` rows of every decode step."""
+        prompt pages — it occupies ``n`` rows of every decode step.
+
+        ``export=True`` and ``send_to=<address>`` are the PREFILL-TIER
+        contracts (disaggregated prefill/decode), resolved at admit
+        time so a handoff can never queue behind the next prompt's
+        prefill on this actor's FIFO: ``export`` returns the freshly-
+        prefilled state inline (``"state"``), ``send_to`` streams the
+        pages DIRECTLY to the destination replica's tensor receiver
+        (worker→worker, no driver hop; the outcome carries only
+        ``"sent": True`` and the destination adopts by sequence id).
+        Either way this replica releases its copy."""
         import numpy as np
         with self._lock:
+            if seq_id in self._handed:    # replayed handoff admit
+                return dict(self._handed[seq_id])
             n = int(request.get("n", 1) or 1)
             if n > 1:
-                return self._admit_group(seq_id, request, n)
+                out = self._admit_group(seq_id, request, n)
+                if not out.get("done"):
+                    if export:
+                        out["state"] = self.export_seq(seq_id)
+                        self.release(seq_id)
+                        self._record_handoff(seq_id, out)
+                    elif send_to:
+                        self.send_seq(seq_id, send_to)
+                        self.release(seq_id)
+                        out["sent"] = True
+                        self._record_handoff(seq_id, out)
+                return out
             if seq_id in self._seqs:          # at-least-once replay
                 seq = self._seqs[seq_id]
                 return {"token": seq.tokens[seq.prompt_len],
                         "done": seq.done and seq.next_step == 0}
             ids = list(request["ids"])
             self._validate_ids(ids)
+            budget = self._budget_of(request)   # may raise: fails alone
             self.cache.create(seq_id)
             try:
                 self.cache.extend(seq_id, len(ids))
@@ -594,7 +661,7 @@ class BertDecodeBackend(CompiledBackendMixin):
                 raise
             token = int(np.argmax(last))
             seq = _DecodeSeq(tokens=ids + [token],
-                             prompt_len=len(ids))
+                             prompt_len=len(ids), budget=budget)
             seq.done = self._finished(seq, token)
             if self.window is not None:
                 self.cache.release_below(
@@ -605,7 +672,26 @@ class BertDecodeBackend(CompiledBackendMixin):
                 # final payload rides the outcome: retiring a sequence
                 # costs the scheduler zero extra round trips
                 out["result"] = self._result_locked(seq)
+            elif export:
+                out["state"] = self.export_seq(seq_id)
+                self.release(seq_id)
+                self._record_handoff(seq_id, out)
+            elif send_to:
+                self.send_seq(seq_id, send_to)
+                self.release(seq_id)
+                out["sent"] = True
+                self._record_handoff(seq_id, out)
             return out
+
+    def _record_handoff(self, seq_id, out: Dict[str, Any]) -> None:
+        """Memoize a handoff admit's outcome (bounded FIFO). Export
+        outcomes drop their ``state`` — memoizing page bytes would pin
+        hundreds of MB; a replay without state falls back to step-0
+        re-admission, which is correct by determinism."""
+        memo = {k: v for k, v in out.items() if k != "state"}
+        self._handed[seq_id] = memo
+        while len(self._handed) > 512:
+            self._handed.popitem(last=False)
 
     def _admit_group(self, seq_id, request: Dict[str, Any],
                      n: int) -> Dict[str, Any]:
@@ -622,7 +708,8 @@ class BertDecodeBackend(CompiledBackendMixin):
         group = _DecodeGroup(
             n=n, beam=bool(request.get("beam", False)),
             temperature=float(request.get("temperature", 1.0) or 1.0),
-            seed=int(request.get("seed", 0) or 0), prompt_len=len(ids))
+            seed=int(request.get("seed", 0) or 0), prompt_len=len(ids),
+            budget=self._budget_of(request))
         root = f"{seq_id}#0"
         self.cache.create(root)
         try:
@@ -677,10 +764,11 @@ class BertDecodeBackend(CompiledBackendMixin):
         return int(rng.choice(len(p), p=p))
 
     def _finished_at(self, n_tokens: int, prompt_len: int,
-                     token: int) -> bool:
+                     token: int, budget: Optional[int] = None) -> bool:
         gen = n_tokens - prompt_len
+        cap = budget if budget is not None else self.max_new_tokens
         return (token == self.eos_id if self.eos_id is not None
-                else False) or gen >= self.max_new_tokens \
+                else False) or gen >= cap \
             or n_tokens >= self.cfg.max_len
 
     def step_batch(self, seq_ids: List[Any],
@@ -719,6 +807,13 @@ class BertDecodeBackend(CompiledBackendMixin):
                 lo = len(plans)
                 if sid in self._groups:
                     out = self._plan_group(sid, step, plans)
+                elif sid not in self._seqs:
+                    # a streamed handoff whose adopt has not landed
+                    # yet (the scheduler activates on the admit
+                    # outcome and relies on actor FIFO; a pressured
+                    # adopt parks the payload): ride this row as
+                    # inactive, the scheduler retries the same step
+                    out = {"pending": True}
                 else:
                     out = self._plan_seq(sid, step, plans)
                 outcomes.append(out)
@@ -875,7 +970,8 @@ class BertDecodeBackend(CompiledBackendMixin):
         a finished branch retires its cache NOW (refcount rollback —
         shared prefix pages survive for its siblings); a live windowed
         branch evicts below its floor."""
-        if self._finished_at(len(b.tokens), g.prompt_len, b.tokens[-1]):
+        if self._finished_at(len(b.tokens), g.prompt_len, b.tokens[-1],
+                             budget=g.budget):
             b.done = True
             self.cache.free(b.cid)
         elif self.window is not None:
@@ -1088,6 +1184,248 @@ class BertDecodeBackend(CompiledBackendMixin):
                 self.cache.free(cid)
                 raise
 
+    # ------------------------------------------------------ live migration
+    #
+    # The decode-migration surface: a sequence (or branch group) moves
+    # between replicas MID-DECODE and continues from the CURRENT step —
+    # the bytes are the kv_cache wire format (validated header), the
+    # bookkeeping (token history, step-outcome ledger) rides alongside,
+    # and the (seq, step) ledger makes a migration racing an in-flight
+    # step idempotent: a step committed on the source just before
+    # export is replayed from the imported ledger on the destination.
+
+    def list_seqs(self) -> List[Any]:
+        """Request ids currently holding replica-side decode state —
+        what a draining node must evacuate. Self-driven ``call()``
+        sequences are EXCLUDED: their driving thread lives on this
+        replica, so a migrated copy would never be stepped or released
+        (the router re-admits the in-flight call instead)."""
+        with self._lock:
+            return sorted(
+                [s for s in list(self._seqs) + list(self._groups)
+                 if not str(s).startswith("__call__/")], key=str)
+
+    def export_seq(self, seq_id) -> Dict[str, Any]:
+        """Full migratable state of one request: decode bookkeeping
+        plus each live branch's KV payload (spilled branches export
+        their stored payload — migration composes with mid-spill).
+        Source state is UNCHANGED: the caller releases it here only
+        after the destination import succeeded."""
+        with self._lock:
+            if seq_id in self._groups:
+                g = self._groups[seq_id]
+                return {
+                    "kind": "group", "n": g.n, "beam": g.beam,
+                    "temperature": g.temperature, "seed": g.seed,
+                    "prompt_len": g.prompt_len,
+                    "next_step": g.next_step, "done": g.done,
+                    "outcomes": list(g.outcomes), "forks": g.forks,
+                    "admit_token": g.admit_token, "budget": g.budget,
+                    "branches": [{
+                        "cid": b.cid, "tokens": list(b.tokens),
+                        "logprob": b.logprob, "done": b.done,
+                        "kv": (None if b.done
+                               else self.cache.export_seq(b.cid)),
+                    } for b in g.beams],
+                }
+            seq = self._seqs[seq_id]
+            return {"kind": "seq", "tokens": list(seq.tokens),
+                    "prompt_len": seq.prompt_len,
+                    "next_step": seq.next_step, "done": seq.done,
+                    "outcomes": list(seq.outcomes),
+                    "budget": seq.budget,
+                    "kv": self.cache.export_seq(seq_id)}
+
+    def import_seq(self, seq_id, state: Dict[str, Any]) -> None:
+        """Adopt an exported request. All-or-nothing: a KV header
+        mismatch raises :class:`~tosem_tpu.serve.kv_cache.KVWireError`
+        and :class:`~tosem_tpu.serve.kv_cache.CachePressure` (pool
+        full) leaves nothing changed — including mid-group rollback, so
+        a half-imported branch set can never leak pages. Idempotent per
+        sequence id (at-least-once actor replay)."""
+        with self._lock:
+            if seq_id in self._seqs or seq_id in self._groups:
+                return                    # at-least-once replay
+            if state.get("kind") == "seq":
+                self.cache.import_seq(seq_id, state["kv"])
+                seq = _DecodeSeq(list(state["tokens"]),
+                                 int(state["prompt_len"]),
+                                 budget=state.get("budget"))
+                seq.next_step = int(state["next_step"])
+                seq.done = bool(state["done"])
+                seq.outcomes = list(state["outcomes"])
+                self._seqs[seq_id] = seq
+                return
+            if state.get("kind") != "group":
+                raise ValueError(
+                    f"unknown decode-state kind {state.get('kind')!r}")
+            imported: List[Any] = []
+            try:
+                for br in state["branches"]:
+                    if not br["done"]:
+                        self.cache.import_seq(br["cid"], br["kv"])
+                        imported.append(br["cid"])
+            except BaseException:
+                for cid in imported:
+                    self.cache.free(cid)
+                raise
+            g = _DecodeGroup(n=int(state["n"]), beam=bool(state["beam"]),
+                             temperature=float(state["temperature"]),
+                             seed=int(state["seed"]),
+                             prompt_len=int(state["prompt_len"]),
+                             budget=state.get("budget"))
+            g.next_step = int(state["next_step"])
+            g.done = bool(state["done"])
+            g.outcomes = list(state["outcomes"])
+            g.forks = int(state["forks"])
+            g.admit_token = int(state["admit_token"])
+            for br in state["branches"]:
+                beam = _Beam(br["cid"], list(br["tokens"]),
+                             float(br["logprob"]))
+                beam.done = bool(br["done"])
+                g.beams.append(beam)
+            self._groups[seq_id] = g
+
+    # node→node transport path: page bytes stream replica→replica over
+    # cluster/transport.py (no driver hop); only the tiny control calls
+    # (addresses, adopt) ride the RPC plane.
+
+    def transport_address(self) -> str:
+        """Lazily start this replica's TensorReceiver; returns its
+        address (what a migration source streams to)."""
+        with self._lock:
+            if getattr(self, "_receiver", None) is None:
+                from tosem_tpu.cluster.transport import TensorReceiver
+                self._receiver = TensorReceiver()
+            return self._receiver.address
+
+    @staticmethod
+    def _strip_kv(state: Dict[str, Any]):
+        """Split an exported state into (JSON-safe meta, arrays): each
+        branch's page arrays move to the chunked binary path, its wire
+        header stays in the metadata."""
+        arrays: Dict[str, Any] = {}
+        meta = dict(state)
+        if state.get("kind") == "seq":
+            kv = state["kv"]
+            arrays["k0"], arrays["v0"] = kv["k"], kv["v"]
+            meta["kv"] = {"header": kv["header"]}
+        else:
+            branches = []
+            for i, br in enumerate(state["branches"]):
+                br = dict(br)
+                if br.get("kv") is not None:
+                    kv = br["kv"]
+                    arrays[f"k{i}"], arrays[f"v{i}"] = kv["k"], kv["v"]
+                    br["kv"] = {"header": kv["header"], "slot": i}
+                branches.append(br)
+            meta["branches"] = branches
+        return meta, arrays
+
+    def send_seq(self, seq_id, address: str) -> int:
+        """Stream one request's state to a peer replica's receiver —
+        spill-format bytes on the wire, the decode bookkeeping in the
+        stream metadata. Returns payload bytes sent; the source keeps
+        its copy until the peer's ``adopt_seq`` confirms."""
+        from tosem_tpu.cluster.transport import send_tensors
+        state = self.export_seq(seq_id)
+        meta, arrays = self._strip_kv(state)
+        return send_tensors(address, {"key": f"seq:{seq_id}",
+                                      "decode_state": meta}, arrays)
+
+    def adopt_seq(self, seq_id, timeout: float = 30.0) -> None:
+        """Import the stream :meth:`send_seq` delivered for
+        ``seq_id``: rebuild the payloads from the mapped receive
+        buffer (the scatter into this pool is the only copy off the
+        wire) and register the sequence — decode continues from the
+        exported step."""
+        with self._lock:
+            receiver = getattr(self, "_receiver", None)
+        if receiver is None:
+            raise RuntimeError("transport_address() was never called "
+                               "on this replica")
+        from tosem_tpu.serve.kv_cache import CachePressure
+        rx = receiver.pop(f"seq:{seq_id}", timeout=timeout)
+        try:
+            state = dict(rx.meta["decode_state"])
+            arrs = rx.arrays()
+            if state.get("kind") == "seq":
+                state["kv"] = {"header": state["kv"]["header"],
+                               "k": arrs["k0"], "v": arrs["v0"],
+                               "length": state["kv"]["header"]["length"],
+                               "released":
+                               state["kv"]["header"]["page_offset"]}
+            else:
+                branches = []
+                for br in state["branches"]:
+                    br = dict(br)
+                    if br.get("kv") is not None:
+                        i = int(br["kv"]["slot"])
+                        hdr = br["kv"]["header"]
+                        br["kv"] = {"header": hdr, "k": arrs[f"k{i}"],
+                                    "v": arrs[f"v{i}"],
+                                    "length": hdr["length"],
+                                    "released": hdr["page_offset"]}
+                    branches.append(br)
+                state["branches"] = branches
+            self.import_seq(seq_id, state)
+        except CachePressure:
+            # transient: park the stream back on the receiver so a
+            # retried adopt does not re-pay the transfer
+            receiver.put_back(f"seq:{seq_id}", rx)
+            raise
+        except BaseException:
+            rx.release()
+            raise
+        else:
+            rx.release()
+
+    # ---------------------------------------------- synchronous decode
+
+    def call(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        """Self-driven single-request decode (admit → step loop →
+        result), the generic serve/router backend contract — what a
+        cluster-plane decode deployment serves per routed request.
+        The scheduler-driven protocol above stays the fast path."""
+        with self._lock:
+            self._call_n = getattr(self, "_call_n", 0) + 1
+            sid = f"__call__/{self._call_n}"
+        out = self.admit(sid, request)
+        step = 0
+        stalls = 0
+        try:
+            while not out.get("done"):
+                out = self.step_batch([sid], [step])[0]
+                if out.get("pressure"):
+                    # concurrent calls hold pages; theirs free as they
+                    # retire — retry the SAME step (nothing applied).
+                    # Bounded like the scheduler's PRESSURE_STALL_LIMIT:
+                    # a pool that can never fit this sequence (nobody
+                    # else holds pages to free) must fail typed, not
+                    # pin the RPC handler thread forever
+                    stalls += 1
+                    if stalls > self.CALL_PRESSURE_LIMIT:
+                        from tosem_tpu.serve.kv_cache import \
+                            CachePressure
+                        raise CachePressure(
+                            f"sequence {sid} made no progress in "
+                            f"{self.CALL_PRESSURE_LIMIT} pressured "
+                            "retries — pool too small for this "
+                            "sequence plus resident state")
+                    time.sleep(0.005)
+                    continue
+                stalls = 0
+                if out.get("pending"):
+                    # the sequence vanished mid-call (released out from
+                    # under us): fail typed, never busy-loop
+                    raise RuntimeError(
+                        f"sequence {sid} no longer lives on this "
+                        "replica (released mid-call)")
+                step += 1
+            return out.get("result") or self.result(sid)
+        finally:
+            self.release(sid)
+
     def cache_stats(self) -> Dict[str, int]:
         out = dict(self.cache.stats())
         with self._lock:
@@ -1105,6 +1443,106 @@ class BertDecodeBackend(CompiledBackendMixin):
 
 # ---------------------------------------------------------------------------
 # sharded replicas (cluster serving plane)
+
+
+class ShardedPagedDecodeBackend:
+    """Sharded DECODE replica: one logical replica running paged
+    decode attention over a dp×tp mesh — the cluster serving plane's
+    generative counterpart to :class:`ShardedAttentionBackend`.
+
+    The process boots with ``dp*tp`` virtual devices pinned
+    (``ClusterServe.deploy(sharding=(dp, tp))``), builds the
+    conventional mesh, and answers requests through
+    :func:`~tosem_tpu.parallel.flash.sharded_paged_attention`: KV
+    pools sharded over the model axis (each chip owns its heads' slice
+    of every page), decode batch over dp, block tables/seq lens
+    following the batch. Requests are ``{"seed": int[, "q_tokens": k,
+    "offsets": bool]}`` — the replica derives a deterministic paged
+    workload (pools, ragged block tables, seq lens) from the seed, so
+    :meth:`reference` computes the SAME inputs through the unsharded
+    kernel and the cluster bench pins the two **bit-identical**
+    (decode attention reduces only within a (batch row, head) cell;
+    sharding splits batch and heads, never a reduction axis)."""
+
+    def __init__(self, dp: int = 1, tp: int = 1, batch: int = 4,
+                 heads: int = 4, head_dim: int = 16, pages: int = 16,
+                 page_size: int = 8, table_w: int = 4,
+                 window: Optional[int] = None):
+        from tosem_tpu.parallel.flash import (dp_tp_mesh,
+                                              sharded_paged_attention)
+        if batch % dp:
+            raise ValueError(f"batch={batch} not divisible by dp={dp}")
+        if heads % tp:
+            raise ValueError(f"heads={heads} not divisible by tp={tp}")
+        self.dp, self.tp = dp, tp
+        self.dims = dict(batch=batch, heads=heads, head_dim=head_dim,
+                         pages=pages, page_size=page_size,
+                         table_w=table_w)
+        self.window = window
+        self._mesh = dp_tp_mesh(dp, tp)
+        self._run = sharded_paged_attention(self._mesh, window=window)
+
+    @staticmethod
+    def _workload(req_seed: int, *, batch, heads, head_dim, pages,
+                  page_size, table_w, q_tokens=0, offsets=False):
+        """Deterministic paged-decode inputs — a pure function of the
+        seed, byte-equal wherever it is computed."""
+        import numpy as np
+        rng = np.random.default_rng(0xDEC0DE + req_seed)
+        if q_tokens:
+            q = rng.standard_normal((batch, q_tokens, heads, head_dim)
+                                    ).astype(np.float32)
+        else:
+            q = rng.standard_normal((batch, heads, head_dim)
+                                    ).astype(np.float32)
+        kp = rng.standard_normal((pages, page_size, heads, head_dim)
+                                 ).astype(np.float32)
+        vp = rng.standard_normal((pages, page_size, heads, head_dim)
+                                 ).astype(np.float32)
+        bt = rng.integers(0, pages, (batch, table_w)).astype(np.int32)
+        po = (rng.integers(0, 2, (batch,)).astype(np.int32)
+              if offsets else None)
+        lo = 1 if not q_tokens else max(q_tokens, 1)
+        sl = rng.integers(lo, table_w * page_size + 1,
+                          (batch,)).astype(np.int32)
+        if po is not None:
+            sl = np.minimum(sl + po * page_size,
+                            (po + table_w) * page_size).astype(np.int32)
+        kr = (rng.integers(1, q_tokens + 1, (batch,)).astype(np.int32)
+              if q_tokens else None)
+        return q, kp, vp, bt, sl, kr, po
+
+    def call(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        import numpy as np
+        q, kp, vp, bt, sl, kr, po = self._workload(
+            int(request.get("seed", 0)), **self.dims,
+            q_tokens=int(request.get("q_tokens", 0) or 0),
+            offsets=bool(request.get("offsets", False)))
+        out = self._run(q, kp, vp, bt, sl, q_rows=kr, page_offsets=po)
+        return {"out": np.asarray(out), "mesh": [self.dp, self.tp],
+                "devices": int(np.prod(self._mesh.devices.shape))}
+
+    def warmup(self, shapes: Sequence) -> Dict[str, Any]:
+        self.call({"seed": 0})
+        return {"warmed": 1}
+
+    @classmethod
+    def reference(cls, request: Dict[str, Any],
+                  window: Optional[int] = None, **dims):
+        """Single-process reference on the same inputs — what a dp×tp
+        response must match bit for bit."""
+        import numpy as np
+        from tosem_tpu.ops.paged_attention import paged_attention
+        full = dict(batch=4, heads=4, head_dim=16, pages=16,
+                    page_size=8, table_w=4)
+        full.update(dims)
+        q, kp, vp, bt, sl, kr, po = cls._workload(
+            int(request.get("seed", 0)), **full,
+            q_tokens=int(request.get("q_tokens", 0) or 0),
+            offsets=bool(request.get("offsets", False)))
+        return np.asarray(paged_attention(
+            q, kp, vp, bt, sl, q_rows=kr, window=window,
+            page_offsets=po))
 
 
 class ShardedAttentionBackend:

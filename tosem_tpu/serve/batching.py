@@ -603,16 +603,29 @@ class DecodePolicy:
     dimension of the compiled step program). ``idle_wait_s`` is the
     scheduler's sleep when admission is blocked but work remains (page
     pressure with nothing retiring yet). ``sampling`` sets the default
-    :class:`SamplingPolicy` merged into every request."""
+    :class:`SamplingPolicy` merged into every request.
+
+    ``prefill_replicas`` turns on prefill/decode DISAGGREGATION: the
+    deployment's first N replicas become prefill-only — admissions run
+    on them ASYNCHRONOUSLY (the scheduler keeps stepping decode
+    replicas while prompts prefill elsewhere, so a burst of long
+    prompts never stalls in-flight token streams), and each prefilled
+    sequence's KV pages migrate to a decode replica over the live-KV-
+    migration path before its first step. Requires a backend with the
+    migration surface (``export_seq``/``import_seq``); the remaining
+    replicas serve decode steps."""
     max_active: int = 8
     idle_wait_s: float = 0.01
     sampling: Optional[SamplingPolicy] = None
+    prefill_replicas: int = 0
 
     def __post_init__(self):
         if self.max_active < 1:
             raise ValueError("max_active must be >= 1")
         if self.idle_wait_s < 0:
             raise ValueError("idle_wait_s must be >= 0")
+        if self.prefill_replicas < 0:
+            raise ValueError("prefill_replicas must be >= 0")
         if self.sampling is not None and self.sampling.n > self.max_active:
             raise ValueError(
                 f"sampling.n={self.sampling.n} exceeds max_active="
@@ -630,6 +643,8 @@ class _DecodeItem:
     attempts: int = 0                # transport-failure re-admissions spent
     stalls: int = 0                  # consecutive page-pressured steps
     slots: int = 1                   # step rows this item packs (group: n)
+    prefill_state: Any = None        # exported state awaiting a decode slot
+    src_replica: Any = None          # prefill replica while admitting
     enqueued_at: float = field(default_factory=time.monotonic)
 
 
@@ -687,8 +702,35 @@ class DecodeQueue:
         self._seqs_err = 0
         self._spills = 0
         self._restores = 0
+        self._migrations = 0
+        self._migration_fallbacks = 0
+        self._readmit_step0 = 0
+        # disaggregated-prefill state: (item, admit ref) pairs in
+        # flight on the prefill tier, prefilled sequences waiting for a
+        # decode-replica slot, and (item, import ref, t0) handoffs in
+        # flight on the decode tier — every phase is ASYNC so the
+        # scheduler loop only ever blocks on step dispatches
+        self._prefilling: List[Tuple[_DecodeItem, Any]] = []
+        self._prefilled: collections.deque = collections.deque()
+        self._importing: List[Tuple[_DecodeItem, Any, float]] = []
+        # decode-replica tensor-receiver addresses, fetched once per
+        # replica (the worker→worker page-stream destinations)
+        self._transport_addrs: Dict[int, str] = {}
+        self._can_stream = (hasattr(deployment.backend_cls, "send_seq")
+                            and hasattr(deployment.backend_cls,
+                                        "transport_address"))
         self._cache_stats: Dict[str, Any] = {}
         self._can_spill = hasattr(deployment.backend_cls, "spill_seq")
+        self._can_migrate = hasattr(deployment.backend_cls, "export_seq")
+        if policy.prefill_replicas and not self._can_migrate:
+            raise ValueError(
+                "prefill_replicas requires a backend with the "
+                "migration surface (export_seq/import_seq)")
+        # serializes live migration against the step loop: an exported
+        # sequence must never receive a step on its OLD replica after
+        # the source copy was released (RLock — the chaos hook drains
+        # from the scheduler thread itself)
+        self._mig_lock = threading.RLock()
         self._metrics = serve_metrics()
         self._last_scrape = 0.0
         self._thread = threading.Thread(
@@ -734,11 +776,13 @@ class DecodeQueue:
         return item.future
 
     def depth(self) -> int:
-        """Demand signal: queued + active + spilled sequences (every
-        sequence the data plane still owes a completion)."""
+        """Demand signal: queued + active + spilled + prefilling
+        sequences (every sequence the data plane still owes a
+        completion)."""
         with self._lock:
             return (len(self._pending) + len(self._active)
-                    + len(self._waiting))
+                    + len(self._waiting) + len(self._prefilling)
+                    + len(self._prefilled) + len(self._importing))
 
     def replica_loads(self) -> Dict[int, int]:
         """Per-replica step-row counts keyed ``id(replica)`` — the
@@ -749,9 +793,21 @@ class DecodeQueue:
         live sequences."""
         with self._lock:
             counts: Dict[int, int] = {}
-            for it in self._active + self._waiting:
+            for it in (self._active + self._waiting
+                       + [p for p, _ in self._prefilling]
+                       + [p for p, _, _ in self._importing]
+                       + list(self._prefilled)):
                 counts[id(it.replica)] = (counts.get(id(it.replica), 0)
                                           + it.slots)
+            # a streamed admit sets .replica to the decode DESTINATION;
+            # the prefill itself runs on src_replica — charge it there
+            # too, or _launch_prefills sees every prefill replica as
+            # idle and piles the whole tier onto index 0
+            for it, _ in self._prefilling:
+                if (it.src_replica is not None
+                        and it.src_replica is not it.replica):
+                    counts[id(it.src_replica)] = (
+                        counts.get(id(it.src_replica), 0) + it.slots)
             return counts
 
     def stats(self) -> Dict[str, Any]:
@@ -766,6 +822,11 @@ class DecodeQueue:
                 "sequences_err": self._seqs_err,
                 "kv_spills": self._spills,
                 "kv_restores": self._restores,
+                "kv_migrations": self._migrations,
+                "kv_migration_fallbacks": self._migration_fallbacks,
+                "seqs_readmitted_step0": self._readmit_step0,
+                "prefilling_sequences": len(self._prefilling)
+                + len(self._prefilled),
                 "scheduler_loop_errors": self._loop_errors,
             }
             out.update({f"kv_{k}": v
@@ -777,10 +838,16 @@ class DecodeQueue:
             self._closed = True
             self._close_error = error
             doomed = (list(self._pending) + list(self._active)
-                      + list(self._waiting))
+                      + list(self._waiting)
+                      + [p for p, _ in self._prefilling]
+                      + [p for p, _, _ in self._importing]
+                      + list(self._prefilled))
             self._pending.clear()
             self._active = []
             self._waiting = []
+            self._prefilling = []
+            self._importing = []
+            self._prefilled.clear()
             self._cv.notify_all()
         from tosem_tpu.runtime.common import ActorDiedError
         exc = error or ActorDiedError(
@@ -848,12 +915,29 @@ class DecodeQueue:
                     return i
         return 0
 
-    def _pick_replica(self, slots: int = 1) -> Optional[Any]:
-        """Least-loaded replica with ``slots`` free decode rows, by THIS
-        queue's own row counts (active + spilled both hold replica-side
-        state). Deterministic: ties break by replica index."""
-        replicas = self._replicas()
+    def _split_replicas(self) -> Tuple[List[Any], List[Any]]:
+        """(prefill tier, decode tier) under disaggregation: the
+        deployment's first ``prefill_replicas`` replicas admit, the
+        rest step. Always leaves at least one decode replica; without
+        disaggregation the prefill tier is empty."""
+        reps = self._replicas()
+        n = min(self.policy.prefill_replicas, max(len(reps) - 1, 0))
+        return reps[:n], reps[n:]
+
+    def _pick_replica(self, slots: int = 1,
+                      exclude=None) -> Optional[Any]:
+        """Least-loaded DECODE replica with ``slots`` free step rows,
+        by THIS queue's own row counts (active + spilled both hold
+        replica-side state). Deterministic: ties break by replica
+        index. ``exclude`` drops one replica from consideration (the
+        drain path must never migrate a sequence back onto the
+        replica being drained)."""
+        _, replicas = self._split_replicas()
+        if exclude is not None:
+            replicas = [r for r in replicas if r is not exclude]
         if not replicas:
+            if exclude is not None:
+                return None       # nowhere else: caller falls back
             from tosem_tpu.runtime.common import ActorDiedError
             raise ActorDiedError(
                 f"deployment {self._dep.name!r} has no replicas "
@@ -867,13 +951,16 @@ class DecodeQueue:
         return replicas[best]
 
     def _requeue_for_readmission(self, items: List[_DecodeItem],
-                                 cause: BaseException) -> None:
+                                 cause: BaseException,
+                                 charge: bool = True) -> None:
         """Replica-death recovery: reset each surviving sequence to step
         0 and put it at the FRONT of the pending queue — re-admission
         re-prefills from the prompt and greedy decode replays the
         identical token path, so the client sees the same output it
         would have seen without the death. Sequences out of retry
-        budget fail instead."""
+        budget fail instead. ``charge=False`` (voluntary drain, a
+        migration falling back) spends no retry budget — the sequence
+        did nothing wrong."""
         for it in items:
             # if the actor restarts (max_restarts) with replayed state,
             # the dead incarnation's pages would otherwise be
@@ -881,12 +968,16 @@ class DecodeQueue:
             # on a fresh restart, and actor FIFO orders it before any
             # re-admission to the same replica
             self._release_replica_state(it)
-            it.attempts += 1
-            if it.attempts > self._dep.max_retries:
-                self._fail(it, cause, verdict=False)
-                continue
+            if charge:
+                it.attempts += 1
+                if it.attempts > self._dep.max_retries:
+                    self._fail(it, cause, verdict=False)
+                    continue
+            with self._lock:
+                self._readmit_step0 += 1
             it.step = 0
             it.replica = None
+            it.prefill_state = None
             with self._cv:
                 closed = self._closed
                 if not closed:
@@ -928,6 +1019,25 @@ class DecodeQueue:
                             if it.replica is not replica]
             self._waiting = [it for it in self._waiting
                              if it.replica is not replica]
+            # disaggregated tier: admits in flight on a dead prefill
+            # replica re-admit too (their refs are dead with the
+            # actor), as do handoffs importing into a dead decode
+            # replica
+            affected += [p for p, _ in self._prefilling
+                         if p.replica is replica
+                         or p.src_replica is replica]
+            affected += [p for p in self._prefilled
+                         if p.replica is replica]
+            affected += [p for p, _, _ in self._importing
+                         if p.replica is replica]
+            self._prefilling = [(p, r) for p, r in self._prefilling
+                                if p.replica is not replica
+                                and p.src_replica is not replica]
+            self._prefilled = collections.deque(
+                p for p in self._prefilled if p.replica is not replica)
+            self._importing = [e for e in self._importing
+                               if e[0].replica is not replica]
+            self._transport_addrs.pop(id(replica), None)
         if not affected:
             return
         breaker = self._dep.breaker
@@ -952,6 +1062,23 @@ class DecodeQueue:
                 self._spill_item(victim)
         elif act["action"] == "slow_step":
             time.sleep(act["delay_s"])
+        elif act["action"] == "drain_replica":
+            # chaos: drain the replica hosting the OLDEST active
+            # sequence with live migration — its sequences must
+            # continue from the current step on other replicas
+            with self._lock:
+                victim = (self._active[0].replica if self._active
+                          else None)
+            if victim is not None:
+                self.drain_replica(victim, migrate=True)
+        elif act["action"] == "crash_prefill":
+            # chaos: SIGKILL the prefill tier's first replica — admits
+            # in flight re-admit, already-migrated sequences on the
+            # decode tier must not notice
+            prefill, _ = self._split_replicas()
+            if prefill:
+                from tosem_tpu.chaos.injector import crash_actor_process
+                crash_actor_process(prefill[0]._actor_id)
 
     def _restore_waiting(self) -> None:
         """Bring spilled sequences back before admitting new ones
@@ -983,6 +1110,376 @@ class DecodeQueue:
                     self._waiting.remove(it)
                     self._active.append(it)
                     self._restores += 1
+
+    # ------------------------------------------------------ live migration
+
+    def _move_item(self, item: _DecodeItem, dst) -> bool:
+        """Move one sequence's replica-side state ``item.replica`` →
+        ``dst`` (export → import → release the source copy) and
+        repoint the item WITHOUT touching its step counter — decode
+        continues from the current step on the destination. On ANY
+        failure the sequence falls back to step-0 re-admission (the
+        recompute path — correct by determinism, just slower), spending
+        no retry budget. Callers hold ``_mig_lock``."""
+        import tosem_tpu.runtime as rt
+        t0 = time.monotonic()
+        try:
+            state = rt.get(item.replica.export_seq.remote(item.seq_id),
+                           timeout=60.0)
+            rt.get(dst.import_seq.remote(item.seq_id, state),
+                   timeout=60.0)
+        except BaseException as e:
+            with self._lock:
+                if item in self._active:
+                    self._active.remove(item)
+                if item in self._waiting:
+                    self._waiting.remove(item)
+                self._migration_fallbacks += 1
+            self._metrics["kv_migrations"].inc(
+                1, (self._dep.name, "fallback"))
+            self._requeue_for_readmission([item], e, charge=False)
+            return False
+        # the destination owns the state now: free the source copy
+        # (fire-and-forget, idempotent) and repoint. A spilled-on-
+        # source sequence imported LIVE on the destination leaves the
+        # waiting set here.
+        self._release_replica_state(item)
+        with self._lock:
+            item.replica = dst
+            if item in self._waiting:
+                self._waiting.remove(item)
+                self._active.append(item)
+            self._migrations += 1
+        self._metrics["kv_migrations"].inc(1, (self._dep.name, "ok"))
+        self._metrics["kv_migration_ms"].observe(
+            (time.monotonic() - t0) * 1e3, (self._dep.name,))
+        return True
+
+    def drain_replica(self, replica, migrate: bool = True
+                      ) -> Dict[str, int]:
+        """Evacuate every sequence pinned to ``replica`` (node drain /
+        scale-down). ``migrate=True`` moves each sequence's KV pages +
+        step ledger to another replica and CONTINUES from the current
+        step (zero recomputed tokens); ``migrate=False`` is the PR-8
+        behavior — step-0 re-admission — kept as the measured baseline
+        arm. Neither path trips the breaker or spends retry budget:
+        a drained sequence did nothing wrong."""
+        with self._mig_lock:
+            with self._lock:
+                items = [it for it in self._active + self._waiting
+                         if it.replica is replica]
+            out = {"migrated": 0, "readmitted": 0}
+            for item in items:
+                dst = (self._pick_replica(item.slots, exclude=replica)
+                       if migrate and self._can_migrate else None)
+                if dst is None:
+                    with self._lock:
+                        if item in self._active:
+                            self._active.remove(item)
+                        if item in self._waiting:
+                            self._waiting.remove(item)
+                    self._requeue_for_readmission(
+                        [item], RuntimeError(
+                            f"replica drained ({self._dep.name})"),
+                        charge=False)
+                    out["readmitted"] += 1
+                elif self._move_item(item, dst):
+                    out["migrated"] += 1
+                else:
+                    out["readmitted"] += 1
+            return out
+
+    # ------------------------------------------ disaggregated prefill
+
+    def _transport_addr(self, replica) -> Optional[str]:
+        """Cached tensor-receiver address of a decode replica (fetched
+        once per replica; None disables the direct stream for this
+        launch — the export fallback still works)."""
+        import tosem_tpu.runtime as rt
+        key = id(replica)
+        if key in self._transport_addrs:
+            return self._transport_addrs[key]
+        try:
+            addr = rt.get(replica.transport_address.remote(),
+                          timeout=30.0)
+        except BaseException:
+            return None
+        self._transport_addrs[key] = addr
+        return addr
+
+    def _launch_prefills(self) -> None:
+        """Disaggregated admission: fire ``admit`` on the prefill tier
+        WITHOUT waiting — the decode tier keeps stepping while prompts
+        prefill in other processes. The DESTINATION decode replica is
+        chosen at launch so the prefill replica can stream the pages
+        straight to its tensor receiver (worker→worker, no driver
+        hop); the driver later fires only ``adopt_seq``. In-flight
+        prefills are bounded by ``max_active`` so a prompt flood
+        cannot run the prefill pool out of pages."""
+        prefill, _ = self._split_replicas()
+        if not prefill:
+            return
+        while True:
+            with self._cv:
+                if self._closed or not self._pending:
+                    return
+                inflight = (sum(p.slots for p, _ in self._prefilling)
+                            + sum(p.slots for p in self._prefilled))
+                item = self._pending[0]
+                if item.slots > self.policy.max_active:
+                    pass              # oversized: the sync path fails it
+                elif inflight + item.slots > self.policy.max_active:
+                    return
+                self._pending.popleft()
+            if item.slots > self.policy.max_active:
+                self._fail(item, ValueError(
+                    f"n={item.slots} branches exceed max_active="
+                    f"{self.policy.max_active}"))
+                continue
+            counts = self.replica_loads()
+            best = min(range(len(prefill)),
+                       key=lambda j: (counts.get(id(prefill[j]), 0), j))
+            src = prefill[best]
+            try:
+                dst = (self._pick_replica(item.slots)
+                       if self._can_stream else None)
+            except BaseException as e:
+                # decode tier momentarily empty (ActorDiedError): the
+                # item is already off _pending, so it must fail here —
+                # escaping would strand it outside every queue with a
+                # future nobody resolves
+                self._fail(item, e, verdict=False)
+                continue
+            addr = self._transport_addr(dst) if dst is not None else None
+            item.src_replica = src
+            # `replica` names where the decode state will LIVE: the
+            # stream destination when known at launch, else the
+            # prefill replica until the export handoff resolves one
+            item.replica = dst if addr is not None else src
+            try:
+                if addr is not None:
+                    ref = src.admit.remote(item.seq_id, item.request,
+                                           False, addr)
+                else:
+                    # no streaming surface / no decode capacity yet:
+                    # the admit outcome carries the exported state
+                    ref = src.admit.remote(item.seq_id, item.request,
+                                           True)
+            except BaseException as e:
+                self._fail(item, e, verdict=False)
+                continue
+            with self._lock:
+                self._prefilling.append((item, ref))
+
+    def _collect_prefills(self) -> None:
+        """Harvest finished async admits: done-at-admit sequences
+        retire straight off the prefill replica; the rest migrate
+        (pages + ledger) onto the decode tier — or park in
+        ``_prefilled`` until a decode slot frees."""
+        import tosem_tpu.runtime as rt
+        from tosem_tpu.serve.kv_cache import CachePressure
+        with self._lock:
+            pending = list(self._prefilling)
+        if not pending:
+            return
+        refs = [ref for _, ref in pending]
+        done, _ = rt.wait(refs, num_returns=len(refs), timeout=0.0)
+        done_set = set(done)
+        for item, ref in pending:
+            if ref not in done_set:
+                continue
+            with self._lock:
+                if (item, ref) not in self._prefilling:
+                    continue          # a death handler swept it
+                self._prefilling.remove((item, ref))
+            try:
+                first = rt.get(ref, timeout=30.0)
+            except TaskError as e:
+                if isinstance(e.cause, CachePressure):
+                    # prefill pool momentarily full: back to the queue
+                    with self._cv:
+                        if not self._closed:
+                            self._pending.appendleft(item)
+                            item.replica = None
+                            continue
+                    self._fail(item, self._close_error or e)
+                else:
+                    self._fail(item, e)   # poison prompt: fails alone
+                continue
+            except self._retryable() as e:
+                # the ADMIT died with the prefill replica; the item
+                # left _prefilling above, so the death sweep can't see
+                # it — requeue it alongside its batchmates
+                self._on_replica_death(item.src_replica or item.replica,
+                                       e)
+                self._requeue_for_readmission([item], e)
+                continue
+            except BaseException as e:
+                self._release_replica_state(item)
+                self._fail(item, e, verdict=False)
+                continue
+            self._tokens += int(first.get("n_tokens", 1))
+            if first.get("done"):
+                # done at admit (short budget / eos): the state never
+                # left the PREFILL replica — retire must release it
+                # there, not on the planned stream destination, or the
+                # prefill pool leaks a sequence per completion
+                item.replica = item.src_replica or item.replica
+                item.src_replica = None
+                with self._lock:
+                    self._active.append(item)
+                self._retire(item, result=first.get("result"))
+                continue
+            item.src_replica = None
+            if first.get("sent"):
+                # pages already streamed worker→worker to item.replica
+                # (the send COMMITTED before the admit outcome): fire
+                # the idempotent adopt WITHOUT waiting and activate
+                # now — actor FIFO orders the adopt before any step
+                # this scheduler dispatches afterwards, so the slot
+                # never idles a round trip. A pressured adopt parks
+                # the payload and the step's "pending" outcome retries.
+                try:
+                    item.replica.adopt_seq.remote(item.seq_id, 10.0)
+                except BaseException as e:
+                    self._fail_prefilled(item, e)
+                    continue
+                with self._lock:
+                    self._active.append(item)
+                    self._migrations += 1
+                self._metrics["kv_migrations"].inc(
+                    1, (self._dep.name, "ok"))
+                continue
+            item.prefill_state = first.get("state")
+            item.replica = None
+            with self._lock:
+                self._prefilled.append(item)
+        self._activate_prefilled()
+
+    def _activate_prefilled(self) -> None:
+        """Hand prefilled sequences to the decode tier as slots free:
+        FIRE the import of the state the admit outcome carried (the
+        live-KV-migration import half; same counters, same wire format
+        as node drain) without waiting — :meth:`_collect_imports`
+        harvests completions, so the handoff never blocks the step
+        loop. A sequence whose state never arrived (older backend)
+        falls back to the synchronous export path."""
+        with self._mig_lock:
+            deferred: List[_DecodeItem] = []
+            while True:
+                with self._lock:
+                    if not self._prefilled:
+                        break
+                    item = self._prefilled.popleft()
+                if item.prefill_state is None \
+                        and item.replica is not None:
+                    # pressured adopt: the stream is parked on the
+                    # destination's receiver — re-fire the adopt there
+                    # (pages free when something retires)
+                    try:
+                        ref = item.replica.adopt_seq.remote(item.seq_id)
+                    except BaseException as e:
+                        self._fail_prefilled(item, e)
+                        continue
+                    with self._lock:
+                        self._importing.append((item, ref,
+                                                time.monotonic()))
+                    continue
+                if item.prefill_state is None:
+                    self._fail_prefilled(item, RuntimeError(
+                        "prefilled sequence lost its exported state"))
+                    continue
+                try:
+                    dst = self._pick_replica(item.slots)
+                except Exception:
+                    deferred.append(item)
+                    break             # no replicas: close() will sweep
+                if dst is None:
+                    deferred.append(item)
+                    break             # decode tier full: retry next tick
+                # binding the item to dst BEFORE the import lands keeps
+                # the slot accounting honest (replica_loads counts
+                # _importing), so concurrent activations can't
+                # oversubscribe the destination
+                item.replica = dst
+                try:
+                    ref = dst.import_seq.remote(item.seq_id,
+                                                item.prefill_state)
+                except BaseException as e:
+                    self._fail_prefilled(item, e)
+                    continue
+                with self._lock:
+                    self._importing.append((item, ref,
+                                            time.monotonic()))
+            if deferred:
+                with self._lock:
+                    self._prefilled.extendleft(reversed(deferred))
+
+    def _collect_imports(self) -> None:
+        """Harvest finished decode-tier imports: the sequence joins the
+        active set and steps from its exported position. Page pressure
+        sends it back to the prefilled queue (retried when something
+        retires); anything else falls back to step-0 re-admission."""
+        import tosem_tpu.runtime as rt
+        from tosem_tpu.serve.kv_cache import CachePressure
+        with self._lock:
+            pending = list(self._importing)
+        if not pending:
+            return
+        refs = [ref for _, ref, _ in pending]
+        done, _ = rt.wait(refs, num_returns=len(refs), timeout=0.0)
+        done_set = set(done)
+        for entry in pending:
+            item, ref, t0 = entry
+            if ref not in done_set:
+                continue
+            with self._lock:
+                if entry not in self._importing:
+                    continue          # a death handler swept it
+                self._importing.remove(entry)
+            try:
+                rt.get(ref, timeout=30.0)
+            except TaskError as e:
+                if isinstance(e.cause, CachePressure):
+                    # pool full on the destination. An exported state
+                    # retries the import anywhere; a streamed payload
+                    # stays parked on ITS destination's receiver
+                    # (adopt_seq put it back), so keep the binding
+                    if item.prefill_state is not None:
+                        item.replica = None
+                    with self._lock:
+                        self._prefilled.append(item)
+                    continue
+                self._fail_prefilled(item, e)
+                continue
+            except self._retryable() as e:
+                self._on_replica_death(item.replica, e)
+                self._fail_prefilled(item, e)
+                continue
+            except BaseException as e:
+                self._fail_prefilled(item, e)
+                continue
+            item.prefill_state = None
+            with self._lock:
+                self._active.append(item)
+                self._migrations += 1
+            self._metrics["kv_migrations"].inc(
+                1, (self._dep.name, "ok"))
+            self._metrics["kv_migration_ms"].observe(
+                (time.monotonic() - t0) * 1e3, (self._dep.name,))
+
+    def _fail_prefilled(self, item: _DecodeItem,
+                        cause: BaseException) -> None:
+        """A prefilled sequence whose decode-tier import failed
+        re-admits from step 0 (its prefill-replica copy was released
+        at export, so recompute is the only fallback)."""
+        item.prefill_state = None
+        item.replica = None
+        with self._lock:
+            self._migration_fallbacks += 1
+        self._metrics["kv_migrations"].inc(
+            1, (self._dep.name, "fallback"))
+        self._requeue_for_readmission([item], cause, charge=False)
 
     def _admit_pending(self) -> None:
         """Fill free batch slots from the queue — the iteration-level
@@ -1080,7 +1577,13 @@ class DecodeQueue:
 
     def _step_replicas(self) -> None:
         """One decode iteration: one ``step_batch`` per replica holding
-        active sequences."""
+        active sequences. Holds ``_mig_lock`` end to end so a drain
+        can never export a sequence between this iteration's dispatch
+        and its commit."""
+        with self._mig_lock:
+            self._step_replicas_locked()
+
+    def _step_replicas_locked(self) -> None:
         import tosem_tpu.runtime as rt
         with self._lock:
             groups: Dict[int, List[_DecodeItem]] = {}
@@ -1088,17 +1591,33 @@ class DecodeQueue:
             for it in self._active:
                 groups.setdefault(id(it.replica), []).append(it)
                 handles[id(it.replica)] = it.replica
-        for key in sorted(groups, key=lambda k: self._replica_index(
-                handles[k])):
+        order = sorted(groups, key=lambda k: self._replica_index(
+            handles[k]))
+        # dispatch EVERY replica's step before reaping any: the per-
+        # replica step programs run concurrently in their actor
+        # processes (serial dispatch-then-wait made N replicas step at
+        # single-replica throughput — the cluster-decode bench's
+        # original bottleneck)
+        refs: Dict[int, Any] = {}
+        for key in order:
             items = groups[key]
             replica = handles[key]
             self._dep._fire_chaos(replica, self._replica_index(replica))
             self._metrics["decode_occupancy"].observe(
                 len(items), (self._dep.name,))
             try:
-                outcomes = rt.get(replica.step_batch.remote(
+                refs[key] = replica.step_batch.remote(
                     [it.seq_id for it in items],
-                    [it.step for it in items]), timeout=120.0)
+                    [it.step for it in items])
+            except BaseException as e:
+                self._on_replica_death(replica, e)
+        for key in order:
+            if key not in refs:
+                continue
+            items = groups[key]
+            replica = handles[key]
+            try:
+                outcomes = rt.get(refs[key], timeout=120.0)
             except self._retryable() as e:
                 self._on_replica_death(replica, e)
                 continue
@@ -1124,6 +1643,27 @@ class DecodeQueue:
                 with self._lock:
                     if it not in self._active:
                         continue
+                if out.get("pending"):
+                    # streamed handoff not adopted yet (parked under
+                    # pressure, or the fire-and-forget adopt was
+                    # lost): re-fire the idempotent adopt and retry
+                    # this step next iteration; a sequence that stays
+                    # pending past the stall limit is unrecoverable
+                    it.stalls += 1
+                    if it.stalls > self.PRESSURE_STALL_LIMIT:
+                        with self._lock:
+                            if it in self._active:
+                                self._active.remove(it)
+                        self._release_replica_state(it)
+                        self._fail_prefilled(it, RuntimeError(
+                            f"sequence {it.seq_id} never adopted on "
+                            "its decode replica"))
+                        continue
+                    try:
+                        it.replica.adopt_seq.remote(it.seq_id, 0.5)
+                    except BaseException:
+                        pass
+                    continue
                 if out.get("pressure"):
                     if pressured is None:
                         pressured = it
@@ -1176,23 +1716,56 @@ class DecodeQueue:
     # have long since landed by the time this trips.
     PRESSURE_STALL_LIMIT = 6
 
-    def _refresh_gauges(self) -> None:
-        name = self._dep.name
-        with self._lock:
-            self._metrics["decode_active"].set(len(self._active), (name,))
-            self._metrics["queue_depth"].set(len(self._pending), (name,))
+    def _refresh_gauges(self, block: bool = True) -> None:
+        # the WHOLE refresh runs on a time budget, not per step: the
+        # local half used to re-walk the metric registry every
+        # iteration (lock + label-set hash per gauge), which at
+        # millisecond step times is measurable scheduler overhead for
+        # telemetry nobody scrapes faster than the remote half anyway.
+        # ``block=False`` is the scheduler loop's mode: the remote
+        # scrape is fired and harvested an interval later, so
+        # telemetry never steals a step's wall time; direct callers
+        # (tests, ad-hoc pokes) keep synchronous semantics.
         now = time.monotonic()
         if now - self._last_scrape < self.SCRAPE_INTERVAL_S:
             return
         self._last_scrape = now
+        name = self._dep.name
+        with self._lock:
+            self._metrics["decode_active"].set(len(self._active), (name,))
+            self._metrics["queue_depth"].set(len(self._pending), (name,))
         import tosem_tpu.runtime as rt
         replicas = self._replicas()
         if not replicas or not hasattr(self._dep.backend_cls,
                                        "cache_stats"):
             return
         try:
-            stats = rt.get(replicas[0].cache_stats.remote(), timeout=30.0)
+            # async mode: harvest the PREVIOUS interval's request and
+            # fire the next — the stats round trip queues behind a step
+            # on a busy actor, and waiting on it here would steal a
+            # step's worth of wall time from the scheduler per interval
+            prev = getattr(self, "_scrape_ref", None)
+            stats = None
+            if prev is not None:
+                if not block:
+                    # scheduler mode: POLL — on a busy actor the stats
+                    # ref queues behind a step, and rt.get's timeout
+                    # would stall the loop for the full 0.5 s every
+                    # interval; leave the ref outstanding and retry
+                    # next interval instead
+                    done, _ = rt.wait([prev], num_returns=1,
+                                      timeout=0.0)
+                    if not done:
+                        return
+                stats = rt.get(prev, timeout=0.5)
+            self._scrape_ref = replicas[0].cache_stats.remote()
+            if block and stats is None:
+                stats = rt.get(self._scrape_ref, timeout=5.0)
+                self._scrape_ref = None
         except BaseException:
+            self._scrape_ref = None
+            return
+        if stats is None:
             return
         with self._lock:
             self._cache_stats = dict(stats)
@@ -1212,7 +1785,9 @@ class DecodeQueue:
         while True:
             with self._cv:
                 while not (self._pending or self._active
-                           or self._waiting) and not self._closed:
+                           or self._waiting or self._prefilling
+                           or self._prefilled or self._importing) \
+                        and not self._closed:
                     self._cv.wait()
                 if self._closed:
                     return
@@ -1220,12 +1795,30 @@ class DecodeQueue:
             try:
                 self._fire_decode_chaos()
                 self._restore_waiting()
-                self._admit_pending()
+                if self.policy.prefill_replicas:
+                    # disaggregated: fire-and-forget admits on the
+                    # prefill tier, harvest finished ones, hand them
+                    # to the decode tier (also async), and keep
+                    # stepping — the loop only ever BLOCKS on steps
+                    self._launch_prefills()
+                    self._collect_prefills()
+                    self._collect_imports()
+                    if not self._split_replicas()[0]:
+                        # a 1-replica fleet has no prefill tier
+                        # (_split_replicas always keeps a decode
+                        # replica): admit colocated rather than
+                        # stalling _pending forever
+                        self._admit_pending()
+                else:
+                    self._admit_pending()
                 with self._lock:
                     stepping = bool(self._active)
+                    prefilling = bool(self._prefilling
+                                      or self._prefilled
+                                      or self._importing)
                 if stepping:
                     self._step_replicas()
-                self._refresh_gauges()
+                self._refresh_gauges(block=False)
             except BaseException:
                 # anything the per-call handlers didn't classify (e.g.
                 # a builtin TimeoutError from rt.get on a slow host):
@@ -1238,6 +1831,12 @@ class DecodeQueue:
                 time.sleep(max(self.policy.idle_wait_s, 0.05))
                 continue
             if not had_active and not stepping:
-                # admission blocked (page pressure, no replicas): don't
-                # spin — pages free when something retires or restores
-                time.sleep(self.policy.idle_wait_s)
+                if prefilling:
+                    # nothing to step YET but admits are in flight on
+                    # the prefill tier: poll briskly so the first
+                    # prefilled sequence starts decoding promptly
+                    time.sleep(min(self.policy.idle_wait_s, 0.002))
+                else:
+                    # admission blocked (page pressure, no replicas):
+                    # don't spin — pages free when something retires
+                    time.sleep(self.policy.idle_wait_s)

@@ -41,6 +41,7 @@ then a replica node, deterministically.
 """
 from __future__ import annotations
 
+import contextlib
 import itertools
 import json
 import threading
@@ -490,6 +491,113 @@ class ClusterServe:
                 f"deployment {dep.name!r} was deleted during "
                 "re-placement")
         return rep
+
+    # -- node drain (live KV migration) --------------------------------
+
+    def drain_node(self, node_name: str) -> Dict[str, Any]:
+        """Gracefully drain ``node_name``: for every replica placed
+        there, live-migrate its in-flight decode sequences to a
+        SURVIVOR replica of the same deployment (backends exposing the
+        migration surface — ``list_seqs``/``transport_address``/
+        ``send_seq``/``adopt_seq``; page bytes stream node→node over
+        :mod:`tosem_tpu.cluster.transport`, the driver only brokers
+        addresses), drop the node from routing, re-place its replicas
+        on surviving capacity under the same ids, and stop its
+        processes. Unlike node DEATH (step-0 re-admission), a drained
+        node's sequences continue from their current step. Returns
+        ``{"replicas_moved", "sequences_migrated", "deployments"}``;
+        journaled as ``node_drained``."""
+        from tosem_tpu.cluster.rpc import RpcClient, RpcError
+        with self._lock:
+            doomed: List[Tuple[ClusterDeployment, ClusterReplica]] = []
+            for dep in self._deployments.values():
+                for rep in [r for r in dep.replicas
+                            if r.node == node_name]:
+                    dep.replicas.remove(rep)
+                    doomed.append((dep, rep))
+        if not doomed:
+            return {"replicas_moved": 0, "sequences_migrated": 0,
+                    "deployments": []}
+        # stop NEW traffic to the draining replicas first: routers must
+        # not admit fresh sequences onto state that is about to move
+        self._push_table()
+        migrated = 0
+        for dep, rep in doomed:
+            with self._lock:
+                survivors = [r for r in dep.replicas
+                             if r.node != node_name]
+            if not survivors:
+                continue              # nowhere to move: re-place below
+            try:
+                with contextlib.ExitStack() as stack:
+                    src_cli = stack.enter_context(
+                        RpcClient(rep.address))
+                    seqs = src_cli.call("backend_call", "list_seqs")
+                    if not seqs:
+                        continue
+                    # one client + transport address per survivor;
+                    # sequences round-robin over them so one replica
+                    # does not absorb every migrated page
+                    dsts = []
+                    for r in survivors:
+                        try:
+                            cli = stack.enter_context(
+                                RpcClient(r.address))
+                            dsts.append((cli, cli.call(
+                                "backend_call", "transport_address")))
+                        except (RpcError, ConnectionError,
+                                TimeoutError, OSError):
+                            continue
+                    if not dsts:
+                        continue
+                    for j, sid in enumerate(seqs):
+                        dst_cli, addr = dsts[j % len(dsts)]
+                        # per-sequence containment: one failed
+                        # migration (pressure on the destination, a
+                        # torn stream) must not abandon the REST of
+                        # the replica's sequences to step-0 recompute
+                        try:
+                            src_cli.call("backend_call", "send_seq",
+                                         sid, addr)
+                            dst_cli.call("backend_call", "adopt_seq",
+                                         sid)
+                            src_cli.call("backend_call", "release",
+                                         sid)
+                            migrated += 1
+                        except (RpcError, ConnectionError,
+                                TimeoutError, OSError):
+                            continue
+            except (RpcError, ConnectionError, TimeoutError, OSError):
+                pass  # backend without the surface / replica gone:
+                #       sequences fall back to the re-admission path
+        nodes = self.pool.live_nodes()
+        node = nodes.get(node_name)
+        for dep, rep in doomed:
+            self.pool.record_event(
+                "replica_removed", deployment=dep.name,
+                replica_id=rep.replica_id, reason="node_drain",
+                node=node_name)
+            if node is not None:
+                try:
+                    node.stop_replica(rep.replica_id)
+                except Exception:
+                    pass
+            if rep.gang is not None:
+                rep.gang.release()
+            try:
+                self._place_one(dep, rep.replica_id,
+                                exclude=(node_name,))
+            except Exception as e:
+                self.pool.record_event(
+                    "replica_lost", deployment=dep.name,
+                    replica_id=rep.replica_id, error=repr(e))
+        self.pool.record_event("node_drained", node=node_name,
+                               replicas=len(doomed),
+                               sequences_migrated=migrated)
+        self._push_table()
+        return {"replicas_moved": len(doomed),
+                "sequences_migrated": migrated,
+                "deployments": sorted({d.name for d, _ in doomed})}
 
     # -- chaos seam ----------------------------------------------------
 

@@ -102,12 +102,18 @@ def shape_key(model: str, shape: Sequence[int], dtype: str) -> Tuple:
     return (model, tuple(int(d) for d in shape), str(dtype))
 
 
-def aot_compile(fn: Callable, arg_specs: Sequence[Tuple[Sequence[int], Any]]
-                ) -> Any:
+def aot_compile(fn: Callable, arg_specs: Sequence[Tuple[Sequence[int], Any]],
+                donate_argnums: Sequence[int] = ()) -> Any:
     """AOT-lower ``fn`` for the given ``(shape, dtype)`` specs and return
     the compiled executable (callable with concrete arrays of exactly
     those shapes). No real data is touched — safe for deploy-time
-    warming."""
+    warming. ``donate_argnums`` forwards to ``jax.jit`` — the decode
+    backends donate their KV pools into the step/prefill programs so
+    page writes land IN PLACE instead of copying the whole pool per
+    step (at a 768-page pool the functional copy dominated the step;
+    donated arguments must not be read after the call — the backends
+    swap ``set_pools`` immediately)."""
     import jax
     specs = [jax.ShapeDtypeStruct(tuple(s), d) for s, d in arg_specs]
-    return jax.jit(fn).lower(*specs).compile()
+    return jax.jit(fn, donate_argnums=tuple(donate_argnums)).lower(
+        *specs).compile()

@@ -46,6 +46,44 @@ class PagesLostError(RuntimeError):
     loss); the caller must recompute the cache from token history."""
 
 
+class KVWireError(RuntimeError):
+    """A KV spill/wire payload's header does not match the destination
+    pool's configuration (page size, dtype, layout, heads/head_dim/
+    layers, or an unknown wire version). Typed so a migrated sequence
+    can never be scattered into a differently-configured pool
+    silently — the caller must route the payload to a matching replica
+    or fall back to re-prefill from token history."""
+
+
+# The spill payload IS the wire format: what :meth:`PagedKVCache.spill`
+# writes to the object store is byte-for-byte what live KV migration
+# streams between nodes (cluster/transport.py). Every payload carries a
+# version-tagged header naming the pool configuration it was cut from.
+KV_WIRE_VERSION = 1
+# [layers, pages, page_size(slots), heads, head_dim]
+KV_WIRE_LAYOUT = "lpshd"
+
+
+_POOL_SCATTER = None
+
+
+def _pool_scatter():
+    """Donated jitted page scatter ``(k_pool, v_pool, idx, k, v) ->
+    new pools``. Donation lets XLA write the pages IN PLACE instead of
+    copying the whole pool per update — the eager ``.at[].set`` pair
+    cost ~20x more per call (measured on the CPU arm), which made KV
+    import/restore/COW dominate migration and beam forking."""
+    global _POOL_SCATTER
+    if _POOL_SCATTER is None:
+        import jax
+
+        def scatter(kp, vp, idx, k, v):
+            return kp.at[:, idx].set(k), vp.at[:, idx].set(v)
+
+        _POOL_SCATTER = jax.jit(scatter, donate_argnums=(0, 1))
+    return _POOL_SCATTER
+
+
 class LocalSpillStore:
     """In-process spill backend (no runtime needed — tests, benches)."""
 
@@ -211,9 +249,24 @@ class PagedKVCache:
             seq.length = new_len
             return start, new_len
 
+    def _scatter_pages(self, pages, k, v) -> None:
+        """Write page payloads into the pools via the donated jitted
+        scatter (in-place page writes, no whole-pool copy)."""
+        import jax.numpy as jnp
+        idx = jnp.asarray(np.asarray(pages, np.int32))
+        dt = self.k_pool.dtype
+        # jnp.asarray handles numpy (incl. readonly mapped views) AND
+        # device arrays without a host bounce
+        self.k_pool, self.v_pool = _pool_scatter()(
+            self.k_pool, self.v_pool, idx,
+            jnp.asarray(k, dt), jnp.asarray(v, dt))
+
     def _copy_page(self, src: int, dst: int) -> None:
-        self.k_pool = self.k_pool.at[:, dst].set(self.k_pool[:, src])
-        self.v_pool = self.v_pool.at[:, dst].set(self.v_pool[:, src])
+        # gather stays ON DEVICE (a single-page slice), scatter rides
+        # the donated jitted path — a COW divergence never moves the
+        # pool (or even the page) across the host boundary
+        self._scatter_pages([dst], self.k_pool[:, [src]],
+                            self.v_pool[:, [src]])
 
     def fork(self, src_id, dst_id) -> None:
         """Share ``src``'s pages with a new sequence (refcount++); the
@@ -327,6 +380,128 @@ class PagedKVCache:
         with self._lock:
             self.k_pool, self.v_pool = k_pool, v_pool
 
+    # ------------------------------------------------- spill/wire payloads
+
+    def wire_header(self, *, length: int, released: int,
+                    n_pages: int) -> Dict[str, Any]:
+        """Version-tagged header naming the pool configuration a
+        payload was cut from — the contract every import/restore
+        validates before scattering bytes into pages."""
+        return {
+            "version": KV_WIRE_VERSION,
+            "layout": KV_WIRE_LAYOUT,
+            "page_size": self.page_size,
+            "dtype": self.dtype,
+            "layers": self.layers,
+            "heads": self.heads,
+            "head_dim": self.head_dim,
+            "length": int(length),
+            "page_offset": int(released),
+            "n_pages": int(n_pages),
+        }
+
+    def check_wire_header(self, header) -> Dict[str, Any]:
+        """Validate a payload header against THIS pool; raises
+        :class:`KVWireError` on any mismatch. Returns the header."""
+        if not isinstance(header, dict):
+            raise KVWireError("KV payload has no wire header (pre-"
+                              f"version payload? got {type(header)})")
+        if header.get("version") != KV_WIRE_VERSION:
+            raise KVWireError(
+                f"KV wire version {header.get('version')!r} != "
+                f"{KV_WIRE_VERSION}")
+        for field_, mine in (("layout", KV_WIRE_LAYOUT),
+                             ("page_size", self.page_size),
+                             ("dtype", self.dtype),
+                             ("layers", self.layers),
+                             ("heads", self.heads),
+                             ("head_dim", self.head_dim)):
+            if header.get(field_) != mine:
+                raise KVWireError(
+                    f"KV payload {field_}={header.get(field_)!r} does "
+                    f"not match this pool's {field_}={mine!r} — "
+                    "refusing to scatter into a differently-configured "
+                    "pool")
+        return header
+
+    def _gather_pages(self, pages: np.ndarray):
+        """(k, v) page payloads as host ndarrays. On the CPU backend
+        ``np.asarray(pool)`` is a zero-copy view, so the gather costs
+        only the payload's bytes; on a device backend that view would
+        be a WHOLE-POOL device-to-host transfer, so the gather runs on
+        device and only the selected pages cross."""
+        import jax
+        if jax.default_backend() == "cpu":
+            kp = np.asarray(self.k_pool)
+            vp = np.asarray(self.v_pool)
+            return (np.ascontiguousarray(kp[:, pages]),
+                    np.ascontiguousarray(vp[:, pages]))
+        return (np.asarray(self.k_pool[:, pages]),
+                np.asarray(self.v_pool[:, pages]))
+
+    def _cut_payload(self, seq: _Seq) -> Dict[str, Any]:
+        """Spill/wire payload for a LIVE sequence (pages stay owned)."""
+        pages = np.asarray(seq.pages, np.int64)
+        k, v = self._gather_pages(pages)
+        return {
+            "header": self.wire_header(length=seq.length,
+                                       released=seq.released,
+                                       n_pages=len(seq.pages)),
+            "k": k,
+            "v": v,
+            "length": seq.length,
+            "released": seq.released,
+        }
+
+    def export_seq(self, seq_id) -> Dict[str, Any]:
+        """Cut a migratable payload for ``seq_id`` — live or spilled —
+        WITHOUT changing its state here (the migration caller frees the
+        source copy only after the destination import succeeded). A
+        spilled sequence exports its stored payload (raises
+        :class:`PagesLostError` when that is gone); the payload is the
+        same wire format either way, so migration composes with
+        mid-spill sequences for free."""
+        with self._lock:
+            if seq_id in self._spilled:
+                spilled = self._spilled[seq_id]
+                payload = self._spill_store.get(spilled.ref)  # may raise
+                self.check_wire_header(payload.get("header"))
+                return payload
+            return self._cut_payload(self._seqs[seq_id])
+
+    def import_seq(self, seq_id, payload: Dict[str, Any]) -> None:
+        """Admit a migrated payload as a NEW sequence: validate the
+        wire header against this pool (:class:`KVWireError` on
+        mismatch), allocate pages all-or-nothing
+        (:class:`CachePressure` leaves nothing changed), scatter the
+        page bytes, and register the sequence with its exported
+        ``length``/``page_offset`` — decode continues from the CURRENT
+        step, bit-identically, because the bytes are the spill format's
+        and spill/restore is byte-preserving."""
+        with self._lock:
+            header = self.check_wire_header(payload.get("header"))
+            if seq_id in self._seqs or seq_id in self._spilled:
+                raise ValueError(f"sequence {seq_id!r} already exists")
+            n_pages = int(header["n_pages"])
+            k, v = payload["k"], payload["v"]
+            if (tuple(k.shape) != (self.layers, n_pages, self.page_size,
+                                   self.heads, self.head_dim)
+                    or k.shape != v.shape):
+                raise KVWireError(
+                    f"payload arrays {tuple(k.shape)}/{tuple(v.shape)} "
+                    f"do not match header n_pages={n_pages} and pool "
+                    "geometry")
+            if n_pages > len(self._free):
+                raise CachePressure(
+                    f"import needs {n_pages} pages, "
+                    f"{len(self._free)} free")
+            pages = [self._alloc_page() for _ in range(n_pages)]
+            if pages:
+                self._scatter_pages(pages, k, v)
+            self._seqs[seq_id] = _Seq(pages=pages,
+                                      length=int(header["length"]),
+                                      released=int(header["page_offset"]))
+
     # ----------------------------------------------------------- spill tier
 
     def spill(self, seq_id) -> None:
@@ -335,13 +510,7 @@ class PagedKVCache:
         same outputs, bit for bit."""
         with self._lock:
             seq = self._seqs[seq_id]
-            pages = np.asarray(seq.pages, np.int64)
-            payload = {
-                "k": np.asarray(self.k_pool[:, pages]),
-                "v": np.asarray(self.v_pool[:, pages]),
-                "length": seq.length,
-                "released": seq.released,
-            }
+            payload = self._cut_payload(seq)
             ref = self._spill_store.put(payload)
             for p in seq.pages:
                 self._decref(p)
@@ -362,11 +531,13 @@ class PagedKVCache:
                     f"restore needs {spilled.n_pages} pages, "
                     f"{len(self._free)} free")
             payload = self._spill_store.get(spilled.ref)   # may raise
+            # the spill payload is the wire format: a payload that
+            # somehow came from a differently-configured pool (or a
+            # future version) must fail typed, never scatter silently
+            self.check_wire_header(payload.get("header"))
             pages = [self._alloc_page() for _ in range(spilled.n_pages)]
             if pages:
-                idx = np.asarray(pages, np.int64)
-                self.k_pool = self.k_pool.at[:, idx].set(payload["k"])
-                self.v_pool = self.v_pool.at[:, idx].set(payload["v"])
+                self._scatter_pages(pages, payload["k"], payload["v"])
             del self._spilled[seq_id]
             self._spill_store.drop(spilled.ref)
             self._seqs[seq_id] = _Seq(pages=pages,

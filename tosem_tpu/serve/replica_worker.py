@@ -97,6 +97,22 @@ class ReplicaHandlers:
             return self._backend.warmup(shapes)
         return {"warmed": 0}
 
+    def backend_call(self, method: str, *args: Any,
+                     **kwargs: Any) -> Any:
+        """Forward a control-plane call to a PUBLIC backend method —
+        the decode-migration surface (``list_seqs`` /
+        ``transport_address`` / ``send_seq`` / ``adopt_seq`` /
+        ``export_seq`` / ``import_seq``) without widening the fixed
+        data-plane RPC vocabulary. Only the tiny control messages ride
+        this path; migrated page bytes stream replica→replica over
+        :mod:`tosem_tpu.cluster.transport` (no driver hop)."""
+        if method.startswith("_"):
+            raise ValueError(f"backend method {method!r} is private")
+        fn = getattr(self._backend, method, None)
+        if not callable(fn):
+            raise KeyError(f"backend has no method {method!r}")
+        return fn(*args, **kwargs)
+
     def load(self) -> int:
         with self._lock:
             return self._inflight

@@ -117,6 +117,14 @@ MAX_ATTEMPTS = 3
 # NOTHING that way).
 LEG_TUNNEL_WAIT_S = 900.0
 
+# Preflight requeues: a leg that finds the tunnel down is sent to the
+# BACK of the queue (cheap probe, no burned subprocess) this many times
+# before it degrades — if the relay returns mid-round, the leg still
+# captures ON-CHIP instead of spending its only shot on a dead tunnel
+# (r03/r04 were lost and all six r05 configs died on the same
+# unreachable-tunnel failure).
+TUNNEL_REQUEUES = 2
+
 
 def tunnel_alive() -> bool:
     from tosem_tpu.utils.net import tunnel_alive as probe
@@ -178,8 +186,8 @@ def main() -> int:
         picked = [l for l in LEGS if l[0] in wanted]
     else:
         picked = list(LEGS)
-    queue = [(n, a, t, 1) for n, a, t in picked]
-    status = {n: "pending" for n, _, _, _ in queue}
+    queue = [(n, a, t, 1, 0) for n, a, t in picked]
+    status = {n: "pending" for n, _, _, _, _ in queue}
 
     degraded = []
 
@@ -213,7 +221,10 @@ def main() -> int:
 
     def degrade(name, argv, timeout, why):
         """Last resort: the CPU/interpret path with an explicit marker —
-        degraded evidence beats the nothing rounds 3-4 recorded."""
+        degraded evidence beats the nothing rounds 3-4 recorded. A leg
+        lost to the tunnel reports ``skipped (tunnel)`` — it is never
+        silently counted as on-chip evidence (and a failed degraded run
+        does not reclassify a tunnel loss as a code failure)."""
         print(f"[capture] {name}: degrading to CPU ({why})", flush=True)
         cmd, env = _cpu_leg(argv)
         ok, d_why, dt = run_leg(name, cmd, timeout, env=env)
@@ -221,16 +232,19 @@ def main() -> int:
             degraded.append(name)
             status[name] = f"degraded (cpu, {dt:.0f}s; {why})"
             flush_summary()
+        elif "tunnel" in why:
+            status[name] = f"skipped (tunnel; degraded run: {d_why})"
         else:
             status[name] = f"failed ({why}; degraded run: {d_why})"
 
     tunnel_down = False
     while queue and time.time() < deadline:
-        name, argv, timeout, attempt = queue.pop(0)
-        # retry-reconnect, bounded PER LEG — and only ONCE per outage:
-        # after a wait expires, subsequent legs probe instead of each
-        # re-paying the full window (a sustained outage must spend the
-        # wall budget on degraded CPU runs, not on sleeps)
+        name, argv, timeout, attempt, requeues = queue.pop(0)
+        # PREFLIGHT: probe the tunnel once per leg before launching.
+        # The wait-for-a-window is paid only ONCE per outage: after it
+        # expires, subsequent legs probe instead of each re-paying the
+        # full window (a sustained outage must spend the wall budget on
+        # degraded CPU runs, not on sleeps).
         if tunnel_down:
             up = tunnel_alive()
         else:
@@ -238,6 +252,18 @@ def main() -> int:
                                      time.time() + LEG_TUNNEL_WAIT_S))
         tunnel_down = not up
         if not up:
+            if requeues < TUNNEL_REQUEUES:
+                # re-queue (bounded) instead of burning the leg: if
+                # the relay returns before the queue drains, this leg
+                # still runs on-chip
+                queue.append((name, argv, timeout, attempt,
+                              requeues + 1))
+                status[name] = (f"requeued (tunnel, "
+                                f"{requeues + 1}/{TUNNEL_REQUEUES})")
+                print(f"[capture] {name}: tunnel down at preflight; "
+                      f"requeued ({requeues + 1}/{TUNNEL_REQUEUES})",
+                      flush=True)
+                continue
             degrade(name, argv, timeout, "tunnel unreachable")
             continue
         print(f"[capture] {name} (attempt {attempt}) ...", flush=True)
@@ -250,7 +276,8 @@ def main() -> int:
             print(f"[capture] {name}: {why} after {dt:.0f}s "
                   f"(attempt {attempt})", flush=True)
             if attempt < MAX_ATTEMPTS:
-                queue.append((name, argv, timeout, attempt + 1))
+                queue.append((name, argv, timeout, attempt + 1,
+                              requeues))
                 status[name] = f"retry ({why})"
             else:
                 degrade(name, argv, timeout,
@@ -259,6 +286,11 @@ def main() -> int:
         status.setdefault(name, "pending")
         if status[name].startswith("retry"):
             status[name] = f"budget-exhausted ({status[name]})"
+        elif status[name].startswith("requeued (tunnel"):
+            # the budget ran out while the leg waited for a window: a
+            # tunnel loss, not a code failure — and never on-chip
+            # evidence
+            status[name] = "skipped (tunnel)"
     print("[capture] done:", json.dumps(status, indent=1), flush=True)
     return 0 if all(v.startswith("ok") for v in status.values()) else 1
 
