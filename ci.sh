@@ -31,19 +31,21 @@ echo "== byte-compile (syntax gate)"
 python -m compileall -q tosem_tpu tests examples bench.py __graft_entry__.py
 
 chaos_smoke() {
-  # fast chaos smoke: 8 canned fault plans, fixed seeds — the
+  # fast chaos smoke: 9 canned fault plans, fixed seeds — the
   # runtime/serve/tune failure paths AND the recovery layer (lineage
   # reconstruction of an evicted object, node-kill resubmission,
   # KV-page eviction + replica crash mid-decode, live-drain migration
   # + prefill-node kill on a disaggregated decode deployment, router +
-  # replica-node kill under cluster-serve traffic) run on every PR,
-  # not just when a chaos test file is touched (see tosem_tpu/chaos/);
-  # the recovery plans gate on zero surfaced errors — the workload
-  # must HEAL, not merely fail loudly
-  echo "== chaos smoke (8 canned fault plans, fixed seeds)"
+  # replica-node kill under cluster-serve traffic, node kill under a
+  # distributed training run — shrink, continue, grow back, loss
+  # trajectory bit-identical) run on every PR, not just when a chaos
+  # test file is touched (see tosem_tpu/chaos/); the recovery plans
+  # gate on zero surfaced errors — the workload must HEAL, not merely
+  # fail loudly
+  echo "== chaos smoke (9 canned fault plans, fixed seeds)"
   for plan in worker-carnage serve-flap trial-crash \
               evict-heal node-kill-heal decode-chaos decode-migrate \
-              router-chaos; do
+              router-chaos train-cluster; do
     JAX_PLATFORMS=cpu python -m tosem_tpu.cli chaos --plan "$plan"
   done
 }
@@ -116,6 +118,20 @@ perf_smoke() {
     echo "== perf smoke: sparse regression reported; one retry (noisy host?)"
     JAX_PLATFORMS=cpu "${spcmd[@]}"
   fi
+  # distributed training: bucketed-overlap vs serialized all-reduce on
+  # the comms-dominated dp4 job (paced wire — loopback is pure CPU
+  # work, so the unpaced A/B measures scheduling, not comms hiding),
+  # async vs sync checkpoint on-step cost, and the dp4-vs-single-
+  # process bit-identity pin (hard-asserted in-bench; the gated rows
+  # hold overlap ≥1.3x and async savings ≥0.8 release over release)
+  echo "== perf smoke (train microbench vs results/bench_train.json)"
+  local tcmd=(python -m tosem_tpu.cli microbench --train --trials 2
+              --min-s 0.4 --quiet --only gated
+              --check results/bench_train.json --threshold 0.30)
+  if ! JAX_PLATFORMS=cpu "${tcmd[@]}"; then
+    echo "== perf smoke: train regression reported; one retry (noisy host?)"
+    JAX_PLATFORMS=cpu "${tcmd[@]}"
+  fi
 }
 
 if [[ "$PERF" == "1" ]]; then
@@ -137,12 +153,17 @@ if [[ "$QUICK" == "1" ]]; then
   # test_sharded_decode = the dp×tp paged-decode bit-identity gate;
   # test_cluster_transport = the tensor-transport framing gate (torn
   # stream / truncated header / out-of-order chunks typed, mapped
-  # arrivals)
+  # arrivals);
+  # test_train_distributed + test_train_checkpoint = the distributed-
+  # training reproducibility gate (dp-vs-single-process bit-identity
+  # through shrink/grow/resume, bucket partitioning, crash-point
+  # checkpoint durability, async checkpointer semantics)
   python -m pytest -q -m "not slow" \
     tests/test_ops.py tests/test_pallas_kernels.py tests/test_nn.py \
     tests/test_flash_blocks.py tests/test_mask_programs.py \
     tests/test_decode_modes.py tests/test_sharded_decode.py \
     tests/test_cluster_transport.py \
+    tests/test_train_distributed.py tests/test_train_checkpoint.py \
     tests/test_sharding.py tests/test_serial.py tests/test_utils.py \
     tests/test_analysis.py tests/test_image_ops.py tests/test_htm.py \
     tests/test_compress.py tests/test_scorer.py tests/test_ring.py \

@@ -97,6 +97,34 @@ class TestHeadJournal:
         assert state["placements"]["vec#r0"]["node"] == "n1"
         assert state["placements"]["vec#r0"]["address"] == "h:3"
 
+    def test_reconcile_rebuilds_train_progress(self, tmp_path):
+        """Training rides the same journal: a recovered head learns
+        which dp jobs were live and the last journaled step (what a
+        restarted DistributedTrainer resumes from), with elasticity
+        events folding into the world size."""
+        p = str(tmp_path / "head.journal")
+        j = HeadJournal(p)
+        j.record("train_started", job="dp", world=3, grain=4,
+                 backend="nodes")
+        j.record("train_step_done", job="dp", step=1)
+        j.record("train_step_done", job="dp", step=2)
+        j.record("train_worker_lost", job="dp", node="n2")
+        j.record("train_shrunk", job="dp", step=2, world=2)
+        j.record("train_step_done", job="dp", step=3)
+        j.record("train_grown", job="dp", step=3, world=3)
+        j.record("train_started", job="done-job", world=1, grain=1,
+                 backend="threads")
+        j.record("train_step_done", job="done-job", step=5)
+        j.record("train_finished", job="done-job", step=5)
+        j.close()
+        state = HeadJournal.reconcile(HeadJournal.load(p))
+        tj = state["train_jobs"]
+        assert tj["dp"]["step"] == 3
+        assert tj["dp"]["world"] == 3
+        assert tj["dp"]["grain"] == 4
+        assert tj["dp"]["finished"] is False
+        assert tj["done-job"]["finished"] is True
+
     def test_recover_from_sigkilled_head_torn_tail(self, tmp_path):
         """A head SIGKILLed mid-record leaves a torn final line; recover
         must skip the tail and still expose every completed serve

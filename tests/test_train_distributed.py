@@ -1,0 +1,369 @@
+"""Distributed data-parallel training over the cluster fabric.
+
+The contract under test is reproducibility-first: a job's data
+parallelism is ``grain`` fixed logical shards reduced by a strict left
+fold in shard order, so the loss trajectory is a pure function of
+(job, grain) — independent of how many workers the shards are spread
+over, of the overlap mode, and of any mid-run membership change.
+Everything here pins some face of that contract; the chain-transport
+arm runs over real sockets (threads backend) in-process.
+"""
+import numpy as np
+import pytest
+
+from tosem_tpu.train.distributed import (Bucket, DataParallelConfig,
+                                         DistributedTrainer,
+                                         TrainWorkerLost, _assign_shards,
+                                         demo_job, fit_distributed,
+                                         make_dp_train_step,
+                                         partition_buckets)
+
+JOB_KW = dict(towers=3, dim=16, batch=16, grain=4, seed=7)
+JOB_REF = "tosem_tpu.train.distributed:demo_job"
+
+
+def _reference_losses(num_steps, jobkw=JOB_KW):
+    job = demo_job(**jobkw)
+    state = job.init_state()
+    step_fn = make_dp_train_step(job)
+    out = []
+    for _ in range(num_steps):
+        state, m = step_fn(state)
+        out.append(m["loss"])
+    return out
+
+
+def _trainer(world=2, jobkw=JOB_KW, **kw):
+    cfg = kw.pop("cfg", None) or DataParallelConfig(
+        grain=jobkw["grain"], bucket_bytes=kw.pop("bucket_bytes", 1024),
+        job=kw.pop("job", f"test-{world}"), transport_capacity=8 << 20)
+    return DistributedTrainer(JOB_REF, dict(jobkw), cfg,
+                              backend="threads", world=world, **kw)
+
+
+# ------------------------------------------------------------- buckets
+
+
+class TestPartitionBuckets:
+    def test_size_targeted_runs(self):
+        meta = [(100, 0), (150, 0), (100, 0), (60, 0)]
+        out = partition_buckets(meta, bucket_bytes=260)
+        assert [b.leaves for b in out] == [(0, 1), (2, 3)]
+        assert [b.nbytes for b in out] == [250, 160]
+        assert [b.bid for b in out] == [0, 1]
+
+    def test_oversized_leaf_rides_alone(self):
+        meta = [(10, 0), (5000, 0), (10, 0)]
+        out = partition_buckets(meta, bucket_bytes=100)
+        assert [b.leaves for b in out] == [(0,), (1,), (2,)]
+
+    def test_uneven_tail_gets_own_bucket(self):
+        meta = [(90, 0)] * 5
+        out = partition_buckets(meta, bucket_bytes=180)
+        assert [b.leaves for b in out] == [(0, 1), (2, 3), (4,)]
+
+    def test_buckets_never_span_stages(self):
+        meta = [(10, 0), (10, 1), (10, 1), (10, 2)]
+        out = partition_buckets(meta, bucket_bytes=10_000)
+        assert [b.leaves for b in out] == [(0,), (1, 2), (3,)]
+        assert [b.stage for b in out] == [0, 1, 2]
+
+    def test_single_param_bucket(self):
+        out = partition_buckets([(42, 0)], bucket_bytes=1)
+        assert out == [Bucket(bid=0, stage=0, leaves=(0,), nbytes=42)]
+
+    def test_dtype_mixed_tree_groups_without_concat(self):
+        # fp32/bf16/int leaves only differ in nbytes here: leaves are
+        # grouped per bucket, never concatenated, so mixed dtypes are
+        # structurally safe — the partition must still cover every
+        # leaf exactly once, in order
+        meta = [(4 * 8, 0), (2 * 8, 0), (8 * 8, 0), (4, 0)]
+        out = partition_buckets(meta, bucket_bytes=70)
+        flat = [li for b in out for li in b.leaves]
+        assert flat == [0, 1, 2, 3]
+        assert sum(b.nbytes for b in out) == sum(nb for nb, _ in meta)
+
+    def test_bad_bucket_bytes_rejected(self):
+        with pytest.raises(ValueError):
+            partition_buckets([(1, 0)], bucket_bytes=0)
+
+
+def test_assign_shards_contiguous_ascending():
+    assert _assign_shards(4, 2) == [[0, 1], [2, 3]]
+    assert _assign_shards(4, 3) == [[0, 1], [2], [3]]
+    assert _assign_shards(5, 2) == [[0, 1, 2], [3, 4]]
+    assert _assign_shards(4, 4) == [[0], [1], [2], [3]]
+
+
+# -------------------------------------------------------- bit identity
+
+
+class TestBitIdentity:
+    def test_dp4_matches_single_process(self):
+        ref = _reference_losses(4)
+        with _trainer(world=4, job="bi-dp4") as tr:
+            assert tr.fit(4) == ref
+
+    def test_uneven_shard_runs_match(self):
+        # world=3 over grain=4: ranks own 2/1/1 shards — the fold
+        # grouping must still be ((g0+g1)+g2)+g3
+        ref = _reference_losses(3)
+        with _trainer(world=3, job="bi-dp3") as tr:
+            assert tr.fit(3) == ref
+
+    def test_world1_matches_single_process(self):
+        ref = _reference_losses(3)
+        with _trainer(world=1, job="bi-dp1") as tr:
+            assert tr.fit(3) == ref
+
+    def test_serialized_comms_identical_to_overlap(self):
+        # overlap changes WHEN reduces launch, never the fold order
+        with _trainer(world=2, job="bi-ov") as a:
+            a.overlap = True
+            ov = a.fit(3)
+        with _trainer(world=2, job="bi-se") as b:
+            b.overlap = False
+            se = b.fit(3)
+        assert ov == se == _reference_losses(3)
+
+    def test_mixed_precision_arms_agree(self):
+        kw = dict(JOB_KW, mixed_precision=True)
+        ref = _reference_losses(3, kw)
+        with _trainer(world=2, jobkw=kw, job="bi-mp") as tr:
+            assert tr.fit(3) == ref
+
+    def test_every_rank_contributes_to_the_fold(self):
+        # corrupt ONE rank's replicated params: its shard gradients
+        # enter the fold, so the trajectory must depart from the
+        # reference — proof the chain really sums every rank's shards
+        # rather than quietly using one rank's local gradients
+        ref = _reference_losses(4)
+        with _trainer(world=2, job="bi-sens") as tr:
+            assert tr.fit(1) == ref[:1]
+            w = tr._workers[0].backend._state["params"]["s00"]["w"]
+            tr._workers[0].backend._state["params"]["s00"]["w"] = w + 1.0
+            got = tr.fit(4)
+        assert got[1:] != ref[1:]
+
+
+# ----------------------------------------------------------- elasticity
+
+
+class TestElastic:
+    def test_shrink_mid_epoch_bit_identical(self):
+        ref = _reference_losses(6)
+        with _trainer(world=3, job="el-shrink") as tr:
+            tr._workers[-1].fail_at_step = 2   # dies inside step 2
+            got = tr.fit(6)
+            assert got == ref
+            st = tr.stats()
+            assert st["world"] == 2 and st["shrinks"] == 1
+
+    def test_grow_mid_epoch_bit_identical(self):
+        ref = _reference_losses(6)
+        with _trainer(world=2, job="el-grow") as tr:
+            tr.fit(3)
+            tr.add_worker()
+            got = tr.fit(6)
+            assert got == ref
+            st = tr.stats()
+            assert st["world"] == 3 and st["grows"] == 1
+
+    def test_shrink_then_grow_same_trajectory(self):
+        ref = _reference_losses(8)
+        with _trainer(world=3, job="el-sg") as tr:
+            tr._workers[-1].fail_at_step = 2
+            tr.fit(5)
+            tr.add_worker()
+            assert tr.fit(8) == ref
+            st = tr.stats()
+            assert st["shrinks"] == 1 and st["grows"] == 1
+
+    def test_double_death_same_step(self):
+        ref = _reference_losses(5)
+        with _trainer(world=4, job="el-dd") as tr:
+            tr._workers[-1].fail_at_step = 1
+            tr._workers[-2].fail_at_step = 1
+            assert tr.fit(5) == ref
+            assert tr.world == 2
+
+    def test_all_dead_raises(self):
+        with _trainer(world=2, job="el-dead") as tr:
+            tr._workers[0].fail_at_step = 1
+            tr._workers[1].fail_at_step = 1
+            with pytest.raises(TrainWorkerLost):
+                tr.fit(4)
+
+    def test_grow_beyond_grain_rejected(self):
+        with _trainer(world=4, job="el-cap") as tr:
+            with pytest.raises(ValueError, match="grain"):
+                tr.add_worker()
+
+    def test_world_bounds_validated(self):
+        with pytest.raises(ValueError, match="world"):
+            _trainer(world=5, job="el-bounds")
+
+
+# --------------------------------------------------- checkpoint resume
+
+
+class TestCheckpointResume:
+    def test_resume_across_restart_bit_identical(self, tmp_path):
+        ref = _reference_losses(8)
+        root = str(tmp_path / "ckpt")
+        with _trainer(world=2, job="ck-a", ckpt_dir=root,
+                      checkpoint_every=2, async_save=False) as tr:
+            assert tr.fit(4) == ref[:4]
+        with _trainer(world=2, job="ck-b", ckpt_dir=root,
+                      checkpoint_every=2, async_save=False) as tr:
+            assert tr.fit(8) == ref
+
+    def test_resume_across_node_death_mid_epoch(self, tmp_path):
+        # a node dies AFTER a checkpoint lands; the shrunk run finishes
+        # and a fresh trainer resumes the journaled step — trajectory
+        # stays bit-identical end to end, including the killed span
+        ref = _reference_losses(8)
+        root = str(tmp_path / "ckpt")
+        with _trainer(world=3, job="ck-kill", ckpt_dir=root,
+                      checkpoint_every=1, async_save=False) as tr:
+            tr._workers[-1].fail_at_step = 3
+            assert tr.fit(5) == ref[:5]
+            assert tr.stats()["shrinks"] == 1
+        with _trainer(world=2, job="ck-kill2", ckpt_dir=root,
+                      checkpoint_every=1, async_save=False) as tr:
+            assert tr.fit(8) == ref
+
+    def test_async_checkpoints_resume_identically(self, tmp_path):
+        ref = _reference_losses(6)
+        root = str(tmp_path / "ckpt")
+        with _trainer(world=2, job="ck-async", ckpt_dir=root,
+                      checkpoint_every=1, async_save=True) as tr:
+            assert tr.fit(3) == ref[:3]
+            # close() flushes the background writer via the backend
+        with _trainer(world=2, job="ck-async2", ckpt_dir=root,
+                      checkpoint_every=1, async_save=True) as tr:
+            assert tr.fit(6) == ref
+
+    def test_fit_distributed_one_shot(self, tmp_path):
+        ref = _reference_losses(3)
+        got = fit_distributed(JOB_REF, 3, job_kwargs=dict(JOB_KW),
+                              cfg=DataParallelConfig(
+                                  grain=4, bucket_bytes=1024,
+                                  job="ck-oneshot",
+                                  transport_capacity=8 << 20),
+                              world=2,
+                              ckpt_dir=str(tmp_path / "ck"))
+        assert got == ref
+
+
+# ------------------------------------------- reduction-arm parity
+
+
+class TestReductionArms:
+    def test_shard_map_arm_float_parity(self):
+        # the on-chip lowering (shard_map psum over a dp mesh) is
+        # float-parity with the fold arms, not bit (psum order is
+        # XLA's): trajectories must agree to fp32 tolerance
+        import jax
+        from jax.sharding import Mesh
+        job = demo_job(**JOB_KW)
+        devs = np.array(jax.devices()[:4])
+        mesh = Mesh(devs, ("dp",))
+        step_fn = make_dp_train_step(job, reduce="shard_map", mesh=mesh)
+        state = step_fn(job.init_state())[0]
+        losses = []
+        for _ in range(3):
+            state, m = step_fn(state)
+            losses.append(m["loss"])
+        ref = _reference_losses(4)[1:]
+        np.testing.assert_allclose(losses, ref, rtol=2e-5)
+
+    def test_shard_map_arm_validates_mesh(self):
+        job = demo_job(**JOB_KW)
+        with pytest.raises(ValueError, match="mesh"):
+            make_dp_train_step(job, reduce="shard_map")
+
+    def test_unknown_reduce_rejected(self):
+        with pytest.raises(ValueError, match="lowering"):
+            make_dp_train_step(demo_job(**JOB_KW), reduce="nccl")
+
+    def test_transport_arm_parity_with_shard_map_arm(self):
+        # cross-arm check: chain-transport dp (bit == local fold) vs
+        # shard_map psum — same trajectory to float tolerance
+        import jax
+        from jax.sharding import Mesh
+        job = demo_job(**JOB_KW)
+        mesh = Mesh(np.array(jax.devices()[:4]), ("dp",))
+        step_fn = make_dp_train_step(job, reduce="shard_map", mesh=mesh)
+        state = job.init_state()
+        sm = []
+        for _ in range(3):
+            state, m = step_fn(state)
+            sm.append(m["loss"])
+        with _trainer(world=4, job="arm-x") as tr:
+            tp = tr.fit(3)
+        np.testing.assert_allclose(tp, sm, rtol=2e-5)
+
+
+# ------------------------------------------------------- observability
+
+
+def test_http_stats_includes_live_train_jobs():
+    # the serving ingress's /-/stats rolls live training jobs in next
+    # to the deployments (telemetry never fails the endpoint)
+    import json
+    from urllib.request import urlopen
+
+    from tosem_tpu.serve.http import HttpIngress
+
+    class _Controller:
+        def get_deployment(self, name):
+            return None
+
+        def list_deployments(self):
+            return []
+
+        def stats(self):
+            return {}
+
+    cfg = DataParallelConfig(grain=4, bucket_bytes=1024,
+                             job="http-job", transport_capacity=8 << 20)
+    tr = DistributedTrainer(JOB_REF, dict(JOB_KW), cfg,
+                            backend="threads", world=2)
+    ingress = HttpIngress(_Controller())
+    try:
+        tr.fit(1)
+        st = json.loads(urlopen(f"{ingress.url}/-/stats",
+                                timeout=30).read())
+        assert st["train"]["http-job"]["world"] == 2
+        assert st["train"]["http-job"]["step"] == 1
+    finally:
+        ingress.shutdown()
+        tr.close()
+    # closed trainers drop out of the rollup
+    from tosem_tpu.train.distributed import jobs_stats
+    assert "http-job" not in jobs_stats()
+
+
+def test_stats_and_metrics_rollup():
+    from tosem_tpu.obs.metrics import Registry
+    reg = Registry()
+    cfg = DataParallelConfig(grain=4, bucket_bytes=1024, job="obs-job",
+                             transport_capacity=8 << 20)
+    tr = DistributedTrainer(JOB_REF, dict(JOB_KW), cfg,
+                            backend="threads", world=2, registry=reg)
+    try:
+        tr.fit(2)
+        from tosem_tpu.train.distributed import jobs_stats
+        js = jobs_stats()
+        assert js["obs-job"]["step"] == 2
+        assert js["obs-job"]["world"] == 2
+        text = reg.prometheus_text()
+        assert 'train_steps_total{job="obs-job"} 2' in text
+        assert 'train_dp_size{job="obs-job"} 2' in text
+        assert "train_allreduce_bytes_total" in text
+        assert "train_allreduce_ms" in text
+        assert "train_examples_per_s" in text
+    finally:
+        tr.close()
+    assert "obs-job" not in jobs_stats()

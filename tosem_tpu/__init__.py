@@ -89,6 +89,24 @@ _LAZY_EXPORTS = {
     "mask_from_spec": ("tosem_tpu.ops.mask_programs", "mask_from_spec"),
     "compile_mask_programs": ("tosem_tpu.ops.mask_programs",
                               "compile_mask_programs"),
+    # distributed training (round 13): gang-scheduled data-parallel
+    # fit() over the cluster fabric — bucketed chain all-reduce over
+    # the transport (or shard_map psum), elastic membership, and the
+    # bit-reproducible left-fold reduction contract
+    "DistributedTrainer": ("tosem_tpu.train.distributed",
+                           "DistributedTrainer"),
+    "DataParallelConfig": ("tosem_tpu.train.distributed",
+                           "DataParallelConfig"),
+    "fit_distributed": ("tosem_tpu.train.distributed",
+                        "fit_distributed"),
+    "make_dp_train_step": ("tosem_tpu.train.distributed",
+                           "make_dp_train_step"),
+    "partition_buckets": ("tosem_tpu.train.distributed",
+                          "partition_buckets"),
+    "TrainWorkerLost": ("tosem_tpu.train.distributed",
+                        "TrainWorkerLost"),
+    "AsyncCheckpointer": ("tosem_tpu.train.checkpoint",
+                          "AsyncCheckpointer"),
 }
 
 __all__ = sorted(_LAZY_EXPORTS)

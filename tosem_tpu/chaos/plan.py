@@ -30,7 +30,7 @@ from typing import Any, Dict, List, Optional
 VALID_SITES = (
     "runtime.dispatch", "runtime.result", "runtime.store",
     "serve.dispatch", "serve.decode_step", "serve.route", "tune.step",
-    "cluster.submit", "train.step",
+    "cluster.submit", "train.step", "train.dist_step",
 )
 
 VALID_ACTIONS = {
@@ -55,6 +55,10 @@ VALID_ACTIONS = {
     "tune.step": ("crash_trial",),
     "cluster.submit": ("kill_node",),
     "train.step": ("preempt",),
+    # fired once per distributed-training step before dispatch:
+    # kill_node hard-kills the node hosting the highest dp rank (the
+    # trainer must shrink the dp axis and continue bit-identically)
+    "train.dist_step": ("kill_node",),
 }
 
 
@@ -215,6 +219,18 @@ def _canned() -> Dict[str, FaultPlan]:
         "router-chaos": FaultPlan(seed=43, name="router-chaos", faults=[
             Fault(site="serve.route", action="kill_router", at=6),
             Fault(site="serve.route", action="kill_node", at=14),
+        ]),
+        # the distributed-training acceptance plan: hard-kill the node
+        # hosting the highest dp rank mid-epoch — the trainer must
+        # SHRINK the dp axis (rewire the reduce chain over survivors,
+        # catch stragglers up worker→worker) and continue, the scenario
+        # then GROWS it back via rejoin — and the whole loss trajectory
+        # must stay bit-identical to single-process fit() throughout,
+        # with zero surfaced errors (the reproducibility contract:
+        # logical shards and the left-fold reduction order are fixed;
+        # membership only moves shard boundaries)
+        "train-cluster": FaultPlan(seed=47, name="train-cluster", faults=[
+            Fault(site="train.dist_step", action="kill_node", at=3),
         ]),
         # the self-healing acceptance plan: a live object evicted, a
         # worker killed mid-task, AND a node agent killed — one run,

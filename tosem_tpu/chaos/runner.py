@@ -527,6 +527,77 @@ def _scenario_router(chaos: ChaosController,
         pool.close(close_nodes=True)
 
 
+def _scenario_train_cluster(chaos: ChaosController,
+                            rep: SurvivalReport) -> None:
+    """The distributed-training acceptance run: a dp job (grain=4
+    logical shards) gang-scheduled over 3 node agents trains while the
+    plan hard-kills the node hosting the highest rank mid-epoch
+    (``train.dist_step`` ordinal 3). The trainer must SHRINK the dp
+    axis — rewire the reduce chain over the survivors, catch
+    stragglers up worker→worker — and continue; the scenario then
+    GROWS it back (rejoin bootstraps params from rank 0). The whole
+    loss trajectory must be BIT-identical to single-process ``fit()``
+    at equal global batch (logical shards and the left-fold reduction
+    order are fixed; membership only moves shard boundaries), with
+    zero surfaced errors."""
+    from tosem_tpu.cluster.node import RemoteNode
+    from tosem_tpu.cluster.supervisor import NodePool
+    from tosem_tpu.train.distributed import (DataParallelConfig,
+                                             DistributedTrainer,
+                                             demo_job, make_dp_train_step)
+
+    jobkw = dict(towers=3, dim=16, batch=16, grain=4, seed=7)
+    job = demo_job(**jobkw)
+    state = job.init_state()
+    step_fn = make_dp_train_step(job)
+    ref = []
+    for _ in range(10):
+        state, m = step_fn(state)
+        ref.append(m["loss"])
+
+    pool = NodePool(miss_threshold=1, probe_timeout=3.0)
+    tr = None
+    errors = 0
+    losses: List[float] = []
+    try:
+        for i in range(3):
+            pool.add_node(RemoteNode.spawn_local(num_workers=1),
+                          name=f"n{i}")
+        cfg = DataParallelConfig(grain=4, job="train-cluster")
+        tr = DistributedTrainer("tosem_tpu.train.distributed:demo_job",
+                                jobkw, cfg, backend="nodes", world=3,
+                                pool=pool)
+        try:
+            tr.fit(6)          # the plan kills a node at ordinal 3
+            tr.add_worker()    # rejoin: grow the dp axis back
+            losses = tr.fit(10)
+        except BaseException as e:
+            errors += 1
+            rep.notes.append(f"fit surfaced {type(e).__name__}: {e}")
+        inj = chaos.injections("train.dist_step")
+        st = tr.stats()
+        rep.counts["steps"] = len(losses)
+        rep.counts["errors_surfaced"] = errors
+        rep.counts["nodes_killed"] = len(
+            [e for e in inj if e["action"] == "kill_node"])
+        rep.counts["shrinks"] = st["shrinks"]
+        rep.counts["grows"] = st["grows"]
+        rep.counts["world"] = st["world"]
+        rep.counts["losses_bit_identical"] = int(losses == ref)
+        rep.counts["nodes_surviving"] = len(pool.live_nodes())
+        rep.ok = (errors == 0 and losses == ref
+                  and rep.counts["nodes_killed"] >= 1
+                  and rep.counts["shrinks"] >= 1
+                  and rep.counts["grows"] >= 1)
+        if losses != ref:
+            rep.notes.append(f"loss trajectory diverged: ref {ref} "
+                             f"got {losses}")
+    finally:
+        if tr is not None:
+            tr.close()
+        pool.close(close_nodes=True)
+
+
 SCENARIOS: Dict[str, Callable[[ChaosController, SurvivalReport], None]] = {
     "worker-carnage": _scenario_runtime,
     "serve-flap": _scenario_serve,
@@ -539,6 +610,7 @@ SCENARIOS: Dict[str, Callable[[ChaosController, SurvivalReport], None]] = {
     "decode-chaos": _scenario_decode,
     "decode-migrate": _scenario_decode_migrate,
     "router-chaos": _scenario_router,
+    "train-cluster": _scenario_train_cluster,
 }
 
 

@@ -29,7 +29,8 @@ CONFIGS = ("gemm", "timing_check", "conv_sweep", "allreduce",
            "flash_autotune", "autotune_decode_pages", "flash_sparse",
            "detection_train", "detection_infer", "pointpillars_infer",
            "speech_train", "serve_bench", "decode_bench",
-           "decode_scenarios", "cluster_bench", "analysis")
+           "decode_scenarios", "cluster_bench", "train_bench",
+           "analysis")
 
 
 def make_flags() -> FlagSet:
@@ -1071,6 +1072,20 @@ def run_cluster_bench(fs: FlagSet) -> List[Any]:
     return rows
 
 
+def run_train_bench(fs: FlagSet) -> List[Any]:
+    """Distributed-training microbench as a capture-harness leg: the
+    bucketed-overlap vs serialized all-reduce A/B on the paced-wire
+    dp4 job, sync vs async checkpoint on-step cost, and the dp4 vs
+    single-process bit-identity pin (see
+    :mod:`tosem_tpu.train.bench_train`). Rows land under the
+    ``train_bench`` config."""
+    from tosem_tpu.train.bench_train import run_train_benchmarks
+    rows = run_train_benchmarks(trials=2, min_s=0.4)
+    for r in rows:
+        r.config = "train_bench"
+    return rows
+
+
 def run_analysis(fs: FlagSet) -> List[Any]:
     """Study analysis layer (L8): classify this repo's test suite into the
     RQ3/RQ4 taxonomy and correlate the bench CSVs — the consumer role of
@@ -1147,6 +1162,7 @@ RUNNERS = {
     "decode_bench": run_decode_bench,
     "decode_scenarios": run_decode_scenarios,
     "cluster_bench": run_cluster_bench,
+    "train_bench": run_train_bench,
     "analysis": run_analysis,
 }
 

@@ -104,6 +104,7 @@ class HeadJournal:
         trials: Dict[str, Dict[str, Any]] = {}
         deployments: Dict[str, Dict[str, Any]] = {}
         placements: Dict[str, Dict[str, Any]] = {}  # replica_id -> event
+        train_jobs: Dict[str, Dict[str, Any]] = {}  # job -> progress
         for e in events:
             ev = e.get("event")
             if ev == "node_added":
@@ -128,9 +129,29 @@ class HeadJournal:
                 placements[e["replica_id"]] = e
             elif ev == "replica_removed":
                 placements.pop(e["replica_id"], None)
+            elif ev == "train_started":
+                train_jobs[e["job"]] = {"step": 0,
+                                        "world": e.get("world"),
+                                        "grain": e.get("grain"),
+                                        "finished": False}
+            elif ev == "train_step_done":
+                tj = train_jobs.setdefault(e["job"], {})
+                tj["step"] = int(e["step"])
+                # fit() is resumable: a step AFTER a train_finished
+                # means the job is live again (finished replays only
+                # if it is the job's last word)
+                tj["finished"] = False
+            elif ev in ("train_shrunk", "train_grown"):
+                tj = train_jobs.setdefault(e["job"], {"finished": False})
+                tj["world"] = e.get("world")
+                tj["step"] = max(int(e.get("step", 0)),
+                                 int(tj.get("step", 0)))
+            elif ev == "train_finished":
+                train_jobs.setdefault(e["job"], {})["finished"] = True
         return {"nodes": nodes, "outstanding_work": work,
                 "outstanding_trials": trials,
-                "deployments": deployments, "placements": placements}
+                "deployments": deployments, "placements": placements,
+                "train_jobs": train_jobs}
 
 
 # ------------------------------------------------------ failure detector
@@ -507,6 +528,9 @@ class NodePool:
         # outlived the head, re-placing the rest)
         pool.deployments = state["deployments"]
         pool.placements = state["placements"]
+        # training progress at crash time: which jobs were live and the
+        # last journaled step — what a recovered head resumes from
+        pool.train_jobs = state["train_jobs"]
         return pool
 
     def close(self, close_nodes: bool = False) -> None:

@@ -41,6 +41,7 @@ import queue
 import socket
 import struct
 import threading
+import time
 from typing import Any, Dict, List, Optional
 
 from tosem_tpu.obs import metrics as _metrics
@@ -265,6 +266,7 @@ class TensorReceiver:
         self._received = 0
         self._errors = 0
         self._bytes = 0
+        self._intr_seq = 0
         self._last_error = ""
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True,
@@ -421,11 +423,17 @@ class TensorReceiver:
             ) -> ReceivedTensors:
         """The stream sent with ``meta["key"] == key`` (the migration
         adopt path — streams land in any order). Raises
-        :class:`TimeoutError` when it never arrives."""
+        :class:`TimeoutError` when it never arrives, or
+        :class:`TransportError` when :meth:`interrupt` wakes the wait
+        (a peer died — there is no point riding out the timeout)."""
         import time as _time
         deadline = None if timeout is None else _time.monotonic() + timeout
         with self._cv:
+            entry_seq = self._intr_seq
             while str(key) not in self._by_key:
+                if self._intr_seq != entry_seq:
+                    raise TransportError(
+                        f"wait for stream {key!r} interrupted")
                 remaining = (None if deadline is None
                              else deadline - _time.monotonic())
                 if remaining is not None and remaining <= 0:
@@ -435,6 +443,18 @@ class TensorReceiver:
                         f"(last transport error: {last})")
                 self._cv.wait(timeout=remaining)
             return self._by_key.pop(str(key))
+
+    def interrupt(self) -> None:
+        """Wake every blocked :meth:`pop` and fail it with
+        :class:`TransportError` NOW — the caller learned out-of-band
+        (a failure detector, a dead peer) that the streams it is
+        waiting for can never arrive, so riding out the timeout only
+        delays recovery. The receiver keeps serving: committed streams
+        stay claimable and waits entered after this call are
+        unaffected."""
+        with self._cv:
+            self._intr_seq += 1
+            self._cv.notify_all()
 
     def put_back(self, key: str, rx: ReceivedTensors) -> None:
         """Re-park a popped stream under its key (a consumer that hit
@@ -470,20 +490,32 @@ class TensorReceiver:
 def send_tensors(address: str, meta: Dict[str, Any],
                  arrays: Dict[str, Any], *,
                  chunk_bytes: int = DEFAULT_CHUNK_BYTES,
-                 timeout: float = 60.0) -> int:
+                 timeout: float = 60.0,
+                 pace_bps: Optional[float] = None) -> int:
     """Stream ``arrays`` (name → ndarray) to a
     :class:`TensorReceiver` at ``address``; blocks until the receiver
     COMMITTED the stream (sealed into its store). Returns payload
     bytes sent. ``meta`` rides the header frame verbatim (JSON-safe
-    values only); set ``meta["key"]`` for by-key retrieval."""
+    values only); set ``meta["key"]`` for by-key retrieval.
+
+    ``pace_bps`` emulates a bandwidth-limited interconnect: chunk sends
+    are paced (sleeps, which burn no CPU and release the GIL) so the
+    stream's payload rate is ≤ ``pace_bps`` bytes/second. On a
+    CPU-saturated single host, loopback transfer time is pure CPU work
+    (memcpy + syscalls), so nothing can hide behind it; pacing restores
+    the cross-node regime — wire time the host CPUs do NOT pay for —
+    which is what comms/compute overlap actually hides on a cluster."""
     import numpy as np
     if chunk_bytes < 1:
         raise ValueError("chunk_bytes must be >= 1")
     specs, views, total = [], [], 0
     for name, arr in arrays.items():
         a = np.ascontiguousarray(arr)
+        # ascontiguousarray coerces 0-d scalars to shape (1,): the spec
+        # records the ORIGINAL shape so a streamed scalar (train-state
+        # step counters) arrives 0-d, not silently rank-1
         specs.append({"name": str(name), "dtype": str(a.dtype),
-                      "shape": [int(d) for d in a.shape],
+                      "shape": [int(d) for d in np.shape(arr)],
                       "offset": total, "nbytes": int(a.nbytes)})
         # custom dtypes (bfloat16 via ml_dtypes) refuse the buffer
         # protocol — a flat uint8 view of the same memory does not
@@ -505,6 +537,7 @@ def send_tensors(address: str, meta: Dict[str, Any],
         try:
             sock.sendall(MAGIC + _HLEN.pack(len(header)) + header)
             idx, off = 0, 0
+            t0 = time.monotonic()
             for v in views:
                 pos = 0
                 while pos < v.nbytes:
@@ -514,6 +547,12 @@ def send_tensors(address: str, meta: Dict[str, Any],
                     pos += n
                     off += n
                     idx += 1
+                    if pace_bps:
+                        # sleep until the cumulative payload rate drops
+                        # back under the emulated wire bandwidth
+                        lag = off / pace_bps - (time.monotonic() - t0)
+                        if lag > 0:
+                            time.sleep(lag)
             sock.sendall(_CHUNK.pack(_FIN_INDEX, off, 0))
             ack = _recv_exact(sock, 2, "ack")
         except socket.timeout:

@@ -342,6 +342,50 @@ def cluster_serve_metrics(registry: Optional[Registry] = None
     }
 
 
+def train_metrics(registry: Optional[Registry] = None) -> Dict[str, Metric]:
+    """The distributed-training plane's instruments, defined once (the
+    metric_defs.h discipline) and fed driver-side by
+    :class:`~tosem_tpu.train.distributed.DistributedTrainer` (workers
+    report per-bucket reduce stats in their step results — the router-
+    rollup pattern, so multi-process workers need no scrape). All are
+    labelled by job:
+
+    - ``train_steps_total`` (counter): global optimizer steps applied.
+    - ``train_examples_per_s`` (gauge): global-batch examples per
+      second of the most recent step — the throughput the overlap
+      engine is supposed to raise.
+    - ``train_allreduce_bytes_total`` (counter, labels job/bucket):
+      gradient payload bytes moved per all-reduce bucket (chain
+      forwards + broadcast legs).
+    - ``train_allreduce_ms`` (histogram, labels job/bucket): wall time
+      of one bucket's chain reduce — under overlap this hides behind
+      backward, but the histogram still shows what WOULD serialize.
+    - ``train_dp_size`` (gauge): current worker count of the dp axis —
+      elasticity (shrink on node death, grow on rejoin) moves this.
+    """
+    reg = registry or DEFAULT
+    return {
+        "steps": reg.counter(
+            "train_steps_total",
+            "global optimizer steps applied", labels=("job",)),
+        "examples_per_s": reg.gauge(
+            "train_examples_per_s",
+            "global-batch examples per second (latest step)",
+            labels=("job",)),
+        "allreduce_bytes": reg.counter(
+            "train_allreduce_bytes_total",
+            "gradient all-reduce payload bytes by bucket",
+            labels=("job", "bucket")),
+        "allreduce_ms": reg.histogram(
+            "train_allreduce_ms",
+            "wall time of one bucket's gradient all-reduce",
+            labels=("job", "bucket"), buckets=_BATCH_WAIT_BUCKETS),
+        "dp_size": reg.gauge(
+            "train_dp_size",
+            "current data-parallel worker count", labels=("job",)),
+    }
+
+
 class MetricsServer:
     """Tiny /metrics HTTP endpoint (prometheus_exporter.py role)."""
 

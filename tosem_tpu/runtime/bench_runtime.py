@@ -487,6 +487,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "(ops/bench_sparse.py) instead — t8192 "
                         "LocalMask(1024) vs the dense-causal flash "
                         "path, interleaved A/B")
+    p.add_argument("--train", action="store_true",
+                   help="run the distributed-training benches "
+                        "(train/bench_train.py) instead — bucketed-"
+                        "overlap vs serialized all-reduce on a comms-"
+                        "dominated dp4 job, async vs sync checkpoint "
+                        "step cost, and the dp-vs-single-process "
+                        "bit-identity pin")
     p.add_argument("--scenario", default=None,
                    choices=("window", "beam", "spec", "decode",
                             "migrate"),
@@ -514,6 +521,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     elif args.sparse:
         from tosem_tpu.ops.bench_sparse import GATED_SPARSE_BENCHES
         gated = GATED_SPARSE_BENCHES
+    elif args.train:
+        from tosem_tpu.train.bench_train import GATED_TRAIN_BENCHES
+        gated = GATED_TRAIN_BENCHES
     else:
         gated = GATED_BENCHES
     only = None
@@ -554,6 +564,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         rows = run_sparse_benchmarks(trials=args.trials,
                                      min_s=args.min_s,
                                      quiet=args.quiet, only=only)
+    elif args.train:
+        from tosem_tpu.train.bench_train import run_train_benchmarks
+        rows = run_train_benchmarks(trials=args.trials,
+                                    min_s=args.min_s,
+                                    quiet=args.quiet, only=only)
     else:
         rows = run_microbenchmarks(num_workers=args.workers,
                                    trials=args.trials,

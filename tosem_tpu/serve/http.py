@@ -69,7 +69,17 @@ class HttpIngress:
                     # data-plane telemetry: queue depth, batch sizes,
                     # per-request outcome counts — the operator's view
                     # of whether batching is actually engaging
-                    self._reply(200, {"deployments": serve.stats()})
+                    payload = {"deployments": serve.stats()}
+                    # distributed-training jobs share the stats surface
+                    # (dp size, step, examples/s) when any are live
+                    try:
+                        from tosem_tpu.train.distributed import jobs_stats
+                        train = jobs_stats()
+                        if train:
+                            payload["train"] = train
+                    except Exception:
+                        pass     # telemetry never fails the endpoint
+                    self._reply(200, payload)
                 else:
                     self._reply(404, {"error": "POST to /<endpoint>"})
 

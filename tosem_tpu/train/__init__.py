@@ -2,7 +2,8 @@ from tosem_tpu.train.trainer import (TrainState, TrainingPreempted,
                                      create_train_state, fit,
                                      make_train_step, cross_entropy_loss,
                                      shard_batch)
-from tosem_tpu.train.checkpoint import (CheckpointCorruptError,
+from tosem_tpu.train.checkpoint import (AsyncCheckpointer,
+                                        CheckpointCorruptError,
                                         latest_checkpoint, restore_checkpoint,
                                         restore_latest, restore_or_init,
                                         save_checkpoint, save_versioned)

@@ -1,0 +1,253 @@
+"""Distributed-training microbench — `cli microbench --train`.
+
+Three legs, all on the CPU arm (threads backend over real transport
+sockets — the same fold/chain code the nodes backend runs), following
+the bench-noise protocol: interleaved A/B rounds so both arms share the
+host phase, per-round values recorded so ``--save`` floors baselines at
+the min across rounds, and the gated rows are the phase-immune
+in-round ratios:
+
+- **Overlap vs serialized comms** on a comms-dominated staged model
+  (8 towers ⇒ 8 buckets; deep-linear backward sized to the wire time):
+  the bucketed chain reduce launched per-stage must hide behind the
+  remaining backward — the gated ``train_overlap_speedup`` ratio is
+  asserted ≥ 1.3× in-bench (best round; the floor rides the baseline
+  JSON). The gradient streams ride a PACED wire
+  (``DataParallelConfig.wire_bps``, 40 MB/s): on this 2-CPU host a
+  loopback transfer is pure CPU work (memcpy + syscalls), so nothing
+  can hide behind it and an unpaced A/B measures thread scheduling,
+  not comms hiding (measured 0.7–1.0x both directions); pacing
+  restores the cross-node regime — wire time the host CPUs do not
+  pay — which is exactly what overlap hides on a real cluster.
+- **Async vs sync checkpointing**: the ON-STEP cost of a checkpoint —
+  wall time the training loop spends inside the save call each step —
+  sync (serialize + per-file fsync + manifest hash, ~55 ms for the
+  128-leaf tree) vs async (owned host snapshot + join-previous-write,
+  ~7 ms). The model is a 128-leaf tree because durability cost is
+  per-FILE, as in real many-tensor checkpoints; each save is followed
+  by a ~200 ms compute step, the window the background write drains
+  into. Measured at the call site (the same primitives ``fit()``
+  dispatches on) rather than as total fit() wall: the write's CPU
+  portion contends with compute on this 2-CPU host either way, so
+  total wall measures host capacity, not what the loop stopped
+  waiting for. The gated ``train_ckpt_async_saving`` row is the
+  fraction of the on-step checkpoint cost async removes — asserted
+  ≥ 0.8 (best round).
+- **dp parity pin**: dp=4 over the transport chain vs the
+  single-process reference — BIT-identical loss trajectories, hard
+  asserted; the row exists so the gate notices if the pin ever stops
+  running.
+
+Note on what is NOT measured: raw multi-process scaling. The 2-CPU CI
+host saturates from one process, so absolute steps/s here reflects the
+fold/transport machinery, not cluster capacity — the gated rows are
+deterministic ratios and the parity pin, per the ISSUE's evidence
+protocol.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import tempfile
+import time
+from typing import List, Optional
+
+from tosem_tpu.serve.bench_common import SuiteEmitter
+from tosem_tpu.utils.results import ResultRow
+
+GATED_TRAIN_BENCHES = (
+    "train_step_overlap", "train_overlap_speedup",
+    "train_ckpt_async_overhead_ms", "train_ckpt_async_saving",
+    "train_dp_parity",
+)
+
+# comms-dominated synthetic: 8 towers x 256x256 fp32 = 8 buckets of
+# 256 KB gradient each per step; depth=8 deepens backward (FLOPs
+# without payload) so backward wall ~ wire wall at 40 MB/s — the
+# regime where serializing comms visibly stretches the step and
+# overlap hides it behind the remaining towers' backward
+_OVERLAP_JOB = dict(towers=8, dim=256, batch=64, grain=4, seed=11,
+                    depth=8)
+_OVERLAP_WIRE_BPS = 40e6
+_PARITY_JOB = dict(towers=3, dim=16, batch=16, grain=4, seed=7)
+
+
+def _steps_per_s(trainer, overlap: bool, min_s: float) -> float:
+    trainer.overlap = overlap
+    target = len(trainer.history) + 2
+    t0 = time.perf_counter()
+    n = 0
+    while True:
+        trainer.fit(target)
+        n += 2
+        target += 2
+        dt = time.perf_counter() - t0
+        if dt >= min_s:
+            return n / dt
+
+
+def _bench_overlap(em: SuiteEmitter, trials: int, min_s: float) -> None:
+    ids = {"train_step_overlap", "train_step_serial",
+           "train_overlap_speedup"}
+    if not any(em.want(b) for b in ids):
+        return
+    from tosem_tpu.train.distributed import (DataParallelConfig,
+                                             DistributedTrainer)
+    cfg = DataParallelConfig(grain=4, bucket_bytes=1 << 20,
+                             job="bench-overlap",
+                             transport_capacity=64 << 20,
+                             wire_bps=_OVERLAP_WIRE_BPS)
+    tr = DistributedTrainer("tosem_tpu.train.distributed:demo_job",
+                            dict(_OVERLAP_JOB), cfg, backend="threads",
+                            world=4)
+    try:
+        _steps_per_s(tr, True, 0.2)          # warmup: jits + sockets
+        ov, se, ratios = [], [], []
+        for _ in range(trials):
+            a = _steps_per_s(tr, True, min_s)
+            b = _steps_per_s(tr, False, min_s)
+            ov.append(a)
+            se.append(b)
+            ratios.append(a / b)
+    finally:
+        tr.close()
+    em.emit("train_step_overlap",
+            "dp4 steps overlapped comms", ov, unit="steps/s")
+    em.emit("train_step_serial",
+            "dp4 steps serialized comms", se, unit="steps/s")
+    em.emit("train_overlap_speedup",
+            "train overlap over serialized", ratios, unit="x")
+    best = max(ratios)
+    assert best >= 1.3, (
+        f"bucketed-overlap all-reduce speedup {best:.2f}x < 1.3x vs the "
+        f"serialized-comms arm (rounds {[round(r, 2) for r in ratios]}) "
+        "— comms are no longer hiding behind backward")
+
+
+def _bench_parity(em: SuiteEmitter) -> None:
+    if not em.want("train_dp_parity"):
+        return
+    import jax
+
+    from tosem_tpu.train.distributed import (DataParallelConfig,
+                                             DistributedTrainer,
+                                             demo_job, make_dp_train_step)
+    from tosem_tpu.train.trainer import fit
+    job = demo_job(**_PARITY_JOB)
+    _, ref_hist = fit(job.init_state(), make_dp_train_step(job),
+                      lambda s: None, 5, rng=jax.random.PRNGKey(0))
+    ref = [h["loss"] for h in ref_hist]
+    cfg = DataParallelConfig(grain=4, bucket_bytes=1024,
+                             job="bench-parity",
+                             transport_capacity=8 << 20)
+    tr = DistributedTrainer("tosem_tpu.train.distributed:demo_job",
+                            dict(_PARITY_JOB), cfg, backend="threads",
+                            world=4)
+    try:
+        hist = tr.fit(5)
+    finally:
+        tr.close()
+    assert hist == ref, (
+        f"dp=4 loss trajectory diverged from single-process fit(): "
+        f"{hist} vs {ref} — the bit-identity contract is broken")
+    em.emit("train_dp_parity", "dp4 vs single-process bit-identity",
+            [1.0], unit="identical")
+
+
+def _bench_ckpt(em: SuiteEmitter, trials: int, min_s: float) -> None:
+    ids = {"train_ckpt_sync_overhead_ms", "train_ckpt_async_overhead_ms",
+           "train_ckpt_async_saving"}
+    if not any(em.want(b) for b in ids):
+        return
+    import jax
+    import jax.numpy as jnp
+
+    from tosem_tpu.train.checkpoint import (AsyncCheckpointer,
+                                            save_versioned)
+
+    # 128 leaves × 64 KB: the write pays per-file write+fsync plus the
+    # manifest's re-read+hash — the dominant on-step cost the async
+    # writer removes; the snapshot memcpy it keeps is ~7 ms. The K=32
+    # matmul chain (~200 ms/step) is the compute window the background
+    # write drains into before the next save's join.
+    L, d, K = 128, 128, 32
+
+    def init():
+        return {"step": jnp.zeros((), jnp.int32),
+                "c": jnp.ones((512, 512), jnp.float32),
+                "params": {f"p{i:03d}": jnp.ones((d, d), jnp.float32)
+                           for i in range(L)}}
+
+    @jax.jit
+    def step(state):
+        m = state["c"]
+        for _ in range(K):
+            m = (m @ m) * (1.0 / 512.0)
+        params = jax.tree_util.tree_map(lambda w: w * 0.999,
+                                        state["params"])
+        return {"step": state["step"] + 1, "c": m, "params": params}
+
+    steps = 8
+    st = init()
+    st = step(st)
+    jax.block_until_ready(st["c"])                         # warmup jit
+    sync_ms, async_ms, savings = [], [], []
+    root = tempfile.mkdtemp(prefix="bench_train_ckpt_")
+    try:
+        for t in range(trials):
+            # interleaved A/B: each arm runs the same compute/save
+            # cadence in the same host phase; timed region is the save
+            # call alone (what the loop stops for)
+            d_sync = os.path.join(root, f"s{t}")
+            st = init()
+            costs = []
+            for s in range(steps):
+                st = step(st)
+                jax.block_until_ready(st["c"])
+                t0 = time.perf_counter()
+                save_versioned(d_sync, s + 1, st, keep=2)
+                costs.append(time.perf_counter() - t0)
+            os_ms = sum(costs) / steps * 1e3
+
+            d_async = os.path.join(root, f"a{t}")
+            st = init()
+            costs = []
+            with AsyncCheckpointer(d_async, keep=2) as saver:
+                for s in range(steps):
+                    st = step(st)
+                    jax.block_until_ready(st["c"])
+                    t0 = time.perf_counter()
+                    saver.save(s + 1, st)
+                    costs.append(time.perf_counter() - t0)
+            oa_ms = sum(costs) / steps * 1e3
+            sync_ms.append(os_ms)
+            async_ms.append(oa_ms)
+            savings.append(1.0 - oa_ms / os_ms)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    em.emit("train_ckpt_sync_overhead_ms",
+            "sync checkpoint on-step overhead", sync_ms, unit="ms",
+            lower_is_better=True)
+    em.emit("train_ckpt_async_overhead_ms",
+            "async checkpoint on-step overhead", async_ms, unit="ms",
+            lower_is_better=True)
+    em.emit("train_ckpt_async_saving",
+            "fraction of on-step checkpoint cost removed", savings,
+            unit="ratio")
+    best = max(savings)
+    assert best >= 0.8, (
+        f"async checkpointing removed only {best:.0%} of the on-step "
+        f"cost (rounds {[round(s, 2) for s in savings]}; sync "
+        f"{[round(m, 1) for m in sync_ms]}ms vs async "
+        f"{[round(m, 1) for m in async_ms]}ms) — the background writer "
+        "is back on the hot path")
+
+
+def run_train_benchmarks(trials: int = 3, min_s: float = 0.4,
+                         quiet: bool = False,
+                         only: Optional[set] = None) -> List[ResultRow]:
+    em = SuiteEmitter("train", only=only)
+    _bench_parity(em)
+    _bench_overlap(em, trials, min_s)
+    _bench_ckpt(em, trials, min_s)
+    return em.flush(quiet)

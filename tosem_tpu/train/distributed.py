@@ -1,0 +1,1367 @@
+"""Distributed data-parallel training over the cluster fabric.
+
+Serving crossed node boundaries in PRs 8/11; this module makes
+*training* the third cluster workload. The design center is the
+reproducibility contract, and everything else falls out of it:
+
+- **Logical shards, physical workers.** A job's data parallelism is a
+  fixed ``grain`` of L *logical shards* per step — shard ``s`` gets
+  rows ``[s·B/L, (s+1)·B/L)`` of the global batch and the PRNG stream
+  ``fold_in(fold_in(rng, step), s)`` (the PR-2 per-step ``fold_in``
+  discipline, extended per-rank). Workers own *contiguous runs* of
+  shards; membership changes (node death ⇒ shrink, rejoin ⇒ grow) only
+  move shard boundaries, never the shards themselves.
+- **Strict left-fold reduction.** The global gradient is the strict
+  left fold ``((g₀+g₁)+g₂)+…`` over logical shards, in shard order.
+  A chain all-reduce threads the running partial through the workers in
+  rank order; each worker folds its own shards' gradients one at a time
+  onto the incoming partial, so the *grouping* of the float additions
+  is identical for every world size — dp=4 ``fit()`` is bit-identical
+  to single-process ``fit()`` at equal global batch, and stays
+  bit-identical through a mid-epoch shrink or grow.
+- **Two reduction lowerings, one interface.** Off-chip (multi-process
+  CPU arm) the fold rides :mod:`tosem_tpu.cluster.transport` chunked
+  streams worker→worker — the spill-format wire, mapped-in-place
+  arrival, no driver hop. On-chip the same step lowers to a
+  ``shard_map`` ``psum`` over a dp mesh (:func:`make_dp_train_step`
+  with ``reduce="shard_map"``) — XLA's AllReduce over ICI. The arms are
+  float-parity (not bit) against each other; the bit contract holds
+  within each arm.
+- **Bucketed all-reduce overlapped with backward.** Parameters are
+  grouped into size-targeted buckets (:func:`partition_buckets`;
+  uneven tails and oversized leaves get their own buckets). Jobs that
+  declare *gradient stages* (disjoint parameter groups whose losses are
+  independent — the DDP bucket-hook analog) have each bucket's chain
+  reduce launched the moment its stage's backward completes, so comms
+  hide behind the remaining backward compute; ``overlap=False`` keeps
+  the serialized-comms mode as the measured baseline arm
+  (``cli microbench --train`` gates the A/B).
+
+The worker is an ordinary replica-plane backend
+(:class:`TrainWorkerBackend` hosted by
+:mod:`tosem_tpu.serve.replica_worker`), so the nodes backend rides the
+PR-8 machinery unchanged: gang reservation over ``NodePool`` agents,
+journaled placement, lifeline-kill on node death. Parameter traffic
+(elastic catch-up, rejoin bootstrap, driver state fetch) rides the same
+transport streams as gradients.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import asdict, dataclass
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from tosem_tpu.chaos import hooks as _chaos
+from tosem_tpu.cluster.transport import (TensorReceiver, TransportError,
+                                         send_tensors)
+from tosem_tpu.obs import metrics as _metrics
+
+__all__ = [
+    "DataParallelConfig", "DPJob", "Bucket", "partition_buckets",
+    "ChainReducer", "TrainWorkerBackend", "DistributedTrainer",
+    "fit_distributed", "make_dp_train_step", "demo_job", "jobs_stats",
+    "TrainWorkerLost",
+]
+
+_LOSS_KEY = "___loss"
+
+
+class TrainWorkerLost(RuntimeError):
+    """Every worker (or the last usable configuration) was lost."""
+
+
+# --------------------------------------------------------------- config
+
+
+@dataclass
+class DataParallelConfig:
+    """Knobs of one data-parallel job. ``grain`` is the number of
+    logical shards — FIXED for the job's lifetime (it defines the
+    reduction order and therefore the loss trajectory); the worker
+    count is what flexes under elasticity, bounded by ``1 <= world <=
+    grain``."""
+
+    grain: int = 4
+    bucket_bytes: int = 1 << 20
+    overlap: bool = True
+    job: str = "train"
+    transport_capacity: int = 32 << 20
+    chunk_bytes: int = 1 << 18
+    reduce_timeout: float = 120.0
+    # emulated interconnect bandwidth for the gradient streams
+    # (bytes/s; None = unpaced loopback). On a single CPU-saturated
+    # host loopback transfer is pure CPU work, so overlap has nothing
+    # to hide behind; pacing restores the cross-node regime the
+    # overlap engine exists for (see transport.send_tensors pace_bps)
+    wire_bps: Optional[float] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "DataParallelConfig":
+        return cls(**(d or {}))
+
+
+# --------------------------------------------------------------- buckets
+
+
+@dataclass(frozen=True)
+class Bucket:
+    """One all-reduce unit: a run of consecutive gradient leaves of one
+    stage, targeted at ``bucket_bytes`` (an oversized leaf rides
+    alone — the uneven tail case)."""
+
+    bid: int
+    stage: int
+    leaves: Tuple[int, ...]
+    nbytes: int
+
+
+def partition_buckets(leaf_meta: Sequence[Tuple[int, int]],
+                      bucket_bytes: int) -> List[Bucket]:
+    """Group leaves (``(nbytes, stage)`` per flat-leaf index, in leaf
+    order) into size-targeted buckets. Buckets never span stages (a
+    bucket's readiness is its stage's backward completing); a leaf that
+    alone exceeds ``bucket_bytes`` still gets a bucket (its own);
+    dtype-mixed trees work because leaves are never concatenated, only
+    grouped."""
+    if bucket_bytes < 1:
+        raise ValueError("bucket_bytes must be >= 1")
+    out: List[Bucket] = []
+    cur: List[int] = []
+    cur_bytes = 0
+    cur_stage = -1
+
+    def flush():
+        nonlocal cur, cur_bytes
+        if cur:
+            out.append(Bucket(bid=len(out), stage=cur_stage,
+                              leaves=tuple(cur), nbytes=cur_bytes))
+            cur, cur_bytes = [], 0
+
+    for i, (nb, st) in enumerate(leaf_meta):
+        if cur and (st != cur_stage or cur_bytes + nb > bucket_bytes):
+            flush()
+        cur.append(i)
+        cur_bytes += int(nb)
+        cur_stage = int(st)
+    flush()
+    return out
+
+
+# ------------------------------------------------------- the fold (spec)
+
+
+def _fold(acc: Optional[Dict[str, np.ndarray]],
+          g: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    """One left-fold step of the canonical reduction. This helper IS
+    the reduction spec: every arm (local reference, chain transport)
+    sums through it, so the float grouping can never diverge."""
+    if acc is None:
+        return g
+    return {k: np.add(acc[k], g[k]) for k in acc}
+
+
+def _mean_loss(total: np.floating, grain: int) -> float:
+    """Canonical loss normalization (shared by every arm)."""
+    return float(np.float32(total) / np.float32(grain))
+
+
+# --------------------------------------------------------------- the job
+
+
+class DPJob:
+    """One training job: model/optimizer/pipeline, expressed as *gradient
+    stages* over a stage-keyed parameter dict.
+
+    ``params`` is a dict ``{stage_name: subtree}`` with stage names in
+    ascending (sorted) order matching ``stage_losses``. Each
+    ``loss_fn(params, batch_shard, rng) -> scalar`` is differentiated
+    w.r.t. ITS stage's subtree only, so stages must be
+    gradient-disjoint (a single-stage job — the general case — just
+    puts everything under one name). Staging is what buys
+    backward/comms overlap; correctness never depends on it.
+
+    ``batch_fn(step) -> global batch`` must be deterministic in
+    ``step`` — that plus the per-(step, shard) ``fold_in`` PRNG is what
+    makes the loss trajectory a pure function of (job, grain).
+    """
+
+    def __init__(self, *, init_params: Callable[[], Dict[str, Any]],
+                 stage_losses: Sequence[Tuple[str, Callable]],
+                 batch_fn: Callable[[int], Any],
+                 optimizer: Any,
+                 grain: int,
+                 global_batch: int,
+                 seed: int = 0,
+                 mixed_precision: bool = False):
+        import jax
+        names = [n for n, _ in stage_losses]
+        if names != sorted(names):
+            raise ValueError("stage names must be in ascending sorted "
+                             f"order (dict leaf order), got {names}")
+        if global_batch % grain:
+            raise ValueError(f"global_batch {global_batch} not divisible "
+                             f"by grain {grain}")
+        self.stage_names = names
+        self._stage_losses = dict(stage_losses)
+        self.batch_fn = batch_fn
+        self.optimizer = optimizer
+        self.grain = int(grain)
+        self.global_batch = int(global_batch)
+        self.seed = int(seed)
+        self.mixed_precision = bool(mixed_precision)
+        self.init_params = init_params
+        self._jax = jax
+        self._stage_grad_jit: Dict[str, Any] = {}
+        self._apply_jit = None
+        self._batch_cache: Tuple[int, Any] = (-1, None)
+
+    # -- state ---------------------------------------------------------
+
+    def init_state(self) -> Dict[str, Any]:
+        import jax.numpy as jnp
+        params = self.init_params()
+        if sorted(params) != self.stage_names:
+            raise ValueError(f"init_params keys {sorted(params)} != "
+                             f"stage names {self.stage_names}")
+        return {"step": jnp.zeros((), jnp.int32), "params": params,
+                "opt_state": self.optimizer.init(params)}
+
+    def grad_template(self, params: Dict[str, Any]):
+        """→ (leaf_meta [(nbytes, stage)], treedef) of the gradient
+        tree (== the params tree, stage-keyed dict in sorted order)."""
+        jax = self._jax
+        meta: List[Tuple[int, int]] = []
+        for si, name in enumerate(self.stage_names):
+            for leaf in jax.tree_util.tree_leaves(params[name]):
+                meta.append((int(np.dtype(leaf.dtype).itemsize
+                                 * int(np.prod(leaf.shape, dtype=np.int64))),
+                             si))
+        _, treedef = jax.tree_util.tree_flatten(params)
+        return meta, treedef
+
+    # -- per-shard pipeline --------------------------------------------
+
+    def batch_shard(self, step: int, shard: int):
+        """The shard's slice of the deterministic global batch. The
+        global batch is built once per step and sliced per shard, so a
+        worker materializes only what it reads beyond that one call."""
+        cs, cb = self._batch_cache
+        if cs != step:
+            cb = self.batch_fn(step)
+            self._batch_cache = (step, cb)
+        per = self.global_batch // self.grain
+        lo = shard * per
+
+        def cut(x):
+            return x[lo:lo + per] if getattr(x, "ndim", 0) >= 1 else x
+        return self._jax.tree_util.tree_map(cut, cb)
+
+    def shard_rng(self, step: int, shard: int):
+        jax = self._jax
+        root = jax.random.PRNGKey(self.seed)
+        return jax.random.fold_in(jax.random.fold_in(root, step), shard)
+
+    def stage_grad(self, name: str):
+        """Jitted ``(params, batch_shard, rng) -> (loss, grads_subtree)``
+        for one stage — gradient w.r.t. the stage's own subtree, with
+        fp32 master params and optional bf16 compute."""
+        fn = self._stage_grad_jit.get(name)
+        if fn is not None:
+            return fn
+        jax = self._jax
+        import jax.numpy as jnp
+        loss_fn = self._stage_losses[name]
+        mp = self.mixed_precision
+
+        def cast(tree):
+            return jax.tree_util.tree_map(
+                lambda x: x.astype(jnp.bfloat16)
+                if jnp.issubdtype(x.dtype, jnp.floating) else x, tree)
+
+        def lf(sub, params, batch, rng):
+            p = dict(params)
+            p[name] = sub
+            if mp:
+                p = cast(p)      # bf16 compute off the fp32 master copy
+            return loss_fn(p, batch, rng)
+
+        def f(params, batch, rng):
+            loss, grads = jax.value_and_grad(lf)(params[name], params,
+                                                 batch, rng)
+            return loss, grads
+        fn = jax.jit(f)
+        self._stage_grad_jit[name] = fn
+        return fn
+
+    def apply(self, state: Dict[str, Any], summed_grads: Dict[str, Any]
+              ) -> Dict[str, Any]:
+        """Optimizer update from SUMMED (not yet averaged) gradients.
+        Jitted once with donated state buffers — no per-step realloc of
+        params/opt state — and the ``/grain`` normalization lives inside
+        the jit so every arm shares the exact same division."""
+        if self._apply_jit is None:
+            jax = self._jax
+            import optax
+            grain = self.grain
+            opt = self.optimizer
+
+            def ap(st, grads):
+                g = jax.tree_util.tree_map(lambda x: x / grain, grads)
+                updates, opt_state = opt.update(g, st["opt_state"],
+                                                st["params"])
+                params = optax.apply_updates(st["params"], updates)
+                return {"step": st["step"] + 1, "params": params,
+                        "opt_state": opt_state}
+            self._apply_jit = jax.jit(ap, donate_argnums=(0,))
+        return self._apply_jit(state, summed_grads)
+
+    # -- canonical shard gradients -------------------------------------
+
+    def shard_grads(self, state: Dict[str, Any], step: int, shard: int
+                    ) -> Tuple[np.floating, List[np.ndarray]]:
+        """One logical shard's (loss, grad leaves) — loss left-folded
+        over stages in stage order, leaves in grad-tree order. Stages
+        write disjoint leaves, so assembly involves no float adds."""
+        batch = self.batch_shard(step, shard)
+        rng = self.shard_rng(step, shard)
+        loss_acc: Optional[np.floating] = None
+        leaves: List[np.ndarray] = []
+        for name in self.stage_names:
+            loss, grads = self.stage_grad(name)(state["params"], batch, rng)
+            l32 = np.float32(np.asarray(loss))
+            loss_acc = l32 if loss_acc is None else np.float32(
+                np.add(loss_acc, l32))
+            leaves.extend(np.asarray(x)
+                          for x in self._jax.tree_util.tree_leaves(grads))
+        return loss_acc, leaves
+
+
+# -------------------------------------------------------- chain reducer
+
+
+class ChainReducer:
+    """Transport lowering of the strict left fold: the running partial
+    for each bucket enters at rank 0, each rank folds its own shards'
+    gradients one shard at a time (ascending), and the last rank — the
+    holder of the complete fold — streams the result back to everyone.
+    The float grouping is ``((g₀+g₁)+g₂)+…`` regardless of how many
+    workers the shards are spread over, which is the whole bit-identity
+    argument. Byte-exact in flight: arrays ride
+    :func:`tosem_tpu.cluster.transport.send_tensors` raw-bytes streams
+    into the receiver's shm segment, mapped in place on arrival."""
+
+    def __init__(self, capacity: int = 32 << 20,
+                 chunk_bytes: int = 1 << 18,
+                 pace_bps: Optional[float] = None):
+        self.receiver = TensorReceiver(store_capacity=capacity)
+        self.chunk_bytes = int(chunk_bytes)
+        self.pace_bps = pace_bps
+        self.rank = 0
+        self.addrs: List[str] = [self.receiver.address]
+        self.gen = 0
+        self._aborted = False
+
+    @property
+    def address(self) -> str:
+        return self.receiver.address
+
+    def configure(self, rank: int, addrs: Sequence[str], gen: int) -> None:
+        self.rank, self.addrs, self.gen = int(rank), list(addrs), int(gen)
+        self._aborted = False          # a rewire re-arms the chain
+        # drain streams parked by an aborted generation — their keys can
+        # never be popped again and would pin receive-segment pages
+        for k in self.receiver.stats()["pending_keys"]:
+            try:
+                self.receiver.pop(k, timeout=0.05).release()
+            except (TimeoutError, TransportError):
+                pass
+
+    def abort(self) -> None:
+        """Fail the chain NOW (a peer died): every blocked pop wakes
+        with :class:`TransportError`, and reduces entered before the
+        next :meth:`configure` fail fast instead of waiting out their
+        timeout on streams a dead peer can never send. Sticky until
+        the rewire, so late-arriving reduce calls of the broken
+        generation cannot hang either."""
+        self._aborted = True
+        self.receiver.interrupt()
+
+    def _pop(self, key: str, timeout: float):
+        """pop() that also honors a sticky abort: the interrupt wakes
+        waits that are already blocked, the 1 s re-check closes the
+        race where abort() lands between reduce() entry and the pop
+        (the wait would otherwise ride out the full timeout)."""
+        deadline = time.monotonic() + timeout
+        while True:
+            if self._aborted:
+                raise TransportError("reduce chain aborted (peer death)")
+            step = min(1.0, deadline - time.monotonic())
+            if step <= 0:
+                raise TimeoutError(f"stream {key!r} never arrived")
+            try:
+                return self.receiver.pop(key, timeout=step)
+            except TimeoutError:
+                continue
+
+    def reduce(self, tag: str,
+               shard_arrays: Sequence[Dict[str, np.ndarray]],
+               timeout: float = 120.0
+               ) -> Tuple[Dict[str, np.ndarray], Callable[[], None], int]:
+        """Fold ``shard_arrays`` (this worker's shards, ascending) into
+        the chain → (final arrays, release_cb, payload bytes sent).
+        The final arrays may be readonly views over the receive segment;
+        call ``release_cb`` once they are consumed."""
+        world = len(self.addrs)
+        if self._aborted:
+            raise TransportError("reduce chain aborted (peer death)")
+        acc: Optional[Dict[str, np.ndarray]] = None
+        rx = None
+        if self.rank > 0:
+            rx = self._pop(f"p:{tag}", timeout)
+            acc = rx.arrays()
+        for g in shard_arrays:
+            acc = _fold(acc, g)
+        if rx is not None:
+            rx.release()            # folded past the mapped partial
+        if acc is None:
+            raise ValueError("reduce with no local shards and no "
+                             "predecessor partial")
+        sent = 0
+        if world == 1:
+            return acc, (lambda: None), 0
+        if self.rank < world - 1:
+            sent += send_tensors(self.addrs[self.rank + 1],
+                                 {"key": f"p:{tag}"}, acc,
+                                 chunk_bytes=self.chunk_bytes,
+                                 pace_bps=self.pace_bps)
+            fin = self._pop(f"f:{tag}", timeout)
+            return fin.arrays(), fin.release, sent
+        for i, addr in enumerate(self.addrs):
+            if i != self.rank:
+                sent += send_tensors(addr, {"key": f"f:{tag}"}, acc,
+                                     chunk_bytes=self.chunk_bytes,
+                                     pace_bps=self.pace_bps)
+        return acc, (lambda: None), sent
+
+    def close(self) -> None:
+        self.receiver.shutdown()
+
+
+# ------------------------------------------------------- worker backend
+
+
+def resolve_job(ref: str, kwargs: Optional[Dict[str, Any]]) -> DPJob:
+    from tosem_tpu.serve.replica_worker import resolve_backend
+    job = resolve_backend(ref)(**(kwargs or {}))
+    if not isinstance(job, DPJob):
+        raise TypeError(f"job ref {ref!r} did not build a DPJob")
+    return job
+
+
+class TrainWorkerBackend:
+    """One data-parallel rank, hostable two ways: in-process (the
+    threads backend — fast tests, benches) or as a replica-plane
+    process (``node.start_replica`` with this class as ``backend_ref``
+    — the nodes backend, where node death is real SIGKILL via the
+    agent lifeline). All methods ride ``backend_call`` in the replica
+    case; tiny control messages only — gradients and parameters stream
+    worker→worker over the transport."""
+
+    def __init__(self, job_ref: str = "", job_kwargs: Optional[dict] = None,
+                 cfg: Optional[dict] = None, job: Optional[DPJob] = None):
+        self.cfg = (cfg if isinstance(cfg, DataParallelConfig)
+                    else DataParallelConfig.from_dict(cfg))
+        self.job = job if job is not None else resolve_job(job_ref,
+                                                           job_kwargs)
+        if self.job.grain != self.cfg.grain:
+            raise ValueError(f"job grain {self.job.grain} != cfg grain "
+                             f"{self.cfg.grain}")
+        self.reducer = ChainReducer(capacity=self.cfg.transport_capacity,
+                                    chunk_bytes=self.cfg.chunk_bytes,
+                                    pace_bps=self.cfg.wire_bps)
+        self._state: Optional[Dict[str, Any]] = None
+        self._history: List[float] = []
+        self._shards: List[int] = []
+        self._gen = -1
+        self._rank = 0
+        self._world = 1
+        self._buckets: List[Bucket] = []
+        self._treedef = None
+        self._saver = None
+        self._step_lock = threading.Lock()
+
+    # -- control plane -------------------------------------------------
+
+    def transport_address(self) -> str:
+        return self.reducer.address
+
+    def configure(self, rank: int, world: int, addrs: Sequence[str],
+                  shards: Sequence[int], gen: int,
+                  ckpt_dir: Optional[str] = None,
+                  resume: bool = True) -> Dict[str, Any]:
+        """(Re)wire this rank into the ring: its position, the ring
+        addresses, and its contiguous logical-shard run. First call
+        initializes (or checkpoint-restores) the replicated state."""
+        shards = [int(s) for s in shards]
+        if shards != sorted(shards):
+            raise ValueError("shard run must be ascending")
+        with self._step_lock:
+            if self._state is None:
+                state = self.job.init_state()
+                if ckpt_dir and resume:
+                    from tosem_tpu.train import checkpoint as _ckpt
+                    found = _ckpt.restore_latest(ckpt_dir, state)
+                    if found is not None:
+                        _, state, extra = found
+                        self._history = [float(v) for v in
+                                         (extra or {}).get("history", [])]
+                self._state = state
+                meta, self._treedef = self.job.grad_template(
+                    state["params"])
+                self._leaf_meta = meta
+                self._buckets = partition_buckets(meta,
+                                                  self.cfg.bucket_bytes)
+            self._rank, self._world = int(rank), int(world)
+            self._shards = shards
+            self._gen = int(gen)
+            self.reducer.configure(rank, addrs, gen)
+        return {"step": int(self._state["step"]),
+                "buckets": len(self._buckets)}
+
+    def abort_step(self) -> None:
+        """Fail any in-flight reduce immediately (driver-side failure
+        detector saw a peer die). Lock-free on purpose: the step holds
+        ``_step_lock``, and this is exactly the call that unwedges it."""
+        self.reducer.abort()
+
+    def last_step(self) -> int:
+        return int(self._state["step"]) if self._state is not None else 0
+
+    def get_history(self) -> List[float]:
+        return list(self._history)
+
+    def set_history(self, history: Sequence[float]) -> None:
+        self._history = [float(v) for v in history]
+
+    # -- the step ------------------------------------------------------
+
+    def run_step(self, step: int, gen: int,
+                 overlap: Optional[bool] = None) -> Dict[str, Any]:
+        step = int(step)
+        with self._step_lock:
+            if self._state is None:
+                raise RuntimeError("worker not configured")
+            cur = int(self._state["step"])
+            if step < cur:
+                # idempotent replay: this rank already applied the step
+                # (it finished before a peer died mid-broadcast)
+                return {"step": cur, "loss": self._history[step],
+                        "replayed": True, "reduce": {}}
+            if step != cur:
+                raise RuntimeError(f"worker at step {cur}, asked to run "
+                                   f"{step}")
+            if int(gen) != self._gen:
+                raise RuntimeError(f"stale generation {gen} (current "
+                                   f"{self._gen})")
+            return self._run_step_locked(step, overlap)
+
+    def _run_step_locked(self, step: int,
+                         overlap: Optional[bool]) -> Dict[str, Any]:
+        ov = self.cfg.overlap if overlap is None else bool(overlap)
+        job, buckets = self.job, self._buckets
+        stage_buckets: Dict[int, List[Bucket]] = {}
+        for b in buckets:
+            stage_buckets.setdefault(b.stage, []).append(b)
+        loss_bucket = buckets[-1]
+        nsh = len(self._shards)
+        # per (bucket, local shard) named-array dicts, filled stage by
+        # stage; a bucket launches the moment its stage's backward is
+        # done for every local shard
+        per_bucket: Dict[int, List[Dict[str, np.ndarray]]] = {
+            b.bid: [dict() for _ in range(nsh)] for b in buckets}
+        shard_loss: List[Optional[np.floating]] = [None] * nsh
+        results: Dict[int, Tuple[Dict[str, np.ndarray],
+                                 Callable[[], None], int, float]] = {}
+        errors: List[BaseException] = []
+        threads: List[threading.Thread] = []
+        serialized: List[Bucket] = []
+
+        def do_reduce(bucket: Bucket) -> None:
+            try:
+                t0 = time.perf_counter()
+                arrays, release, sent = self.reducer.reduce(
+                    f"{self._gen}:{step}:{bucket.bid}",
+                    per_bucket[bucket.bid],
+                    timeout=self.cfg.reduce_timeout)
+                results[bucket.bid] = (arrays, release, sent,
+                                       (time.perf_counter() - t0) * 1e3)
+            except BaseException as e:   # surfaced after the joins
+                errors.append(e)
+
+        # backward, stage by stage over this rank's shards; each stage
+        # produces a contiguous leaf range → scatter into buckets
+        stage_lo = 0
+        for si, name in enumerate(job.stage_names):
+            fn = job.stage_grad(name)
+            n_leaves = 0
+            for j, shard in enumerate(self._shards):
+                loss, grads = fn(self._state["params"],
+                                 job.batch_shard(step, shard),
+                                 job.shard_rng(step, shard))
+                leaves = [np.asarray(x) for x in
+                          job._jax.tree_util.tree_leaves(grads)]
+                n_leaves = len(leaves)
+                l32 = np.float32(np.asarray(loss))
+                shard_loss[j] = (l32 if shard_loss[j] is None
+                                 else np.float32(np.add(shard_loss[j],
+                                                        l32)))
+                for b in stage_buckets.get(si, ()):
+                    d = per_bucket[b.bid][j]
+                    for li in b.leaves:
+                        d[f"l{li}"] = leaves[li - stage_lo]
+            stage_lo += n_leaves
+            for b in stage_buckets.get(si, ()):
+                if b.bid == loss_bucket.bid:
+                    for j in range(nsh):
+                        per_bucket[b.bid][j][_LOSS_KEY] = np.asarray(
+                            [shard_loss[j]], dtype=np.float32)
+                if ov:
+                    t = threading.Thread(target=do_reduce, args=(b,),
+                                         daemon=True,
+                                         name=f"tosem-allreduce-b{b.bid}")
+                    t.start()
+                    threads.append(t)
+                else:
+                    serialized.append(b)
+        for b in serialized:        # baseline arm: comms after backward,
+            do_reduce(b)            # one blocked bucket at a time
+        for t in threads:
+            t.join()
+        if errors:
+            # a broken chain (peer death) aborts the step: release any
+            # buckets that DID commit so their receive pages recycle
+            for arrays, release, _, _ in results.values():
+                release()
+            raise errors[0]
+
+        # assemble mean grads + apply (donated buffers, /grain in-jit)
+        n_total = len(self._leaf_meta)
+        flat: List[Optional[np.ndarray]] = [None] * n_total
+        reduce_stats: Dict[str, Dict[str, float]] = {}
+        try:
+            for b in buckets:
+                arrays, _, sent, ms = results[b.bid]
+                for li in b.leaves:
+                    flat[li] = arrays[f"l{li}"]
+                reduce_stats[f"b{b.bid}"] = {"bytes": float(sent),
+                                             "ms": round(ms, 3)}
+            total_loss = results[loss_bucket.bid][0][_LOSS_KEY][0]
+            grads_tree = job._jax.tree_util.tree_unflatten(self._treedef,
+                                                           flat)
+            self._state = job.apply(self._state, grads_tree)
+        finally:
+            for arrays, release, _, _ in results.values():
+                release()
+        mean = _mean_loss(total_loss, job.grain)
+        self._history.append(mean)
+        return {"step": step + 1, "loss": mean, "reduce": reduce_stats}
+
+    # -- parameter traffic (elastic catch-up / rejoin / state fetch) ---
+
+    @staticmethod
+    def state_from_stream(rx: Any, template: Any) -> Any:
+        """Rebuild a replicated-state tree from a received ``s{i}``
+        leaf stream (the inverse of ``_state_arrays``). Owned copies,
+        so the mapped receive pages can recycle after ``release``."""
+        import jax
+        arrays = rx.arrays()
+        leaves, treedef = jax.tree_util.tree_flatten(template)
+        new = [jax.numpy.asarray(np.array(arrays[f"s{i}"]))
+               for i in range(len(leaves))]
+        return jax.tree_util.tree_unflatten(treedef, new)
+
+    def _state_arrays(self) -> Dict[str, np.ndarray]:
+        import jax
+        leaves = jax.tree_util.tree_leaves(self._state)
+        return {f"s{i}": np.asarray(x) for i, x in enumerate(leaves)}
+
+    def send_params(self, address: str, key: str) -> int:
+        """Stream the full replicated state (params + opt state + step)
+        to a peer's transport receiver — the rejoin/catch-up path; the
+        driver brokers addresses only, bytes go worker→worker."""
+        return send_tensors(address, {"key": str(key),
+                                      "step": self.last_step()},
+                            self._state_arrays(),
+                            chunk_bytes=self.cfg.chunk_bytes)
+
+    def recv_params(self, key: str, timeout: float = 60.0) -> int:
+        """Adopt a peer's streamed state (byte-identical leaves)."""
+        rx = self.reducer.receiver.pop(str(key), timeout=timeout)
+        try:
+            template = (self._state if self._state is not None
+                        else self.job.init_state())
+            new_state = self.state_from_stream(rx, template)
+            with self._step_lock:
+                self._state = new_state
+        finally:
+            rx.release()
+        return self.last_step()
+
+    # -- checkpointing -------------------------------------------------
+
+    def save_checkpoint(self, root: str, history: Sequence[float],
+                        keep: int = 3, async_save: bool = True) -> int:
+        from tosem_tpu.train import checkpoint as _ckpt
+        step = self.last_step()
+        extra = {"history": [float(v) for v in history]}
+        if async_save:
+            if self._saver is None:
+                self._saver = _ckpt.AsyncCheckpointer(root, keep=keep)
+            self._saver.save(step, self._state, extra=extra)
+        else:
+            _ckpt.save_versioned(root, step, self._state, extra=extra,
+                                 keep=keep)
+        return step
+
+    def flush_checkpoints(self) -> None:
+        if self._saver is not None:
+            self._saver.flush()
+
+    def stats(self) -> Dict[str, Any]:
+        return {"rank": self._rank, "world": self._world,
+                "shards": list(self._shards), "step": self.last_step(),
+                "buckets": len(self._buckets), "gen": self._gen}
+
+    def close(self) -> None:
+        self.flush_checkpoints()
+        self.reducer.close()
+
+
+# ----------------------------------------------------- single-process arm
+
+
+def make_dp_train_step(job: DPJob, reduce: str = "local",
+                       mesh: Any = None, dp_axis: str = "dp"):
+    """The SAME dp step as the cluster loop, lowered for one process —
+    usable directly with :func:`tosem_tpu.train.trainer.fit` (``fit``'s
+    ``batch``/``rng`` arguments are superseded by the job's own
+    deterministic pipeline; pass any placeholders).
+
+    - ``reduce="local"``: sequential shards + the canonical left fold —
+      BIT-identical to the transport arm at any world size (the
+      reference the tests pin against).
+    - ``reduce="shard_map"``: the on-chip lowering — per-shard grads
+      under ``shard_map`` on a ``grain``-sized dp mesh axis with a
+      ``lax.psum`` reduction (XLA AllReduce over ICI). Float-parity
+      with the fold arms (psum order is XLA's, not the left fold).
+    """
+    if reduce == "local":
+        def step_fn(state, batch=None, rng=None):
+            step = int(state["step"])
+            acc: Optional[Dict[str, np.ndarray]] = None
+            loss_acc: Optional[np.floating] = None
+            for shard in range(job.grain):
+                loss, leaves = job.shard_grads(state, step, shard)
+                acc = _fold(acc, {f"l{i}": x
+                                  for i, x in enumerate(leaves)})
+                loss_acc = (loss if loss_acc is None
+                            else np.float32(np.add(loss_acc, loss)))
+            _, treedef = job._jax.tree_util.tree_flatten(
+                state["params"])
+            grads = job._jax.tree_util.tree_unflatten(
+                treedef, [acc[f"l{i}"] for i in range(len(acc))])
+            new_state = job.apply(state, grads)
+            return new_state, {"loss": _mean_loss(loss_acc, job.grain)}
+        return step_fn
+
+    if reduce != "shard_map":
+        raise ValueError(f"unknown reduce lowering {reduce!r}")
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from tosem_tpu.parallel.compat import shard_map
+    if mesh is None:
+        raise ValueError("reduce='shard_map' needs a mesh")
+    if int(mesh.shape[dp_axis]) != job.grain:
+        raise ValueError(f"mesh axis {dp_axis!r} size "
+                         f"{mesh.shape[dp_axis]} != grain {job.grain}")
+
+    stage_names = job.stage_names
+
+    def total_loss(params, batch, rng):
+        out = None
+        for name in stage_names:
+            l = job._stage_losses[name](params, batch, rng)
+            out = l if out is None else out + l
+        return out
+
+    def body(params, batch, rng):
+        loss, grads = jax.value_and_grad(total_loss)(params, batch,
+                                                     rng[0])
+        return (lax.psum(loss, dp_axis),
+                jax.tree_util.tree_map(lambda g: lax.psum(g, dp_axis),
+                                       grads))
+
+    smapped = jax.jit(shard_map(
+        body, mesh=mesh,
+        in_specs=(P(), P(dp_axis), P(dp_axis)),
+        out_specs=(P(), P()), check_vma=False))
+
+    def step_fn(state, batch=None, rng=None):
+        step = int(state["step"])
+        gbatch = job.batch_fn(step)
+        rngs = jnp.stack([job.shard_rng(step, s)
+                          for s in range(job.grain)])
+        loss, grads = smapped(state["params"], gbatch, rngs)
+        new_state = job.apply(state, grads)
+        return new_state, {"loss": _mean_loss(np.float32(np.asarray(loss)),
+                                              job.grain)}
+    return step_fn
+
+
+# ------------------------------------------------------------ demo job
+
+
+def demo_job(towers: int = 4, dim: int = 32, batch: int = 32,
+             grain: int = 4, seed: int = 0, lr: float = 0.1,
+             depth: int = 1, mixed_precision: bool = False) -> DPJob:
+    """A gradient-staged synthetic job: ``towers`` independent linear
+    regressions over a shared deterministic batch — one stage (and so
+    one-or-more buckets) per tower, which is what lets the overlap
+    engine hide each tower's all-reduce behind the next tower's
+    backward. Used by the bench, the chaos scenario, and the tests;
+    JSON-safe kwargs so it ships to replica processes by ref."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    names = [f"s{i:02d}" for i in range(towers)]
+
+    def init_params():
+        root = jax.random.PRNGKey(seed + 1)
+        return {n: {"w": jax.random.normal(
+            jax.random.fold_in(root, i), (dim, dim),
+            dtype=jnp.float32) * 0.05} for i, n in enumerate(names)}
+
+    def batch_fn(step):
+        k = jax.random.fold_in(jax.random.PRNGKey(seed), step)
+        x = jax.random.normal(k, (batch, dim), dtype=jnp.float32)
+        y = jnp.roll(x, 1, axis=1)
+        return {"x": x, "y": y}
+
+    def make_loss(name):
+        # depth re-applies w (a deep linear chain): backward FLOPs
+        # scale with depth while the gradient payload stays one dim×dim
+        # leaf — the knob the bench turns to balance backward wall time
+        # against (emulated) wire time without inflating traffic
+        def loss_fn(params, b, rng):
+            pred = b["x"]
+            for _ in range(depth):
+                pred = pred @ params[name]["w"]
+            return jnp.mean((pred - b["y"]) ** 2)
+        return loss_fn
+
+    return DPJob(init_params=init_params,
+                 stage_losses=[(n, make_loss(n)) for n in names],
+                 batch_fn=batch_fn, optimizer=optax.sgd(lr),
+                 grain=grain, global_batch=batch, seed=seed,
+                 mixed_precision=mixed_precision)
+
+
+# ----------------------------------------------------------- the driver
+
+
+_JOBS: Dict[str, "DistributedTrainer"] = {}
+_JOBS_LOCK = threading.Lock()
+
+
+def jobs_stats() -> Dict[str, Dict[str, Any]]:
+    """Live rollup of every registered trainer — served under the
+    ``/-/stats`` ingress next to the serving deployments."""
+    with _JOBS_LOCK:
+        items = list(_JOBS.items())
+    return {name: t.stats() for name, t in items}
+
+
+class _LocalHandle:
+    """Threads-backend worker: the backend object in-process. ``dead``
+    and ``fail_at_step`` are the deterministic stand-ins for node loss
+    (the nodes backend gets the real SIGKILL via the agent lifeline)."""
+
+    def __init__(self, backend: TrainWorkerBackend, rank: int):
+        self.backend = backend
+        self.birth_rank = rank
+        self.node_name = f"local{rank}"
+        self.dead = False
+        self.fail_at_step: Optional[int] = None
+
+    def call(self, method: str, *args, **kwargs):
+        if self.dead:
+            raise ConnectionError("train worker dead (simulated)")
+        if (method == "run_step" and self.fail_at_step is not None
+                and int(args[0]) >= self.fail_at_step):
+            self.dead = True
+            raise ConnectionError("train worker died mid-step (simulated)")
+        return getattr(self.backend, method)(*args, **kwargs)
+
+    def alive(self) -> bool:
+        return not self.dead
+
+    def close(self) -> None:
+        try:
+            self.backend.close()
+        except Exception:
+            pass
+
+
+class _ReplicaHandle:
+    """Nodes-backend worker: a replica process reached over the RPC
+    plane (``backend_call`` forwarding). A fresh client per call keeps
+    concurrent step dispatch / control calls trivially safe."""
+
+    def __init__(self, node_name: str, node: Any, replica_id: str,
+                 address: str, call_timeout: float = 300.0):
+        self.node_name = node_name
+        self.node = node
+        self.replica_id = replica_id
+        self.address = address
+        self._call_timeout = call_timeout
+
+    def call(self, method: str, *args, **kwargs):
+        from tosem_tpu.cluster.rpc import RpcClient, RpcError
+        cli = RpcClient(self.address, call_timeout=self._call_timeout)
+        try:
+            return cli.call("backend_call", method, *args, **kwargs)
+        except RpcError as e:
+            # app-level failure: the worker is alive, the step is not
+            raise RuntimeError(f"train worker {self.replica_id}: {e}")
+        finally:
+            cli.close()
+
+    def alive(self) -> bool:
+        from tosem_tpu.cluster.rpc import RpcClient
+        try:
+            cli = RpcClient(self.address, timeout=2.0, call_timeout=5.0)
+            try:
+                return bool(cli.call("health").get("ok"))
+            finally:
+                cli.close()
+        except Exception:
+            return False
+
+    def close(self) -> None:
+        try:
+            self.node.stop_replica(self.replica_id)
+        except Exception:
+            pass
+
+
+def _assign_shards(grain: int, world: int) -> List[List[int]]:
+    """Contiguous ascending shard runs per rank — contiguity is load-
+    bearing: it keeps the chain's fold order equal to shard order."""
+    base, rem = divmod(grain, world)
+    out, lo = [], 0
+    for r in range(world):
+        n = base + (1 if r < rem else 0)
+        out.append(list(range(lo, lo + n)))
+        lo += n
+    return out
+
+
+class DistributedTrainer:
+    """Gang-scheduled data-parallel ``fit()`` over the cluster fabric.
+
+    ``backend="threads"`` runs the ranks in-process over real transport
+    sockets (the CPU-arm tests/benches); ``backend="nodes"`` gang-
+    reserves slots across a :class:`~tosem_tpu.cluster.supervisor.
+    NodePool`'s agents (journaled via the pool) and spawns each rank as
+    a replica process. Node death shrinks the dp worker set and the run
+    continues from the journaled step with a BIT-identical loss
+    trajectory; :meth:`add_worker` grows it back."""
+
+    def __init__(self, job_ref: str = "",
+                 job_kwargs: Optional[Dict[str, Any]] = None,
+                 cfg: Optional[DataParallelConfig] = None, *,
+                 backend: str = "threads", world: int = 2,
+                 pool: Any = None,
+                 job: Optional[DPJob] = None,
+                 ckpt_dir: Optional[str] = None,
+                 checkpoint_every: int = 0, keep: int = 3,
+                 async_save: bool = True, resume: bool = True,
+                 registry: Any = None):
+        self.cfg = cfg or DataParallelConfig()
+        if not 1 <= world <= self.cfg.grain:
+            raise ValueError(f"world {world} must satisfy 1 <= world <= "
+                             f"grain {self.cfg.grain}")
+        self.backend = backend
+        self.pool = pool
+        self.job_ref, self.job_kwargs = job_ref, dict(job_kwargs or {})
+        # the driver's own job copy: templates for state fetch + batch
+        # metadata for throughput accounting (never steps)
+        self.job = job if job is not None else resolve_job(job_ref,
+                                                           self.job_kwargs)
+        self.ckpt_dir = ckpt_dir
+        self.checkpoint_every = int(checkpoint_every)
+        self.keep, self.async_save, self.resume = keep, async_save, resume
+        self.overlap: Optional[bool] = None     # per-run override (bench)
+        self.history: List[float] = []
+        self._gen = 0
+        self._workers: List[Any] = []
+        self._gang = None
+        self._rx: Optional[TensorReceiver] = None
+        self._shrinks = 0
+        self._grows = 0
+        self._examples_per_s = 0.0
+        self._metrics = _metrics.train_metrics(registry)
+        self._spawn_seq = 0
+        # one dispatch pool for the whole run (grain bounds the world,
+        # so growth never needs a resize); a per-step executor would
+        # pay `world` thread spawns + joins every step
+        from concurrent.futures import ThreadPoolExecutor
+        self._pool_exec = ThreadPoolExecutor(
+            max_workers=self.cfg.grain,
+            thread_name_prefix=f"tosem-dp-{self.cfg.job}")
+        if backend == "threads":
+            for r in range(world):
+                self._workers.append(self._spawn_local())
+        elif backend == "nodes":
+            if pool is None:
+                raise ValueError("backend='nodes' needs a NodePool")
+            self._spawn_gang(world)
+        else:
+            raise ValueError(f"unknown backend {backend!r}")
+        self._configure_all()
+        with _JOBS_LOCK:
+            _JOBS[self.cfg.job] = self
+        self._record("train_started", world=world, grain=self.cfg.grain,
+                     backend=backend)
+
+    # -- worker lifecycle ----------------------------------------------
+
+    def _spawn_local(self) -> _LocalHandle:
+        self._spawn_seq += 1
+        # with a ref, every rank builds its OWN DPJob (private jit/batch
+        # caches); a direct job object is shared — its caches are
+        # deterministic, so concurrent ranks at worst recompute a batch
+        backend = TrainWorkerBackend(
+            job_ref=self.job_ref, job_kwargs=self.job_kwargs,
+            cfg=self.cfg.to_dict(),
+            job=(None if self.job_ref else self.job))
+        return _LocalHandle(backend, self._spawn_seq)
+
+    def _spawn_gang(self, world: int) -> None:
+        from tosem_tpu.cluster.gang import reserve_gang
+        live = self.pool.live_nodes()
+        self._gang = reserve_gang(list(live.values()), world,
+                                  strategy="spread", timeout=60.0)
+        addr_to_name = {n.address: name for name, n in live.items()}
+        ranks: List[Tuple[str, Any]] = []
+        for addr in sorted(self._gang.counts):
+            for _ in range(self._gang.counts[addr]):
+                ranks.append((addr_to_name[addr], live[addr_to_name[addr]]))
+        for name, node in ranks:
+            self._workers.append(self._spawn_replica(name, node))
+
+    def _spawn_replica(self, node_name: str, node: Any) -> _ReplicaHandle:
+        self._spawn_seq += 1
+        rid = f"train-{self.cfg.job}-{self._spawn_seq}"
+        init = {"job_ref": self.job_ref, "job_kwargs": self.job_kwargs,
+                "cfg": self.cfg.to_dict()}
+        address = node.start_replica(
+            rid, "tosem_tpu.train.distributed:TrainWorkerBackend",
+            init_kwargs=init)
+        self._record("train_worker_placed", replica_id=rid,
+                     node=node_name)
+        return _ReplicaHandle(node_name, node, rid, address)
+
+    def _record(self, event: str, **fields: Any) -> None:
+        if self.pool is not None:
+            try:
+                self.pool.record_event(event, job=self.cfg.job, **fields)
+            except Exception:
+                pass
+
+    # -- wiring --------------------------------------------------------
+
+    @property
+    def world(self) -> int:
+        return len(self._workers)
+
+    def _configure_all(self, start_hint: int = 0) -> int:
+        self._gen += 1
+        addrs = [h.call("transport_address") for h in self._workers]
+        assign = _assign_shards(self.cfg.grain, self.world)
+        step = start_hint
+        for r, h in enumerate(self._workers):
+            out = h.call("configure", r, self.world, addrs, assign[r],
+                         self._gen, self.ckpt_dir, self.resume)
+            step = max(step, int(out["step"]))
+        self._metrics["dp_size"].set(self.world, (self.cfg.job,))
+        return step
+
+    # -- elasticity ----------------------------------------------------
+
+    def _handle_failure(self, step: int) -> int:
+        """Classify failed workers, drop the dead, catch laggards up
+        from the most-advanced survivor (params stream worker→worker),
+        rewire the chain, and return the step to continue from."""
+        dropped = 0
+        while True:
+            survivors = []
+            for h in self._workers:
+                if h.alive():
+                    survivors.append(h)
+                else:
+                    dropped += 1
+                    self._record("train_worker_lost",
+                                 node=getattr(h, "node_name", "?"))
+                    if self.backend == "nodes" and self.pool is not None:
+                        try:
+                            self.pool.detector.declare_dead(h.node_name)
+                        except Exception:
+                            pass
+                    h.close()
+            if not survivors:
+                raise TrainWorkerLost(
+                    f"every train worker died at step {step}")
+            self._workers = survivors
+            try:
+                last = [int(h.call("last_step"))
+                        for h in self._workers]
+                mx = max(last)
+                ahead = self._workers[last.index(mx)]
+                self.history = [float(v)
+                                for v in ahead.call("get_history")]
+                for h, ls in zip(self._workers, last):
+                    if ls < mx:
+                        key = f"sync:{self._gen}:{mx}:{id(h) & 0xffff}"
+                        ahead.call("send_params",
+                                   h.call("transport_address"), key)
+                        h.call("recv_params", key)
+                        h.call("set_history", self.history)
+                self._configure_all()
+            except (ConnectionError, TimeoutError, OSError):
+                continue        # another death mid-recovery: reclassify
+            if dropped:
+                # an app-level step failure with every worker alive is
+                # a resync, not a shrink — the dp axis didn't move
+                self._shrinks += 1
+                self._record("train_shrunk", step=mx, world=self.world)
+            return mx
+
+    def add_worker(self, node_name: Optional[str] = None) -> int:
+        """Grow the dp worker set by one (rejoin): the new rank
+        bootstraps params from rank 0 over the transport, shards
+        rebalance, and the trajectory continues bit-identically."""
+        if self.world >= self.cfg.grain:
+            raise ValueError("world already equals grain")
+        if self.backend == "threads":
+            h = self._spawn_local()
+        else:
+            live = self.pool.live_nodes()
+            if not live:
+                raise TrainWorkerLost("no live node to grow onto")
+            name = node_name or sorted(live)[0]
+            h = self._spawn_replica(name, live[name])
+        # bootstrap BEFORE joining the ring: configure (init state),
+        # then adopt rank 0's replicated state byte-for-byte
+        h.call("configure", 0, 1, [h.call("transport_address")], [0],
+               self._gen, None, False)
+        key = f"grow:{self._gen}:{self._spawn_seq}"
+        self._workers[0].call("send_params", h.call("transport_address"),
+                              key)
+        h.call("recv_params", key)
+        h.call("set_history", self.history)
+        self._workers.append(h)
+        step = self._configure_all()
+        self._grows += 1
+        self._record("train_grown", step=step, world=self.world)
+        return step
+
+    # -- the loop ------------------------------------------------------
+
+    def _kill_victim(self) -> None:
+        """Chaos ``train.dist_step``/``kill_node``: hard-kill the node
+        hosting the highest rank (deterministic victim)."""
+        h = self._workers[-1]
+        if isinstance(h, _LocalHandle):
+            h.dead = True
+        else:
+            try:
+                h.node.kill()
+            except Exception:
+                pass
+            if self.pool is not None:
+                try:
+                    self.pool.detector.declare_dead(h.node_name)
+                except Exception:
+                    pass
+
+    def fit(self, num_steps: int,
+            on_step: Optional[Callable[[int, Dict[str, float]], None]]
+            = None) -> List[float]:
+        """Run to ``num_steps`` global steps (resumable: call again with
+        a larger target). Returns the loss history (one float per
+        step), bit-identical to the single-process reference whatever
+        died along the way."""
+        from concurrent.futures import FIRST_EXCEPTION
+        from concurrent.futures import wait as cf_wait
+        step = max((int(h.call("last_step")) for h in self._workers),
+                   default=0)
+        if step > len(self.history):
+            # checkpoint-restored workers carry their history; adopt it
+            self.history = [float(v)
+                            for v in self._workers[0].call("get_history")]
+        step = max(step, len(self.history)) if self.history else step
+        while step < num_steps:
+            act = _chaos.fire("train.dist_step", step=step,
+                              job=self.cfg.job)
+            if act is not None and act["action"] == "kill_node":
+                self._kill_victim()
+            t0 = time.perf_counter()
+            futs = [self._pool_exec.submit(h.call, "run_step", step,
+                                           self._gen, self.overlap)
+                    for h in self._workers]
+            done, not_done = cf_wait(futs, return_when=FIRST_EXCEPTION)
+            if not_done and any(f.exception() is not None
+                                for f in done):
+                # a rank failed mid-step: survivors are blocked on
+                # chain streams the dead peer can never send — abort
+                # their reduces NOW instead of letting them ride out
+                # reduce_timeout before recovery starts
+                for h in self._workers:
+                    try:
+                        h.call("abort_step")
+                    except Exception:
+                        pass
+            outs: List[Any] = []
+            for f in futs:
+                try:
+                    outs.append(f.result())
+                except BaseException as e:
+                    outs.append(e)
+            fails = [o for o in outs if isinstance(o, BaseException)]
+            if fails:
+                step = self._handle_failure(step)
+                continue
+            dt = time.perf_counter() - t0
+            losses = {o["loss"] for o in outs}
+            if len(losses) != 1:
+                raise AssertionError(
+                    f"replicas diverged at step {step}: {sorted(losses)} "
+                    "— determinism contract broken")
+            loss = outs[0]["loss"]
+            if len(self.history) == step:
+                self.history.append(loss)
+            else:
+                self.history[step] = loss
+            self._examples_per_s = self.job.global_batch / max(dt, 1e-9)
+            m = self._metrics
+            m["steps"].inc(1, (self.cfg.job,))
+            m["examples_per_s"].set(self._examples_per_s, (self.cfg.job,))
+            for o in outs:
+                for bid, rs in o.get("reduce", {}).items():
+                    m["allreduce_bytes"].inc(rs["bytes"],
+                                             (self.cfg.job, bid))
+                    m["allreduce_ms"].observe(rs["ms"],
+                                              (self.cfg.job, bid))
+            done = step + 1
+            if on_step is not None:
+                on_step(done, {"loss": loss})
+            self._record("train_step_done", step=done)
+            if (self.ckpt_dir and self.checkpoint_every
+                    and (done % self.checkpoint_every == 0
+                         or done == num_steps)):
+                try:
+                    self._workers[0].call(
+                        "save_checkpoint", self.ckpt_dir,
+                        self.history, self.keep, self.async_save)
+                except (ConnectionError, TimeoutError, OSError):
+                    step = self._handle_failure(done)
+                    continue
+            step = done
+        if self.ckpt_dir:
+            try:
+                self._workers[0].call("flush_checkpoints")
+            except (ConnectionError, TimeoutError, OSError):
+                pass
+        self._record("train_finished", step=num_steps)
+        return list(self.history)
+
+    # -- state / stats -------------------------------------------------
+
+    def fetch_state(self) -> Dict[str, Any]:
+        """Pull rank 0's replicated state to the driver (transport
+        stream → rebuilt on the job template)."""
+        h = self._workers[0]
+        if isinstance(h, _LocalHandle):
+            return h.backend._state
+        if self._rx is None:
+            self._rx = TensorReceiver(store_capacity=64 << 20)
+        key = f"fetch:{self._gen}:{time.monotonic_ns() & 0xffffff}"
+        h.call("send_params", self._rx.address, key)
+        rx = self._rx.pop(key, timeout=60.0)
+        try:
+            return TrainWorkerBackend.state_from_stream(
+                rx, self.job.init_state())
+        finally:
+            rx.release()
+
+    def stats(self) -> Dict[str, Any]:
+        return {"job": self.cfg.job, "backend": self.backend,
+                "world": self.world, "grain": self.cfg.grain,
+                "step": len(self.history),
+                "examples_per_s": round(self._examples_per_s, 2),
+                "shrinks": self._shrinks, "grows": self._grows,
+                "workers": [getattr(h, "node_name", "?")
+                            for h in self._workers]}
+
+    def close(self) -> None:
+        with _JOBS_LOCK:
+            if _JOBS.get(self.cfg.job) is self:
+                del _JOBS[self.cfg.job]
+        self._pool_exec.shutdown(wait=False)
+        for h in self._workers:
+            h.close()
+        self._workers = []
+        if self._gang is not None:
+            self._gang.release()
+            self._gang = None
+        if self._rx is not None:
+            self._rx.shutdown()
+            self._rx = None
+
+    def __enter__(self) -> "DistributedTrainer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def fit_distributed(job_ref: str, num_steps: int, *,
+                    job_kwargs: Optional[Dict[str, Any]] = None,
+                    cfg: Optional[DataParallelConfig] = None,
+                    backend: str = "threads", world: int = 2,
+                    pool: Any = None,
+                    ckpt_dir: Optional[str] = None,
+                    checkpoint_every: int = 0, keep: int = 3,
+                    async_save: bool = True, resume: bool = True,
+                    on_step: Optional[Callable] = None) -> List[float]:
+    """One-shot convenience: build a :class:`DistributedTrainer`, fit,
+    close. Returns the loss history."""
+    tr = DistributedTrainer(job_ref, job_kwargs, cfg, backend=backend,
+                            world=world, pool=pool, ckpt_dir=ckpt_dir,
+                            checkpoint_every=checkpoint_every, keep=keep,
+                            async_save=async_save, resume=resume)
+    try:
+        return tr.fit(num_steps, on_step=on_step)
+    finally:
+        tr.close()

@@ -213,7 +213,7 @@ def shard_batch_by_rules(batch: Any, mesh: Mesh, batch_rules: Rules) -> Any:
 def fit(state: TrainState, step_fn: Callable, batch_fn: Callable[[int], Any],
         num_steps: int, *, rng: jax.Array,
         ckpt_dir: Optional[str] = None, checkpoint_every: int = 0,
-        keep: int = 3, resume: bool = True,
+        keep: int = 3, resume: bool = True, async_save: bool = False,
         on_step: Optional[Callable[[int, Dict[str, float]], None]] = None
         ) -> Tuple[TrainState, List[Dict[str, float]]]:
     """Preemption-safe training loop: checkpoint + auto-resume.
@@ -231,6 +231,13 @@ def fit(state: TrainState, step_fn: Callable, batch_fn: Callable[[int], Any],
     retained); ``resume=True`` restores the newest valid checkpoint
     before stepping, skipping any version a preemption tore mid-write.
 
+    With ``async_save=True`` the serialize+fsync of each checkpoint
+    runs in a background thread (:class:`~tosem_tpu.train.checkpoint.
+    AsyncCheckpointer`): the loop pays only the on-step host snapshot,
+    the next save joins the previous write, and a
+    :class:`TrainingPreempted` preemption flushes synchronously before
+    propagating — resume semantics are identical either way.
+
     Chaos site ``train.step`` fires after each step's bookkeeping
     (action ``preempt`` raises :class:`TrainingPreempted` — the
     deterministic analog of a mid-training SIGKILL for tests).
@@ -243,6 +250,8 @@ def fit(state: TrainState, step_fn: Callable, batch_fn: Callable[[int], Any],
         if found is not None:
             start, state, extra = found
             history = list((extra or {}).get("history", []))
+    saver = (_ckpt.AsyncCheckpointer(ckpt_dir, keep=keep)
+             if ckpt_dir and async_save else None)
     for step in range(start, num_steps):
         batch = batch_fn(step)
         step_rng = jax.random.fold_in(rng, step)
@@ -254,12 +263,22 @@ def fit(state: TrainState, step_fn: Callable, batch_fn: Callable[[int], Any],
         done = step + 1
         if ckpt_dir and checkpoint_every and \
                 (done % checkpoint_every == 0 or done == num_steps):
-            _ckpt.save_versioned(ckpt_dir, done, state,
-                                 extra={"history": history}, keep=keep)
+            if saver is not None:
+                # snapshot the history NOW: the background writer must
+                # not see appends from later steps (a torn extra breaks
+                # bit-exact resume)
+                saver.save(done, state, extra={"history": list(history)})
+            else:
+                _ckpt.save_versioned(ckpt_dir, done, state,
+                                     extra={"history": history}, keep=keep)
         act = _chaos.fire("train.step", step=done)
         if act is not None and act["action"] == "preempt":
+            if saver is not None:
+                saver.flush()   # preemption: the snapshot must land NOW
             raise TrainingPreempted(
                 f"training preempted after step {done}")
+    if saver is not None:
+        saver.flush()
     return state, history
 
 
