@@ -17,7 +17,9 @@
 #                    data-plane benches gated against
 #                    results/bench_serve.json + the autoregressive-
 #                    decode benches gated against
-#                    results/bench_decode.json — fails on >30%
+#                    results/bench_decode.json + the cluster / sparse /
+#                    kernel-backend / train suites gated against their
+#                    results/bench_*.json floors — fails on >30%
 #                    throughput regression on any gated bench
 set -euo pipefail
 cd "$(dirname "$0")"
@@ -118,6 +120,20 @@ perf_smoke() {
     echo "== perf smoke: sparse regression reported; one retry (noisy host?)"
     JAX_PLATFORMS=cpu "${spcmd[@]}"
   fi
+  # cross-backend kernel layer: every registered lowering of every
+  # kernel family (flash / paged / schedule) raced interleaved on this
+  # host, parity-pinned before timing — the reproducible off-chip arm
+  # of the kernel perf evidence (rows are platform=cpu, never on-chip
+  # evidence; the on-chip kernel_matrix capture leg re-runs the same
+  # suite). Floors are min-of-rounds in results/bench_kernels.json.
+  echo "== perf smoke (kernel microbench vs results/bench_kernels.json)"
+  local kcmd=(python -m tosem_tpu.cli microbench --kernels --trials 2
+              --min-s 0.4 --quiet --only gated
+              --check results/bench_kernels.json --threshold 0.30)
+  if ! JAX_PLATFORMS=cpu "${kcmd[@]}"; then
+    echo "== perf smoke: kernel regression reported; one retry (noisy host?)"
+    JAX_PLATFORMS=cpu "${kcmd[@]}"
+  fi
   # distributed training: bucketed-overlap vs serialized all-reduce on
   # the comms-dominated dp4 job (paced wire — loopback is pure CPU
   # work, so the unpaced A/B measures scheduling, not comms hiding),
@@ -150,6 +166,13 @@ if [[ "$QUICK" == "1" ]]; then
   # test_decode_modes = the decode fast-path gate (multi-token/window/
   # offset kernel parity, window eviction bounds, speculative
   # bit-identity, COW beam groups, the "decode" cache section);
+  # test_kernel_registry = the backend-registry gate (resolution order,
+  # capability filtering, backend= override, fallback counting,
+  # platform-scoped autotune cache);
+  # test_parity_harness = the universal cross-backend parity matrix
+  # (every registered lowering pair x the declared scenario matrix,
+  # incl. MultiHeadMask+segments vs schedule-XLA and windowed multi-q
+  # vs the numpy oracle);
   # test_sharded_decode = the dp×tp paged-decode bit-identity gate;
   # test_cluster_transport = the tensor-transport framing gate (torn
   # stream / truncated header / out-of-order chunks typed, mapped
@@ -161,6 +184,7 @@ if [[ "$QUICK" == "1" ]]; then
   python -m pytest -q -m "not slow" \
     tests/test_ops.py tests/test_pallas_kernels.py tests/test_nn.py \
     tests/test_flash_blocks.py tests/test_mask_programs.py \
+    tests/test_kernel_registry.py tests/test_parity_harness.py \
     tests/test_decode_modes.py tests/test_sharded_decode.py \
     tests/test_cluster_transport.py \
     tests/test_train_distributed.py tests/test_train_checkpoint.py \

@@ -1,13 +1,17 @@
 """Unit tests for flash-attention block-size selection
 (:mod:`tosem_tpu.ops.flash_blocks`): table pins, VMEM-budget fallback,
-divisibility alignment, and the autotune JSON cache."""
+divisibility alignment, and the platform/backend-scoped autotune JSON
+cache (one keyed store shared by every section — blocks, pages, sparse,
+decode — with identical corrupt/missing/partial tolerance)."""
 import json
 
 import pytest
 
 from tosem_tpu.ops.flash_blocks import (BlockSizes, DEFAULT_VMEM_BUDGET,
-                                        reset_cache, save_cache,
+                                        cache_scope, reset_cache,
+                                        save_cache, scoped_key,
                                         select_block_sizes,
+                                        select_page_size, select_spec_q,
                                         vmem_bytes_estimate)
 
 
@@ -88,7 +92,9 @@ class TestAutotuneCache:
         save_cache({"t512_d64_bfloat16": [256, 256, 256, 256]}, path)
         save_cache({"t2048_d64_bfloat16": [512, 1024, 512, 512]}, path)
         data = json.load(open(path))["blocks"]
-        assert set(data) == {"t512_d64_bfloat16", "t2048_d64_bfloat16"}
+        assert set(data) == {
+            scoped_key("blocks", "t512_d64_bfloat16"),
+            scoped_key("blocks", "t2048_d64_bfloat16")}
 
     def test_corrupt_cache_falls_back_to_table(self, tmp_path):
         path = str(tmp_path / "flash_blocks.json")
@@ -105,15 +111,150 @@ class TestAutotuneCache:
         assert b == BlockSizes(512, 512, 512, 512)
 
     def test_autotune_writes_cache_and_picks_best(self, tmp_path):
-        """End-to-end autotune on a tiny interpret-mode shape."""
+        """End-to-end autotune on a tiny interpret-mode shape; the
+        sweep records which (backend, platform) it tuned and writes
+        under that scope."""
         from tosem_tpu.ops.flash_blocks import autotune
         path = str(tmp_path / "flash_blocks.json")
         recs = autotune([(1, 1, 128, 16, "float32")], reps=1,
                         cache_path=path)
         assert recs and any(r["best"] for r in recs)
+        assert all(r["backend"] == "pallas-interpret" for r in recs)
+        assert all(r["platform"] for r in recs)
         data = json.load(open(path))["blocks"]
-        assert "t128_d16_float32" in data
+        key = scoped_key("blocks", "t128_d16_float32")
+        assert key in data
         reset_cache()
         b = select_block_sizes(128, 16, "float32", cache_path=path)
-        assert b.as_list() == data["t128_d16_float32"]
+        assert b.as_list() == data[key]
         assert select_block_sizes.last_source == "cache"
+
+
+class TestPlatformScopedCache:
+    """The acceptance regression: an autotune winner recorded on one
+    (platform, backend) scope is NEVER selected on another — a
+    CPU-smoke winner cannot drive a TPU kernel, and vice versa."""
+
+    def test_platform_mismatched_entry_never_selected(self, tmp_path):
+        path = str(tmp_path / "flash_blocks.json")
+        save_cache({"t512_d64_bfloat16": [128, 128, 128, 128]}, path,
+                   platform="tpu", backend="pallas-tpu")
+        reset_cache()
+        # this process runs on CPU: the tpu-scoped entry must not win
+        b = select_block_sizes(512, 64, "bfloat16", cache_path=path)
+        assert select_block_sizes.last_source == "table"
+        assert b == BlockSizes(512, 512, 512, 512)
+        # the matching scope still reads it
+        b = select_block_sizes(512, 64, "bfloat16", cache_path=path,
+                               platform="tpu", backend="pallas-tpu")
+        assert select_block_sizes.last_source == "cache"
+        assert b == BlockSizes(128, 128, 128, 128)
+
+    def test_backend_mismatched_entry_never_selected(self, tmp_path):
+        path = str(tmp_path / "flash_blocks.json")
+        save_cache({"decode_d64_bfloat16": 256}, path, section="pages",
+                   backend="pallas-interpret")
+        reset_cache()
+        # the CPU default paged backend is xla — the interpret-scoped
+        # winner must not cross lowerings
+        assert select_page_size(64, "bfloat16", cache_path=path) == 128
+        assert select_page_size.last_source == "table"
+        assert select_page_size(64, "bfloat16", cache_path=path,
+                                backend="pallas-interpret") == 256
+        assert select_page_size.last_source == "cache"
+
+    def test_legacy_flat_keys_are_dropped(self, tmp_path):
+        """Pre-scope cache files carried unscoped keys; their platform
+        is unknowable, so they degrade to the table path (the same
+        tolerance as a corrupt entry), never crash, never win."""
+        path = str(tmp_path / "flash_blocks.json")
+        with open(path, "w") as f:
+            json.dump({"blocks": {"t512_d64_bfloat16":
+                                  [128, 128, 128, 128]}}, f)
+        reset_cache()
+        b = select_block_sizes(512, 64, "bfloat16", cache_path=path)
+        assert select_block_sizes.last_source == "table"
+        assert b == BlockSizes(512, 512, 512, 512)
+
+
+class TestSharedSectionStore:
+    """Satellite: the four cache sections ride ONE keyed store —
+    corrupt, missing, and partially-corrupt sections behave identically
+    across sections."""
+
+    SECTIONS = ("blocks", "pages", "sparse", "decode")
+
+    @staticmethod
+    def _select(section, path):
+        """(value, last_source) through the section's public selector."""
+        if section == "blocks":
+            v = select_block_sizes(512, 64, "bfloat16", cache_path=path)
+            return v, select_block_sizes.last_source
+        if section == "sparse":
+            v = select_block_sizes(512, 64, "bfloat16", cache_path=path,
+                                   mask_sig="local:64:0")
+            return v, select_block_sizes.last_source
+        if section == "pages":
+            v = select_page_size(64, "bfloat16", cache_path=path)
+            return v, select_page_size.last_source
+        v = select_spec_q(64, "bfloat16", cache_path=path)
+        return v, select_spec_q.last_source
+
+    @staticmethod
+    def _good_entry(section):
+        key = ("t512_d64_bfloat16_local:64:0" if section == "sparse"
+               else "t512_d64_bfloat16" if section == "blocks"
+               else "decode_d64_bfloat16" if section == "pages"
+               else "spec_q_d64_bfloat16")
+        # values survive the selectors' clamps: pages floor at 8
+        # sublanes, spec-q clamps into [1, 8]
+        val = ([256, 256, 256, 256] if section in ("blocks", "sparse")
+               else 16 if section == "pages" else 2)
+        return key, val
+
+    @pytest.mark.parametrize("section", SECTIONS)
+    def test_corrupt_section_degrades_to_table(self, section, tmp_path):
+        path = str(tmp_path / "c.json")
+        with open(path, "w") as f:
+            json.dump({section: "garbage"}, f)
+        reset_cache()
+        _, src = self._select(section, path)
+        assert src in ("table", "default")
+
+    @pytest.mark.parametrize("section", SECTIONS)
+    def test_partial_corruption_keeps_good_entries(self, section,
+                                                   tmp_path):
+        """One bad value must not poison the section's good entries."""
+        path = str(tmp_path / "c.json")
+        key, val = self._good_entry(section)
+        save_cache({key: val}, path, section=section)
+        raw = json.load(open(path))
+        raw[section][scoped_key(section, "bogus_key")] = \
+            {"not": "a value"}
+        with open(path, "w") as f:
+            json.dump(raw, f)
+        reset_cache()
+        got, src = self._select(section, path)
+        assert src in ("cache", "sparse")
+        if section in ("blocks", "sparse"):
+            assert got.as_list() == val
+        else:
+            assert got == val
+
+    @pytest.mark.parametrize("section", SECTIONS)
+    def test_save_preserves_other_sections(self, section, tmp_path):
+        path = str(tmp_path / "c.json")
+        for other in self.SECTIONS:
+            key, val = self._good_entry(other)
+            save_cache({key: val}, path, section=other)
+        raw = json.load(open(path))
+        for other in self.SECTIONS:
+            key, val = self._good_entry(other)
+            assert raw[other][scoped_key(other, key)] == val
+
+    def test_unknown_section_rejected(self, tmp_path):
+        with pytest.raises(ValueError, match="section"):
+            save_cache({"k": 1}, str(tmp_path / "c.json"),
+                       section="nope")
+        with pytest.raises(ValueError, match="section"):
+            cache_scope("nope")

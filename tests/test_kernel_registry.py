@@ -1,0 +1,265 @@
+"""Kernel-backend registry (:mod:`tosem_tpu.ops.registry`): resolution
+order, capability filtering, the ``backend=`` override and legacy
+``impl`` alias, fallback counting, and the dispatch-tally keying the
+registry names drive. The platform-scoped autotune cache regressions
+live in ``test_flash_blocks.py``; the cross-backend numerics in
+``test_parity_harness.py``."""
+import numpy as np
+import pytest
+
+from tosem_tpu.ops import registry
+
+
+@pytest.fixture(autouse=True)
+def _fresh_fallbacks():
+    registry.reset_fallback_counts()
+    yield
+    registry.reset_fallback_counts()
+
+
+class TestRegistryShape:
+    def test_every_family_registers_all_three_backends(self):
+        for family in registry.FAMILIES:
+            assert set(registry.lowerings(family)) == {
+                "pallas-tpu", "pallas-interpret", "xla"}, family
+
+    def test_every_loader_resolves_to_a_callable(self):
+        for family in registry.FAMILIES:
+            for entry in registry.lowerings(family).values():
+                assert callable(entry.fn()), entry.loader
+
+    def test_pallas_tpu_is_tpu_only(self):
+        for family in registry.FAMILIES:
+            caps = registry.lowerings(family)["pallas-tpu"].caps
+            assert caps.platforms == ("tpu",)
+            assert not caps.supports("cpu", "float32", frozenset())
+
+    def test_unknown_family_rejected(self):
+        with pytest.raises(ValueError, match="family"):
+            registry.lowerings("conv")
+        with pytest.raises(ValueError, match="family"):
+            registry.register("conv", "xla", "m:f",
+                              registry.Capabilities())
+
+    def test_duplicate_registration_needs_replace(self):
+        entry = registry.lowerings("flash")["xla"]
+        with pytest.raises(ValueError, match="already registered"):
+            registry.register("flash", "xla", entry.loader, entry.caps)
+        # replace=True restores the identical entry (no net change)
+        registry.register("flash", "xla", entry.loader, entry.caps,
+                          replace=True)
+        assert registry.lowerings("flash")["xla"].loader == entry.loader
+
+
+class TestResolution:
+    def test_platform_defaults(self):
+        """CPU preference order preserves pre-registry behavior: flash
+        and schedule ran pallas-interpret off-chip, paged decode ran
+        the XLA gather (PR 6's ``impl=None`` rule)."""
+        assert registry.default_backend("flash", "cpu") == \
+            "pallas-interpret"
+        assert registry.default_backend("schedule", "cpu") == \
+            "pallas-interpret"
+        assert registry.default_backend("paged", "cpu") == "xla"
+        for family in registry.FAMILIES:
+            assert registry.default_backend(family, "tpu") == \
+                "pallas-tpu"
+
+    def test_backends_order_drops_unavailable(self):
+        names = registry.backends("paged", "cpu")
+        assert names[0] == "xla"
+        assert "pallas-tpu" not in names
+        assert "pallas-tpu" in registry.backends(
+            "paged", "cpu", available_only=False)
+
+    def test_explicit_override_honored_when_capable(self):
+        assert registry.resolve("paged", "pallas-interpret",
+                                platform="cpu").backend == \
+            "pallas-interpret"
+        assert not registry.FALLBACK_COUNTS
+
+    def test_legacy_pallas_alias_is_platform_dependent(self):
+        assert registry.canonical_backend("pallas", "tpu") == \
+            "pallas-tpu"
+        assert registry.canonical_backend("pallas", "cpu") == \
+            "pallas-interpret"
+        assert registry.canonical_backend("xla", "cpu") == "xla"
+        assert registry.canonical_backend(None) is None
+        with pytest.raises(ValueError, match="unknown backend"):
+            registry.canonical_backend("mosaic")
+
+    def test_unavailable_request_falls_back_and_counts(self):
+        entry = registry.resolve("flash", "pallas-tpu", platform="cpu")
+        assert entry.backend == "pallas-interpret"
+        assert registry.FALLBACK_COUNTS[
+            "flash:pallas-tpu->pallas-interpret"] == 1
+
+    def test_strict_refuses_to_fall_back(self):
+        with pytest.raises(registry.BackendUnavailable):
+            registry.resolve("flash", "pallas-tpu", platform="cpu",
+                             strict=True)
+        # strict failure is not a fallback event
+        assert not registry.FALLBACK_COUNTS
+
+    def test_feature_filtering(self):
+        caps = registry.Capabilities(features=frozenset({"window"}))
+        assert caps.supports("cpu", "float32", frozenset({"window"}))
+        assert not caps.supports("cpu", "float32",
+                                 frozenset({"window", "multi_query"}))
+        # default dtypes=None is unrestricted (the pre-registry paths
+        # ran whatever dtype arrived); an explicit list restricts
+        assert caps.supports("cpu", "float16", frozenset())
+        narrow = registry.Capabilities(dtypes=("float32",))
+        assert not narrow.supports("cpu", "float16", frozenset())
+
+    def test_unlisted_dtype_still_dispatches(self):
+        """Regression (review finding): fp16 operands ran before the
+        registry existed and must keep running — dtype capability is a
+        restriction opt-in, not an allowlist."""
+        import jax.numpy as jnp
+        from tosem_tpu.nn.attention import flash_attn_fn
+        from tosem_tpu.ops.flash_attention import flash_attention
+        rng = np.random.default_rng(5)
+        q = jnp.asarray(rng.normal(size=(1, 1, 128, 16)), jnp.float16)
+        out = flash_attention(q, q, q, causal=True)
+        assert out.dtype == jnp.float16
+        q2 = jnp.asarray(rng.normal(size=(1, 128, 2, 16)), jnp.float16)
+        out2 = flash_attn_fn(causal=True)(q2, q2, q2, None)
+        assert np.isfinite(np.asarray(out2, np.float32)).all()
+
+
+class TestDispatchIntegration:
+    def _paged_case(self):
+        import jax.numpy as jnp
+        rng = np.random.default_rng(0)
+        q = jnp.asarray(rng.normal(size=(2, 2, 8)), jnp.float32)
+        kp = jnp.asarray(rng.normal(size=(6, 4, 2, 8)), jnp.float32)
+        vp = jnp.asarray(rng.normal(size=(6, 4, 2, 8)), jnp.float32)
+        bt = jnp.asarray(rng.integers(0, 6, size=(2, 3)), jnp.int32)
+        sl = jnp.asarray([5, 9], jnp.int32)
+        return q, kp, vp, bt, sl
+
+    def test_impl_alias_equals_canonical_backend(self):
+        """``impl="pallas"`` (the PR-6 spelling) and
+        ``backend="pallas-interpret"`` are the same lowering on CPU —
+        bit-identical outputs."""
+        from tosem_tpu.ops.paged_attention import paged_attention
+        q, kp, vp, bt, sl = self._paged_case()
+        a = np.asarray(paged_attention(q, kp, vp, bt, sl,
+                                       impl="pallas"))
+        b = np.asarray(paged_attention(q, kp, vp, bt, sl,
+                                       backend="pallas-interpret"))
+        np.testing.assert_array_equal(a, b)
+
+    def test_requested_tpu_backend_serves_off_chip_with_fallback(self):
+        """The tunnel-outage story: asking for pallas-tpu off-chip
+        still serves (degraded dispatch), and the event is COUNTED."""
+        from tosem_tpu.ops.paged_attention import paged_attention
+        q, kp, vp, bt, sl = self._paged_case()
+        before = dict(registry.FALLBACK_COUNTS)
+        out = paged_attention(q, kp, vp, bt, sl, backend="pallas-tpu")
+        assert np.isfinite(np.asarray(out)).all()
+        keys = [k for k, v in registry.FALLBACK_COUNTS.items()
+                if v > before.get(k, 0)]
+        assert any(k.startswith("paged:pallas-tpu->") for k in keys)
+
+    def test_flash_attn_fn_tallies_exact_backend(self):
+        """Satellite 2: the dispatch tally keys are the registry's
+        backend names, so an A/B asserts the exact lowering that ran —
+        and an explicit xla request runs (and tallies) xla."""
+        import jax
+        import jax.numpy as jnp
+        from tosem_tpu.nn.attention import (FLASH_DISPATCH_COUNTS,
+                                            flash_attn_fn)
+        served = registry.default_backend("flash")
+        ks = jax.random.split(jax.random.PRNGKey(0), 3)
+        mk = lambda kk: jax.random.normal(kk, (1, 128, 2, 16))
+        q, k, v = mk(ks[0]), mk(ks[1]), mk(ks[2])
+        before = dict(FLASH_DISPATCH_COUNTS)
+        flash_attn_fn(causal=True)(q, k, v, None)
+        assert FLASH_DISPATCH_COUNTS[served] == before.get(served, 0) + 1
+        assert FLASH_DISPATCH_COUNTS[f"{served}:causal"] == \
+            before.get(f"{served}:causal", 0) + 1
+        assert FLASH_DISPATCH_COUNTS["flash"] == \
+            before.get("flash", 0) + 1              # legacy aggregate
+        before = dict(FLASH_DISPATCH_COUNTS)
+        out_x = flash_attn_fn(causal=True, backend="xla")(q, k, v, None)
+        assert FLASH_DISPATCH_COUNTS["xla:causal"] == \
+            before.get("xla:causal", 0) + 1
+        assert FLASH_DISPATCH_COUNTS[served] == before.get(served, 0)
+        out_p = flash_attn_fn(causal=True)(q, k, v, None)
+        np.testing.assert_allclose(np.asarray(out_x), np.asarray(out_p),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_flash_attn_fn_ineligible_shape_counts_fallback(self):
+        """An explicitly-requested Pallas lowering on an untileable
+        shape degrades to XLA — and the registry fallback counter says
+        which request was not honored."""
+        import jax
+        from tosem_tpu.nn.attention import (FLASH_DISPATCH_COUNTS,
+                                            flash_attn_fn)
+        ks = jax.random.split(jax.random.PRNGKey(1), 3)
+        mk = lambda kk: jax.random.normal(kk, (1, 100, 2, 16))  # T%128
+        q, k, v = mk(ks[0]), mk(ks[1]), mk(ks[2])
+        before = dict(FLASH_DISPATCH_COUNTS)
+        before_fb = dict(registry.FALLBACK_COUNTS)
+        flash_attn_fn(backend="pallas")(q, k, v, None)
+        assert FLASH_DISPATCH_COUNTS["xla:dense"] == \
+            before.get("xla:dense", 0) + 1
+        requested = registry.canonical_backend("pallas")
+        key = f"flash:{requested}->xla"
+        assert registry.FALLBACK_COUNTS[key] == before_fb.get(key, 0) + 1
+
+    def test_flash_backend_xla_matches_pallas_interpret(self):
+        """The new flash xla lowering is semantics-identical to the
+        kernel across layouts (registry-level spot check; the full
+        matrix lives in test_parity_harness.py)."""
+        import jax.numpy as jnp
+        from tosem_tpu.ops.flash_attention import flash_attention
+        rng = np.random.default_rng(2)
+        for layout, shape in (("bhtd", (1, 2, 128, 16)),
+                              ("bthd", (1, 128, 2, 16))):
+            q = jnp.asarray(rng.normal(size=shape), jnp.float32)
+            k = jnp.asarray(rng.normal(size=shape), jnp.float32)
+            v = jnp.asarray(rng.normal(size=shape), jnp.float32)
+            a = flash_attention(q, k, v, causal=True, layout=layout,
+                                backend="pallas-interpret")
+            b = flash_attention(q, k, v, causal=True, layout=layout,
+                                backend="xla")
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-5, rtol=2e-5)
+
+    def test_kernel_bench_runs_every_available_lowering(self):
+        """`cli microbench --kernels`: one row per (family, executable
+        backend), rows labelled with the platform (CPU rows are never
+        on-chip evidence), excluded lowerings reported — and the gated
+        subset names exactly the off-chip rows."""
+        from tosem_tpu.ops.bench_kernels import (GATED_KERNEL_BENCHES,
+                                                 run_kernel_benchmarks)
+        rows = run_kernel_benchmarks(trials=1, min_s=0.05, quiet=True)
+        ids = {r.bench_id for r in rows}
+        platform = registry.current_platform()
+        for family in registry.FAMILIES:
+            for name in registry.backends(family, platform):
+                assert f"kernels_{family}_{name}" in ids
+        for r in rows:
+            assert r.extra["platform"] == platform
+            assert r.extra["on_chip"] == (platform == "tpu")
+            if platform != "tpu":
+                assert "pallas-tpu" in r.extra["skipped_backends"]
+            assert r.value > 0
+        if platform != "tpu":
+            assert ids == set(GATED_KERNEL_BENCHES)
+
+    def test_xla_flash_rejects_programs_without_mask(self):
+        import jax.numpy as jnp
+        from tosem_tpu.ops.flash_attention import flash_attention
+        from tosem_tpu.ops.flash_blocks import BlockSizes
+        from tosem_tpu.ops.mask_programs import (CausalMask,
+                                                 compile_mask_programs)
+        rng = np.random.default_rng(3)
+        q = jnp.asarray(rng.normal(size=(1, 1, 128, 16)), jnp.float32)
+        progs = compile_mask_programs(CausalMask(), 128, 128,
+                                      BlockSizes(32, 32, 32, 32))
+        with pytest.raises(ValueError, match="mask"):
+            flash_attention(q, q, q, programs=progs, backend="xla")

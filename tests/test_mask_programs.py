@@ -310,6 +310,18 @@ class TestKernelParity:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=3e-6, rtol=3e-6)
 
+    def test_schedule_pairs_parity_via_harness(self):
+        """Kernel-vs-XLA schedule parity migrated onto the universal
+        harness (ISSUE 14) — here the previously-untested
+        MultiHeadMask + segments cross pair (the schedule-XLA lowering
+        now composes segment ids); the full scenario matrix sweeps in
+        test_parity_harness.py."""
+        from tosem_tpu.ops import parity
+        for sc in [s for s in parity.scenarios("schedule")
+                   if s.name in ("multihead_segments", "doc_segments")]:
+            for a, b in parity.available_pairs("schedule"):
+                parity.check_pair("schedule", a, b, sc)
+
     def test_mismatched_program_blocks_rejected(self):
         q, k, v = _qkv(B=1, H=1)
         progs = compile_mask_programs(CausalMask(), 256, 256,
@@ -328,12 +340,15 @@ class TestDispatchTally:
         ks = jax.random.split(KEY, 3)
         mk = lambda kk: jax.random.normal(kk, (B, T, H, D))
         q, k, v = mk(ks[0]), mk(ks[1]), mk(ks[2])
+        from tosem_tpu.ops import registry
+        be = registry.default_backend("flash")   # the exact lowering
         before = dict(FLASH_DISPATCH_COUNTS)
         core = flash_attn_fn(mask=LocalMask(96))
         out = core(q, k, v, None)
         assert FLASH_DISPATCH_COUNTS["flash"] == before.get("flash", 0) + 1
-        assert FLASH_DISPATCH_COUNTS["flash:local:96:0"] == \
-            before.get("flash:local:96:0", 0) + 1
+        assert FLASH_DISPATCH_COUNTS[be] == before.get(be, 0) + 1
+        assert FLASH_DISPATCH_COUNTS[f"{be}:local:96:0"] == \
+            before.get(f"{be}:local:96:0", 0) + 1
         ref = _dense_ref(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
                          v.transpose(0, 2, 1, 3), LocalMask(96))
         np.testing.assert_allclose(
@@ -342,10 +357,10 @@ class TestDispatchTally:
         # dense flash call bumps the :dense key, not the local one
         core_d = flash_attn_fn()
         core_d(q, k, v, None)
-        assert FLASH_DISPATCH_COUNTS["flash:dense"] == \
-            before.get("flash:dense", 0) + 1
-        assert FLASH_DISPATCH_COUNTS["flash:local:96:0"] == \
-            before.get("flash:local:96:0", 0) + 1
+        assert FLASH_DISPATCH_COUNTS[f"{be}:dense"] == \
+            before.get(f"{be}:dense", 0) + 1
+        assert FLASH_DISPATCH_COUNTS[f"{be}:local:96:0"] == \
+            before.get(f"{be}:local:96:0", 0) + 1
 
     def test_xla_fallback_folds_mask_program(self):
         """Ragged (non-tile) lengths fall back to XLA WITH the mask
@@ -397,16 +412,19 @@ class TestSparseCacheSection:
         assert select_block_sizes.last_source == "table"
 
     def test_sparse_section_merge_preserves_others(self, tmp_path):
-        from tosem_tpu.ops.flash_blocks import save_cache
+        from tosem_tpu.ops.flash_blocks import save_cache, scoped_key
         path = str(tmp_path / "flash_blocks.json")
         save_cache({"t512_d64_bfloat16": [256, 256, 256, 256]}, path)
         save_cache({"decode_d64_bfloat16": 128}, path, section="pages")
         save_cache({"t512_d64_bfloat16_causal": [512, 512, 512, 512]},
                    path, section="sparse")
         data = json.load(open(path))
-        assert set(data) == {"blocks", "pages", "sparse"}
-        assert data["blocks"] == {"t512_d64_bfloat16": [256, 256, 256, 256]}
-        assert data["pages"] == {"decode_d64_bfloat16": 128}
+        assert {"blocks", "pages", "sparse"} <= set(data)
+        assert data["blocks"] == {
+            scoped_key("blocks", "t512_d64_bfloat16"):
+            [256, 256, 256, 256]}
+        assert data["pages"] == {
+            scoped_key("pages", "decode_d64_bfloat16"): 128}
 
     @pytest.mark.parametrize("sparse", [
         "not-a-dict", {"t512_d64_bfloat16_causal": [512, "x"]},
@@ -415,10 +433,11 @@ class TestSparseCacheSection:
                                                          sparse):
         """Mirror of the "pages" regression tests: a bad sparse section
         degrades to the dense selection path, never crashes."""
-        from tosem_tpu.ops.flash_blocks import (reset_cache,
+        from tosem_tpu.ops.flash_blocks import (reset_cache, scoped_key,
                                                 select_block_sizes)
         path = str(tmp_path / "flash_blocks.json")
-        payload = {"blocks": {"t512_d64_bfloat16": [256, 256, 256, 256]}}
+        payload = {"blocks": {scoped_key("blocks", "t512_d64_bfloat16"):
+                              [256, 256, 256, 256]}}
         if sparse is not None:
             payload["sparse"] = sparse
         with open(path, "w") as f:
@@ -441,12 +460,14 @@ class TestSparseCacheSection:
         assert recs and any(r["best"] for r in recs)
         assert all(0 < r["executed_block_fraction"] <= 1 for r in recs)
         sig = recs[0]["mask"]
+        from tosem_tpu.ops.flash_blocks import scoped_key
         data = json.load(open(path))["sparse"]
-        assert f"t128_d16_float32_{sig}" in data
+        key = scoped_key("sparse", f"t128_d16_float32_{sig}")
+        assert key in data
         reset_cache()
         b = select_block_sizes(128, 16, "float32", cache_path=path,
                                mask_sig=sig)
-        assert b.as_list() == data[f"t128_d16_float32_{sig}"]
+        assert b.as_list() == data[key]
         assert select_block_sizes.last_source == "sparse"
 
 
@@ -477,7 +498,9 @@ class TestServeRouting:
         delta = {k: v - before.get(k, 0)
                  for k, v in FLASH_DISPATCH_COUNTS.items()
                  if v != before.get(k, 0)}
-        assert any(k == "flash:local:64:63" for k in delta), delta
+        from tosem_tpu.ops import registry
+        served = registry.default_backend("flash")
+        assert any(k == f"{served}:local:64:63" for k in delta), delta
         assert all(np.isfinite(o["pooled"]).all() for o in out)
         # short bucket: dense
         before = dict(FLASH_DISPATCH_COUNTS)
@@ -485,7 +508,7 @@ class TestServeRouting:
         delta = {k: v - before.get(k, 0)
                  for k, v in FLASH_DISPATCH_COUNTS.items()
                  if v != before.get(k, 0)}
-        assert any(k == "flash:dense" for k in delta), delta
+        assert any(k == f"{served}:dense" for k in delta), delta
 
     def test_bert_backend_sparse_parity_with_model(self):
         """The routed sparse program computes exactly the model with

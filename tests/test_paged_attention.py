@@ -45,29 +45,21 @@ def test_xla_impl_is_the_reference_bit_exact():
     np.testing.assert_array_equal(np.asarray(ref), np.asarray(auto))
 
 
-@pytest.mark.parametrize("lens", [[4], [7, 0, 16], [1, 8, 3, 13]])
-def test_pallas_interpret_matches_reference_fp32(lens):
-    from tosem_tpu.ops.paged_attention import (paged_attention,
-                                               paged_attention_reference)
-    rng = np.random.default_rng(1)
-    B = len(lens)
-    q, kp, vp, bt, sl = _case(rng, B, 2, 8, 4, 6, 4, lens)
-    ref = np.asarray(paged_attention_reference(q, kp, vp, bt, sl))
-    out = np.asarray(paged_attention(q, kp, vp, bt, sl, impl="pallas"))
-    np.testing.assert_allclose(out, ref, atol=FP32_ATOL, rtol=0)
+# The three-lowering parity pins migrated onto the universal harness
+# (ISSUE 14): every pair of executable lowerings cross-checks over the
+# paged scenario matrix (ragged/bf16/multi-q/window/offsets), plus the
+# numpy-oracle pins — see tosem_tpu/ops/parity.py for the matrix and
+# tests/test_parity_harness.py for the full sweep across families.
 
-
-def test_pallas_interpret_matches_reference_bf16():
-    from tosem_tpu.ops.paged_attention import (paged_attention,
-                                               paged_attention_reference)
-    rng = np.random.default_rng(2)
-    q, kp, vp, bt, sl = _case(rng, 2, 2, 8, 4, 5, 3, [9, 12],
-                              dtype="bfloat16")
-    ref = np.asarray(paged_attention_reference(q, kp, vp, bt, sl),
-                     np.float32)
-    out = np.asarray(paged_attention(q, kp, vp, bt, sl, impl="pallas"),
-                     np.float32)
-    np.testing.assert_allclose(out, ref, atol=BF16_ATOL, rtol=0)
+@pytest.mark.parametrize("scenario", ["ragged_lens", "single_full"])
+def test_lowering_pairs_parity_via_harness(scenario):
+    """(The oracle pins for these cells run in test_parity_harness.py —
+    this keeps the pair cross-check next to the kernel's own tests.)"""
+    from tosem_tpu.ops import parity
+    for sc in [s for s in parity.scenarios("paged")
+               if s.name == scenario]:
+        for a, b in parity.available_pairs("paged"):
+            parity.check_pair("paged", a, b, sc)
 
 
 def test_inactive_rows_emit_exact_zeros():

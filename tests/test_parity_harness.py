@@ -1,0 +1,97 @@
+"""Universal cross-backend parity matrix (:mod:`tosem_tpu.ops.parity`).
+
+One parametrized engine replaces the per-file hand-rolled parity tests
+(ISSUE 14 satellite): for EVERY kernel family, every pair of lowerings
+executable on this platform is cross-checked over the family's declared
+scenario matrix (mask × dtype × layout × window/spec-k), plus numpy /
+dense-oracle pins for the cells the ISSUE names (windowed multi-token-q
+vs dense oracle; pallas-interpret vs schedule-XLA under MultiHeadMask +
+segments). On CPU the pairs are (pallas-interpret, xla); on TPU
+pallas-tpu joins and the matrix widens automatically — no test edits.
+"""
+import pytest
+
+from tosem_tpu.ops import parity, registry
+
+# parametrized at collection from the STATIC matrix (no jax import);
+# pairs are enumerated inside the test where the platform is known
+_CELLS = [(fam, sc) for fam in registry.FAMILIES
+          for sc in parity.scenarios(fam)]
+
+
+@pytest.mark.parametrize("family,sc", _CELLS, ids=[str(s) for _, s in
+                                                   _CELLS])
+def test_all_available_pairs_agree(family, sc):
+    pairs = parity.available_pairs(family)
+    assert pairs, f"{family}: fewer than two lowerings on this platform"
+    for a, b in pairs:
+        parity.check_pair(family, a, b, sc)
+
+
+class TestOraclePins:
+    """The lowerings agreeing with EACH OTHER is necessary, not
+    sufficient — these cells also pin against brute-force references
+    that share no code with any jax lowering."""
+
+    def test_windowed_multi_q_vs_dense_oracle(self):
+        """ISSUE-named cross pair: windowed multi-token-q against the
+        numpy oracle, on every executable paged lowering."""
+        sc = [s for s in parity.scenarios("paged")
+              if s.name == "window_multi_q"][0]
+        for backend in parity.available_backends("paged"):
+            parity.check_oracle("paged", backend, sc)
+
+    def test_rolling_offsets_vs_dense_oracle(self):
+        sc = [s for s in parity.scenarios("paged")
+              if s.name == "window_offsets"][0]
+        for backend in parity.available_backends("paged"):
+            parity.check_oracle("paged", backend, sc)
+
+    def test_multihead_segments_vs_dense_oracle(self):
+        """ISSUE-named cross pair: MultiHeadMask + segments — the
+        schedule-XLA lowering (new segment support) and the Pallas
+        kernels against the dense fold."""
+        sc = [s for s in parity.scenarios("schedule")
+              if s.name == "multihead_segments"][0]
+        for backend in parity.available_backends("schedule"):
+            parity.check_oracle("schedule", backend, sc)
+
+    @pytest.mark.parametrize("family", ["flash", "schedule"])
+    def test_default_backend_vs_dense_oracle_sample(self, family):
+        backend = registry.default_backend(family)
+        for sc in parity.scenarios(family, "float32")[:3]:
+            parity.check_oracle(family, backend, sc)
+
+
+class TestHarnessMechanics:
+    def test_build_case_is_deterministic(self):
+        import numpy as np
+        sc = parity.scenarios("paged")[0]
+        (q1, *_), _ = parity.build_case(sc)
+        (q2, *_), _ = parity.build_case(sc)
+        np.testing.assert_array_equal(np.asarray(q1), np.asarray(q2))
+
+    def test_pairs_are_strict(self):
+        """A pair must run exactly the lowerings it names: an
+        unavailable backend raises instead of silently self-checking
+        via fallback."""
+        sc = parity.scenarios("flash")[0]
+        if registry.current_platform() == "tpu":
+            pytest.skip("pallas-tpu is available on TPU")
+        with pytest.raises(registry.BackendUnavailable):
+            parity.check_pair("flash", "pallas-tpu", "xla", sc)
+
+    def test_violation_reports_scenario_and_pair(self):
+        """A mismatch names the scenario, the pair, and the worst
+        element — the debugging surface the per-file tests used to
+        hand-roll."""
+        sc = parity.scenarios("flash")[0]
+        a, b = parity.available_pairs("flash")[0]
+        with pytest.raises(AssertionError, match="parity.*vs"):
+            parity.check_pair("flash", a, b, sc, atol=0.0)
+
+    def test_run_matrix_covers_every_pair(self):
+        recs = parity.run_matrix(families=("paged",))
+        pairs = {tuple(r["pair"]) for r in recs}
+        assert pairs == set(parity.available_pairs("paged"))
+        assert len(recs) == len(pairs) * len(parity.scenarios("paged"))

@@ -7,6 +7,7 @@ entry points can never measure the same config under different
 parameters), and a typo'd --legs selection is an error, not a silent
 successful no-op.
 """
+import json
 import os
 import subprocess
 import sys
@@ -83,3 +84,21 @@ class TestTunnelPreflight:
         assert out.count("requeued") >= tpu_capture.TUNNEL_REQUEUES
         assert "skipped (tunnel" in out
         assert "failed (" not in out        # a tunnel loss, not a bug
+        # every leg tunnel-lost ⇒ the report HEADLINE says so
+        # explicitly instead of leaving an empty evidence section
+        assert "HEADLINE" in out and "zero on-chip evidence" in out
+        summary = json.loads((tmp_path / "summary.json").read_text())
+        assert "zero on-chip evidence" in summary["headline"]
+
+
+class TestCaptureHeadline:
+    def test_all_skipped_states_it(self):
+        hl = tpu_capture.capture_headline(
+            {"a": "skipped (tunnel)",
+             "b": "skipped (tunnel; degraded run: rc=1)"})
+        assert hl and "zero on-chip evidence" in hl
+
+    def test_any_on_chip_leg_suppresses_it(self):
+        assert tpu_capture.capture_headline(
+            {"a": "skipped (tunnel)", "b": "ok (12s)"}) is None
+        assert tpu_capture.capture_headline({}) is None

@@ -37,6 +37,12 @@ _LAZY_EXPORTS = {
                                "CheckpointCorruptError"),
     # flash-attention kernel surface (round 6): segment-masked streamed
     # kernels + block-size selection + the shard_map wrapper
+    # backend-portable kernel layer (round 14): the lowering registry,
+    # cross-backend parity harness, and fallback-visibility surface
+    "BackendUnavailable": ("tosem_tpu.ops.registry",
+                           "BackendUnavailable"),
+    "kernel_backends": ("tosem_tpu.ops.registry", "backends"),
+    "run_kernel_parity": ("tosem_tpu.ops.parity", "run_matrix"),
     "SegmentIds": ("tosem_tpu.ops.flash_attention", "SegmentIds"),
     "BlockSizes": ("tosem_tpu.ops.flash_blocks", "BlockSizes"),
     "select_block_sizes": ("tosem_tpu.ops.flash_blocks",

@@ -30,7 +30,7 @@ CONFIGS = ("gemm", "timing_check", "conv_sweep", "allreduce",
            "detection_train", "detection_infer", "pointpillars_infer",
            "speech_train", "serve_bench", "decode_bench",
            "decode_scenarios", "cluster_bench", "train_bench",
-           "analysis")
+           "kernel_matrix", "analysis")
 
 
 def make_flags() -> FlagSet:
@@ -1086,6 +1086,21 @@ def run_train_bench(fs: FlagSet) -> List[Any]:
     return rows
 
 
+def run_kernel_matrix(fs: FlagSet) -> List[Any]:
+    """Cross-backend kernel suite as a capture/bench leg: the SAME
+    ``bench_kernels`` suite ``ci.sh --perf`` gates off-chip, re-run
+    here — on-chip when ``--device=tpu``, where the ``pallas-tpu``
+    lowerings join the race — so off-chip floors and on-chip captures
+    share one row schema (rows carry ``extra.platform`` /
+    ``extra.on_chip``; CPU rows are never on-chip evidence). Rows land
+    under the ``kernel_matrix`` config."""
+    from tosem_tpu.ops.bench_kernels import run_kernel_benchmarks
+    rows = run_kernel_benchmarks(trials=2, min_s=0.4)
+    for r in rows:
+        r.config = "kernel_matrix"
+    return rows
+
+
 def run_analysis(fs: FlagSet) -> List[Any]:
     """Study analysis layer (L8): classify this repo's test suite into the
     RQ3/RQ4 taxonomy and correlate the bench CSVs — the consumer role of
@@ -1163,6 +1178,7 @@ RUNNERS = {
     "decode_scenarios": run_decode_scenarios,
     "cluster_bench": run_cluster_bench,
     "train_bench": run_train_bench,
+    "kernel_matrix": run_kernel_matrix,
     "analysis": run_analysis,
 }
 

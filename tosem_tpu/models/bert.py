@@ -281,7 +281,8 @@ class Bert(Module):
             return logits, jnp.stack(ks), jnp.stack(vs_)
         return fwd
 
-    def decode_step_fn(self, vs, *, page_size: int, impl=None):
+    def decode_step_fn(self, vs, *, page_size: int, impl=None,
+                       backend=None):
         """One-token decode step over the paged cache: ``fwd(ids [B],
         positions [B], k_pool, v_pool [L,P,page,H,Dh], block_tables
         [B,max_pages], seq_lens [B]) -> (logits [B,vocab], k_pool',
@@ -299,6 +300,9 @@ class Bert(Module):
         self._check_decodable()
         from tosem_tpu.ops.paged_attention import paged_attention
         p = vs["params"]
+        # ``backend`` (registry name) wins over the legacy ``impl``
+        # alias; both funnel into paged_attention's registry dispatch
+        backend = backend if backend is not None else impl
 
         def fwd(ids, positions, k_pool, v_pool, block_tables, seq_lens):
             B = ids.shape[0]
@@ -317,7 +321,7 @@ class Bert(Module):
             for i, layer in enumerate(self.layers):
                 h, k_pool, v_pool = _decode_layer_step(
                     layer, p[f"layer{i}"], h, i, k_pool, v_pool,
-                    pages, rows, block_tables, seq_lens, impl)
+                    pages, rows, block_tables, seq_lens, backend)
             h, _ = self.ln_out.apply(variables(p["ln_out"]), h[:, None])
             logits = self.tok.attend(variables(p["tok"]),
                                      h[:, 0].astype(jnp.float32))
@@ -325,7 +329,8 @@ class Bert(Module):
         return fwd
 
     def decode_multi_fn(self, vs, *, page_size: int, q_tokens: int,
-                        impl=None, window: Optional[int] = None):
+                        impl=None, window: Optional[int] = None,
+                        backend=None):
         """K-token decode step over the paged cache — the speculative-
         scoring / sliding-window generalization of
         :meth:`decode_step_fn`: ``fwd(ids [B,K], positions [B,K],
@@ -350,6 +355,7 @@ class Bert(Module):
         from tosem_tpu.ops.paged_attention import paged_attention
         p = vs["params"]
         K = q_tokens
+        backend = backend if backend is not None else impl
 
         def fwd(ids, positions, k_pool, v_pool, block_tables, seq_lens,
                 q_rows, page_offsets):
@@ -373,7 +379,7 @@ class Bert(Module):
             for i, layer in enumerate(self.layers):
                 h, k_pool, v_pool = _decode_layer_multi(
                     layer, p[f"layer{i}"], h, i, k_pool, v_pool, pages,
-                    rows, block_tables, sl, kr, po, impl, window)
+                    rows, block_tables, sl, kr, po, backend, window)
             h, _ = self.ln_out.apply(variables(p["ln_out"]), h)
             logits = self.tok.attend(variables(p["tok"]),
                                      h.astype(jnp.float32))
@@ -427,7 +433,7 @@ def _decode_layer_full(layer, p_l, x, core):
 
 
 def _decode_layer_step(layer, p_l, x, layer_idx, k_pool, v_pool, pages,
-                       rows, block_tables, seq_lens, impl):
+                       rows, block_tables, seq_lens, backend):
     """One layer of the single-token decode step: project q/k/v for the
     current token, scatter K/V into its page slot, attend over the
     paged cache (which now includes the token itself), then the same
@@ -446,7 +452,7 @@ def _decode_layer_step(layer, p_l, x, layer_idx, k_pool, v_pool, pages,
     v_pool = v_pool.at[layer_idx, pages, rows].set(
         v.astype(v_pool.dtype))
     out = paged_attention(q, k_pool[layer_idx], v_pool[layer_idx],
-                          block_tables, seq_lens, impl=impl)
+                          block_tables, seq_lens, backend=backend)
     out = out.reshape(B, attn.dim).astype(x.dtype)
     out, _ = attn.o.apply(variables(p_l["attn"]["o"]), out)
     x = x + out
@@ -459,7 +465,7 @@ def _decode_layer_step(layer, p_l, x, layer_idx, k_pool, v_pool, pages,
 
 def _decode_layer_multi(layer, p_l, x, layer_idx, k_pool, v_pool, pages,
                         rows, block_tables, seq_lens, q_rows,
-                        page_offsets, impl, window):
+                        page_offsets, backend, window):
     """One layer of the K-token decode step (the multi-query sibling of
     :func:`_decode_layer_step`): project q/k/v for all K fed tokens,
     scatter their K/V into the page slots ([B, K] index arrays — OOB
@@ -478,7 +484,7 @@ def _decode_layer_multi(layer, p_l, x, layer_idx, k_pool, v_pool, pages,
     v_pool = v_pool.at[layer_idx, pages, rows].set(
         v.astype(v_pool.dtype))
     out = paged_attention(q, k_pool[layer_idx], v_pool[layer_idx],
-                          block_tables, seq_lens, impl=impl,
+                          block_tables, seq_lens, backend=backend,
                           q_rows=q_rows, window=window,
                           page_offsets=page_offsets)
     out = out.reshape(B, K, attn.dim).astype(x.dtype)

@@ -35,21 +35,26 @@ def dot_product_attention(q, k, v, mask: Optional[jax.Array] = None, *,
                       precision=PRECISION[precision])
 
 
-# trace-time dispatch tally: which attention core ran per traced call.
-# The padded-batch A/B test asserts the flash path actually fired (a
-# silent XLA fallback is exactly the regression this guards against).
-# Besides the "flash"/"xla" aggregates, every dispatch also bumps a
-# mask-signature-qualified key ("flash:causal", "flash:local:1024:0",
-# "xla:dense", …) so A/B tests can assert the SPARSE path specifically —
-# the aggregate alone cannot distinguish a causal-flash dispatch from a
-# dense-flash one. A Counter so callers may clear() it between
-# measurements.
+# trace-time dispatch tally: which attention lowering ran per traced
+# call. The padded-batch A/B test asserts the flash path actually fired
+# (a silent XLA fallback is exactly the regression this guards against).
+# Keys are the REGISTRY's backend names ("pallas-tpu",
+# "pallas-interpret", "xla") plus a mask-signature-qualified key per
+# dispatch ("pallas-interpret:local:1024:0", "xla:dense", …) so A/B
+# tests assert the EXACT lowering that ran; the legacy "flash"
+# aggregate still counts every Pallas dispatch ("xla" is both the exact
+# backend name and its own aggregate). Registry fallback events
+# (requested backend unavailable → which one served) are counted
+# separately in tosem_tpu.ops.registry.FALLBACK_COUNTS. A Counter so
+# callers may clear() it between measurements.
 FLASH_DISPATCH_COUNTS = collections.Counter({"flash": 0, "xla": 0})
 
 
-def _tally(path: str, sig: str) -> None:
-    FLASH_DISPATCH_COUNTS[path] += 1
-    FLASH_DISPATCH_COUNTS[f"{path}:{sig}"] += 1
+def _tally(backend: str, sig: str) -> None:
+    FLASH_DISPATCH_COUNTS[backend] += 1
+    FLASH_DISPATCH_COUNTS[f"{backend}:{sig}"] += 1
+    if backend != "xla":
+        FLASH_DISPATCH_COUNTS["flash"] += 1
 
 
 def _as_key_padding(mask, B: int, Tk: int) -> Optional[jax.Array]:
@@ -68,7 +73,7 @@ def _as_key_padding(mask, B: int, Tk: int) -> Optional[jax.Array]:
 
 
 def flash_attn_fn(causal: bool = False, precision: str = "default",
-                  mask=None):
+                  mask=None, backend=None):
     """An ``attn_fn`` for :class:`MultiHeadAttention` that routes
     eligible shapes through the Pallas flash kernel (bf16-native MXU
     path) and falls back to the XLA path otherwise. Key-padding masks
@@ -90,7 +95,15 @@ def flash_attn_fn(causal: bool = False, precision: str = "default",
     pay neither MXU nor HBM, and the model's runtime key-padding mask
     still composes as segment ids on top. Thread it through a model's
     ``apply(..., attn_fn=flash_attn_fn(mask=LocalMask(1024)))`` — e.g.
-    long-document BERT serving at t8192."""
+    long-document BERT serving at t8192.
+
+    ``backend`` overrides the registry's platform-default lowering
+    (``pallas-tpu`` / ``pallas-interpret`` / ``xla``, or the legacy
+    ``"pallas"`` alias). Shapes the Pallas kernels cannot tile still
+    fall back to XLA — counted in ``registry.FALLBACK_COUNTS`` when a
+    Pallas backend was explicitly requested — and every dispatch
+    tallies under the backend name that actually served."""
+    from tosem_tpu.ops import registry
     from tosem_tpu.ops.flash_attention import (SegmentIds,
                                                mha_flash_attention)
 
@@ -112,15 +125,40 @@ def flash_attn_fn(causal: bool = False, precision: str = "default",
         # lengths, so short ragged T falls back to XLA
         blocks_ok = Tq % 8 == 0 and Tk % 128 == 0
         kv_mask = _as_key_padding(attn_mask, B, Tk)
-        if blocks_ok and (attn_mask is None or kv_mask is not None):
+        eligible = blocks_ok and (attn_mask is None
+                                  or kv_mask is not None)
+        served = "xla"
+        if eligible:
+            feats = {"layout_bthd"}
+            if mask is not None or causal:
+                feats.add("mask")
+            if kv_mask is not None:
+                feats.add("segments")
+            try:
+                served = registry.resolve(
+                    "flash", backend, dtype=str(q.dtype),
+                    features=frozenset(feats)).backend
+            except registry.BackendUnavailable:
+                # the contract is fall-back-to-XLA, never crash the
+                # model forward pass (the dense path below runs
+                # anything)
+                served = "xla"
+        elif backend is not None:
+            # an explicitly-requested Pallas lowering degrading to XLA
+            # on an untileable/dense-masked shape is a fallback event
+            requested = registry.canonical_backend(backend)
+            if requested != "xla":
+                registry.FALLBACK_COUNTS[f"flash:{requested}->xla"] += 1
+        if served != "xla":
             seg = None
             if kv_mask is not None:
                 seg = SegmentIds(q=jnp.ones((B, Tq), jnp.int32),
                                  kv=kv_mask.astype(jnp.int32))
-            _tally("flash", sig)
+            _tally(served, sig)
             return mha_flash_attention(q, k, v, causal=causal,
                                        segment_ids=seg,
-                                       mask_program=mask)
+                                       mask_program=mask,
+                                       backend=served)
         _tally("xla", sig)
         if causal:
             cm = jnp.tril(jnp.ones((Tq, Tk), bool))[None, None]

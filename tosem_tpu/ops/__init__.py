@@ -1,3 +1,4 @@
+from tosem_tpu.ops import registry
 from tosem_tpu.ops.gemm import gemm, gemm_bench, GemmSpec
 from tosem_tpu.ops.conv import conv2d, conv_bench, ConvSpec, RESNET50_CONV_SWEEP
 from tosem_tpu.ops.flash_attention import (flash_attention,

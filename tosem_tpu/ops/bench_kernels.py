@@ -1,0 +1,127 @@
+"""Cross-backend kernel microbench: every registered lowering, A/B'd.
+
+The registry's bench arm (``cli microbench --kernels``): for each
+kernel family it runs EVERY lowering executable on this platform over
+one fixed scenario, interleaved per round (all arms share each round's
+host phase), and emits one rate row per (family, backend). Off-chip
+rows carry ``platform=cpu`` in their extras and are NEVER on-chip
+evidence — they are the reproducible arm the BENCH trajectory lost to
+the tunnel (r03/r04 lost, r05 degraded): a tunnel outage now degrades
+evidence *freshness* (the on-chip ``kernel_matrix`` capture leg goes
+stale), not evidence *existence* (these floors keep gating).
+
+Before any timing, the arms are parity-pinned against each other
+through :mod:`tosem_tpu.ops.parity` — an A/B between lowerings that
+compute different things is not a benchmark.
+
+Bench-noise protocol (the ``bench_runtime`` discipline): interleaved
+rounds, per-round rates recorded, ``--save`` floors baselines at the
+min across rounds, ``ci.sh --perf`` gates the floors in
+``results/bench_kernels.json``. Lowerings registered on this platform
+but not run (none today) and lowerings excluded by platform
+(``pallas-tpu`` off-chip) are reported in ``extra["skipped_backends"]``
+— silent truncation must not read as coverage.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from tosem_tpu.utils.results import ResultRow
+
+# the ci.sh --perf gated subset: every off-chip lowering's rate floor
+GATED_KERNEL_BENCHES = (
+    "kernels_flash_pallas-interpret",
+    "kernels_flash_xla",
+    "kernels_paged_pallas-interpret",
+    "kernels_paged_xla",
+    "kernels_schedule_pallas-interpret",
+    "kernels_schedule_xla",
+)
+
+
+def _rate(fn, args, budget_s: float) -> float:
+    """Iterations/second over a >= ``budget_s`` window; one untimed
+    warmup call, at least two timed iterations (the bench_sparse
+    rule: a one-iteration window measures launch jitter)."""
+    import jax
+    jax.block_until_ready(fn(*args))
+    n, t0 = 0, time.perf_counter()
+    while True:
+        jax.block_until_ready(fn(*args))
+        n += 1
+        dt = time.perf_counter() - t0
+        if dt >= budget_s and n >= 2:
+            return n / dt
+
+
+def _bench_scenario(family: str):
+    """The fixed scenario each family's arms race on: small enough for
+    interpret mode, structured enough (mask/segments/ragged pages) that
+    the lowerings' real dispatch paths run."""
+    from tosem_tpu.ops import parity
+    if family == "flash":
+        return parity._sc("flash", "bench_causal_segments",
+                          causal=True, segments=True)
+    if family == "paged":
+        return parity._sc("paged", "bench_ragged", lens=(31, 7, 0, 24))
+    return parity._sc("schedule", "bench_local", mask="local:48")
+
+
+def run_kernel_benchmarks(trials: int = 3, min_s: float = 0.5,
+                          quiet: bool = False,
+                          only: Optional[set] = None) -> List[ResultRow]:
+    import jax
+
+    from tosem_tpu.ops import parity, registry
+    from tosem_tpu.serve.bench_common import SuiteEmitter
+
+    platform = registry.current_platform()
+    em = SuiteEmitter("kernels", only)
+    for family in registry.FAMILIES:
+        sc = _bench_scenario(family)
+        args, kwargs = parity.build_case(sc)
+        registered = set(registry.lowerings(family))
+        names = registry.backends(family, platform)
+        skipped = sorted(registered - set(names))
+        if skipped and not quiet:
+            print(f"  kernels[{family}]: {skipped} not executable on "
+                  f"platform={platform} (on-chip capture re-runs them)")
+        # parity pin across ALL arms before any timing
+        for a, b in parity.available_pairs(family, platform):
+            parity.check_pair(family, a, b, sc)
+        arms: Dict[str, object] = {}
+        for name in names:
+            fn = registry.resolve(family, name, strict=True).fn()
+            jitted = jax.jit(lambda *xs, _fn=fn, _kw=kwargs:
+                             _fn(*xs, **_kw))
+            jax.block_until_ready(jitted(*args))   # compile outside
+            arms[name] = jitted
+        per_round: Dict[str, List[float]] = {n: [] for n in names}
+        for _ in range(max(trials, 1)):
+            # interleaved: every arm sees this round's host phase
+            for name in names:
+                per_round[name].append(_rate(arms[name], args, min_s))
+        for name in names:
+            r = em.emit(f"kernels_{family}_{name}",
+                        f"{family} kernel, {name} lowering "
+                        f"({sc.name}, {sc.dtype})",
+                        per_round[name], unit="it/s")
+            if r:
+                r.extra.update(
+                    platform=platform, backend=name, family=family,
+                    scenario=sc.name, dtype=sc.dtype,
+                    skipped_backends=skipped,
+                    on_chip=platform == "tpu")
+    return em.flush(quiet)
+
+
+def main(argv=None) -> int:
+    """Standalone entry: ``python -m tosem_tpu.ops.bench_kernels`` —
+    the cli route is ``python -m tosem_tpu.cli microbench --kernels``."""
+    from tosem_tpu.runtime.bench_runtime import main as micro_main
+    return micro_main(["--kernels"] + (argv or []))
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

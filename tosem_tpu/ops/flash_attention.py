@@ -241,11 +241,15 @@ def _check_blocks(Tq, Tk, bq, bk):
 
 
 def _pallas_call(kernel, *, grid, in_specs, out_specs, out_shape,
-                 scratch_shapes, scheduled):
+                 scratch_shapes, scheduled, interpret=None):
     """One pallas_call surface for both paths: scheduled calls wrap the
     grid in ``PrefetchScalarGridSpec`` (schedule arrays land in SMEM
     before the body runs; every index map receives them trailing), the
-    dense path keeps the plain grid."""
+    dense path keeps the plain grid. ``interpret`` selects the
+    pallas-interpret vs pallas-tpu lowering (None = the platform
+    default — interpret everywhere but TPU)."""
+    if interpret is None:
+        interpret = _interpret()
     if scheduled:
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=_N_SCHED, grid=grid, in_specs=in_specs,
@@ -253,12 +257,12 @@ def _pallas_call(kernel, *, grid, in_specs, out_specs, out_shape,
         return pl.pallas_call(kernel, grid_spec=grid_spec,
                               out_shape=out_shape,
                               compiler_params=_STREAMED,
-                              interpret=_interpret())
+                              interpret=interpret)
     return pl.pallas_call(kernel, grid=grid, in_specs=in_specs,
                           out_specs=out_specs, out_shape=out_shape,
                           scratch_shapes=scratch_shapes,
                           compiler_params=_STREAMED,
-                          interpret=_interpret())
+                          interpret=interpret)
 
 
 # ---------------------------------------------------------------------------
@@ -337,7 +341,8 @@ def _fwd_kernel(*refs, sm_scale, segmented, scheduled, bq, bk, n_k):
         lse_ref[...] = jnp.broadcast_to(m + jnp.log(l_safe), lse_ref.shape)
 
 
-def _flash_fwd(q, k, v, segment_ids, programs, sm_scale, blocks, layout):
+def _flash_fwd(q, k, v, segment_ids, programs, sm_scale, blocks, layout,
+               interpret=None):
     B, Tq, H, d = _shapes(layout, q)
     _, Tk, _, _ = _shapes(layout, k)
     blocks = blocks.clamp(Tq, Tk)
@@ -382,7 +387,7 @@ def _flash_fwd(q, k, v, segment_ids, programs, sm_scale, blocks, layout):
         scratch_shapes=[pltpu.VMEM((bq, _LANES), jnp.float32),
                         pltpu.VMEM((bq, _LANES), jnp.float32),
                         pltpu.VMEM((bq, d), jnp.float32)],
-        scheduled=scheduled)
+        scheduled=scheduled, interpret=interpret)
     if scheduled:
         out, lse = call(*_sched_args(sched), *args)
     else:
@@ -526,7 +531,7 @@ def _bwd_dq_kernel(*refs, sm_scale, segmented, scheduled, bq, bk, n_k):
         dq_ref[...] = (dq_sc[...] * sm_scale).astype(dq_ref.dtype)
 
 
-def _flash_bwd(sm_scale, blocks, layout, res, g):
+def _flash_bwd(sm_scale, blocks, layout, interpret, res, g):
     q, k, v, out, lse, segment_ids, programs = res
     do = g
     B, Tq, H, d = _shapes(layout, q)
@@ -587,7 +592,7 @@ def _flash_bwd(sm_scale, blocks, layout, res, g):
                    jax.ShapeDtypeStruct(kv_shape, v.dtype)],
         scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
                         pltpu.VMEM((bk, d), jnp.float32)],
-        scheduled=scheduled)
+        scheduled=scheduled, interpret=interpret)
     if scheduled:
         dk, dv = dkv_call(*_sched_args(programs.dkv), *dkv_args)
     else:
@@ -626,7 +631,7 @@ def _flash_bwd(sm_scale, blocks, layout, res, g):
         out_specs=_tile_spec(layout, bq, d, _resident),
         out_shape=jax.ShapeDtypeStruct(q_shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
-        scheduled=scheduled)
+        scheduled=scheduled, interpret=interpret)
     if scheduled:
         dq = dq_call(*_sched_args(programs.dq), *dq_args)
     else:
@@ -638,17 +643,18 @@ def _flash_bwd(sm_scale, blocks, layout, res, g):
 # public op
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
 def _flash_attention(q, k, v, segment_ids, programs, sm_scale, blocks,
-                     layout):
+                     layout, interpret=None):
     out, _ = _flash_fwd(q, k, v, segment_ids, programs, sm_scale, blocks,
-                        layout)
+                        layout, interpret)
     return out
 
 
-def _vjp_fwd(q, k, v, segment_ids, programs, sm_scale, blocks, layout):
+def _vjp_fwd(q, k, v, segment_ids, programs, sm_scale, blocks, layout,
+             interpret=None):
     out, lse = _flash_fwd(q, k, v, segment_ids, programs, sm_scale,
-                          blocks, layout)
+                          blocks, layout, interpret)
     return out, (q, k, v, out, lse, segment_ids, programs)
 
 
@@ -656,8 +662,8 @@ def _float0_zeros(x):
     return np.zeros(np.shape(x), jax.dtypes.float0)
 
 
-def _vjp_bwd(sm_scale, blocks, layout, res, g):
-    dq, dk, dv = _flash_bwd(sm_scale, blocks, layout, res, g)
+def _vjp_bwd(sm_scale, blocks, layout, interpret, res, g):
+    dq, dk, dv = _flash_bwd(sm_scale, blocks, layout, interpret, res, g)
     segment_ids, programs = res[5], res[6]
     dseg = None if segment_ids is None else SegmentIds(
         _float0_zeros(segment_ids.q), _float0_zeros(segment_ids.kv))
@@ -670,19 +676,51 @@ _flash_attention.defvjp(_vjp_fwd, _vjp_bwd)
 
 
 def _resolve(q, k, v, sm_scale, bq, bk, block_sizes, layout,
-             mask_sig=None):
+             mask_sig=None, backend=None):
     _, Tq, _, d = _shapes(layout, q)
     _, Tk, _, _ = _shapes(layout, k)
     scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(d)
     if block_sizes is None:
         if bq is None and bk is None:
             block_sizes = select_block_sizes(Tq, d, str(q.dtype), Tk,
-                                             mask_sig=mask_sig)
+                                             mask_sig=mask_sig,
+                                             backend=backend)
         else:
             bq = DEFAULT_BQ if bq is None else bq
             bk = DEFAULT_BK if bk is None else bk
             block_sizes = BlockSizes(bq=bq, bk=bk, bq_bwd=bq, bk_bwd=bk)
     return scale, block_sizes.clamp(Tq, Tk)
+
+
+def _flash_attention_xla(q, k, v, segment_ids, mask, sm_scale, layout):
+    """Pure-XLA lowering of the flash computation: the mask program's
+    dense materialization and the segment equality fold into one dense
+    attention-mask ``where`` (identical semantics to the kernel's
+    schedule-prunes / segments-refine composition, minus the skipped
+    work). Natively differentiable — the registry's ``xla`` flash arm
+    and the dense side of every flash parity pair."""
+    tr = (lambda x: jnp.transpose(x, (0, 2, 1, 3)))
+    qb, kb, vb = (q, k, v) if layout == "bthd" else (tr(q), tr(k), tr(v))
+    B, Tq = qb.shape[0], qb.shape[1]
+    Tk = kb.shape[1]
+    m = None
+    if mask is not None:
+        dm = jnp.asarray(mask.dense(Tq, Tk))
+        m = dm[None, None] if dm.ndim == 2 else dm[None]
+    if segment_ids is not None:
+        seg = (jnp.asarray(segment_ids.q, jnp.int32)[:, :, None]
+               == jnp.asarray(segment_ids.kv, jnp.int32)[:, None, :])
+        seg = seg[:, None]                            # [B, 1, Tq, Tk]
+        m = seg if m is None else jnp.logical_and(m, seg)
+    s = jnp.einsum("bqhd,bkhd->bhqk", qb, kb,
+                   preferred_element_type=jnp.float32)
+    s = s.astype(jnp.float32) * sm_scale
+    if m is not None:
+        s = jnp.where(m, s, _NEG_INF)
+    w = jax.nn.softmax(s, axis=-1).astype(vb.dtype)
+    out = jnp.einsum("bhqk,bkhd->bqhd", w, vb,
+                     preferred_element_type=jnp.float32).astype(q.dtype)
+    return out if layout == "bthd" else tr(out)
 
 
 def flash_attention(q, k, v, sm_scale: Optional[float] = None,
@@ -692,12 +730,14 @@ def flash_attention(q, k, v, sm_scale: Optional[float] = None,
                     segment_ids: Optional[SegmentIds] = None,
                     layout: str = "bhtd",
                     mask: Optional[Mask] = None,
-                    programs: Optional[MaskPrograms] = None):
+                    programs: Optional[MaskPrograms] = None,
+                    backend: Optional[str] = None):
     """q,k,v: [B, H, T, D] (``layout="bhtd"``, default) or [B, T, H, D]
     (``layout="bthd"``) → same layout out. With neither bq/bk nor
     ``block_sizes`` given, blocks come from the selection table /
     autotune cache (:func:`select_block_sizes`, consulting the
-    mask-signature-keyed "sparse" section for scheduled calls);
+    mask-signature-keyed "sparse" section for scheduled calls, scoped
+    to the resolved backend);
     ``block_sizes`` overrides the positional bq/bk with independent
     fwd/bwd chunks; ``segment_ids`` enables kernel-level
     padding/segment masking.
@@ -708,24 +748,49 @@ def flash_attention(q, k, v, sm_scale: Optional[float] = None,
     is sugar for ``mask=CausalMask()`` (ANDed with ``mask`` when both
     are given). Advanced callers (the sharded per-head path) may pass
     precompiled ``programs`` directly — then ``mask`` is only used for
-    block selection and may be None."""
+    block selection and may be None.
+
+    ``backend`` picks the lowering through the kernel registry
+    (:mod:`tosem_tpu.ops.registry`, family ``"flash"``):
+    ``pallas-tpu`` / ``pallas-interpret`` / ``xla``, the legacy
+    ``"pallas"`` alias, or None for the platform default."""
     if causal:
         mask = CausalMask() if mask is None else (mask & CausalMask())
     sig = mask.signature() if mask is not None else None
+    from tosem_tpu.ops import registry
+    feats = set()
+    if mask is not None or programs is not None:
+        feats.add("mask")
+    if segment_ids is not None:
+        feats.add("segments")
+    if layout == "bthd":
+        feats.add("layout_bthd")
+    entry = registry.resolve("flash", backend, dtype=str(q.dtype),
+                             features=frozenset(feats))
     scale, blocks = _resolve(q, k, v, sm_scale, bq, bk, block_sizes,
-                             layout, mask_sig=sig)
+                             layout, mask_sig=sig, backend=entry.backend)
+    if entry.backend == registry.BACKEND_XLA:
+        if mask is None and programs is not None:
+            raise ValueError(
+                "the xla flash lowering folds the MASK into a dense "
+                "where; precompiled programs without their mask cannot "
+                "retarget — pass mask= (or a pallas backend)")
+        return _flash_attention_xla(q, k, v, segment_ids, mask, scale,
+                                    layout)
     if programs is None and mask is not None:
         _, Tq, H, _ = _shapes(layout, q)
         _, Tk, _, _ = _shapes(layout, k)
         programs = compile_mask_programs(mask, Tq, Tk, blocks, heads=H)
+    interpret = entry.backend == registry.BACKEND_PALLAS_INTERPRET
     return _flash_attention(q, k, v, segment_ids, programs, scale, blocks,
-                            layout)
+                            layout, interpret)
 
 
 def mha_flash_attention(q, k, v, mask=None, *, causal: bool = False,
                         segment_ids: Optional[SegmentIds] = None,
                         block_sizes: Optional[BlockSizes] = None,
-                        mask_program: Optional[Mask] = None):
+                        mask_program: Optional[Mask] = None,
+                        backend: Optional[str] = None):
     """Flash attention in the native [B, T, H, D] layout of
     :func:`tosem_tpu.nn.attention.dot_product_attention` — the kernels
     index heads via BlockSpecs, so no transposed copy of q/k/v/o is ever
@@ -734,7 +799,7 @@ def mha_flash_attention(q, k, v, mask=None, *, causal: bool = False,
     masks automatically; arbitrary dense masks take the XLA path) and
     static sparsity as ``mask_program`` (a
     :class:`~tosem_tpu.ops.mask_programs.Mask` compiled to a block
-    schedule)."""
+    schedule). ``backend`` forwards to the registry dispatch."""
     if mask is not None:
         raise ValueError("flash path takes causal/segment/program masks "
                          "only; pass padding as segment_ids "
@@ -743,4 +808,51 @@ def mha_flash_attention(q, k, v, mask=None, *, causal: bool = False,
     return flash_attention(q, k, v, None, causal,
                            block_sizes=block_sizes,
                            segment_ids=segment_ids, layout="bthd",
-                           mask=mask_program)
+                           mask=mask_program, backend=backend)
+
+
+# ---------------------------------------------------------------------------
+# registry adapters — the uniform per-family call shape every lowering
+# exposes to the parity harness / kernel bench (ops/registry.py's
+# loader targets). Each forces its own backend through the SAME public
+# dispatch, so driving a lowering via the registry and via
+# ``flash_attention(backend=...)`` is one code path.
+# ---------------------------------------------------------------------------
+
+
+def _flash_lowering(backend, q, k, v, *, sm_scale=None, causal=False,
+                    block_sizes=None, segment_ids=None, layout="bhtd",
+                    mask=None, programs=None):
+    return flash_attention(q, k, v, sm_scale, causal,
+                           block_sizes=block_sizes,
+                           segment_ids=segment_ids, layout=layout,
+                           mask=mask, programs=programs, backend=backend)
+
+
+flash_lowering_pallas_tpu = functools.partial(
+    _flash_lowering, "pallas-tpu")
+flash_lowering_pallas_interpret = functools.partial(
+    _flash_lowering, "pallas-interpret")
+flash_lowering_xla = functools.partial(_flash_lowering, "xla")
+
+
+def _schedule_lowering(backend, q, k, v, *, mask, sm_scale=None,
+                       block_sizes=None, segment_ids=None,
+                       layout="bhtd"):
+    """Schedule-family lowering on the Pallas kernels: the mask compiles
+    to a block schedule and drives the stream grid (the ``xla`` sibling
+    executes the SAME schedule with gathers —
+    :func:`tosem_tpu.ops.mask_programs.schedule_lowering_xla`)."""
+    if mask is None:
+        raise ValueError("the schedule family lowers a Mask; use the "
+                         "flash family for dense/segment-only calls")
+    return flash_attention(q, k, v, sm_scale, False,
+                           block_sizes=block_sizes,
+                           segment_ids=segment_ids, layout=layout,
+                           mask=mask, backend=backend)
+
+
+schedule_lowering_pallas_tpu = functools.partial(
+    _schedule_lowering, "pallas-tpu")
+schedule_lowering_pallas_interpret = functools.partial(
+    _schedule_lowering, "pallas-interpret")

@@ -15,6 +15,12 @@ style:
   the device-loop harness and caches winners to
   ``results/flash_blocks.json`` — the table answers instantly, the
   cache (when present) wins over the table.
+
+Every cache section (blocks / pages / sparse / decode) is keyed
+``{platform}/{backend}/{shape key}`` — see :class:`_CacheStore` — so a
+winner is only ever consulted on the (platform, kernel backend) that
+measured it: a CPU-smoke winner can never be selected on TPU, and an
+XLA-lowering winner never drives the Pallas kernel's chunking.
 """
 from __future__ import annotations
 
@@ -152,71 +158,138 @@ def _align_to_seq(blocks: BlockSizes, Tq: int, Tk: int) -> BlockSizes:
                       fit(blocks.bq_bwd, Tq), fit(blocks.bk_bwd, Tk))
 
 
-_cache: Optional[Dict[str, List[int]]] = None
-_pages_cache: Optional[Dict[str, int]] = None
-_sparse_cache: Optional[Dict[str, List[int]]] = None
-_decode_cache: Optional[Dict[str, int]] = None
-_cache_path_loaded: Optional[str] = None
+# ---------------------------------------------------------------------------
+# the autotune cache: ONE keyed store for every section.
+#
+# The four sections (blocks / pages / sparse / decode) used to carry
+# their load/merge/corrupt-tolerance plumbing three separate ways; they
+# now share one store with two value validators. Every entry is keyed
+# ``{platform}/{backend}/{shape key}`` — autotune winners are only ever
+# consulted on the (platform, backend) that measured them, so a
+# CPU-smoke winner can NEVER be selected on TPU (and vice versa).
+# Legacy flat keys (no scope prefix) are dropped at load with the same
+# corrupt-tolerance discipline: an unscoped winner's platform is
+# unknowable, which is exactly the bug this layout removes.
+
+def _valid_blocks_value(v) -> bool:
+    """A blocks-shaped value: list of 4 positive ints."""
+    return (isinstance(v, list) and len(v) == 4
+            and all(isinstance(x, int) and x > 0 for x in v))
 
 
-def _load_raw(path: str) -> dict:
-    try:
-        with open(path) as f:
-            return json.load(f)
-    except (OSError, ValueError):
-        return {}
+def _valid_scalar_value(v) -> bool:
+    """A scalar-valued entry ("pages"/"decode" sections)."""
+    return isinstance(v, (int, float)) and int(v) > 0
 
 
-def _valid_blocks(section) -> Dict[str, List[int]]:
-    """Filter a blocks-shaped cache section (list-of-4 values),
-    tolerating a missing/corrupt section: a bad "sparse" entry must
-    degrade to the dense selection path, never crash the kernel."""
-    if not isinstance(section, dict):
-        return {}
-    return {k: v for k, v in section.items()
-            if isinstance(v, list) and len(v) == 4
-            and all(isinstance(x, int) and x > 0 for x in v)}
+# section -> (value validator, coercer, kernel family whose default
+# backend scopes unqualified reads/writes)
+_SECTIONS = {
+    "blocks": (_valid_blocks_value, list, "flash"),
+    "pages": (_valid_scalar_value, int, "paged"),
+    "sparse": (_valid_blocks_value, list, "flash"),
+    "decode": (_valid_scalar_value, int, "paged"),
+}
 
 
-def _valid_scalars(section) -> Dict[str, int]:
-    """Filter a scalar-valued cache section ("pages"/"decode"),
-    tolerating a missing/corrupt section — a bad entry degrades to the
-    table/default path, never crashes selection."""
-    if not isinstance(section, dict):
-        return {}
-    return {k: int(v) for k, v in section.items()
-            if isinstance(v, (int, float)) and int(v) > 0}
+def _check_section(section: str) -> None:
+    if section not in _SECTIONS:
+        raise ValueError(f"unknown cache section {section!r}; expected "
+                         f"one of {tuple(_SECTIONS)}")
 
 
-def _load_cache(path: str) -> Dict[str, List[int]]:
-    global _cache, _pages_cache, _sparse_cache, _decode_cache
-    global _cache_path_loaded
-    if _cache is not None and _cache_path_loaded == path:
-        return _cache
-    raw = _load_raw(path)
-    data = _valid_blocks(raw.get("blocks", {}))
-    pages = _valid_scalars(raw.get("pages", {}))
-    sparse = _valid_blocks(raw.get("sparse", {}))
-    decode = _valid_scalars(raw.get("decode", {}))
-    _cache, _pages_cache, _sparse_cache = data, pages, sparse
-    _decode_cache = decode
-    _cache_path_loaded = path
-    return data
+def cache_scope(section: str, platform: Optional[str] = None,
+                backend: Optional[str] = None) -> str:
+    """``"{platform}/{backend}"`` prefix for a section's cache keys.
+
+    Defaults: the current jax platform, and the backend an unqualified
+    call of the section's kernel family resolves to there (the registry
+    preference order) — so a sweep and the selector that consumes it
+    agree on scope without either naming it."""
+    _check_section(section)
+    if platform is None or backend is None:
+        from tosem_tpu.ops import registry
+        platform = platform or registry.current_platform()
+        if backend is None:
+            backend = registry.default_backend(_SECTIONS[section][2],
+                                               platform)
+        else:
+            backend = registry.canonical_backend(backend, platform)
+    return f"{platform}/{backend}"
 
 
-def _load_pages(path: str) -> Dict[str, int]:
-    _load_cache(path)
-    return _pages_cache or {}
+def scoped_key(section: str, key: str,
+               platform: Optional[str] = None,
+               backend: Optional[str] = None) -> str:
+    return f"{cache_scope(section, platform, backend)}/{key}"
 
 
-def _load_decode(path: str) -> Dict[str, int]:
-    _load_cache(path)
-    return _decode_cache or {}
+class _CacheStore:
+    """In-process view of the JSON cache file: every section loaded and
+    validated once per path, invalidated by :func:`reset_cache` or a
+    :func:`save_cache` write. Corrupt files, corrupt sections, and
+    corrupt entries all degrade identically — to the table/default
+    selection path — for every section."""
+
+    def __init__(self) -> None:
+        self.path: Optional[str] = None
+        self.sections: Dict[str, dict] = {}
+
+    def _validate(self, raw: dict) -> Dict[str, dict]:
+        out: Dict[str, dict] = {}
+        for name, (valid, coerce, _) in _SECTIONS.items():
+            section = raw.get(name, {})
+            if not isinstance(section, dict):
+                out[name] = {}
+                continue
+            out[name] = {k: coerce(v) for k, v in section.items()
+                         if isinstance(k, str) and k.count("/") >= 2
+                         and valid(v)}
+        return out
+
+    def load(self, path: str) -> Dict[str, dict]:
+        if self.path != path or not self.sections:
+            try:
+                with open(path) as f:
+                    raw = json.load(f)
+                if not isinstance(raw, dict):
+                    raw = {}
+            except (OSError, ValueError):
+                raw = {}
+            self.sections = self._validate(raw)
+            self.path = path
+        return self.sections
+
+    def get(self, path: str, section: str, key: str,
+            platform: Optional[str], backend: Optional[str]):
+        _check_section(section)
+        return self.load(path)[section].get(
+            scoped_key(section, key, platform, backend))
+
+    def save(self, winners: dict, path: str, section: str,
+             platform: Optional[str], backend: Optional[str]) -> None:
+        _check_section(section)
+        scope = cache_scope(section, platform, backend)
+        sections = {n: dict(s) for n, s in self.load(path).items()}
+        sections[section].update(
+            {f"{scope}/{k}": v for k, v in winners.items()})
+        payload = {n: s for n, s in sections.items() if s or n == "blocks"}
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+        self.sections = sections
+        self.path = path
+
+    def reset(self) -> None:
+        self.path = None
+        self.sections = {}
 
 
-def _load_sparse(path: str) -> Dict[str, List[int]]:
-    _load_cache(path)
-    return _sparse_cache or {}
+_STORE = _CacheStore()
 
 
 def _cache_key(T: int, d: int, dtype: str) -> str:
@@ -230,27 +303,34 @@ def _sparse_key(T: int, d: int, dtype: str, mask_sig: str) -> str:
 def select_block_sizes(Tq: int, d: int, dtype: str, Tk: Optional[int] = None,
                        *, vmem_budget: int = DEFAULT_VMEM_BUDGET,
                        cache_path: Optional[str] = DEFAULT_CACHE_PATH,
-                       mask_sig: Optional[str] = None) -> BlockSizes:
+                       mask_sig: Optional[str] = None,
+                       platform: Optional[str] = None,
+                       backend: Optional[str] = None) -> BlockSizes:
     """Pick block sizes for a (T, d, dtype) shape.
 
     Priority: sparse autotune cache (``mask_sig`` given — per-schedule
     winners keyed (T, d, dtype, mask signature)) → dense autotune cache
-    (measured on-chip) → static table → default; then clamp to the
-    sequence lengths, align to divisibility, and apply the VMEM-budget
-    fallback. ``dtype`` is the operand dtype name
-    ("bfloat16"/"float32"). ``last_source`` reports "sparse" distinctly
-    from "cache" so sparse-cache hits are auditable."""
+    → static table → default; then clamp to the sequence lengths, align
+    to divisibility, and apply the VMEM-budget fallback. Cache lookups
+    are scoped ``{platform}/{backend}`` (defaults: this process's
+    platform and its default flash lowering), so winners measured on one
+    platform or lowering are never selected on another. ``dtype`` is the
+    operand dtype name ("bfloat16"/"float32"). ``last_source`` reports
+    "sparse" distinctly from "cache" so sparse-cache hits are
+    auditable."""
     Tk = Tq if Tk is None else Tk
     dtype = str(dtype)
     picked: Optional[BlockSizes] = None
     src = "default"
     if cache_path and mask_sig:
-        hit = _load_sparse(cache_path).get(
-            _sparse_key(Tk, d, dtype, mask_sig))
+        hit = _STORE.get(cache_path, "sparse",
+                         _sparse_key(Tk, d, dtype, mask_sig),
+                         platform, backend)
         if hit:
             picked, src = BlockSizes(*hit), "sparse"
     if picked is None and cache_path:
-        hit = _load_cache(cache_path).get(_cache_key(Tk, d, dtype))
+        hit = _STORE.get(cache_path, "blocks", _cache_key(Tk, d, dtype),
+                         platform, backend)
         if hit:
             picked, src = BlockSizes(*hit), "cache"
     if picked is None:
@@ -299,23 +379,39 @@ def _budget_candidates(T: int, d: int, itemsize: int) -> List[Tuple[int, int]]:
     return out
 
 
+def _sweep_scope(family: str, backend: Optional[str]) -> Tuple[str, str]:
+    """(platform, canonical backend) a sweep measures under — recorded
+    in every sweep record and used as the cache-write scope, so the
+    cache always says WHICH lowering a winner belongs to."""
+    from tosem_tpu.ops import registry
+    platform = registry.current_platform()
+    if backend is None:
+        backend = registry.default_backend(family, platform)
+    else:
+        backend = registry.canonical_backend(backend, platform)
+    return platform, backend
+
+
 def autotune(shapes: Iterable[Tuple[int, int, int, int, str]],
              *, reps: int = 3, cache_path: str = DEFAULT_CACHE_PATH,
-             include_bwd: bool = False) -> List[dict]:
+             include_bwd: bool = False,
+             backend: Optional[str] = None) -> List[dict]:
     """Measure candidate block sizes on the current device and cache the
     winners.
 
     ``shapes``: iterables of (B, H, T, d, dtype). Returns one record per
-    measured candidate (``{"shape", "blocks", "time_us", "best"}``) so
-    callers can emit sweep rows; winners are written to ``cache_path``
-    (merged over any existing entries) for ``select_block_sizes`` to
-    pick up."""
+    measured candidate (``{"shape", "blocks", "time_us", "best",
+    "backend", "platform"}``) so callers can emit sweep rows; winners
+    are written to ``cache_path`` under the measured
+    ``{platform}/{backend}`` scope (merged over any existing entries)
+    for ``select_block_sizes`` to pick up on the same scope only."""
     import jax
     import jax.numpy as jnp
 
     from tosem_tpu.ops.flash_attention import flash_attention
     from tosem_tpu.utils.timing import DeviceLoopBench
 
+    platform, backend = _sweep_scope("flash", backend)
     records: List[dict] = []
     winners: Dict[str, List[int]] = {}
     for B, H, T, d, dtype in shapes:
@@ -329,11 +425,13 @@ def autotune(shapes: Iterable[Tuple[int, int, int, int, str]],
         timed = []
         for bq, bk in cands:
             fwd = jax.jit(lambda a, b, c, bq=bq, bk=bk:
-                          flash_attention(a, b, c, None, False, bq, bk))
+                          flash_attention(a, b, c, None, False, bq, bk,
+                                          backend=backend))
             if include_bwd:
                 fn = jax.jit(jax.grad(
                     lambda a, b, c, bq=bq, bk=bk: jnp.sum(
-                        flash_attention(a, b, c, None, False, bq, bk)
+                        flash_attention(a, b, c, None, False, bq, bk,
+                                        backend=backend)
                         .astype(jnp.float32) ** 2)))
                 op = lambda a, b, c, fn=fn: jnp.stack(
                     [jnp.mean(fn(a, b, c).astype(jnp.float32))])
@@ -348,19 +446,22 @@ def autotune(shapes: Iterable[Tuple[int, int, int, int, str]],
             records.append({"shape": [B, H, T, d, dtype],
                             "blocks": [bq, bk, bq, bk],
                             "time_us": sec * 1e6,
+                            "backend": backend, "platform": platform,
                             "best": (bq, bk) == best[0]})
         if best is not None:
             bq, bk = best[0]
             winners[_cache_key(T, d, str(dtype))] = [bq, bk, bq, bk]
     if winners:
-        save_cache(winners, cache_path)
+        save_cache(winners, cache_path, platform=platform,
+                   backend=backend)
     return records
 
 
 def autotune_sparse(shapes: Iterable[Tuple[int, int, int, int, str]],
                     mask_specs: Iterable[str] = ("local:1024",),
                     *, reps: int = 3, include_bwd: bool = False,
-                    cache_path: str = DEFAULT_CACHE_PATH) -> List[dict]:
+                    cache_path: str = DEFAULT_CACHE_PATH,
+                    backend: Optional[str] = None) -> List[dict]:
     """Measure candidate block sizes under block-sparse mask schedules
     and cache the winners in the ``"sparse"`` section.
 
@@ -381,6 +482,7 @@ def autotune_sparse(shapes: Iterable[Tuple[int, int, int, int, str]],
                                              mask_from_spec)
     from tosem_tpu.utils.timing import DeviceLoopBench
 
+    platform, backend = _sweep_scope("flash", backend)
     records: List[dict] = []
     winners: Dict[str, List[int]] = {}
     for B, H, T, d, dtype in shapes:
@@ -401,14 +503,16 @@ def autotune_sparse(shapes: Iterable[Tuple[int, int, int, int, str]],
                     fn = jax.jit(jax.grad(
                         lambda a, b, c, m=mask, bl=blocks: jnp.sum(
                             flash_attention(a, b, c, mask=m,
-                                            block_sizes=bl)
+                                            block_sizes=bl,
+                                            backend=backend)
                             .astype(jnp.float32) ** 2)))
                     op = lambda a, b, c, fn=fn: jnp.stack(
                         [jnp.mean(fn(a, b, c).astype(jnp.float32))])
                 else:
                     op = jax.jit(lambda a, b, c, m=mask, bl=blocks:
                                  flash_attention(a, b, c, mask=m,
-                                                 block_sizes=bl))
+                                                 block_sizes=bl,
+                                                 backend=backend))
                 sec = DeviceLoopBench(op=op, args=(q, k, v),
                                       perturb=0).time(reps=reps)
                 timed.append(((bq, bk), sec, frac))
@@ -420,61 +524,38 @@ def autotune_sparse(shapes: Iterable[Tuple[int, int, int, int, str]],
                                 "blocks": [bq, bk, bq, bk],
                                 "time_us": sec * 1e6,
                                 "executed_block_fraction": frac,
+                                "backend": backend,
+                                "platform": platform,
                                 "best": (bq, bk) == best[0]})
             if best is not None:
                 bq, bk = best[0]
                 winners[_sparse_key(T, d, str(dtype), sig)] = \
                     [bq, bk, bq, bk]
     if winners:
-        save_cache(winners, cache_path, section="sparse")
+        save_cache(winners, cache_path, section="sparse",
+                   platform=platform, backend=backend)
     return records
 
 
-def save_cache(winners: Dict[str, List[int]],
+def save_cache(winners: Dict[str, object],
                cache_path: str = DEFAULT_CACHE_PATH, *,
-               section: str = "blocks") -> None:
+               section: str = "blocks",
+               platform: Optional[str] = None,
+               backend: Optional[str] = None) -> None:
     """Merge winners into the JSON cache (atomic write). ``section`` is
     ``"blocks"`` (flash chunk sizes, list-of-4 values), ``"pages"``
     (decode page sizes, scalar values), ``"sparse"`` (per-mask-
     signature chunk sizes, list-of-4 values), or ``"decode"``
     (multi-token decode q-block rows, scalar values); the other
-    sections are preserved."""
-    global _cache, _pages_cache, _sparse_cache, _decode_cache
-    global _cache_path_loaded
-    if section not in ("blocks", "pages", "sparse", "decode"):
-        raise ValueError(f"unknown cache section {section!r}")
-    blocks = dict(_load_cache(cache_path))
-    pages = dict(_pages_cache or {})
-    sparse = dict(_sparse_cache or {})
-    decode = dict(_decode_cache or {})
-    {"blocks": blocks, "pages": pages, "sparse": sparse,
-     "decode": decode}[section].update(winners)
-    payload: dict = {"blocks": blocks}
-    if pages:
-        payload["pages"] = pages
-    if sparse:
-        payload["sparse"] = sparse
-    if decode:
-        payload["decode"] = decode
-    d = os.path.dirname(cache_path)
-    if d:
-        os.makedirs(d, exist_ok=True)
-    tmp = cache_path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(payload, f, indent=1, sort_keys=True)
-    os.replace(tmp, cache_path)
-    _cache, _pages_cache, _sparse_cache = blocks, pages, sparse
-    _decode_cache = decode
-    _cache_path_loaded = cache_path
+    sections are preserved. Winner keys are plain shape keys — they are
+    written under the ``{platform}/{backend}`` scope (defaults: this
+    process's), so a sweep records exactly where it measured."""
+    _STORE.save(winners, cache_path, section, platform, backend)
 
 
 def reset_cache() -> None:
     """Drop the in-process cache view (tests; after external writes)."""
-    global _cache, _pages_cache, _sparse_cache, _decode_cache
-    global _cache_path_loaded
-    _cache, _pages_cache, _sparse_cache = None, None, None
-    _decode_cache = None
-    _cache_path_loaded = None
+    _STORE.reset()
 
 
 # ---------------------------------------------------------------------------
@@ -502,19 +583,23 @@ def _page_key(d: int, dtype: str) -> str:
 
 
 def select_page_size(d: int, dtype: str, *, max_len: Optional[int] = None,
-                     cache_path: Optional[str] = DEFAULT_CACHE_PATH) -> int:
+                     cache_path: Optional[str] = DEFAULT_CACHE_PATH,
+                     platform: Optional[str] = None,
+                     backend: Optional[str] = None) -> int:
     """Pick the KV page size for a (d, dtype) decode config.
 
-    Priority mirrors :func:`select_block_sizes`: autotune cache →
-    static table → default; then clamp to ``max_len`` (a cache that can
-    only ever hold short sequences gains nothing from big pages),
-    flooring at 8 sublanes. Sets ``select_page_size.last_source``.
+    Priority mirrors :func:`select_block_sizes`: autotune cache (scoped
+    ``{platform}/{backend}`` like every section) → static table →
+    default; then clamp to ``max_len`` (a cache that can only ever hold
+    short sequences gains nothing from big pages), flooring at 8
+    sublanes. Sets ``select_page_size.last_source``.
     """
     dtype = str(dtype)
     picked: Optional[int] = None
     src = "default"
     if cache_path:
-        hit = _load_pages(cache_path).get(_page_key(d, dtype))
+        hit = _STORE.get(cache_path, "pages", _page_key(d, dtype),
+                         platform, backend)
         if hit:
             picked, src = int(hit), "cache"
     if picked is None:
@@ -535,7 +620,8 @@ select_page_size.last_source = "default"
 
 def autotune_decode_pages(shapes: Iterable[Tuple[int, int, int, int, str]],
                           *, reps: int = 3,
-                          cache_path: str = DEFAULT_CACHE_PATH
+                          cache_path: str = DEFAULT_CACHE_PATH,
+                          backend: Optional[str] = None
                           ) -> List[dict]:
     """Measure candidate page sizes for the paged decode kernel on the
     current device and cache the winners (the decode rows of the
@@ -544,16 +630,20 @@ def autotune_decode_pages(shapes: Iterable[Tuple[int, int, int, int, str]],
     ``shapes``: iterables of (B, H, T, d, dtype) where T is the cached
     context length per sequence. Returns one record per measured
     candidate; winners land in the ``"pages"`` section of
-    ``cache_path`` for :func:`select_page_size` to pick up. Winners are
-    keyed (d, dtype) — the same key the selector reads — so when
-    several shapes share one, the FIRST shape's winner sticks: order
-    your sweep north-star shape first."""
+    ``cache_path`` — under the measured ``{platform}/{backend}`` scope
+    — for :func:`select_page_size` to pick up. The default backend is
+    the platform's default paged lowering (the one serving actually
+    runs there), so a CPU smoke sweeps the XLA gather, not interpret
+    noise. Winners are keyed (d, dtype) — the same key the selector
+    reads — so when several shapes share one, the FIRST shape's winner
+    sticks: order your sweep north-star shape first."""
     import jax
     import jax.numpy as jnp
 
     from tosem_tpu.ops.paged_attention import paged_attention
     from tosem_tpu.utils.timing import DeviceLoopBench
 
+    platform, backend = _sweep_scope("paged", backend)
     records: List[dict] = []
     winners: Dict[str, int] = {}
     for B, H, T, d, dtype in shapes:
@@ -574,7 +664,8 @@ def autotune_decode_pages(shapes: Iterable[Tuple[int, int, int, int, str]],
             bt = jnp.arange(P, dtype=jnp.int32).reshape(B, n_pages)
             sl = jnp.full((B,), T, jnp.int32)
             op = jax.jit(lambda q, k, v, bt=bt, sl=sl:
-                         paged_attention(q, k, v, bt, sl, impl="pallas"))
+                         paged_attention(q, k, v, bt, sl,
+                                         backend=backend))
             sec = DeviceLoopBench(op=op, args=(q, kp, vp),
                                   perturb=0).time(reps=reps)
             timed.append((page, sec))
@@ -583,11 +674,13 @@ def autotune_decode_pages(shapes: Iterable[Tuple[int, int, int, int, str]],
         for page, sec in timed:
             records.append({"shape": [B, H, T, d, dtype], "page": page,
                             "time_us": sec * 1e6,
+                            "backend": backend, "platform": platform,
                             "best": page == best[0]})
         if best is not None:
             winners.setdefault(_page_key(d, str(dtype)), best[0])
     if winners:
-        save_cache(winners, cache_path, section="pages")
+        save_cache(winners, cache_path, section="pages",
+                   platform=platform, backend=backend)
     return records
 
 
@@ -616,17 +709,21 @@ def _spec_q_key(d: int, dtype: str) -> str:
 
 
 def select_spec_q(d: int, dtype: str, *,
-                  cache_path: Optional[str] = DEFAULT_CACHE_PATH) -> int:
+                  cache_path: Optional[str] = DEFAULT_CACHE_PATH,
+                  platform: Optional[str] = None,
+                  backend: Optional[str] = None) -> int:
     """Pick the draft block (q rows per speculative step) for a
     (d, dtype) decode config. Priority mirrors the other selectors:
-    autotune cache ("decode" section) → static table → default; result
-    clamped to the 8 sublane rows. Sets ``select_spec_q.last_source``.
+    autotune cache ("decode" section, scoped like every section) →
+    static table → default; result clamped to the 8 sublane rows. Sets
+    ``select_spec_q.last_source``.
     """
     dtype = str(dtype)
     picked: Optional[int] = None
     src = "default"
     if cache_path:
-        hit = _load_decode(cache_path).get(_spec_q_key(d, dtype))
+        hit = _STORE.get(cache_path, "decode", _spec_q_key(d, dtype),
+                         platform, backend)
         if hit:
             picked, src = int(hit), "cache"
     if picked is None:
@@ -644,7 +741,8 @@ select_spec_q.last_source = "default"
 
 def autotune_spec_q(shapes: Iterable[Tuple[int, int, int, int, str]],
                     *, reps: int = 3, ks: Tuple[int, ...] = _SPEC_Q_CANDIDATES,
-                    cache_path: str = DEFAULT_CACHE_PATH) -> List[dict]:
+                    cache_path: str = DEFAULT_CACHE_PATH,
+                    backend: Optional[str] = None) -> List[dict]:
     """Measure candidate multi-token q-blocks for the decode kernel and
     cache the winners in the ``"decode"`` section.
 
@@ -659,12 +757,14 @@ def autotune_spec_q(shapes: Iterable[Tuple[int, int, int, int, str]],
     from tosem_tpu.ops.paged_attention import paged_attention
     from tosem_tpu.utils.timing import DeviceLoopBench
 
+    platform, backend = _sweep_scope("paged", backend)
     records: List[dict] = []
     winners: Dict[str, int] = {}
     for B, H, T, d, dtype in shapes:
         dt = jnp.dtype(dtype)
         page = select_page_size(d, str(dtype), max_len=T,
-                                cache_path=cache_path)
+                                cache_path=cache_path,
+                                platform=platform, backend=backend)
         page = min(page, T)
         while T % page:
             page //= 2
@@ -686,7 +786,7 @@ def autotune_spec_q(shapes: Iterable[Tuple[int, int, int, int, str]],
                                   jnp.float32).astype(dt)
             op = jax.jit(lambda q, kp, vp, bt=bt, sl=sl:
                          paged_attention(q, kp, vp, bt, sl,
-                                         impl="pallas"))
+                                         backend=backend))
             sec = DeviceLoopBench(op=op, args=(q, kp, vp),
                                   perturb=0).time(reps=reps)
             timed.append((k, sec))
@@ -696,9 +796,11 @@ def autotune_spec_q(shapes: Iterable[Tuple[int, int, int, int, str]],
             records.append({"shape": [B, H, T, d, dtype], "k": k,
                             "time_us": sec * 1e6,
                             "per_token_us": sec * 1e6 / k,
+                            "backend": backend, "platform": platform,
                             "best": k == best[0]})
         if best is not None:
             winners.setdefault(_spec_q_key(d, str(dtype)), best[0])
     if winners:
-        save_cache(winners, cache_path, section="decode")
+        save_cache(winners, cache_path, section="decode",
+                   platform=platform, backend=backend)
     return records

@@ -471,24 +471,29 @@ def reset_program_cache() -> None:
 
 def schedule_attention_xla(q, k, v, schedule: BlockSchedule, *,
                            sm_scale: Optional[float] = None,
-                           layout: str = "bhtd"):
+                           layout: str = "bhtd", segment_ids=None):
     """Execute a q-major :class:`BlockSchedule` with plain XLA ops:
     gather exactly the scheduled K/V blocks, mask partial cells with
     their bitmaps, softmax over the gathered axis.
 
-    The same computation the Pallas kernels run, lowered per the PR-6
-    ``impl="xla"`` pattern — it pays FLOPs only for scheduled blocks,
-    so the sparse A/B bench measures the real executed-blocks effect on
-    hosts where Pallas only interprets; and it is the parity oracle the
-    kernel tests pin against at sizes where a dense [Tq, Tk] reference
-    would not fit."""
+    The same computation the Pallas kernels run, lowered per the
+    registry's ``backend="xla"`` schedule arm — it pays FLOPs only for
+    scheduled blocks, so the sparse A/B bench measures the real
+    executed-blocks effect on hosts where Pallas only interprets; and
+    it is the parity oracle the kernel tests pin against at sizes where
+    a dense [Tq, Tk] reference would not fit. ``segment_ids``
+    (:class:`~tosem_tpu.ops.flash_attention.SegmentIds`-shaped, [B, Tq]
+    / [B, Tk] int32) compose exactly like the kernels: the schedule
+    prunes statically, the segment equality refines the gathered
+    scores."""
     import jax
     import jax.numpy as jnp
 
     if layout == "bthd":
         tr = lambda x: x.transpose(0, 2, 1, 3)
         return tr(schedule_attention_xla(tr(q), tr(k), tr(v), schedule,
-                                         sm_scale=sm_scale))
+                                         sm_scale=sm_scale,
+                                         segment_ids=segment_ids))
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
     num, blk, kind, mid, mask_blocks = (jnp.asarray(a) for a in schedule)
@@ -521,6 +526,15 @@ def schedule_attention_xla(q, k, v, schedule: BlockSchedule, *,
     keep = keep & active[..., None, None]
     # keep: [H, n_q, L, bq, bk] → align with s's [B, H, n_q, bq, L, bk]
     s = jnp.where(keep.transpose(0, 1, 3, 2, 4)[None], s, _NEG_INF)
+    if segment_ids is not None:
+        qseg = jnp.asarray(segment_ids.q, jnp.int32) \
+            .reshape(B, n_major, bq)
+        kvb = jnp.asarray(segment_ids.kv, jnp.int32) \
+            .reshape(B, Tk // bk, bk)
+        gseg = kvb[:, blk_h]                  # [B, H, n_q, L, bk]
+        segkeep = (qseg[:, None, :, :, None, None]
+                   == gseg[:, :, :, None, :, :])
+        s = jnp.where(segkeep, s, _NEG_INF)
     flat = s.reshape(B, H, n_major, bq, L * bk)
     m = jnp.max(flat, -1, keepdims=True)
     p = jnp.exp(flat - m)
@@ -530,3 +544,39 @@ def schedule_attention_xla(q, k, v, schedule: BlockSchedule, *,
     out = jnp.einsum("bhtqlk,bhtlkd->bhtqd", p, gv,
                      preferred_element_type=jnp.float32)
     return out.reshape(B, H, Tq, D).astype(q.dtype)
+
+
+def schedule_lowering_xla(q, k, v, *, mask: Mask,
+                          sm_scale: Optional[float] = None,
+                          block_sizes=None, segment_ids=None,
+                          layout: str = "bhtd"):
+    """Registry adapter (family ``"schedule"``, backend ``xla``): the
+    uniform mask-in call shape of the schedule family — compiles the
+    mask to a q-major program and runs :func:`schedule_attention_xla`
+    on it. Parity pairs MUST pass explicit ``block_sizes`` so both
+    arms execute the identical schedule (the harness does); without
+    it, selection reads the cache scope of the platform's DEFAULT
+    schedule lowering — the arm this one is most often paired against
+    — not the ``xla`` scope, so the default-vs-xla pair still shares
+    one schedule by construction."""
+    from tosem_tpu.ops import registry
+    from tosem_tpu.ops.flash_blocks import select_block_sizes
+
+    if mask is None:
+        raise ValueError("the schedule family lowers a Mask")
+    if layout == "bhtd":
+        B, H, Tq, D = q.shape
+        Tk = k.shape[2]
+    elif layout == "bthd":
+        B, Tq, H, D = q.shape
+        Tk = k.shape[1]
+    else:
+        raise ValueError(f"unknown layout {layout!r}")
+    blocks = block_sizes or select_block_sizes(
+        Tq, D, str(q.dtype), Tk, mask_sig=mask.signature(),
+        backend=registry.default_backend("schedule"))
+    blocks = blocks.clamp(Tq, Tk)
+    programs = compile_mask_programs(mask, Tq, Tk, blocks, heads=H)
+    return schedule_attention_xla(q, k, v, programs.fwd,
+                                  sm_scale=sm_scale, layout=layout,
+                                  segment_ids=segment_ids)

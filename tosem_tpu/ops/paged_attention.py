@@ -36,12 +36,15 @@ the copy of a revisited block).
 
 Off-TPU the kernel runs in interpret mode (tier-1's CPU mesh). Because
 interpret mode unrolls the grid at trace time — expensive for the large
-(B·H·pages) decode grids the serve bench runs — :func:`paged_attention`
-also carries a pure-XLA lowering of the same computation
-(``impl="xla"``, a gather + masked softmax); ``impl=None`` picks Pallas
-on TPU and XLA elsewhere, the 2304.12576 one-kernel-many-lowerings
-argument applied to decode. Parity tests pin all three paths
-(pallas-interpret, xla, dense reference) against each other.
+(B·H·pages) decode grids the serve bench runs — the family also
+carries a pure-XLA lowering of the same computation (a gather + masked
+softmax). Both register with the kernel registry
+(:mod:`tosem_tpu.ops.registry`, family ``"paged"``): ``backend=``
+picks a lowering explicitly (``impl=`` is the legacy PR-6 alias), None
+resolves to Mosaic on TPU and the XLA gather elsewhere — the
+2304.12576 one-kernel-many-lowerings argument applied to decode. The
+cross-backend parity harness (:mod:`tosem_tpu.ops.parity`) pins every
+registered lowering pair against each other and the dense reference.
 
 Three composable decode fast-path modes extend the base kernel (each
 with the same dual lowering and parity discipline):
@@ -140,7 +143,7 @@ def _decode_kernel(bt_ref, sl_ref, q_ref, k_ref, v_ref, o_ref,
 
 
 def _paged_attention_pallas(q, k_pages, v_pages, block_tables, seq_lens,
-                            sm_scale):
+                            sm_scale, interpret=None):
     B, H, D = q.shape
     P, page_size, Hk, Dk = k_pages.shape
     n_pages = block_tables.shape[1]
@@ -177,7 +180,7 @@ def _paged_attention_pallas(q, k_pages, v_pages, block_tables, seq_lens,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, H, _SUBLANES, D), q.dtype),
         compiler_params=_PAGED,
-        interpret=_interpret(),
+        interpret=_interpret() if interpret is None else interpret,
     )(bt, sl, qb, k_pages, v_pages)
     return out[:, :, 0, :]
 
@@ -283,7 +286,7 @@ def _decode_multi_kernel(bt_ref, sl_ref, kr_ref, po_ref, q_ref, k_ref,
 
 def _paged_attention_pallas_multi(q, k_pages, v_pages, block_tables,
                                   seq_lens, q_rows, page_offsets,
-                                  sm_scale, window):
+                                  sm_scale, window, interpret=None):
     B, K, H, D = q.shape
     page_size = k_pages.shape[1]
     n_pages = block_tables.shape[1]
@@ -333,7 +336,7 @@ def _paged_attention_pallas_multi(q, k_pages, v_pages, block_tables,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, H, _SUBLANES, D), q.dtype),
         compiler_params=_PAGED,
-        interpret=_interpret(),
+        interpret=_interpret() if interpret is None else interpret,
     )(bt, sl, kr, po, qb, k_pages, v_pages)
     return jnp.transpose(out[:, :, :K], (0, 2, 1, 3))  # [B, K, H, D]
 
@@ -377,6 +380,7 @@ def _paged_attention_xla_multi(q, k_pages, v_pages, block_tables,
 def paged_attention(q, k_pages, v_pages, block_tables, seq_lens, *,
                     sm_scale: Optional[float] = None,
                     impl: Optional[str] = None,
+                    backend: Optional[str] = None,
                     q_rows=None, window: Optional[int] = None,
                     page_offsets=None):
     """Decode attention over a paged KV cache.
@@ -393,9 +397,14 @@ def paged_attention(q, k_pages, v_pages, block_tables, seq_lens, *,
     ``window`` most recent keys (itself included), and out-of-window
     pages are skipped, not just masked. ``page_offsets``: [B] int32 —
     block-table slot j holds logical page ``page_offsets[b] + j`` (the
-    rolling-table contract for window-evicted sequences). ``impl``:
-    ``"pallas"`` (TPU kernel; interpret mode off-chip), ``"xla"`` (the
-    gather lowering), or None to pick pallas on TPU and xla elsewhere.
+    rolling-table contract for window-evicted sequences).
+
+    ``backend`` picks the lowering through the kernel registry
+    (:mod:`tosem_tpu.ops.registry`, family ``"paged"``): ``pallas-tpu``
+    / ``pallas-interpret`` / ``xla``, or None for the platform default
+    (Mosaic on TPU, the XLA gather elsewhere). ``impl`` is the legacy
+    PR-6 alias (``"pallas"``/``"xla"``), accepted wherever ``backend``
+    is.
     """
     multi = q.ndim == 4
     if multi:
@@ -417,34 +426,60 @@ def paged_attention(q, k_pages, v_pages, block_tables, seq_lens, *,
     if window is not None and window < 1:
         raise ValueError(f"window must be >= 1, got {window}")
     scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(D)
-    if impl is None:
-        impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+    from tosem_tpu.ops import registry
+    feats = set()
+    if multi:
+        feats.add("multi_query")
+    if window is not None:
+        feats.add("window")
+    if page_offsets is not None:
+        feats.add("page_offsets")
+    entry = registry.resolve("paged", backend if backend is not None
+                             else impl, dtype=str(q.dtype),
+                             features=frozenset(feats))
+    name = entry.backend
+    interpret = name == registry.BACKEND_PALLAS_INTERPRET
     general = multi or window is not None or page_offsets is not None \
         or q_rows is not None
     if not general:
-        if impl == "pallas":
-            return _paged_attention_pallas(q, k_pages, v_pages,
-                                           block_tables, seq_lens, scale)
-        if impl == "xla":
+        if name == registry.BACKEND_XLA:
             return _paged_attention_xla(q, k_pages, v_pages,
                                         block_tables, seq_lens, scale)
-        raise ValueError(f"unknown impl {impl!r}; expected pallas|xla")
+        return _paged_attention_pallas(q, k_pages, v_pages,
+                                       block_tables, seq_lens, scale,
+                                       interpret)
     q4 = q if multi else q[:, None]
     kr = (jnp.full((B,), K, jnp.int32) if q_rows is None
           else jnp.asarray(q_rows, jnp.int32))
     po = (jnp.zeros((B,), jnp.int32) if page_offsets is None
           else jnp.asarray(page_offsets, jnp.int32))
-    if impl == "pallas":
-        out = _paged_attention_pallas_multi(
-            q4, k_pages, v_pages, block_tables, seq_lens, kr, po, scale,
-            window)
-    elif impl == "xla":
+    if name == registry.BACKEND_XLA:
         out = _paged_attention_xla_multi(
             q4, k_pages, v_pages, block_tables, seq_lens, kr, po, scale,
             window)
     else:
-        raise ValueError(f"unknown impl {impl!r}; expected pallas|xla")
+        out = _paged_attention_pallas_multi(
+            q4, k_pages, v_pages, block_tables, seq_lens, kr, po, scale,
+            window, interpret)
     return out if multi else out[:, 0]
+
+
+def _paged_lowering(backend, q, k_pages, v_pages, block_tables,
+                    seq_lens, *, sm_scale=None, q_rows=None, window=None,
+                    page_offsets=None):
+    """Registry adapter (family ``"paged"``): the uniform call shape the
+    parity harness / kernel bench drive every lowering through."""
+    return paged_attention(q, k_pages, v_pages, block_tables, seq_lens,
+                           sm_scale=sm_scale, backend=backend,
+                           q_rows=q_rows, window=window,
+                           page_offsets=page_offsets)
+
+
+paged_lowering_pallas_tpu = functools.partial(
+    _paged_lowering, "pallas-tpu")
+paged_lowering_pallas_interpret = functools.partial(
+    _paged_lowering, "pallas-interpret")
+paged_lowering_xla = functools.partial(_paged_lowering, "xla")
 
 
 def paged_partition_specs(data_axis="dp", model_axis="tp", multi=False):
@@ -482,5 +517,5 @@ def paged_attention_reference(q, k_pages, v_pages, block_tables,
         return _paged_attention_xla(q, k_pages, v_pages, block_tables,
                                     seq_lens, scale)
     return paged_attention(q, k_pages, v_pages, block_tables, seq_lens,
-                           sm_scale=scale, impl="xla", q_rows=q_rows,
+                           sm_scale=scale, backend="xla", q_rows=q_rows,
                            window=window, page_offsets=page_offsets)

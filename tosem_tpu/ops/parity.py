@@ -1,0 +1,473 @@
+"""Universal cross-backend parity harness for the kernel registry.
+
+Before the registry, every kernel family hand-rolled its own parity
+tests: ``test_paged_attention`` pinned the three decode lowerings
+against each other, ``test_mask_programs`` pinned kernels against the
+schedule-XLA oracle, ``test_decode_modes`` pinned the window/multi-q
+modes — three copies of the same engine, each covering only the pairs
+its author thought of. This module is the one parametrized engine they
+all run through now:
+
+- each family declares a **scenario matrix** (mask × dtype × layout ×
+  window/spec-k — :func:`scenarios`), with deterministic input builders
+  (:func:`build_case`) so every lowering of a pair sees byte-identical
+  operands;
+- :func:`check_pair` runs ANY two registered lowerings of a family over
+  a scenario and asserts they agree within the family's per-dtype
+  tolerance (fp32 online-vs-dense softmax re-association budgets, not
+  loose epsilons);
+- :func:`check_oracle` additionally pins a lowering against the
+  family's brute-force numpy/dense oracle — the ground truth no jax
+  lowering shares code with;
+- :func:`available_pairs` enumerates every unordered pair of lowerings
+  executable on this platform, so the test matrix grows automatically
+  when a backend is registered.
+
+Lowerings are resolved STRICTLY (``registry.resolve(strict=True)``): a
+parity pair must run exactly the two lowerings it names — silent
+fallback would turn a cross-check into a self-check.
+"""
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from tosem_tpu.ops import registry
+
+# fp32 budget: a few ulps of online-vs-dense softmax re-association.
+# bf16 operands round scores/probabilities to 8 mantissa bits first.
+TOLERANCES: Dict[str, Dict[str, float]] = {
+    "flash": {"float32": 2e-5, "bfloat16": 2e-2},
+    "paged": {"float32": 5e-6, "bfloat16": 2e-2},
+    "schedule": {"float32": 2e-5, "bfloat16": 2e-2},
+}
+
+
+@dataclass(frozen=True)
+class Scenario:
+    """One cell of a family's parity matrix. ``params`` carries the
+    family-specific knobs (mask spec, window, q_rows, segments, …)."""
+    family: str
+    name: str
+    dtype: str = "float32"
+    params: tuple = field(default_factory=tuple)
+
+    def p(self) -> dict:
+        return dict(self.params)
+
+    def __str__(self) -> str:                  # pytest id
+        return f"{self.family}:{self.name}:{self.dtype}"
+
+
+def _sc(family: str, name: str, dtype: str = "float32", **params):
+    return Scenario(family, name, dtype, tuple(sorted(params.items())))
+
+
+# ---------------------------------------------------------------------------
+# the declared matrices. Shapes are deliberately tiny (interpret mode
+# unrolls the grid at trace time); coverage comes from the MODE axes,
+# not the extents.
+
+_FLASH_SCENARIOS: List[Scenario] = [
+    _sc("flash", "dense"),
+    _sc("flash", "dense", "bfloat16"),
+    _sc("flash", "causal", causal=True),
+    _sc("flash", "causal", "bfloat16", causal=True),
+    _sc("flash", "segments", segments=True),
+    _sc("flash", "causal_segments", causal=True, segments=True),
+    _sc("flash", "bthd_layout", layout="bthd", causal=True),
+    _sc("flash", "local_mask", mask="local:48"),
+    _sc("flash", "prefix_mask", mask="prefix:32"),
+    _sc("flash", "doc_mask", mask="doc:64"),
+    _sc("flash", "doc_mask_segments", mask="doc:64", segments=True),
+]
+
+_PAGED_SCENARIOS: List[Scenario] = [
+    _sc("paged", "ragged_lens", lens=(7, 0, 16)),
+    _sc("paged", "ragged_lens", "bfloat16", lens=(9, 12)),
+    _sc("paged", "single_full", lens=(32,)),
+    _sc("paged", "multi_q", lens=(29, 17), k=4),
+    _sc("paged", "multi_q_ragged_rows", lens=(29, 17), k=4,
+        q_rows=(4, 3)),
+    _sc("paged", "window", lens=(29, 17), k=2, window=10),
+    _sc("paged", "window_multi_q", lens=(30, 20), k=4, window=12),
+    _sc("paged", "window_offsets", lens=(30, 20), k=2, window=6,
+        offsets=True),
+]
+
+_SCHEDULE_SCENARIOS: List[Scenario] = [
+    _sc("schedule", "causal", mask="causal"),
+    _sc("schedule", "local", mask="local:48"),
+    _sc("schedule", "local", "bfloat16", mask="local:48"),
+    _sc("schedule", "prefix", mask="prefix:40"),
+    _sc("schedule", "doc", mask="doc:64"),
+    _sc("schedule", "local_band", mask="local:32:31"),
+    _sc("schedule", "multihead", multihead=True),
+    _sc("schedule", "multihead_segments", multihead=True,
+        segments=True),
+    _sc("schedule", "doc_segments", mask="doc:64", segments=True),
+]
+
+_MATRIX: Dict[str, List[Scenario]] = {
+    "flash": _FLASH_SCENARIOS,
+    "paged": _PAGED_SCENARIOS,
+    "schedule": _SCHEDULE_SCENARIOS,
+}
+
+
+def scenarios(family: str,
+              dtype: Optional[str] = None) -> List[Scenario]:
+    """The family's declared scenario matrix (optionally one dtype)."""
+    out = _MATRIX[family]
+    if dtype is not None:
+        out = [s for s in out if s.dtype == dtype]
+    return list(out)
+
+
+# ---------------------------------------------------------------------------
+# deterministic input builders — one per family
+
+
+def _segments_for(rng, B: int, T: int):
+    import jax.numpy as jnp
+    from tosem_tpu.ops.flash_attention import SegmentIds
+    # two segments per row plus a padded tail: exercises both the
+    # equal-id gate and the padding semantics
+    cut = T // 2
+    pad = max(T // 8, 1)
+    seg = np.ones((B, T), np.int32)
+    seg[:, cut:] = 2
+    seg[:, T - pad:] = 3
+    return SegmentIds(q=jnp.asarray(seg), kv=jnp.asarray(seg))
+
+
+def _flash_case(sc: Scenario, seed: int = 0):
+    import jax.numpy as jnp
+    from tosem_tpu.ops.flash_blocks import BlockSizes
+    from tosem_tpu.ops.mask_programs import mask_from_spec
+    p = sc.p()
+    # one batch row / head: B and H are trivially parallel grid dims
+    # (the kernels' own tests cover multi-B/H); the parity risk axes
+    # are the MODE knobs, and interpret-mode cost scales with B·H
+    B, H, T, D = 1, 1, 128, 16
+    rng = np.random.default_rng(seed)
+    dt = jnp.dtype(sc.dtype)
+    layout = p.get("layout", "bhtd")
+    shape = (B, H, T, D) if layout == "bhtd" else (B, T, H, D)
+    mk = lambda: jnp.asarray(rng.normal(size=shape),
+                             jnp.float32).astype(dt)
+    args = (mk(), mk(), mk())
+    kwargs = {"layout": layout,
+              # one explicit BlockSizes: every lowering of a pair must
+              # execute the identical schedule
+              "block_sizes": BlockSizes(32, 32, 32, 32)}
+    if p.get("causal"):
+        kwargs["causal"] = True
+    if p.get("mask"):
+        kwargs["mask"] = mask_from_spec(p["mask"], T)
+    if p.get("segments"):
+        kwargs["segment_ids"] = _segments_for(rng, B, T)
+    return args, kwargs
+
+
+def _schedule_case(sc: Scenario, seed: int = 0):
+    import jax.numpy as jnp
+    from tosem_tpu.ops.flash_blocks import BlockSizes
+    from tosem_tpu.ops.mask_programs import (CausalMask, LocalMask,
+                                             MultiHeadMask,
+                                             mask_from_spec)
+    p = sc.p()
+    # H=2 exercises the per-head schedule row indexing (and matches
+    # the MultiHeadMask arity); one batch row keeps interpret cheap
+    B, H, T, D = 1, 2, 128, 16
+    rng = np.random.default_rng(seed)
+    dt = jnp.dtype(sc.dtype)
+    mk = lambda: jnp.asarray(rng.normal(size=(B, H, T, D)),
+                             jnp.float32).astype(dt)
+    args = (mk(), mk(), mk())
+    if p.get("multihead"):
+        mask = MultiHeadMask((CausalMask(), LocalMask(32)))
+    else:
+        mask = mask_from_spec(p["mask"], T)
+    kwargs = {"mask": mask, "block_sizes": BlockSizes(32, 32, 32, 32)}
+    if p.get("segments"):
+        kwargs["segment_ids"] = _segments_for(rng, B, T)
+    return args, kwargs
+
+
+def _paged_case(sc: Scenario, seed: int = 0):
+    import jax.numpy as jnp
+    p = sc.p()
+    lens = p["lens"]
+    B = len(lens)
+    H, D, page, npg = 2, 16, 8, 4
+    K = p.get("k", 0)
+    rng = np.random.default_rng(seed)
+    dt = jnp.dtype(sc.dtype)
+    P = B * npg + 2
+    kp = jnp.asarray(rng.standard_normal((P, page, H, D)),
+                     jnp.float32).astype(dt)
+    vp = jnp.asarray(rng.standard_normal((P, page, H, D)),
+                     jnp.float32).astype(dt)
+    bt = jnp.asarray(rng.permutation(P)[:B * npg]
+                     .reshape(B, npg).astype(np.int32))
+    sl = jnp.asarray(lens, jnp.int32)
+    qshape = (B, K, H, D) if K else (B, H, D)
+    q = jnp.asarray(rng.standard_normal(qshape),
+                    jnp.float32).astype(dt)
+    kwargs = {}
+    if p.get("window"):
+        kwargs["window"] = p["window"]
+    if p.get("q_rows"):
+        kwargs["q_rows"] = jnp.asarray(p["q_rows"], jnp.int32)
+    if p.get("offsets"):
+        # rolling-table contract (window eviction): slot j holds
+        # logical page po+j; po is the first page still holding an
+        # in-window key, the narrow table runs through each sequence's
+        # last real page — the same physical pages the full table names
+        window = p["window"]
+        kq = K or 1
+        po = np.asarray(
+            [max(int(l) - kq - window + 1, 0) // page for l in lens],
+            np.int64)
+        last = np.asarray(
+            [(int(l) + page - 1) // page - 1 for l in lens], np.int64)
+        w = int((last - po).max()) + 1
+        po = np.minimum(po, npg - w)
+        bt = jnp.stack([bt[b, int(po[b]):int(po[b]) + w]
+                        for b in range(B)])
+        kwargs["page_offsets"] = jnp.asarray(po, jnp.int32)
+    return (q, kp, vp, bt, sl), kwargs
+
+
+_BUILDERS = {"flash": _flash_case, "paged": _paged_case,
+             "schedule": _schedule_case}
+
+
+def build_case(sc: Scenario, seed: int = 0) -> Tuple[tuple, dict]:
+    """Deterministic ``(args, kwargs)`` for the scenario — identical
+    bytes on every call, so every lowering of a pair sees the same
+    operands."""
+    return _BUILDERS[sc.family](sc, seed)
+
+
+# ---------------------------------------------------------------------------
+# oracles: brute-force references no jax lowering shares code with
+
+
+def _dense_mask_oracle(q, k, v, kwargs) -> np.ndarray:
+    """Numpy dense attention with mask program + segments folded in —
+    oracle for the flash AND schedule families."""
+    layout = kwargs.get("layout", "bhtd")
+    q, k, v = (np.asarray(x, np.float32) for x in (q, k, v))
+    if layout == "bthd":
+        tr = lambda x: x.transpose(0, 2, 1, 3)
+        q, k, v = tr(q), tr(k), tr(v)
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    keep = np.ones((B, H, Tq, Tk), bool)
+    mask = kwargs.get("mask")
+    if kwargs.get("causal"):
+        from tosem_tpu.ops.mask_programs import CausalMask
+        mask = CausalMask() if mask is None else (mask & CausalMask())
+    if mask is not None:
+        dm = np.asarray(mask.dense(Tq, Tk))
+        keep &= (dm[None, None] if dm.ndim == 2 else dm[None])
+    seg = kwargs.get("segment_ids")
+    if seg is not None:
+        sq = np.asarray(seg.q)[:, :, None]
+        sk = np.asarray(seg.kv)[:, None, :]
+        keep &= (sq == sk)[:, None]
+    s = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D)
+    s = np.where(keep, s, -1e30)
+    s -= s.max(-1, keepdims=True)
+    p = np.exp(s)
+    p /= p.sum(-1, keepdims=True)
+    out = np.einsum("bhqk,bhkd->bhqd", p, v)
+    return out if layout == "bhtd" else out.transpose(0, 2, 1, 3)
+
+
+def _paged_oracle(q, kp, vp, bt, sl, kwargs) -> np.ndarray:
+    """Brute-force numpy decode oracle for every paged mode (multi-q
+    intra-step causal bound, window, rolling offsets)."""
+    q = np.asarray(q, np.float32)
+    multi = q.ndim == 4
+    q4 = q if multi else q[:, None]
+    kp, vp = np.asarray(kp, np.float32), np.asarray(vp, np.float32)
+    bt, sl = np.asarray(bt), np.asarray(sl)
+    B, K, H, D = q4.shape
+    page = kp.shape[1]
+    T = bt.shape[1] * page
+    window = kwargs.get("window")
+    q_rows = kwargs.get("q_rows")
+    po = kwargs.get("page_offsets")
+    po = np.zeros(B, int) if po is None else np.asarray(po)
+    k = kp[bt].reshape(B, T, H, D)
+    v = vp[bt].reshape(B, T, H, D)
+    out = np.zeros((B, K, H, D), np.float32)
+    for b in range(B):
+        if sl[b] == 0:
+            continue
+        kr = K if q_rows is None else int(q_rows[b])
+        for r in range(K):
+            bound = int(sl[b]) - kr + min(r, kr - 1)
+            lo = bound - window + 1 if window else 0
+            idx = [t - po[b] * page for t in
+                   range(max(lo, po[b] * page),
+                         min(bound + 1, po[b] * page + T))]
+            for h in range(H):
+                s = q4[b, r, h] @ k[b, idx, h].T / np.sqrt(D)
+                p = np.exp(s - s.max())
+                p /= p.sum()
+                out[b, r, h] = p @ v[b, idx, h]
+    return out if multi else out[:, 0]
+
+
+# ---------------------------------------------------------------------------
+# the engine
+
+
+def available_backends(family: str,
+                       platform: Optional[str] = None) -> Tuple[str, ...]:
+    """Backends of a family executable on this platform."""
+    return registry.backends(family, platform)
+
+
+def available_pairs(family: str, platform: Optional[str] = None
+                    ) -> List[Tuple[str, str]]:
+    """Every unordered pair of executable lowerings — the full
+    cross-check set this platform can run."""
+    names = available_backends(family, platform)
+    return [(a, b) for i, a in enumerate(names)
+            for b in names[i + 1:]]
+
+
+def _features_of(family: str, args: tuple, kwargs: dict
+                 ) -> FrozenSet[str]:
+    """The capability features a scenario's case actually exercises —
+    what the STRICT resolve must check, so a lowering lacking a mode
+    fails the pair loudly instead of the adapter's inner dispatch
+    silently falling back (a cross-check must never self-check)."""
+    feats = set()
+    if family == "paged":
+        if args[0].ndim == 4:
+            feats.add("multi_query")
+        if kwargs.get("window") is not None:
+            feats.add("window")
+        if kwargs.get("page_offsets") is not None:
+            feats.add("page_offsets")
+    elif family == "schedule":
+        from tosem_tpu.ops.mask_programs import MultiHeadMask
+        if isinstance(kwargs.get("mask"), MultiHeadMask):
+            feats.add("multihead")
+        if kwargs.get("segment_ids") is not None:
+            feats.add("segments")
+    else:
+        if kwargs.get("mask") is not None or kwargs.get("causal"):
+            feats.add("mask")
+        if kwargs.get("segment_ids") is not None:
+            feats.add("segments")
+        if kwargs.get("layout") == "bthd":
+            feats.add("layout_bthd")
+    return frozenset(feats)
+
+
+@functools.lru_cache(maxsize=512)
+def _run_cell(family: str, backend: str, scenario: Scenario,
+              seed: int) -> np.ndarray:
+    """One lowering over one scenario's deterministic case. Memoized:
+    the SAME (lowering, scenario, seed) cell recurs across the harness
+    sweep, the migrated per-file tests, the oracle pins, and the
+    kernel bench's pre-timing parity gate — inputs are deterministic by
+    construction, so the first run's output IS every rerun's output
+    (and eager interpret tracing is the dominant per-cell cost)."""
+    args, kwargs = build_case(scenario, seed)
+    entry = registry.resolve(family, backend, strict=True,
+                             dtype=scenario.dtype,
+                             features=_features_of(family, args, kwargs))
+    return np.asarray(entry.fn()(*args, **kwargs), np.float32)
+
+
+def reset_cell_cache() -> None:
+    """Tests: drop memoized lowering outputs."""
+    _run_cell.cache_clear()
+
+
+def check_pair(family: str, backend_a: str, backend_b: str,
+               scenario: Scenario, *, seed: int = 0,
+               atol: Optional[float] = None) -> float:
+    """Run both lowerings over the scenario's deterministic case and
+    assert agreement within the family tolerance. Returns the max abs
+    difference (the evidence a green test run leaves behind)."""
+    args, kwargs = build_case(scenario, seed)
+    out_a = _run_cell(family, backend_a, scenario, seed)
+    out_b = _run_cell(family, backend_b, scenario, seed)
+    tol = atol if atol is not None else TOLERANCES[family][scenario.dtype]
+    diff = _assert_close(out_a, out_b, tol, family, scenario,
+                         f"{backend_a} vs {backend_b}", args, kwargs)
+    return diff
+
+
+def check_oracle(family: str, backend: str, scenario: Scenario, *,
+                 seed: int = 0, atol: Optional[float] = None) -> float:
+    """Pin one lowering against the family's numpy oracle."""
+    args, kwargs = build_case(scenario, seed)
+    out = _run_cell(family, backend, scenario, seed)
+    if family == "paged":
+        ref = _paged_oracle(*args, kwargs)
+    else:
+        ref = _dense_mask_oracle(args[0], args[1], args[2], kwargs)
+    tol = atol if atol is not None else TOLERANCES[family][scenario.dtype]
+    return _assert_close(out, ref, tol, family, scenario,
+                         f"{backend} vs oracle", args, kwargs)
+
+
+def _valid_rows_mask(family: str, args: tuple, kwargs: dict,
+                     shape) -> np.ndarray:
+    """Rows whose outputs are CONTRACT, not garbage: paged padding rows
+    (r >= q_rows[b]) mirror real rows but emit discardable values —
+    exclude them from the comparison, exactly like the serving layer
+    discards them."""
+    keep = np.ones(shape, bool)
+    if family == "paged":
+        q_rows = kwargs.get("q_rows")
+        if q_rows is not None and len(shape) == 4:
+            for b, kr in enumerate(np.asarray(q_rows)):
+                keep[b, int(kr):] = False
+    return keep
+
+
+def _assert_close(a: np.ndarray, b: np.ndarray, tol: float,
+                  family: str, scenario: Scenario, who: str,
+                  args: tuple, kwargs: dict) -> float:
+    keep = _valid_rows_mask(family, args, kwargs, a.shape)
+    diff = np.abs(np.where(keep, a, 0.0) - np.where(keep, b, 0.0))
+    worst = float(diff.max()) if diff.size else 0.0
+    if not np.isfinite(a[keep]).all() or not np.isfinite(b[keep]).all():
+        raise AssertionError(
+            f"[parity:{scenario}] {who}: non-finite outputs")
+    if worst > tol:
+        idx = np.unravel_index(int(diff.argmax()), diff.shape)
+        raise AssertionError(
+            f"[parity:{scenario}] {who}: max |diff| {worst:.3e} > "
+            f"{tol:.0e} at {idx} (a={a[idx]:.6f}, b={b[idx]:.6f})")
+    return worst
+
+
+def run_matrix(families: Optional[Tuple[str, ...]] = None,
+               platform: Optional[str] = None) -> List[dict]:
+    """Sweep EVERY (family, pair, scenario) cell this platform can run
+    — the one-call form the bench/CLI use. Returns one record per cell;
+    raises on the first parity violation."""
+    out: List[dict] = []
+    for family in families or registry.FAMILIES:
+        for a, b in available_pairs(family, platform):
+            for sc in scenarios(family):
+                diff = check_pair(family, a, b, sc)
+                out.append({"family": family, "pair": (a, b),
+                            "scenario": sc.name, "dtype": sc.dtype,
+                            "max_abs_diff": diff})
+    return out

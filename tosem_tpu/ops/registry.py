@@ -1,0 +1,301 @@
+"""Kernel-backend registry: one dispatch layer for every lowering.
+
+The repo grew the "one kernel, many lowerings" pattern three times over,
+ad hoc: ``paged_attention(impl="pallas"|"xla")`` (PR 6),
+``schedule_attention_xla`` as the sparse oracle/CPU bench arm (PR 9),
+and per-file Pallas-interpret parity tests. This module promotes it to
+an explicit dispatch layer — the CuPBoP (2206.07896) / COX (2112.10034)
+argument that a single kernel definition should retarget across
+architectures through a registry, not copy-pasted ``impl=`` branches.
+
+Every kernel **family** registers its **lowerings** under named
+backends:
+
+- ``pallas-tpu`` — the Mosaic-compiled Pallas kernel (TPU only);
+- ``pallas-interpret`` — the same kernel body in Pallas interpret mode
+  (runs anywhere; the traditional off-chip parity arm);
+- ``xla`` — a pure-XLA lowering of the identical computation (the dense
+  reference / CPU fast path).
+
+Each lowering declares :class:`Capabilities` (platforms, dtypes,
+optional features such as masks/segments/window/multi-query);
+:func:`resolve` picks a lowering by platform + capability, honours an
+explicit ``backend=`` override, and — when the requested backend cannot
+serve on this platform — falls back down the platform's preference
+order and counts the event in :data:`FALLBACK_COUNTS` so A/B tests can
+assert the exact lowering that ran. ``strict=True`` raises instead of
+falling back (the parity harness runs exact pairs).
+
+The registry itself imports nothing heavy: lowerings are dotted
+``"module:qualname"`` strings resolved lazily at first call, so the
+module is cheap to import from anywhere (flash_blocks consults it for
+the platform-scoped autotune cache scope without a cycle).
+"""
+from __future__ import annotations
+
+import collections
+import importlib
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, Optional, Tuple
+
+FAMILIES = ("flash", "paged", "schedule")
+
+BACKEND_PALLAS_TPU = "pallas-tpu"
+BACKEND_PALLAS_INTERPRET = "pallas-interpret"
+BACKEND_XLA = "xla"
+
+# requested-but-unavailable backend -> which lowering served instead;
+# keys are "family:requested->served". A/B tests assert exact dispatch
+# against this (and FLASH_DISPATCH_COUNTS) instead of inferring it.
+FALLBACK_COUNTS: "collections.Counter[str]" = collections.Counter()
+
+
+class BackendUnavailable(ValueError):
+    """No registered lowering can serve the request (or ``strict=True``
+    and the requested one cannot)."""
+
+
+@dataclass(frozen=True)
+class Capabilities:
+    """What a lowering can run. ``platforms`` is where it EXECUTES
+    (interpret mode runs anywhere, Mosaic only on TPU); ``dtypes``
+    restricts operand dtypes (None = unrestricted — the built-ins all
+    take whatever the caller feeds, exactly like the pre-registry
+    code paths did); ``features`` are the optional kernel modes it
+    implements; ``max_seq`` bounds the KV/sequence extent (None =
+    unbounded); ``tiled_seq`` means sequence lengths must tile
+    (8-sublane / 128-lane) — the Mosaic alignment rule the XLA
+    lowerings do not share."""
+    platforms: Tuple[str, ...] = ("cpu", "gpu", "tpu")
+    dtypes: Optional[Tuple[str, ...]] = None
+    features: FrozenSet[str] = frozenset()
+    max_seq: Optional[int] = None
+    tiled_seq: bool = False
+
+    def supports(self, platform: str, dtype: Optional[str],
+                 features: FrozenSet[str]) -> bool:
+        if platform not in self.platforms:
+            return False
+        if (self.dtypes is not None and dtype is not None
+                and dtype not in self.dtypes):
+            return False
+        return features <= self.features
+
+
+@dataclass(frozen=True)
+class Lowering:
+    """One registered (family, backend) lowering. ``loader`` is a lazy
+    ``"module:qualname"`` reference to the adapter callable — every
+    adapter takes the family's uniform argument list and forces its own
+    backend, so the parity harness and the kernel bench drive every
+    lowering through one call shape."""
+    family: str
+    backend: str
+    loader: str
+    caps: Capabilities
+    _fn_cache: dict = field(default_factory=dict, compare=False,
+                            repr=False)
+
+    def fn(self):
+        if "fn" not in self._fn_cache:
+            mod, _, name = self.loader.partition(":")
+            self._fn_cache["fn"] = getattr(
+                importlib.import_module(mod), name)
+        return self._fn_cache["fn"]
+
+
+# family -> platform -> backend preference order. "*" covers every
+# platform without its own entry. The off-chip defaults preserve the
+# pre-registry behavior exactly: flash/schedule ran the Pallas kernel in
+# interpret mode off-TPU, paged decode ran the XLA gather (PR 6's
+# ``impl=None`` rule).
+_DEFAULT_ORDER: Dict[str, Dict[str, Tuple[str, ...]]] = {
+    "flash": {"tpu": (BACKEND_PALLAS_TPU, BACKEND_PALLAS_INTERPRET,
+                      BACKEND_XLA),
+              "*": (BACKEND_PALLAS_INTERPRET, BACKEND_XLA)},
+    "paged": {"tpu": (BACKEND_PALLAS_TPU, BACKEND_PALLAS_INTERPRET,
+                      BACKEND_XLA),
+              "*": (BACKEND_XLA, BACKEND_PALLAS_INTERPRET)},
+    "schedule": {"tpu": (BACKEND_PALLAS_TPU, BACKEND_PALLAS_INTERPRET,
+                         BACKEND_XLA),
+                 "*": (BACKEND_PALLAS_INTERPRET, BACKEND_XLA)},
+}
+
+_FLASH_FEATURES = frozenset({"mask", "segments", "bwd", "layout_bthd"})
+_PAGED_FEATURES = frozenset({"window", "multi_query", "page_offsets"})
+_SCHED_FEATURES = frozenset({"multihead", "segments"})
+
+_ENTRIES: Dict[str, Dict[str, Lowering]] = {f: {} for f in FAMILIES}
+
+
+def register(family: str, backend: str, loader: str,
+             caps: Capabilities, *, replace: bool = False) -> Lowering:
+    """Register a lowering. Families are closed (:data:`FAMILIES`);
+    re-registering an existing backend requires ``replace=True`` so a
+    typo cannot silently shadow a built-in."""
+    if family not in _ENTRIES:
+        raise ValueError(f"unknown kernel family {family!r}; expected "
+                         f"one of {FAMILIES}")
+    if backend in _ENTRIES[family] and not replace:
+        raise ValueError(f"{family}:{backend} already registered "
+                         "(pass replace=True to override)")
+    entry = Lowering(family, backend, loader, caps)
+    _ENTRIES[family][backend] = entry
+    return entry
+
+
+def lowerings(family: str) -> Dict[str, Lowering]:
+    """All registered lowerings of a family, keyed by backend name."""
+    if family not in _ENTRIES:
+        raise ValueError(f"unknown kernel family {family!r}; expected "
+                         f"one of {FAMILIES}")
+    return dict(_ENTRIES[family])
+
+
+def current_platform() -> str:
+    import jax
+    return jax.default_backend()
+
+
+def canonical_backend(name: Optional[str],
+                      platform: Optional[str] = None) -> Optional[str]:
+    """Normalize a backend request. The PR-6 legacy ``impl=`` names stay
+    accepted: ``"pallas"`` means the platform's Pallas arm (Mosaic on
+    TPU, interpret elsewhere), ``"xla"`` is already canonical."""
+    if name is None:
+        return None
+    if name == "pallas":
+        platform = platform or current_platform()
+        return (BACKEND_PALLAS_TPU if platform == "tpu"
+                else BACKEND_PALLAS_INTERPRET)
+    if name in (BACKEND_PALLAS_TPU, BACKEND_PALLAS_INTERPRET,
+                BACKEND_XLA):
+        return name
+    raise ValueError(
+        f"unknown backend {name!r}; expected pallas|xla|"
+        f"{BACKEND_PALLAS_TPU}|{BACKEND_PALLAS_INTERPRET}")
+
+
+def _order(family: str, platform: str) -> Tuple[str, ...]:
+    by_platform = _DEFAULT_ORDER.get(family, {})
+    return by_platform.get(platform, by_platform.get("*", ()))
+
+
+def backends(family: str, platform: Optional[str] = None, *,
+             available_only: bool = True) -> Tuple[str, ...]:
+    """Backend names of a family in this platform's preference order
+    (registered-but-unlisted backends trail). ``available_only`` drops
+    lowerings that cannot execute on the platform at all."""
+    platform = platform or current_platform()
+    entries = lowerings(family)
+    ordered = [b for b in _order(family, platform) if b in entries]
+    ordered += [b for b in entries if b not in ordered]
+    if available_only:
+        ordered = [b for b in ordered
+                   if platform in entries[b].caps.platforms]
+    return tuple(ordered)
+
+
+def resolve(family: str, backend: Optional[str] = None, *,
+            platform: Optional[str] = None, dtype: Optional[str] = None,
+            features: FrozenSet[str] = frozenset(),
+            strict: bool = False) -> Lowering:
+    """Pick the lowering that serves this request.
+
+    No ``backend``: first capable entry in the platform's preference
+    order. Explicit ``backend`` (canonical or legacy ``impl`` name):
+    that lowering when it can serve; otherwise ``strict=True`` raises
+    :class:`BackendUnavailable`, ``strict=False`` falls back down the
+    preference order and bumps ``FALLBACK_COUNTS["family:req->served"]``
+    — requested-but-degraded dispatch is counted, never silent."""
+    platform = platform or current_platform()
+    features = frozenset(features)
+    entries = lowerings(family)
+    if not entries:
+        raise BackendUnavailable(f"kernel family {family!r} has no "
+                                 "registered lowerings")
+    requested = canonical_backend(backend, platform)
+    if requested is not None:
+        entry = entries.get(requested)
+        if entry is not None and entry.caps.supports(platform, dtype,
+                                                     features):
+            return entry
+        why = ("not registered" if entry is None else
+               f"cannot serve platform={platform} dtype={dtype} "
+               f"features={sorted(features)}")
+        if strict:
+            raise BackendUnavailable(
+                f"{family}:{requested} {why}")
+    for name in backends(family, platform, available_only=False):
+        if requested is not None and name == requested:
+            continue
+        entry = entries[name]
+        if entry.caps.supports(platform, dtype, features):
+            if requested is not None:
+                FALLBACK_COUNTS[f"{family}:{requested}->{name}"] += 1
+            return entry
+    raise BackendUnavailable(
+        f"no {family} lowering serves platform={platform} "
+        f"dtype={dtype} features={sorted(features)} "
+        f"(registered: {sorted(entries)})")
+
+
+def default_backend(family: str,
+                    platform: Optional[str] = None) -> str:
+    """The backend an unqualified call resolves to on ``platform`` —
+    also the scope the platform-keyed autotune cache reads/writes
+    (:mod:`tosem_tpu.ops.flash_blocks`)."""
+    return resolve(family, platform=platform).backend
+
+
+def reset_fallback_counts() -> None:
+    """Tests: drop recorded fallback events."""
+    FALLBACK_COUNTS.clear()
+
+
+# ---------------------------------------------------------------------------
+# built-in lowerings. Adapters live next to their kernels and force the
+# backend explicitly, so registry.fn() and the public entry points
+# (flash_attention / paged_attention / flash_attn_fn) share ONE dispatch
+# path — the capability table below is the README's registry table.
+
+register(
+    "flash", BACKEND_PALLAS_TPU,
+    "tosem_tpu.ops.flash_attention:flash_lowering_pallas_tpu",
+    Capabilities(platforms=("tpu",), features=_FLASH_FEATURES,
+                 tiled_seq=True))
+register(
+    "flash", BACKEND_PALLAS_INTERPRET,
+    "tosem_tpu.ops.flash_attention:flash_lowering_pallas_interpret",
+    Capabilities(features=_FLASH_FEATURES, tiled_seq=True))
+register(
+    "flash", BACKEND_XLA,
+    "tosem_tpu.ops.flash_attention:flash_lowering_xla",
+    Capabilities(features=_FLASH_FEATURES))
+
+register(
+    "paged", BACKEND_PALLAS_TPU,
+    "tosem_tpu.ops.paged_attention:paged_lowering_pallas_tpu",
+    Capabilities(platforms=("tpu",), features=_PAGED_FEATURES))
+register(
+    "paged", BACKEND_PALLAS_INTERPRET,
+    "tosem_tpu.ops.paged_attention:paged_lowering_pallas_interpret",
+    Capabilities(features=_PAGED_FEATURES))
+register(
+    "paged", BACKEND_XLA,
+    "tosem_tpu.ops.paged_attention:paged_lowering_xla",
+    Capabilities(features=_PAGED_FEATURES))
+
+register(
+    "schedule", BACKEND_PALLAS_TPU,
+    "tosem_tpu.ops.flash_attention:schedule_lowering_pallas_tpu",
+    Capabilities(platforms=("tpu",), features=_SCHED_FEATURES,
+                 tiled_seq=True))
+register(
+    "schedule", BACKEND_PALLAS_INTERPRET,
+    "tosem_tpu.ops.flash_attention:schedule_lowering_pallas_interpret",
+    Capabilities(features=_SCHED_FEATURES, tiled_seq=True))
+register(
+    "schedule", BACKEND_XLA,
+    "tosem_tpu.ops.mask_programs:schedule_lowering_xla",
+    Capabilities(features=_SCHED_FEATURES))

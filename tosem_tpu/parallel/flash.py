@@ -59,7 +59,8 @@ def sharded_paged_attention(mesh: Mesh, *,
                             window: Optional[int] = None,
                             data_axis: str = "dp",
                             model_axis: Optional[str] = "tp",
-                            impl: Optional[str] = None):
+                            impl: Optional[str] = None,
+                            backend: Optional[str] = None):
     """Model-sharded paged decode attention under ``shard_map``.
 
     Builds a jitted ``(q, k_pages, v_pages, block_tables, seq_lens[,
@@ -108,7 +109,8 @@ def sharded_paged_attention(mesh: Mesh, *,
             kr = rest.pop(0) if have_rows else None
             po = rest.pop(0) if have_offs else None
             return paged_attention(q, kp, vp, bt, sl, sm_scale=sm_scale,
-                                   impl=impl, q_rows=kr, window=window,
+                                   impl=impl, backend=backend,
+                                   q_rows=kr, window=window,
                                    page_offsets=po)
 
         return shard_map(body, mesh=mesh, in_specs=tuple(in_specs),
@@ -161,7 +163,8 @@ def sharded_flash_attention(mesh: Mesh, *, causal: bool = False,
                             model_axis: Optional[str] = "tp",
                             layout: str = "bthd",
                             block_sizes: Optional[BlockSizes] = None,
-                            mask: Optional[Mask] = None):
+                            mask: Optional[Mask] = None,
+                            backend: Optional[str] = None):
     """Build a jitted ``(q, k, v[, segment_ids]) -> out`` over ``mesh``.
 
     q/k/v use ``layout`` ("bthd" = the nn-layer [B, T, H, D] default);
@@ -206,7 +209,7 @@ def sharded_flash_attention(mesh: Mesh, *, causal: bool = False,
                                block_sizes=blocks,
                                segment_ids=segment_ids, layout=layout,
                                mask=None if per_head else eff_mask,
-                               programs=programs)
+                               programs=programs, backend=backend)
 
     # segment_ids'/programs' None-ness is static at trace time: each
     # combination traces its own shard_map body, so the unmasked call
@@ -239,7 +242,8 @@ def sharded_flash_attention(mesh: Mesh, *, causal: bool = False,
             Tq, Tk = q.shape[t_dim], k.shape[t_dim]
             blocks = (block_sizes or select_block_sizes(
                 Tq, q.shape[-1], str(q.dtype), Tk,
-                mask_sig=eff_mask.signature())).clamp(Tq, Tk)
+                mask_sig=eff_mask.signature(),
+                backend=backend)).clamp(Tq, Tk)
             progs = jax.tree_util.tree_map(
                 jnp.asarray,
                 compile_mask_programs(eff_mask, Tq, Tk, blocks, heads=H))

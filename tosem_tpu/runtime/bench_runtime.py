@@ -487,6 +487,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "(ops/bench_sparse.py) instead — t8192 "
                         "LocalMask(1024) vs the dense-causal flash "
                         "path, interleaved A/B")
+    p.add_argument("--kernels", action="store_true",
+                   help="run the cross-backend kernel benches "
+                        "(ops/bench_kernels.py) instead — every "
+                        "registered lowering of every kernel family, "
+                        "interleaved A/B, parity-pinned; off-chip rows "
+                        "labelled platform=cpu")
     p.add_argument("--train", action="store_true",
                    help="run the distributed-training benches "
                         "(train/bench_train.py) instead — bucketed-"
@@ -521,6 +527,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     elif args.sparse:
         from tosem_tpu.ops.bench_sparse import GATED_SPARSE_BENCHES
         gated = GATED_SPARSE_BENCHES
+    elif args.kernels:
+        from tosem_tpu.ops.bench_kernels import GATED_KERNEL_BENCHES
+        gated = GATED_KERNEL_BENCHES
     elif args.train:
         from tosem_tpu.train.bench_train import GATED_TRAIN_BENCHES
         gated = GATED_TRAIN_BENCHES
@@ -562,6 +571,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     elif args.sparse:
         from tosem_tpu.ops.bench_sparse import run_sparse_benchmarks
         rows = run_sparse_benchmarks(trials=args.trials,
+                                     min_s=args.min_s,
+                                     quiet=args.quiet, only=only)
+    elif args.kernels:
+        from tosem_tpu.ops.bench_kernels import run_kernel_benchmarks
+        rows = run_kernel_benchmarks(trials=args.trials,
                                      min_s=args.min_s,
                                      quiet=args.quiet, only=only)
     elif args.train:

@@ -399,6 +399,7 @@ class BertDecodeBackend(CompiledBackendMixin):
                  page_size: Optional[int] = None, num_pages: int = 64,
                  max_new_tokens: int = 16, eos_id: Optional[int] = None,
                  impl: Optional[str] = None,
+                 backend: Optional[str] = None,
                  window: Optional[int] = None, spec_k: int = 0,
                  dim: int = 32, heads: int = 2, layers: int = 2,
                  mlp_dim: int = 64):
@@ -417,7 +418,10 @@ class BertDecodeBackend(CompiledBackendMixin):
         self.max_batch = max_batch
         self.max_new_tokens = max_new_tokens
         self.eos_id = eos_id
-        self.impl = impl
+        # ``backend`` is the kernel-registry name ("pallas-tpu" /
+        # "pallas-interpret" / "xla"); ``impl`` stays as the legacy
+        # alias. One value threads down into paged_attention's dispatch.
+        self.impl = impl = backend if backend is not None else impl
         if window is not None and window < 1:
             raise ValueError(f"window must be >= 1, got {window}")
         if not 0 <= spec_k <= 8:
@@ -1467,7 +1471,8 @@ class ShardedPagedDecodeBackend:
     def __init__(self, dp: int = 1, tp: int = 1, batch: int = 4,
                  heads: int = 4, head_dim: int = 16, pages: int = 16,
                  page_size: int = 8, table_w: int = 4,
-                 window: Optional[int] = None):
+                 window: Optional[int] = None,
+                 backend: Optional[str] = None):
         from tosem_tpu.parallel.flash import (dp_tp_mesh,
                                               sharded_paged_attention)
         if batch % dp:
@@ -1479,8 +1484,10 @@ class ShardedPagedDecodeBackend:
                          pages=pages, page_size=page_size,
                          table_w=table_w)
         self.window = window
+        self.backend = backend
         self._mesh = dp_tp_mesh(dp, tp)
-        self._run = sharded_paged_attention(self._mesh, window=window)
+        self._run = sharded_paged_attention(self._mesh, window=window,
+                                            backend=backend)
 
     @staticmethod
     def _workload(req_seed: int, *, batch, heads, head_dim, pages,

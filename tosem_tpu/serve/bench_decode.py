@@ -223,7 +223,7 @@ def _window_leg(em: SuiteEmitter, trials: int, min_s: float) -> None:
         full.extend(f"s{b}", T - 1)
     filled(full)
     step_full = jax.jit(model.decode_step_fn(vs, page_size=PAGE,
-                                             impl="xla"))
+                                             backend="xla"))
     tables_f = jnp.asarray(np.stack(
         [full.block_table(f"s{b}", n_full) for b in range(B)]))
 
@@ -250,7 +250,7 @@ def _window_leg(em: SuiteEmitter, trials: int, min_s: float) -> None:
         raise RuntimeError("window arm never evicted a page")
     table_w = bound + 2
     step_win = jax.jit(model.decode_multi_fn(
-        vs, page_size=PAGE, q_tokens=1, impl="xla", window=W))
+        vs, page_size=PAGE, q_tokens=1, backend="xla", window=W))
     tables_w = jnp.asarray(np.stack(
         [win.block_table(f"w{b}", table_w) for b in range(B)]))
     offs = jnp.asarray([win.page_offset(f"w{b}") for b in range(B)],
