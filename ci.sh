@@ -44,10 +44,10 @@ chaos_smoke() {
   # test file is touched (see tosem_tpu/chaos/); the recovery plans
   # gate on zero surfaced errors — the workload must HEAL, not merely
   # fail loudly
-  echo "== chaos smoke (9 canned fault plans, fixed seeds)"
+  echo "== chaos smoke (10 canned fault plans, fixed seeds)"
   for plan in worker-carnage serve-flap trial-crash \
               evict-heal node-kill-heal decode-chaos decode-migrate \
-              router-chaos train-cluster; do
+              router-chaos train-cluster scale-under-kill; do
     JAX_PLATFORMS=cpu python -m tosem_tpu.cli chaos --plan "$plan"
   done
 }
@@ -107,6 +107,21 @@ perf_smoke() {
   if ! JAX_PLATFORMS=cpu "${ccmd[@]}"; then
     echo "== perf smoke: cluster regression reported; one retry (noisy host?)"
     JAX_PLATFORMS=cpu "${ccmd[@]}"
+  fi
+  # control plane: the closed-loop diurnal/burst scenario — open-loop
+  # 1x->8x->1x ramp with autoscaling (replicas AND router tier), SLO
+  # admission with priority classes, and warm-before-traffic scale-up
+  # live (in-bench hard asserts: zero untyped errors, zero steady-state
+  # sheds, p99 under the latency budget, post-burst convergence to
+  # baseline, zero cold-compile serves; the gated rows hold the levels
+  # release over release)
+  echo "== perf smoke (control microbench vs results/bench_control.json)"
+  local ctcmd=(python -m tosem_tpu.cli microbench --control --trials 1
+               --min-s 0.4 --quiet --only gated
+               --check results/bench_control.json --threshold 0.30)
+  if ! JAX_PLATFORMS=cpu "${ctcmd[@]}"; then
+    echo "== perf smoke: control regression reported; one retry (noisy host?)"
+    JAX_PLATFORMS=cpu "${ctcmd[@]}"
   fi
   # block-sparse mask programs: t8192 LocalMask(1024) vs dense-causal,
   # interleaved A/B with the in-round (phase-immune) speedup ratio as

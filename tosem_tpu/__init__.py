@@ -113,6 +113,18 @@ _LAZY_EXPORTS = {
                         "TrainWorkerLost"),
     "AsyncCheckpointer": ("tosem_tpu.train.checkpoint",
                           "AsyncCheckpointer"),
+    # traffic-scale control plane (round 15): closed-loop autoscaling
+    # over the cluster serving tier, SLO-aware admission with priority
+    # classes, and multi-model multiplexing
+    "ControlPlane": ("tosem_tpu.control.plane", "ControlPlane"),
+    "ScalePolicy": ("tosem_tpu.control.policy", "ScalePolicy"),
+    "PolicyCore": ("tosem_tpu.control.policy", "PolicyCore"),
+    "SLOConfig": ("tosem_tpu.control.admission", "SLOConfig"),
+    "Overloaded": ("tosem_tpu.control.admission", "Overloaded"),
+    "PriorityGate": ("tosem_tpu.control.admission", "PriorityGate"),
+    "ModelLedger": ("tosem_tpu.control.multiplex", "ModelLedger"),
+    "PlacementScorer": ("tosem_tpu.control.multiplex",
+                        "PlacementScorer"),
 }
 
 __all__ = sorted(_LAZY_EXPORTS)

@@ -22,6 +22,7 @@ serve.route         request routed via a ClusterHandle      kill_router,
 tune.step           trial step result processed             crash_trial
 cluster.submit      NodePool routes work to a node agent    kill_node
 train.step          trainer fit() finished one step         preempt
+control.scale       scale-up placement target chosen        kill_node
 ==================  =====================================  =============
 
 The cluster layer's node agent runs in a separate process, so its

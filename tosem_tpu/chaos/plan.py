@@ -31,6 +31,7 @@ VALID_SITES = (
     "runtime.dispatch", "runtime.result", "runtime.store",
     "serve.dispatch", "serve.decode_step", "serve.route", "tune.step",
     "cluster.submit", "train.step", "train.dist_step",
+    "control.scale",
 )
 
 VALID_ACTIONS = {
@@ -59,6 +60,12 @@ VALID_ACTIONS = {
     # kill_node hard-kills the node hosting the highest dp rank (the
     # trainer must shrink the dp axis and continue bit-identically)
     "train.dist_step": ("kill_node",),
+    # fired once per control-plane scale-up placement, AFTER the target
+    # node is chosen and BEFORE the replica process starts: kill_node
+    # SIGKILLs exactly that node and declares it dead — the controller
+    # must not count the dead node's warming replica toward capacity,
+    # and admission must shed typed instead of routing to it
+    "control.scale": ("kill_node",),
 }
 
 
@@ -231,6 +238,17 @@ def _canned() -> Dict[str, FaultPlan]:
         # membership only moves shard boundaries)
         "train-cluster": FaultPlan(seed=47, name="train-cluster", faults=[
             Fault(site="train.dist_step", action="kill_node", at=3),
+        ]),
+        # the control-plane acceptance plan: a node dies in the middle
+        # of an autoscaler-driven scale-up (after the controller chose
+        # it as the placement target, before the replica process
+        # started) — the warming replica must never be counted toward
+        # capacity or routed to, overload during the capacity gap must
+        # shed TYPED (Overloaded, never an untyped error or a route to
+        # the corpse), and the scale-up must land on the survivor
+        "scale-under-kill": FaultPlan(seed=53, name="scale-under-kill",
+                                      faults=[
+            Fault(site="control.scale", action="kill_node", at=1),
         ]),
         # the self-healing acceptance plan: a live object evicted, a
         # worker killed mid-task, AND a node agent killed — one run,

@@ -66,6 +66,19 @@ class _EchoBackend:
         return {"echo": request}
 
 
+class _SlowEchoBackend:
+    """Echo with a fixed service time — queue depth (the autoscaling
+    demand signal) and estimated wait (the admission signal) both
+    become controllable via offered concurrency."""
+
+    def __init__(self, delay_s: float = 0.05):
+        self._delay_s = float(delay_s)
+
+    def call(self, request):
+        time.sleep(self._delay_s)
+        return {"echo": request}
+
+
 def _counting_trainable():
     """The resumable step-counting trainable (state = iteration count):
     shared with the cluster trial plane's crash-resume tests so every
@@ -527,6 +540,113 @@ def _scenario_router(chaos: ChaosController,
         pool.close(close_nodes=True)
 
 
+def _scenario_scale_kill(chaos: ChaosController,
+                         rep: SurvivalReport) -> None:
+    """The control-plane acceptance run: a 16-client burst over a
+    1-replica SLO-admitted deployment drives the closed loop to scale
+    up; the plan kills the node the controller CHOSE as the scale-up
+    target, after the pick and before the replica process starts (the
+    warming-replica window). Survival means: the dead node's warming
+    replica is never counted toward capacity or routed to, overload in
+    the capacity gap sheds TYPED (``Overloaded``) — zero untyped
+    errors — and the scale-up lands on the surviving node."""
+    import threading
+
+    from tosem_tpu.cluster.node import RemoteNode
+    from tosem_tpu.cluster.supervisor import NodePool
+    from tosem_tpu.control import ControlPlane, Overloaded, ScalePolicy
+    from tosem_tpu.control.admission import SLOConfig
+    from tosem_tpu.serve.cluster_serve import ClusterServe
+
+    pool = NodePool(miss_threshold=1, probe_timeout=3.0)
+    cs = None
+    try:
+        for i in range(2):
+            pool.add_node(RemoteNode.spawn_local(num_workers=2),
+                          name=f"n{i}")
+        cs = ClusterServe(pool, num_routers=1, router_procs=False)
+        dep = cs.deploy(
+            "mux", "tosem_tpu.chaos.runner:_SlowEchoBackend",
+            num_replicas=1, strategy="pack",
+            init_kwargs={"delay_s": 0.05},
+            slo=SLOConfig(latency_budget_s=0.15, est_service_s=0.05,
+                          target_inflight_per_replica=2,
+                          classes={"decode": 10, "bulk": 0}))
+        plane = ControlPlane(cs, default=ScalePolicy(
+            min_units=1, max_units=3, target_per_unit=1.0,
+            idle_ticks_before_downscale=2, max_up_per_tick=2))
+        h = cs.get_handle("mux")
+        h.call({"warm": 0})
+        before = {r.node for r in dep.replicas}
+
+        ok = [0]
+        sheds = [0]
+        untyped: List[BaseException] = []
+        lock = threading.Lock()
+        stop = time.perf_counter() + 2.5
+
+        def client(i: int) -> None:
+            k = 0
+            while time.perf_counter() < stop:
+                try:
+                    h.call({"i": i, "k": k},
+                           klass="decode" if i % 2 else "bulk")
+                    with lock:
+                        ok[0] += 1
+                except Overloaded:
+                    with lock:
+                        sheds[0] += 1
+                    time.sleep(0.02)
+                except BaseException as e:
+                    with lock:
+                        untyped.append(e)
+                k += 1
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(16)]
+        for t in threads:
+            t.start()
+        # let depth build, then run the control loop under load — the
+        # first scale-up placement fires the plan's kill_node
+        time.sleep(0.4)
+        deadline = time.perf_counter() + 4.0
+        while (time.perf_counter() < deadline
+               and len(dep.replicas) < 2):
+            plane.tick()
+            time.sleep(0.1)
+        for t in threads:
+            t.join()
+
+        inj = chaos.injections("control.scale")
+        live = set(pool.live_nodes())
+        placed_nodes = {r.node for r in dep.replicas}
+        rep.counts["requests_ok"] = ok[0]
+        rep.counts["sheds_typed"] = sheds[0]
+        rep.counts["errors_untyped"] = len(untyped)
+        rep.counts["nodes_killed"] = len(
+            [e for e in inj if e["action"] == "kill_node"])
+        rep.counts["replicas_live"] = len(dep.replicas)
+        rep.counts["replicas_on_dead_nodes"] = len(
+            [r for r in dep.replicas if r.node not in live])
+        rep.counts["scaled_up"] = int(len(dep.replicas) >= 2)
+        rep.ok = (not untyped
+                  and rep.counts["nodes_killed"] >= 1
+                  and rep.counts["replicas_on_dead_nodes"] == 0
+                  and rep.counts["scaled_up"] == 1
+                  and ok[0] > 0)
+        if untyped:
+            rep.notes.append(
+                f"{len(untyped)} UNTYPED client errors (first: "
+                f"{untyped[0]!r}) — overload must shed Overloaded, "
+                "never route to a warming/dead replica")
+        if before and placed_nodes and before & placed_nodes == set():
+            rep.notes.append("original replica moved unexpectedly")
+    finally:
+        if cs is not None:
+            cs.close()
+        pool.close(close_nodes=True)
+
+
 def _scenario_train_cluster(chaos: ChaosController,
                             rep: SurvivalReport) -> None:
     """The distributed-training acceptance run: a dp job (grain=4
@@ -611,6 +731,7 @@ SCENARIOS: Dict[str, Callable[[ChaosController, SurvivalReport], None]] = {
     "decode-migrate": _scenario_decode_migrate,
     "router-chaos": _scenario_router,
     "train-cluster": _scenario_train_cluster,
+    "scale-under-kill": _scenario_scale_kill,
 }
 
 

@@ -29,8 +29,8 @@ CONFIGS = ("gemm", "timing_check", "conv_sweep", "allreduce",
            "flash_autotune", "autotune_decode_pages", "flash_sparse",
            "detection_train", "detection_infer", "pointpillars_infer",
            "speech_train", "serve_bench", "decode_bench",
-           "decode_scenarios", "cluster_bench", "train_bench",
-           "kernel_matrix", "analysis")
+           "decode_scenarios", "cluster_bench", "control_bench",
+           "train_bench", "kernel_matrix", "analysis")
 
 
 def make_flags() -> FlagSet:
@@ -1072,6 +1072,20 @@ def run_cluster_bench(fs: FlagSet) -> List[Any]:
     return rows
 
 
+def run_control_bench(fs: FlagSet) -> List[Any]:
+    """Control-plane microbench as a capture-harness leg: the open-loop
+    diurnal 1x->8x->1x scenario with the closed autoscaling loop, SLO
+    admission (priority classes, typed sheds), router-tier scaling, and
+    warm-before-traffic scale-up live (see
+    :func:`tosem_tpu.serve.bench_cluster.run_control_benchmarks`). Rows
+    land under the ``control_bench`` config."""
+    from tosem_tpu.serve.bench_cluster import run_control_benchmarks
+    rows = run_control_benchmarks(trials=1, min_s=0.4)
+    for r in rows:
+        r.config = "control_bench"
+    return rows
+
+
 def run_train_bench(fs: FlagSet) -> List[Any]:
     """Distributed-training microbench as a capture-harness leg: the
     bucketed-overlap vs serialized all-reduce A/B on the paced-wire
@@ -1177,6 +1191,7 @@ RUNNERS = {
     "decode_bench": run_decode_bench,
     "decode_scenarios": run_decode_scenarios,
     "cluster_bench": run_cluster_bench,
+    "control_bench": run_control_bench,
     "train_bench": run_train_bench,
     "kernel_matrix": run_kernel_matrix,
     "analysis": run_analysis,

@@ -5,16 +5,20 @@ load metrics from the GCS, `resource_demand_scheduler` converts backlog
 into node launches, idle nodes terminate after a timeout). Single-host
 TPU translation: the "nodes" are runtime worker processes, demand is the
 scheduler's pending+inflight backlog from ``rt.stats()``, and scaling
-calls ``rt.add_worker()`` / ``rt.remove_idle_worker()``. Deterministic
+calls ``rt.add_worker()`` / ``rt.remove_idle_worker()``. The scaling
+*law* (backlog threshold, launch-ahead step-up, idle-tick hysteresis)
+is the shared :class:`tosem_tpu.control.policy.PolicyCore` in
+``backlog`` mode — this module is the worker-pool adapter over it, with
+semantics unchanged from the pre-dedup implementation. Deterministic
 ``tick()`` (no background thread by default) keeps tests exact; a
 ``run()`` loop provides the monitor-daemon behavior.
 """
 from __future__ import annotations
 
-import threading
-import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional
+
+from tosem_tpu.control.policy import PolicyCore, ScalePolicy, ScalerLoop
 
 
 @dataclass
@@ -27,63 +31,57 @@ class AutoscalerConfig:
     idle_ticks_before_downscale: int = 3
     max_scale_up_per_tick: int = 2
 
+    def to_policy(self) -> ScalePolicy:
+        """The shared-core translation (backlog mode: launch-ahead
+        step-up, down-scale only on a completely idle backlog)."""
+        return ScalePolicy(
+            min_units=self.min_workers, max_units=self.max_workers,
+            target_per_unit=self.backlog_per_worker,
+            idle_ticks_before_downscale=self.idle_ticks_before_downscale,
+            max_up_per_tick=self.max_scale_up_per_tick, mode="backlog")
 
-class Autoscaler:
+
+class Autoscaler(ScalerLoop):
+    thread_name = "tosem-autoscaler"
+
     def __init__(self, config: Optional[AutoscalerConfig] = None, *,
                  stats_fn: Optional[Callable[[], Dict[str, int]]] = None,
                  add_fn: Optional[Callable[[], int]] = None,
                  remove_fn: Optional[Callable[[], bool]] = None):
         import tosem_tpu.runtime as rt
+        super().__init__()
         self.cfg = config if config is not None else AutoscalerConfig()
+        self._core = PolicyCore(self.cfg.to_policy())
         self._stats = stats_fn or rt.stats
         self._add = add_fn or rt.add_worker
         self._remove = remove_fn or rt.remove_idle_worker
-        self._idle_ticks = 0
         self.history: List[Dict[str, int]] = []
-        self._stop = threading.Event()
-        self._thread: Optional[threading.Thread] = None
+
+    def _on_tick_error(self, e: BaseException) -> None:
+        pass  # a dead runtime must not crash (or spam) the monitor
 
     def tick(self) -> Dict[str, int]:
-        """One monitor round: read demand, scale, record the decision."""
+        """One monitor round: read demand, scale, record the decision.
+        The policy is rebuilt when ``self.cfg`` changed — the pre-dedup
+        tick read the config fields live every round."""
+        policy = self.cfg.to_policy()
+        if self._core.policy != policy:
+            self._core = PolicyCore(policy)
         s = self._stats()
         workers = s["num_workers"]
         # dispatchable demand only — dep-blocked/actor-bound pending work
         # can't drain onto added task workers (falls back to raw pending
         # for stats sources that don't report readiness)
         backlog = s.get("pending_ready", s["pending"]) + s["inflight"]
+        want = self._core.decide(workers, backlog)
         added = removed = 0
-        if backlog > self.cfg.backlog_per_worker * workers:
-            self._idle_ticks = 0
-            want = min(self.cfg.max_workers - workers,
-                       self.cfg.max_scale_up_per_tick)
-            for _ in range(max(want, 0)):
+        if want > workers:
+            for _ in range(want - workers):
                 self._add()
                 added += 1
-        elif backlog == 0 and workers > self.cfg.min_workers:
-            self._idle_ticks += 1
-            if self._idle_ticks >= self.cfg.idle_ticks_before_downscale:
-                if self._remove():
-                    removed = 1
-                self._idle_ticks = 0
-        else:
-            self._idle_ticks = 0
+        elif want < workers:
+            if self._remove():
+                removed = 1
         decision = {**s, "added": added, "removed": removed}
         self.history.append(decision)
         return decision
-
-    def run(self, interval: float = 1.0) -> None:
-        """Background monitor loop (the autoscaler daemon role)."""
-        def loop():
-            while not self._stop.wait(interval):
-                try:
-                    self.tick()
-                except Exception:
-                    pass  # a dead runtime must not crash the monitor
-        self._thread = threading.Thread(target=loop, daemon=True,
-                                        name="tosem-autoscaler")
-        self._thread.start()
-
-    def stop(self) -> None:
-        self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=2.0)

@@ -49,6 +49,19 @@ class Metric:
                              f"{self.label_names}, got {vals}")
         return vals
 
+    def remove(self, labels: Sequence[str] = ()) -> bool:
+        """Drop one label series entirely (True if it existed). The
+        departed-label discipline: a gauge row for a node/replica that
+        left the pool must DISAPPEAR from the exposition — a permanent
+        zero row reads as a live-but-idle label set forever."""
+        k = self._key(labels)
+        with self._lock:
+            return self._series.pop(k, None) is not None
+
+    def labelsets(self) -> List[Tuple[str, ...]]:
+        with self._lock:
+            return sorted(self._series)
+
     def collect(self) -> List[str]:
         out = [f"# HELP {self.name} {self.description}",
                f"# TYPE {self.name} {self.kind}"]
@@ -112,6 +125,14 @@ class Histogram(Metric):
                     counts[i] += 1
                     break
             self._sums[k] = self._sums.get(k, 0.0) + value
+
+    def remove(self, labels: Sequence[str] = ()) -> bool:
+        k = self._key(labels)
+        with self._lock:
+            existed = self._counts.pop(k, None) is not None
+            self._sums.pop(k, None)
+            self._series.pop(k, None)
+            return existed
 
     def collect(self) -> List[str]:
         out = [f"# HELP {self.name} {self.description}",
@@ -320,6 +341,16 @@ def cluster_serve_metrics(registry: Optional[Registry] = None
     - ``serve_replicas_placed`` (gauge, labels deployment/node):
       replicas currently placed per (deployment, node) — failover
       visibly moves this mass off a dead node.
+    - ``serve_admission_shed_total`` (counter, labels deployment/
+      class/reason): requests rejected typed (``Overloaded``) by the
+      SLO admission check — per priority class, split by shed reason
+      (``est_wait`` = estimated wait over budget at arrival,
+      ``slot_timeout`` = no dispatch slot freed within the budget).
+
+    Departed label sets are REMOVED from the gauges (``Metric.remove``),
+    never pinned at zero: a dead node's queue-depth row disappearing is
+    the honest signal; a permanent zero row is indistinguishable from a
+    live idle node.
     """
     reg = registry or DEFAULT
     return {
@@ -339,6 +370,42 @@ def cluster_serve_metrics(registry: Optional[Registry] = None
             "serve_replicas_placed",
             "replicas currently placed per deployment and node",
             labels=("deployment", "node")),
+        "admission_shed": reg.counter(
+            "serve_admission_shed_total",
+            "requests shed typed (Overloaded) by SLO admission, "
+            "per priority class and shed reason",
+            labels=("deployment", "class", "reason")),
+    }
+
+
+def control_plane_metrics(registry: Optional[Registry] = None
+                          ) -> Dict[str, Metric]:
+    """The closed-loop controller's instruments, fed by
+    :class:`~tosem_tpu.control.plane.ControlPlane`:
+
+    - ``control_demand`` (gauge, labels deployment): the folded demand
+      signal (router depth rollup + admission queues) each tick decided
+      on — graphing this against ``serve_replicas_placed`` shows the
+      loop actually closing.
+    - ``control_scale_events_total`` (counter, labels kind/name/
+      direction): applied scale decisions (``deployment`` replicas or
+      the ``router`` tier, ``up``/``down``).
+    - ``control_model_evictions_total`` (counter): cold model
+      executables evicted from node ledgers under memory pressure.
+    """
+    reg = registry or DEFAULT
+    return {
+        "demand": reg.gauge(
+            "control_demand",
+            "per-deployment demand signal the control loop decided on",
+            labels=("deployment",)),
+        "scale_events": reg.counter(
+            "control_scale_events_total",
+            "applied autoscale decisions by kind and direction",
+            labels=("kind", "name", "direction")),
+        "model_evictions": reg.counter(
+            "control_model_evictions_total",
+            "cold model executables evicted under memory pressure"),
     }
 
 

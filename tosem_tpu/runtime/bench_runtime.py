@@ -482,6 +482,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "replicas behind the router tier vs the single-"
                         "process data plane, plus the node-kill "
                         "failover leg")
+    p.add_argument("--control", action="store_true",
+                   help="run the control-plane benches "
+                        "(serve/bench_cluster.py diurnal scenario) "
+                        "instead — open-loop 1x->8x->1x ramp with the "
+                        "closed autoscaling loop, SLO admission, and "
+                        "warm-before-traffic scale-up live")
     p.add_argument("--sparse", action="store_true",
                    help="run the block-sparse attention benches "
                         "(ops/bench_sparse.py) instead — t8192 "
@@ -524,6 +530,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     elif args.cluster:
         from tosem_tpu.serve.bench_cluster import GATED_CLUSTER_BENCHES
         gated = GATED_CLUSTER_BENCHES
+    elif args.control:
+        from tosem_tpu.serve.bench_cluster import GATED_CONTROL_BENCHES
+        gated = GATED_CONTROL_BENCHES
     elif args.sparse:
         from tosem_tpu.ops.bench_sparse import GATED_SPARSE_BENCHES
         gated = GATED_SPARSE_BENCHES
@@ -566,6 +575,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     elif args.cluster:
         from tosem_tpu.serve.bench_cluster import run_cluster_benchmarks
         rows = run_cluster_benchmarks(trials=args.trials,
+                                      min_s=args.min_s,
+                                      quiet=args.quiet, only=only)
+    elif args.control:
+        from tosem_tpu.serve.bench_cluster import run_control_benchmarks
+        rows = run_control_benchmarks(trials=args.trials,
                                       min_s=args.min_s,
                                       quiet=args.quiet, only=only)
     elif args.sparse:

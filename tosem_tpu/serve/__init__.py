@@ -9,6 +9,7 @@ coalesces concurrent requests into padding-bucketed batches on the flash
 kernels, behind a deploy-time-warmed compiled-program cache
 (:mod:`tosem_tpu.serve.compile_cache`).
 """
+from tosem_tpu.control.admission import Overloaded, SLOConfig
 from tosem_tpu.serve.autoscale import ServeAutoscaler, ServeScaleConfig
 from tosem_tpu.serve.backends import BertEncodeBackend
 from tosem_tpu.serve.batching import (BatchedFuture, BatchingReplica,
@@ -33,7 +34,8 @@ __all__ = [
     "ClusterServe", "ClusterDeployment", "ClusterHandle",
     "PlacementError", "RouterCore", "RouterPolicy", "RemoteRouter",
     "NoReplicaAvailable", "ReplicaAppError",
-    "CircuitBreaker", "CircuitOpen",
+    "CircuitBreaker", "CircuitOpen", "Overloaded", "SLOConfig",
+    "ServeAutoscaler", "ServeScaleConfig",
     "BatchPolicy", "BatchQueue", "BatchedFuture", "BatchingReplica",
     "CompileCache", "DEFAULT_COMPILE_CACHE",
     "BertEncodeBackend", "SpeechBatchBackend",

@@ -3,10 +3,12 @@
 Serve's controller scales replica counts from queue-length metrics
 (`python/ray/serve/autoscaling_policy.py` — target in-flight requests
 per replica with upper/lower bounds). Same policy here over
-:meth:`Deployment.load`: scale up when in-flight demand exceeds
-``target_inflight_per_replica`` × replicas, scale down after sustained
-idleness. Deterministic ``tick()`` for tests; ``run()`` for the
-controller-loop behavior.
+:meth:`Deployment.load` — since the control-plane PR the policy *law*
+itself (target backlog, idle-tick hysteresis, bounded step-up) lives
+once in :class:`tosem_tpu.control.policy.PolicyCore`; this module is
+the thin Serve adapter over it. Deterministic ``tick()`` for tests;
+``run()`` for the controller-loop behavior — both unchanged in
+semantics from the pre-dedup implementation.
 
 With micro-batching enabled, ``load()`` counts LOGICAL requests —
 queued-in-the-batch-queue plus in-flight, a 16-request batch weighing
@@ -17,11 +19,10 @@ depth of that queue.
 from __future__ import annotations
 
 import collections
-import math
-import threading
 from dataclasses import dataclass
 from typing import Deque, Dict, Optional
 
+from tosem_tpu.control.policy import PolicyCore, ScalePolicy, ScalerLoop
 from tosem_tpu.serve.core import Serve
 
 
@@ -33,48 +34,50 @@ class ServeScaleConfig:
     idle_ticks_before_downscale: int = 3
     max_up_per_tick: int = 2
 
+    def to_policy(self) -> ScalePolicy:
+        """The shared-core translation (proportional mode: trickle
+        traffic below target still scales down toward desired)."""
+        return ScalePolicy(
+            min_units=self.min_replicas, max_units=self.max_replicas,
+            target_per_unit=self.target_inflight_per_replica,
+            idle_ticks_before_downscale=self.idle_ticks_before_downscale,
+            max_up_per_tick=self.max_up_per_tick, mode="proportional")
 
-class ServeAutoscaler:
+
+class ServeAutoscaler(ScalerLoop):
+    thread_name = "serve-autoscaler"
+
     def __init__(self, serve: Serve,
                  configs: Optional[Dict[str, ServeScaleConfig]] = None,
                  default: Optional[ServeScaleConfig] = None):
+        super().__init__()
         self.serve = serve
         self.configs = dict(configs or {})
         self.default = default or ServeScaleConfig()
-        self._low: Dict[str, int] = {}      # consecutive want-lower ticks
+        self._cores: Dict[str, PolicyCore] = {}
         self.history: Deque[Dict[str, int]] = collections.deque(
             maxlen=1000)                    # bounded: run() is long-lived
-        self._stop = threading.Event()
-        self._thread: Optional[threading.Thread] = None
 
     def _cfg(self, name: str) -> ServeScaleConfig:
         return self.configs.get(name, self.default)
 
+    def _core(self, name: str) -> PolicyCore:
+        """Rebuilt when the deployment's config changed — the pre-dedup
+        tick() re-read configs every round, so a live edit of
+        ``self.configs`` must keep taking effect (rebuilding resets the
+        idle-tick hysteresis, which a changed policy invalidates)."""
+        policy = self._cfg(name).to_policy()
+        core = self._cores.get(name)
+        if core is None or core.policy != policy:
+            core = self._cores[name] = PolicyCore(policy)
+        return core
+
     def tick(self) -> list:
         decisions = []
         for name, dep in self.serve.deployments().items():
-            cfg = self._cfg(name)
             load = dep.load()
             n = dep.num_replicas
-            # target replica count from demand (the autoscaling_policy
-            # shape): enough replicas for target in-flight each
-            desired = max(cfg.min_replicas,
-                          min(cfg.max_replicas, math.ceil(
-                              load / cfg.target_inflight_per_replica)))
-            want = n
-            if desired > n:
-                self._low[name] = 0
-                want = min(n + cfg.max_up_per_tick, desired)
-            elif desired < n:
-                # hysteresis: shrink one step only after the demand has
-                # stayed below the current size for consecutive ticks —
-                # a trickle of traffic still scales down toward desired
-                self._low[name] = self._low.get(name, 0) + 1
-                if self._low[name] >= cfg.idle_ticks_before_downscale:
-                    want = n - 1
-                    self._low[name] = 0
-            else:
-                self._low[name] = 0
+            want = self._core(name).decide(n, load)
             if want != n:
                 dep.scale(want)
             d = {"deployment": name, "load": load, "replicas": n,
@@ -82,28 +85,3 @@ class ServeAutoscaler:
             decisions.append(d)
             self.history.append(d)
         return decisions
-
-    def run(self, interval: float = 1.0) -> None:
-        def loop():
-            import sys
-            warned = set()
-            while not self._stop.wait(interval):
-                try:
-                    self.tick()
-                except Exception as e:
-                    # keep the controller alive through teardown races,
-                    # but surface genuine bugs once per error type —
-                    # silently-disabled autoscaling is invisible
-                    key = type(e).__name__
-                    if key not in warned:
-                        warned.add(key)
-                        print(f"[serve-autoscaler] tick failed: {e!r}",
-                              file=sys.stderr)
-        self._thread = threading.Thread(target=loop, daemon=True,
-                                        name="serve-autoscaler")
-        self._thread.start()
-
-    def stop(self) -> None:
-        self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=2.0)

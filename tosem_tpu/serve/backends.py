@@ -71,7 +71,20 @@ class CompiledBackendMixin:
     def warmup(self, shapes: Sequence[int]) -> Dict[str, Any]:
         """Pre-compile one program per declared bucket (``shapes`` is
         the pad-target palette). Called by ``Serve.deploy(
-        warmup_shapes=…)`` on every replica before serving starts."""
+        warmup_shapes=…)`` on every replica before serving starts.
+        In a DEDICATED replica process (``serve_replica`` sets
+        ``TOSEM_REPLICA_PROCESS``) the warmed model is PINNED in the
+        process cache: under a bounded cache
+        (``TOSEM_COMPILE_CACHE_BUDGET``) eviction skips models a
+        serving backend depends on, and the pin's process lifetime IS
+        the replica's lifetime. Shared processes (driver, actor
+        workers) never pin — nothing unpins on deployment churn there,
+        so a pin would defeat the budget forever; plain LRU already
+        protects their hot models."""
+        import os
+        if os.environ.get("TOSEM_REPLICA_PROCESS"):
+            DEFAULT_COMPILE_CACHE.pin(self._tag,
+                                      owner=f"backend-{id(self)}")
         for pad_to in shapes:
             self._compiled(int(pad_to))
         return {"warmed": len(list(shapes)),

@@ -36,7 +36,7 @@ import os
 import tempfile
 import threading
 import time
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from tosem_tpu.serve.bench_common import (SuiteEmitter, closed_loop,
                                           paired_loop)
@@ -64,7 +64,17 @@ CLUSTER_SCENARIOS = {
                 "cluster_drain_errors"),
 }
 
+# ``cli microbench --control`` — the closed-loop diurnal/burst scenario
+# (tosem_tpu/control/ acceptance leg), gated against
+# results/bench_control.json in ci.sh --perf
+GATED_CONTROL_BENCHES = (
+    "control_steady_p99_ms", "control_steady_sheds",
+    "control_burst_scaleup", "control_replica_convergence",
+    "control_cold_serves",
+)
+
 DEFAULT_BASELINE = "results/bench_cluster.json"
+DEFAULT_CONTROL_BASELINE = "results/bench_control.json"
 
 BACKEND_REF = "tosem_tpu.serve.bench_serve:VectorWorkBackend"
 BACKEND_KW = {"n": 256}
@@ -497,6 +507,314 @@ def _cluster_decode_benchmarks(em: SuiteEmitter, trials: int,
                 serve.delete(name)
             except Exception:
                 pass
+
+
+class ControlLoadBackend:
+    """Fixed-service-time backend with warm/cold accounting — the
+    control-plane bench's unit of work. ``warmup()`` simulates the AOT
+    executable build (``compile_s``); a call served BEFORE warmup
+    counts a ``cold_serve`` and pays the build inline — exactly the
+    tail latency the warm-before-traffic contract must make impossible.
+    ``stats()`` rides the replica's stats RPC so the bench can assert
+    zero cold serves across every replica autoscaling ever placed."""
+
+    def __init__(self, delay_s: float = 0.02, compile_s: float = 0.25):
+        self._delay_s = float(delay_s)
+        self._compile_s = float(compile_s)
+        self._warmed = False
+        self._cold_serves = 0
+        self._lock = threading.Lock()
+
+    def warmup(self, shapes):
+        time.sleep(self._compile_s)
+        with self._lock:
+            self._warmed = True
+        return {"warmed": len(shapes)}
+
+    def call(self, request):
+        with self._lock:
+            cold = not self._warmed
+            if cold:
+                self._cold_serves += 1
+                self._warmed = True        # the JIT memoizes either way
+        if cold:
+            time.sleep(self._compile_s)
+        time.sleep(self._delay_s)
+        return {"x": request.get("x", 0)}
+
+    def stats(self):
+        with self._lock:
+            return {"cold_serves": self._cold_serves,
+                    "warmed": self._warmed}
+
+
+def _open_loop(call, rate_hz: float, duration_s: float,
+               start_index: int = 0, workers: int = 48):
+    """Open-loop load: requests fire on the offered-rate schedule
+    whether or not earlier ones completed (closed-loop fleets
+    self-throttle under overload — useless for proving admission).
+    Latency is measured from the request's SCHEDULED time, so client-
+    side queueing counts against the system like it does for a user.
+    Returns (samples, errors): samples are ``(sched_offset_s,
+    latency_s, outcome)`` with outcome ``ok`` | ``shed``."""
+    import queue
+
+    from tosem_tpu.control.admission import Overloaded
+
+    q: "queue.Queue" = queue.Queue()
+    samples: List[tuple] = []
+    errors: List[BaseException] = []
+    lock = threading.Lock()
+    start = time.perf_counter() + 0.05
+
+    def worker():
+        while True:
+            item = q.get()
+            if item is None:
+                return
+            sched, i = item
+            klass = "decode" if i % 2 else "bulk"
+            try:
+                call({"x": i}, klass=klass)
+                out = "ok"
+            except Overloaded:
+                out = "shed"
+            except BaseException as e:  # pragma: no cover - asserted 0
+                out = "error"
+                with lock:
+                    errors.append(e)
+            dt = time.perf_counter() - sched
+            with lock:
+                samples.append((sched - start, dt, out))
+
+    threads = [threading.Thread(target=worker) for _ in range(workers)]
+    for t in threads:
+        t.start()
+    n = int(rate_hz * duration_s)
+    for i in range(n):
+        sched = start + i / rate_hz
+        now = time.perf_counter()
+        if sched > now:
+            time.sleep(sched - now)
+        q.put((sched, start_index + i))
+    for _ in threads:
+        q.put(None)
+    for t in threads:
+        t.join()
+    return samples, errors
+
+
+def _p99(samples: List[tuple], after_s: float = 0.0) -> float:
+    lat = sorted(dt for off, dt, out in samples
+                 if out == "ok" and off >= after_s)
+    if not lat:
+        return float("nan")
+    return lat[min(int(len(lat) * 0.99), len(lat) - 1)]
+
+
+def _sheds(samples: List[tuple], after_s: float = 0.0) -> int:
+    return sum(1 for off, _, out in samples
+               if out == "shed" and off >= after_s)
+
+
+def run_control_benchmarks(trials: int = 1, min_s: float = 0.5,
+                           quiet: bool = False,
+                           only: Optional[set] = None) -> List[ResultRow]:
+    """The control-plane acceptance scenario: an open-loop diurnal
+    1×→8×→1× ramp over a 2-node cluster with the FULL closed loop live
+    — :class:`~tosem_tpu.control.plane.ControlPlane` scaling the
+    deployment's replicas AND the router tier from the queue-depth
+    rollup, SLO admission with decode/bulk priority classes, and
+    affinity-scored placement over a model ledger.
+
+    Deterministic acceptance criteria are hard asserts; the gated rows
+    track them release over release:
+
+    - zero UNTYPED client errors anywhere (sheds are typed);
+    - zero sheds at steady state (burst-shoulder sheds allowed);
+    - steady-state p99 under the deployment's latency budget;
+    - the burst scales replicas up (>= 2) and both replica count and
+      router-tier count RETURN TO BASELINE within the scale-down
+      window;
+    - zero cold-compile serves on every replica ever placed (scale-up
+      warms before the routing table sees the replica)."""
+    import statistics as _stats
+
+    from tosem_tpu.cluster.node import RemoteNode
+    from tosem_tpu.cluster.rpc import RpcClient
+    from tosem_tpu.cluster.supervisor import NodePool
+    from tosem_tpu.control import (ControlPlane, ModelLedger,
+                                   PlacementScorer, ScalePolicy)
+    from tosem_tpu.control.admission import SLOConfig
+    from tosem_tpu.serve.cluster_serve import ClusterServe
+
+    em = SuiteEmitter("control", only)
+    if not any(em.want(b) for b in GATED_CONTROL_BENCHES):
+        return em.flush(quiet)
+
+    slo = SLOConfig(latency_budget_s=0.5, est_service_s=0.02,
+                    target_inflight_per_replica=8,
+                    classes={"decode": 10, "bulk": 0}, aging_s=0.2)
+    r0 = 24.0                      # steady offered load, req/s
+    burst = 8 * r0                 # the 8x diurnal peak
+    steady_s, burst_s, settle_s = 2.0, 2.5, 6.0
+
+    pool = NodePool(miss_threshold=2, probe_timeout=3.0)
+    cs = None
+    plane = None
+    try:
+        for i in range(2):
+            pool.add_node(RemoteNode.spawn_local(num_workers=4),
+                          name=f"n{i}")
+        cs = ClusterServe(
+            pool, num_routers=1, router_procs=False,
+            placement_scorer=PlacementScorer(ModelLedger(
+                budget_per_node=4.0)))
+        dep = cs.deploy(
+            "diurnal", "tosem_tpu.serve.bench_cluster:ControlLoadBackend",
+            num_replicas=1, strategy="pack",
+            init_kwargs={"delay_s": 0.02, "compile_s": 0.25},
+            warmup_shapes=[1], slo=slo)
+        plane = ControlPlane(
+            cs,
+            deployments={"diurnal": ScalePolicy(
+                min_units=1, max_units=4, target_per_unit=1.0,
+                idle_ticks_before_downscale=3, max_up_per_tick=2)},
+            router_policy=ScalePolicy(
+                min_units=1, max_units=2, target_per_unit=4.0,
+                idle_ticks_before_downscale=3, max_up_per_tick=1))
+        h = cs.get_handle("diurnal")
+        h.call({"x": 0}, klass="decode")      # end-to-end warm
+        plane.run(interval=0.1)
+
+        p99s, rounds_extra = [], {}
+        scaleups, cold_totals = [], []
+        shed_rounds, conv_rounds = [], []
+        for _round in range(max(trials, 1)):
+            cold_by_rid: Dict[str, int] = {}
+
+            def harvest_cold():
+                with cs._lock:
+                    reps = list(dep.replicas)
+                for r in reps:
+                    try:
+                        with RpcClient(r.address) as cli:
+                            st = cli.call("stats")
+                        cold_by_rid[r.replica_id] = int(
+                            st.get("cold_serves", 0))
+                    except Exception:
+                        pass
+
+            a_samples, a_err = _open_loop(h.call, r0, steady_s)
+            max_reps = [len(dep.replicas)]
+            max_routers = [cs.num_routers()]
+
+            def watch():
+                while not watch_stop.is_set():
+                    max_reps[0] = max(max_reps[0], len(dep.replicas))
+                    max_routers[0] = max(max_routers[0],
+                                         cs.num_routers())
+                    watch_stop.wait(0.05)
+
+            watch_stop = threading.Event()
+            wt = threading.Thread(target=watch)
+            wt.start()
+            b_samples, b_err = _open_loop(h.call, burst, burst_s,
+                                          start_index=10_000)
+            harvest_cold()         # replicas the burst placed, pre-drain
+            c_samples, c_err = _open_loop(h.call, r0, settle_s,
+                                          start_index=50_000)
+            watch_stop.set()
+            wt.join()
+            harvest_cold()
+            errors = a_err + b_err + c_err
+            if errors:
+                raise RuntimeError(
+                    f"{len(errors)} UNTYPED client errors across the "
+                    f"diurnal ramp (first: {errors[0]!r}) — overload "
+                    "must shed typed, never fail raw")
+            # steady state = phase A after warm shoulder + the tail of
+            # phase C (after the scale-down window)
+            steady_sheds = (_sheds(a_samples, after_s=0.3)
+                            + _sheds(c_samples, after_s=settle_s / 2))
+            if steady_sheds:
+                raise RuntimeError(
+                    f"{steady_sheds} requests shed at STEADY state — "
+                    "admission must only shed into the burst shoulder")
+            p99 = max(_p99(a_samples, after_s=0.3),
+                      _p99(c_samples, after_s=settle_s / 2))
+            if not p99 < slo.latency_budget_s:
+                raise RuntimeError(
+                    f"steady-state p99 {p99 * 1e3:.0f}ms breaches the "
+                    f"{slo.latency_budget_s * 1e3:.0f}ms budget")
+            if max_reps[0] < 2:
+                raise RuntimeError(
+                    f"the 8x burst never scaled up (max replicas "
+                    f"{max_reps[0]}) — the loop is open, not closed")
+            # convergence: both axes back at baseline
+            deadline = time.perf_counter() + 6.0
+            while time.perf_counter() < deadline and (
+                    len(dep.replicas) > 1 or cs.num_routers() > 1):
+                time.sleep(0.1)
+            converged = (len(dep.replicas) == 1
+                         and cs.num_routers() == 1)
+            if not converged:
+                raise RuntimeError(
+                    f"no post-burst convergence: replicas="
+                    f"{len(dep.replicas)} routers={cs.num_routers()} "
+                    "(baseline is 1/1)")
+            cold = sum(cold_by_rid.values())
+            if cold:
+                raise RuntimeError(
+                    f"{cold} cold-compile serves ({cold_by_rid}) — "
+                    "scale-up must warm BEFORE the routing table sees "
+                    "a replica")
+            p99s.append(p99 * 1e3)
+            scaleups.append(float(max_reps[0]))
+            cold_totals.append(float(cold))
+            # measured values (provably 0 / 1.0 past the hard asserts
+            # above — recorded as measurements, not constants, so the
+            # rows' provenance stays honest)
+            shed_rounds.append(float(steady_sheds))
+            conv_rounds.append(float(converged))
+            rounds_extra = {
+                "burst_sheds": _sheds(b_samples) + _sheds(
+                    c_samples, after_s=0.0) - _sheds(
+                    c_samples, after_s=settle_s / 2),
+                "max_routers": max_routers[0],
+                "steady_rate_hz": r0, "burst_rate_hz": burst,
+                "steady_p50_ms": round(_stats.median(
+                    dt for _, dt, out in a_samples
+                    if out == "ok") * 1e3, 2),
+                "cold_by_replica": cold_by_rid,
+                "scale_history": [
+                    d for d in list(plane.history)[-40:]
+                    if d.get("replicas") != d.get("new_replicas")],
+            }
+        row = em.emit("control_steady_p99_ms",
+                      "diurnal scenario steady-state p99 latency",
+                      p99s, unit="ms", lower_is_better=True)
+        if row is not None:
+            row.extra.update(rounds_extra)
+        em.emit("control_steady_sheds",
+                "typed sheds at steady state (must be zero)",
+                shed_rounds, unit="errors")
+        em.emit("control_burst_scaleup",
+                "peak replica count reached during the 8x burst",
+                scaleups, unit="replicas")
+        em.emit("control_replica_convergence",
+                "replica+router counts returned to baseline post-burst",
+                conv_rounds, unit="bool")
+        em.emit("control_cold_serves",
+                "cold-compile serves across every replica placed",
+                cold_totals, unit="errors")
+    finally:
+        if plane is not None:
+            plane.stop()
+        if cs is not None:
+            cs.close()
+        pool.close(close_nodes=True)
+    return em.flush(quiet)
 
 
 def _cluster_drain_benchmarks(em: SuiteEmitter, trials: int,

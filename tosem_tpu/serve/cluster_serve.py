@@ -44,6 +44,7 @@ from __future__ import annotations
 import contextlib
 import itertools
 import json
+import re
 import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
@@ -51,6 +52,7 @@ from tosem_tpu.chaos import hooks as _chaos
 from tosem_tpu.cluster.gang import GangReservation, _plan, reserve_gang
 from tosem_tpu.cluster.node import RemoteNode
 from tosem_tpu.cluster.supervisor import NodePool
+from tosem_tpu.control.admission import Overloaded, SLOConfig
 from tosem_tpu.serve.breaker import CircuitOpen
 from tosem_tpu.serve.router import (NoReplicaAvailable, RemoteRouter,
                                     ReplicaAppError, RouterCore,
@@ -87,7 +89,8 @@ class ClusterDeployment:
     def __init__(self, name: str, backend_ref: str,
                  init_kwargs: Dict[str, Any], num_replicas: int,
                  strategy: str, sharding: Optional[Tuple[int, int]],
-                 warmup_shapes: Optional[Sequence] = None):
+                 warmup_shapes: Optional[Sequence] = None,
+                 slo: Optional[SLOConfig] = None):
         self.name = name
         self.backend_ref = backend_ref
         self.init_kwargs = dict(init_kwargs)
@@ -95,6 +98,7 @@ class ClusterDeployment:
         self.strategy = strategy
         self.sharding = tuple(sharding) if sharding else None
         self.warmup_shapes = list(warmup_shapes or [])
+        self.slo = slo
         self.replicas: List[ClusterReplica] = []
 
     @property
@@ -110,7 +114,8 @@ class ClusterDeployment:
                 "num_replicas": self.num_replicas,
                 "strategy": self.strategy,
                 "sharding": list(self.sharding) if self.sharding else None,
-                "warmup_shapes": self.warmup_shapes}
+                "warmup_shapes": self.warmup_shapes,
+                "slo": self.slo.to_dict() if self.slo else None}
 
     @classmethod
     def from_spec(cls, spec: Dict[str, Any]) -> "ClusterDeployment":
@@ -118,7 +123,9 @@ class ClusterDeployment:
                    json.loads(spec.get("init_kwargs") or "{}"),
                    int(spec["num_replicas"]), spec.get("strategy", "spread"),
                    tuple(spec["sharding"]) if spec.get("sharding") else None,
-                   spec.get("warmup_shapes") or [])
+                   spec.get("warmup_shapes") or [],
+                   slo=(SLOConfig.from_dict(spec["slo"])
+                        if spec.get("slo") else None))
 
 
 def plan_replicas(capacities: Dict[str, int], num_replicas: int,
@@ -156,11 +163,15 @@ class ClusterHandle:
         self._rr = itertools.count()
 
     def call(self, request: Any, timeout: Optional[float] = None,
-             key: Optional[str] = None) -> Any:
+             key: Optional[str] = None,
+             klass: Optional[str] = None) -> Any:
         """Route one request. ``timeout`` is accepted for interface
         parity with :class:`~tosem_tpu.serve.core.Handle` but bounds
         nothing here: the RPC layer fails fast on dead peers (the only
-        unbounded wait is a healthy backend legitimately computing)."""
+        unbounded wait is a healthy backend legitimately computing).
+        ``klass`` names the priority class for SLO-admitted
+        deployments (decode classes preempt bulk in the router
+        queue)."""
         self._cs._fire_route_chaos(self._name)
         routers = self._cs._routers_snapshot()
         if not routers:
@@ -170,8 +181,10 @@ class ClusterHandle:
         for k in range(len(routers)):
             router = routers[(start + k) % len(routers)]
             try:
-                return router.route(self._name, request, key=key)
-            except (NoReplicaAvailable, ReplicaAppError, CircuitOpen):
+                return router.route(self._name, request, key=key,
+                                    klass=klass)
+            except (NoReplicaAvailable, ReplicaAppError, CircuitOpen,
+                    Overloaded):
                 raise               # typed verdicts: not a router death
             except (ConnectionError, TimeoutError, OSError) as e:
                 last = e            # router gone: fail over to the next
@@ -187,6 +200,16 @@ class ClusterHandle:
         """Re-type a remote router error (the RPC layer ships
         ``repr(exc)``; prefix-match like RemoteNode._translate)."""
         msg = str(e)
+        if msg.startswith("Overloaded("):
+            # recover the retry hint the admission check computed — a
+            # typed shed without its backoff number is half a verdict.
+            # [retry_after=…] is the structural field _shed embeds for
+            # exactly this parse; the prose fallback covers Overloaded
+            # raised elsewhere
+            m = (re.search(r"\[retry_after=(\d+(?:\.\d+)?)s\]", msg)
+                 or re.search(r"estimated wait (\d+(?:\.\d+)?)s", msg))
+            return Overloaded(
+                msg, retry_after=float(m.group(1)) if m else 0.0)
         for prefix, typ in (("NoReplicaAvailable(", NoReplicaAvailable),
                             ("ReplicaAppError(", ReplicaAppError),
                             ("CircuitOpen(", CircuitOpen)):
@@ -204,7 +227,8 @@ class ClusterServe:
     def __init__(self, pool: NodePool, num_routers: int = 1,
                  router_procs: bool = True,
                  router_policy: Optional[RouterPolicy] = None,
-                 replica_startup_timeout: float = 120.0):
+                 replica_startup_timeout: float = 120.0,
+                 placement_scorer: Optional[Any] = None):
         self.pool = pool
         self._lock = threading.RLock()
         self._deployments: Dict[str, ClusterDeployment] = {}
@@ -212,6 +236,14 @@ class ClusterServe:
         self._rid_next: Dict[str, int] = {}
         self._replica_startup_timeout = replica_startup_timeout
         self._closed = False
+        # multi-model multiplexing: single-replica placements (scale-up,
+        # failover re-placement) score nodes by compile-cache / KV
+        # affinity through this scorer and its model ledger; None keeps
+        # the pre-control-plane best-capacity choice
+        self._scorer = placement_scorer
+        self._router_procs = router_procs
+        self._router_policy = router_policy
+        self._router_seq = max(1, num_routers)
         # telemetry state (guarded by self._lock in stats(): /-/stats
         # is served by a threaded HTTP server, so scrapes race)
         self._metrics: Optional[Dict[str, Any]] = None
@@ -289,11 +321,78 @@ class ClusterServe:
 
     def _warm_replica(self, dep: ClusterDeployment,
                       rep: ClusterReplica) -> None:
-        if not dep.warmup_shapes:
-            return
-        from tosem_tpu.cluster.rpc import RpcClient
-        with RpcClient(rep.address) as cli:
-            cli.call("warmup", list(dep.warmup_shapes))
+        if dep.warmup_shapes:
+            from tosem_tpu.cluster.rpc import RpcClient
+            with RpcClient(rep.address) as cli:
+                cli.call("warmup", list(dep.warmup_shapes))
+        if self._scorer is not None:
+            # the model's executable is now resident on this node: LRU-
+            # ledger it (cold models may be evicted to fit) and PIN it
+            # for this replica — eviction must skip models with traffic
+            ledger = self._scorer.ledger
+            evicted = ledger.record_warm(rep.node, dep.name)
+            ledger.pin(rep.node, dep.name, rep.replica_id)
+            if evicted:
+                from tosem_tpu.obs.metrics import control_plane_metrics
+                control_plane_metrics()["model_evictions"].inc(
+                    float(len(evicted)))
+
+    def _unpin_replica(self, dep: ClusterDeployment,
+                       rep: ClusterReplica) -> None:
+        if self._scorer is not None:
+            self._scorer.ledger.unpin(rep.node, dep.name,
+                                      rep.replica_id)
+
+    def _discard_replica(self, dep: ClusterDeployment,
+                         rep: ClusterReplica, node: Optional[RemoteNode],
+                         reason: str) -> None:
+        """Stop and release a started-but-unwanted replica (warm
+        failure, delete race) — the ONE place start-side resources
+        (process, gang, ledger pin) are unwound."""
+        self._unpin_replica(dep, rep)
+        if node is not None:
+            try:
+                node.stop_replica(rep.replica_id)
+            except Exception:
+                pass
+        if rep.gang is not None:
+            rep.gang.release()
+        self.pool.record_event("replica_removed", deployment=dep.name,
+                               replica_id=rep.replica_id, reason=reason)
+
+    def _finish_placement(self, dep: ClusterDeployment,
+                          rep: ClusterReplica,
+                          node: Optional[RemoteNode]) -> bool:
+        """Warm, then register, one just-started replica (shared by
+        scale-up and failover re-placement — the delete-races-placement
+        handshake must not exist twice). A warm failure or a delete
+        race DISCARDS the replica instead of leaking its process/gang:
+        placement is contained per replica, so a repeating failure
+        cannot bleed node slots tick over tick. True = the replica
+        entered ``dep.replicas``."""
+        try:
+            self._warm_replica(dep, rep)
+        except Exception as e:
+            self.pool.record_event("replica_lost", deployment=dep.name,
+                                   replica_id=rep.replica_id,
+                                   error=repr(e))
+            self._discard_replica(dep, rep, node, reason="warmup failed")
+            return False
+        with self._lock:
+            # a delete/failed-deploy can race this placement: if the
+            # deployment is no longer registered, the fresh replica
+            # must be torn down, not leaked as an orphan the journal
+            # records placed after deployment_deleted
+            if self._deployments.get(dep.name) is not dep:
+                registered = False
+            else:
+                dep.replicas.append(rep)
+                registered = True
+        if not registered:
+            self._discard_replica(dep, rep, node,
+                                  reason="deployment gone")
+            return False
+        return True
 
     # -- control plane -------------------------------------------------
 
@@ -301,18 +400,22 @@ class ClusterServe:
                strategy: str = "spread",
                sharding: Optional[Tuple[int, int]] = None,
                init_kwargs: Optional[Dict[str, Any]] = None,
-               warmup_shapes: Optional[Sequence] = None
+               warmup_shapes: Optional[Sequence] = None,
+               slo: Optional[SLOConfig] = None
                ) -> ClusterDeployment:
         """Place ``num_replicas`` of ``backend`` (a class or a
         ``"module:qualname"`` ref importable on the nodes) across the
         pool and route traffic to them. ``sharding=(dp, tp)`` makes
         each logical replica a dp×tp-meshed sharded program (the
-        backend receives ``dp``/``tp`` kwargs)."""
+        backend receives ``dp``/``tp`` kwargs). ``slo`` turns on
+        SLO-aware admission at every router: overload rejects typed
+        (:class:`~tosem_tpu.control.admission.Overloaded`) under the
+        declared latency budget, with priority classes."""
         ref = (backend if isinstance(backend, str)
                else f"{backend.__module__}:{backend.__qualname__}")
         dep = ClusterDeployment(name, ref, init_kwargs or {},
                                 num_replicas, strategy, sharding,
-                                warmup_shapes)
+                                warmup_shapes, slo=slo)
         with self._lock:
             if self._closed:
                 raise RuntimeError("controller is closed")
@@ -373,6 +476,209 @@ class ClusterServe:
         self.pool.record_event("deployment_deleted", deployment=name)
         self._push_table()
 
+    # -- autoscaling (the ControlPlane's actuator) ---------------------
+
+    def scale(self, name: str, num_replicas: int) -> Dict[str, Any]:
+        """Move deployment ``name`` to ``num_replicas`` (the control
+        plane's actuator).
+
+        Scale-UP places each new replica and **warms its compile cache
+        before it enters the routing table** — the router tier only
+        sees the replica after ``warmup_shapes`` compiled, so its first
+        request never pays a JIT. A node dying mid-placement (the
+        ``scale-under-kill`` chaos window) is contained per replica:
+        the warming replica never joins ``dep.replicas`` — it cannot
+        be counted as capacity or routed to — and placement retries on
+        surviving nodes.
+
+        Scale-DOWN removes the least-loaded replicas from routing
+        FIRST (typed ``NodeDrainingError``-style fail-fast: no fresh
+        traffic lands on a leaving replica), live-migrates their
+        in-flight decode sequences to survivors (PR 11's KV migration
+        — zero step-0 restarts), then stops the processes."""
+        if num_replicas < 1:
+            raise ValueError("a deployment needs at least one replica; "
+                             "use ClusterServe.delete to tear it down")
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("controller is closed")
+            dep = self._deployments.get(name)
+            if dep is None:
+                raise KeyError(f"no deployment {name!r}")
+            current = len(dep.replicas)
+        out = {"deployment": name, "from": current, "to": num_replicas,
+               "placed": 0, "removed": 0, "sequences_migrated": 0}
+        if num_replicas > current:
+            out["placed"] = self._scale_up(dep, num_replicas - current)
+        elif num_replicas < current:
+            removed, migrated = self._scale_down(
+                dep, current - num_replicas)
+            out["removed"], out["sequences_migrated"] = removed, migrated
+        with self._lock:
+            dep.num_replicas = len(dep.replicas)
+        self.pool.record_event("deployment_scaled", deployment=name,
+                               **{k: v for k, v in out.items()
+                                  if k != "deployment"})
+        return out
+
+    def _scale_up(self, dep: ClusterDeployment, count: int) -> int:
+        placed = 0
+        for _ in range(count):
+            rep = None
+            exclude: List[str] = []
+            for _attempt in range(3):
+                caps = self._capacities(
+                    per_replica=max(1, dep.devices_per_replica),
+                    exclude=exclude)
+                try:
+                    node_name = self._pick_node(dep, caps)
+                except PlacementError:
+                    break
+                self._fire_scale_chaos(dep.name, node_name)
+                node = self.pool.live_nodes().get(node_name)
+                if node is None:
+                    # the chosen node died between pick and placement:
+                    # nothing was started there, nothing to count —
+                    # retry on the survivors
+                    exclude.append(node_name)
+                    continue
+                rid = self._next_rid(dep.name)
+                try:
+                    rep = self._start_replica(dep, node_name, node, rid)
+                except Exception as e:
+                    # mid-placement node death: the half-started
+                    # replica is NOT appended to dep.replicas, so the
+                    # control loop's capacity view and the routing
+                    # table never include it
+                    self.pool.record_event(
+                        "replica_lost", deployment=dep.name,
+                        replica_id=rid, error=repr(e))
+                    exclude.append(node_name)
+                    continue
+                break
+            if rep is None:
+                break               # no capacity now: next tick retries
+            # warm BEFORE routing: the replica enters the table (and
+            # takes traffic) only with its compile cache filled
+            if not self._finish_placement(dep, rep, node):
+                break               # discarded (warm failure / delete)
+            placed += 1
+        if placed:
+            self._push_table()
+        return placed
+
+    def _scale_down(self, dep: ClusterDeployment,
+                    count: int) -> Tuple[int, int]:
+        from tosem_tpu.cluster.rpc import RpcClient
+        with self._lock:
+            reps = list(dep.replicas)
+        count = min(count, len(reps) - 1)   # never below one replica
+        if count <= 0:
+            return 0, 0
+        loads: Dict[str, int] = {}
+        for r in reps:
+            try:
+                with RpcClient(r.address) as cli:
+                    loads[r.replica_id] = int(cli.call("load"))
+            except Exception:
+                # unprobeable replica: most attractive victim (likely
+                # already dead)
+                loads[r.replica_id] = -1
+        victims = sorted(reps, key=lambda r: (loads[r.replica_id],
+                                              r.replica_id))[:count]
+        with self._lock:
+            for v in victims:
+                if v in dep.replicas:
+                    dep.replicas.remove(v)
+        # stop NEW traffic first (the drain-before-stop contract), then
+        # move live decode state, then stop the processes
+        self._push_table()
+        migrated = 0
+        live = self.pool.live_nodes()
+        for v in victims:
+            with self._lock:
+                survivors = list(dep.replicas)
+            migrated += self._migrate_replica_seqs(dep, v, survivors)
+            self._unpin_replica(dep, v)
+            node = live.get(v.node)
+            if node is not None:
+                try:
+                    node.stop_replica(v.replica_id)
+                except Exception:
+                    pass
+            if v.gang is not None:
+                v.gang.release()
+            self.pool.record_event(
+                "replica_removed", deployment=dep.name,
+                replica_id=v.replica_id, reason="scale_down",
+                node=v.node)
+        return len(victims), migrated
+
+    def scale_routers(self, num_routers: int) -> int:
+        """Grow/shrink the router TIER (the second closed-loop axis):
+        fresh routers receive the current table+admission push before
+        any client can reach them; shrink closes the tail routers —
+        clients holding their addresses fail over, by design."""
+        if num_routers < 1:
+            raise ValueError("the router tier needs at least one router")
+        with self._lock:
+            if self._closed:
+                return len(self._routers)
+            cur = len(self._routers)
+        if num_routers > cur:
+            fresh: List[Union[RemoteRouter, RouterCore]] = []
+            for _ in range(num_routers - cur):
+                with self._lock:
+                    name = f"router{self._router_seq}"
+                    self._router_seq += 1
+                if self._router_procs:
+                    fresh.append(RemoteRouter.spawn_local(
+                        name=name, policy=self._router_policy))
+                else:
+                    fresh.append(RouterCore(
+                        name=name, policy=self._router_policy))
+            with self._lock:
+                self._routers.extend(fresh)
+            self._push_table()      # the fresh routers catch up here
+            self.pool.record_event("routers_scaled", count=num_routers,
+                                   direction="up")
+        elif num_routers < cur:
+            with self._lock:
+                victims = self._routers[num_routers:]
+                self._routers = self._routers[:num_routers]
+            for router in victims:
+                try:
+                    router.close()
+                except Exception:
+                    pass
+            # re-push so survivors learn the NEW shard count: a stale
+            # _shards leaves each survivor admitting 1/old_count of the
+            # SLO budget — permanent under-admission
+            self._push_table()
+            self.pool.record_event("routers_scaled", count=num_routers,
+                                   direction="down")
+        with self._lock:
+            return len(self._routers)
+
+    def num_routers(self) -> int:
+        with self._lock:
+            return len(self._routers)
+
+    def _fire_scale_chaos(self, deployment: str, node_name: str) -> None:
+        """Chaos seam ``control.scale``: fired once per scale-up
+        placement with the chosen target node — ``kill_node`` SIGKILLs
+        that node and declares it dead BEFORE the replica starts (the
+        mid-scale-up death window the ``scale-under-kill`` plan
+        pins)."""
+        act = _chaos.fire("control.scale", target=deployment)
+        if act is None:
+            return
+        if act["action"] == "kill_node":
+            node = self.pool.live_nodes().get(node_name)
+            if node is not None:
+                node.kill()
+                self.pool.detector.declare_dead(node_name)
+
     def _teardown_deployment(self, dep: ClusterDeployment) -> None:
         nodes = self.pool.live_nodes()
         with self._lock:
@@ -386,6 +692,7 @@ class ClusterServe:
                     pass            # dead node: its replicas died too
             if rep.gang is not None:
                 rep.gang.release()
+            self._unpin_replica(dep, rep)
             self.pool.record_event("replica_removed", deployment=dep.name,
                                    replica_id=rep.replica_id,
                                    reason="deleted")
@@ -407,9 +714,17 @@ class ClusterServe:
             table = {name: [rep.info() for rep in dep.replicas]
                      for name, dep in self._deployments.items()}
             routers = list(self._routers)
+            # each router admits 1/N of the deployment's budget: the
+            # SLO is an AGGREGATE contract, and scaling the router
+            # tier must not multiply the admitted inflight
+            admission = {
+                name: {**dep.slo.to_dict(),
+                       "_shards": max(1, len(routers))}
+                for name, dep in self._deployments.items()
+                if dep.slo is not None}
         for router in routers:
             try:
-                router.update_table(table, version)
+                router.update_table(table, version, admission)
             except Exception:
                 pass
         return version
@@ -432,6 +747,10 @@ class ClusterServe:
                 for rep in mine:
                     dep.replicas.remove(rep)
                     lost.append((dep, rep))
+        if self._scorer is not None:
+            # the node's ledger (residency AND pins) dies with it —
+            # never zero it, REMOVE it
+            self._scorer.ledger.drop_node(node_name)
         if not lost:
             return
         self._push_table()
@@ -453,46 +772,102 @@ class ClusterServe:
                     replica_id=rep.replica_id, error=repr(e))
         self._push_table()
 
-    def _place_one(self, dep: ClusterDeployment, replica_id: str,
-                   exclude: Sequence[str] = ()) -> ClusterReplica:
-        """Re-place one replica on the best-capacity surviving node."""
-        caps = self._capacities(
-            per_replica=max(1, dep.devices_per_replica), exclude=exclude)
+    def _pick_node(self, dep: ClusterDeployment,
+                   caps: Dict[str, int]) -> str:
+        """Node choice for ONE replica: affinity-scored when a
+        placement scorer is configured (warm compile cache /
+        co-residency / pressure — see
+        :class:`~tosem_tpu.control.multiplex.PlacementScorer`),
+        best-free-capacity otherwise (the pre-control-plane rule)."""
         candidates = [n for n, c in caps.items() if c > 0]
         if not candidates:
             raise PlacementError(
-                f"no surviving capacity for {replica_id} "
+                f"no capacity for a replica of {dep.name!r} "
                 f"(capacities {caps})")
-        node_name = max(candidates, key=lambda n: caps[n])
+        if self._scorer is not None:
+            with self._lock:
+                co: Dict[str, int] = {}
+                for r in dep.replicas:
+                    co[r.node] = co.get(r.node, 0) + 1
+            pick = self._scorer.pick(
+                {n: caps[n] for n in candidates}, dep.name, co)
+            if pick is not None:
+                return pick
+        return max(sorted(candidates), key=lambda n: caps[n])
+
+    def _place_one(self, dep: ClusterDeployment, replica_id: str,
+                   exclude: Sequence[str] = ()) -> ClusterReplica:
+        """Re-place one replica on the best surviving node."""
+        caps = self._capacities(
+            per_replica=max(1, dep.devices_per_replica), exclude=exclude)
+        node_name = self._pick_node(dep, caps)
         node = self.pool.live_nodes()[node_name]
         rep = self._start_replica(dep, node_name, node, replica_id)
-        self._warm_replica(dep, rep)
-        with self._lock:
-            # a delete/failed-deploy can race this re-placement: if
-            # the deployment is no longer registered, the fresh
-            # replica must be torn down, not leaked as an orphan the
-            # journal records placed after deployment_deleted
-            if self._deployments.get(dep.name) is not dep:
-                registered = False
-            else:
-                dep.replicas.append(rep)
-                registered = True
-        if not registered:
-            try:
-                node.stop_replica(replica_id)
-            except Exception:
-                pass
-            if rep.gang is not None:
-                rep.gang.release()
-            self.pool.record_event("replica_removed", deployment=dep.name,
-                                   replica_id=replica_id,
-                                   reason="deployment gone")
+        if not self._finish_placement(dep, rep, node):
             raise PlacementError(
-                f"deployment {dep.name!r} was deleted during "
-                "re-placement")
+                f"replica {replica_id} was discarded during placement "
+                "(deployment deleted, or warmup failed)")
         return rep
 
     # -- node drain (live KV migration) --------------------------------
+
+    def _migrate_replica_seqs(self, dep: ClusterDeployment,
+                              rep: ClusterReplica,
+                              survivors: Sequence[ClusterReplica]
+                              ) -> int:
+        """Live-migrate ``rep``'s in-flight decode sequences onto
+        ``survivors`` (backends exposing the migration surface —
+        ``list_seqs``/``transport_address``/``send_seq``/``adopt_seq``;
+        page bytes stream node→node over
+        :mod:`tosem_tpu.cluster.transport`, the driver only brokers
+        addresses). Shared by :meth:`drain_node` and replica-level
+        scale-down — a scaled-away decode replica must not restart its
+        sequences at step 0 any more than a drained node's. Returns the
+        migrated-sequence count; failures fall back to the re-admission
+        path per sequence."""
+        from tosem_tpu.cluster.rpc import RpcClient, RpcError
+        if not survivors:
+            return 0
+        migrated = 0
+        try:
+            with contextlib.ExitStack() as stack:
+                src_cli = stack.enter_context(RpcClient(rep.address))
+                seqs = src_cli.call("backend_call", "list_seqs")
+                if not seqs:
+                    return 0
+                # one client + transport address per survivor;
+                # sequences round-robin over them so one replica does
+                # not absorb every migrated page
+                dsts = []
+                for r in survivors:
+                    try:
+                        cli = stack.enter_context(RpcClient(r.address))
+                        dsts.append((cli, cli.call(
+                            "backend_call", "transport_address")))
+                    except (RpcError, ConnectionError,
+                            TimeoutError, OSError):
+                        continue
+                if not dsts:
+                    return 0
+                for j, sid in enumerate(seqs):
+                    dst_cli, addr = dsts[j % len(dsts)]
+                    # per-sequence containment: one failed migration
+                    # (pressure on the destination, a torn stream)
+                    # must not abandon the REST of the replica's
+                    # sequences to step-0 recompute
+                    try:
+                        src_cli.call("backend_call", "send_seq", sid,
+                                     addr)
+                        dst_cli.call("backend_call", "adopt_seq", sid)
+                        src_cli.call("backend_call", "release", sid)
+                        migrated += 1
+                    except (RpcError, ConnectionError,
+                            TimeoutError, OSError):
+                        continue
+        except (RpcError, ConnectionError, TimeoutError, OSError):
+            pass  # backend without the surface / replica gone:
+            #       sequences fall back to the re-admission path
+        return migrated
 
     def drain_node(self, node_name: str) -> Dict[str, Any]:
         """Gracefully drain ``node_name``: for every replica placed
@@ -507,7 +882,6 @@ class ClusterServe:
         node's sequences continue from their current step. Returns
         ``{"replicas_moved", "sequences_migrated", "deployments"}``;
         journaled as ``node_drained``."""
-        from tosem_tpu.cluster.rpc import RpcClient, RpcError
         with self._lock:
             doomed: List[Tuple[ClusterDeployment, ClusterReplica]] = []
             for dep in self._deployments.values():
@@ -526,50 +900,7 @@ class ClusterServe:
             with self._lock:
                 survivors = [r for r in dep.replicas
                              if r.node != node_name]
-            if not survivors:
-                continue              # nowhere to move: re-place below
-            try:
-                with contextlib.ExitStack() as stack:
-                    src_cli = stack.enter_context(
-                        RpcClient(rep.address))
-                    seqs = src_cli.call("backend_call", "list_seqs")
-                    if not seqs:
-                        continue
-                    # one client + transport address per survivor;
-                    # sequences round-robin over them so one replica
-                    # does not absorb every migrated page
-                    dsts = []
-                    for r in survivors:
-                        try:
-                            cli = stack.enter_context(
-                                RpcClient(r.address))
-                            dsts.append((cli, cli.call(
-                                "backend_call", "transport_address")))
-                        except (RpcError, ConnectionError,
-                                TimeoutError, OSError):
-                            continue
-                    if not dsts:
-                        continue
-                    for j, sid in enumerate(seqs):
-                        dst_cli, addr = dsts[j % len(dsts)]
-                        # per-sequence containment: one failed
-                        # migration (pressure on the destination, a
-                        # torn stream) must not abandon the REST of
-                        # the replica's sequences to step-0 recompute
-                        try:
-                            src_cli.call("backend_call", "send_seq",
-                                         sid, addr)
-                            dst_cli.call("backend_call", "adopt_seq",
-                                         sid)
-                            src_cli.call("backend_call", "release",
-                                         sid)
-                            migrated += 1
-                        except (RpcError, ConnectionError,
-                                TimeoutError, OSError):
-                            continue
-            except (RpcError, ConnectionError, TimeoutError, OSError):
-                pass  # backend without the surface / replica gone:
-                #       sequences fall back to the re-admission path
+            migrated += self._migrate_replica_seqs(dep, rep, survivors)
         nodes = self.pool.live_nodes()
         node = nodes.get(node_name)
         for dep, rep in doomed:
@@ -577,6 +908,7 @@ class ClusterServe:
                 "replica_removed", deployment=dep.name,
                 replica_id=rep.replica_id, reason="node_drain",
                 node=node_name)
+            self._unpin_replica(dep, rep)
             if node is not None:
                 try:
                     node.stop_replica(rep.replica_id)
@@ -750,6 +1082,16 @@ class ClusterServe:
             router_stats.append(rs)
             if isinstance(router, RemoteRouter):
                 remote_stats.append(rs)
+        if self._scorer is not None:
+            # serve-recency feeds the ledger's LRU order: a model whose
+            # replicas show router-observed depth is HOT on its node,
+            # whatever order placement warmed things in
+            for rs in router_stats:
+                for info in rs.get("replicas", {}).values():
+                    if info.get("depth", 0) > 0:
+                        self._scorer.ledger.touch(
+                            info.get("node", "?"),
+                            info.get("deployment", "?"))
         nodes: Dict[str, Dict[str, Any]] = {}
         routed = spilled = 0
         for rs in router_stats:
@@ -799,13 +1141,15 @@ class ClusterServe:
             for node, d in nodes.items():
                 self._metrics["node_queue_depth"].set(d["queue_depth"],
                                                       (node,))
-            # zero series whose label sets departed (a dead node
+            # REMOVE series whose label sets departed (a dead node
             # keeping its last replica count/queue depth forever would
-            # read as mass that failover never moved)
+            # read as mass that failover never moved — and a permanent
+            # zero row is just as stale: it reads as a live idle node
+            # to every aggregation over the label)
             for name, node in self._exported_placed - placed_now:
-                self._metrics["replicas_placed"].set(0, (name, node))
+                self._metrics["replicas_placed"].remove((name, node))
             for node in self._exported_nodes - set(nodes):
-                self._metrics["node_queue_depth"].set(0, (node,))
+                self._metrics["node_queue_depth"].remove((node,))
             self._exported_placed = placed_now
             self._exported_nodes = set(nodes)
         return {"deployments": deps, "routers": router_stats,

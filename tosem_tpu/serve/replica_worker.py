@@ -144,6 +144,12 @@ def serve_replica(backend_ref: str, init_kwargs: Dict[str, Any],
     dead node must not leave orphan replicas answering on old ports —
     PDEATHSIG is not deliverable on every kernel this runs under)."""
     from tosem_tpu.cluster.rpc import RpcServer
+    # mark this process as a DEDICATED replica: compile-cache model
+    # pins taken here (CompiledBackendMixin.warmup) live exactly as
+    # long as the replica — in shared processes (driver, actor
+    # workers) backends must NOT pin, or deployment churn would pin
+    # the budgeted cache over its bound forever
+    os.environ["TOSEM_REPLICA_PROCESS"] = "1"
     backend = resolve_backend(backend_ref)(**init_kwargs)
     server = RpcServer(ReplicaHandlers(backend), port=port)
     line = f"{server.address}\n".encode()

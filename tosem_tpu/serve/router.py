@@ -32,6 +32,15 @@ decode, padded-program encode). The per-deployment breaker sees ONE
 failure per logical request whatever the attempt count, mirroring the
 PR-5/6 logical-request accounting. Application errors are never
 retried and surface to the caller typed.
+
+SLO admission (the control-plane PR): deployments with an
+:class:`~tosem_tpu.control.admission.SLOConfig` pushed alongside the
+routing table run every request through an estimated-wait check and a
+priority-class dispatch gate BEFORE the breaker — overload rejects
+typed (:class:`~tosem_tpu.control.admission.Overloaded`, with
+``retry_after``) instead of queueing into a breaker trip, decode-class
+requests preempt bulk encode in the wait queue, and per-class shed
+counters feed ``serve_admission_shed_total`` and ``/-/stats``.
 """
 from __future__ import annotations
 
@@ -146,6 +155,10 @@ class RouterCore:
         self._rings: Dict[str, List[Tuple[int, _Link]]] = {}
         self._rr = 0
         self._breakers: Dict[str, CircuitBreaker] = {}
+        # SLO admission state per deployment (configs pushed with the
+        # routing table; absent deployment = no admission, the
+        # pre-control-plane behavior)
+        self._admission: Dict[str, Any] = {}
         self._routed = 0          # affinity/least-loaded picks honored
         self._spilled = 0         # affinity overridden by queue depth
         self._retried = 0         # transport-failure re-dispatches
@@ -159,12 +172,18 @@ class RouterCore:
     # -- control plane -------------------------------------------------
 
     def update_table(self, table: Dict[str, List[Dict[str, Any]]],
-                     version: int) -> bool:
+                     version: int,
+                     admission: Optional[Dict[str, Dict[str, Any]]] = None
+                     ) -> bool:
         """Install a routing table push. Stale versions are ignored
         (controller pushes can race over different router connections).
         Links are kept per address so cached depths survive a push;
         dead marks clear — the controller believes these addresses are
-        alive, and a wrong belief costs one retried request."""
+        alive, and a wrong belief costs one retried request.
+        ``admission`` maps deployment → serialized
+        :class:`~tosem_tpu.control.admission.SLOConfig`; replica-count
+        changes resize each deployment's dispatch gate in place (wait
+        queues survive the push)."""
         with self._lock:
             if version <= self._version:
                 return False
@@ -197,15 +216,51 @@ class RouterCore:
             self._table = new_table
             self._rings = rings
             self._version = version
-        # zero the departed replicas' depth series OUTSIDE the lock —
-        # a gauge that keeps a dead replica's last depth forever reads
-        # as load on a node that may no longer exist
+            self._update_admission_locked(table, admission)
+        # REMOVE the departed replicas' depth series OUTSIDE the lock —
+        # a gauge that keeps a dead replica's row (even at zero) forever
+        # reads as a live-but-idle replica on a node that may no longer
+        # exist
         m = self._metrics_dict()
         for dep, lk in dropped:
-            m["replica_queue_depth"].set(
-                0, (dep, lk.info.get("node", "?"),
-                    lk.info.get("replica_id", lk.address)))
+            m["replica_queue_depth"].remove(
+                (dep, lk.info.get("node", "?"),
+                 lk.info.get("replica_id", lk.address)))
         return True
+
+    def _update_admission_locked(
+            self, table: Dict[str, List[Dict[str, Any]]],
+            admission: Optional[Dict[str, Dict[str, Any]]]) -> None:
+        """Refresh per-deployment admission controllers against the new
+        table. ``admission=None`` keeps the existing configs (a plain
+        table push must not drop the SLOs installed by an earlier one);
+        deployments that left the table lose their state."""
+        from tosem_tpu.control.admission import (AdmissionController,
+                                                 SLOConfig)
+        shards: Dict[str, int] = {}
+        if admission is not None:
+            for dep, cfg in admission.items():
+                cur = self._admission.get(dep)
+                slo = SLOConfig.from_dict(cfg)
+                shards[dep] = max(1, int(cfg.get("_shards", 1)))
+                if cur is None or cur.slo.to_dict() != slo.to_dict():
+                    self._admission[dep] = AdmissionController(
+                        dep, slo, replicas=len(table.get(dep, ())) or 1,
+                        shards=shards[dep],
+                        on_shed=self._make_shed_observer(dep))
+            for dep in [d for d in self._admission
+                        if d not in admission]:
+                del self._admission[dep]
+        for dep, adm in self._admission.items():
+            if dep in table:
+                adm.update_replicas(len(table[dep]) or 1,
+                                    shards=shards.get(dep))
+
+    def _make_shed_observer(self, dep: str):
+        def observe(klass: str, reason: str) -> None:
+            self._metrics_dict()["admission_shed"].inc(
+                1.0, (dep, klass, reason))
+        return observe
 
     def table_version(self) -> int:
         with self._lock:
@@ -294,8 +349,27 @@ class RouterCore:
             return br
 
     def route(self, deployment: str, request: Any,
-              key: Optional[str] = None) -> Any:
-        """Route one logical request; returns the backend's value."""
+              key: Optional[str] = None,
+              klass: Optional[str] = None) -> Any:
+        """Route one logical request; returns the backend's value.
+        ``klass`` names the request's priority class for deployments
+        with SLO admission (unknown/None ranks 0 — bulk)."""
+        with self._lock:
+            adm = self._admission.get(deployment)
+        if adm is None:
+            return self._route_admitted(deployment, request, key)
+        # admission BEFORE the breaker: a shed is a typed capacity
+        # verdict (Overloaded, retry_after), not backend-failure
+        # evidence — it must neither trip the breaker nor occupy a
+        # half-open probe slot
+        adm.admit(klass)               # may raise Overloaded
+        try:
+            return self._route_admitted(deployment, request, key)
+        finally:
+            adm.release()
+
+    def _route_admitted(self, deployment: str, request: Any,
+                        key: Optional[str] = None) -> Any:
         br = self._breaker(deployment)
         probe = br.allow()              # may raise CircuitOpen
         tried: set = set()
@@ -389,6 +463,8 @@ class RouterCore:
             for (dep, path), n in self._dep_counts.items():
                 requests.setdefault(dep, {})[path] = n
             out["requests"] = requests
+            out["admission"] = {dep: adm.stats()
+                                for dep, adm in self._admission.items()}
         per_node: Dict[str, int] = {}
         replicas = {}
         for dep, lk in links:
@@ -499,13 +575,17 @@ class RemoteRouter:
 
     # data plane (per-thread connection)
     def route(self, deployment: str, request: Any,
-              key: Optional[str] = None) -> Any:
-        return self._client().call("route", deployment, request, key)
+              key: Optional[str] = None,
+              klass: Optional[str] = None) -> Any:
+        return self._client().call("route", deployment, request, key,
+                                   klass)
 
     # control plane (shared connection; controller is single-threaded
     # per router)
-    def update_table(self, table: Dict[str, Any], version: int) -> bool:
-        return bool(self._ctl().call("update_table", table, version))
+    def update_table(self, table: Dict[str, Any], version: int,
+                     admission: Optional[Dict[str, Any]] = None) -> bool:
+        return bool(self._ctl().call("update_table", table, version,
+                                     admission))
 
     def stats(self) -> Dict[str, Any]:
         return self._ctl().call("stats")
