@@ -43,11 +43,18 @@ chaos_smoke() {
   # trajectory bit-identical) run on every PR, not just when a chaos
   # test file is touched (see tosem_tpu/chaos/); the recovery plans
   # gate on zero surfaced errors — the workload must HEAL, not merely
-  # fail loudly
-  echo "== chaos smoke (10 canned fault plans, fixed seeds)"
+  # fail loudly. The gray-failure plans (emulated-network faults, not
+  # crashes) gate the adaptive-detection/fencing/hedging layer:
+  # partition-heal (head<->node cut -> SUSPECT + router de-preference,
+  # heal -> rejoin, zero deaths), slow-node-hedge (gray replica ->
+  # hedged p99 within 2x healthy, side-effect ledger duplicate-free),
+  # stale-head-fenced (split-brain: every stale-head write rejected
+  # with StaleEpochError, replica ownership exclusively the new head's)
+  echo "== chaos smoke (13 canned fault plans, fixed seeds)"
   for plan in worker-carnage serve-flap trial-crash \
               evict-heal node-kill-heal decode-chaos decode-migrate \
-              router-chaos train-cluster scale-under-kill; do
+              router-chaos train-cluster scale-under-kill \
+              partition-heal slow-node-hedge stale-head-fenced; do
     JAX_PLATFORMS=cpu python -m tosem_tpu.cli chaos --plan "$plan"
   done
 }

@@ -28,14 +28,65 @@ jax.config.update("jax_platforms", "cpu")
 # dominates suite wall-clock on CPU CI, and repeated runs (local
 # iteration, CI retries, the tiered gates) hit the same programs. The
 # cache dir survives across runs; harmless when cold.
+#
+# Crash-safety: jax's disk cache writes entries IN PLACE (no
+# write-temp + rename), so a run killed mid-write — `timeout -k` in
+# the tiered gates, the OOM killer — leaves a torn serialized
+# executable under a valid key. Deserializing it in a later run
+# aborts the process (Fatal Python error inside XLA) or, worse,
+# silently yields a wrong executable: tests fail in ways that have
+# nothing to do with the code under test, and stay failing until
+# someone deletes the cache by hand. Every session therefore drops a
+# liveness marker next to the cache; on startup, markers whose owner
+# pid is gone mean a session died mid-flight, and every entry that
+# session may have been writing (mtime at-or-after its start) is
+# swept before the cache is turned on.
+
+
+def _sweep_torn_cache_entries(cache_dir: str) -> None:
+    import glob
+    suspect_since = None
+    for marker in glob.glob(os.path.join(cache_dir, "in_use.*")):
+        try:
+            pid = int(marker.rsplit(".", 1)[1])
+            os.kill(pid, 0)         # raises if the owner is gone
+        except (ValueError, ProcessLookupError):
+            try:
+                born = os.stat(marker).st_mtime
+                suspect_since = (born if suspect_since is None
+                                 else min(suspect_since, born))
+                os.unlink(marker)
+            except OSError:
+                pass
+        except OSError:
+            pass                    # owner alive (or unprobeable): keep
+    if suspect_since is None:
+        return
+    for entry in glob.glob(os.path.join(cache_dir, "*")):
+        if os.path.basename(entry).startswith("in_use."):
+            continue
+        try:                        # 1s slack for mtime granularity
+            if os.stat(entry).st_mtime >= suspect_since - 1.0:
+                os.unlink(entry)
+        except OSError:
+            pass
+
+
 try:
+    import atexit
     import tempfile
     _default_cache = os.path.join(
         tempfile.gettempdir(),
         f"tosem_jax_cache_{os.getuid() if hasattr(os, 'getuid') else 'u'}")
-    jax.config.update(
-        "jax_compilation_cache_dir",
-        os.environ.get("TOSEM_JAX_CACHE_DIR", _default_cache))
+    _cache_dir = os.environ.get("TOSEM_JAX_CACHE_DIR", _default_cache)
+    os.makedirs(_cache_dir, exist_ok=True)
+    _sweep_torn_cache_entries(_cache_dir)
+    _marker = os.path.join(_cache_dir, f"in_use.{os.getpid()}")
+    with open(_marker, "w"):
+        pass
+    atexit.register(lambda: os.path.exists(_marker)
+                    and os.unlink(_marker))
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 except Exception:   # unknown config on some jax versions: run uncached
     pass

@@ -158,3 +158,48 @@ class TestAgentChaos:
                 return st
             time.sleep(0.2)
         raise AssertionError(f"trial {tid} never finished: {st}")
+
+
+@pytest.mark.slow   # the ci.sh chaos smoke runs these plans every PR
+class TestGrayFailurePlans:
+    """The three gray-failure proving plans end to end: partition →
+    suspect window → heal, one chaos-slowed replica under hedged
+    routing, and a partitioned-away head fenced by the epoch lease."""
+
+    def test_partition_heal_plan_survives(self):
+        from tosem_tpu.chaos.plan import CANNED_PLANS
+        from tosem_tpu.chaos.runner import run_plan
+        rep = run_plan(CANNED_PLANS["partition-heal"])
+        assert rep.ok, rep.render()
+        assert rep.counts["errors_surfaced"] == 0
+        assert rep.counts["deaths"] == 0          # gray, never declared
+        assert rep.counts["suspect_enters"] >= 1
+        assert rep.counts["suspect_clears"] >= 1
+        # the suspect window drained traffic to the healthy replica,
+        # and the healed node rejoined the serving set
+        assert rep.counts["replicas_serving_suspect_window"] == 1
+        assert rep.counts["replicas_serving_healed"] == 2
+
+    def test_slow_node_hedge_plan_survives(self):
+        from tosem_tpu.chaos.plan import CANNED_PLANS
+        from tosem_tpu.chaos.runner import run_plan
+        rep = run_plan(CANNED_PLANS["slow-node-hedge"])
+        assert rep.ok, rep.render()
+        assert rep.counts["errors_surfaced"] == 0
+        assert rep.counts["hedge_wins"] >= 1
+        # duplicate-retire safety: every request applied exactly once
+        # in the side-effect ledger, hedge losers included
+        assert rep.counts["ledger_applied"] == rep.counts["requests"]
+        assert rep.counts["ledger_duplicates"] == 0
+
+    def test_stale_head_fenced_plan_survives(self):
+        from tosem_tpu.chaos.plan import CANNED_PLANS
+        from tosem_tpu.chaos.runner import run_plan
+        rep = run_plan(CANNED_PLANS["stale-head-fenced"])
+        assert rep.ok, rep.render()
+        assert rep.counts["epoch_new"] > rep.counts["epoch_old"]
+        # every stale-head write path rejected typed, and the new head
+        # adopted each replica exactly once
+        assert rep.counts["stale_writes_fenced"] == 4
+        assert rep.counts["duplicate_ownership"] == 0
+        assert rep.counts["errors_surfaced"] == 0

@@ -248,3 +248,67 @@ class TestKvGlue:
         with pytest.raises(WireFormatError):
             received_kv_payload(got)
         got.release()
+
+
+class TestDuplicateStreams:
+    """At-least-once delivery: a sender whose COMMIT ack was lost
+    replays the whole stream. The receiver's by-key dedupe must DROP
+    the replay — the first copy is the committed one (consumers may
+    already hold views over it) — and count it, never pin two copies
+    or clobber the parked payload."""
+
+    def test_replayed_key_keeps_first_copy(self, rx):
+        from tosem_tpu.cluster.transport import transport_counters
+        dup0 = transport_counters()["streams"].value(("duplicate",))
+        first = np.arange(64, dtype=np.int32)
+        send_tensors(rx.address, {"key": "dup"}, {"a": first})
+        # the replay arrives with DIFFERENT bytes (a buggy retry, a
+        # stale buffer): the committed copy must win regardless
+        send_tensors(rx.address, {"key": "dup"},
+                     {"a": np.zeros(64, dtype=np.int32)})
+        got = rx.pop("dup", timeout=10.0)
+        assert got.arrays()["a"].tolist() == first.tolist()
+        got.release()
+        st = rx.stats()
+        assert st["received"] == 2           # both fully drained
+        assert st["pending_keys"] == []      # exactly ONE was parked
+        assert transport_counters()["streams"].value(
+            ("duplicate",)) == dup0 + 1
+
+    def test_chaos_dup_stream_absorbed(self, rx):
+        """The ``dup_stream`` chaos fault: the emulated network arms a
+        lost-ack replay, send_tensors re-sends the committed stream in
+        full, and exactly one payload is claimable."""
+        from tosem_tpu.chaos import network as _net
+        from tosem_tpu.cluster.transport import transport_counters
+        dup0 = transport_counters()["streams"].value(("duplicate",))
+        try:
+            _net.state().dup_stream(1)
+            a = np.arange(32, dtype=np.float32)
+            n = send_tensors(rx.address, {"key": "cd"}, {"a": a})
+            assert n == a.nbytes             # caller sees ONE send
+            got = rx.pop("cd", timeout=10.0)
+            assert got.arrays()["a"].tolist() == a.tolist()
+            got.release()
+            deadline = time.time() + 5.0
+            while rx.stats()["received"] < 2 and time.time() < deadline:
+                time.sleep(0.01)             # replay drains async
+            st = rx.stats()
+            assert st["received"] == 2 and st["pending_keys"] == []
+            assert transport_counters()["streams"].value(
+                ("duplicate",)) == dup0 + 1
+        finally:
+            _net.state().reset()
+
+    def test_partitioned_stream_drops_typed(self, rx):
+        from tosem_tpu.chaos import network as _net
+        try:
+            _net.state().partition(["src"], ["dst"])
+            with pytest.raises(TransportError):
+                send_tensors(rx.address,
+                             {"key": "p", "src_node": "src",
+                              "dst_node": "dst"},
+                             {"a": np.zeros(4)})
+            assert rx.stats()["received"] == 0
+        finally:
+            _net.state().reset()

@@ -1,0 +1,542 @@
+"""Gray-failure tolerance units: adaptive (phi-accrual) failure
+detection with a SUSPECT state, concurrent probing that survives a
+hung node, journal reconcile under fuzzed torn/duplicated/stale-epoch
+appends, and router-level hedged requests with end-to-end deadlines.
+
+The end-to-end proofs live in the canned chaos plans
+(``partition-heal``, ``slow-node-hedge``, ``stale-head-fenced``); this
+file pins the mechanism-level contracts with fast fakes.
+"""
+import json
+import random
+import threading
+import time
+
+import pytest
+
+from tosem_tpu.chaos import network as _net
+from tosem_tpu.cluster.rpc import RpcServer
+from tosem_tpu.cluster.supervisor import FailureDetector, HeadJournal
+from tosem_tpu.runtime.common import DeadlineExceeded
+from tosem_tpu.serve.router import RouterCore, RouterPolicy
+
+
+class _FakeNode:
+    """Duck-typed RemoteNode with scripted liveness."""
+
+    def __init__(self, alive=True):
+        self.address = f"fake:{id(self)}"
+        self._alive = alive
+
+    def alive(self, timeout=None):
+        return self._alive
+
+    def close(self):
+        pass
+
+
+class _HungNode:
+    """A node whose probe WEDGES (gray failure: the socket accepts but
+    the agent never answers) until released."""
+
+    def __init__(self):
+        self.address = f"hung:{id(self)}"
+        self.release = threading.Event()
+        self.probes = 0
+
+    def alive(self, timeout=None):
+        self.probes += 1
+        self.release.wait(timeout=20.0)
+        return True
+
+    def close(self):
+        self.release.set()
+
+
+# ------------------------------------------------- suspicion + phi
+
+
+class TestSuspicion:
+    def test_suspect_entered_on_first_miss_cleared_on_success(self):
+        events = []
+        node = _FakeNode()
+        det = FailureDetector(
+            miss_threshold=5,
+            on_suspect=lambda n, _, entering: events.append((n, entering)))
+        det.add("n0", node)
+        det.check_once()
+        assert det.state("n0") == "alive" and events == []
+        node._alive = False
+        det.check_once()                     # miss 1: SUSPECT, not dead
+        assert det.state("n0") == "suspect"
+        assert det.is_suspect("n0") and det.suspects() == ["n0"]
+        assert not det.is_dead("n0")
+        node._alive = True
+        det.check_once()                     # probe answered: cleared
+        assert det.state("n0") == "alive" and not det.is_suspect("n0")
+        assert events == [("n0", True), ("n0", False)]
+
+    def test_suspect_callback_errors_are_contained(self):
+        node = _FakeNode(alive=False)
+
+        def boom(*a):
+            raise RuntimeError("listener bug")
+
+        det = FailureDetector(miss_threshold=5, on_suspect=boom)
+        det.add("n0", node)
+        det.check_once()                     # must not raise
+        assert det.is_suspect("n0")
+
+    def test_death_skips_suspect_callback_same_sweep(self):
+        # miss_threshold=1: the first miss IS death — the layer above
+        # must see on_dead, never a suspect-enter for a corpse
+        events = []
+        det = FailureDetector(
+            miss_threshold=1,
+            on_suspect=lambda n, _, e: events.append((n, e)))
+        det.add("n0", _FakeNode(alive=False))
+        assert det.check_once() == ["n0"]
+        assert events == []
+
+    def test_phi_zero_without_history_grows_with_silence(self):
+        det = FailureDetector()
+        det.add("n0", _FakeNode())
+        assert det.phi("n0") == 0.0          # no successful probe yet
+        det.check_once()
+        assert det.phi("n0") == 0.0          # one success: no intervals
+        now = time.monotonic()
+        with det._lock:
+            det._intervals["n0"].extend([0.5] * 8)
+            det._last_ok["n0"] = now
+        import math
+        one_decade = now + 0.5 * math.log(10.0)
+        assert det.phi("n0", now=now) == 0.0
+        assert det.phi("n0", now=one_decade) == pytest.approx(1.0,
+                                                              rel=1e-6)
+        assert det.phi("n0", now=now + 100.0) > 3.0
+
+    def test_phi_accrual_accelerates_past_miss_budget(self):
+        # a node with a tight learned heartbeat that has been silent
+        # for hundreds of intervals dies on the SECOND miss, long
+        # before the 10-miss floor
+        deaths = []
+        node = _FakeNode()
+        det = FailureDetector(miss_threshold=10, dead_phi=3.0,
+                              on_dead=lambda n, _: deaths.append(n))
+        det.add("n0", node)
+        det.check_once()                     # baseline success
+        with det._lock:
+            det._intervals["n0"].extend([0.01] * 8)
+            det._last_ok["n0"] = time.monotonic() - 5.0
+        node._alive = False
+        assert det.check_once() == []        # miss 1: never phi-killed
+        assert det.check_once() == ["n0"]    # miss 2 + phi >> dead_phi
+        assert deaths == ["n0"]
+
+    def test_fresh_history_never_phi_killed(self):
+        # same two misses WITHOUT a long silence: phi stays low, the
+        # miss floor governs — no premature death from jitter
+        node = _FakeNode()
+        det = FailureDetector(miss_threshold=10, dead_phi=3.0)
+        det.add("n0", node)
+        for _ in range(4):
+            det.check_once()
+        node._alive = False
+        det.check_once()
+        det.check_once()
+        assert not det.is_dead("n0")
+
+
+# --------------------------------------------- concurrent probing (S1)
+
+
+class TestConcurrentProbes:
+    def test_hung_node_costs_one_probe_budget_not_one_per_node(self):
+        """Regression: probes run concurrently against a shared
+        deadline, so one wedged agent cannot stall the sweep for the
+        nodes behind it in iteration order (serial probing would take
+        n_hung x probe_timeout and starve death detection fleetwide)."""
+        hung = [_HungNode(), _HungNode()]
+        healthy = [_FakeNode() for _ in range(3)]
+        det = FailureDetector(miss_threshold=3, probe_timeout=0.4)
+        det.add("h0", hung[0])
+        det.add("n0", healthy[0])
+        det.add("h1", hung[1])                # hung nodes interleaved
+        det.add("n1", healthy[1])
+        det.add("n2", healthy[2])
+        try:
+            t0 = time.monotonic()
+            died = det.check_once()
+            elapsed = time.monotonic() - t0
+            # one shared budget (+0.5s join margin), NOT 2 x 20s
+            assert elapsed < 2.0, elapsed
+            assert died == []
+            # the wedged probes counted as misses -> suspects; the
+            # healthy nodes answered inside the same sweep
+            assert sorted(det.suspects()) == ["h0", "h1"]
+            for n in ("n0", "n1", "n2"):
+                assert det.state(n) == "alive"
+        finally:
+            for h in hung:
+                h.release.set()
+
+    def test_hung_node_eventually_declared_dead(self):
+        hung = _HungNode()
+        deaths = []
+        det = FailureDetector(miss_threshold=2, probe_timeout=0.2,
+                              on_dead=lambda n, _: deaths.append(n))
+        det.add("h0", hung)
+        det.add("n0", _FakeNode())
+        try:
+            det.check_once()
+            died = det.check_once()
+            assert died == ["h0"] and deaths == ["h0"]
+            assert det.state("n0") == "alive"
+        finally:
+            hung.release.set()
+
+
+# ------------------------------------------- journal reconcile fuzz (S4)
+
+
+class TestReconcileFuzz:
+    """Randomized journals with the three corruption classes a head
+    crash + split-brain handoff can produce: torn tails, duplicated
+    (at-least-once) appends, and stale-epoch lines racing the fence."""
+
+    def _generate(self, rng):
+        """Returns (lines, expected placements, expected stale count,
+        max epoch). A tiny shadow ledger tracks what a correct replay
+        must end with: last NON-STALE placed/removed wins per id."""
+        lines = []
+        placements = {}
+        epoch = 1
+        stale = 0
+
+        def emit(ev, stale_line=False, **fields):
+            nonlocal stale
+            e = {"event": ev, "epoch": epoch - 1 if stale_line else epoch}
+            e.update(fields)
+            lines.append(e)
+            if stale_line:
+                stale += 1
+            return e
+
+        emit("node_added", name="n0", address="h:0")
+        emit("deployment_created", deployment="d", num_replicas=2)
+        for i in range(rng.randint(8, 20)):
+            rid = f"d#r{rng.randint(0, 4)}"
+            roll = rng.random()
+            if roll < 0.5:
+                e = emit("replica_placed", deployment="d",
+                         replica_id=rid, node=f"n{rng.randint(0, 2)}",
+                         address=f"a:{i}",
+                         stale_line=(epoch > 1 and rng.random() < 0.4))
+                if e["epoch"] == epoch:
+                    placements[rid] = e["address"]
+                if rng.random() < 0.5:       # at-least-once duplicate
+                    lines.append(dict(e))
+                    if e["epoch"] < epoch:
+                        stale += 1
+            elif roll < 0.7:
+                e = emit("replica_removed", deployment="d",
+                         replica_id=rid,
+                         stale_line=(epoch > 1 and rng.random() < 0.4))
+                if e["epoch"] == epoch:
+                    placements.pop(rid, None)
+            else:
+                epoch += 1                   # head handoff: fence bumped
+                emit("node_added", name=f"m{epoch}",
+                     address=f"h:{epoch}")
+        return lines, placements, stale, epoch
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_fuzzed_journal_reconciles_exactly(self, tmp_path, seed):
+        rng = random.Random(seed)
+        lines, want_placements, want_stale, want_epoch = \
+            self._generate(rng)
+        p = str(tmp_path / "head.journal")
+        body = b"".join(json.dumps(e, sort_keys=True).encode() + b"\n"
+                        for e in lines)
+        # torn tail: a mid-write crash truncates the final line; the
+        # poison line AND anything a buggy loader might read past it
+        # must be invisible
+        tear = json.dumps({"event": "replica_placed", "deployment": "d",
+                           "replica_id": "d#r0", "node": "nX",
+                           "address": "POISON",
+                           "epoch": want_epoch}).encode()
+        body += tear[:rng.randint(1, len(tear) - 1)]
+        with open(p, "wb") as f:
+            f.write(body)
+        state = HeadJournal.reconcile(HeadJournal.load(p))
+        got = {rid: e["address"]
+               for rid, e in state["placements"].items()}
+        assert got == want_placements, seed
+        assert state["stale_dropped"] == want_stale, seed
+        assert state["epoch"] == want_epoch, seed
+        # zero duplicate ownership: one owner per replica id
+        assert "POISON" not in got.values()
+
+    def test_duplicate_replica_placed_is_idempotent(self, tmp_path):
+        p = str(tmp_path / "head.journal")
+        ev = {"event": "replica_placed", "deployment": "d",
+              "replica_id": "d#r0", "node": "n0", "address": "a:1",
+              "epoch": 1}
+        with open(p, "wb") as f:
+            for _ in range(5):               # at-least-once replay x5
+                f.write(json.dumps(ev).encode() + b"\n")
+        state = HeadJournal.reconcile(HeadJournal.load(p))
+        assert list(state["placements"]) == ["d#r0"]
+        assert state["stale_dropped"] == 0
+
+    def test_stale_epoch_append_cannot_resurrect_placement(self, tmp_path):
+        # the split-brain race: the old head's line lands AFTER the new
+        # head removed the replica — the stale epoch fences it out
+        p = str(tmp_path / "head.journal")
+        events = [
+            {"event": "replica_placed", "deployment": "d",
+             "replica_id": "d#r0", "node": "n0", "address": "a:1",
+             "epoch": 1},
+            {"event": "replica_removed", "deployment": "d",
+             "replica_id": "d#r0", "epoch": 2},
+            {"event": "replica_placed", "deployment": "d",
+             "replica_id": "d#r0", "node": "n0", "address": "a:STALE",
+             "epoch": 1},
+        ]
+        with open(p, "wb") as f:
+            for e in events:
+                f.write(json.dumps(e).encode() + b"\n")
+        state = HeadJournal.reconcile(HeadJournal.load(p))
+        assert state["placements"] == {}
+        assert state["stale_dropped"] == 1 and state["epoch"] == 2
+
+
+# ------------------------------------------------- hedged routing
+
+
+class _FakeReplica:
+    """In-process replica: an RpcServer with the replica wire shape."""
+
+    def __init__(self):
+        self.calls = 0
+        self._server = RpcServer({"call": self._call})
+        self.address = self._server.address
+
+    def _call(self, request):
+        self.calls += 1
+        return {"value": {"echo": request}, "load": 0}
+
+    def kill(self):
+        self._server.shutdown()
+
+
+def _table(deployment, replicas, suspect=()):
+    return {deployment: [
+        {"replica_id": f"{deployment}#r{i}", "address": r.address,
+         "node": f"n{i}", "devices": 0, "suspect": i in suspect}
+        for i, r in enumerate(replicas)]}
+
+
+@pytest.fixture()
+def fleet():
+    reps = [_FakeReplica(), _FakeReplica()]
+    yield reps
+    for r in reps:
+        r.kill()
+    _net.state().reset()
+
+
+class TestHedgedRouting:
+    def test_hedge_caps_gray_replica_latency(self, fleet):
+        router = RouterCore("r0", policy=RouterPolicy(
+            hedge_after_s=0.03, hedge_min_samples=10_000))
+        try:
+            router.update_table(_table("echo", fleet), 1)
+            _net.state().slow_node("n1", 0.5)    # gray, not dead
+            for i in range(8):
+                t0 = time.monotonic()
+                out = router.route("echo", {"i": i})
+                assert out == {"echo": {"i": i}}
+                # nowhere near the 500ms gray path: hedge delay floor
+                # (30ms) + a healthy dispatch
+                assert time.monotonic() - t0 < 0.25
+            st = router.stats()
+            assert st["errors"] == 0
+            assert st["hedged"] >= 1 and st["hedge_wins"] >= 1
+        finally:
+            router.close()
+
+    def test_ring_records_winner_attempt_not_client_total(self, fleet):
+        """Regression: the latency ring feeding the hedge-delay
+        quantile must see the winning ATTEMPT's dispatch time. A
+        hedged winner's client-observed total embeds the hedge delay
+        itself; feeding that back ratchets the quantile upward until
+        hedging self-disables."""
+        router = RouterCore("r0", policy=RouterPolicy(
+            hedge_after_s=0.1, hedge_min_samples=10_000))
+        try:
+            router.update_table(_table("echo", fleet), 1)
+            router.route("echo", {"i": 0}, key="pin")
+            gray = "n0" if fleet[0].calls else "n1"
+            _net.state().slow_node(gray, 0.5)
+            t0 = time.monotonic()
+            router.route("echo", {"i": 1}, key="pin")  # affinity -> gray
+            wall = time.monotonic() - t0
+            assert wall >= 0.09                  # the hedge delay paid
+            newest = router._latency["echo"][-1]
+            assert newest < 0.05, newest         # attempt, not total
+        finally:
+            router.close()
+
+    def test_deadline_exceeded_mid_hedge_is_typed(self, fleet):
+        router = RouterCore("r0", policy=RouterPolicy(
+            hedge_after_s=0.03, hedge_min_samples=10_000))
+        try:
+            router.update_table(_table("echo", fleet), 1)
+            _net.state().slow_node("n0", 0.5)
+            _net.state().slow_node("n1", 0.5)    # whole fleet gray
+            t0 = time.monotonic()
+            with pytest.raises(DeadlineExceeded):
+                router.route("echo", {"i": 0}, timeout_s=0.15)
+            assert time.monotonic() - t0 < 0.45  # shed, not ridden out
+            assert router.stats()["deadline_shed"] >= 1
+        finally:
+            router.close()
+
+    def test_expired_budget_sheds_before_dispatch(self, fleet):
+        router = RouterCore("r0")
+        try:
+            router.update_table(_table("echo", fleet), 1)
+            with pytest.raises(DeadlineExceeded):
+                router.route("echo", {}, timeout_s=0.0)
+            assert fleet[0].calls == 0 and fleet[1].calls == 0
+        finally:
+            router.close()
+
+    def test_suspect_node_depreferenced_until_cleared(self, fleet):
+        router = RouterCore("r0")
+        try:
+            router.update_table(_table("echo", fleet, suspect={0}), 1)
+            for i in range(6):
+                router.route("echo", {"i": i})
+            # fresh traffic prefers the node answering heartbeats
+            assert fleet[0].calls == 0 and fleet[1].calls == 6
+            router.update_table(_table("echo", fleet), 2)  # cleared
+            for i in range(6):
+                router.route("echo", {"i": i})
+            assert fleet[0].calls > 0                      # restored
+        finally:
+            router.close()
+
+    def test_saturated_hedge_pool_spills_to_fresh_thread(self, fleet):
+        """Regression: abandoned hedge losers sleep out a gray
+        replica's latency holding pool threads; with zero permits free
+        an attempt must spill to a one-shot thread, never queue behind
+        the sleepers (queued primaries re-create the gray tail)."""
+        router = RouterCore("r0", policy=RouterPolicy(
+            hedge_after_s=0.02, hedge_min_samples=10_000))
+        try:
+            router.update_table(_table("echo", fleet), 1)
+            while router._hedge_slots.acquire(blocking=False):
+                pass                         # pool "full of sleepers"
+            t0 = time.monotonic()
+            for i in range(4):
+                out = router.route("echo", {"i": i})
+                assert out == {"echo": {"i": i}}
+            assert time.monotonic() - t0 < 2.0
+            assert router.stats()["errors"] == 0
+        finally:
+            router.close()
+
+
+# ------------------------------------- decode straggler watchdog
+
+
+class _FakeDecodeDep:
+    """Duck-typed deployment for direct DecodeQueue construction."""
+
+    def __init__(self, replicas):
+        self.name = "dq"
+        self.backend_cls = object          # no migration/spill surface
+        self.max_retries = 0
+        self._lock = threading.Lock()
+        self._replicas = replicas
+
+
+class _Rep:
+    pass
+
+
+@pytest.fixture()
+def decode_queue():
+    from tosem_tpu.serve.batching import DecodePolicy, DecodeQueue
+    reps = [_Rep(), _Rep(), _Rep()]
+    q = DecodeQueue(_FakeDecodeDep(reps),
+                    DecodePolicy(straggler_factor=3.0,
+                                 straggler_min_samples=3,
+                                 straggler_min_s=0.02))
+    drained = []
+    q.drain_replica = lambda r, migrate=True: drained.append(
+        (r, migrate))
+    yield q, reps, drained
+    q.close()
+
+
+class TestStragglerWatchdog:
+    def _feed(self, q, reps, times, rounds):
+        handles = {id(r): r for r in reps}
+        for _ in range(rounds):
+            q._check_stragglers(
+                {id(r): t for r, t in zip(reps, times)}, handles)
+
+    def test_slow_replica_drained_and_quarantined(self, decode_queue):
+        q, reps, drained = decode_queue
+        # replica 2 steps at 10x the fleet median — a slow-but-alive
+        # node the crash-stop detector never sees
+        self._feed(q, reps, [0.01, 0.012, 0.1], rounds=3)
+        assert drained == [(reps[2], True)]  # the live-migration drain
+        st = q.stats()
+        assert st["straggler_drains"] == 1
+        assert st["straggler_quarantined"] == 1
+        # quarantined: admission routes around it
+        assert q._pick_replica() in (reps[0], reps[1])
+        # and it is never re-drained while quarantined
+        self._feed(q, reps, [0.01, 0.012, 0.1], rounds=3)
+        assert len(drained) == 1
+
+    def test_below_min_samples_never_drains(self, decode_queue):
+        q, reps, drained = decode_queue
+        self._feed(q, reps, [0.01, 0.01, 0.5], rounds=2)  # < 3 samples
+        assert drained == []
+
+    def test_jitter_below_absolute_floor_never_drains(self, decode_queue):
+        q, reps, drained = decode_queue
+        # 10x the fleet median but under straggler_min_s: sub-floor
+        # steps jitter — one GC pause must not drain a healthy replica
+        self._feed(q, reps, [0.001, 0.001, 0.01], rounds=4)
+        assert drained == []
+
+    def test_healthy_fleet_never_drains(self, decode_queue):
+        q, reps, drained = decode_queue
+        self._feed(q, reps, [0.03, 0.031, 0.032], rounds=5)
+        assert drained == []
+
+    def test_single_replica_has_no_fleet_to_compare(self):
+        from tosem_tpu.serve.batching import DecodePolicy, DecodeQueue
+        rep = _Rep()
+        q = DecodeQueue(_FakeDecodeDep([rep]),
+                        DecodePolicy(straggler_factor=2.0,
+                                     straggler_min_samples=2))
+        drained = []
+        q.drain_replica = lambda r, migrate=True: drained.append(r)
+        try:
+            for _ in range(4):
+                q._check_stragglers({id(rep): 0.5}, {id(rep): rep})
+            assert drained == []             # nothing to migrate TO
+        finally:
+            q.close()
+
+    def test_watchdog_off_by_default(self):
+        from tosem_tpu.serve.batching import DecodePolicy
+        assert DecodePolicy().straggler_factor == 0.0
+

@@ -299,6 +299,45 @@ class TestFlushPolicy:
         assert time.monotonic() - t0 < 10.0    # nowhere near the 30s call
         serve.delete("synct")
 
+    def test_queued_deadline_sheds_typed_at_flush(self, serve):
+        # flush-time per-item deadline: a request whose budget expired
+        # while it queued behind a slow batch is shed typed at dispatch
+        # — its batchmates ride the batch untouched, and the shed never
+        # reaches the replica
+        from tosem_tpu.runtime.common import DeadlineExceeded
+        pol = BatchPolicy(max_batch_size=4, batch_wait_ms=5.0,
+                          max_inflight_per_replica=1)
+        dep = serve.deploy("dlq", SlowBatch, num_replicas=1,
+                           batch_policy=pol, max_retries=0)
+        h = serve.get_handle("dlq")
+        h.call({"s": 0.01}, timeout=60.0)      # cold boot
+        blocker = h.remote({"s": 0.8})         # occupies the replica
+        time.sleep(0.1)                        # ...and is in flight
+        healthy = [h.remote({"s": 0.01}) for _ in range(3)]
+        doomed = dep._queue.submit({"s": 0.01}, timeout=0.05)
+        assert blocker.result(timeout=60.0) == "done"
+        with pytest.raises(DeadlineExceeded):
+            doomed.result(timeout=60.0)
+        # batchmates dispatched as if the expired item never queued
+        assert all(f.result(timeout=60.0) == "done" for f in healthy)
+        serve.delete("dlq")
+
+    def test_queued_deadline_not_expired_rides_batch(self, serve):
+        # the deadline only sheds EXPIRED work: a generous budget on
+        # the queued path must not fail the request
+        pol = BatchPolicy(max_batch_size=4, batch_wait_ms=5.0,
+                          max_inflight_per_replica=1)
+        dep = serve.deploy("dlq2", SlowBatch, num_replicas=1,
+                           batch_policy=pol)
+        h = serve.get_handle("dlq2")
+        h.call({"s": 0.01}, timeout=60.0)      # cold boot
+        blocker = h.remote({"s": 0.3})
+        time.sleep(0.05)
+        f = dep._queue.submit({"s": 0.01}, timeout=30.0)
+        assert blocker.result(timeout=60.0) == "done"
+        assert f.result(timeout=60.0) == "done"
+        serve.delete("dlq2")
+
     def test_delete_fails_queued_requests(self, serve):
         pol = BatchPolicy(max_batch_size=1, batch_wait_ms=1.0,
                           max_inflight_per_replica=1)

@@ -8,6 +8,8 @@ over, of the overlap mode, and of any mid-run membership change.
 Everything here pins some face of that contract; the chain-transport
 arm runs over real sockets (threads backend) in-process.
 """
+import time
+
 import numpy as np
 import pytest
 
@@ -303,6 +305,94 @@ class TestReductionArms:
         with _trainer(world=4, job="arm-x") as tr:
             tp = tr.fit(3)
         np.testing.assert_allclose(tp, sm, rtol=2e-5)
+
+
+# ------------------------------------------------- straggler watchdog
+
+
+def _wd_cfg(job, **kw):
+    return DataParallelConfig(grain=4, bucket_bytes=1024, job=job,
+                              transport_capacity=8 << 20,
+                              straggler_factor=kw.pop("factor", 4.0),
+                              straggler_min_samples=kw.pop("samples", 2),
+                              straggler_min_s=kw.pop("floor", 0.05), **kw)
+
+
+class TestStragglerWatchdog:
+    def test_slow_rank_evicted_bit_identical(self):
+        # a gray-slow rank (alive to every probe, 0.25s extra backward)
+        # must be evicted through the SAME shrink path as a death, and
+        # the trajectory must not notice — shard boundaries move, the
+        # fold order doesn't
+        ref = _reference_losses(8)
+        with _trainer(world=3, cfg=_wd_cfg("wd-evict")) as tr:
+            tr._workers[-1].backend.set_debug_slow(0.25)
+            got = tr.fit(8)
+            st = tr.stats()
+        assert got == ref
+        assert st["straggler_evictions"] == 1
+        assert st["world"] == 2 and st["shrinks"] == 1
+
+    def test_recovery_same_magnitude_as_node_death(self):
+        # the acceptance bound: slow-rank recovery must ride the death
+        # path's timescale (detection window + one rewire), nowhere
+        # near a per-step reduce_timeout stall regime
+        ref = _reference_losses(6)
+        t0 = time.perf_counter()
+        with _trainer(world=3, cfg=_wd_cfg("wd-mag-dead")) as tr:
+            tr._workers[-1].fail_at_step = 2
+            assert tr.fit(6) == ref
+        t_dead = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        with _trainer(world=3, cfg=_wd_cfg("wd-mag-slow")) as tr:
+            tr._workers[-1].backend.set_debug_slow(0.2)
+            assert tr.fit(6) == ref
+            assert tr.stats()["straggler_evictions"] == 1
+        t_slow = time.perf_counter() - t0
+        # same order of magnitude: the slow arm pays the detection
+        # window (min_samples slow steps) on top of one death-style
+        # rewire; 10× the death arm (with a CI-jitter floor) bounds it,
+        # and both sit far under the 120s reduce_timeout it replaces
+        assert t_slow < 10 * max(t_dead, 1.0)
+        assert t_slow < 60.0
+
+    def test_watchdog_off_by_default(self):
+        # straggler_factor=0.0 is the default: a slow rank makes the
+        # run slower, never smaller — deterministic tests and 2-rank
+        # fleets must not self-drain
+        assert DataParallelConfig().straggler_factor == 0.0
+        ref = _reference_losses(3)
+        with _trainer(world=2, job="wd-off") as tr:
+            tr._workers[-1].backend.set_debug_slow(0.06)
+            assert tr.fit(3) == ref
+            st = tr.stats()
+        assert st["straggler_evictions"] == 0 and st["world"] == 2
+
+    def test_absolute_floor_protects_fast_fleets(self):
+        # with the watchdog armed but no injected slowness, natural
+        # jitter on a millisecond-scale job sits under the 50ms
+        # absolute floor — the factor alone must never evict
+        ref = _reference_losses(5)
+        with _trainer(world=3, cfg=_wd_cfg("wd-floor", factor=1.2)) as tr:
+            assert tr.fit(5) == ref
+            st = tr.stats()
+        assert st["straggler_evictions"] == 0 and st["world"] == 3
+
+    def test_chaos_slow_node_drives_watchdog(self):
+        # the canned-fault route: train.dist_step/slow_node turns the
+        # highest rank gray at step 2; the watchdog must evict it and
+        # the trajectory must stay bit-identical
+        from tosem_tpu.chaos import ChaosController, Fault, FaultPlan
+        ref = _reference_losses(8)
+        plan = FaultPlan(seed=71, name="wd-chaos", faults=[
+            Fault(site="train.dist_step", action="slow_node", at=2,
+                  delay_s=0.25)])
+        with ChaosController(plan):
+            with _trainer(world=3, cfg=_wd_cfg("wd-chaos")) as tr:
+                got = tr.fit(8)
+                st = tr.stats()
+        assert got == ref
+        assert st["straggler_evictions"] == 1 and st["world"] == 2
 
 
 # ------------------------------------------------------- observability

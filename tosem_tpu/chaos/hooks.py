@@ -18,12 +18,23 @@ runtime.store       large result sealed into the store      evict_object
 serve.dispatch      request routed to a replica             crash_replica,
                                                             slow_replica
 serve.route         request routed via a ClusterHandle      kill_router,
-                                                            kill_node
+                                                            kill_node,
+                                                            slow_node
 tune.step           trial step result processed             crash_trial
 cluster.submit      NodePool routes work to a node agent    kill_node
+cluster.probe       failure-detector sweep reaches a node   partition,
+                                                            heal,
+                                                            slow_node
+transport.send      tensor stream about to leave a sender   drop, delay,
+                                                            dup_stream
 train.step          trainer fit() finished one step         preempt
 control.scale       scale-up placement target chosen        kill_node
 ==================  =====================================  =============
+
+The gray-failure actions (partition / slow_node / dup_stream) do not
+act on processes; they arm :mod:`tosem_tpu.chaos.network` — the
+process-wide emulated-network state that failure-detector probes,
+router dispatch, and tensor-transport sends consult.
 
 The cluster layer's node agent runs in a separate process, so its
 faults ride environment variables instead (``TOSEM_CHAOS_NODE_

@@ -30,7 +30,8 @@ from typing import Any, Dict, List, Optional
 VALID_SITES = (
     "runtime.dispatch", "runtime.result", "runtime.store",
     "serve.dispatch", "serve.decode_step", "serve.route", "tune.step",
-    "cluster.submit", "train.step", "train.dist_step",
+    "cluster.submit", "cluster.probe", "transport.send",
+    "train.step", "train.dist_step",
     "control.scale",
 )
 
@@ -52,14 +53,31 @@ VALID_ACTIONS = {
     # must fail over), kill_node SIGKILLs a node hosting one of the
     # deployment's replicas and declares it dead (the controller must
     # re-place, the routers must re-admit in-flight requests)
-    "serve.route": ("kill_router", "kill_node"),
+    # slow_node injects gray latency on the node hosting the targeted
+    # deployment's last replica (the emulated network adds it to every
+    # dispatch) — the tail the hedging path must absorb
+    "serve.route": ("kill_router", "kill_node", "slow_node"),
     "tune.step": ("crash_trial",),
     "cluster.submit": ("kill_node",),
+    # fired once per node per failure-detector sweep (target = node
+    # name, BEFORE that node is probed): partition severs head↔target
+    # bidirectionally in the emulated network, heal removes every
+    # partition, slow_node stalls the target's probes/dispatches by
+    # delay_s — the gray-failure triad
+    "cluster.probe": ("partition", "heal", "slow_node"),
+    # fired once per tensor stream (target = stream key, else the
+    # destination address): drop severs the stream mid-flight (what a
+    # partition does to an in-flight transfer), delay stalls it,
+    # dup_stream replays the committed stream in full (the lost-ack
+    # retry the receiver's by-key dedupe must drop exactly once)
+    "transport.send": ("drop", "delay", "dup_stream"),
     "train.step": ("preempt",),
     # fired once per distributed-training step before dispatch:
     # kill_node hard-kills the node hosting the highest dp rank (the
-    # trainer must shrink the dp axis and continue bit-identically)
-    "train.dist_step": ("kill_node",),
+    # trainer must shrink the dp axis and continue bit-identically);
+    # slow_node makes that rank gray-slow by delay_s per backward —
+    # alive to every probe, caught only by the straggler watchdog
+    "train.dist_step": ("kill_node", "slow_node"),
     # fired once per control-plane scale-up placement, AFTER the target
     # node is chosen and BEFORE the replica process starts: kill_node
     # SIGKILLs exactly that node and declares it dead — the controller
@@ -249,6 +267,49 @@ def _canned() -> Dict[str, FaultPlan]:
         "scale-under-kill": FaultPlan(seed=53, name="scale-under-kill",
                                       faults=[
             Fault(site="control.scale", action="kill_node", at=1),
+        ]),
+        # the gray-failure detection plan: partition the head away from
+        # one node (its probes start failing silently — no crash, no
+        # RST), hold the cut across several sweeps, then heal. The
+        # detector must move the node ALIVE → SUSPECT (router
+        # de-preference fires) before declaring it dead, work must
+        # keep completing on the survivor throughout, and after the
+        # heal the node must rejoin and serve again — zero surfaced
+        # errors end to end
+        "partition-heal": FaultPlan(seed=59, name="partition-heal",
+                                    faults=[
+            Fault(site="cluster.probe", action="partition", at=2,
+                  target="n1"),
+            Fault(site="cluster.probe", action="heal", at=6,
+                  target="n1"),
+        ]),
+        # the tail-tolerance acceptance plan: one replica's node turns
+        # gray (10× dispatch latency, injected at the emulated wire) —
+        # the router's quantile-derived hedge must cap routed p99
+        # within 2× the healthy-fleet p99, and the backend's
+        # per-request outcome ledger must show ZERO duplicated side
+        # effects (first-wins, the hedge loser retires cleanly)
+        "slow-node-hedge": FaultPlan(seed=61, name="slow-node-hedge",
+                                     faults=[
+            Fault(site="serve.route", action="slow_node", at=1,
+                  target="hedged", delay_s=0.3),
+        ]),
+        # the split-brain acceptance plan: partition the head away from
+        # BOTH nodes (it suspects the whole fleet), heal, and recover a
+        # REPLACEMENT head from the journal while the old one still
+        # holds its clients. Every subsequent write by the stale head —
+        # journal append, replica placement, KV adopt — must be
+        # rejected by epoch fencing (StaleEpochError), with zero
+        # duplicate replica ownership and zero client-surfaced errors
+        # through the new head
+        "stale-head-fenced": FaultPlan(seed=67, name="stale-head-fenced",
+                                       faults=[
+            Fault(site="cluster.probe", action="partition", at=2,
+                  target="n0"),
+            Fault(site="cluster.probe", action="partition", at=2,
+                  target="n1"),
+            Fault(site="cluster.probe", action="heal", at=5,
+                  target="n0"),
         ]),
         # the self-healing acceptance plan: a live object evicted, a
         # worker killed mid-task, AND a node agent killed — one run,

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List
@@ -77,6 +78,45 @@ class _SlowEchoBackend:
     def call(self, request):
         time.sleep(self._delay_s)
         return {"echo": request}
+
+
+class _PidEchoBackend:
+    """Echo stamped with the replica process's pid — the cheapest
+    possible "which replica served this?" probe, so the partition-heal
+    scenario can assert router de-preferencing (suspect window →
+    exactly one serving pid) without any backend-side bookkeeping."""
+
+    def call(self, request):
+        return {"pid": os.getpid(), "echo": request}
+
+
+class _LedgerEchoBackend:
+    """Echo that applies each request id EXACTLY ONCE to a shared
+    append-only ledger file, flock-serialized across the replica
+    processes. This is the side-effect audit the hedging acceptance
+    plan needs: a hedge loser that lands after the winner must find
+    its id already applied and retire WITHOUT a second application —
+    so ledger lines == unique request ids proves first-wins hedging
+    duplicated nothing."""
+
+    def __init__(self, ledger_path: str, delay_s: float = 0.0):
+        self._path = ledger_path
+        self._delay_s = float(delay_s)
+
+    def call(self, request):
+        import fcntl
+        if self._delay_s > 0:
+            time.sleep(self._delay_s)
+        rid = str(request["id"])
+        with open(self._path, "a+") as f:
+            fcntl.flock(f, fcntl.LOCK_EX)
+            f.seek(0)
+            duplicate = rid in f.read().split()
+            if not duplicate:
+                f.write(rid + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+        return {"echo": rid, "duplicate": duplicate}
 
 
 def _counting_trainable():
@@ -718,6 +758,344 @@ def _scenario_train_cluster(chaos: ChaosController,
         pool.close(close_nodes=True)
 
 
+def _scenario_partition_heal(chaos: ChaosController,
+                             rep: SurvivalReport) -> None:
+    """The gray-failure detection run: the head is partitioned away
+    from n1 (probes fail silently — the node itself stays healthy and
+    keeps serving), held dark across four sweeps, then healed. The
+    detector must move n1 ALIVE → SUSPECT (never dead: the adaptive
+    detector is what buys the heal time a binary one would not), the
+    router must de-prefer the suspect replica (every suspect-window
+    request lands on the healthy node's pid), and after the heal the
+    suspicion must clear and BOTH replicas serve again — zero surfaced
+    errors end to end."""
+    from tosem_tpu.chaos import network as _net
+    from tosem_tpu.cluster.node import RemoteNode
+    from tosem_tpu.cluster.supervisor import NodePool
+    from tosem_tpu.serve.cluster_serve import ClusterServe
+    # miss budget 5 so four partitioned sweeps (misses 1-4) stay in
+    # SUSPECT; the plan heals at n1's sweep 6, before the probes fire
+    pool = NodePool(miss_threshold=5, probe_timeout=3.0)
+    cs = None
+    suspect_events: List[bool] = []
+    deaths: List[str] = []
+    try:
+        for i in range(2):
+            pool.add_node(RemoteNode.spawn_local(num_workers=2),
+                          name=f"n{i}")
+        pool.add_suspect_listener(
+            lambda name, node, entering: suspect_events.append(entering))
+        pool.add_death_listener(lambda name, node: deaths.append(name))
+        cs = ClusterServe(pool, num_routers=1, router_procs=False)
+        cs.deploy("echo", "tosem_tpu.chaos.runner:_PidEchoBackend",
+                  num_replicas=2, strategy="spread")
+        h = cs.get_handle("echo")
+        errors = 0
+
+        def batch(n: int) -> set:
+            nonlocal errors
+            pids = set()
+            for i in range(n):
+                try:
+                    pids.add(h.call({"i": i})["pid"])
+                except BaseException:
+                    errors += 1
+            return pids
+
+        pool.detector.check_once()       # sweep 1: all healthy
+        healthy_pids = batch(8)
+        pool.detector.check_once()       # sweep 2: partition → SUSPECT
+        window_pids = batch(8)           # de-preference window
+        for _ in range(3):
+            pool.detector.check_once()   # sweeps 3-5: misses 2..4
+        still_gray = pool.detector.is_suspect("n1")
+        pool.detector.check_once()       # sweep 6: heal → probe ok
+        healed_pids = batch(8)
+
+        rep.counts["requests"] = 24
+        rep.counts["errors_surfaced"] = errors
+        rep.counts["suspect_enters"] = sum(1 for e in suspect_events if e)
+        rep.counts["suspect_clears"] = sum(
+            1 for e in suspect_events if not e)
+        rep.counts["deaths"] = len(deaths)
+        rep.counts["replicas_serving_healthy"] = len(healthy_pids)
+        rep.counts["replicas_serving_suspect_window"] = len(window_pids)
+        rep.counts["replicas_serving_healed"] = len(healed_pids)
+        rep.counts["partitions_injected"] = len(
+            [e for e in chaos.injections("cluster.probe")
+             if e["action"] == "partition"])
+        rep.counts["heals_injected"] = len(
+            [e for e in chaos.injections("cluster.probe")
+             if e["action"] == "heal"])
+        rep.ok = (errors == 0 and not deaths and still_gray
+                  and rep.counts["suspect_enters"] >= 1
+                  and rep.counts["suspect_clears"] >= 1
+                  and not pool.detector.is_suspect("n1")
+                  and len(healthy_pids) == 2
+                  and len(window_pids) == 1
+                  and window_pids < healthy_pids
+                  and len(healed_pids) == 2
+                  and rep.counts["partitions_injected"] >= 1
+                  and rep.counts["heals_injected"] >= 1)
+        if len(window_pids) != 1:
+            rep.notes.append(
+                "suspect-window traffic was not drained onto the "
+                f"healthy replica (served by {len(window_pids)} pids)")
+        if deaths:
+            rep.notes.append(f"gray node declared dead: {deaths} — the "
+                             "heal should have beaten the miss budget")
+    finally:
+        if cs is not None:
+            cs.close()
+        pool.close(close_nodes=True)
+        _net.state().reset()
+
+
+def _scenario_slow_node_hedge(chaos: ChaosController,
+                              rep: SurvivalReport) -> None:
+    """The tail-tolerance acceptance run: two deployments share a
+    flock-serialized side-effect ledger; the plan turns one of the
+    hedged deployment's replica nodes gray (0.3s injected wire delay —
+    6× the 50ms service time) on its first request. The router's hedge
+    must cap the hedged deployment's p99 within 2× the healthy-fleet
+    p99 (measured on the untouched baseline deployment) and WELL under
+    the injected delay, with zero surfaced errors and a ledger showing
+    every request id applied exactly once (the hedge loser retires,
+    never double-applies)."""
+    import tempfile
+    import shutil
+
+    from tosem_tpu.chaos import network as _net
+    from tosem_tpu.cluster.node import RemoteNode
+    from tosem_tpu.cluster.supervisor import NodePool
+    from tosem_tpu.serve.cluster_serve import ClusterServe
+    from tosem_tpu.serve.router import RouterPolicy
+
+    pool = NodePool(miss_threshold=3, probe_timeout=3.0)
+    cs = None
+    tmp = tempfile.mkdtemp(prefix="chaos_hedge_")
+    ledger = os.path.join(tmp, "ledger.txt")
+    open(ledger, "w").close()
+    try:
+        for i in range(2):
+            pool.add_node(RemoteNode.spawn_local(num_workers=2),
+                          name=f"n{i}")
+        cs = ClusterServe(
+            pool, num_routers=1, router_procs=False,
+            router_policy=RouterPolicy(hedge_after_s=0.06,
+                                       hedge_quantile=0.9,
+                                       hedge_min_samples=6))
+        for dep in ("baseline", "hedged"):
+            cs.deploy(dep, "tosem_tpu.chaos.runner:_LedgerEchoBackend",
+                      num_replicas=2, strategy="spread",
+                      init_kwargs={"ledger_path": ledger,
+                                   "delay_s": 0.05})
+        errors = 0
+
+        def run(handle, tag: str, n: int) -> List[float]:
+            nonlocal errors
+            lat = []
+            for i in range(n):
+                t0 = time.perf_counter()
+                try:
+                    handle.call({"id": f"{tag}-{i}"})
+                except BaseException:
+                    errors += 1
+                lat.append(time.perf_counter() - t0)
+            return lat
+
+        def p99(lat: List[float]) -> float:
+            return sorted(lat)[int(0.99 * (len(lat) - 1))]
+
+        lat_base = run(cs.get_handle("baseline"), "base", 40)
+        # first hedged request fires the plan's slow_node on the node
+        # hosting the hedged deployment's last replica
+        lat_hedge = run(cs.get_handle("hedged"), "hedge", 40)
+        time.sleep(0.4)              # let the last hedge losers retire
+        p99_healthy, p99_hedged = p99(lat_base), p99(lat_hedge)
+
+        lines = [ln for ln in open(ledger).read().splitlines() if ln]
+        stats = [r.stats() for r in cs._routers_snapshot()]
+        hedged_fired = sum(s.get("hedged", 0) for s in stats)
+        hedge_wins = sum(s.get("hedge_wins", 0) for s in stats)
+        rep.counts["requests"] = 80
+        rep.counts["errors_surfaced"] = errors
+        rep.counts["p99_healthy_ms"] = int(p99_healthy * 1e3)
+        rep.counts["p99_hedged_ms"] = int(p99_hedged * 1e3)
+        rep.counts["hedges_fired"] = hedged_fired
+        rep.counts["hedge_wins"] = hedge_wins
+        rep.counts["ledger_applied"] = len(lines)
+        rep.counts["ledger_duplicates"] = len(lines) - len(set(lines))
+        rep.counts["slow_nodes_injected"] = len(
+            chaos.injections("serve.route"))
+        # the 0.18s floor absorbs CI scheduler jitter when the healthy
+        # p99 itself is tiny; 0.25s keeps the bound strictly under the
+        # 0.3s injected gray delay (an unhedged slow hit costs 0.35s)
+        tail_ok = p99_hedged <= max(2 * p99_healthy, 0.18) \
+            and p99_hedged < 0.25
+        rep.ok = (errors == 0 and tail_ok
+                  and hedged_fired >= 1 and hedge_wins >= 1
+                  and len(lines) == 80
+                  and rep.counts["ledger_duplicates"] == 0
+                  and set(lines) == {f"base-{i}" for i in range(40)}
+                  | {f"hedge-{i}" for i in range(40)}
+                  and rep.counts["slow_nodes_injected"] >= 1)
+        if not tail_ok:
+            rep.notes.append(
+                f"hedged p99 {p99_hedged * 1e3:.0f}ms vs healthy "
+                f"{p99_healthy * 1e3:.0f}ms — hedging failed to cap "
+                "the gray tail")
+        if rep.counts["ledger_duplicates"]:
+            rep.notes.append("hedge loser double-applied a side effect")
+    finally:
+        if cs is not None:
+            cs.close()
+        pool.close(close_nodes=True)
+        _net.state().reset()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _scenario_stale_head_fenced(chaos: ChaosController,
+                                rep: SurvivalReport) -> None:
+    """The split-brain acceptance run: head A (journaled) is
+    partitioned away from BOTH nodes — it suspects the whole fleet
+    while the agents and replicas keep running — and a REPLACEMENT
+    head B recovers from the journal during A's gray window, bumping
+    the epoch lease and fencing every surviving agent and replica.
+    After the heal, stale head A still believes it owns the cluster:
+    every write it attempts — journal append, replica placement,
+    replica stop, backend control call — must be rejected with a TYPED
+    StaleEpochError, replica ownership must sit exclusively with B
+    (adopted under the SAME ids and addresses, no duplicates), and
+    clients riding B must see zero errors."""
+    import shutil
+    import tempfile
+
+    from tosem_tpu.chaos import network as _net
+    from tosem_tpu.cluster.fencing import StaleEpochError
+    from tosem_tpu.cluster.node import RemoteNode
+    from tosem_tpu.cluster.rpc import RpcClient, RpcError
+    from tosem_tpu.cluster.supervisor import NodePool
+    from tosem_tpu.serve.cluster_serve import ClusterServe
+
+    tmp = tempfile.mkdtemp(prefix="chaos_fence_")
+    jpath = os.path.join(tmp, "head.jsonl")
+    # miss budget 4: three partitioned sweeps (misses 1-3) keep the
+    # fleet in SUSPECT at head A — gray, never declared dead
+    pool_a = NodePool(journal_path=jpath, miss_threshold=4,
+                      probe_timeout=3.0)
+    cs_a = cs_b = None
+    try:
+        for i in range(2):
+            pool_a.add_node(RemoteNode.spawn_local(num_workers=2),
+                            name=f"n{i}")
+        cs_a = ClusterServe(pool_a, num_routers=1, router_procs=False)
+        dep_a = cs_a.deploy("echo", "tosem_tpu.chaos.runner:_EchoBackend",
+                            num_replicas=2, strategy="spread")
+        old_epoch = cs_a.epoch
+        owned = {r.replica_id: (r.node, r.address)
+                 for r in dep_a.replicas}
+        pool_a.detector.check_once()     # sweep 1: healthy
+        pool_a.detector.check_once()     # sweep 2: partition both
+        suspects = len(pool_a.detector.suspects())
+        pool_a.detector.check_once()     # sweep 3 (miss 2)
+        pool_a.detector.check_once()     # sweep 4 (miss 3 < budget)
+        # replacement head: journal recovery bumps the epoch lease and
+        # fences the agents + adopted replicas (recovery's own health
+        # probes are direct RPC — the emulated partition only severs
+        # head A's detector)
+        cs_b = ClusterServe.recover(jpath, num_routers=1,
+                                    router_procs=False,
+                                    probe_timeout=3.0, miss_threshold=4)
+        new_epoch = cs_b.epoch
+        reps_b = list(cs_b._deployments["echo"].replicas)
+        adopted = {r.replica_id: (r.node, r.address) for r in reps_b}
+
+        # stale head A, still holding its clients, tries to write
+        fenced = dict.fromkeys(
+            ("journal", "placement", "stop", "backend"), 0)
+        try:
+            pool_a.record_event("stale_head_write")
+        except StaleEpochError:
+            fenced["journal"] = 1
+        live_a = pool_a.live_nodes()
+        try:
+            live_a["n0"].start_replica(
+                "echo#stale", "tosem_tpu.chaos.runner:_EchoBackend",
+                init_kwargs={}, epoch=old_epoch)
+        except StaleEpochError:
+            fenced["placement"] = 1
+        rid0, (host0, addr0) = sorted(owned.items())[0]
+        try:
+            live_a[host0].stop_replica(rid0, epoch=old_epoch)
+        except StaleEpochError:
+            fenced["stop"] = 1
+        try:
+            with RpcClient(addr0) as cli:
+                cli.call("backend_call", "call", {"i": "stale"},
+                         _epoch=old_epoch)
+        except RpcError as e:
+            if str(e).startswith("StaleEpochError("):
+                fenced["backend"] = 1
+        pool_a.detector.check_once()     # sweep 5: heal fires
+        # clients ride the NEW head; the fleet serves as before
+        h_b = cs_b.get_handle("echo")
+        ok = errors = 0
+        for i in range(8):
+            try:
+                if h_b.call({"i": i}) == {"echo": {"i": i}}:
+                    ok += 1
+            except BaseException:
+                errors += 1
+
+        rids_b = [r.replica_id for r in reps_b]
+        rep.counts["epoch_old"] = old_epoch
+        rep.counts["epoch_new"] = new_epoch
+        rep.counts["fleet_suspected"] = suspects
+        rep.counts["stale_writes_fenced"] = sum(fenced.values())
+        rep.counts["replicas_adopted"] = len(reps_b)
+        rep.counts["duplicate_ownership"] = (
+            len(rids_b) - len(set(rids_b))
+            + sum(1 for rid in adopted if adopted[rid] != owned.get(rid)))
+        rep.counts["requests_ok"] = ok
+        rep.counts["errors_surfaced"] = errors
+        rep.counts["partitions_injected"] = len(
+            [e for e in chaos.injections("cluster.probe")
+             if e["action"] == "partition"])
+        rep.ok = (new_epoch > old_epoch and suspects == 2
+                  and sum(fenced.values()) == 4
+                  and adopted.keys() == owned.keys()
+                  and rep.counts["duplicate_ownership"] == 0
+                  and errors == 0 and ok == 8
+                  and rep.counts["partitions_injected"] >= 2)
+        for path, hit in sorted(fenced.items()):
+            if not hit:
+                rep.notes.append(f"stale head's {path} write was NOT "
+                                 "fenced (split-brain hazard)")
+        if adopted.keys() != owned.keys():
+            rep.notes.append(f"recovery re-placed instead of adopting: "
+                             f"owned {sorted(owned)} vs adopted "
+                             f"{sorted(adopted)}")
+    finally:
+        if cs_b is not None:
+            try:
+                cs_b.close()
+            except Exception:
+                pass
+            try:
+                cs_b.pool.close(close_nodes=False)
+            except Exception:
+                pass
+        if cs_a is not None:
+            try:
+                cs_a.close()     # fenced: teardown journaling may raise
+            except Exception:
+                pass
+        pool_a.close(close_nodes=True)
+        _net.state().reset()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 SCENARIOS: Dict[str, Callable[[ChaosController, SurvivalReport], None]] = {
     "worker-carnage": _scenario_runtime,
     "serve-flap": _scenario_serve,
@@ -732,6 +1110,9 @@ SCENARIOS: Dict[str, Callable[[ChaosController, SurvivalReport], None]] = {
     "router-chaos": _scenario_router,
     "train-cluster": _scenario_train_cluster,
     "scale-under-kill": _scenario_scale_kill,
+    "partition-heal": _scenario_partition_heal,
+    "slow-node-hedge": _scenario_slow_node_hedge,
+    "stale-head-fenced": _scenario_stale_head_fenced,
 }
 
 

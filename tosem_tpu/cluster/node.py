@@ -21,6 +21,7 @@ import time
 from concurrent.futures import ProcessPoolExecutor
 from typing import Any, Callable, Dict, List, Optional
 
+from tosem_tpu.cluster.fencing import StaleEpochError, Watermark
 from tosem_tpu.cluster.rpc import RpcClient, RpcError, RpcServer
 
 
@@ -145,6 +146,16 @@ class _AgentHandlers:
             os.environ.get("TOSEM_CHAOS_NODE_UNHEALTHY_AFTER", "0") or "0")
         self._chaos_slow_health_s = float(
             os.environ.get("TOSEM_CHAOS_SLOW_HEALTH_S", "0") or "0")
+        # head-epoch watermark: replica lifecycle calls stamped with an
+        # older head epoch than the highest seen are rejected typed — a
+        # superseded head cannot place or stop replicas on this node
+        self._epoch = Watermark()
+
+    def fence(self, epoch: int) -> int:
+        """Advance the agent's head-epoch watermark (monotonic; a
+        recovered head fences every live agent it re-adopts)."""
+        self._epoch.check(int(epoch), what="fence")
+        return self._epoch.epoch
 
     def health(self) -> Dict[str, Any]:
         with self._adm:
@@ -424,13 +435,17 @@ class _AgentHandlers:
 
     def start_replica(self, replica_id: str, backend_ref: str,
                       init_kwargs_json: str = "{}", devices: int = 0,
-                      startup_timeout: float = 120.0) -> str:
+                      startup_timeout: float = 120.0,
+                      epoch: Optional[int] = None) -> str:
         """Spawn a long-lived serve replica process hosting
         ``backend_ref`` ("module:qualname") and return its RPC address.
         Idempotent per id while the process lives (a re-placement retry
         must not leak a second process). ``devices`` > 0 pins that many
         virtual XLA host devices before the backend imports jax — the
-        dp*tp mesh of a sharded replica."""
+        dp*tp mesh of a sharded replica. ``epoch`` is the placing
+        head's fencing epoch: stale (a superseded head) is rejected
+        typed before anything spawns."""
+        self._epoch.check(epoch, what="start_replica")
         if self._draining:
             raise NodeDrainingError(
                 "node agent is draining; rejecting new replicas")
@@ -494,7 +509,9 @@ class _AgentHandlers:
                                        "lifeline": life_w}
         return address
 
-    def stop_replica(self, replica_id: str) -> bool:
+    def stop_replica(self, replica_id: str,
+                     epoch: Optional[int] = None) -> bool:
+        self._epoch.check(epoch, what="stop_replica")
         with self._sreps_lock:
             rec = self._sreps.pop(replica_id, None)
         if rec is None:
@@ -590,15 +607,25 @@ class RemoteNode:
 
     @staticmethod
     def _translate(e: RpcError) -> BaseException:
-        """Re-type a remote drain rejection so callers can catch it
-        without string-matching RpcError themselves. The RPC layer
+        """Re-type a remote drain/fence rejection so callers can catch
+        it without string-matching RpcError themselves. The RPC layer
         ships ``repr(exc)`` of the handler's exception, so a real
         drain rejection is exactly ``NodeDrainingError(...)`` at the
         START of the message — a substring match would misclassify an
         application error that merely *mentions* the name."""
         if str(e).startswith("NodeDrainingError("):
             return NodeDrainingError(str(e))
+        if str(e).startswith("StaleEpochError("):
+            return StaleEpochError(str(e))
         return e
+
+    def fence(self, epoch: int) -> int:
+        """Advance the agent's head-epoch watermark (what a recovered
+        head does to every live agent it re-adopts)."""
+        try:
+            return int(self._client.call("fence", int(epoch)))
+        except RpcError as e:
+            raise self._translate(e) from None
 
     def alive(self, timeout: float = 5.0) -> bool:
         # a bounded, independent probe connection: a long task holding
@@ -664,18 +691,27 @@ class RemoteNode:
     def start_replica(self, replica_id: str, backend_ref: str,
                       init_kwargs: Optional[Dict[str, Any]] = None,
                       devices: int = 0,
-                      startup_timeout: float = 120.0) -> str:
-        """Host a serve replica on this node; returns its RPC address."""
+                      startup_timeout: float = 120.0,
+                      epoch: Optional[int] = None) -> str:
+        """Host a serve replica on this node; returns its RPC address.
+        ``epoch`` stamps the placing head's fencing epoch (stale heads
+        are rejected typed — :class:`StaleEpochError`)."""
         import json
         try:
             return str(self._client.call(
                 "start_replica", replica_id, backend_ref,
-                json.dumps(init_kwargs or {}), devices, startup_timeout))
+                json.dumps(init_kwargs or {}), devices, startup_timeout,
+                epoch))
         except RpcError as e:
             raise self._translate(e) from None
 
-    def stop_replica(self, replica_id: str) -> bool:
-        return bool(self._client.call("stop_replica", replica_id))
+    def stop_replica(self, replica_id: str,
+                     epoch: Optional[int] = None) -> bool:
+        try:
+            return bool(self._client.call("stop_replica", replica_id,
+                                          epoch))
+        except RpcError as e:
+            raise self._translate(e) from None
 
     def list_replicas(self) -> Dict[str, Dict[str, Any]]:
         return self._client.call("list_replicas")

@@ -29,14 +29,21 @@ site.
 from __future__ import annotations
 
 import json
+import math
 import os
 import threading
 import time
 import uuid
+from collections import deque
 from typing import Any, Callable, Dict, List, Optional
 
 from tosem_tpu.chaos import hooks as _chaos
+from tosem_tpu.chaos import network as _net
+from tosem_tpu.cluster.fencing import EpochFence, StaleEpochError
 from tosem_tpu.cluster.node import NodeDrainingError, RemoteNode
+
+__all__ = ["NodeLostError", "StaleEpochError", "HeadJournal",
+           "FailureDetector", "NodePool"]
 
 
 class NodeLostError(RuntimeError):
@@ -52,6 +59,16 @@ class HeadJournal:
     Each :meth:`record` is one fsync'd line, so the journal survives a
     head crash mid-write (a torn final line is skipped on load — same
     contract as the trial progress files).
+
+    Epoch lease: opening a journal ACQUIRES the next epoch from the
+    fence file beside it (``<path>.epoch``), and every :meth:`record`
+    both re-checks the fence and stamps the event with the holder's
+    epoch. A head that was partitioned away while a replacement
+    recovered (which re-opened the journal and therefore bumped the
+    fence) gets :class:`StaleEpochError` on its next append — split-
+    brain journal writes are REJECTED at the write, and ``reconcile``
+    additionally drops any stale-epoch line that slipped in during the
+    handoff window.
     """
 
     def __init__(self, path: str):
@@ -59,8 +76,12 @@ class HeadJournal:
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
         self._lock = threading.Lock()
         self._f = open(path, "ab")
+        self.fence = EpochFence(path + ".epoch")
+        self.epoch = self.fence.acquire()
 
     def record(self, event: str, **fields: Any) -> None:
+        self.fence.check(self.epoch)
+        fields.setdefault("epoch", self.epoch)
         line = json.dumps({"event": event, **fields},
                           sort_keys=True).encode() + b"\n"
         with self._lock:
@@ -98,15 +119,30 @@ class HeadJournal:
         trials started-but-not-finished (with their last known node),
         plus the serving control plane — deployments declared and the
         replica placements live at crash time, so a recovered head can
-        rebuild the routing table (``ClusterServe.recover``)."""
+        rebuild the routing table (``ClusterServe.recover``).
+
+        Epoch discipline: the replay tracks the highest epoch any event
+        has carried so far and DROPS events stamped with an older one —
+        a stale head that raced a line into the journal during the
+        recovery handoff cannot resurrect a placement or membership the
+        new head already superseded. Events without an epoch field
+        (pre-lease journals) always apply."""
         nodes: Dict[str, str] = {}           # name -> address
         work: Dict[str, Dict[str, Any]] = {}
         trials: Dict[str, Dict[str, Any]] = {}
         deployments: Dict[str, Dict[str, Any]] = {}
         placements: Dict[str, Dict[str, Any]] = {}  # replica_id -> event
         train_jobs: Dict[str, Dict[str, Any]] = {}  # job -> progress
+        epoch = 0
+        stale_dropped = 0
         for e in events:
             ev = e.get("event")
+            e_epoch = e.get("epoch")
+            if e_epoch is not None:
+                if int(e_epoch) < epoch:
+                    stale_dropped += 1
+                    continue                # stale-head write: fenced out
+                epoch = int(e_epoch)
             if ev == "node_added":
                 nodes[e["name"]] = e["address"]
             elif ev == "node_removed":
@@ -151,30 +187,66 @@ class HeadJournal:
         return {"nodes": nodes, "outstanding_work": work,
                 "outstanding_trials": trials,
                 "deployments": deployments, "placements": placements,
-                "train_jobs": train_jobs}
+                "train_jobs": train_jobs, "epoch": epoch,
+                "stale_dropped": stale_dropped}
 
 
 # ------------------------------------------------------ failure detector
 
 
 class FailureDetector:
-    """Heartbeat-based liveness: a node missing ``miss_threshold``
-    consecutive probes is declared dead exactly once (``on_dead``
-    callback), after which it is no longer probed. Run the background
-    thread via :meth:`start`, or call :meth:`check_once` from a test
-    for a deterministic sweep."""
+    """Adaptive (phi-accrual-style) liveness detection.
+
+    The fixed miss counter survives as the FLOOR — ``miss_threshold``
+    consecutive failed probes still declare death exactly once
+    (``on_dead``), keeping the crash-stop behaviour deterministic for
+    tests. On top of it:
+
+    - **Suspicion before death.** The first missed probe moves a node
+      to ``SUSPECT`` (``on_suspect(name, node, True)``) so the serving
+      layer can de-preference its replicas and prep a drain BEFORE the
+      node is declared dead; a successful probe clears suspicion
+      (``on_suspect(name, node, False)``). Query with :meth:`state` /
+      :meth:`suspects`.
+    - **Phi-accrual acceleration.** Each node's successful-probe
+      inter-arrival history (Hayashibara et al.) yields
+      ``phi = elapsed / (mean · ln 10)`` — the exponential-tail
+      suspicion level. A missed probe whose phi already exceeds
+      ``dead_phi`` skips the remaining miss budget: a node that has
+      been silent for many learned intervals is declared dead on
+      evidence, not on a fixed count.
+    - **Concurrent probing** (one thread per target, joined against a
+      shared deadline): one wedged node costs ONE probe timeout for
+      the whole sweep, not one per node behind it in iteration order.
+      A probe that has not returned by the deadline counts as a miss
+      for this sweep.
+
+    Emulated-network faults (:mod:`tosem_tpu.chaos.network`) apply at
+    the probe: a head↔node partition fails the probe outright, a
+    slow-node fault stalls it by the injected delay — so partition and
+    gray-slow chaos plans exercise exactly this code path.
+    """
 
     def __init__(self, interval_s: float = 0.5, miss_threshold: int = 3,
                  probe_timeout: float = 2.0,
-                 on_dead: Optional[Callable[[str, RemoteNode], None]] = None):
+                 on_dead: Optional[Callable[[str, RemoteNode], None]] = None,
+                 on_suspect: Optional[
+                     Callable[[str, RemoteNode, bool], None]] = None,
+                 dead_phi: float = 3.0, history: int = 32):
         self.interval_s = interval_s
         self.miss_threshold = max(1, miss_threshold)
         self.probe_timeout = probe_timeout
         self.on_dead = on_dead
+        self.on_suspect = on_suspect
+        self.dead_phi = dead_phi
         self._lock = threading.Lock()
         self._nodes: Dict[str, RemoteNode] = {}
         self._misses: Dict[str, int] = {}
         self._dead: Dict[str, RemoteNode] = {}
+        self._suspect: Dict[str, bool] = {}
+        self._last_ok: Dict[str, float] = {}
+        self._intervals: Dict[str, deque] = {}
+        self._history = max(2, history)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -183,12 +255,18 @@ class FailureDetector:
             self._nodes[name] = node
             self._misses[name] = 0
             self._dead.pop(name, None)
+            self._suspect.pop(name, None)
+            self._last_ok.pop(name, None)
+            self._intervals[name] = deque(maxlen=self._history)
 
     def remove(self, name: str) -> None:
         with self._lock:
             self._nodes.pop(name, None)
             self._misses.pop(name, None)
             self._dead.pop(name, None)
+            self._suspect.pop(name, None)
+            self._last_ok.pop(name, None)
+            self._intervals.pop(name, None)
 
     def live_names(self) -> List[str]:
         with self._lock:
@@ -198,36 +276,145 @@ class FailureDetector:
         with self._lock:
             return name in self._dead
 
+    def is_suspect(self, name: str) -> bool:
+        with self._lock:
+            return bool(self._suspect.get(name))
+
+    def suspects(self) -> List[str]:
+        with self._lock:
+            return [n for n, s in self._suspect.items() if s]
+
+    def state(self, name: str) -> str:
+        """``"alive"`` | ``"suspect"`` | ``"dead"`` | ``"unknown"``."""
+        with self._lock:
+            if name in self._dead:
+                return "dead"
+            if self._suspect.get(name):
+                return "suspect"
+            if name in self._nodes:
+                return "alive"
+            return "unknown"
+
+    def phi(self, name: str, now: Optional[float] = None) -> float:
+        """Suspicion level: how many decades of improbability the
+        current silence represents under an exponential model of the
+        node's learned probe inter-arrival times. 0.0 with no history;
+        ~0.43 after one mean interval; past :attr:`dead_phi` the node
+        has been silent for ``dead_phi·ln10`` mean intervals."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            last = self._last_ok.get(name)
+            hist = self._intervals.get(name)
+            if last is None or not hist:
+                return 0.0
+            mean = sum(hist) / len(hist)
+        if mean <= 0.0:
+            return 0.0
+        return max(0.0, (now - last) / (mean * math.log(10.0)))
+
     def declare_dead(self, name: str) -> None:
         """Out-of-band death report (e.g. a submit hit a closed socket):
         skip the remaining probe budget — the caller KNOWS."""
         with self._lock:
             node = self._nodes.pop(name, None)
             self._misses.pop(name, None)
+            self._suspect.pop(name, None)
             if node is None:
                 return
             self._dead[name] = node
         if self.on_dead is not None:
             self.on_dead(name, node)
 
+    def _probe_one(self, name: str, node: RemoteNode,
+                   results: Dict[str, bool]) -> None:
+        net = _net.state()
+        delay = net.delay(name)
+        if delay > 0:
+            time.sleep(delay)
+        if net.dropped(_net.HEAD, name):
+            results[name] = False
+            return
+        try:
+            results[name] = node.alive(timeout=self.probe_timeout)
+        except Exception:
+            results[name] = False
+
     def check_once(self) -> List[str]:
         """One probe sweep; returns names declared dead BY this sweep."""
         with self._lock:
             targets = list(self._nodes.items())
+        # chaos seam: one ``cluster.probe`` event per node per sweep
+        # (fired in registration order BEFORE the probes launch, so
+        # ordinals stay deterministic even though probing is
+        # concurrent). partition/heal/slow_node mutate the emulated
+        # network that the probes below consult.
+        for name, _node in targets:
+            act = _chaos.fire("cluster.probe", target=name)
+            if act is None:
+                continue
+            net = _net.state()
+            if act["action"] == "partition":
+                net.partition([_net.HEAD], [name])
+            elif act["action"] == "heal":
+                net.heal()
+            elif act["action"] == "slow_node":
+                net.slow_node(name, act.get("delay_s") or 0.0)
+        results: Dict[str, bool] = {}
+        if len(targets) == 1:
+            # single node: no thread tax, identical semantics
+            self._probe_one(targets[0][0], targets[0][1], results)
+        elif targets:
+            threads = []
+            for name, node in targets:
+                t = threading.Thread(target=self._probe_one,
+                                     args=(name, node, results),
+                                     daemon=True,
+                                     name=f"tosem-probe-{name}")
+                t.start()
+                threads.append(t)
+            # shared deadline: the sweep costs ONE probe budget total,
+            # however many nodes hang; stragglers count as misses
+            deadline = time.monotonic() + self.probe_timeout + 0.5
+            for t in threads:
+                t.join(timeout=max(0.0, deadline - time.monotonic()))
         died: List[str] = []
+        now = time.monotonic()
+        suspect_events: List[tuple] = []
         for name, node in targets:
-            ok = node.alive(timeout=self.probe_timeout)
+            ok = results.get(name, False)   # unreturned probe = miss
+            declare = False
             with self._lock:
                 if name not in self._nodes:
                     continue        # removed/declared dead concurrently
                 if ok:
+                    last = self._last_ok.get(name)
+                    if last is not None:
+                        self._intervals[name].append(now - last)
+                    self._last_ok[name] = now
                     self._misses[name] = 0
+                    if self._suspect.pop(name, None):
+                        suspect_events.append((name, node, False))
                     continue
                 self._misses[name] = self._misses.get(name, 0) + 1
-                if self._misses[name] < self.miss_threshold:
-                    continue
-            self.declare_dead(name)
-            died.append(name)
+                if not self._suspect.get(name):
+                    self._suspect[name] = True
+                    suspect_events.append((name, node, True))
+                if self._misses[name] >= self.miss_threshold:
+                    declare = True
+            if not declare and self._misses.get(name, 0) >= 2 \
+                    and self.phi(name, now) >= self.dead_phi:
+                declare = True      # phi-accrual acceleration
+            if declare:
+                self.declare_dead(name)
+                died.append(name)
+        if self.on_suspect is not None:
+            for name, node, entering in suspect_events:
+                if name in died:
+                    continue        # went straight to dead this sweep
+                try:
+                    self.on_suspect(name, node, entering)
+                except Exception:
+                    pass            # suspicion callbacks are advisory
         return died
 
     def start(self) -> "FailureDetector":
@@ -280,11 +467,22 @@ class NodePool:
         # serving controller re-places a dead node's replicas through
         # one of these) — called AFTER the pool's own resubmission
         self._death_listeners: List[Callable[[str, RemoteNode], None]] = []
+        # suspicion listeners: fired on SUSPECT enter/clear so the
+        # serving layer can de-preference a gray node's replicas
+        self._suspect_listeners: List[
+            Callable[[str, RemoteNode, bool], None]] = []
         self.detector = FailureDetector(
             interval_s=heartbeat_interval_s, miss_threshold=miss_threshold,
-            probe_timeout=probe_timeout, on_dead=self._on_node_dead)
+            probe_timeout=probe_timeout, on_dead=self._on_node_dead,
+            on_suspect=self._on_node_suspect)
         if start_detector:
             self.detector.start()
+
+    @property
+    def epoch(self) -> int:
+        """This head's epoch lease (0 when running journal-less —
+        unfenced receivers accept epoch-less writes)."""
+        return self._journal.epoch if self._journal is not None else 0
 
     # -- membership ----------------------------------------------------
 
@@ -326,6 +524,25 @@ class NodePool:
         stop the detector sweep or other listeners."""
         with self._lock:
             self._death_listeners.append(fn)
+
+    def add_suspect_listener(
+            self, fn: Callable[[str, RemoteNode, bool], None]) -> None:
+        """Run ``fn(name, node, entering)`` when a node enters
+        (``True``) or clears (``False``) the detector's SUSPECT state —
+        the pre-death hook for router de-preferencing and drain prep."""
+        with self._lock:
+            self._suspect_listeners.append(fn)
+
+    def _on_node_suspect(self, name: str, node: RemoteNode,
+                         entering: bool) -> None:
+        with self._lock:
+            listeners = list(self._suspect_listeners)
+        for fn in listeners:
+            try:
+                fn(name, node, entering)
+            except Exception as e:
+                self._record("suspect_listener_error", name=name,
+                             error=repr(e))
 
     def _on_node_dead(self, name: str, node: RemoteNode) -> None:
         """Detector callback: drop the corpse and resubmit its trials
@@ -512,6 +729,10 @@ class NodePool:
         state = HeadJournal.reconcile(HeadJournal.load(journal_path))
         pool = cls(journal_path=journal_path, probe_timeout=probe_timeout,
                    **kwargs)
+        # opening the journal acquired the NEXT epoch from the fence —
+        # the old holder's first append after this line raises
+        # StaleEpochError; record the handoff for the audit trail
+        pool._record("head_recovered", prev_epoch=state.get("epoch", 0))
         for name, address in state["nodes"].items():
             node = RemoteNode(address)
             if node.alive(timeout=probe_timeout):

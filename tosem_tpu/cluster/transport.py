@@ -44,6 +44,8 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from tosem_tpu.chaos import hooks as _chaos
+from tosem_tpu.chaos import network as _net
 from tosem_tpu.obs import metrics as _metrics
 
 __all__ = ["TensorReceiver", "send_tensors", "send_kv_payload",
@@ -79,7 +81,8 @@ def transport_counters():
     ``cluster_transport_bytes_total`` counts payload bytes by
     ``direction`` (sent/received) and
     ``cluster_transport_streams_total`` stream outcomes by ``outcome``
-    (ok/error)."""
+    (ok/error/duplicate — duplicate being a re-sent stream dropped by
+    the receiver's by-key dedupe)."""
     return {
         "bytes": _metrics.counter(
             "cluster_transport_bytes_total",
@@ -316,23 +319,29 @@ class TensorReceiver:
             conn.close()
             return
         key = rx.meta.get("key")
-        stale = None
+        duplicate = False
         with self._cv:
             self._received += 1
             self._bytes += rx.nbytes
             if key is not None:
-                # latest wins: a re-sent stream (at-least-once admit
-                # replay) must not pin TWO copies of the payload in
-                # the receive segment forever
-                stale = self._by_key.pop(str(key), None)
-                self._by_key[str(key)] = rx
+                if str(key) in self._by_key:
+                    # duplicate delivery: the sender's COMMIT ack was
+                    # lost and it re-sent the whole stream. The FIRST
+                    # copy is the committed one — consumers may already
+                    # hold views over it — so the replay is drained
+                    # (fully read above) and DROPPED, never clobbering
+                    # the parked payload and never pinning two copies
+                    duplicate = True
+                else:
+                    self._by_key[str(key)] = rx
             else:
                 self._fifo.put(rx)
             self._cv.notify_all()
-        if stale is not None:
-            stale.release()
+        if duplicate:
+            rx.release()
         self._metrics["bytes"].inc(rx.nbytes, ("received",))
-        self._metrics["streams"].inc(1, ("ok",))
+        self._metrics["streams"].inc(
+            1, ("duplicate" if duplicate else "ok",))
         try:
             conn.sendall(b"OK")
         except OSError:
@@ -504,10 +513,43 @@ def send_tensors(address: str, meta: Dict[str, Any],
     CPU-saturated single host, loopback transfer time is pure CPU work
     (memcpy + syscalls), so nothing can hide behind it; pacing restores
     the cross-node regime — wire time the host CPUs do NOT pay for —
-    which is what comms/compute overlap actually hides on a cluster."""
+    which is what comms/compute overlap actually hides on a cluster.
+
+    Chaos seam: ``transport.send`` fires once per stream (target: the
+    stream key, falling back to the address). Action ``drop`` severs
+    the stream (:class:`TransportError` — what a partition does to an
+    in-flight transfer), ``delay`` stalls it, ``dup_stream`` replays
+    the committed stream in full (the lost-ack retry the receiver's
+    by-key dedupe must absorb). The emulated network
+    (:mod:`tosem_tpu.chaos.network`) applies too: a partition between
+    ``meta["src_node"]`` and ``meta["dst_node"]`` (defaulting to
+    head↔address) drops the stream, and an armed ``dup_stream`` is
+    consumed per send."""
     import numpy as np
     if chunk_bytes < 1:
         raise ValueError("chunk_bytes must be >= 1")
+    dup_replay = False
+    act = _chaos.fire("transport.send",
+                      target=str(meta.get("key") or address))
+    if act is not None:
+        if act.get("delay_s"):
+            time.sleep(act["delay_s"])
+        if act["action"] == "drop":
+            raise TransportError(
+                f"chaos: stream to {address} dropped (partition)")
+        if act["action"] == "dup_stream":
+            dup_replay = True
+    net = _net.state()
+    src = str(meta.get("src_node", _net.HEAD))
+    dst = str(meta.get("dst_node", address))
+    if net.dropped(src, dst):
+        raise TransportError(
+            f"stream {src} -> {dst} dropped: network partition")
+    extra = net.delay(dst)
+    if extra > 0:
+        time.sleep(extra)
+    if net.take_dup():
+        dup_replay = True
     specs, views, total = [], [], 0
     for name, arr in arrays.items():
         a = np.ascontiguousarray(arr)
@@ -526,50 +568,66 @@ def send_tensors(address: str, meta: Dict[str, Any],
                          "meta": meta}).encode()
     host, _, port = address.rpartition(":")
     mets = transport_counters()
-    try:
-        sock = socket.create_connection((host or "127.0.0.1", int(port)),
-                                        timeout=timeout)
-    except OSError as e:
-        raise TransportError(f"connect to {address} failed: {e}")
-    try:
-        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        sock.settimeout(timeout)
+
+    def _send_once() -> None:
         try:
-            sock.sendall(MAGIC + _HLEN.pack(len(header)) + header)
-            idx, off = 0, 0
-            t0 = time.monotonic()
-            for v in views:
-                pos = 0
-                while pos < v.nbytes:
-                    n = min(chunk_bytes, v.nbytes - pos)
-                    sock.sendall(_CHUNK.pack(idx, off, n))
-                    sock.sendall(v[pos:pos + n])
-                    pos += n
-                    off += n
-                    idx += 1
-                    if pace_bps:
-                        # sleep until the cumulative payload rate drops
-                        # back under the emulated wire bandwidth
-                        lag = off / pace_bps - (time.monotonic() - t0)
-                        if lag > 0:
-                            time.sleep(lag)
-            sock.sendall(_CHUNK.pack(_FIN_INDEX, off, 0))
-            ack = _recv_exact(sock, 2, "ack")
-        except socket.timeout:
-            raise TransportError(f"send to {address} timed out")
+            sock = socket.create_connection(
+                (host or "127.0.0.1", int(port)), timeout=timeout)
         except OSError as e:
-            raise TransportError(f"send to {address} failed: {e}")
-        if ack == b"OK":
-            mets["bytes"].inc(total, ("sent",))
-            return total
-        if ack == b"ER":
-            (elen,) = _HLEN.unpack(_recv_exact(sock, 4, "error length"))
-            err = _recv_exact(sock, min(elen, 4096), "error").decode(
-                "utf-8", "replace")
-            raise TransportError(f"receiver rejected stream: {err}")
-        raise WireFormatError(f"bad ack {ack!r}")
-    finally:
-        sock.close()
+            raise TransportError(f"connect to {address} failed: {e}")
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            sock.settimeout(timeout)
+            try:
+                sock.sendall(MAGIC + _HLEN.pack(len(header)) + header)
+                idx, off = 0, 0
+                t0 = time.monotonic()
+                for v in views:
+                    pos = 0
+                    while pos < v.nbytes:
+                        n = min(chunk_bytes, v.nbytes - pos)
+                        sock.sendall(_CHUNK.pack(idx, off, n))
+                        sock.sendall(v[pos:pos + n])
+                        pos += n
+                        off += n
+                        idx += 1
+                        if pace_bps:
+                            # sleep until the cumulative payload rate
+                            # drops back under the emulated bandwidth
+                            lag = (off / pace_bps
+                                   - (time.monotonic() - t0))
+                            if lag > 0:
+                                time.sleep(lag)
+                sock.sendall(_CHUNK.pack(_FIN_INDEX, off, 0))
+                ack = _recv_exact(sock, 2, "ack")
+            except socket.timeout:
+                raise TransportError(f"send to {address} timed out")
+            except OSError as e:
+                raise TransportError(f"send to {address} failed: {e}")
+            if ack == b"OK":
+                return
+            if ack == b"ER":
+                (elen,) = _HLEN.unpack(
+                    _recv_exact(sock, 4, "error length"))
+                err = _recv_exact(sock, min(elen, 4096), "error").decode(
+                    "utf-8", "replace")
+                raise TransportError(f"receiver rejected stream: {err}")
+            raise WireFormatError(f"bad ack {ack!r}")
+        finally:
+            sock.close()
+
+    _send_once()
+    mets["bytes"].inc(total, ("sent",))
+    if dup_replay:
+        # the lost-ack retry: the stream committed but chaos "lost" the
+        # OK, so the sender replays the WHOLE stream — the receiver's
+        # by-key dedupe drains and drops it. Replay failures are noise
+        # (the payload already landed), not caller errors.
+        try:
+            _send_once()
+        except (TransportError, WireFormatError):
+            pass
+    return total
 
 
 # --------------------------------------------------------------- KV glue

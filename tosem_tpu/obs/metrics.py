@@ -346,6 +346,13 @@ def cluster_serve_metrics(registry: Optional[Registry] = None
       SLO admission check — per priority class, split by shed reason
       (``est_wait`` = estimated wait over budget at arrival,
       ``slot_timeout`` = no dispatch slot freed within the budget).
+    - ``serve_router_hedges_total`` (counter, labels deployment/
+      outcome): hedged dispatches — ``fired`` counts second attempts
+      launched after the quantile-derived delay, ``won`` the subset
+      whose result beat the primary (tail absorbed).
+    - ``serve_suspect_nodes`` (gauge, labels node): 1 for each node
+      currently in the failure detector's SUSPECT state (routers
+      de-preference its replicas); the row disappears on clear/death.
 
     Departed label sets are REMOVED from the gauges (``Metric.remove``),
     never pinned at zero: a dead node's queue-depth row disappearing is
@@ -375,6 +382,14 @@ def cluster_serve_metrics(registry: Optional[Registry] = None
             "requests shed typed (Overloaded) by SLO admission, "
             "per priority class and shed reason",
             labels=("deployment", "class", "reason")),
+        "router_hedges": reg.counter(
+            "serve_router_hedges_total",
+            "hedged dispatches by outcome (fired / won)",
+            labels=("deployment", "outcome")),
+        "suspect_nodes": reg.gauge(
+            "serve_suspect_nodes",
+            "nodes currently SUSPECT in the failure detector",
+            labels=("node",)),
     }
 
 

@@ -43,7 +43,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 from tosem_tpu.chaos import hooks as _chaos
 from tosem_tpu.data.feeding import pad_target
 from tosem_tpu.obs.metrics import serve_metrics
-from tosem_tpu.runtime.common import TaskError
+from tosem_tpu.runtime.common import DeadlineExceeded, TaskError
 from tosem_tpu.serve.breaker import CircuitOpen
 
 # statuses on the replica→driver batch wire
@@ -123,6 +123,7 @@ class _Item:
     future: BatchedFuture
     probe: bool
     enqueued_at: float = field(default_factory=time.monotonic)
+    deadline: Optional[float] = None   # monotonic shed-by time
 
 
 class BatchingReplica:
@@ -255,11 +256,15 @@ class BatchQueue:
         creation and cross-thread wakeups are the dominant per-request
         cost on small hosts, not the batch bookkeeping). ``timeout``
         bounds the INLINE chain (get + backoff retries) so the sync
-        caller's deadline contract survives batching; it is ignored on
-        the queued path, where ``result(timeout)`` does the bounding."""
+        caller's deadline contract survives batching; on the queued
+        path it becomes the item's flush-time deadline — a request
+        whose budget expired while it queued is shed typed
+        (:class:`~tosem_tpu.runtime.common.DeadlineExceeded`) at
+        dispatch instead of riding the batch to an answer its caller
+        already abandoned (its batchmates dispatch untouched)."""
         deadline = (None if timeout is None
                     else time.monotonic() + timeout)
-        item = _Item(request, BatchedFuture(), probe)
+        item = _Item(request, BatchedFuture(), probe, deadline=deadline)
         bucket = self.policy.bucket_of(request)
         items = None
         with self._cv:
@@ -383,6 +388,24 @@ class BatchQueue:
                   deadline: Optional[float] = None) -> None:
         name = self._dep.name
         now = time.monotonic()
+        # flush-time deadline shed: an item whose budget expired while
+        # it queued fails ALONE, typed, before any replica work — its
+        # batchmates dispatch as if it never queued. No breaker verdict
+        # (the deployment did nothing wrong; the budget was just small).
+        expired = [it for it in items
+                   if it.deadline is not None and now >= it.deadline]
+        if expired:
+            items = [it for it in items if it not in expired]
+            for it in expired:
+                self._release_probe(it)
+                it.future._set_exception(DeadlineExceeded(
+                    f"request budget expired after "
+                    f"{(now - it.enqueued_at) * 1e3:.0f}ms in the "
+                    f"{name!r} batch queue"))
+            self._count(err=len(expired))
+            if not items:
+                self._batch_done_locked_dec()
+                return
         self._metrics["batch_size"].set(len(items), (name,))
         for it in items:
             self._metrics["batch_wait_ms"].observe(
@@ -613,11 +636,25 @@ class DecodePolicy:
     sequence's KV pages migrate to a decode replica over the live-KV-
     migration path before its first step. Requires a backend with the
     migration surface (``export_seq``/``import_seq``); the remaining
-    replicas serve decode steps."""
+    replicas serve decode steps.
+
+    ``straggler_factor`` > 0 arms the slow-replica watchdog (gray-
+    failure recovery): a replica whose recent median step time exceeds
+    ``straggler_factor`` × the fleet median (with at least
+    ``straggler_min_samples`` steps observed and an absolute floor of
+    ``straggler_min_s`` — tiny steps jitter) is DRAINED through the
+    live-migration path, exactly like a deliberate node drain: its
+    sequences continue from their current step on healthy replicas
+    instead of decoding at the straggler's pace until a 120s step
+    timeout finally declares it dead. Off by default (0.0) — single-
+    replica fleets and deterministic tests must never self-drain."""
     max_active: int = 8
     idle_wait_s: float = 0.01
     sampling: Optional[SamplingPolicy] = None
     prefill_replicas: int = 0
+    straggler_factor: float = 0.0
+    straggler_min_samples: int = 3
+    straggler_min_s: float = 0.02
 
     def __post_init__(self):
         if self.max_active < 1:
@@ -626,6 +663,10 @@ class DecodePolicy:
             raise ValueError("idle_wait_s must be >= 0")
         if self.prefill_replicas < 0:
             raise ValueError("prefill_replicas must be >= 0")
+        if self.straggler_factor < 0:
+            raise ValueError("straggler_factor must be >= 0")
+        if self.straggler_min_samples < 1:
+            raise ValueError("straggler_min_samples must be >= 1")
         if self.sampling is not None and self.sampling.n > self.max_active:
             raise ValueError(
                 f"sampling.n={self.sampling.n} exceeds max_active="
@@ -713,6 +754,13 @@ class DecodeQueue:
         self._prefilling: List[Tuple[_DecodeItem, Any]] = []
         self._prefilled: collections.deque = collections.deque()
         self._importing: List[Tuple[_DecodeItem, Any, float]] = []
+        # straggler watchdog state: recent per-replica step times keyed
+        # id(replica), replicas quarantined after a straggler drain
+        # (admission routes around them until they die or recover), and
+        # the drain count for stats/tests
+        self._step_times: Dict[int, collections.deque] = {}
+        self._quarantined: set = set()
+        self._straggler_drains = 0
         # decode-replica tensor-receiver addresses, fetched once per
         # replica (the worker→worker page-stream destinations)
         self._transport_addrs: Dict[int, str] = {}
@@ -828,6 +876,8 @@ class DecodeQueue:
                 "prefilling_sequences": len(self._prefilling)
                 + len(self._prefilled),
                 "scheduler_loop_errors": self._loop_errors,
+                "straggler_drains": self._straggler_drains,
+                "straggler_quarantined": len(self._quarantined),
             }
             out.update({f"kv_{k}": v
                         for k, v in sorted(self._cache_stats.items())})
@@ -935,6 +985,16 @@ class DecodeQueue:
         _, replicas = self._split_replicas()
         if exclude is not None:
             replicas = [r for r in replicas if r is not exclude]
+        with self._lock:
+            quarantined = set(self._quarantined)
+        if quarantined:
+            # a drained straggler keeps its process but loses admission
+            # preference: route around it while ANY healthy replica has
+            # room (it still serves as the last resort — a quarantined
+            # fleet must not deadlock the queue)
+            healthy = [r for r in replicas if id(r) not in quarantined]
+            if healthy:
+                replicas = healthy
         if not replicas:
             if exclude is not None:
                 return None       # nowhere else: caller falls back
@@ -1038,6 +1098,8 @@ class DecodeQueue:
             self._importing = [e for e in self._importing
                                if e[0].replica is not replica]
             self._transport_addrs.pop(id(replica), None)
+            self._step_times.pop(id(replica), None)
+            self._quarantined.discard(id(replica))
         if not affected:
             return
         breaker = self._dep.breaker
@@ -1611,6 +1673,7 @@ class DecodeQueue:
                     [it.step for it in items])
             except BaseException as e:
                 self._on_replica_death(replica, e)
+        elapsed = self._time_steps(refs)
         for key in order:
             if key not in refs:
                 continue
@@ -1702,8 +1765,78 @@ class DecodeQueue:
                         f"{self.PRESSURE_STALL_LIMIT} eviction attempts"))
                 elif others or rotating:
                     self._spill_item(pressured)
+        self._check_stragglers(elapsed, handles)
         with self._lock:
             self._steps += 1
+
+    def _time_steps(self, refs: Dict[int, Any]) -> Dict[int, float]:
+        """Per-replica wall time of THIS iteration's concurrent step
+        dispatches, measured as each ref completes (an in-order reap
+        would charge a slow replica's wait to every replica reaped
+        after it). Only runs with the watchdog armed and a fleet to
+        compare — otherwise zero overhead and zero behavior change."""
+        if self.policy.straggler_factor <= 0 or len(refs) < 2:
+            return {}
+        import tosem_tpu.runtime as rt
+        t0 = time.monotonic()
+        by_ref = {ref: key for key, ref in refs.items()}
+        waiting = list(refs.values())
+        deadline = t0 + 120.0
+        elapsed: Dict[int, float] = {}
+        while waiting:
+            budget = deadline - time.monotonic()
+            if budget <= 0:
+                break             # hung replica: the reap loop's case
+            try:
+                done, waiting = rt.wait(waiting, num_returns=1,
+                                        timeout=budget)
+            except BaseException:
+                break
+            if not done:
+                break
+            now = time.monotonic()
+            for ref in done:
+                elapsed[by_ref[ref]] = now - t0
+        return elapsed
+
+    def _check_stragglers(self, elapsed: Dict[int, float],
+                          handles: Dict[int, Any]) -> None:
+        """Slow-replica watchdog: a replica whose recent MEDIAN step
+        time exceeds ``straggler_factor`` × the fleet median is drained
+        through the live-migration path (sequences continue from their
+        current step elsewhere — the node-drain machinery, fired by
+        detection instead of an operator) and quarantined from new
+        admissions. Robust by construction: medians on both axes, an
+        absolute floor, and a minimum sample count — one GC pause must
+        not drain a healthy replica."""
+        if not elapsed:
+            return
+        import statistics
+        with self._lock:
+            for key, dt in elapsed.items():
+                self._step_times.setdefault(
+                    key, collections.deque(maxlen=32)).append(dt)
+            meds = {key: statistics.median(self._step_times[key])
+                    for key in elapsed
+                    if len(self._step_times[key])
+                    >= self.policy.straggler_min_samples
+                    and key not in self._quarantined}
+        if len(meds) < 2:
+            return                # no fleet to compare against
+        fleet = statistics.median(meds.values())
+        worst = max(meds, key=lambda k: meds[k])
+        threshold = max(self.policy.straggler_factor * fleet,
+                        self.policy.straggler_min_s)
+        if meds[worst] <= threshold:
+            return
+        victim = handles.get(worst)
+        if victim is None:
+            return
+        with self._lock:
+            self._step_times.pop(worst, None)
+            self._quarantined.add(worst)
+            self._straggler_drains += 1
+        self.drain_replica(victim, migrate=True)
 
     # KV-page gauges need a replica round trip (cache_stats lives actor-
     # side); scraping every decode step would cost as much as the step

@@ -53,7 +53,13 @@ GATED_CLUSTER_BENCHES = (
     "cluster_decode_disagg_c16", "cluster_decode_coloc_c16",
     "cluster_decode_disagg_vs_coloc",
     "cluster_drain_migrate_vs_readmit",
+    "router_hedged_p99",
 )
+
+# the hedged-tail A/B's bench ids (its own small cluster + emulated-
+# network fault — kept out of the legacy router/failover block)
+HEDGE_BENCH_IDS = ("router_hedged_p99", "router_unhedged_p99",
+                   "router_hedge_tail_win")
 
 # ``cli microbench --cluster --scenario=...`` subsets (mirrors the
 # decode bench's SCENARIO_BENCHES shape)
@@ -137,8 +143,11 @@ def run_cluster_benchmarks(trials: int = 3, min_s: float = 0.5,
     em = SuiteEmitter("cluster", only)
     decode_ids = (set(CLUSTER_SCENARIOS["decode"])
                   | set(CLUSTER_SCENARIOS["migrate"]))
-    legacy_wanted = only is None or bool(set(only) - decode_ids)
+    hedge_ids = set(HEDGE_BENCH_IDS)
+    legacy_wanted = only is None or bool(
+        set(only) - decode_ids - hedge_ids)
     decode_wanted = only is None or bool(set(only) & decode_ids)
+    hedge_wanted = only is None or bool(set(only) & hedge_ids)
 
     own_runtime = not rt.is_initialized()
     if own_runtime:
@@ -146,6 +155,8 @@ def run_cluster_benchmarks(trials: int = 3, min_s: float = 0.5,
     try:
         if legacy_wanted:
             _router_failover_benchmarks(em, trials, min_s, only)
+        if hedge_wanted:
+            _router_hedge_benchmarks(em, trials, min_s)
         if decode_wanted:
             _cluster_decode_benchmarks(em, trials, min_s)
             _cluster_drain_benchmarks(em, trials, min_s)
@@ -386,6 +397,117 @@ def _router_failover_benchmarks(em: SuiteEmitter, trials: int,
         cs.close()
         pool.close(close_nodes=True)
         serve.delete("bench-ref")
+
+
+def _router_hedge_benchmarks(em: SuiteEmitter, trials: int,
+                             min_s: float) -> None:
+    """Hedged vs unhedged tail latency with one chaos-slowed replica,
+    interleaved A/B.
+
+    Two in-process routers share the SAME 2-replica deployment, table
+    pushes, and host phase; the only difference is the hedge knob.
+    The emulated network then turns one replica's node gray (100 ms
+    injected dispatch latency — ~20x the healthy service time, the
+    slow-but-alive fault crash-stop detection never sees). Per round,
+    both arms run the same sequential request train: the unhedged arm's
+    p99 IS the injected delay (half its picks land on the gray
+    replica), while the hedged arm must cap its p99 at roughly the
+    quantile-derived hedge delay plus one healthy service time. Hard
+    asserts: zero errors on both arms, hedges actually fired, and the
+    hedged p99 well under the injected delay; the gated
+    ``router_hedged_p99`` row holds the level release over release."""
+    from tosem_tpu.chaos import network as _net
+    from tosem_tpu.cluster.node import RemoteNode
+    from tosem_tpu.cluster.supervisor import NodePool
+    from tosem_tpu.serve.cluster_serve import ClusterServe
+    from tosem_tpu.serve.router import RouterCore, RouterPolicy
+
+    if not any(em.want(b) for b in HEDGE_BENCH_IDS):
+        return
+    slow_s = 0.1
+    pool = NodePool(miss_threshold=2, probe_timeout=3.0)
+    cs = None
+    try:
+        for i in range(2):
+            pool.add_node(RemoteNode.spawn_local(num_workers=2),
+                          name=f"n{i}")
+        cs = ClusterServe(
+            pool, num_routers=1, router_procs=False,
+            router_policy=RouterPolicy(hedge_after_s=0.02,
+                                       hedge_quantile=0.9,
+                                       hedge_min_samples=8))
+        # the unhedged control rides the same table pushes: register it
+        # before the deploy so every push reaches both routers
+        unhedged = RouterCore(name="router-unhedged",
+                              policy=RouterPolicy())
+        with cs._lock:
+            cs._routers.append(unhedged)
+        cs.deploy("hedge-bench", BACKEND_REF, num_replicas=2,
+                  strategy="spread", init_kwargs=dict(BACKEND_KW))
+        hedged = next(r for r in cs._routers_snapshot()
+                      if r is not unhedged)
+        # warm clients AND the latency rings: the first calls pay
+        # connection setup, and the hedge delay is a ring quantile —
+        # enough healthy samples must bury the cold-start outliers
+        # below the hedge quantile before the fault is armed
+        for router in (hedged, unhedged):
+            for i in range(32):
+                router.route("hedge-bench", {"x": i})
+        slow_node = cs.chaos_slow_replica_node("hedge-bench", slow_s)
+
+        def arm_p99_ms(router, n=48):
+            lat = []
+            for i in range(n):
+                t0 = time.perf_counter()
+                router.route("hedge-bench", {"x": i})
+                lat.append(time.perf_counter() - t0)
+            lat.sort()
+            return lat[min(int(len(lat) * 0.99), len(lat) - 1)] * 1e3
+
+        hedged_p99, unhedged_p99, wins = [], [], []
+        for _ in range(max(trials, 1)):
+            # one A/B round: both arms adjacent in time
+            a = arm_p99_ms(hedged)
+            b = arm_p99_ms(unhedged)
+            hedged_p99.append(a)
+            unhedged_p99.append(b)
+            wins.append(b / a if a else float("inf"))
+        hst, ust = hedged.stats(), unhedged.stats()
+        if hst["errors"] or ust["errors"]:
+            raise RuntimeError(
+                f"routed errors under the gray fault (hedged "
+                f"{hst['errors']}, unhedged {ust['errors']}) — a slow "
+                "node is not a dead node; nothing may fail")
+        if hst["hedged"] < 1 or hst["hedge_wins"] < 1:
+            raise RuntimeError(
+                f"the hedged arm never hedged (fired {hst['hedged']}, "
+                f"won {hst['hedge_wins']}) against a {slow_s * 1e3:.0f}"
+                "ms-gray replica")
+        if max(hedged_p99) >= slow_s * 1e3 * 0.8:
+            raise RuntimeError(
+                f"hedged p99 {max(hedged_p99):.0f}ms sits at the "
+                f"injected {slow_s * 1e3:.0f}ms gray delay — hedging "
+                "failed to cap the tail")
+        row = em.emit("router_hedged_p99",
+                      "hedged routed p99, one chaos-slowed replica",
+                      hedged_p99, unit="ms", lower_is_better=True)
+        if row is not None:
+            row.extra.update({
+                "slow_node": slow_node,
+                "injected_delay_ms": slow_s * 1e3,
+                "hedges_fired": hst["hedged"],
+                "hedge_wins": hst["hedge_wins"]})
+        em.emit("router_unhedged_p99",
+                "unhedged routed p99, one chaos-slowed replica",
+                unhedged_p99, unit="ms", lower_is_better=True)
+        em.emit("router_hedge_tail_win",
+                "unhedged vs hedged p99 under the gray fault",
+                wins, unit="x")
+    finally:
+        if cs is not None:
+            cs.close()
+        pool.close(close_nodes=True)
+        _net.state().reset()
 
 
 # ---------------------------------------------------------------------------

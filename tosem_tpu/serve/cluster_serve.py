@@ -35,9 +35,10 @@ Three layers:
   padded-program encode), one breaker trip per logical request.
 
 Chaos seam: ``serve.route`` fires per client request routed through a
-:class:`ClusterHandle` (actions ``kill_router`` / ``kill_node``), so
-the canned ``router-chaos`` plan can kill a router mid-traffic and
-then a replica node, deterministically.
+:class:`ClusterHandle` (actions ``kill_router`` / ``kill_node`` /
+``slow_node``), so the canned ``router-chaos`` plan can kill a router
+mid-traffic and then a replica node, and ``slow-node-hedge`` can turn
+one replica's node gray (alive but slow), deterministically.
 """
 from __future__ import annotations
 
@@ -49,10 +50,13 @@ import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 from tosem_tpu.chaos import hooks as _chaos
+from tosem_tpu.chaos import network as _net
+from tosem_tpu.cluster.fencing import StaleEpochError
 from tosem_tpu.cluster.gang import GangReservation, _plan, reserve_gang
 from tosem_tpu.cluster.node import RemoteNode
 from tosem_tpu.cluster.supervisor import NodePool
 from tosem_tpu.control.admission import Overloaded, SLOConfig
+from tosem_tpu.runtime.common import DeadlineExceeded
 from tosem_tpu.serve.breaker import CircuitOpen
 from tosem_tpu.serve.router import (NoReplicaAvailable, RemoteRouter,
                                     ReplicaAppError, RouterCore,
@@ -165,13 +169,14 @@ class ClusterHandle:
     def call(self, request: Any, timeout: Optional[float] = None,
              key: Optional[str] = None,
              klass: Optional[str] = None) -> Any:
-        """Route one request. ``timeout`` is accepted for interface
-        parity with :class:`~tosem_tpu.serve.core.Handle` but bounds
-        nothing here: the RPC layer fails fast on dead peers (the only
-        unbounded wait is a healthy backend legitimately computing).
-        ``klass`` names the priority class for SLO-admitted
-        deployments (decode classes preempt bulk in the router
-        queue)."""
+        """Route one request. ``timeout`` is the request's END-TO-END
+        deadline budget: the router sheds it typed
+        (:class:`~tosem_tpu.runtime.common.DeadlineExceeded`) the
+        moment the budget cannot be met — before admission, and at
+        every retry-loop top — instead of burning replica capacity on
+        an answer the client has already abandoned. ``klass`` names
+        the priority class for SLO-admitted deployments (decode
+        classes preempt bulk in the router queue)."""
         self._cs._fire_route_chaos(self._name)
         routers = self._cs._routers_snapshot()
         if not routers:
@@ -182,9 +187,9 @@ class ClusterHandle:
             router = routers[(start + k) % len(routers)]
             try:
                 return router.route(self._name, request, key=key,
-                                    klass=klass)
+                                    klass=klass, timeout_s=timeout)
             except (NoReplicaAvailable, ReplicaAppError, CircuitOpen,
-                    Overloaded):
+                    Overloaded, DeadlineExceeded):
                 raise               # typed verdicts: not a router death
             except (ConnectionError, TimeoutError, OSError) as e:
                 last = e            # router gone: fail over to the next
@@ -212,7 +217,9 @@ class ClusterHandle:
                 msg, retry_after=float(m.group(1)) if m else 0.0)
         for prefix, typ in (("NoReplicaAvailable(", NoReplicaAvailable),
                             ("ReplicaAppError(", ReplicaAppError),
-                            ("CircuitOpen(", CircuitOpen)):
+                            ("CircuitOpen(", CircuitOpen),
+                            ("DeadlineExceeded(", DeadlineExceeded),
+                            ("StaleEpochError(", StaleEpochError)):
             if msg.startswith(prefix):
                 return typ(msg)
         return e
@@ -259,6 +266,20 @@ class ClusterServe:
                 self._routers.append(
                     RouterCore(name=f"router{i}", policy=router_policy))
         pool.add_death_listener(self._on_node_dead)
+        # gray-failure wiring: SUSPECT nodes (detector phi-accrual /
+        # missed-probe state) are flagged in the pushed table so routers
+        # de-prefer — not drop — their replicas before death is declared
+        self._suspect_nodes: set = set()
+        add_suspect = getattr(pool, "add_suspect_listener", None)
+        if add_suspect is not None:
+            add_suspect(self._on_node_suspect)
+
+    @property
+    def epoch(self) -> int:
+        """The head's fencing epoch (the pool journal's lease term);
+        stamped on placements and KV adoptions so a superseded head's
+        writes are rejected typed by every receiver."""
+        return int(getattr(self.pool, "epoch", 0) or 0)
 
     # -- capacity / placement ------------------------------------------
 
@@ -306,7 +327,8 @@ class ClusterServe:
             address = node.start_replica(
                 replica_id, dep.backend_ref, init_kwargs,
                 devices=devices,
-                startup_timeout=self._replica_startup_timeout)
+                startup_timeout=self._replica_startup_timeout,
+                epoch=self.epoch)
         except BaseException:
             if gang is not None:
                 gang.release()
@@ -352,7 +374,8 @@ class ClusterServe:
         self._unpin_replica(dep, rep)
         if node is not None:
             try:
-                node.stop_replica(rep.replica_id)
+                node.stop_replica(rep.replica_id,
+                                      epoch=self.epoch)
             except Exception:
                 pass
         if rep.gang is not None:
@@ -603,7 +626,8 @@ class ClusterServe:
             node = live.get(v.node)
             if node is not None:
                 try:
-                    node.stop_replica(v.replica_id)
+                    node.stop_replica(v.replica_id,
+                                      epoch=self.epoch)
                 except Exception:
                     pass
             if v.gang is not None:
@@ -687,7 +711,8 @@ class ClusterServe:
             node = nodes.get(rep.node)
             if node is not None:
                 try:
-                    node.stop_replica(rep.replica_id)
+                    node.stop_replica(rep.replica_id,
+                                      epoch=self.epoch)
                 except Exception:
                     pass            # dead node: its replicas died too
             if rep.gang is not None:
@@ -711,7 +736,10 @@ class ClusterServe:
         with self._lock:
             self._version += 1
             version = self._version
-            table = {name: [rep.info() for rep in dep.replicas]
+            suspect = set(self._suspect_nodes)
+            table = {name: [dict(rep.info(),
+                                 suspect=(rep.node in suspect))
+                            for rep in dep.replicas]
                      for name, dep in self._deployments.items()}
             routers = list(self._routers)
             # each router admits 1/N of the deployment's budget: the
@@ -735,12 +763,37 @@ class ClusterServe:
 
     # -- failover ------------------------------------------------------
 
+    def _on_node_suspect(self, node_name: str, node: RemoteNode,
+                         entering: bool) -> None:
+        """Pool suspect listener (the detector's pre-death state): flag
+        the node's replicas in the routing table so routers de-prefer
+        them — traffic drains toward healthy replicas BEFORE the death
+        verdict, instead of piling retries onto a gray node — and clear
+        the flag when a probe succeeds again."""
+        with self._lock:
+            if entering:
+                self._suspect_nodes.add(node_name)
+            else:
+                self._suspect_nodes.discard(node_name)
+            if self._metrics is None:
+                from tosem_tpu.obs.metrics import cluster_serve_metrics
+                self._metrics = cluster_serve_metrics()
+            if entering:
+                self._metrics["suspect_nodes"].set(1.0, (node_name,))
+            else:
+                self._metrics["suspect_nodes"].remove((node_name,))
+        self._push_table()
+
     def _on_node_dead(self, node_name: str, node: RemoteNode) -> None:
         """Pool death listener: drop the node's replicas from routing
         (pushed immediately), then re-place them on survivors under
         the SAME replica ids — the hash ring stays stable, so affinity
         keys land on the re-placed replica, not a shuffled one."""
         with self._lock:
+            # a dead node's suspect flag (and its gauge row) dies with it
+            self._suspect_nodes.discard(node_name)
+            if self._metrics is not None:
+                self._metrics["suspect_nodes"].remove((node_name,))
             lost: List[Tuple[ClusterDeployment, ClusterReplica]] = []
             for dep in self._deployments.values():
                 mine = [r for r in dep.replicas if r.node == node_name]
@@ -858,7 +911,8 @@ class ClusterServe:
                     try:
                         src_cli.call("backend_call", "send_seq", sid,
                                      addr)
-                        dst_cli.call("backend_call", "adopt_seq", sid)
+                        dst_cli.call("backend_call", "adopt_seq", sid,
+                                     _epoch=self.epoch)
                         src_cli.call("backend_call", "release", sid)
                         migrated += 1
                     except (RpcError, ConnectionError,
@@ -911,7 +965,8 @@ class ClusterServe:
             self._unpin_replica(dep, rep)
             if node is not None:
                 try:
-                    node.stop_replica(rep.replica_id)
+                    node.stop_replica(rep.replica_id,
+                                      epoch=self.epoch)
                 except Exception:
                     pass
             if rep.gang is not None:
@@ -941,6 +996,24 @@ class ClusterServe:
             self.chaos_kill_router()
         elif act["action"] == "kill_node":
             self.chaos_kill_replica_node(deployment)
+        elif act["action"] == "slow_node":
+            self.chaos_slow_replica_node(
+                deployment, float(act.get("delay_s") or 0.0))
+
+    def chaos_slow_replica_node(self, deployment: str,
+                                delay_s: float) -> Optional[str]:
+        """Arm a gray fault: the node hosting ``deployment``'s LAST
+        replica answers every dispatch ``delay_s`` late (the emulated-
+        network state routers consult) — the node is alive and correct,
+        just slow. Hedged routing is what keeps the tail flat through
+        this; the ``slow-node-hedge`` plan pins exactly that."""
+        with self._lock:
+            dep = self._deployments.get(deployment)
+            if dep is None or not dep.replicas:
+                return None
+            node_name = dep.replicas[-1].node
+        _net.state().slow_node(node_name, delay_s)
+        return node_name
 
     def chaos_kill_router(self) -> Optional[str]:
         """SIGKILL the first live router process (chaos: the client's
@@ -999,6 +1072,7 @@ class ClusterServe:
                 listings[node_name] = node.list_replicas()
             except Exception:
                 listings[node_name] = {}
+        adopted: List[ClusterReplica] = []
         for rid, p in sorted(placements.items()):
             dep = cs._deployments.get(p["deployment"])
             if dep is None:
@@ -1017,6 +1091,7 @@ class ClusterServe:
                                        live[node_name]},
                         {live[node_name].address: rep.devices})
                 dep.replicas.append(rep)
+                adopted.append(rep)
                 pool.record_event("replica_adopted", deployment=dep.name,
                                   replica_id=rid, node=node_name)
                 # keep ids monotonic past the adopted ones
@@ -1042,8 +1117,29 @@ class ClusterServe:
                     pool.record_event("replica_lost",
                                       deployment=dep.name,
                                       replica_id=rid, error=repr(e))
+        # fence the survivors under the NEW epoch: every agent and every
+        # adopted replica advances its watermark, so the superseded
+        # head's stamped writes (placements, adopt_seq, stops) are
+        # rejected typed from here on — re-adoption IS the fencing point
+        cs._fence_survivors(live, adopted)
         cs._push_table()
         return cs
+
+    def _fence_survivors(self, live: Dict[str, RemoteNode],
+                         adopted: Sequence[ClusterReplica]) -> None:
+        from tosem_tpu.cluster.rpc import RpcClient
+        epoch = self.epoch
+        for node in live.values():
+            try:
+                node.fence(epoch)
+            except Exception:
+                pass            # unreachable agent: the detector's case
+        for rep in adopted:
+            try:
+                with RpcClient(rep.address) as cli:
+                    cli.call("fence", epoch)
+            except Exception:
+                pass            # dead replica: re-placement's case
 
     def _bump_rid(self, name: str, rid: str) -> None:
         """Advance the id counter past a journal-recovered replica id

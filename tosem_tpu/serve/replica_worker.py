@@ -34,6 +34,10 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+# import-light on purpose (os + threading only) — the fencing watermark
+# must be importable here without dragging in jax/numpy
+from tosem_tpu.cluster.fencing import Watermark
+
 
 def resolve_backend(ref: str):
     """``"module:qualname"`` → class/factory (the trainable_ref idiom
@@ -58,6 +62,11 @@ class ReplicaHandlers:
         self._served = 0
         self._errors = 0
         self._started = time.time()
+        # epoch watermark: control-plane writes stamped with an older
+        # head epoch than the highest this replica has seen are rejected
+        # typed (StaleEpochError) — a superseded head cannot double-
+        # adopt KV state or stop a replica the new head owns
+        self._epoch = Watermark()
 
     def _enter(self) -> None:
         with self._lock:
@@ -105,13 +114,30 @@ class ReplicaHandlers:
         ``export_seq`` / ``import_seq``) without widening the fixed
         data-plane RPC vocabulary. Only the tiny control messages ride
         this path; migrated page bytes stream replica→replica over
-        :mod:`tosem_tpu.cluster.transport` (no driver hop)."""
+        :mod:`tosem_tpu.cluster.transport` (no driver hop).
+
+        The reserved ``_epoch`` kwarg (never forwarded to the backend)
+        is the caller head's fencing epoch: a value below this
+        replica's watermark raises
+        :class:`~tosem_tpu.cluster.fencing.StaleEpochError` instead of
+        mutating state — the fence that makes a superseded head's
+        ``adopt_seq`` a typed no-op rather than a double adoption."""
+        epoch = kwargs.pop("_epoch", None)
+        self._epoch.check(epoch, what=f"backend_call:{method}")
         if method.startswith("_"):
             raise ValueError(f"backend method {method!r} is private")
         fn = getattr(self._backend, method, None)
         if not callable(fn):
             raise KeyError(f"backend has no method {method!r}")
         return fn(*args, **kwargs)
+
+    def fence(self, epoch: int) -> int:
+        """Advance the replica's epoch watermark (a recovered head
+        fences the replicas it re-adopts). Monotonic: fencing to an
+        OLDER epoch raises — the new head cannot be fenced out by a
+        delayed call from the superseded one."""
+        self._epoch.check(int(epoch), what="fence")
+        return self._epoch.epoch
 
     def load(self) -> int:
         with self._lock:

@@ -50,11 +50,15 @@ import subprocess
 import sys
 import threading
 import time
+from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
+from tosem_tpu.chaos import network as _net
+from tosem_tpu.runtime.common import DeadlineExceeded
 from tosem_tpu.serve.breaker import CircuitBreaker, CircuitOpen
 
 VNODES = 32          # hash-ring points per replica
+_HEDGE_POOL_WORKERS = 16   # reusable hedge-dispatch threads per router
 
 
 class NoReplicaAvailable(RuntimeError):
@@ -121,18 +125,34 @@ class RouterPolicy:
     operator configures actually reach process routers)."""
 
     def __init__(self, spill_depth: int = 4, scrape_ttl_s: float = 0.25,
-                 failure_threshold: int = 8, cooldown_s: float = 2.0):
+                 failure_threshold: int = 8, cooldown_s: float = 2.0,
+                 hedge_after_s: float = 0.0, hedge_quantile: float = 0.95,
+                 hedge_min_samples: int = 8):
         self.spill_depth = spill_depth
         self.scrape_ttl_s = scrape_ttl_s
         self.failure_threshold = failure_threshold
         self.cooldown_s = cooldown_s
+        # hedging (Dean, "The Tail at Scale"): hedge_after_s > 0 arms
+        # it — a request still in flight after the hedge delay is
+        # re-dispatched to a SECOND replica, first success wins. The
+        # delay starts at hedge_after_s and, once hedge_min_samples
+        # latencies are observed for a deployment, becomes that
+        # deployment's hedge_quantile latency — so hedges fire only in
+        # the tail the fleet itself defines, bounding the extra load to
+        # ~(1 - quantile) of traffic
+        self.hedge_after_s = hedge_after_s
+        self.hedge_quantile = hedge_quantile
+        self.hedge_min_samples = max(1, int(hedge_min_samples))
 
     def to_json(self) -> str:
         import json
         return json.dumps({"spill_depth": self.spill_depth,
                            "scrape_ttl_s": self.scrape_ttl_s,
                            "failure_threshold": self.failure_threshold,
-                           "cooldown_s": self.cooldown_s},
+                           "cooldown_s": self.cooldown_s,
+                           "hedge_after_s": self.hedge_after_s,
+                           "hedge_quantile": self.hedge_quantile,
+                           "hedge_min_samples": self.hedge_min_samples},
                           sort_keys=True)
 
     @classmethod
@@ -163,6 +183,18 @@ class RouterCore:
         self._spilled = 0         # affinity overridden by queue depth
         self._retried = 0         # transport-failure re-dispatches
         self._errors = 0          # logical requests ultimately failed
+        self._hedged = 0          # hedge attempts launched
+        self._hedge_wins = 0      # hedge attempts whose result was used
+        self._deadline_shed = 0   # requests shed expired before dispatch
+        # per-deployment latency rings feeding the quantile-derived
+        # hedge delay; suspects: node names the controller de-preferences
+        self._latency: Dict[str, deque] = {}
+        self._hedge_pool = None
+        # admission gate for the hedge pool: one permit per pool
+        # thread, so an attempt either starts immediately or spills to
+        # a one-shot thread — it never queues behind abandoned losers
+        # still sleeping out a gray replica's latency
+        self._hedge_slots = threading.Semaphore(_HEDGE_POOL_WORKERS)
         # per-(deployment, path) totals: what the controller mirrors
         # into the DRIVER registry for process routers (whose own
         # registries no scrape endpoint serves)
@@ -298,10 +330,15 @@ class RouterCore:
             self._rr += 1
             order = self._rr
         # least-loaded with round-robin tiebreak: equal-depth replicas
-        # share fresh traffic instead of one absorbing it all
+        # share fresh traffic instead of one absorbing it all. Replicas
+        # on SUSPECT nodes (failure detector missed a probe — gray, not
+        # yet dead) rank behind every healthy one: they still serve as
+        # a last resort, but fresh traffic prefers nodes answering
+        # their heartbeats
         n = len(live)
-        i = min(range(n), key=lambda j: (self._fresh_depth(live[j]),
-                                         (j - order) % n))
+        i = min(range(n), key=lambda j: (
+            1 if live[j].info.get("suspect") else 0,
+            self._fresh_depth(live[j]), (j - order) % n))
         return live[i]
 
     def _pick(self, dep: str, key: Optional[str],
@@ -328,6 +365,15 @@ class RouterCore:
             primary = ring[lo % len(ring)][1]
         if (primary is not None and primary.address not in exclude
                 and not primary.dead):
+            if primary.info.get("suspect"):
+                # affinity defers to suspicion: a warm cache on a node
+                # that stopped answering heartbeats is not worth the
+                # gray-latency risk — spill to a healthy replica and
+                # let a cleared suspicion restore affinity
+                best = self._least_loaded(links, exclude)
+                if best is not primary:
+                    return best, True
+                return primary, False
             depth = self._fresh_depth(primary)
             if depth < self.policy.spill_depth:
                 return primary, False
@@ -350,31 +396,217 @@ class RouterCore:
 
     def route(self, deployment: str, request: Any,
               key: Optional[str] = None,
-              klass: Optional[str] = None) -> Any:
+              klass: Optional[str] = None,
+              timeout_s: Optional[float] = None) -> Any:
         """Route one logical request; returns the backend's value.
         ``klass`` names the request's priority class for deployments
-        with SLO admission (unknown/None ranks 0 — bulk)."""
+        with SLO admission (unknown/None ranks 0 — bulk).
+
+        ``timeout_s`` is the request's end-to-end deadline budget:
+        expired work sheds as typed :class:`DeadlineExceeded` BEFORE
+        dispatch (and before admission — a request nobody is waiting
+        for must not occupy an admission slot or a replica), and every
+        retry re-checks the remaining budget."""
+        if timeout_s is not None and timeout_s <= 0:
+            with self._lock:
+                self._deadline_shed += 1
+            raise DeadlineExceeded(
+                f"request to {deployment!r} arrived with an expired "
+                f"deadline budget ({timeout_s:.3f}s)")
+        deadline = (None if timeout_s is None
+                    else time.monotonic() + timeout_s)
         with self._lock:
             adm = self._admission.get(deployment)
         if adm is None:
-            return self._route_admitted(deployment, request, key)
+            return self._route_admitted(deployment, request, key,
+                                        deadline=deadline)
         # admission BEFORE the breaker: a shed is a typed capacity
         # verdict (Overloaded, retry_after), not backend-failure
         # evidence — it must neither trip the breaker nor occupy a
         # half-open probe slot
         adm.admit(klass)               # may raise Overloaded
         try:
-            return self._route_admitted(deployment, request, key)
+            return self._route_admitted(deployment, request, key,
+                                        deadline=deadline)
         finally:
             adm.release()
 
+    # -- dispatch helpers ----------------------------------------------
+
+    def _call_replica(self, lk: _Link, request: Any) -> Dict[str, Any]:
+        """One dispatch to one replica. The emulated network's
+        slow-node fault applies HERE — gray latency on the wire to a
+        slow node's replicas, which is exactly the tail the hedge
+        delay must cover."""
+        gray = _net.state().delay(lk.info.get("node", ""))
+        if gray > 0:
+            time.sleep(gray)
+        return lk.client().call("call", request)
+
+    def _hedge_delay(self, deployment: str) -> Optional[float]:
+        """None when hedging is disarmed; otherwise the current hedge
+        delay — the policy floor until enough latencies are observed,
+        then the deployment's own hedge_quantile latency."""
+        if self.policy.hedge_after_s <= 0:
+            return None
+        with self._lock:
+            ring = self._latency.get(deployment)
+            samples = sorted(ring) if ring else []
+        if len(samples) < self.policy.hedge_min_samples:
+            return self.policy.hedge_after_s
+        q = min(max(self.policy.hedge_quantile, 0.0), 1.0)
+        idx = min(len(samples) - 1, int(q * len(samples)))
+        return max(samples[idx], 1e-4)
+
+    def _record_latency(self, deployment: str, elapsed: float) -> None:
+        with self._lock:
+            ring = self._latency.get(deployment)
+            if ring is None:
+                ring = self._latency[deployment] = deque(maxlen=128)
+            ring.append(elapsed)
+
+    def _call_hedged(self, deployment: str, lk: _Link, spilled: bool,
+                     request: Any, tried: set, delay: float,
+                     deadline: Optional[float]):
+        """First-wins hedged dispatch: launch the primary, wait the
+        hedge delay, and if it has not returned launch ONE hedge on a
+        different replica. The first SUCCESS wins; the loser is
+        abandoned (its late result is discarded — duplicate-retire is
+        safe because the data-plane backends are idempotent per
+        request: deterministic encode/decode, per-(seq, step) outcome
+        ledgers on the stateful paths). Returns ``(out, winner_link,
+        spilled, attempt_s)`` — ``attempt_s`` is the winning attempt's
+        own dispatch latency; on total failure re-raises with every
+        corpse marked so the outer retry loop moves on."""
+        cv = threading.Condition()
+        outcomes: List[tuple] = []
+
+        def attempt(link: _Link) -> None:
+            a0 = time.monotonic()
+            try:
+                res = (link, True, self._call_replica(link, request),
+                       time.monotonic() - a0)
+            except BaseException as e:
+                res = (link, False, e, 0.0)
+            with cv:
+                outcomes.append(res)
+                cv.notify_all()
+
+        self._dispatch_attempt(attempt, lk)
+        wait = delay
+        if deadline is not None:
+            wait = min(wait, max(0.0, deadline - time.monotonic()))
+        with cv:
+            cv.wait_for(lambda: outcomes, timeout=wait)
+            launched = 1
+        if not outcomes:
+            second = None
+            try:
+                second, _ = self._pick(deployment, None,
+                                       tried | {lk.address})
+            except NoReplicaAvailable:
+                second = None
+            if second is not None and second.address != lk.address:
+                with self._lock:
+                    self._hedged += 1
+                self._metrics_dict()["router_hedges"].inc(
+                    1.0, (deployment, "fired"))
+                self._dispatch_attempt(attempt, second)
+                launched = 2
+        while True:
+            with cv:
+                wins = [o for o in outcomes if o[1]]
+                if wins:
+                    winner = wins[0]
+                    break
+                if len(outcomes) >= launched:
+                    winner = None
+                    break
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    with self._lock:
+                        self._deadline_shed += 1
+                    raise DeadlineExceeded(
+                        f"request to {deployment!r} exceeded its "
+                        "deadline budget mid-hedge")
+                cv.wait(timeout=remaining)
+        if winner is not None:
+            wlk = winner[0]
+            if launched == 2 and wlk is not lk:
+                with self._lock:
+                    self._hedge_wins += 1
+                self._metrics_dict()["router_hedges"].inc(
+                    1.0, (deployment, "won"))
+            # the ring gets the winning ATTEMPT's latency, not the
+            # client-observed total: a hedged winner's total embeds the
+            # hedge delay itself, and a quantile fed its own delay
+            # ratchets upward until hedging self-disables
+            return winner[2], wlk, spilled, winner[3]
+        # every launched attempt failed: mark transport corpses, then
+        # surface an application error if one occurred (never retried),
+        # else the primary's transport error (outer loop retries)
+        app_err = None
+        conn_err = None
+        for link, _ok, exc in outcomes:
+            if isinstance(exc, (ConnectionError, TimeoutError, OSError)):
+                link.dead = True
+                tried.add(link.address)
+                conn_err = conn_err or exc
+            else:
+                app_err = app_err or exc
+        raise app_err or conn_err
+
+    def _pool(self):
+        """Lazy dispatch pool for hedged attempts (worker threads are
+        reused, so per-thread RPC clients are too)."""
+        with self._lock:
+            if self._hedge_pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+                self._hedge_pool = ThreadPoolExecutor(
+                    max_workers=_HEDGE_POOL_WORKERS,
+                    thread_name_prefix=f"tosem-hedge-{self.name}")
+            return self._hedge_pool
+
+    def _dispatch_attempt(self, fn, link) -> None:
+        """Start one hedged attempt WITHOUT ever queueing it. Pool
+        threads are preferred (reused RPC clients), but a loser
+        abandoned on a gray replica holds its thread for that replica's
+        full latency — under a slow-node fault the pool fills with
+        sleeping corpses, and a queued PRIMARY would inherit their
+        delay, re-creating the very tail hedging exists to cut. When no
+        pool permit is free the attempt runs on a one-shot thread
+        instead."""
+        if self._hedge_slots.acquire(blocking=False):
+            def run(lk=link):
+                try:
+                    fn(lk)
+                finally:
+                    self._hedge_slots.release()
+            self._pool().submit(run)
+        else:
+            threading.Thread(
+                target=fn, args=(link,), daemon=True,
+                name=f"tosem-hedge-spill-{self.name}").start()
+
     def _route_admitted(self, deployment: str, request: Any,
-                        key: Optional[str] = None) -> Any:
+                        key: Optional[str] = None,
+                        deadline: Optional[float] = None) -> Any:
         br = self._breaker(deployment)
         probe = br.allow()              # may raise CircuitOpen
         tried: set = set()
+        t0 = time.monotonic()
         try:
             while True:
+                if deadline is not None and time.monotonic() >= deadline:
+                    # budget burnt walking corpses: shed typed, no
+                    # breaker verdict (a deadline is the CALLER's
+                    # constraint, not backend-failure evidence)
+                    with self._lock:
+                        self._deadline_shed += 1
+                    raise DeadlineExceeded(
+                        f"request to {deployment!r} exceeded its "
+                        "deadline budget before dispatch")
                 try:
                     lk, spilled = self._pick(deployment, key, tried)
                 except NoReplicaAvailable:
@@ -383,8 +615,17 @@ class RouterCore:
                     br.record_failure(probe=probe)
                     probe = False
                     raise
+                hedge_delay = self._hedge_delay(deployment)
+                attempt_s = None
                 try:
-                    out = lk.client().call("call", request)
+                    if hedge_delay is None:
+                        out = self._call_replica(lk, request)
+                    else:
+                        out, lk, spilled, attempt_s = self._call_hedged(
+                            deployment, lk, spilled, request, tried,
+                            hedge_delay, deadline)
+                except DeadlineExceeded:
+                    raise
                 except (ConnectionError, TimeoutError, OSError):
                     # transport loss: the replica (or its node) is gone.
                     # Exclude it locally — the controller's next table
@@ -419,6 +660,10 @@ class RouterCore:
                         self._dep_counts.get(ckey, 0) + 1
                 br.record_success(probe=probe)
                 probe = False
+                self._record_latency(
+                    deployment,
+                    attempt_s if attempt_s is not None
+                    else time.monotonic() - t0)
                 self._observe(deployment, lk, spilled)
                 return out["value"]
         except BaseException:
@@ -458,7 +703,10 @@ class RouterCore:
                      for lk in ls]
             out = {"name": self.name, "version": self._version,
                    "routed": self._routed, "spilled": self._spilled,
-                   "retried": self._retried, "errors": self._errors}
+                   "retried": self._retried, "errors": self._errors,
+                   "hedged": self._hedged,
+                   "hedge_wins": self._hedge_wins,
+                   "deadline_shed": self._deadline_shed}
             requests: Dict[str, Dict[str, int]] = {}
             for (dep, path), n in self._dep_counts.items():
                 requests.setdefault(dep, {})[path] = n
@@ -480,6 +728,9 @@ class RouterCore:
     def close(self) -> None:
         with self._lock:
             links = [lk for ls in self._table.values() for lk in ls]
+            pool, self._hedge_pool = self._hedge_pool, None
+        if pool is not None:
+            pool.shutdown(wait=False)
         for lk in links:
             lk.close()
 
@@ -576,9 +827,10 @@ class RemoteRouter:
     # data plane (per-thread connection)
     def route(self, deployment: str, request: Any,
               key: Optional[str] = None,
-              klass: Optional[str] = None) -> Any:
+              klass: Optional[str] = None,
+              timeout_s: Optional[float] = None) -> Any:
         return self._client().call("route", deployment, request, key,
-                                   klass)
+                                   klass, timeout_s)
 
     # control plane (shared connection; controller is single-threaded
     # per router)

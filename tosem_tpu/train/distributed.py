@@ -47,6 +47,8 @@ transport streams as gradients.
 """
 from __future__ import annotations
 
+import collections
+import statistics
 import threading
 import time
 from dataclasses import asdict, dataclass
@@ -55,6 +57,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from tosem_tpu.chaos import hooks as _chaos
+from tosem_tpu.cluster.fencing import StaleEpochError
 from tosem_tpu.cluster.transport import (TensorReceiver, TransportError,
                                          send_tensors)
 from tosem_tpu.obs import metrics as _metrics
@@ -97,6 +100,17 @@ class DataParallelConfig:
     # to hide behind; pacing restores the cross-node regime the
     # overlap engine exists for (see transport.send_tensors pace_bps)
     wire_bps: Optional[float] = None
+    # slow-rank watchdog: evict a rank whose median LOCAL backward
+    # time exceeds straggler_factor × the fleet median (chain sync
+    # equalizes end-to-end step times, so the driver keys off each
+    # rank's self-reported compute_ms instead). 0.0 = off — the
+    # default, because a 2-rank fleet under CI jitter must never
+    # self-drain in deterministic tests. The eviction rides the SAME
+    # shrink path as node death, so a gray-slow node costs one
+    # detection window rather than a reduce_timeout stall per step.
+    straggler_factor: float = 0.0
+    straggler_min_samples: int = 3
+    straggler_min_s: float = 0.05
 
     def to_dict(self) -> Dict[str, Any]:
         return asdict(self)
@@ -495,6 +509,9 @@ class TrainWorkerBackend:
         self._treedef = None
         self._saver = None
         self._step_lock = threading.Lock()
+        # deterministic gray-slow simulation (chaos slow_node / tests):
+        # added to the measured compute region of every step
+        self._debug_slow_s = 0.0
 
     # -- control plane -------------------------------------------------
 
@@ -539,6 +556,14 @@ class TrainWorkerBackend:
         detector saw a peer die). Lock-free on purpose: the step holds
         ``_step_lock``, and this is exactly the call that unwedges it."""
         self.reducer.abort()
+
+    def set_debug_slow(self, seconds: float) -> None:
+        """Make this rank gray-slow: every subsequent step sleeps
+        ``seconds`` inside the measured backward region. The chaos
+        ``train.dist_step``/``slow_node`` fault and the watchdog tests
+        drive this — a slow node that still answers every RPC, the
+        failure mode a liveness probe can never see."""
+        self._debug_slow_s = float(seconds)
 
     def last_step(self) -> int:
         return int(self._state["step"]) if self._state is not None else 0
@@ -605,7 +630,13 @@ class TrainWorkerBackend:
                 errors.append(e)
 
         # backward, stage by stage over this rank's shards; each stage
-        # produces a contiguous leaf range → scatter into buckets
+        # produces a contiguous leaf range → scatter into buckets.
+        # t_bw brackets the LOCAL compute region only (reduce waits are
+        # fleet-synchronized and would mask the straggler) — the
+        # watchdog's per-rank signal
+        t_bw = time.perf_counter()
+        if self._debug_slow_s > 0:
+            time.sleep(self._debug_slow_s)
         stage_lo = 0
         for si, name in enumerate(job.stage_names):
             fn = job.stage_grad(name)
@@ -639,6 +670,7 @@ class TrainWorkerBackend:
                     threads.append(t)
                 else:
                     serialized.append(b)
+        compute_ms = (time.perf_counter() - t_bw) * 1e3
         for b in serialized:        # baseline arm: comms after backward,
             do_reduce(b)            # one blocked bucket at a time
         for t in threads:
@@ -670,7 +702,8 @@ class TrainWorkerBackend:
                 release()
         mean = _mean_loss(total_loss, job.grain)
         self._history.append(mean)
-        return {"step": step + 1, "loss": mean, "reduce": reduce_stats}
+        return {"step": step + 1, "loss": mean, "reduce": reduce_stats,
+                "compute_ms": round(compute_ms, 3)}
 
     # -- parameter traffic (elastic catch-up / rejoin / state fetch) ---
 
@@ -925,22 +958,32 @@ class _LocalHandle:
 class _ReplicaHandle:
     """Nodes-backend worker: a replica process reached over the RPC
     plane (``backend_call`` forwarding). A fresh client per call keeps
-    concurrent step dispatch / control calls trivially safe."""
+    concurrent step dispatch / control calls trivially safe. Every
+    control call carries the spawning head's fencing ``epoch`` — a
+    worker re-fenced by a recovered head rejects this handle's calls
+    typed (:class:`~tosem_tpu.cluster.fencing.StaleEpochError`), so a
+    superseded driver cannot keep steering a rank it no longer owns."""
 
     def __init__(self, node_name: str, node: Any, replica_id: str,
-                 address: str, call_timeout: float = 300.0):
+                 address: str, call_timeout: float = 300.0,
+                 epoch: Optional[int] = None):
         self.node_name = node_name
         self.node = node
         self.replica_id = replica_id
         self.address = address
         self._call_timeout = call_timeout
+        self._epoch = epoch
 
     def call(self, method: str, *args, **kwargs):
         from tosem_tpu.cluster.rpc import RpcClient, RpcError
+        if self._epoch is not None:
+            kwargs.setdefault("_epoch", self._epoch)
         cli = RpcClient(self.address, call_timeout=self._call_timeout)
         try:
             return cli.call("backend_call", method, *args, **kwargs)
         except RpcError as e:
+            if str(e).startswith("StaleEpochError("):
+                raise StaleEpochError(str(e))
             # app-level failure: the worker is alive, the step is not
             raise RuntimeError(f"train worker {self.replica_id}: {e}")
         finally:
@@ -959,7 +1002,7 @@ class _ReplicaHandle:
 
     def close(self) -> None:
         try:
-            self.node.stop_replica(self.replica_id)
+            self.node.stop_replica(self.replica_id, epoch=self._epoch)
         except Exception:
             pass
 
@@ -1019,6 +1062,10 @@ class DistributedTrainer:
         self._rx: Optional[TensorReceiver] = None
         self._shrinks = 0
         self._grows = 0
+        self._straggler_evictions = 0
+        # per-handle deque of self-reported backward times (the
+        # watchdog's evidence), keyed by id(handle)
+        self._compute_hist: Dict[int, Any] = {}
         self._examples_per_s = 0.0
         self._metrics = _metrics.train_metrics(registry)
         self._spawn_seq = 0
@@ -1075,12 +1122,13 @@ class DistributedTrainer:
         rid = f"train-{self.cfg.job}-{self._spawn_seq}"
         init = {"job_ref": self.job_ref, "job_kwargs": self.job_kwargs,
                 "cfg": self.cfg.to_dict()}
+        epoch = int(getattr(self.pool, "epoch", 0) or 0) or None
         address = node.start_replica(
             rid, "tosem_tpu.train.distributed:TrainWorkerBackend",
-            init_kwargs=init)
+            init_kwargs=init, epoch=epoch)
         self._record("train_worker_placed", replica_id=rid,
                      node=node_name)
-        return _ReplicaHandle(node_name, node, rid, address)
+        return _ReplicaHandle(node_name, node, rid, address, epoch=epoch)
 
     def _record(self, event: str, **fields: Any) -> None:
         if self.pool is not None:
@@ -1205,6 +1253,76 @@ class DistributedTrainer:
                 except Exception:
                     pass
 
+    def _slow_victim(self, delay_s: float) -> None:
+        """Chaos ``train.dist_step``/``slow_node``: make the highest
+        rank gray-slow — alive to every probe, ``delay_s`` slower per
+        backward. The straggler watchdog is what must catch it."""
+        h = self._workers[-1]
+        if isinstance(h, _LocalHandle):
+            h.backend.set_debug_slow(delay_s)
+        else:
+            try:
+                h.call("set_debug_slow", delay_s)
+            except Exception:
+                pass
+
+    # -- straggler watchdog --------------------------------------------
+
+    def _note_compute(self, outs: Sequence[Any]) -> None:
+        """Fold each rank's self-reported backward time into its
+        history, and drop histories of departed handles."""
+        live = {id(h) for h in self._workers}
+        for k in [k for k in self._compute_hist if k not in live]:
+            del self._compute_hist[k]
+        for h, o in zip(self._workers, outs):
+            ms = o.get("compute_ms") if isinstance(o, dict) else None
+            if ms is None:
+                continue            # idempotent replay carries no timing
+            self._compute_hist.setdefault(
+                id(h), collections.deque(maxlen=32)).append(float(ms))
+
+    def _find_straggler(self) -> Optional[Any]:
+        """→ the worker whose median backward time exceeds the robust
+        threshold (``straggler_factor`` × fleet median-of-medians, with
+        the ``straggler_min_s`` absolute floor so microsecond-scale
+        jitter on tiny jobs can never trip the factor), or None."""
+        cfg = self.cfg
+        if cfg.straggler_factor <= 0 or self.world < 2:
+            return None
+        meds: Dict[int, float] = {}
+        for h in self._workers:
+            hist = self._compute_hist.get(id(h))
+            if hist is not None and len(hist) >= cfg.straggler_min_samples:
+                meds[id(h)] = statistics.median(hist)
+        if len(meds) < 2:
+            return None
+        fleet = statistics.median(meds.values())
+        worst_id = max(meds, key=lambda k: meds[k])
+        threshold = max(cfg.straggler_factor * fleet,
+                        cfg.straggler_min_s * 1e3)
+        if meds[worst_id] <= threshold:
+            return None
+        return next(h for h in self._workers if id(h) == worst_id)
+
+    def _evict_straggler(self, h: Any, step: int) -> None:
+        """Route a gray-slow rank through the node-death path: mark it
+        unusable so :meth:`_handle_failure` drops it, catches the fleet
+        up, and rewires — recovery on the same timescale as a real
+        death instead of a ``reduce_timeout`` stall every step."""
+        self._straggler_evictions += 1
+        self._compute_hist.pop(id(h), None)
+        self._record("train_straggler_evicted", step=step,
+                     node=getattr(h, "node_name", "?"))
+        if isinstance(h, _LocalHandle):
+            h.dead = True
+        else:
+            h.close()               # stopped replica fails alive()
+            if self.pool is not None:
+                try:
+                    self.pool.detector.declare_dead(h.node_name)
+                except Exception:
+                    pass
+
     def fit(self, num_steps: int,
             on_step: Optional[Callable[[int, Dict[str, float]], None]]
             = None) -> List[float]:
@@ -1226,6 +1344,8 @@ class DistributedTrainer:
                               job=self.cfg.job)
             if act is not None and act["action"] == "kill_node":
                 self._kill_victim()
+            elif act is not None and act["action"] == "slow_node":
+                self._slow_victim(float(act.get("delay_s") or 0.0))
             t0 = time.perf_counter()
             futs = [self._pool_exec.submit(h.call, "run_step", step,
                                            self._gen, self.overlap)
@@ -1287,6 +1407,14 @@ class DistributedTrainer:
                 except (ConnectionError, TimeoutError, OSError):
                     step = self._handle_failure(done)
                     continue
+            self._note_compute(outs)
+            victim = self._find_straggler()
+            if victim is not None:
+                # the step COMMITTED (history has its loss) — evict,
+                # then recover exactly like a death at `done`
+                self._evict_straggler(victim, done)
+                step = self._handle_failure(done)
+                continue
             step = done
         if self.ckpt_dir:
             try:
@@ -1321,6 +1449,7 @@ class DistributedTrainer:
                 "step": len(self.history),
                 "examples_per_s": round(self._examples_per_s, 2),
                 "shrinks": self._shrinks, "grows": self._grows,
+                "straggler_evictions": self._straggler_evictions,
                 "workers": [getattr(h, "node_name", "?")
                             for h in self._workers]}
 
