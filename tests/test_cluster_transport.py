@@ -300,6 +300,36 @@ class TestDuplicateStreams:
         finally:
             _net.state().reset()
 
+    def test_keyless_stream_neither_replays_nor_eats_armed_dup(self, rx):
+        """Regression: the receiver only dedupes KEYED streams, so a
+        dup landing on a keyless stream would deliver the payload twice
+        — and silently disarm the fault the next keyed stream should
+        absorb. A keyless send must pass the armed dup through
+        untouched; the following keyed send eats exactly one replay."""
+        from tosem_tpu.chaos import network as _net
+        from tosem_tpu.cluster.transport import transport_counters
+        dup0 = transport_counters()["streams"].value(("duplicate",))
+        try:
+            _net.state().dup_stream(1)
+            send_tensors(rx.address, {}, {"a": np.zeros(8)})
+            got = rx.take(timeout=10.0)      # delivered exactly once
+            got.release()
+            assert rx.stats()["received"] == 1
+            a = np.arange(16, dtype=np.float32)
+            send_tensors(rx.address, {"key": "kd"}, {"a": a})
+            got = rx.pop("kd", timeout=10.0)
+            got.release()
+            deadline = time.time() + 5.0
+            while rx.stats()["received"] < 3 and time.time() < deadline:
+                time.sleep(0.01)             # keyed replay drains async
+            st = rx.stats()
+            assert st["received"] == 3       # keyless + keyed + replay
+            assert st["pending_keys"] == []
+            assert transport_counters()["streams"].value(
+                ("duplicate",)) == dup0 + 1
+        finally:
+            _net.state().reset()
+
     def test_partitioned_stream_drops_typed(self, rx):
         from tosem_tpu.chaos import network as _net
         try:

@@ -18,7 +18,8 @@ from tosem_tpu.chaos import network as _net
 from tosem_tpu.cluster.rpc import RpcServer
 from tosem_tpu.cluster.supervisor import FailureDetector, HeadJournal
 from tosem_tpu.runtime.common import DeadlineExceeded
-from tosem_tpu.serve.router import RouterCore, RouterPolicy
+from tosem_tpu.serve.router import (NoReplicaAvailable, RouterCore,
+                                    RouterPolicy)
 
 
 class _FakeNode:
@@ -194,6 +195,45 @@ class TestConcurrentProbes:
             assert det.state("n0") == "alive"
         finally:
             hung.release.set()
+
+
+# ------------------------------------------------- epoch fence
+
+
+def _acquire_epochs(path, n, out_q):
+    from tosem_tpu.cluster.fencing import EpochFence
+    fence = EpochFence(path)
+    out_q.put([fence.acquire() for _ in range(n)])
+
+
+class TestEpochFence:
+    def test_concurrent_cross_process_acquires_are_distinct(self, tmp_path):
+        """The fence arbitrates between heads in DIFFERENT processes:
+        concurrent acquires racing the read-modify-replace must be
+        granted strictly distinct epochs (two heads sharing an epoch
+        both pass check() — split-brain)."""
+        import multiprocessing as mp
+        path = str(tmp_path / "fence.epoch")
+        q = mp.Queue()
+        procs = [mp.Process(target=_acquire_epochs, args=(path, 25, q))
+                 for _ in range(4)]
+        for p in procs:
+            p.start()
+        epochs = []
+        for _ in procs:
+            epochs.extend(q.get(timeout=30))
+        for p in procs:
+            p.join(timeout=30)
+        assert sorted(epochs) == list(range(1, 101))
+
+    def test_stale_epoch_rejected_after_newer_acquire(self, tmp_path):
+        from tosem_tpu.cluster.fencing import EpochFence, StaleEpochError
+        fence = EpochFence(str(tmp_path / "fence.epoch"))
+        old = fence.acquire()
+        new = fence.acquire()
+        fence.check(new)                     # current holder passes
+        with pytest.raises(StaleEpochError):
+            fence.check(old)
 
 
 # ------------------------------------------- journal reconcile fuzz (S4)
@@ -445,6 +485,45 @@ class TestHedgedRouting:
                 assert out == {"echo": {"i": i}}
             assert time.monotonic() - t0 < 2.0
             assert router.stats()["errors"] == 0
+        finally:
+            router.close()
+
+    def test_all_hedged_attempts_fail_marks_dead_and_retries(self, fleet):
+        """Regression: with hedging armed and every launched attempt
+        failing on transport (here: connection refused — both replicas
+        dead), the failure-retirement loop must surface the transport
+        error so the outer loop marks links dead and retries, not blow
+        up unpacking the 4-tuple outcomes."""
+        for r in fleet:
+            r.kill()
+        router = RouterCore("r0", policy=RouterPolicy(
+            hedge_after_s=0.01, hedge_min_samples=10_000))
+        try:
+            router.update_table(_table("echo", fleet), 1)
+            with pytest.raises(NoReplicaAvailable):
+                router.route("echo", {"i": 0})
+            st = router.stats()
+            assert st["retried"] == 2            # both corpses walked
+        finally:
+            router.close()
+
+    def test_hedge_fired_and_both_attempts_fail(self, fleet):
+        """Same retirement path with the hedge actually LAUNCHED: both
+        nodes gray enough that the hedge fires, both replicas dead, so
+        primary and hedge each raise ConnectionError."""
+        for r in fleet:
+            r.kill()
+        router = RouterCore("r0", policy=RouterPolicy(
+            hedge_after_s=0.02, hedge_min_samples=10_000))
+        try:
+            router.update_table(_table("echo", fleet), 1)
+            _net.state().slow_node("n0", 0.15)
+            _net.state().slow_node("n1", 0.15)
+            with pytest.raises(NoReplicaAvailable):
+                router.route("echo", {"i": 0})
+            st = router.stats()
+            assert st["hedged"] >= 1             # the hedge launched
+            assert st["retried"] >= 1            # links retired, retried
         finally:
             router.close()
 

@@ -35,6 +35,11 @@ import os
 import threading
 from typing import Optional
 
+try:                                     # POSIX; absent on Windows
+    import fcntl
+except ImportError:                      # pragma: no cover
+    fcntl = None  # type: ignore[assignment]
+
 
 class StaleEpochError(RuntimeError):
     """A control write carried an epoch older than the receiver's
@@ -48,14 +53,34 @@ class EpochFence:
 
     The file holds a single ASCII integer: the highest epoch ever
     granted for this journal. ``acquire`` is the lease grant — read,
-    increment, atomic replace, fsync — and is safe against a concurrent
-    stale holder because the stale holder never writes the fence file
-    (it only ``check``s it and loses).
+    increment, atomic replace, fsync — serialized across PROCESSES by
+    an flock'd sibling lock file, because the heads this fence
+    arbitrates between live in different processes: two heads
+    recovering concurrently must be granted DISTINCT epochs, or both
+    pass ``check`` and the split-brain the fence exists to prevent is
+    back. The in-process mutex alone cannot provide that.
     """
 
     def __init__(self, path: str):
         self.path = path
         self._lock = threading.Lock()
+
+    def _flocked(self):
+        """Open (creating if needed) the sibling ``.lock`` file and
+        take an exclusive flock on it; returns the fd or ``None`` where
+        flock is unavailable. The lock file is separate from the fence
+        file because ``os.replace`` swaps the fence inode out from
+        under any lock held on it."""
+        if fcntl is None:
+            return None
+        fd = os.open(f"{self.path}.lock",
+                     os.O_CREAT | os.O_RDWR, 0o644)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX)
+        except OSError:
+            os.close(fd)
+            return None
+        return fd
 
     def read(self) -> int:
         try:
@@ -66,17 +91,25 @@ class EpochFence:
 
     def acquire(self) -> int:
         """Grant the next epoch: bump the fence file and return the new
-        value. Crash-safe: tmp + rename, fsync'd, so a torn write can
-        never roll the fence backwards."""
+        value. Crash-safe (tmp + rename, fsync'd, so a torn write can
+        never roll the fence backwards) and atomic across processes
+        (exclusive flock around the read-modify-replace, so concurrent
+        recoveries are granted distinct epochs)."""
         with self._lock:
-            epoch = self.read() + 1
-            tmp = f"{self.path}.tmp.{os.getpid()}"
-            with open(tmp, "w") as f:
-                f.write(str(epoch))
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp, self.path)
-            return epoch
+            lock_fd = self._flocked()
+            try:
+                epoch = self.read() + 1
+                tmp = f"{self.path}.tmp.{os.getpid()}"
+                with open(tmp, "w") as f:
+                    f.write(str(epoch))
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, self.path)
+                return epoch
+            finally:
+                if lock_fd is not None:
+                    fcntl.flock(lock_fd, fcntl.LOCK_UN)
+                    os.close(lock_fd)
 
     def check(self, epoch: int) -> None:
         """Raise :class:`StaleEpochError` if ``epoch`` has been

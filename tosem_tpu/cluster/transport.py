@@ -537,7 +537,9 @@ def send_tensors(address: str, meta: Dict[str, Any],
         if act["action"] == "drop":
             raise TransportError(
                 f"chaos: stream to {address} dropped (partition)")
-        if act["action"] == "dup_stream":
+        if act["action"] == "dup_stream" and meta.get("key"):
+            # only keyed streams are deduped by the receiver; replaying
+            # a keyless stream would DELIVER the payload twice
             dup_replay = True
     net = _net.state()
     src = str(meta.get("src_node", _net.HEAD))
@@ -548,7 +550,9 @@ def send_tensors(address: str, meta: Dict[str, Any],
     extra = net.delay(dst)
     if extra > 0:
         time.sleep(extra)
-    if net.take_dup():
+    # keyless streams must not consume the armed fault either — it
+    # would silently disarm the dup the NEXT (keyed) stream should eat
+    if meta.get("key") and net.take_dup():
         dup_replay = True
     specs, views, total = [], [], 0
     for name, arr in arrays.items():

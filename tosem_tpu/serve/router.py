@@ -548,7 +548,7 @@ class RouterCore:
         # else the primary's transport error (outer loop retries)
         app_err = None
         conn_err = None
-        for link, _ok, exc in outcomes:
+        for link, _ok, exc, _t in outcomes:
             if isinstance(exc, (ConnectionError, TimeoutError, OSError)):
                 link.dead = True
                 tried.add(link.address)
