@@ -49,12 +49,16 @@ chaos_smoke() {
   # heal -> rejoin, zero deaths), slow-node-hedge (gray replica ->
   # hedged p99 within 2x healthy, side-effect ledger duplicate-free),
   # stale-head-fenced (split-brain: every stale-head write rejected
-  # with StaleEpochError, replica ownership exclusively the new head's)
-  echo "== chaos smoke (13 canned fault plans, fixed seeds)"
+  # with StaleEpochError, replica ownership exclusively the new head's),
+  # prefix-node-kill (SIGKILL the node owning the hot shared KV prefix
+  # mid-session -> cold-prefill fallback on the survivor, zero errors,
+  # responses bit-identical to the fault-free run)
+  echo "== chaos smoke (14 canned fault plans, fixed seeds)"
   for plan in worker-carnage serve-flap trial-crash \
               evict-heal node-kill-heal decode-chaos decode-migrate \
               router-chaos train-cluster scale-under-kill \
-              partition-heal slow-node-hedge stale-head-fenced; do
+              partition-heal slow-node-hedge stale-head-fenced \
+              prefix-node-kill; do
     JAX_PLATFORMS=cpu python -m tosem_tpu.cli chaos --plan "$plan"
   done
 }

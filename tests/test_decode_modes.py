@@ -122,8 +122,16 @@ def test_kernel_mode_validation():
     kp, vp, bt = _pools(rng, 1, 2, 16, 8, 2)
     sl = jnp.asarray([9], jnp.int32)
     q9 = jnp.asarray(rng.standard_normal((1, 9, 2, 16)), jnp.float32)
+    # arbitrary k is served by the XLA lowering (the wide suffix-prefill
+    # chunks of the serve prefix cache); the Pallas kernels tile queries
+    # into one 8-row sublane block and must refuse wider steps
+    wide = paged_attention(q9, kp, vp, bt, sl, impl="xla")
+    assert wide.shape == (1, 9, 2, 16)
     with pytest.raises(ValueError, match="q tokens"):
-        paged_attention(q9, kp, vp, bt, sl, impl="xla")
+        paged_attention(q9, kp, vp, bt, sl, impl="pallas")
+    q0 = jnp.asarray(rng.standard_normal((1, 0, 2, 16)), jnp.float32)
+    with pytest.raises(ValueError, match="q tokens"):
+        paged_attention(q0, kp, vp, bt, sl, impl="xla")
     q1 = jnp.asarray(rng.standard_normal((1, 2, 16)), jnp.float32)
     with pytest.raises(ValueError, match="window"):
         paged_attention(q1, kp, vp, bt, sl, impl="xla", window=0)
@@ -279,6 +287,9 @@ class TestSpeculative:
         long_prompt = {"ids": [3] * 28}
         res = drive(spec, "edge", long_prompt)
         assert len(res["tokens"]) <= 32
+        # the radix prefix cache deliberately keeps whole-page prefixes
+        # resident past release; dropping it must free everything
+        spec._prefix.clear()
         assert spec.cache.stats()["pages_used"] == 0
 
 
@@ -397,6 +408,8 @@ class TestGroups:
         assert group <= 1.5 * single
         b.release("s")
         b.release("g")
+        # only the radix-pinned whole-page prefixes stay resident
+        b._prefix.clear()
         assert b.cache.stats()["pages_used"] == 0
 
     def test_sampling_deterministic_and_isolated(self):

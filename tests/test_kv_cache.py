@@ -210,7 +210,8 @@ def test_stats_counts():
     c.spill("b")
     s = c.stats()
     assert s == {"pages_total": 6, "pages_used": 2, "pages_free": 4,
-                 "pages_spilled": 1, "pages_evicted_total": 0,
+                 "pages_shared": 0, "pages_spilled": 1,
+                 "pages_evicted_total": 0,
                  "sequences": 1, "sequences_spilled": 1}
 
 

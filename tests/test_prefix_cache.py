@@ -1,0 +1,308 @@
+"""Cluster-wide prefix caching + multi-turn sessions (PR 18): the
+radix index over committed KV pages (:mod:`tosem_tpu.serve.
+prefix_cache`), high-fan-out COW sharing in the page allocator, the
+page-gauge dedupe contract, prefix-hit decode bit-identity (local AND
+over the worker-to-worker transport plane), and session suffix-only
+prefill. Pure host-side allocator legs up top; the backend legs run
+the tiny Bert decode models on CPU."""
+import numpy as np
+import pytest
+
+from tosem_tpu.serve.kv_cache import (CachePressure, LocalSpillStore,
+                                      PagedKVCache)
+from tosem_tpu.serve.prefix_cache import PrefixCache, prefix_hash
+
+
+def make_cache(num_pages=16, page_size=4, **kw):
+    kw.setdefault("layers", 2)
+    kw.setdefault("heads", 2)
+    kw.setdefault("head_dim", 4)
+    kw.setdefault("spill_store", LocalSpillStore())
+    return PagedKVCache(num_pages, page_size, **kw)
+
+
+def fill_pages(cache, seq_id, seed=0):
+    rng = np.random.default_rng(seed)
+    idx = np.asarray(cache.pages_of(seq_id), np.int64)
+    k = rng.normal(size=(cache.layers, len(idx), cache.page_size,
+                         cache.heads, cache.head_dim)).astype(np.float32)
+    v = rng.normal(size=k.shape).astype(np.float32)
+    cache.set_pools(cache.k_pool.at[:, idx].set(k),
+                    cache.v_pool.at[:, idx].set(v))
+    return k, v
+
+
+def gather(cache, seq_id):
+    idx = np.asarray(cache.pages_of(seq_id), np.int64)
+    return (np.asarray(cache.k_pool[:, idx]),
+            np.asarray(cache.v_pool[:, idx]))
+
+
+# ------------------------------------------------------------ prefix_hash
+
+
+def test_prefix_hash_stable_and_order_sensitive():
+    a = prefix_hash([1, 2, 3, 4])
+    assert a == prefix_hash([1, 2, 3, 4])          # pure function
+    assert a == prefix_hash((1, 2, 3, 4))          # container-agnostic
+    assert len(a) == 16
+    assert a != prefix_hash([4, 3, 2, 1])
+    assert a != prefix_hash([1, 2, 3])
+    # the wire identity two nodes agree on must not depend on numpy vs
+    # python int boxing
+    assert a == prefix_hash(np.asarray([1, 2, 3, 4], np.int32))
+
+
+# ------------------------------------------------------------ radix index
+
+
+def seeded(cache, ids, seq_id="src"):
+    cache.create(seq_id)
+    cache.extend(seq_id, len(ids))
+    return seq_id
+
+
+def test_insert_indexes_every_page_aligned_depth():
+    c = make_cache()
+    ids = list(range(1, 11))                       # 10 tokens, q=4
+    src = seeded(c, ids)
+    pc = PrefixCache(c, page_size=4)
+    assert pc.insert(ids, src) == 2                # depths 1 and 2
+    assert len(pc) == 2
+    assert pc.insert(ids, src) == 0                # idempotent
+    d = pc.digest()
+    assert sorted((depth, n) for depth, n, _ in d) == [(1, 4), (2, 8)]
+    for depth, n, h in d:
+        assert h == prefix_hash(ids[:n])
+        assert pc.by_hash(depth, h) is not None
+
+
+def test_lookup_deepest_match_leaves_a_suffix_token():
+    c = make_cache()
+    ids = list(range(1, 13))                       # 3 whole pages
+    src = seeded(c, ids)
+    pc = PrefixCache(c, page_size=4)
+    pc.insert(ids, src)
+    assert pc.lookup(ids + [99]).depth == 3
+    # an EXACT whole-prefix prompt must fall back one page: admit
+    # needs >= 1 real suffix token to score
+    assert pc.lookup(ids).depth == 2
+    assert pc.lookup(ids[:5]).depth == 1
+    assert pc.lookup([7, 7, 7, 7, 7]) is None      # diverging tokens
+    assert pc.lookup(ids[:3]) is None              # shorter than a page
+
+
+def test_lru_bound_evicts_oldest_and_frees_owner_pages():
+    c = make_cache(num_pages=32)
+    pc = PrefixCache(c, page_size=4, max_entries=2)
+    for i, base in enumerate((10, 40, 80)):
+        ids = list(range(base, base + 4))
+        src = seeded(c, ids, f"s{i}")
+        pc.insert(ids, src)
+    assert len(pc) == 2
+    assert pc.lookup([10, 11, 12, 13, 1]) is None  # oldest evicted
+    assert pc.lookup([80, 81, 82, 83, 1]) is not None
+    # owner entries COW-share their source's physical page: freeing
+    # the sources leaves only the 2 surviving index pins resident
+    for i in range(3):
+        c.free(f"s{i}")
+    assert c.stats()["pages_used"] == 2
+    pc.clear()
+    assert c.stats()["pages_used"] == 0
+
+
+def test_invalidate_forgets_externally_freed_owner():
+    c = make_cache()
+    ids = list(range(1, 9))
+    src = seeded(c, ids)
+    pc = PrefixCache(c, page_size=4)
+    pc.insert(ids, src)
+    ent = pc.lookup(ids + [1])
+    c.free(ent.cid)                                # pressure path
+    pc.invalidate(ent.cid)
+    assert pc.lookup(ids + [1]).depth == ent.depth - 1
+    assert pc.by_hash(ent.depth, ent.hash) is None
+
+
+# --------------------------------------------------- high-fan-out COW
+
+
+def test_64_children_share_prefix_pages_refcount_safe():
+    c = make_cache(num_pages=8)
+    src = seeded(c, list(range(1, 9)))             # 2 whole pages
+    k0, _ = fill_pages(c, src, seed=3)
+    base = c.stats()["pages_used"]
+    kids = [f"kid/{i}" for i in range(64)]
+    for kid in kids:
+        c.fork_prefix(src, kid, 2)
+    st = c.stats()
+    # dedupe contract: 65 sequences, the SAME 2 physical pages — each
+    # page counts once in pages_used, and both land in pages_shared
+    assert st["pages_used"] == base
+    assert st["pages_shared"] == 2
+    assert st["sequences"] == 65
+    c.free(src)
+    for kid in kids[:-1]:
+        c.free(kid)
+    # the last child still reads the exact prefix bytes
+    k_last, _ = gather(c, kids[-1])
+    np.testing.assert_array_equal(k_last, k0)
+    assert c.stats()["pages_used"] == 2
+    c.free(kids[-1])
+    assert c.stats()["pages_used"] == 0
+    assert c.stats()["pages_shared"] == 0
+
+
+def test_child_release_below_never_frees_sibling_pages():
+    c = make_cache(num_pages=8)
+    src = seeded(c, list(range(1, 9)))
+    k0, _ = fill_pages(c, src, seed=5)
+    c.fork_prefix(src, "a", 2)
+    c.fork_prefix(src, "b", 2)
+    # window-evict child a's leading page: refcount rollback only
+    assert c.release_below("a", 8) == 1
+    assert c.page_offset("a") == 1
+    st = c.stats()
+    assert st["pages_used"] == 2                   # b + src still hold it
+    k_b, _ = gather(c, "b")
+    np.testing.assert_array_equal(k_b, k0)
+
+
+def test_spill_restore_of_shared_prefix_is_byte_preserving():
+    c = make_cache(num_pages=8)
+    src = seeded(c, list(range(1, 9)))
+    k0, v0 = fill_pages(c, src, seed=7)
+    c.fork_prefix(src, "kid", 2)
+    c.spill("kid")                                 # decref: pages live on
+    assert c.stats()["pages_used"] == 2
+    k_src, _ = gather(c, src)
+    np.testing.assert_array_equal(k_src, k0)
+    c.restore("kid")                               # fresh pages, same bytes
+    k_kid, v_kid = gather(c, "kid")
+    np.testing.assert_array_equal(k_kid, k0)
+    np.testing.assert_array_equal(v_kid, v0)
+
+
+def test_evicting_indexed_prefix_keeps_forked_children_alive():
+    c = make_cache(num_pages=8)
+    src = seeded(c, list(range(1, 9)))
+    k0, _ = fill_pages(c, src, seed=9)
+    pc = PrefixCache(c, page_size=4)
+    pc.insert(list(range(1, 9)), src)
+    ent = pc.lookup(list(range(1, 9)) + [1])
+    c.fork(ent.cid, "hit")                         # a live prefix hit
+    c.free(src)
+    while pc.evict_one():                          # pool pressure
+        pass
+    k_hit, _ = gather(c, "hit")
+    np.testing.assert_array_equal(k_hit, k0)
+
+
+# ------------------------------------------------- backend bit-identity
+
+KW = dict(max_batch=4, max_len=96, page_size=16, num_pages=48,
+          max_new_tokens=8)
+SHARED = [1 + (5 * j) % 97 for j in range(32)]     # 2 whole pages
+
+
+def prompt(i):
+    return {"ids": SHARED + [2 + i, 3 + i, 4 + i]}
+
+
+@pytest.fixture(scope="module")
+def backends():
+    from tosem_tpu.serve.backends import BertDecodeBackend
+    warm = BertDecodeBackend(**KW)
+    cold = BertDecodeBackend(prefix_cache=False, **KW)
+    return warm, cold
+
+
+def test_wide_suffix_chunks_resolve_on_cpu(backends):
+    # CPU resolves the paged multi-query family to the XLA lowering,
+    # which takes arbitrary query rows — suffix prefill must pick the
+    # wide chunk, not the 8-row Pallas sublane cap
+    warm, _ = backends
+    assert warm.suffix_q == 64
+    assert warm.SUFFIX_Q == 8
+
+
+def test_prefix_hit_decode_bit_identical_to_cold_prefill(backends):
+    warm, cold = backends
+    ref = [cold.call(prompt(i))["tokens"] for i in range(4)]
+    got = [warm.call(prompt(i))["tokens"] for i in range(4)]
+    assert got == ref                              # bit-identical, incl.
+    assert warm._prefix_hits >= 3                  # ...the hit decodes
+    st = warm.cache_stats()
+    assert st["prefix_pages_reused"] >= 3 * 2      # 2 shared pages each
+    assert st["reused_tokens"] >= 3 * 32
+
+
+def test_session_turn2_prefills_only_the_suffix(backends):
+    warm, cold = backends
+
+    def drive(backend, sid, req):
+        out = backend.admit(sid, req)
+        step = 0
+        while not out.get("done"):
+            out = backend.step_batch([sid], [step])[0]
+            step += 1
+        res = backend.result(sid)
+        backend.release(sid)
+        return res
+
+    turn1 = {"ids": SHARED[:20], "session": "chat"}
+    hist = drive(warm, "t1", turn1)["tokens"]
+    ids2 = hist + [9, 9]
+    before = warm.cache_stats()["prefill_tokens"]
+    res2 = drive(warm, "t2", {"ids": ids2, "session": "chat"})
+    prefilled = warm.cache_stats()["prefill_tokens"] - before
+    # the stash holds every position but the last sampled token's
+    assert prefilled == len(ids2) - (len(hist) - 1)
+    assert drive(cold, "ref2", {"ids": ids2})["tokens"] == res2["tokens"]
+
+
+def test_cross_node_transfer_hit_bit_identical(backends):
+    from tosem_tpu.serve.backends import BertDecodeBackend
+    warm, cold = backends
+    peer = BertDecodeBackend(**KW)                 # same seed, same model
+    addr = peer.transport_address()
+    warm.call(prompt(0))                           # ensure indexed here
+    depth, n_tok, h = max(warm.prefix_digest(), key=lambda r: r[0])
+    assert h == prefix_hash(SHARED[:n_tok])
+    with pytest.raises(KeyError):
+        warm.send_prefix(depth, "0" * 16, addr)    # evicted-since-digest
+    warm.send_prefix(depth, h, addr)
+    assert peer.adopt_prefix(h) >= 1
+    assert peer.cache_stats()["prefix_remote_imports"] == 1
+    # a prompt sharing the transferred prefix now hits on the peer and
+    # decodes the exact cold-prefill stream
+    got = peer.call(prompt(40))["tokens"]
+    assert peer._prefix_hits >= 1
+    assert got == cold.call(prompt(40))["tokens"]
+
+
+def test_pool_pressure_evicts_prefixes_not_live_decodes():
+    from tosem_tpu.serve.backends import BertDecodeBackend
+    kw = dict(KW, num_pages=10)                    # prompt+index fill it
+    tight = BertDecodeBackend(**kw)
+    cold = BertDecodeBackend(prefix_cache=False, **kw)
+    for i in range(3):                             # relief must kick in
+        assert tight.call(prompt(i))["tokens"] == \
+            cold.call(prompt(i))["tokens"]
+    assert tight.cache.stats()["pages_used"] <= 10
+
+
+# ------------------------------------------------------- metric surface
+
+
+def test_serve_metrics_export_prefix_gauges():
+    from tosem_tpu.obs.metrics import Registry, serve_metrics
+    m = serve_metrics(Registry())
+    for key, name in (
+            ("kv_pages_shared", "serve_kv_pages_shared"),
+            ("prefix_hit_rate", "serve_prefix_hit_rate"),
+            ("prefix_pages", "serve_prefix_pages"),
+            ("prefix_suffix_fraction",
+             "serve_prefix_suffix_token_fraction"),
+            ("prefix_remote_hits", "serve_prefix_remote_hits_total")):
+        assert m[key].name == name

@@ -245,6 +245,17 @@ def _canned() -> Dict[str, FaultPlan]:
             Fault(site="serve.route", action="kill_router", at=6),
             Fault(site="serve.route", action="kill_node", at=14),
         ]),
+        # the prefix-cache acceptance plan: SIGKILL the node that owns
+        # the hot shared prefix mid-session (routers have been steering
+        # shared-prefix admits to it by longest-prefix match) — the
+        # fleet must fall back to COLD prefill on the survivor with
+        # zero surfaced errors, and every response must stay
+        # bit-identical to the fault-free run (prefix reuse is an
+        # optimisation, never a correctness dependency)
+        "prefix-node-kill": FaultPlan(seed=71, name="prefix-node-kill",
+                                      faults=[
+            Fault(site="serve.route", action="kill_node", at=10),
+        ]),
         # the distributed-training acceptance plan: hard-kill the node
         # hosting the highest dp rank mid-epoch — the trainer must
         # SHRINK the dp axis (rewire the reduce chain over survivors,

@@ -580,6 +580,97 @@ def _scenario_router(chaos: ChaosController,
         pool.close(close_nodes=True)
 
 
+def _scenario_prefix_node_kill(chaos: ChaosController,
+                               rep: SurvivalReport) -> None:
+    """The prefix-cache acceptance run: 18 shared-prefix requests (a
+    96-token hot prefix + per-request suffixes, one multi-turn session
+    among them) through the router tier while the plan SIGKILLs the
+    node the prefix-aware router has been steering those admits to.
+    Survival means: the fleet falls back to cold prefill on the
+    survivor with ZERO client-surfaced errors and every response
+    bit-identical to the fault-free run — prefix reuse is an
+    optimisation, never a correctness dependency."""
+    from tosem_tpu.cluster.node import RemoteNode
+    from tosem_tpu.cluster.supervisor import NodePool
+    from tosem_tpu.serve.backends import BertDecodeBackend
+    from tosem_tpu.serve.cluster_serve import ClusterServe
+
+    kw = dict(max_batch=4, max_len=192, page_size=16, num_pages=96,
+              max_new_tokens=6)
+    shared = [(7 * i) % 97 + 1 for i in range(96)]
+    prompts = [{"ids": shared + [5 + i, 6 + i, 7 + i]}
+               for i in range(16)]
+    # one session rides along: turn 2 extends turn 1's full history
+    sess1 = {"ids": shared + [90, 91], "session": "chat"}
+
+    ref_backend = BertDecodeBackend(**kw)
+    ref_n = [0]
+
+    def _ref(req):
+        ref_n[0] += 1
+        sid = f"ref{ref_n[0]}"
+        out = ref_backend.admit(sid, dict(req, session=None))
+        step = 0
+        while not out.get("done"):
+            out = ref_backend.step_batch([sid], [step])[0]
+            step += 1
+        toks = ref_backend.result(sid)["tokens"]
+        ref_backend.release(sid)
+        return toks
+
+    expected = [_ref(p) for p in prompts]
+    exp_s1 = _ref(sess1)
+    # result tokens are the FULL stream (prompt + generated): turn 2
+    # replays the whole history plus one new user token
+    sess2 = {"ids": exp_s1 + [93], "session": "chat"}
+    exp_s2 = _ref(sess2)
+
+    pool = NodePool(miss_threshold=1, probe_timeout=3.0)
+    cs = None
+    try:
+        for i in range(2):
+            pool.add_node(RemoteNode.spawn_local(num_workers=2),
+                          name=f"n{i}")
+        cs = ClusterServe(pool, num_routers=2, router_procs=True)
+        cs.deploy("decode", "tosem_tpu.serve.backends:BertDecodeBackend",
+                  num_replicas=2, strategy="spread", init_kwargs=kw)
+        h = cs.get_handle("decode")
+        got, errors = [], 0
+        traffic = ([(p, e) for p, e in zip(prompts[:8], expected[:8])]
+                   + [(sess1, exp_s1)]
+                   + [(p, e) for p, e in zip(prompts[8:], expected[8:])]
+                   + [(sess2, exp_s2)])
+        correct = 0
+        for req, exp in traffic:
+            try:
+                out = h.call(req, timeout=300.0)
+                got.append(out.get("tokens"))
+                if out.get("tokens") == exp:
+                    correct += 1
+            except BaseException:
+                got.append(None)
+                errors += 1
+        inj = chaos.injections("serve.route")
+        st = cs.stats()
+        rep.counts["requests"] = len(traffic)
+        rep.counts["requests_correct"] = correct
+        rep.counts["errors_surfaced"] = errors
+        rep.counts["nodes_killed"] = len(
+            [e for e in inj if e["action"] == "kill_node"])
+        rep.counts["prefix_routed"] = st.get("prefix_routed", 0)
+        rep.counts["nodes_surviving"] = len(pool.live_nodes())
+        rep.ok = (errors == 0 and correct == len(traffic)
+                  and rep.counts["nodes_killed"] >= 1
+                  and rep.counts["nodes_surviving"] >= 1)
+        if not rep.ok:
+            rep.notes.append(
+                f"expected bit-identical fault-free tokens; got {got}")
+    finally:
+        if cs is not None:
+            cs.close()
+        pool.close(close_nodes=True)
+
+
 def _scenario_scale_kill(chaos: ChaosController,
                          rep: SurvivalReport) -> None:
     """The control-plane acceptance run: a 16-client burst over a
@@ -1112,6 +1203,7 @@ SCENARIOS: Dict[str, Callable[[ChaosController, SurvivalReport], None]] = {
     "scale-under-kill": _scenario_scale_kill,
     "partition-heal": _scenario_partition_heal,
     "slow-node-hedge": _scenario_slow_node_hedge,
+    "prefix-node-kill": _scenario_prefix_node_kill,
     "stale-head-fenced": _scenario_stale_head_fenced,
 }
 

@@ -317,6 +317,30 @@ def serve_metrics(registry: Optional[Registry] = None) -> Dict[str, Metric]:
             "wall time of one successful sequence migration "
             "(export + import)",
             labels=("deployment",), buckets=_BATCH_WAIT_BUCKETS),
+        "kv_pages_shared": reg.gauge(
+            "serve_kv_pages_shared",
+            "physical KV pages COW-shared by more than one sequence "
+            "(each page counts once in serve_kv_pages used)",
+            labels=("deployment",)),
+        "prefix_hit_rate": reg.gauge(
+            "serve_prefix_hit_rate",
+            "prefix-cache admit hit ratio (hits / (hits + misses))",
+            labels=("deployment",)),
+        "prefix_pages": reg.gauge(
+            "serve_prefix_pages",
+            "KV pages at admit by path (reused = COW-forked from a "
+            "cached prefix, prefilled = computed)",
+            labels=("deployment", "path")),
+        "prefix_suffix_fraction": reg.gauge(
+            "serve_prefix_suffix_token_fraction",
+            "fraction of admitted prompt tokens actually prefilled "
+            "(1.0 = all cold, lower = prefix/session reuse working)",
+            labels=("deployment",)),
+        "prefix_remote_hits": reg.gauge(
+            "serve_prefix_remote_hits_total",
+            "prefixes adopted over worker-to-worker transport "
+            "(cluster-wide prefix-cache hits on another node)",
+            labels=("deployment",)),
     }
 
 

@@ -50,9 +50,11 @@ Three composable decode fast-path modes extend the base kernel (each
 with the same dual lowering and parity discipline):
 
 - **Multi-token queries** (speculative scoring): ``q`` may be
-  ``[B, k, H, D]`` with ``k <= 8`` — the ``k`` draft tokens ride the
-  sublane rows the single-token path spends on broadcast, so scoring k
-  draft positions costs ONE kernel step. ``q_rows [B]`` gives the
+  ``[B, k, H, D]`` with ``k <= 8`` on the Pallas lowerings — the ``k``
+  draft tokens ride the sublane rows the single-token path spends on
+  broadcast, so scoring k draft positions costs ONE kernel step. The
+  XLA lowering accepts arbitrary ``k`` (the wide suffix-prefill chunks
+  of the serve prefix cache). ``q_rows [B]`` gives the
   per-sequence count of REAL rows (padding rows mirror the last real
   one); row r holds the token at absolute position
   ``seq_len - q_rows + r`` and attends causally up to itself — the
@@ -385,10 +387,11 @@ def paged_attention(q, k_pages, v_pages, block_tables, seq_lens, *,
                     page_offsets=None):
     """Decode attention over a paged KV cache.
 
-    ``q``: [B, H, D] (one token per sequence) or [B, k, H, D] with
-    ``k <= 8`` (speculative scoring: the k tokens occupy absolute
+    ``q``: [B, H, D] (one token per sequence) or [B, k, H, D]
+    (speculative scoring / suffix prefill: the k tokens occupy absolute
     positions ``seq_len - k .. seq_len - 1`` and attend causally up to
-    themselves); ``k_pages``/``v_pages``: [P, page_size, H, D] pools;
+    themselves; ``k <= 8`` on the Pallas lowerings — sublane tiling —
+    arbitrary k on XLA); ``k_pages``/``v_pages``: [P, page_size, H, D] pools;
     ``block_tables``: [B, max_pages] int32; ``seq_lens``: [B] int32
     (0 = inactive row → zero output). ``q_rows``: [B] int32 count of
     REAL query rows per sequence (defaults to k; padding rows mirror the
@@ -409,8 +412,8 @@ def paged_attention(q, k_pages, v_pages, block_tables, seq_lens, *,
     multi = q.ndim == 4
     if multi:
         B, K, H, D = q.shape
-        if K < 1 or K > _SUBLANES:
-            raise ValueError(f"q tokens {K} outside [1, {_SUBLANES}]")
+        if K < 1:
+            raise ValueError(f"q tokens {K} must be >= 1")
     else:
         B, H, D = q.shape
         K = 1
@@ -438,6 +441,13 @@ def paged_attention(q, k_pages, v_pages, block_tables, seq_lens, *,
                              else impl, dtype=str(q.dtype),
                              features=frozenset(feats))
     name = entry.backend
+    if multi and K > _SUBLANES and name != registry.BACKEND_XLA:
+        # the Pallas kernels tile query rows into one sublane block;
+        # wider multi-query (the suffix-prefill path) is XLA-only
+        raise ValueError(
+            f"q tokens {K} > {_SUBLANES} requires the XLA lowering "
+            f"(Pallas tiles queries into {_SUBLANES} sublanes); "
+            f"resolved backend is {name!r}")
     interpret = name == registry.BACKEND_PALLAS_INTERPRET
     general = multi or window is not None or page_offsets is not None \
         or q_rows is not None

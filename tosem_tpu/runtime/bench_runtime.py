@@ -507,11 +507,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "step cost, and the dp-vs-single-process "
                         "bit-identity pin")
     p.add_argument("--scenario", default=None,
-                   choices=("window", "beam", "spec", "decode",
-                            "migrate"),
+                   choices=("window", "beam", "spec", "prefix",
+                            "decode", "migrate"),
                    help="with --decode: run one decode fast-path "
                         "scenario's legs only (sliding-window t8192 "
-                        "A/B, beam fanout, speculative k=4); with "
+                        "A/B, beam fanout, speculative k=4, prefix-"
+                        "cache TTFT A/B + sessions); with "
                         "--cluster: decode (disaggregated prefill/"
                         "decode A/B) or migrate (drain-with-migration "
                         "vs step-0 re-admission)")
@@ -559,7 +560,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             from tosem_tpu.serve.bench_decode import SCENARIO_BENCHES
             if args.scenario not in SCENARIO_BENCHES:
                 p.error(f"--scenario={args.scenario} is not a "
-                        "--decode scenario (choose window|beam|spec)")
+                        "--decode scenario (choose "
+                        "window|beam|spec|prefix)")
             scen = set(SCENARIO_BENCHES[args.scenario])
         else:
             p.error("--scenario requires --decode or --cluster")

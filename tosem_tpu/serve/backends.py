@@ -246,10 +246,11 @@ class _DecodeSeq:
     PR-2 at-least-once actor replay can never double-apply a step."""
 
     __slots__ = ("tokens", "prompt_len", "next_step", "done", "outcomes",
-                 "budget")
+                 "budget", "session")
 
     def __init__(self, tokens: List[int], prompt_len: int,
-                 budget: Optional[int] = None):
+                 budget: Optional[int] = None,
+                 session: Optional[str] = None):
         self.tokens = tokens
         self.prompt_len = prompt_len
         self.next_step = 0
@@ -258,6 +259,10 @@ class _DecodeSeq:
         # per-request new-token budget (the request-level max_tokens
         # knob); None = the backend's max_new_tokens cap
         self.budget = budget
+        # multi-turn session key: at retirement the finished KV stays
+        # resident under this key so the next turn admits as a pure
+        # suffix prefill
+        self.session = session
 
 
 class NGramDrafter:
@@ -415,7 +420,8 @@ class BertDecodeBackend(CompiledBackendMixin):
                  backend: Optional[str] = None,
                  window: Optional[int] = None, spec_k: int = 0,
                  dim: int = 32, heads: int = 2, layers: int = 2,
-                 mlp_dim: int = 64):
+                 mlp_dim: int = 64, prefix_cache: bool = True,
+                 prefix_entries: int = 64, max_sessions: int = 16):
         import jax
         from tosem_tpu.models.bert import Bert, BertConfig
         from tosem_tpu.ops.flash_blocks import select_page_size
@@ -491,6 +497,41 @@ class BertDecodeBackend(CompiledBackendMixin):
             collections.OrderedDict()
         self._spec_proposed = 0
         self._spec_accepted = 0
+        # --- prefix cache + multi-turn sessions (whole-page prefix
+        # reuse is gated OFF under sliding-window decode: release_below
+        # drops leading pages, so a committed prefix is not guaranteed
+        # resident and windowed prefill K/V depends on the mask band)
+        from tosem_tpu.serve.prefix_cache import PrefixCache
+        self._prefix = (PrefixCache(self.cache, self.page_size,
+                                    max_entries=prefix_entries)
+                        if prefix_cache and window is None else None)
+        self.max_sessions = max_sessions
+        self._sessions: "collections.OrderedDict[Any, Dict[str, Any]]" \
+            = collections.OrderedDict()
+        self._session_n = 0
+        self._suffix_step = None
+        # suffix-prefill chunk width: the XLA paged lowering takes
+        # arbitrary query rows (one dispatch covers a whole page-sized
+        # suffix); the Pallas kernels tile queries into 8 sublanes
+        import numpy as np
+
+        from tosem_tpu.ops import registry
+        try:
+            entry = registry.resolve(
+                "paged", impl, dtype=str(np.dtype(cfg.dtype)),
+                features=frozenset({"multi_query"}))
+            wide = entry.backend == registry.BACKEND_XLA
+        except Exception:
+            wide = False
+        self.suffix_q = 64 if wide else self.SUFFIX_Q
+        self._prefix_hits = 0
+        self._prefix_misses = 0
+        self._prefix_pages_reused = 0
+        self._prefix_pages_prefilled = 0
+        self._prefill_tokens = 0
+        self._reused_tokens = 0
+        self._session_hits = 0
+        self._prefix_remote_imports = 0
         self._lock = threading.RLock()
         self._tag = model_tag("bert_decode", cfg, seed,
                               page=self.page_size, pages=num_pages,
@@ -556,12 +597,117 @@ class BertDecodeBackend(CompiledBackendMixin):
 
     def warmup(self, shapes: Sequence[int]) -> Dict[str, Any]:
         """``shapes`` is the prompt-bucket palette (page multiples);
-        the decode step program is always warmed too."""
+        the decode step program is always warmed too (plus the suffix-
+        prefill program when the prefix cache is on, so a warm prefix
+        hit never pays a compile)."""
         for pad_to in shapes:
             self._prefill_compiled(int(pad_to))
         self._step_compiled()
-        return {"warmed": len(list(shapes)) + 1,
+        extra = 1
+        if self._prefix is not None:
+            self._suffix_compiled()
+            extra = 2
+        return {"warmed": len(list(shapes)) + extra,
                 "cache": DEFAULT_COMPILE_CACHE.stats()}
+
+    SUFFIX_Q = 8   # chunk width on the Pallas lowerings (sublane cap)
+
+    def _suffix_compiled(self):
+        """ONE compiled B=1 multi-query step program that prefill-feeds
+        a suffix in chunks of up to ``suffix_q`` tokens over pages a
+        prefix ``fork`` already shares — each query row computes exactly
+        what the sequential decode step would (the speculative-scoring
+        contract), so a prefix-hit admit emits the same greedy stream as
+        a cold full prefill."""
+        import numpy as np
+        if self._suffix_step is None:
+            self._suffix_step = self.model.decode_multi_fn(
+                self._vs, page_size=self.page_size,
+                q_tokens=self.suffix_q, impl=self.impl, window=None)
+        pool = self.cache.k_pool
+        key = shape_key(self._tag + ";suffix",
+                        (1, self.max_pages, self.page_size,
+                         self.suffix_q), self.cfg.dtype)
+        Q = self.suffix_q
+        return DEFAULT_COMPILE_CACHE.get_or_build(
+            key, lambda: aot_compile(
+                self._suffix_step,
+                [((1, Q), np.int32), ((1, Q), np.int32),
+                 (tuple(pool.shape), pool.dtype),
+                 (tuple(pool.shape), pool.dtype),
+                 ((1, self.max_pages), np.int32), ((1,), np.int32),
+                 ((1,), np.int32), ((1,), np.int32)],
+                donate_argnums=(2, 3)))
+
+    def _suffix_feed(self, seq_id, toks: List[int], start: int):
+        """Prefill positions ``[start, len(toks))`` through the chunked
+        multi-query program (pages for the whole suffix are extended up
+        front, all-or-nothing). Returns the logits row of the LAST
+        token (fp32 np) — the prefix-hit admit's counterpart of
+        :meth:`_prefill_into_cache`."""
+        import numpy as np
+        n_suffix = len(toks) - start
+        self._extend_with_relief(seq_id, n_suffix)
+        fn = self._suffix_compiled()
+        last = None
+        pos = start
+        while pos < len(toks):
+            n = min(self.suffix_q, len(toks) - pos)
+            chunk = toks[pos:pos + n]
+            ids_t = np.full((1, self.suffix_q), chunk[-1], np.int32)
+            ids_t[0, :n] = chunk
+            positions = np.full((1, self.suffix_q), pos + n - 1,
+                                np.int32)
+            positions[0, :n] = np.arange(pos, pos + n)
+            tables = self.cache.block_table(
+                seq_id, self.max_pages)[None, :]
+            lens = np.asarray([pos + n], np.int32)
+            q_rows = np.asarray([n], np.int32)
+            offs = np.zeros((1,), np.int32)
+            logits, k_pool, v_pool = fn(
+                ids_t, positions, self.cache.k_pool, self.cache.v_pool,
+                tables, lens, q_rows, offs)
+            self.cache.set_pools(k_pool, v_pool)
+            last = np.asarray(logits, np.float32)[0, n - 1]
+            pos += n
+        return last
+
+    # -------------------------------------------- pressure relief (reclaim)
+
+    def _relieve_pressure(self) -> bool:
+        """Reclaim the least-valuable resident state: spill the LRU
+        session first (restorable — session warmth survives in the
+        object plane), then evict the LRU prefix entry (refcount-safe:
+        live children keep their shared pages). Returns True when
+        something was freed. Caller holds ``_lock``."""
+        for key, st in self._sessions.items():
+            cid = st["cid"]
+            if not self.cache.is_spilled(cid):
+                try:
+                    self.cache.spill(cid)
+                    return True
+                except KeyError:
+                    continue
+        if self._prefix is not None and self._prefix.evict_one():
+            return True
+        return False
+
+    def _with_relief(self, fn):
+        """Run ``fn`` retrying under :class:`CachePressure` while
+        reclaimable prefix/session state remains; re-raises once there
+        is nothing left to free (the scheduler's pressure contract
+        takes over)."""
+        from tosem_tpu.serve.kv_cache import CachePressure
+        while True:
+            try:
+                return fn()
+            except CachePressure:
+                if not self._relieve_pressure():
+                    raise
+
+    def _extend_with_relief(self, seq_id, n_tokens: int):
+        return self._with_relief(
+            lambda: self.cache.extend(seq_id, n_tokens))
 
     # ------------------------------------------------------- decode client
 
@@ -669,21 +815,51 @@ class BertDecodeBackend(CompiledBackendMixin):
             ids = list(request["ids"])
             self._validate_ids(ids)
             budget = self._budget_of(request)   # may raise: fails alone
-            self.cache.create(seq_id)
+            session = request.get("session")
+            # longest-prefix reuse: a session resume or radix hit COW-
+            # shares the already-computed pages and prefills only the
+            # suffix — same greedy stream as a cold admit (shared pages
+            # are byte-identical; each suffix row computes exactly the
+            # sequential step's result)
+            reused = 0
+            if session is not None:
+                reused = self._session_resume(seq_id, session, ids)
+            if reused == 0 and self._prefix is not None:
+                ent = self._prefix.lookup(ids)
+                if ent is not None:
+                    self.cache.fork(ent.cid, seq_id)
+                    reused = ent.depth * self.page_size
+                    self._prefix_hits += 1
+                    self._prefix_pages_reused += ent.depth
+                elif session is None or session not in self._sessions:
+                    self._prefix_misses += 1
             try:
-                self.cache.extend(seq_id, len(ids))
-                last = self._prefill_into_cache(seq_id, ids)
+                if reused:
+                    last = self._suffix_feed(seq_id, ids, reused)
+                else:
+                    self.cache.create(seq_id)
+                    self._extend_with_relief(seq_id, len(ids))
+                    last = self._prefill_into_cache(seq_id, ids)
             except BaseException:
                 self.cache.free(seq_id)
                 raise
+            self._prefill_tokens += len(ids) - reused
+            self._reused_tokens += reused
+            self._prefix_pages_prefilled += \
+                -(-(len(ids) - reused) // self.page_size)
             token = int(np.argmax(last))
             seq = _DecodeSeq(tokens=ids + [token],
-                             prompt_len=len(ids), budget=budget)
+                             prompt_len=len(ids), budget=budget,
+                             session=session)
             seq.done = self._finished(seq, token)
             if self.window is not None:
                 self.cache.release_below(
                     seq_id, self._release_floor(len(seq.tokens)))
             self._seqs[seq_id] = seq
+            if self._prefix is not None:
+                self._prefix.insert(ids, seq_id)
+            if seq.done and session is not None:
+                self._session_stash(seq_id, seq)
             out = {"token": token, "done": seq.done}
             if seq.done:
                 # final payload rides the outcome: retiring a sequence
@@ -709,6 +885,102 @@ class BertDecodeBackend(CompiledBackendMixin):
         self._handed[seq_id] = memo
         while len(self._handed) > 512:
             self._handed.popitem(last=False)
+
+    # ------------------------------------------------- multi-turn sessions
+
+    def _session_resume(self, seq_id, key, ids: List[int]) -> int:
+        """Fork the stashed KV of session ``key`` into ``seq_id`` when
+        ``ids`` extends the stashed history. Returns the number of
+        cached positions reused (0 = cold admit: no stash, history
+        mismatch, or the spilled payload was lost). Caller holds
+        ``_lock``."""
+        from tosem_tpu.serve.kv_cache import (CachePressure,
+                                              PagesLostError)
+        st = self._sessions.get(key)
+        if st is None:
+            return 0
+        hist = st["tokens"]
+        cached = len(hist) - 1
+        if cached < 1 or len(ids) < len(hist) \
+                or ids[:len(hist)] != hist:
+            return 0
+        cid = st["cid"]
+        if self.cache.is_spilled(cid):
+            try:
+                self._with_relief(lambda: self.cache.restore(cid))
+            except (PagesLostError, CachePressure):
+                # lost or unrestorable: fall back to cold prefill and
+                # forget the stash (the retiring turn re-stashes)
+                del self._sessions[key]
+                self._drop_session_state(st)
+                return 0
+        try:
+            self.cache.fork(cid, seq_id)
+        except KeyError:
+            del self._sessions[key]
+            return 0
+        self._sessions.move_to_end(key)
+        self._session_hits += 1
+        return cached
+
+    def _session_stash(self, seq_id, seq: _DecodeSeq) -> None:
+        """Keep a finished sequence's KV resident under its session key
+        (COW fork — retiring the request itself frees nothing shared).
+        Replaces any previous stash for the key; LRU-bounded. Caller
+        holds ``_lock``."""
+        old = self._sessions.pop(seq.session, None)
+        if old is not None:
+            self._drop_session_state(old)
+        self._session_n += 1
+        cid = f"__session__/{self._session_n}"
+        try:
+            self.cache.fork(seq_id, cid)
+        except (KeyError, ValueError):
+            return
+        self._sessions[seq.session] = {"cid": cid,
+                                       "tokens": list(seq.tokens)}
+        while len(self._sessions) > self.max_sessions:
+            _, st = self._sessions.popitem(last=False)
+            self._drop_session_state(st)
+
+    def _drop_session_state(self, st: Dict[str, Any]) -> None:
+        self._release_cid(st["cid"])
+
+    def export_sessions(self) -> Dict[Any, Dict[str, Any]]:
+        """Migratable stash state of every resident session — what
+        :meth:`~tosem_tpu.serve.batching.DecodeQueue.drain_replica`
+        relocates so multi-turn warmth survives a planned drain."""
+        from tosem_tpu.serve.kv_cache import PagesLostError
+        with self._lock:
+            out: Dict[Any, Dict[str, Any]] = {}
+            for key, st in self._sessions.items():
+                try:
+                    kv = self.cache.export_seq(st["cid"])
+                except (KeyError, PagesLostError):
+                    continue
+                out[key] = {"tokens": list(st["tokens"]), "kv": kv}
+            return out
+
+    def import_session(self, key, state: Dict[str, Any]) -> None:
+        """Adopt one exported session stash. Best-effort: sessions are
+        a warmth hint, so a pool too pressured to hold the pages drops
+        the import instead of failing the drain."""
+        from tosem_tpu.serve.kv_cache import CachePressure
+        with self._lock:
+            if key in self._sessions:
+                return                      # at-least-once replay
+            self._session_n += 1
+            cid = f"__session__/{self._session_n}"
+            try:
+                self._with_relief(
+                    lambda: self.cache.import_seq(cid, state["kv"]))
+            except CachePressure:
+                return
+            self._sessions[key] = {"cid": cid,
+                                   "tokens": list(state["tokens"])}
+            while len(self._sessions) > self.max_sessions:
+                _, st = self._sessions.popitem(last=False)
+                self._drop_session_state(st)
 
     def _admit_group(self, seq_id, request: Dict[str, Any],
                      n: int) -> Dict[str, Any]:
@@ -879,7 +1151,7 @@ class BertDecodeBackend(CompiledBackendMixin):
             kr = min(self.K, self.cfg.max_len - (L - 1))
             drafts = self._drafter.propose(seq.tokens, kr - 1)
         try:
-            start, _ = self.cache.extend(sid, kr)
+            start, _ = self._extend_with_relief(sid, kr)
         except CachePressure:
             return {"pressure": True}
         plans.append(_RowPlan(sid, [seq.tokens[-1]] + drafts, start))
@@ -925,9 +1197,14 @@ class BertDecodeBackend(CompiledBackendMixin):
         m = len(seq.tokens) - L
         if m != 1:
             out["n_tokens"] = m
+            # streaming consumers need every committed token, not just
+            # the newest (a speculative step commits several at once)
+            out["tokens"] = list(seq.tokens[L:])
         seq.done = done
         if done:
             out["result"] = self._result_locked(seq)
+            if seq.session is not None:
+                self._session_stash(sid, seq)
         seq.outcomes.append(out)
         seq.next_step += 1
         return out
@@ -943,7 +1220,7 @@ class BertDecodeBackend(CompiledBackendMixin):
         extended: List[_Beam] = []
         try:
             for b in live:
-                self.cache.extend(b.cid, 1)
+                self._extend_with_relief(b.cid, 1)
                 extended.append(b)
         except CachePressure:
             # all-or-nothing for the whole group: roll the extends back
@@ -1397,6 +1674,76 @@ class BertDecodeBackend(CompiledBackendMixin):
         else:
             rx.release()
 
+    # -------------------------------------- cluster-wide prefix transfer
+    #
+    # Routers learn each replica's hottest prefixes from the compact
+    # digest piggybacked on response loads; a longest-prefix match that
+    # lands on the WRONG node pulls the matched pages worker→worker
+    # (same transport plane as live migration) instead of re-prefilling.
+
+    def prefix_digest(self) -> List[List[Any]]:
+        """Bounded ``[depth, hash]`` pairs for this replica's hottest
+        prefixes (JSON-safe) — what rides replica responses up to the
+        routing tier."""
+        if self._prefix is None:
+            return []
+        return self._prefix.digest()
+
+    def send_prefix(self, depth: int, hash_: str, address: str) -> int:
+        """Stream one indexed prefix's pages to a peer's receiver —
+        spill-format bytes keyed ``prefix:<hash>``, the token prefix in
+        the stream metadata. Source entry unchanged (shared pages are
+        read-only). Raises ``KeyError`` when the prefix is no longer
+        indexed here (evicted since the router's digest snapshot)."""
+        from tosem_tpu.cluster.transport import send_tensors
+        if self._prefix is None:
+            raise KeyError("prefix cache disabled on this replica")
+        ent = self._prefix.by_hash(int(depth), str(hash_))
+        if ent is None:
+            raise KeyError(
+                f"prefix ({depth}, {hash_}) not indexed on this replica")
+        with self._lock:
+            kv = self.cache.export_seq(ent.cid)
+        meta = {"header": kv["header"], "tokens": list(ent.tokens)}
+        return send_tensors(address, {"key": f"prefix:{hash_}",
+                                      "prefix_state": meta},
+                            {"k": kv["k"], "v": kv["v"]})
+
+    def adopt_prefix(self, hash_: str, timeout: float = 30.0) -> int:
+        """Index the prefix :meth:`send_prefix` streamed for ``hash_``:
+        import the pages, register every page-aligned depth in the
+        local radix, release the staging sequence (refcounts keep the
+        indexed pages). Returns how many radix entries landed."""
+        with self._lock:
+            receiver = getattr(self, "_receiver", None)
+        if receiver is None:
+            raise RuntimeError("transport_address() was never called "
+                               "on this replica")
+        if self._prefix is None:
+            raise RuntimeError("prefix cache disabled on this replica")
+        rx = receiver.pop(f"prefix:{hash_}", timeout=timeout)
+        try:
+            meta = rx.meta["prefix_state"]
+            toks = [int(t) for t in meta["tokens"]]
+            arrs = rx.arrays()
+            payload = {"header": meta["header"],
+                       "k": arrs["k"], "v": arrs["v"]}
+            with self._lock:
+                staging = f"__prefix_rx__/{hash_}"
+                self._with_relief(
+                    lambda: self.cache.import_seq(staging, payload))
+                try:
+                    added = self._prefix.insert(toks, staging)
+                finally:
+                    self.cache.free(staging)
+                self._prefix_remote_imports += 1
+        except BaseException:
+            rx.release()
+            raise
+        else:
+            rx.release()
+        return added
+
     # ---------------------------------------------- synchronous decode
 
     def call(self, request: Dict[str, Any]) -> Dict[str, Any]:
@@ -1448,6 +1795,17 @@ class BertDecodeBackend(CompiledBackendMixin):
         with self._lock:
             out["spec_proposed"] = self._spec_proposed
             out["spec_accepted"] = self._spec_accepted
+            out["prefix_hits"] = self._prefix_hits
+            out["prefix_misses"] = self._prefix_misses
+            out["prefix_pages_reused"] = self._prefix_pages_reused
+            out["prefix_pages_prefilled"] = self._prefix_pages_prefilled
+            out["prefill_tokens"] = self._prefill_tokens
+            out["reused_tokens"] = self._reused_tokens
+            out["session_hits"] = self._session_hits
+            out["sessions"] = len(self._sessions)
+            out["prefix_remote_imports"] = self._prefix_remote_imports
+            if self._prefix is not None:
+                out.update(self._prefix.stats())
         return out
 
     def stats(self) -> Dict[str, Any]:

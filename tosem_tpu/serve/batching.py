@@ -655,6 +655,10 @@ class DecodePolicy:
     straggler_factor: float = 0.0
     straggler_min_samples: int = 3
     straggler_min_s: float = 0.02
+    # multi-turn sessions: requests may carry {"session": key}; the
+    # replica keeps the finished KV resident (spillable, migrating with
+    # drains) so the next turn admits as a pure suffix prefill
+    session: bool = False
 
     def __post_init__(self):
         if self.max_active < 1:
@@ -686,6 +690,9 @@ class _DecodeItem:
     slots: int = 1                   # step rows this item packs (group: n)
     prefill_state: Any = None        # exported state awaiting a decode slot
     src_replica: Any = None          # prefill replica while admitting
+    on_token: Any = None             # streaming callback (tokens, done)
+    streamed: int = 0                # tokens delivered to on_token
+    observed: int = 0                # tokens seen since last admit
     enqueued_at: float = field(default_factory=time.monotonic)
 
 
@@ -790,12 +797,23 @@ class DecodeQueue:
 
     def submit(self, request: Any, probe: bool = False,
                sync: bool = False,
-               timeout: Optional[float] = None) -> BatchedFuture:
+               timeout: Optional[float] = None,
+               on_token: Any = None) -> BatchedFuture:
         """Queue one sequence for decode. ``sync``/``timeout`` exist for
         Handle-surface compatibility; a decode request spans many
         scheduler iterations, so there is no inline fast path — the
-        caller bounds its wait via ``result(timeout)``."""
+        caller bounds its wait via ``result(timeout)``.
+
+        ``on_token(tokens, done)`` streams committed tokens out of the
+        step loop as they land (called from the scheduler thread —
+        callbacks must be fast and non-blocking; push into a queue)."""
         del sync, timeout
+        if isinstance(request, dict) and request.get("session") is not None \
+                and not self.policy.session:
+            raise ValueError(
+                "request carries a session key but "
+                "DecodePolicy(session=True) is not set for deployment "
+                f"{self._dep.name!r}")
         sampling = self.policy.sampling
         if sampling is not None and sampling.n > 1 \
                 and isinstance(request, dict):
@@ -818,7 +836,7 @@ class DecodeQueue:
             item = _DecodeItem(
                 request=request, future=BatchedFuture(), probe=probe,
                 seq_id=f"{self._dep.name}/{self._seq_counter}",
-                slots=slots)
+                slots=slots, on_token=on_token)
             self._pending.append(item)
             self._cv.notify_all()
         return item.future
@@ -1038,6 +1056,10 @@ class DecodeQueue:
             it.step = 0
             it.replica = None
             it.prefill_state = None
+            # re-admission replays the identical token path from step
+            # 0; the streaming dedupe counter restarts with it so the
+            # callback never sees a token twice
+            it.observed = 0
             with self._cv:
                 closed = self._closed
                 if not closed:
@@ -1249,7 +1271,38 @@ class DecodeQueue:
                     out["migrated"] += 1
                 else:
                     out["readmitted"] += 1
+            out["sessions"] = self._move_sessions(replica)
             return out
+
+    def _move_sessions(self, replica) -> int:
+        """Relocate the draining replica's resident session stashes so
+        multi-turn warmth survives the drain. Best-effort (sessions are
+        a perf hint, correctness is cold re-prefill): any failure just
+        leaves the next turn cold."""
+        import tosem_tpu.runtime as rt
+        if not (self.policy.session
+                and hasattr(self._dep.backend_cls, "export_sessions")):
+            return 0
+        try:
+            dst = self._pick_replica(1, exclude=replica)
+        except BaseException:
+            dst = None
+        if dst is None:
+            return 0
+        try:
+            sessions = rt.get(replica.export_sessions.remote(),
+                              timeout=60.0)
+        except BaseException:
+            return 0
+        moved = 0
+        for key, state in sessions.items():
+            try:
+                rt.get(dst.import_session.remote(key, state),
+                       timeout=60.0)
+                moved += 1
+            except BaseException:
+                continue
+        return moved
 
     # ------------------------------------------ disaggregated prefill
 
@@ -1610,8 +1663,33 @@ class DecodeQueue:
             with self._lock:
                 self._active.append(item)
             self._tokens += int(first.get("n_tokens", 1))
+            self._fire_on_token(item, first)
             if first.get("done"):
                 self._retire(item, result=first.get("result"))
+
+    @staticmethod
+    def _fire_on_token(item: _DecodeItem, out: Dict[str, Any]) -> None:
+        """Push an outcome's committed tokens to the item's streaming
+        callback. A step-0 re-admission (replica death) replays the
+        identical greedy path, so the monotonic ``streamed`` watermark
+        dedupes: only tokens past it are delivered. Callback errors
+        never touch the scheduler loop — the consumer (e.g. a dropped
+        HTTP connection) fails alone."""
+        if "token" not in out:
+            return
+        toks = out.get("tokens") or [out["token"]]
+        before = item.observed
+        item.observed += len(toks)
+        if item.on_token is None:
+            return
+        fresh = list(toks[max(item.streamed - before, 0):])
+        item.streamed = max(item.streamed, item.observed)
+        if not fresh and not out.get("done"):
+            return
+        try:
+            item.on_token(fresh, bool(out.get("done")))
+        except BaseException:
+            item.on_token = None
 
     def _retire(self, item: _DecodeItem,
                 result: Optional[Any] = None) -> None:
@@ -1736,6 +1814,7 @@ class DecodeQueue:
                 # a speculative step commits up to spec_k tokens, a
                 # group step one per live branch
                 self._tokens += int(out.get("n_tokens", 1))
+                self._fire_on_token(it, out)
                 if out.get("done"):
                     self._retire(it, result=out.get("result"))
             if pressured is not None:
@@ -1890,11 +1969,17 @@ class DecodeQueue:
                                       timeout=0.0)
                     if not done:
                         return
-                stats = rt.get(prev, timeout=0.5)
-            self._scrape_ref = replicas[0].cache_stats.remote()
-            if block and stats is None:
-                stats = rt.get(self._scrape_ref, timeout=5.0)
+                    stats = rt.get(prev, timeout=0.5)
+                # block mode: DISCARD the in-flight ref — synchronous
+                # callers (tests, ad-hoc scrapes) want the counters as
+                # of NOW, and the outstanding request is an interval
+                # old (fired mid-decode, pre-retirement)
+            if block:
+                stats = rt.get(replicas[0].cache_stats.remote(),
+                               timeout=5.0)
                 self._scrape_ref = None
+            else:
+                self._scrape_ref = replicas[0].cache_stats.remote()
         except BaseException:
             self._scrape_ref = None
             return
@@ -1906,6 +1991,9 @@ class DecodeQueue:
             v = stats.get(f"pages_{state}")
             if v is not None:
                 self._metrics["kv_pages"].set(v, (name, state))
+        shared = stats.get("pages_shared")
+        if shared is not None:
+            self._metrics["kv_pages_shared"].set(shared, (name,))
         evicted = stats.get("pages_evicted_total")
         if evicted is not None:
             self._metrics["kv_evicted"].set(evicted, (name,))
@@ -1913,6 +2001,24 @@ class DecodeQueue:
         if proposed:
             self._metrics["spec_acceptance"].set(
                 stats.get("spec_accepted", 0) / proposed, (name,))
+        hits = stats.get("prefix_hits") or 0
+        misses = stats.get("prefix_misses") or 0
+        if hits or misses:
+            self._metrics["prefix_hit_rate"].set(
+                hits / (hits + misses), (name,))
+        for path, key in (("reused", "prefix_pages_reused"),
+                          ("prefilled", "prefix_pages_prefilled")):
+            v = stats.get(key)
+            if v is not None:
+                self._metrics["prefix_pages"].set(v, (name, path))
+        prefill = stats.get("prefill_tokens") or 0
+        reused = stats.get("reused_tokens") or 0
+        if prefill or reused:
+            self._metrics["prefix_suffix_fraction"].set(
+                prefill / (prefill + reused), (name,))
+        remote = stats.get("prefix_remote_imports")
+        if remote is not None:
+            self._metrics["prefix_remote_hits"].set(remote, (name,))
 
     def _loop(self) -> None:
         while True:

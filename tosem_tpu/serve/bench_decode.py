@@ -10,7 +10,7 @@ reuse). Both arms serve the same tiny-topology causal decoder with the
 same seed, so their greedy token paths are identical — the A/B isolates
 exactly what continuous batching + the paged cache buy.
 
-Three scenario legs cover the decode fast paths on top of that:
+Four scenario legs cover the decode fast paths on top of that:
 
 - ``window`` — sliding-window paged decode at t8192 against the
   full-cache step program, with the live-page bound asserted
@@ -22,6 +22,11 @@ Three scenario legs cover the decode fast paths on top of that:
 - ``beam`` — n=4 beam fanout through COW page sharing, with the
   group-vs-single page-allocation ratio asserted <= 1.5x at equal
   prefix.
+- ``prefix`` — radix prefix cache on vs off at 0.75 prefix share:
+  admit-to-first-token (``max_new_tokens=1``) warm vs cold with the
+  greedy outputs pinned bit-identical and the >=2x TTFT advantage
+  hard-asserted, plus a multi-turn session leg proving suffix-only
+  prefill via the prefill-token counters (zero per-admit recompiles).
 
 Interleaved A/B rounds per the bench-noise protocol (both arms of a
 round share the host phase; the speedup ratio is phase-immune). After
@@ -32,7 +37,8 @@ leg additionally reports per-token p50/p99 latency rows (lower-is-
 better floors) next to its throughput.
 
 ``python -m tosem_tpu.cli microbench --decode`` runs it
-(``--scenario=window|beam|spec`` restricts to one scenario's legs);
+(``--scenario=window|beam|spec|prefix`` restricts to one scenario's
+legs);
 ``--save`` / ``--check`` record/gate against
 ``results/bench_decode.json`` floors (min-of-rounds for throughput,
 max-of-rounds ceilings for latency) in ``ci.sh --perf``.
@@ -59,6 +65,7 @@ GATED_DECODE_BENCHES = (
     "decode_window_t8192", "decode_window_speedup_t8192",
     "decode_spec_c8", "decode_spec_speedup_c8",
     "decode_beam_c4",
+    "decode_prefix_warm_ttft_ms", "decode_prefix_ttft_speedup",
 )
 
 # --scenario legs for `cli microbench --decode --scenario=...` and the
@@ -69,6 +76,9 @@ SCENARIO_BENCHES = {
     "spec": ("decode_single_c8", "decode_spec_c8",
              "decode_spec_speedup_c8"),
     "beam": ("decode_beam_c4", "decode_beam_pages_ratio"),
+    "prefix": ("decode_prefix_cold_ttft_ms", "decode_prefix_warm_ttft_ms",
+               "decode_prefix_ttft_speedup",
+               "decode_prefix_session_suffix_frac"),
 }
 
 DEFAULT_BASELINE = "results/bench_decode.json"
@@ -87,6 +97,17 @@ PROMPT_LEN = 12
 # COW divergence have room to act, 8 concurrent sequences
 SCEN_KW = dict(max_batch=8, max_len=192, page_size=16, num_pages=128,
                max_new_tokens=48)
+
+# prefix scenario: 256-token prompts sharing a 192-token hot prefix
+# (0.75 share, 12 whole pages); the suffix rides ONE wide multi-query
+# chunk (suffix_q=64 on the XLA lowering), so a warm admit pays one
+# dispatch where a cold admit pays the full 256-token prefill. The
+# pool is kept small — pool-update bytes are a COMMON cost both arms
+# pay per dispatch and only wash out the A/B contrast.
+PREFIX_PLEN = 256
+PREFIX_SHARE = 192
+PREFIX_KW = dict(max_batch=8, max_len=288, page_size=16, num_pages=64,
+                 max_new_tokens=48)
 
 # window scenario: t8192 context, w1024 sliding window, one-lane pages
 WIN_T = 8192
@@ -412,6 +433,142 @@ def _beam_leg(em: SuiteEmitter, serve, trials: int,
     serve.delete("bench-beam")
 
 
+def _prefix_leg(em: SuiteEmitter, serve, trials: int,
+                min_s: float) -> None:
+    """Prefix-cache A/B at 0.75 prefix share: admit-to-first-token
+    (per-request ``max_new_tokens=1`` — the sequence finishes AT admit,
+    so call latency IS TTFT) with the radix cache on vs off, 128-token
+    prompts sharing a 96-token hot prefix. Interleaved rounds; the two
+    arms' greedy outputs are pinned bit-identical first, the warm arm's
+    >=2x TTFT advantage is hard-asserted, and a multi-turn session leg
+    proves suffix-only prefill via the backend's prefill-token counters
+    (with zero per-admit recompiles)."""
+    import tosem_tpu.runtime as rt
+    from tosem_tpu.serve.backends import BertDecodeBackend
+    from tosem_tpu.serve.batching import DecodePolicy
+
+    shared = [1 + ((7 * j) % 126) for j in range(PREFIX_SHARE)]
+
+    def prefix_prompt(i: int) -> Dict[str, Any]:
+        return {"ids": shared + [1 + ((i * 11 + j) % 126)
+                                 for j in range(PREFIX_PLEN
+                                                - PREFIX_SHARE)]}
+
+    # TTFT A/B on raw in-process backends (the beam leg's probe idiom):
+    # admit latency IS the quantity under test, so the arms must not
+    # hide behind the data plane's per-call overhead
+    warm = BertDecodeBackend(**PREFIX_KW)
+    cold = BertDecodeBackend(prefix_cache=False, **PREFIX_KW)
+
+    # parity pin (and warm-arm seeding): prompt 0 populates the radix
+    # index, prompts 1..3 take the suffix-prefill hit path — their
+    # greedy streams must match the cold arm's bit for bit
+    for i in range(4):
+        a = warm.call(dict(prefix_prompt(i), max_new_tokens=8))
+        b = cold.call(dict(prefix_prompt(i), max_new_tokens=8))
+        if a["tokens"] != b["tokens"]:
+            raise RuntimeError(
+                f"prefix-hit and cold-prefill arms diverged on prompt "
+                f"{i}: {a['tokens']} vs {b['tokens']}")
+
+    def ttft_ms(backend, n: int, base: int) -> float:
+        total = 0.0
+        for i in range(n):
+            req = dict(prefix_prompt(base + i), max_new_tokens=1)
+            t0 = time.perf_counter()
+            backend.admit(f"ttft/{base + i}", req)
+            total += time.perf_counter() - t0
+            backend.release(f"ttft/{base + i}")
+        return total * 1000.0 / n
+
+    from tosem_tpu.serve.compile_cache import DEFAULT_COMPILE_CACHE
+    misses_before = DEFAULT_COMPILE_CACHE.stats()["misses"]
+    cold_ms, warm_ms, speedups = [], [], []
+    per_round = 12
+    for r in range(max(trials, 1)):
+        # one A/B round, both arms in the same host phase; fresh
+        # suffixes per round so the COLD arm never amortizes anything
+        base = 4 + r * per_round
+        a = ttft_ms(cold, per_round, base)
+        b = ttft_ms(warm, per_round, base)
+        cold_ms.append(a)
+        warm_ms.append(b)
+        speedups.append(a / b if b else float("inf"))
+    st = warm.cache_stats()
+    if not st.get("prefix_hits"):
+        raise RuntimeError(
+            "warm arm recorded zero prefix hits — the radix index "
+            "never engaged and the A/B measured nothing")
+    if max(speedups) < 2.0:
+        raise RuntimeError(
+            f"prefix-cache TTFT only {max(speedups):.2f}x cold prefill "
+            "at 0.75 prefix share (>= 2x required)")
+    if DEFAULT_COMPILE_CACHE.stats()["misses"] != misses_before:
+        raise RuntimeError(
+            "prefix A/B recompiled during the timed rounds "
+            f"({DEFAULT_COMPILE_CACHE.stats()['misses'] - misses_before}"
+            " new compile-cache misses)")
+
+    em.emit("decode_prefix_cold_ttft_ms",
+            "decode cold-prefill TTFT share0.75", cold_ms,
+            unit="ms", lower_is_better=True)
+    row = em.emit("decode_prefix_warm_ttft_ms",
+                  "decode prefix-hit TTFT share0.75", warm_ms,
+                  unit="ms", lower_is_better=True)
+    if row is not None:
+        hits = st["prefix_hits"]
+        row.extra["prefix_hit_rate"] = round(
+            hits / max(hits + st["prefix_misses"], 1), 3)
+        row.extra["pages_reused"] = st["prefix_pages_reused"]
+        row.extra["pages_prefilled"] = st["prefix_pages_prefilled"]
+    em.emit("decode_prefix_ttft_speedup",
+            "decode prefix-hit vs cold-prefill TTFT speedup share0.75",
+            speedups, unit="x")
+
+    # multi-turn session leg: turn 2 replays turn 1's history + 2 new
+    # tokens; the backend must prefill ONLY the suffix (history KV
+    # stays resident under the session key) — asserted exactly via the
+    # prefill-token counter delta, with zero recompiles
+    serve.deploy("bench-prefix-sess", BertDecodeBackend, num_replicas=1,
+                 max_retries=1, init_kwargs=dict(PREFIX_KW),
+                 decode_policy=DecodePolicy(max_active=8, session=True),
+                 warmup_shapes=[16])
+    h = serve.get_handle("bench-prefix-sess")
+    dep = serve.get_deployment("bench-prefix-sess")
+
+    def sess_stats():
+        return rt.get(dep._replicas[0].stats.remote(), timeout=60.0)
+
+    fracs = []
+    for r in range(max(trials, 1)):
+        turn1 = {"ids": prefix_prompt(100 + r)["ids"],
+                 "session": f"bench/{r}", "max_new_tokens": 8}
+        hist = h.call(turn1, timeout=300.0)["tokens"]
+        ids2 = hist + [9, 9]
+        before = sess_stats()
+        out2 = h.call({"ids": ids2, "session": f"bench/{r}",
+                       "max_new_tokens": 8}, timeout=300.0)
+        after = sess_stats()
+        prefilled = after["prefill_tokens"] - before["prefill_tokens"]
+        # session resume holds len(hist)-1 positions; the admit feeds
+        # exactly the suffix (history's last token + the 2 new ones)
+        want_suffix = len(ids2) - (len(hist) - 1)
+        if prefilled != want_suffix:
+            raise RuntimeError(
+                f"session turn 2 prefilled {prefilled} tokens, "
+                f"expected the {want_suffix}-token suffix only")
+        if after["compile_cache"]["misses"] != \
+                before["compile_cache"]["misses"]:
+            raise RuntimeError("session resume recompiled at admit")
+        if out2["tokens"][:len(ids2)] != ids2:
+            raise RuntimeError("session turn 2 lost its history")
+        fracs.append(prefilled / len(ids2))
+    em.emit("decode_prefix_session_suffix_frac",
+            "decode session turn-2 prefilled-token fraction", fracs,
+            unit="frac", lower_is_better=True)
+    serve.delete("bench-prefix-sess")
+
+
 def run_decode_benchmarks(trials: int = 3, min_s: float = 0.5,
                           quiet: bool = False,
                           only: Optional[set] = None) -> List[ResultRow]:
@@ -441,8 +598,10 @@ def run_decode_benchmarks(trials: int = 3, min_s: float = 0.5,
     run_base = any(want(b) for b in base_ids)
     run_spec = any(want(b) for b in SCENARIO_BENCHES["spec"])
     run_beam = any(want(b) for b in SCENARIO_BENCHES["beam"])
+    run_prefix = any(want(b) for b in SCENARIO_BENCHES["prefix"])
 
-    serve = Serve() if (run_base or run_spec or run_beam) else None
+    serve = Serve() if (run_base or run_spec or run_beam
+                        or run_prefix) else None
     if run_base:
         # prompt bucket (one page) is the only prefill shape the paged
         # arm sees; the naive arm re-encodes through every growth bucket
@@ -530,6 +689,8 @@ def run_decode_benchmarks(trials: int = 3, min_s: float = 0.5,
         _spec_leg(em, serve, trials, min_s)
     if run_beam:
         _beam_leg(em, serve, trials, min_s)
+    if run_prefix:
+        _prefix_leg(em, serve, trials, min_s)
 
     if own_runtime:
         rt.shutdown()

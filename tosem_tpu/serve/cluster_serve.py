@@ -1190,9 +1190,12 @@ class ClusterServe:
                             info.get("deployment", "?"))
         nodes: Dict[str, Dict[str, Any]] = {}
         routed = spilled = 0
+        prefix_routed = prefix_transfers = 0
         for rs in router_stats:
             routed += rs.get("routed", 0)
             spilled += rs.get("spilled", 0)
+            prefix_routed += rs.get("prefix_routed", 0)
+            prefix_transfers += rs.get("prefix_transfers", 0)
             for node, depth in rs.get("node_queue_depth", {}).items():
                 cur = nodes.setdefault(node, {"queue_depth": 0,
                                               "replicas": 0})
@@ -1250,7 +1253,9 @@ class ClusterServe:
             self._exported_nodes = set(nodes)
         return {"deployments": deps, "routers": router_stats,
                 "nodes": nodes, "version": version,
-                "routed": routed, "spilled": spilled}
+                "routed": routed, "spilled": spilled,
+                "prefix_routed": prefix_routed,
+                "prefix_transfers": prefix_transfers}
 
     def close(self, stop_replicas: bool = True,
               close_pool: bool = False) -> None:

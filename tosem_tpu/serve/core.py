@@ -478,6 +478,30 @@ class Handle:
                                         timeout=timeout).result(timeout)
         return self.remote(request).result(timeout)
 
+    def stream(self, request: Any, on_token,
+               timeout: Optional[float] = None) -> Any:
+        """Streaming decode: ``on_token(tokens, done)`` fires from the
+        decode scheduler as tokens commit (it must be fast and non-
+        blocking — push into a queue, never write a slow socket
+        directly); returns the final result like :meth:`call`. Only a
+        continuous-batching (DecodeQueue) deployment streams."""
+        from tosem_tpu.serve.batching import DecodeQueue
+        dep = self._dep
+        if not isinstance(dep._queue, DecodeQueue) or self._pin is not None:
+            raise TypeError(
+                f"deployment {dep.name!r} has no decode queue to "
+                "stream from (deploy with decode_policy=...)")
+        breaker = dep.breaker
+        probe = breaker.allow() if breaker is not None else False
+        try:
+            fut = dep._queue.submit(request, probe=probe,
+                                    on_token=on_token)
+        except BaseException:
+            if breaker is not None and probe:
+                breaker.release_probe()
+            raise
+        return fut.result(timeout)
+
 
 class Serve:
     """The controller: name → deployment registry (serve/api.py:36 role)."""

@@ -13,11 +13,18 @@ deployments behind the router tier) both expose ``get_deployment`` /
 plane, ``POST /<endpoint>?key=<affinity>`` pins the request to its
 consistent-hash replica, and ``/-/stats`` serves the router-tier
 rollup (per-node queue depth, routed-vs-spilled counters).
+
+``POST /<endpoint>?stream=1`` switches a decode deployment to chunked
+transfer: one JSON line per committed token batch as the scheduler
+emits it, then a final ``{"result": ...}`` line. The scheduler thread
+never writes the socket — tokens bridge through a queue, so a slow or
+dropped client stalls only its own ingress thread.
 """
 from __future__ import annotations
 
 import inspect
 import json
+import queue
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
@@ -45,7 +52,13 @@ class HttpIngress:
                     n = int(self.headers.get("Content-Length", 0))
                     request = json.loads(self.rfile.read(n) or b"null")
                     handle = serve.get_handle(name)
-                    key = parse_qs(parts.query).get("key", [None])[0]
+                    qs = parse_qs(parts.query)
+                    key = qs.get("key", [None])[0]
+                    stream = qs.get("stream", ["0"])[0] \
+                        not in ("0", "", "false")
+                    if stream and hasattr(handle, "stream"):
+                        self._stream(handle, request)
+                        return
                     # affinity key: only a handle whose call() declares
                     # key= routes on it (the cluster handle); detected
                     # by SIGNATURE, never by catching TypeError around
@@ -61,6 +74,61 @@ class HttpIngress:
                     self._reply(200, {"result": result})
                 except Exception as e:  # backend failure → 500, not a crash
                     self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+
+            def _stream(self, handle, request) -> None:
+                """Per-token chunked streaming: the decode scheduler
+                pushes committed tokens into a queue (its callback
+                never blocks on this socket); THIS thread drains the
+                queue into chunked-transfer JSON lines."""
+                q: "queue.Queue" = queue.Queue()
+
+                def on_token(tokens, done):
+                    q.put((tokens, done))
+
+                worker_err = []
+
+                def run():
+                    try:
+                        result = handle.stream(
+                            request, on_token,
+                            timeout=ingress.request_timeout)
+                        q.put(("__result__", result))
+                    except BaseException as e:
+                        worker_err.append(e)
+                        q.put(("__error__", e))
+
+                t = threading.Thread(target=run, daemon=True,
+                                     name="serve-http-stream")
+                t.start()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "application/x-ndjson")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                try:
+                    while True:
+                        kind, payload = q.get(
+                            timeout=ingress.request_timeout)
+                        if kind == "__error__":
+                            self._chunk({"error":
+                                         f"{type(payload).__name__}: "
+                                         f"{payload}"})
+                            break
+                        if kind == "__result__":
+                            self._chunk({"result": payload})
+                            break
+                        self._chunk({"tokens": list(kind),
+                                     "done": bool(payload)})
+                    self.wfile.write(b"0\r\n\r\n")
+                except (BrokenPipeError, ConnectionError, OSError,
+                        queue.Empty):
+                    pass     # client gone / stalled: fails alone
+
+            def _chunk(self, payload) -> None:
+                body = json.dumps(payload).encode() + b"\n"
+                self.wfile.write(f"{len(body):x}\r\n".encode()
+                                 + body + b"\r\n")
+                self.wfile.flush()
 
             def do_GET(self):
                 if self.path.rstrip("/") in ("", "/-", "/-/routes"):

@@ -281,6 +281,31 @@ class PagedKVCache:
                                       length=src.length,
                                       released=src.released)
 
+    def fork_prefix(self, src_id, dst_id, n_pages: int) -> None:
+        """Share the first ``n_pages`` WHOLE pages of ``src`` with a new
+        sequence (refcount++ on exactly those pages) — the prefix-cache
+        hit path. The child owns ``n_pages * page_size`` positions and
+        its next :meth:`extend` appends into a FRESH page (page-aligned
+        length), so a prefix hit never triggers tail copy-on-write and
+        the shared bytes are read-only for the child by construction."""
+        with self._lock:
+            src = self._seqs[src_id]
+            if dst_id in self._seqs or dst_id in self._spilled:
+                raise ValueError(f"sequence {dst_id!r} already exists")
+            if src.released:
+                raise ValueError(
+                    f"cannot fork_prefix from window-evicted sequence "
+                    f"{src_id!r} ({src.released} pages released)")
+            full = src.length // self.page_size
+            if not 0 < n_pages <= full:
+                raise ValueError(
+                    f"fork_prefix wants {n_pages} whole pages; "
+                    f"{src_id!r} has {full} committed")
+            for p in src.pages[:n_pages]:
+                self._refs[p] += 1
+            self._seqs[dst_id] = _Seq(pages=list(src.pages[:n_pages]),
+                                      length=n_pages * self.page_size)
+
     def release_below(self, seq_id, floor_pos: int) -> int:
         """Sliding-window eviction: release leading pages whose EVERY
         position is below ``floor_pos`` (the lowest position any future
@@ -561,6 +586,11 @@ class PagedKVCache:
                 "pages_total": self.num_pages,
                 "pages_used": used,
                 "pages_free": len(self._free),
+                # each physical page counts ONCE in pages_used however
+                # many sequences share it; pages_shared breaks out the
+                # COW-shared subset so pressure gauges don't double-book
+                "pages_shared": sum(1 for c in self._refs.values()
+                                    if c > 1),
                 "pages_spilled": sum(s.n_pages
                                      for s in self._spilled.values()),
                 "pages_evicted_total": self._evicted,

@@ -67,6 +67,25 @@ class ReplicaHandlers:
         # typed (StaleEpochError) — a superseded head cannot double-
         # adopt KV state or stop a replica the new head owns
         self._epoch = Watermark()
+        # throttled prefix-digest snapshot piggybacked on responses
+        # (routers learn which prefixes live here without extra RPCs)
+        self._digest = None
+        self._digest_ts = 0.0
+
+    _DIGEST_TTL_S = 0.25
+
+    def _prefix_digest(self):
+        fn = getattr(self._backend, "prefix_digest", None)
+        if not callable(fn):
+            return None
+        now = time.monotonic()
+        if now - self._digest_ts > self._DIGEST_TTL_S:
+            try:
+                self._digest = fn()
+            except Exception:
+                self._digest = None
+            self._digest_ts = now
+        return self._digest
 
     def _enter(self) -> None:
         with self._lock:
@@ -88,7 +107,11 @@ class ReplicaHandlers:
             ok = True
         finally:
             depth = self._leave(ok)
-        return {"value": value, "load": depth}
+        out = {"value": value, "load": depth}
+        digest = self._prefix_digest()
+        if digest:
+            out["prefixes"] = digest
+        return out
 
     def call_batch(self, requests: List[Any],
                    bucket: Optional[int] = None) -> Dict[str, Any]:

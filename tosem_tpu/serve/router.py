@@ -100,6 +100,10 @@ class _Link:
         self.depth = 0
         self.depth_ts = 0.0
         self.dead = False
+        # last prefix digest this replica piggybacked on a response:
+        # bounded [depth, n_tokens, hash] triples of its hottest
+        # cached prefixes (None until the first decode response)
+        self.prefixes = None
 
     def client(self):
         from tosem_tpu.cluster.rpc import RpcClient
@@ -127,11 +131,17 @@ class RouterPolicy:
     def __init__(self, spill_depth: int = 4, scrape_ttl_s: float = 0.25,
                  failure_threshold: int = 8, cooldown_s: float = 2.0,
                  hedge_after_s: float = 0.0, hedge_quantile: float = 0.95,
-                 hedge_min_samples: int = 8):
+                 hedge_min_samples: int = 8, prefix_routing: bool = True):
         self.spill_depth = spill_depth
         self.scrape_ttl_s = scrape_ttl_s
         self.failure_threshold = failure_threshold
         self.cooldown_s = cooldown_s
+        # prefix-aware routing: un-keyed decode requests prefer the
+        # replica whose piggybacked digest holds their longest token
+        # prefix (depth still wins: an overloaded owner spills to
+        # least-loaded as usual, with a best-effort worker→worker
+        # prefix transfer to the replica that got the request instead)
+        self.prefix_routing = prefix_routing
         # hedging (Dean, "The Tail at Scale"): hedge_after_s > 0 arms
         # it — a request still in flight after the hedge delay is
         # re-dispatched to a SECOND replica, first success wins. The
@@ -152,7 +162,8 @@ class RouterPolicy:
                            "cooldown_s": self.cooldown_s,
                            "hedge_after_s": self.hedge_after_s,
                            "hedge_quantile": self.hedge_quantile,
-                           "hedge_min_samples": self.hedge_min_samples},
+                           "hedge_min_samples": self.hedge_min_samples,
+                           "prefix_routing": self.prefix_routing},
                           sort_keys=True)
 
     @classmethod
@@ -186,6 +197,9 @@ class RouterCore:
         self._hedged = 0          # hedge attempts launched
         self._hedge_wins = 0      # hedge attempts whose result was used
         self._deadline_shed = 0   # requests shed expired before dispatch
+        self._prefix_routed = 0   # picks overridden by a prefix match
+        self._prefix_transfers = 0       # worker→worker prefix pulls
+        self._prefix_transfer_fails = 0  # pulls that fell back cold
         # per-deployment latency rings feeding the quantile-derived
         # hedge delay; suspects: node names the controller de-preferences
         self._latency: Dict[str, deque] = {}
@@ -382,6 +396,89 @@ class RouterCore:
                 return best, True       # spillover: affinity overridden
             return primary, False
         return self._least_loaded(links, exclude), False
+
+    # -- prefix-aware routing ------------------------------------------
+
+    def _prefix_match(self, links: List[_Link], ids) -> Optional[tuple]:
+        """Deepest piggybacked digest entry that prefixes ``ids``
+        while leaving >= 1 suffix token: ``(link, depth, n_tokens,
+        hash)``, or None. Each candidate length hashes once however
+        many replicas advertise it."""
+        from tosem_tpu.serve.prefix_cache import prefix_hash
+        best = None
+        hashed: Dict[int, str] = {}
+        for lk in links:
+            if lk.dead or not lk.prefixes:
+                continue
+            for ent in lk.prefixes:
+                try:
+                    depth, n_tok, h = (int(ent[0]), int(ent[1]),
+                                       str(ent[2]))
+                except (TypeError, ValueError, IndexError):
+                    continue
+                if not 0 < n_tok < len(ids):
+                    continue
+                if best is not None and n_tok <= best[2]:
+                    continue
+                want = hashed.get(n_tok)
+                if want is None:
+                    want = hashed[n_tok] = prefix_hash(ids[:n_tok])
+                if want == h:
+                    best = (lk, depth, n_tok, h)
+        return best
+
+    def _apply_prefix_routing(self, deployment: str, request: Any,
+                              key: Optional[str], lk: _Link,
+                              spilled: bool,
+                              tried: set) -> Tuple[_Link, bool]:
+        """Longest-prefix override of one pick. An un-keyed decode
+        request reroutes to the replica advertising its deepest cached
+        prefix — unless that owner is suspect or past ``spill_depth``
+        (load still wins, exactly like affinity spill). When the pick
+        stands but another replica owns the prefix (keyed affinity, or
+        an overloaded owner), the matched pages are pulled worker→
+        worker into the picked replica first, so its admit prefills
+        only the suffix instead of recomputing the whole prompt."""
+        if not self.policy.prefix_routing or not isinstance(request, dict):
+            return lk, spilled
+        ids = request.get("ids")
+        if not isinstance(ids, (list, tuple)) or len(ids) < 2:
+            return lk, spilled
+        with self._lock:
+            links = [l for l in self._table.get(deployment, ())
+                     if l.address not in tried]
+        best = self._prefix_match(links, ids)
+        if best is None or best[0] is lk:
+            return lk, spilled
+        owner, depth, _, h = best
+        if (key is None and not owner.info.get("suspect")
+                and self._fresh_depth(owner) < self.policy.spill_depth):
+            with self._lock:
+                self._prefix_routed += 1
+            return owner, spilled
+        self._transfer_prefix(owner, lk, depth, h)
+        return lk, spilled
+
+    def _transfer_prefix(self, owner: _Link, dst: _Link, depth: int,
+                         h: str) -> None:
+        """Best-effort worker→worker prefix pull (owner streams the
+        pages to ``dst``'s tensor receiver, ``dst`` indexes them).
+        Failure just means a cold prefill — never the request's
+        verdict."""
+        try:
+            addr = getattr(dst, "_transport_addr", None)
+            if addr is None:
+                addr = dst.client().call("backend_call",
+                                         "transport_address")
+                dst._transport_addr = addr
+            owner.client().call("backend_call", "send_prefix",
+                                depth, h, addr)
+            dst.client().call("backend_call", "adopt_prefix", h)
+            with self._lock:
+                self._prefix_transfers += 1
+        except Exception:
+            with self._lock:
+                self._prefix_transfer_fails += 1
 
     # -- data plane ----------------------------------------------------
 
@@ -609,6 +706,8 @@ class RouterCore:
                         "deadline budget before dispatch")
                 try:
                     lk, spilled = self._pick(deployment, key, tried)
+                    lk, spilled = self._apply_prefix_routing(
+                        deployment, request, key, lk, spilled, tried)
                 except NoReplicaAvailable:
                     with self._lock:
                         self._errors += 1
@@ -649,6 +748,9 @@ class RouterCore:
                     raise ReplicaAppError(str(e)) from None
                 lk.depth = int(out.get("load", 0))
                 lk.depth_ts = time.monotonic()
+                prefixes = out.get("prefixes")
+                if prefixes is not None:
+                    lk.prefixes = prefixes
                 with self._lock:
                     if spilled:
                         self._spilled += 1
@@ -706,7 +808,11 @@ class RouterCore:
                    "retried": self._retried, "errors": self._errors,
                    "hedged": self._hedged,
                    "hedge_wins": self._hedge_wins,
-                   "deadline_shed": self._deadline_shed}
+                   "deadline_shed": self._deadline_shed,
+                   "prefix_routed": self._prefix_routed,
+                   "prefix_transfers": self._prefix_transfers,
+                   "prefix_transfer_fails":
+                       self._prefix_transfer_fails}
             requests: Dict[str, Dict[str, int]] = {}
             for (dep, path), n in self._dep_counts.items():
                 requests.setdefault(dep, {})[path] = n
